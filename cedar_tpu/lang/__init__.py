@@ -1,0 +1,23 @@
+"""Cedar language core: values, entities, parser, interpreter, authorization."""
+
+from .authorize import ALLOW, DENY, Diagnostics, PolicySet, Reason
+from .entities import Entity, EntityMap, unify_entities
+from .eval import Env, Request, evaluate, policy_matches
+from .lexer import ParseError
+from .parser import parse_policies, parse_policy
+from .values import (
+    CedarRecord,
+    CedarSet,
+    Decimal,
+    EntityUID,
+    EvalError,
+    IPAddr,
+)
+
+__all__ = [
+    "ALLOW", "DENY", "Diagnostics", "PolicySet", "Reason",
+    "Entity", "EntityMap", "unify_entities",
+    "Env", "Request", "evaluate", "policy_matches",
+    "ParseError", "parse_policies", "parse_policy",
+    "CedarRecord", "CedarSet", "Decimal", "EntityUID", "EvalError", "IPAddr",
+]

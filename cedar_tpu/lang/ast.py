@@ -1,0 +1,244 @@
+"""Cedar policy AST.
+
+The node set covers the Cedar subset exercised by the reference project's
+policies, tests, and RBAC converter output (see /root/reference
+internal/convert/testdata/*.cedar and demo/*.yaml): annotations, the three
+scope clauses with ==/in/is/is-in forms, when/unless conditions, short-circuit
+boolean operators, comparisons, `in`, `has`, `like`, `is`, attribute access,
+set/record literals, contains/containsAll/containsAny, if-then-else,
+arithmetic, and the ip/decimal extension constructors and methods.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+from .values import EntityUID
+
+PERMIT = "permit"
+FORBID = "forbid"
+
+# ---------------------------------------------------------------- expressions
+
+
+class Expr:
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class Lit(Expr):
+    """A literal Bool/Long/String value."""
+
+    value: Any
+
+
+@dataclass(frozen=True)
+class EntityLit(Expr):
+    uid: EntityUID
+
+
+@dataclass(frozen=True)
+class Var(Expr):
+    """principal | action | resource | context"""
+
+    name: str
+
+
+@dataclass(frozen=True)
+class Unary(Expr):
+    op: str  # "!" | "neg"
+    arg: Expr
+
+
+@dataclass(frozen=True)
+class Binary(Expr):
+    """Strict binary ops: == != < <= > >= + - * in"""
+
+    op: str
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class And(Expr):
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class Or(Expr):
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class If(Expr):
+    cond: Expr
+    then: Expr
+    els: Expr
+
+
+@dataclass(frozen=True)
+class GetAttr(Expr):
+    obj: Expr
+    attr: str
+
+
+@dataclass(frozen=True)
+class HasAttr(Expr):
+    obj: Expr
+    attr: str
+
+
+@dataclass(frozen=True)
+class Like(Expr):
+    obj: Expr
+    pattern: "Pattern"
+
+
+@dataclass(frozen=True)
+class Is(Expr):
+    obj: Expr
+    entity_type: str
+    in_entity: Optional[Expr] = None  # for `x is T in e`
+
+
+@dataclass(frozen=True)
+class SetLit(Expr):
+    elems: Tuple[Expr, ...]
+
+
+@dataclass(frozen=True)
+class RecordLit(Expr):
+    pairs: Tuple[Tuple[str, Expr], ...]
+
+
+@dataclass(frozen=True)
+class MethodCall(Expr):
+    """obj.method(args): contains/containsAll/containsAny + extension methods
+    (isIpv4, isIpv6, isLoopback, isMulticast, isInRange, lessThan, ...)."""
+
+    obj: Expr
+    method: str
+    args: Tuple[Expr, ...]
+
+
+@dataclass(frozen=True)
+class ExtCall(Expr):
+    """ip("...") / decimal("...") constructors."""
+
+    func: str
+    args: Tuple[Expr, ...]
+
+
+# ------------------------------------------------------------------- patterns
+
+
+@dataclass(frozen=True)
+class Pattern:
+    """A `like` pattern: sequence of components, each a literal chunk or the
+    wildcard. Parsed from a string literal where `*` is the wildcard and
+    `\\*` is a literal asterisk."""
+
+    components: Tuple[Any, ...]  # str chunks and the sentinel WILDCARD
+
+    def match(self, s: str) -> bool:
+        return _match_components(self.components, s)
+
+    def source(self) -> str:
+        out = []
+        for c in self.components:
+            if c is WILDCARD:
+                out.append("*")
+            else:
+                out.append(c.replace("\\", "\\\\").replace("*", "\\*"))
+        return "".join(out)
+
+
+class _Wildcard:
+    def __repr__(self):
+        return "*"
+
+
+WILDCARD = _Wildcard()
+
+
+def _match_components(comps: Tuple[Any, ...], s: str) -> bool:
+    # Bottom-up DP over (component index, string index): worst case
+    # O(len(comps) * len(s)) — no exponential backtracking on adversarial,
+    # request-supplied strings.
+    n = len(comps)
+    m = len(s)
+    # ok[si] == comps[ci:] matches s[si:], computed for ci from n down to 0
+    ok = [False] * (m + 1)
+    ok[m] = True
+    for ci in range(n - 1, -1, -1):
+        c = comps[ci]
+        nxt = ok
+        ok = [False] * (m + 1)
+        if c is WILDCARD:
+            # suffix-or: ok[si] = any(nxt[k] for k >= si)
+            acc = False
+            for si in range(m, -1, -1):
+                acc = acc or nxt[si]
+                ok[si] = acc
+        else:
+            L = len(c)
+            for si in range(m - L + 1):
+                if nxt[si + L] and s.startswith(c, si):
+                    ok[si] = True
+    return ok[0]
+
+
+# --------------------------------------------------------------------- scopes
+
+
+@dataclass(frozen=True)
+class Scope:
+    """One scope clause (principal/action/resource).
+
+    op is one of:
+      "all"      -- bare variable, matches anything
+      "eq"       -- == entity
+      "in"       -- in entity (or, for action, in [entities...])
+      "is"       -- is Type
+      "is_in"    -- is Type in entity
+    """
+
+    op: str
+    entity: Optional[EntityUID] = None
+    entities: Tuple[EntityUID, ...] = ()  # for action in [...]
+    entity_type: Optional[str] = None
+
+
+SCOPE_ALL = Scope("all")
+
+
+# ------------------------------------------------------------------- policies
+
+
+@dataclass(frozen=True)
+class Condition:
+    kind: str  # "when" | "unless"
+    body: Expr
+
+
+@dataclass
+class Policy:
+    effect: str  # PERMIT | FORBID
+    principal: Scope = SCOPE_ALL
+    action: Scope = SCOPE_ALL
+    resource: Scope = SCOPE_ALL
+    conditions: Tuple[Condition, ...] = ()
+    annotations: Tuple[Tuple[str, str], ...] = ()
+    # source info
+    policy_id: str = ""
+    filename: str = ""
+    position: Tuple[int, int, int] = (0, 1, 1)  # offset, line, column
+
+    def annotation(self, key: str) -> Optional[str]:
+        for k, v in self.annotations:
+            if k == key:
+                return v
+        return None

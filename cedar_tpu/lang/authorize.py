@@ -1,0 +1,120 @@
+"""PolicySet + authorization decision with diagnostics.
+
+Mirrors the contract of cedar-go's ``cedar.PolicySet.IsAuthorized(entities,
+request) (Decision, Diagnostic)`` that the reference calls at
+/root/reference internal/server/store/store.go:31, including:
+  * forbid overrides permit; default decision is Deny with no reasons
+  * Diagnostic.Reasons lists the determining policies with source positions
+  * a policy that errors during evaluation is skipped and recorded in
+    Diagnostic.Errors
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .ast import FORBID, PERMIT, Policy
+from .entities import EntityMap
+from .eval import Env, Request, policy_matches
+from .parser import parse_policies
+from .values import EvalError
+
+ALLOW = "allow"
+DENY = "deny"
+
+
+@dataclass(frozen=True)
+class Reason:
+    policy: str
+    filename: str
+    position: Tuple[int, int, int]  # offset, line, column
+
+    def to_dict(self) -> dict:
+        off, line, col = self.position
+        return {
+            "policy": self.policy,
+            "position": {
+                "filename": self.filename,
+                "offset": off,
+                "line": line,
+                "column": col,
+            },
+        }
+
+
+@dataclass
+class Diagnostics:
+    reasons: List[Reason] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+
+    def to_json(self) -> str:
+        out: dict = {}
+        if self.reasons:
+            out["reasons"] = [r.to_dict() for r in self.reasons]
+        if self.errors:
+            out["errors"] = self.errors
+        return json.dumps(out, separators=(",", ":"))
+
+
+class PolicySet:
+    """An ordered, named collection of parsed policies."""
+
+    def __init__(self, policies: Optional[List[Policy]] = None):
+        self._policies: Dict[str, Policy] = {}
+        for p in policies or []:
+            self.add(p)
+
+    @classmethod
+    def from_source(cls, src: str, filename: str = "") -> "PolicySet":
+        return cls(parse_policies(src, filename))
+
+    def add(self, p: Policy, policy_id: Optional[str] = None) -> None:
+        pid = policy_id or p.policy_id or f"policy{len(self._policies)}"
+        p.policy_id = pid
+        self._policies[pid] = p
+
+    def remove(self, policy_id: str) -> None:
+        self._policies.pop(policy_id, None)
+
+    def policies(self) -> List[Policy]:
+        return list(self._policies.values())
+
+    def get(self, policy_id: str) -> Optional[Policy]:
+        return self._policies.get(policy_id)
+
+    def __len__(self) -> int:
+        return len(self._policies)
+
+    def merged_with(self, other: "PolicySet") -> "PolicySet":
+        out = PolicySet()
+        out._policies.update(self._policies)
+        out._policies.update(other._policies)
+        return out
+
+    def is_authorized(
+        self, entities: EntityMap, request: Request
+    ) -> Tuple[str, Diagnostics]:
+        env = Env(request, entities)
+        forbids: List[Reason] = []
+        permits: List[Reason] = []
+        errors: List[str] = []
+        for pid, p in self._policies.items():
+            try:
+                matched = policy_matches(p, env)
+            except EvalError as e:
+                errors.append(f"while evaluating policy `{pid}`: {e}")
+                continue
+            if not matched:
+                continue
+            reason = Reason(pid, p.filename, p.position)
+            if p.effect == FORBID:
+                forbids.append(reason)
+            else:
+                permits.append(reason)
+        if forbids:
+            return DENY, Diagnostics(reasons=forbids, errors=errors)
+        if permits:
+            return ALLOW, Diagnostics(reasons=permits, errors=errors)
+        return DENY, Diagnostics(reasons=[], errors=errors)

@@ -1,0 +1,93 @@
+"""Cedar entity store: entities with attributes and parent hierarchy.
+
+Mirrors the role of cedar-go's ``cedar.EntityMap`` as used by the reference
+webhook (entities built per request, e.g. /root/reference
+internal/server/entities/user.go:35, and merged via
+internal/server/entities/entities.go:7).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional
+
+from .values import CedarRecord, EntityUID
+
+
+class Entity:
+    __slots__ = ("uid", "attrs", "parents")
+
+    def __init__(
+        self,
+        uid: EntityUID,
+        attrs: Optional[CedarRecord] = None,
+        parents: Iterable[EntityUID] = (),
+    ):
+        self.uid = uid
+        self.attrs = attrs if attrs is not None else CedarRecord()
+        self.parents = tuple(parents)
+
+    def __repr__(self) -> str:
+        return f"Entity({self.uid!r}, attrs={self.attrs!r}, parents={list(self.parents)!r})"
+
+
+class EntityMap:
+    """uid -> Entity, with transitive ancestor queries for ``in``."""
+
+    def __init__(self, entities: Iterable[Entity] = ()):
+        self._by_uid: Dict[EntityUID, Entity] = {}
+        for e in entities:
+            self._by_uid[e.uid] = e
+
+    def add(self, e: Entity) -> None:
+        self._by_uid[e.uid] = e
+
+    def get(self, uid: EntityUID) -> Optional[Entity]:
+        return self._by_uid.get(uid)
+
+    def __contains__(self, uid: EntityUID) -> bool:
+        return uid in self._by_uid
+
+    def __iter__(self):
+        return iter(self._by_uid.values())
+
+    def __len__(self) -> int:
+        return len(self._by_uid)
+
+    def attrs_of(self, uid: EntityUID) -> CedarRecord:
+        e = self._by_uid.get(uid)
+        return e.attrs if e is not None else CedarRecord()
+
+    def is_ancestor_or_self(self, child: EntityUID, anc: EntityUID) -> bool:
+        """``child in anc``: true iff child == anc or anc is a transitive
+        parent of child."""
+        if child == anc:
+            return True
+        seen = set()
+        stack = [child]
+        while stack:
+            cur = stack.pop()
+            ent = self._by_uid.get(cur)
+            if ent is None:
+                continue
+            for p in ent.parents:
+                if p == anc:
+                    return True
+                if p not in seen:
+                    seen.add(p)
+                    stack.append(p)
+        return False
+
+    def merged_with(self, other: "EntityMap") -> "EntityMap":
+        """Union of two maps; entries in ``other`` win on uid collision
+        (reference: entities.go UnifyEntities/MergeIntoEntities)."""
+        out = EntityMap()
+        out._by_uid.update(self._by_uid)
+        out._by_uid.update(other._by_uid)
+        return out
+
+
+def unify_entities(*maps: EntityMap) -> EntityMap:
+    out = EntityMap()
+    for m in maps:
+        out._by_uid.update(m._by_uid)
+    return out

@@ -1,0 +1,284 @@
+"""Cedar expression evaluator — the interpreter oracle.
+
+This is the reference-semantics implementation that (a) backs the
+``--backend=interpreter`` evaluation path, (b) serves as the differential
+oracle for the TPU compiler (same inputs must yield identical decisions), and
+(c) evaluates policies the tensor compiler declines to lower.
+
+Semantics follow the Cedar spec as implemented by cedar-go v1.1.0 (the engine
+the reference webhook calls at /root/reference internal/server/store/store.go:31):
+  * ``&&``/``||`` short-circuit; an error on an unevaluated branch is invisible
+  * ``==`` across types is False, never an error
+  * ordering/arithmetic are Long-only, with i64 overflow errors
+  * attribute access on a missing attribute (or unknown entity) is an error
+  * a policy whose condition errors does not match (recorded in diagnostics)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from .ast import (
+    And,
+    Binary,
+    EntityLit,
+    ExtCall,
+    Expr,
+    GetAttr,
+    HasAttr,
+    If,
+    Is,
+    Like,
+    Lit,
+    MethodCall,
+    Or,
+    Policy,
+    RecordLit,
+    Scope,
+    SetLit,
+    Unary,
+    Var,
+)
+from .entities import EntityMap
+from .values import (
+    CedarRecord,
+    CedarSet,
+    Decimal,
+    EntityUID,
+    EvalError,
+    IPAddr,
+    cedar_eq,
+    checked_arith,
+    require_bool,
+    require_entity,
+    require_long,
+    require_set,
+    require_string,
+)
+
+
+@dataclass
+class Request:
+    principal: EntityUID
+    action: EntityUID
+    resource: EntityUID
+    context: CedarRecord
+
+
+class Env:
+    __slots__ = ("request", "entities")
+
+    def __init__(self, request: Request, entities: EntityMap):
+        self.request = request
+        self.entities = entities
+
+
+def evaluate(e: Expr, env: Env) -> Any:
+    if isinstance(e, Lit):
+        return e.value
+    if isinstance(e, EntityLit):
+        return e.uid
+    if isinstance(e, Var):
+        r = env.request
+        if e.name == "principal":
+            return r.principal
+        if e.name == "action":
+            return r.action
+        if e.name == "resource":
+            return r.resource
+        return r.context
+    if isinstance(e, And):
+        if not require_bool(evaluate(e.left, env)):
+            return False
+        return require_bool(evaluate(e.right, env))
+    if isinstance(e, Or):
+        if require_bool(evaluate(e.left, env)):
+            return True
+        return require_bool(evaluate(e.right, env))
+    if isinstance(e, Unary):
+        v = evaluate(e.arg, env)
+        if e.op == "!":
+            return not require_bool(v)
+        return checked_arith(-require_long(v))
+    if isinstance(e, Binary):
+        return _binary(e, env)
+    if isinstance(e, If):
+        if require_bool(evaluate(e.cond, env)):
+            return evaluate(e.then, env)
+        return evaluate(e.els, env)
+    if isinstance(e, GetAttr):
+        obj = evaluate(e.obj, env)
+        attrs = _attrs_of(obj, env)
+        if e.attr not in attrs.attrs:
+            raise EvalError(f"attribute {e.attr!r} not found")
+        return attrs.attrs[e.attr]
+    if isinstance(e, HasAttr):
+        obj = evaluate(e.obj, env)
+        return e.attr in _attrs_of(obj, env).attrs
+    if isinstance(e, Like):
+        s = require_string(evaluate(e.obj, env))
+        return e.pattern.match(s)
+    if isinstance(e, Is):
+        v = require_entity(evaluate(e.obj, env))
+        ok = v.type == e.entity_type
+        if ok and e.in_entity is not None:
+            return _entity_in(v, evaluate(e.in_entity, env), env)
+        return ok
+    if isinstance(e, SetLit):
+        return CedarSet(tuple(evaluate(x, env) for x in e.elems))
+    if isinstance(e, RecordLit):
+        return CedarRecord({k: evaluate(v, env) for k, v in e.pairs})
+    if isinstance(e, MethodCall):
+        return _method(e, env)
+    if isinstance(e, ExtCall):
+        return _ext(e, env)
+    raise EvalError(f"unknown expression node {type(e).__name__}")
+
+
+_EMPTY_RECORD = CedarRecord()
+
+
+def _attrs_of(obj: Any, env: Env) -> CedarRecord:
+    if isinstance(obj, CedarRecord):
+        return obj
+    if isinstance(obj, EntityUID):
+        ent = env.entities.get(obj)
+        # An entity absent from the store behaves as an attribute-less record
+        # (cedar-go: `has` is false, attribute access is a not-found error).
+        return ent.attrs if ent is not None else _EMPTY_RECORD
+    raise EvalError("type error: attribute access on non-entity, non-record")
+
+
+def _entity_in(left: EntityUID, right: Any, env: Env) -> bool:
+    if isinstance(right, EntityUID):
+        return env.entities.is_ancestor_or_self(left, right)
+    if isinstance(right, CedarSet):
+        for r in right:
+            if not isinstance(r, EntityUID):
+                raise EvalError("type error: `in` set must contain entities")
+            if env.entities.is_ancestor_or_self(left, r):
+                return True
+        return False
+    raise EvalError("type error: `in` right side must be entity or set of entities")
+
+
+def _binary(e: Binary, env: Env) -> Any:
+    op = e.op
+    left = evaluate(e.left, env)
+    right = evaluate(e.right, env)
+    if op == "==":
+        return cedar_eq(left, right)
+    if op == "!=":
+        return not cedar_eq(left, right)
+    if op == "in":
+        return _entity_in(require_entity(left), right, env)
+    if op in ("<", "<=", ">", ">="):
+        a, b = require_long(left), require_long(right)
+        return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[op]
+    a, b = require_long(left), require_long(right)
+    if op == "+":
+        return checked_arith(a + b)
+    if op == "-":
+        return checked_arith(a - b)
+    if op == "*":
+        return checked_arith(a * b)
+    raise EvalError(f"unknown operator {op!r}")
+
+
+def _method(e: MethodCall, env: Env) -> Any:
+    obj = evaluate(e.obj, env)
+    m = e.method
+    if m == "contains":
+        if len(e.args) != 1:
+            raise EvalError("contains takes exactly 1 argument")
+        return require_set(obj).contains(evaluate(e.args[0], env))
+    if m in ("containsAll", "containsAny"):
+        if len(e.args) != 1:
+            raise EvalError(f"{m} takes exactly 1 argument")
+        s = require_set(obj)
+        arg = require_set(evaluate(e.args[0], env))
+        if m == "containsAll":
+            return all(s.contains(x) for x in arg)
+        return any(s.contains(x) for x in arg)
+    if m in ("isIpv4", "isIpv6", "isLoopback", "isMulticast", "isInRange"):
+        if not isinstance(obj, IPAddr):
+            raise EvalError(f"type error: {m} on non-ipaddr")
+        if m == "isInRange":
+            arg = evaluate(e.args[0], env)
+            if not isinstance(arg, IPAddr):
+                raise EvalError("type error: isInRange argument must be ipaddr")
+            return obj.is_in_range(arg)
+        return {
+            "isIpv4": obj.is_ipv4,
+            "isIpv6": obj.is_ipv6,
+            "isLoopback": obj.is_loopback,
+            "isMulticast": obj.is_multicast,
+        }[m]()
+    if m in ("lessThan", "lessThanOrEqual", "greaterThan", "greaterThanOrEqual"):
+        if not isinstance(obj, Decimal):
+            raise EvalError(f"type error: {m} on non-decimal")
+        arg = evaluate(e.args[0], env)
+        if not isinstance(arg, Decimal):
+            raise EvalError(f"type error: {m} argument must be decimal")
+        return {
+            "lessThan": obj.units < arg.units,
+            "lessThanOrEqual": obj.units <= arg.units,
+            "greaterThan": obj.units > arg.units,
+            "greaterThanOrEqual": obj.units >= arg.units,
+        }[m]
+    raise EvalError(f"unknown method {m!r}")
+
+
+def _ext(e: ExtCall, env: Env) -> Any:
+    if len(e.args) != 1:
+        raise EvalError(f"{e.func} takes exactly 1 argument")
+    arg = require_string(evaluate(e.args[0], env))
+    if e.func == "ip":
+        return IPAddr.parse(arg)
+    if e.func == "decimal":
+        return Decimal.parse(arg)
+    raise EvalError(f"unknown function {e.func!r}")
+
+
+# ----------------------------------------------------------- policy matching
+
+
+def scope_matches(scope: Scope, value: EntityUID, env: Env) -> bool:
+    op = scope.op
+    if op == "all":
+        return True
+    if op == "eq":
+        return value == scope.entity
+    if op == "in":
+        if scope.entities:
+            return any(
+                env.entities.is_ancestor_or_self(value, e) for e in scope.entities
+            )
+        return env.entities.is_ancestor_or_self(value, scope.entity)
+    if op == "is":
+        return value.type == scope.entity_type
+    if op == "is_in":
+        return value.type == scope.entity_type and env.entities.is_ancestor_or_self(
+            value, scope.entity
+        )
+    raise EvalError(f"unknown scope op {op!r}")
+
+
+def policy_matches(p: Policy, env: Env) -> bool:
+    """True iff the policy's scope matches and all when/unless conditions
+    hold. Raises EvalError if a condition errors (caller records + skips)."""
+    r = env.request
+    if not scope_matches(p.principal, r.principal, env):
+        return False
+    if not scope_matches(p.action, r.action, env):
+        return False
+    if not scope_matches(p.resource, r.resource, env):
+        return False
+    for c in p.conditions:
+        v = require_bool(evaluate(c.body, env))
+        if c.kind == "when" and not v:
+            return False
+        if c.kind == "unless" and v:
+            return False
+    return True

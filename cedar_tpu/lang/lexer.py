@@ -1,0 +1,206 @@
+"""Cedar lexer.
+
+Produces a token stream with positions (offset, line, column). String tokens
+keep their raw source text so `like` patterns can reinterpret ``\\*`` as a
+literal asterisk (Cedar only permits that escape inside patterns).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from .values import EvalError
+
+
+class ParseError(Exception):
+    def __init__(self, msg: str, line: int = 0, col: int = 0):
+        super().__init__(f"{msg} at line {line}:{col}" if line else msg)
+        self.line = line
+        self.col = col
+
+
+@dataclass
+class Token:
+    kind: str  # IDENT STRING LONG PUNCT EOF
+    text: str
+    offset: int
+    line: int
+    col: int
+    value: object = None  # cooked string / int value
+
+
+PUNCTS = [
+    "::",
+    "==",
+    "!=",
+    "<=",
+    ">=",
+    "&&",
+    "||",
+    "(",
+    ")",
+    "[",
+    "]",
+    "{",
+    "}",
+    ",",
+    ";",
+    ":",
+    ".",
+    "<",
+    ">",
+    "!",
+    "+",
+    "-",
+    "*",
+    "@",
+    "=",
+]
+
+
+def unescape(raw: str, line: int, col: int, pattern: bool = False):
+    """Cook the body of a string literal. If ``pattern``, returns a list of
+    components (str chunks and the WILDCARD sentinel) for `like`."""
+    from .ast import WILDCARD
+
+    out: List[object] = []
+    buf: List[str] = []
+    i = 0
+    n = len(raw)
+    while i < n:
+        c = raw[i]
+        if c == "\\":
+            if i + 1 >= n:
+                raise ParseError("bad escape at end of string", line, col)
+            e = raw[i + 1]
+            i += 2
+            if e == "n":
+                buf.append("\n")
+            elif e == "r":
+                buf.append("\r")
+            elif e == "t":
+                buf.append("\t")
+            elif e == "\\":
+                buf.append("\\")
+            elif e == '"':
+                buf.append('"')
+            elif e == "'":
+                buf.append("'")
+            elif e == "0":
+                buf.append("\0")
+            elif e == "*":
+                # Cedar only allows \* inside `like` patterns; the lexer cooks
+                # strings before pattern-ness is known, so accept it leniently
+                # as a literal asterisk here (patterns re-cook from raw text).
+                buf.append("*")
+            elif e == "u" and i < n and raw[i] == "{":
+                j = raw.find("}", i)
+                if j < 0:
+                    raise ParseError("unterminated \\u{...} escape", line, col)
+                try:
+                    buf.append(chr(int(raw[i + 1 : j], 16)))
+                except (ValueError, OverflowError):
+                    raise ParseError(
+                        f"bad \\u{{{raw[i + 1:j]}}} escape", line, col
+                    ) from None
+                i = j + 1
+            else:
+                raise ParseError(f"unknown escape \\{e}", line, col)
+        elif c == "*" and pattern:
+            if buf:
+                out.append("".join(buf))
+                buf = []
+            if not out or out[-1] is not WILDCARD:
+                out.append(WILDCARD)
+            i += 1
+        else:
+            buf.append(c)
+            i += 1
+    if pattern:
+        if buf:
+            out.append("".join(buf))
+        return out
+    return "".join(buf)
+
+
+def tokenize(src: str) -> List[Token]:
+    toks: List[Token] = []
+    i = 0
+    line = 1
+    col = 1
+    n = len(src)
+
+    def adv(k: int):
+        nonlocal i, line, col
+        for _ in range(k):
+            if i < n and src[i] == "\n":
+                line += 1
+                col = 1
+            else:
+                col += 1
+            i += 1
+
+    while i < n:
+        c = src[i]
+        if c in " \t\r\n":
+            adv(1)
+            continue
+        if c == "/" and i + 1 < n and src[i + 1] == "/":
+            while i < n and src[i] != "\n":
+                adv(1)
+            continue
+        if c == "/" and i + 1 < n and src[i + 1] == "*":
+            adv(2)
+            while i + 1 < n and not (src[i] == "*" and src[i + 1] == "/"):
+                adv(1)
+            if i + 1 >= n:
+                raise ParseError("unterminated block comment", line, col)
+            adv(2)
+            continue
+        start, sl, sc = i, line, col
+        if c == '"':
+            adv(1)
+            raw_start = i
+            while i < n and src[i] != '"':
+                if src[i] == "\\":
+                    adv(2)
+                else:
+                    adv(1)
+            if i >= n:
+                raise ParseError("unterminated string", sl, sc)
+            raw = src[raw_start:i]
+            adv(1)
+            cooked = unescape(raw, sl, sc)
+            toks.append(Token("STRING", raw, start, sl, sc, cooked))
+            continue
+        if c.isdigit():
+            j = i
+            while j < n and src[j].isdigit():
+                j += 1
+            text = src[i:j]
+            adv(j - i)
+            val = int(text)
+            if val > 2**63 - 1:
+                raise ParseError(f"long literal {text} exceeds i64 range", sl, sc)
+            toks.append(Token("LONG", text, start, sl, sc, val))
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (src[j].isalnum() or src[j] == "_"):
+                j += 1
+            text = src[i:j]
+            adv(j - i)
+            toks.append(Token("IDENT", text, start, sl, sc))
+            continue
+        matched = None
+        for p in PUNCTS:
+            if src.startswith(p, i):
+                matched = p
+                break
+        if matched is None:
+            raise ParseError(f"unexpected character {c!r}", line, col)
+        adv(len(matched))
+        toks.append(Token("PUNCT", matched, start, sl, sc))
+    toks.append(Token("EOF", "", i, line, col))
+    return toks

@@ -1,0 +1,391 @@
+"""Recursive-descent parser for the Cedar policy language.
+
+Covers the full surface used by the reference project (demo policies,
+converter output, authorizer tests — see /root/reference
+internal/convert/testdata/*.cedar): annotations, scope operators
+(==, in, is, is-in, action-in-list), when/unless conditions, and the Cedar
+expression grammar with its single non-associative relational level.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .ast import (
+    And,
+    Binary,
+    Condition,
+    EntityLit,
+    ExtCall,
+    Expr,
+    GetAttr,
+    HasAttr,
+    If,
+    Is,
+    Like,
+    Lit,
+    MethodCall,
+    Or,
+    Pattern,
+    Policy,
+    RecordLit,
+    Scope,
+    SetLit,
+    Unary,
+    Var,
+    SCOPE_ALL,
+)
+from .lexer import ParseError, Token, tokenize, unescape
+from .values import EntityUID
+
+EXT_FUNCS = {"ip", "decimal"}
+METHODS = {
+    "contains",
+    "containsAll",
+    "containsAny",
+    "isIpv4",
+    "isIpv6",
+    "isLoopback",
+    "isMulticast",
+    "isInRange",
+    "lessThan",
+    "lessThanOrEqual",
+    "greaterThan",
+    "greaterThanOrEqual",
+}
+RESERVED_VARS = {"principal", "action", "resource", "context"}
+
+
+class Parser:
+    def __init__(self, toks: List[Token]):
+        self.toks = toks
+        self.pos = 0
+
+    # ------------------------------------------------------------- utilities
+
+    def peek(self, k: int = 0) -> Token:
+        return self.toks[min(self.pos + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.pos]
+        if t.kind != "EOF":
+            self.pos += 1
+        return t
+
+    def at(self, kind: str, text: Optional[str] = None, k: int = 0) -> bool:
+        t = self.peek(k)
+        return t.kind == kind and (text is None or t.text == text)
+
+    def expect(self, kind: str, text: Optional[str] = None) -> Token:
+        t = self.peek()
+        if not self.at(kind, text):
+            want = text or kind
+            raise ParseError(f"expected {want!r}, got {t.text!r}", t.line, t.col)
+        return self.next()
+
+    def err(self, msg: str) -> ParseError:
+        t = self.peek()
+        return ParseError(f"{msg} (got {t.text!r})", t.line, t.col)
+
+    # --------------------------------------------------------------- policies
+
+    def parse_policies(self) -> List[Policy]:
+        out = []
+        while not self.at("EOF"):
+            out.append(self.parse_policy())
+        return out
+
+    def parse_policy(self) -> Policy:
+        first = self.peek()
+        annotations: List[Tuple[str, str]] = []
+        while self.at("PUNCT", "@"):
+            self.next()
+            key = self.expect("IDENT").text
+            self.expect("PUNCT", "(")
+            val = self.expect("STRING").value
+            self.expect("PUNCT", ")")
+            annotations.append((key, val))
+        eff = self.expect("IDENT")
+        if eff.text not in ("permit", "forbid"):
+            raise ParseError(f"expected permit/forbid, got {eff.text!r}", eff.line, eff.col)
+        self.expect("PUNCT", "(")
+        principal = self.parse_scope("principal")
+        self.expect("PUNCT", ",")
+        action = self.parse_scope("action")
+        self.expect("PUNCT", ",")
+        resource = self.parse_scope("resource")
+        self.expect("PUNCT", ")")
+        conds: List[Condition] = []
+        while self.at("IDENT", "when") or self.at("IDENT", "unless"):
+            kind = self.next().text
+            self.expect("PUNCT", "{")
+            body = self.parse_expr()
+            self.expect("PUNCT", "}")
+            conds.append(Condition(kind, body))
+        self.expect("PUNCT", ";")
+        return Policy(
+            effect=eff.text,
+            principal=principal,
+            action=action,
+            resource=resource,
+            conditions=tuple(conds),
+            annotations=tuple(annotations),
+            position=(first.offset, first.line, first.col),
+        )
+
+    def parse_scope(self, var: str) -> Scope:
+        self.expect("IDENT", var)
+        if self.at("PUNCT", ",") or self.at("PUNCT", ")"):
+            return SCOPE_ALL
+        if self.at("PUNCT", "=="):
+            self.next()
+            return Scope("eq", entity=self.parse_entity_ref())
+        if self.at("IDENT", "in"):
+            self.next()
+            if var == "action" and self.at("PUNCT", "["):
+                self.next()
+                ents = [self.parse_entity_ref()]
+                while self.at("PUNCT", ","):
+                    self.next()
+                    if self.at("PUNCT", "]"):
+                        break
+                    ents.append(self.parse_entity_ref())
+                self.expect("PUNCT", "]")
+                return Scope("in", entities=tuple(ents))
+            return Scope("in", entity=self.parse_entity_ref())
+        if self.at("IDENT", "is"):
+            self.next()
+            etype = self.parse_path()
+            if self.at("IDENT", "in"):
+                self.next()
+                return Scope("is_in", entity=self.parse_entity_ref(), entity_type=etype)
+            return Scope("is", entity_type=etype)
+        raise self.err(f"bad {var} scope")
+
+    def parse_path(self) -> str:
+        parts = [self.expect("IDENT").text]
+        while self.at("PUNCT", "::") and self.at("IDENT", k=1):
+            self.next()
+            parts.append(self.expect("IDENT").text)
+        return "::".join(parts)
+
+    def parse_entity_ref(self) -> EntityUID:
+        etype = self.parse_path()
+        self.expect("PUNCT", "::")
+        eid = self.expect("STRING").value
+        return EntityUID(etype, eid)
+
+    # ------------------------------------------------------------ expressions
+
+    def parse_expr(self) -> Expr:
+        if self.at("IDENT", "if"):
+            self.next()
+            cond = self.parse_expr()
+            self.expect("IDENT", "then")
+            then = self.parse_expr()
+            self.expect("IDENT", "else")
+            els = self.parse_expr()
+            return If(cond, then, els)
+        return self.parse_or()
+
+    def parse_or(self) -> Expr:
+        left = self.parse_and()
+        while self.at("PUNCT", "||"):
+            self.next()
+            left = Or(left, self.parse_and())
+        return left
+
+    def parse_and(self) -> Expr:
+        left = self.parse_relation()
+        while self.at("PUNCT", "&&"):
+            self.next()
+            left = And(left, self.parse_relation())
+        return left
+
+    def parse_relation(self) -> Expr:
+        left = self.parse_add()
+        t = self.peek()
+        if t.kind == "PUNCT" and t.text in ("==", "!=", "<", "<=", ">", ">="):
+            self.next()
+            return Binary(t.text, left, self.parse_add())
+        if self.at("IDENT", "in"):
+            self.next()
+            return Binary("in", left, self.parse_add())
+        if self.at("IDENT", "has"):
+            self.next()
+            return self.parse_has(left)
+        if self.at("IDENT", "like"):
+            self.next()
+            tok = self.expect("STRING")
+            comps = unescape(tok.text, tok.line, tok.col, pattern=True)
+            return Like(left, Pattern(tuple(comps)))
+        if self.at("IDENT", "is"):
+            self.next()
+            etype = self.parse_path()
+            if self.at("IDENT", "in"):
+                self.next()
+                return Is(left, etype, self.parse_add())
+            return Is(left, etype)
+        return left
+
+    def parse_has(self, obj: Expr) -> Expr:
+        # `x has a.b.c` sugar: x has a && x.a has b && x.a.b has c
+        if self.at("STRING"):
+            return HasAttr(obj, self.next().value)
+        attr = self.expect("IDENT").text
+        out: Expr = HasAttr(obj, attr)
+        cur = obj
+        while self.at("PUNCT", ".") and self.at("IDENT", k=1):
+            self.next()
+            cur = GetAttr(cur, attr)
+            attr = self.expect("IDENT").text
+            out = And(out, HasAttr(cur, attr))
+        return out
+
+    def parse_add(self) -> Expr:
+        left = self.parse_mult()
+        while self.at("PUNCT", "+") or self.at("PUNCT", "-"):
+            op = self.next().text
+            left = Binary(op, left, self.parse_mult())
+        return left
+
+    def parse_mult(self) -> Expr:
+        left = self.parse_unary()
+        while self.at("PUNCT", "*"):
+            self.next()
+            left = Binary("*", left, self.parse_unary())
+        return left
+
+    def parse_unary(self) -> Expr:
+        if self.at("PUNCT", "!"):
+            self.next()
+            return Unary("!", self.parse_unary())
+        if self.at("PUNCT", "-"):
+            self.next()
+            inner = self.parse_unary()
+            if isinstance(inner, Lit) and type(inner.value) is int:
+                return Lit(-inner.value)
+            return Unary("neg", inner)
+        return self.parse_member()
+
+    def parse_member(self) -> Expr:
+        e = self.parse_primary()
+        while True:
+            if self.at("PUNCT", ".") and self.at("IDENT", k=1):
+                self.next()
+                name = self.next().text
+                if self.at("PUNCT", "("):
+                    if name not in METHODS:
+                        raise self.err(f"unknown method {name!r}")
+                    self.next()
+                    args = []
+                    if not self.at("PUNCT", ")"):
+                        args.append(self.parse_expr())
+                        while self.at("PUNCT", ","):
+                            self.next()
+                            args.append(self.parse_expr())
+                    self.expect("PUNCT", ")")
+                    e = MethodCall(e, name, tuple(args))
+                else:
+                    e = GetAttr(e, name)
+            elif self.at("PUNCT", "["):
+                self.next()
+                key = self.expect("STRING").value
+                self.expect("PUNCT", "]")
+                e = GetAttr(e, key)
+            else:
+                return e
+
+    def parse_primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == "LONG":
+            self.next()
+            return Lit(t.value)
+        if t.kind == "STRING":
+            self.next()
+            return Lit(t.value)
+        if self.at("PUNCT", "("):
+            self.next()
+            e = self.parse_expr()
+            self.expect("PUNCT", ")")
+            return e
+        if self.at("PUNCT", "["):
+            self.next()
+            elems = []
+            if not self.at("PUNCT", "]"):
+                elems.append(self.parse_expr())
+                while self.at("PUNCT", ","):
+                    self.next()
+                    if self.at("PUNCT", "]"):
+                        break
+                    elems.append(self.parse_expr())
+            self.expect("PUNCT", "]")
+            return SetLit(tuple(elems))
+        if self.at("PUNCT", "{"):
+            self.next()
+            pairs = []
+            while not self.at("PUNCT", "}"):
+                if self.at("STRING"):
+                    key = self.next().value
+                else:
+                    key = self.expect("IDENT").text
+                self.expect("PUNCT", ":")
+                pairs.append((key, self.parse_expr()))
+                if self.at("PUNCT", ","):
+                    self.next()
+                else:
+                    break
+            self.expect("PUNCT", "}")
+            return RecordLit(tuple(pairs))
+        if t.kind == "IDENT":
+            if t.text == "true":
+                self.next()
+                return Lit(True)
+            if t.text == "false":
+                self.next()
+                return Lit(False)
+            if t.text == "if":
+                return self.parse_expr()
+            if t.text in RESERVED_VARS and not (
+                self.at("PUNCT", "::", 1) or (self.at("PUNCT", "(", 1))
+            ):
+                self.next()
+                return Var(t.text)
+            # path: entity reference or extension function call
+            path = self.parse_path()
+            if self.at("PUNCT", "("):
+                if path not in EXT_FUNCS:
+                    raise self.err(f"unknown function {path!r}")
+                self.next()
+                args = []
+                if not self.at("PUNCT", ")"):
+                    args.append(self.parse_expr())
+                    while self.at("PUNCT", ","):
+                        self.next()
+                        args.append(self.parse_expr())
+                self.expect("PUNCT", ")")
+                return ExtCall(path, tuple(args))
+            if self.at("PUNCT", "::"):
+                self.next()
+                eid_tok = self.expect("STRING")
+                return EntityLit(EntityUID(path, eid_tok.value))
+            raise self.err(f"unexpected identifier {path!r}")
+        raise self.err("unexpected token")
+
+
+def parse_policies(src: str, filename: str = "") -> List[Policy]:
+    """Parse Cedar source into policies with ids policy0..policyN and the
+    given filename recorded for diagnostics (mirrors cedar-go
+    NewPolicyListFromBytes naming used at reference store/crd.go:51)."""
+    ps = Parser(tokenize(src)).parse_policies()
+    for i, p in enumerate(ps):
+        p.policy_id = f"policy{i}"
+        p.filename = filename
+    return ps
+
+
+def parse_policy(src: str, filename: str = "") -> Policy:
+    ps = parse_policies(src, filename)
+    if len(ps) != 1:
+        raise ParseError(f"expected exactly 1 policy, got {len(ps)}")
+    return ps[0]

@@ -1,0 +1,292 @@
+"""Cedar value model.
+
+Cedar's dynamic values are: Bool, Long (i64), String, EntityUID, Set, Record,
+plus the `decimal` and `ipaddr` extension types. We represent Bool/Long/String
+as native Python bool/int/str (discriminated with exact type checks, since
+``bool`` subclasses ``int``), Sets as ``CedarSet`` (order/duplicate-insensitive),
+Records as ``CedarRecord`` (a thin dict wrapper), and the rest as dedicated
+classes.
+
+Reference behavior being matched: the cedar-go v1.1.0 evaluator used by
+cedar-access-control-for-k8s (see /root/reference go.mod:9); equality and
+ordering semantics follow the Cedar language spec: ``==`` between values of
+different types is ``false`` (never an error), ordering comparisons are only
+defined on Longs (and decimal via methods), arithmetic is Long-only with
+overflow errors.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import Any, Iterable
+
+I64_MIN = -(2**63)
+I64_MAX = 2**63 - 1
+
+
+class EvalError(Exception):
+    """A Cedar evaluation error. Policies that raise are skipped (recorded in
+    Diagnostics.errors), matching Cedar's error semantics."""
+
+
+class EntityUID:
+    __slots__ = ("type", "id", "_h")
+
+    def __init__(self, type: str, id: str):
+        self.type = type
+        self.id = id
+        self._h = hash((type, id))
+
+    def __repr__(self) -> str:
+        return f'{self.type}::"{self.id}"'
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, EntityUID)
+            and self.type == other.type
+            and self.id == other.id
+        )
+
+    def __hash__(self) -> int:
+        return self._h
+
+
+class CedarSet:
+    """An immutable Cedar set. Equality ignores order and duplicates."""
+
+    __slots__ = ("elems",)
+
+    def __init__(self, elems: Iterable[Any] = ()):
+        self.elems = tuple(elems)
+
+    def __iter__(self):
+        return iter(self.elems)
+
+    def __len__(self) -> int:
+        return len(self.elems)
+
+    def __repr__(self) -> str:
+        return "[" + ", ".join(repr(e) for e in self.elems) + "]"
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, CedarSet):
+            return False
+        return set_key(self) == set_key(other)
+
+    def __hash__(self) -> int:
+        return hash(set_key(self))
+
+    def contains(self, v: Any) -> bool:
+        return any(cedar_eq(e, v) for e in self.elems)
+
+
+class CedarRecord:
+    __slots__ = ("attrs",)
+
+    def __init__(self, attrs: dict | None = None):
+        self.attrs = dict(attrs or {})
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f'"{k}": {v!r}' for k, v in self.attrs.items())
+        return "{" + inner + "}"
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, CedarRecord):
+            return False
+        if self.attrs.keys() != other.attrs.keys():
+            return False
+        return all(cedar_eq(v, other.attrs[k]) for k, v in self.attrs.items())
+
+    def __hash__(self) -> int:
+        return hash(value_key(self))
+
+
+class Decimal:
+    """Cedar decimal: fixed-point with 4 fractional digits, stored scaled."""
+
+    __slots__ = ("units",)
+
+    def __init__(self, units: int):
+        self.units = units  # value * 10^4
+
+    @classmethod
+    def parse(cls, s: str) -> "Decimal":
+        neg = s.startswith("-")
+        body = s[1:] if neg else s
+        if "." not in body:
+            raise EvalError(f"error parsing decimal {s!r}: missing decimal point")
+        whole, frac = body.split(".", 1)
+        if not whole.isdigit() or not frac.isdigit() or not (1 <= len(frac) <= 4):
+            raise EvalError(f"error parsing decimal {s!r}")
+        units = int(whole) * 10000 + int(frac.ljust(4, "0"))
+        if neg:
+            units = -units
+        if not (I64_MIN <= units <= I64_MAX):
+            raise EvalError(f"decimal {s!r} out of range")
+        return cls(units)
+
+    def __repr__(self) -> str:
+        sign = "-" if self.units < 0 else ""
+        u = abs(self.units)
+        return f'decimal("{sign}{u // 10000}.{u % 10000:04d}")'
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Decimal) and self.units == other.units
+
+    def __hash__(self) -> int:
+        return hash(("decimal", self.units))
+
+
+class IPAddr:
+    """Cedar ipaddr extension value: an address plus a prefix length.
+
+    The original address is preserved (host bits are NOT discarded), matching
+    cedar-go's netip.Prefix semantics: ip("10.0.0.1/8") != ip("10.0.0.2/8"),
+    and predicates like isLoopback test the address itself.
+    """
+
+    __slots__ = ("addr", "prefixlen")
+
+    def __init__(self, addr, prefixlen: int):
+        self.addr = addr  # ipaddress.IPv4Address | IPv6Address
+        self.prefixlen = prefixlen
+
+    @classmethod
+    def parse(cls, s: str) -> "IPAddr":
+        try:
+            if "/" in s:
+                a, p = s.rsplit("/", 1)
+                addr = ipaddress.ip_address(a)
+                plen = int(p)
+                if not (0 <= plen <= addr.max_prefixlen):
+                    raise ValueError(f"bad prefix length {plen}")
+            else:
+                addr = ipaddress.ip_address(s)
+                plen = addr.max_prefixlen
+            return cls(addr, plen)
+        except ValueError as e:
+            raise EvalError(f"error parsing ip {s!r}: {e}") from None
+
+    def _network(self):
+        return ipaddress.ip_network((self.addr, self.prefixlen), strict=False)
+
+    def is_ipv4(self) -> bool:
+        return self.addr.version == 4
+
+    def is_ipv6(self) -> bool:
+        return self.addr.version == 6
+
+    def is_loopback(self) -> bool:
+        return self.addr.is_loopback
+
+    def is_multicast(self) -> bool:
+        return self.addr.is_multicast
+
+    def is_in_range(self, other: "IPAddr") -> bool:
+        if self.addr.version != other.addr.version:
+            return False
+        return self._network().subnet_of(other._network())
+
+    def __repr__(self) -> str:
+        if self.prefixlen == self.addr.max_prefixlen:
+            return f'ip("{self.addr}")'
+        return f'ip("{self.addr}/{self.prefixlen}")'
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, IPAddr)
+            and self.addr == other.addr
+            and self.prefixlen == other.prefixlen
+        )
+
+    def __hash__(self) -> int:
+        return hash(("ip", str(self.addr), self.prefixlen))
+
+
+def type_name(v: Any) -> str:
+    if type(v) is bool:
+        return "bool"
+    if type(v) is int:
+        return "long"
+    if type(v) is str:
+        return "string"
+    if isinstance(v, EntityUID):
+        return "entity"
+    if isinstance(v, CedarSet):
+        return "set"
+    if isinstance(v, CedarRecord):
+        return "record"
+    if isinstance(v, Decimal):
+        return "decimal"
+    if isinstance(v, IPAddr):
+        return "ipaddr"
+    raise EvalError(f"unknown value type {type(v)!r}")
+
+
+def value_key(v: Any):
+    """A hashable, order-insensitive canonical key for any Cedar value."""
+    if type(v) is bool:
+        return ("b", v)
+    if type(v) is int:
+        return ("l", v)
+    if type(v) is str:
+        return ("s", v)
+    if isinstance(v, EntityUID):
+        return ("e", v.type, v.id)
+    if isinstance(v, CedarSet):
+        return ("S", set_key(v))
+    if isinstance(v, CedarRecord):
+        return ("R", tuple(sorted((k, value_key(x)) for k, x in v.attrs.items())))
+    if isinstance(v, Decimal):
+        return ("d", v.units)
+    if isinstance(v, IPAddr):
+        return ("i", str(v.net))
+    raise EvalError(f"unhashable value {v!r}")
+
+
+def set_key(s: CedarSet):
+    return frozenset(value_key(e) for e in s.elems)
+
+
+def cedar_eq(a: Any, b: Any) -> bool:
+    """Cedar ``==``: cross-type comparison yields False, never an error."""
+    ta, tb = type_name(a), type_name(b)
+    if ta != tb:
+        return False
+    return a == b
+
+
+def require_bool(v: Any) -> bool:
+    if type(v) is not bool:
+        raise EvalError(f"type error: expected bool, got {type_name(v)}")
+    return v
+
+
+def require_long(v: Any) -> int:
+    if type(v) is not int or type(v) is bool:
+        raise EvalError(f"type error: expected long, got {type_name(v)}")
+    return v
+
+
+def require_string(v: Any) -> str:
+    if type(v) is not str:
+        raise EvalError(f"type error: expected string, got {type_name(v)}")
+    return v
+
+
+def require_set(v: Any) -> CedarSet:
+    if not isinstance(v, CedarSet):
+        raise EvalError(f"type error: expected set, got {type_name(v)}")
+    return v
+
+
+def require_entity(v: Any) -> EntityUID:
+    if not isinstance(v, EntityUID):
+        raise EvalError(f"type error: expected entity, got {type_name(v)}")
+    return v
+
+
+def checked_arith(x: int) -> int:
+    if not (I64_MIN <= x <= I64_MAX):
+        raise EvalError("integer overflow")
+    return x

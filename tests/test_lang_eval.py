@@ -1,0 +1,311 @@
+"""Interpreter semantics tests: short-circuit, error handling, operators,
+hierarchy `in`, extension types, and full is_authorized decisions."""
+
+import json
+
+import pytest
+
+from cedar_tpu.lang import (
+    ALLOW,
+    DENY,
+    CedarRecord,
+    CedarSet,
+    Entity,
+    EntityMap,
+    EntityUID,
+    EvalError,
+    Env,
+    PolicySet,
+    Request,
+    evaluate,
+    parse_policy,
+)
+from cedar_tpu.lang.values import cedar_eq
+
+
+def make_env(context=None, entities=None):
+    em = entities or EntityMap()
+    principal = EntityUID("k8s::User", "alice")
+    action = EntityUID("k8s::Action", "get")
+    resource = EntityUID("k8s::Resource", "/api/v1/pods")
+    for uid in (principal, action, resource):
+        if em.get(uid) is None:
+            em.add(Entity(uid))
+    return Env(Request(principal, action, resource, CedarRecord(context or {})), em)
+
+
+def expr(src: str):
+    p = parse_policy(f"permit (principal, action, resource) when {{ {src} }};")
+    return p.conditions[0].body
+
+
+def ev(src: str, env=None):
+    return evaluate(expr(src), env or make_env())
+
+
+def test_literals_and_arith():
+    assert ev("1 + 2 * 3 - 4") == 3
+    assert ev('"a" == "a"') is True
+    assert ev("true && false") is False
+    assert ev("-(3) == 0 - 3") is True
+
+
+def test_cross_type_eq_is_false_not_error():
+    assert ev('1 == "1"') is False
+    assert ev('1 != "1"') is True
+    assert ev("true == 1") is False
+
+
+def test_comparison_requires_longs():
+    assert ev("1 < 2") is True
+    with pytest.raises(EvalError):
+        ev('"a" < "b"')
+    with pytest.raises(EvalError):
+        ev("true < false")
+
+
+def test_overflow_errors():
+    with pytest.raises(EvalError):
+        ev("9223372036854775807 + 1")
+
+
+def test_short_circuit_hides_errors():
+    # `1 < "x"` would error, but short-circuit avoids evaluating it
+    assert ev('false && 1 < "x"') is False
+    assert ev('true || 1 < "x"') is True
+    with pytest.raises(EvalError):
+        ev('true && 1 < "x"')
+    with pytest.raises(EvalError):
+        ev('false || 1 < "x"')
+
+
+def test_and_or_require_bools():
+    with pytest.raises(EvalError):
+        ev("1 && true")
+    with pytest.raises(EvalError):
+        ev("false || 1")
+
+
+def test_set_ops():
+    assert ev('["a", "b"].contains("a")') is True
+    assert ev('["a", "b"].contains("c")') is False
+    assert ev('["a", "b"].containsAll(["b", "a"])') is True
+    assert ev('["a", "b"].containsAll(["a", "c"])') is False
+    assert ev('["a", "b"].containsAny(["c", "b"])') is True
+    assert ev('["a"].containsAny(["c", "d"])') is False
+    with pytest.raises(EvalError):
+        ev('"notaset".contains("a")')
+
+
+def test_set_equality_ignores_order_and_dupes():
+    assert cedar_eq(CedarSet(["a", "b", "a"]), CedarSet(["b", "a"])) is True
+    assert ev('["a", "b", "a"] == ["b", "a"]') is True
+    assert ev('["a"] == ["b"]') is False
+
+
+def test_records():
+    assert ev('{"k": "v", n: 1} == {n: 1, "k": "v"}') is True
+    assert ev('{"k": "v"} == {"k": "x"}') is False
+    assert ev('{"k": "v"} has k') is True
+    assert ev('{"k": "v"} has missing') is False
+    assert ev('{"k": "v"}.k == "v"') is True
+    with pytest.raises(EvalError):
+        ev('{"k": "v"}.missing')
+
+
+def test_record_contains_in_set():
+    env = make_env()
+    assert (
+        ev('[{"key": "a", "values": ["x"]}].contains({"key": "a", "values": ["x"]})', env)
+        is True
+    )
+    assert (
+        ev('[{"key": "a", "values": ["x"]}].contains({"key": "a", "values": ["y"]})', env)
+        is False
+    )
+
+
+def test_attr_access_on_entities():
+    em = EntityMap()
+    em.add(
+        Entity(
+            EntityUID("k8s::User", "alice"),
+            CedarRecord({"name": "alice", "extra": CedarSet([])}),
+        )
+    )
+    env = make_env(entities=em)
+    assert ev('principal.name == "alice"', env) is True
+    assert ev("principal has name", env) is True
+    assert ev("principal has nope", env) is False
+    with pytest.raises(EvalError):
+        ev("principal.nope", env)
+
+
+def test_entity_in_hierarchy():
+    em = EntityMap()
+    group = EntityUID("k8s::Group", "admins")
+    em.add(Entity(EntityUID("k8s::User", "alice"), parents=[group]))
+    em.add(Entity(group))
+    env = make_env(entities=em)
+    assert ev('principal in k8s::Group::"admins"', env) is True
+    assert ev('principal in k8s::Group::"other"', env) is False
+    assert ev('principal in k8s::User::"alice"', env) is True  # reflexive
+    assert (
+        ev('principal in [k8s::Group::"other", k8s::Group::"admins"]', env) is True
+    )
+
+
+def test_transitive_hierarchy():
+    em = EntityMap()
+    a = EntityUID("T", "a")
+    b = EntityUID("T", "b")
+    c = EntityUID("T", "c")
+    em.add(Entity(a, parents=[b]))
+    em.add(Entity(b, parents=[c]))
+    em.add(Entity(c))
+    env = Env(Request(a, EntityUID("k8s::Action", "get"), a, CedarRecord()), em)
+    assert evaluate(expr('principal in T::"c"'), env) is True
+
+
+def test_is_operator():
+    env = make_env()
+    assert ev("principal is k8s::User", env) is True
+    assert ev("principal is k8s::Node", env) is False
+    with pytest.raises(EvalError):
+        ev('"str" is k8s::User', env)
+
+
+def test_like_operator():
+    assert ev('"/healthz/live" like "/healthz/*"') is True
+    assert ev('"/metrics" like "/healthz/*"') is False
+    assert ev('"prod-db" like "prod*"') is True
+    assert ev('"a*b" like "a\\*b"') is True
+    assert ev('"axb" like "a\\*b"') is False
+    assert ev('"" like "*"') is True
+    with pytest.raises(EvalError):
+        ev('5 like "x*"')
+
+
+def test_if_then_else():
+    assert ev("if 1 < 2 then 10 else 20") == 10
+    assert ev("if 1 > 2 then 10 else 20") == 20
+    with pytest.raises(EvalError):
+        ev('if 5 then 1 else 2')
+
+
+def test_ip_extension():
+    assert ev('ip("10.0.0.1").isIpv4()') is True
+    assert ev('ip("::1").isIpv6()') is True
+    assert ev('ip("127.0.0.1").isLoopback()') is True
+    assert ev('ip("10.1.2.3").isInRange(ip("10.0.0.0/8"))') is True
+    assert ev('ip("11.1.2.3").isInRange(ip("10.0.0.0/8"))') is False
+    assert ev('ip("10.0.0.1") == ip("10.0.0.1")') is True
+    with pytest.raises(EvalError):
+        ev('ip("not-an-ip")')
+
+
+def test_decimal_extension():
+    assert ev('decimal("1.5").lessThan(decimal("2.0"))') is True
+    assert ev('decimal("2.50").greaterThanOrEqual(decimal("2.5"))') is True
+    assert ev('decimal("-0.5") == decimal("-0.5000")') is True
+    with pytest.raises(EvalError):
+        ev('decimal("5")')
+
+
+def test_context_var():
+    env = make_env(context={"port": 443})
+    assert ev("context.port == 443", env) is True
+    assert ev("context has port", env) is True
+
+
+# --------------------------------------------------------- is_authorized
+
+
+def std_entities():
+    em = EntityMap()
+    em.add(
+        Entity(
+            EntityUID("k8s::User", "alice"),
+            CedarRecord({"name": "test-user"}),
+            parents=[EntityUID("k8s::Group", "viewers")],
+        )
+    )
+    em.add(Entity(EntityUID("k8s::Group", "viewers")))
+    em.add(Entity(EntityUID("k8s::Action", "get")))
+    em.add(
+        Entity(
+            EntityUID("k8s::Resource", "/api/v1/pods"),
+            CedarRecord({"resource": "pods", "apiGroup": ""}),
+        )
+    )
+    return em
+
+
+def std_request():
+    return Request(
+        EntityUID("k8s::User", "alice"),
+        EntityUID("k8s::Action", "get"),
+        EntityUID("k8s::Resource", "/api/v1/pods"),
+        CedarRecord(),
+    )
+
+
+def test_authorize_allow():
+    ps = PolicySet.from_source(
+        """
+permit (principal, action, resource) when {
+    principal.name == "test-user" && resource.resource == "pods"
+};""",
+        filename="Allow",
+    )
+    decision, diag = ps.is_authorized(std_entities(), std_request())
+    assert decision == ALLOW
+    got = json.loads(diag.to_json())
+    assert got == {
+        "reasons": [
+            {
+                "policy": "policy0",
+                "position": {"filename": "Allow", "offset": 1, "line": 2, "column": 1},
+            }
+        ]
+    }
+
+
+def test_authorize_forbid_overrides_permit():
+    ps = PolicySet.from_source(
+        "permit (principal, action, resource);\n"
+        'forbid (principal, action, resource) when { resource.resource == "pods" };'
+    )
+    decision, diag = ps.is_authorized(std_entities(), std_request())
+    assert decision == DENY
+    assert [r.policy for r in diag.reasons] == ["policy1"]
+
+
+def test_authorize_default_deny_no_reasons():
+    ps = PolicySet.from_source(
+        'permit (principal, action, resource) when { principal.name == "bob" };'
+    )
+    decision, diag = ps.is_authorized(std_entities(), std_request())
+    assert decision == DENY
+    assert diag.reasons == []
+
+
+def test_erroring_policy_skipped_and_recorded():
+    ps = PolicySet.from_source(
+        "permit (principal, action, resource) when { principal.missing == 1 };\n"
+        'permit (principal, action, resource) when { principal.name == "test-user" };'
+    )
+    decision, diag = ps.is_authorized(std_entities(), std_request())
+    assert decision == ALLOW
+    assert [r.policy for r in diag.reasons] == ["policy1"]
+    assert len(diag.errors) == 1
+    assert "policy0" in diag.errors[0]
+
+
+def test_unless_condition():
+    ps = PolicySet.from_source(
+        "permit (principal in k8s::Group::\"viewers\", action, resource)"
+        ' unless { resource.resource == "secrets" };'
+    )
+    decision, _ = ps.is_authorized(std_entities(), std_request())
+    assert decision == ALLOW

@@ -1,0 +1,175 @@
+"""Parser tests: scope forms, annotations, conditions, expression surface."""
+
+from cedar_tpu.lang import ParseError, parse_policies, parse_policy
+from cedar_tpu.lang.ast import (
+    And,
+    Binary,
+    EntityLit,
+    GetAttr,
+    HasAttr,
+    Is,
+    Like,
+    MethodCall,
+    Or,
+    SetLit,
+    Var,
+    WILDCARD,
+)
+from cedar_tpu.lang.values import EntityUID
+
+import pytest
+
+
+def test_minimal_permit():
+    p = parse_policy("permit (principal, action, resource);")
+    assert p.effect == "permit"
+    assert p.principal.op == "all"
+    assert p.action.op == "all"
+    assert p.resource.op == "all"
+    assert p.conditions == ()
+
+
+def test_scope_forms():
+    p = parse_policy(
+        """
+        permit (
+            principal is k8s::ServiceAccount in k8s::Group::"sa-group",
+            action in [k8s::Action::"get", k8s::Action::"list"],
+            resource == k8s::Resource::"/api/v1/pods"
+        );
+        """
+    )
+    assert p.principal.op == "is_in"
+    assert p.principal.entity_type == "k8s::ServiceAccount"
+    assert p.principal.entity == EntityUID("k8s::Group", "sa-group")
+    assert p.action.op == "in"
+    assert p.action.entities == (
+        EntityUID("k8s::Action", "get"),
+        EntityUID("k8s::Action", "list"),
+    )
+    assert p.resource.op == "eq"
+    assert p.resource.entity == EntityUID("k8s::Resource", "/api/v1/pods")
+
+
+def test_annotations_and_position():
+    src = '\n@clusterRole("admin")\n@policyRule("00")\npermit (principal, action, resource);'
+    p = parse_policy(src)
+    assert p.annotation("clusterRole") == "admin"
+    assert p.annotation("policyRule") == "00"
+    assert p.annotation("missing") is None
+    # position points at the first token (the first annotation's @)
+    assert p.position == (1, 2, 1)
+
+
+def test_multiple_policies_get_sequential_ids():
+    ps = parse_policies(
+        "permit (principal, action, resource);\n"
+        "forbid (principal, action, resource);",
+        filename="myfile",
+    )
+    assert [p.policy_id for p in ps] == ["policy0", "policy1"]
+    assert all(p.filename == "myfile" for p in ps)
+    assert ps[1].effect == "forbid"
+
+
+def test_condition_expression_shapes():
+    p = parse_policy(
+        """
+        permit (principal, action, resource)
+        when {
+            principal.name == "test-user" &&
+            ["batch", "apps"].contains(resource.apiGroup) ||
+            !(resource has subresource) &&
+            resource.path like "/healthz/\\*/x*"
+        }
+        unless { resource.resource == "secrets" };
+        """
+    )
+    assert len(p.conditions) == 2
+    when, unless = p.conditions
+    assert when.kind == "when" and unless.kind == "unless"
+    body = when.body
+    assert isinstance(body, Or)
+    left = body.left
+    assert isinstance(left, And)
+    assert isinstance(left.left, Binary) and left.left.op == "=="
+    assert isinstance(left.right, MethodCall) and left.right.method == "contains"
+    assert isinstance(left.right.obj, SetLit)
+
+
+def test_like_pattern_escapes():
+    p = parse_policy(
+        'permit (principal, action, resource) when { resource.path like "/healthz/\\*/x*" };'
+    )
+    like = p.conditions[0].body
+    assert isinstance(like, Like)
+    comps = like.pattern.components
+    assert comps[0] == "/healthz/*/x"
+    assert comps[1] is WILDCARD
+    assert like.pattern.match("/healthz/*/xyz")
+    assert not like.pattern.match("/healthz/a/xyz")
+
+
+def test_has_dotted_sugar():
+    p = parse_policy(
+        "permit (principal, action, resource) when { resource has metadata.labels };"
+    )
+    body = p.conditions[0].body
+    assert isinstance(body, And)
+    assert isinstance(body.left, HasAttr) and body.left.attr == "metadata"
+    assert isinstance(body.right, HasAttr) and body.right.attr == "labels"
+    assert isinstance(body.right.obj, GetAttr)
+
+
+def test_is_in_expression():
+    p = parse_policy(
+        'permit (principal, action, resource) when '
+        '{ resource is k8s::User in k8s::Group::"g" };'
+    )
+    body = p.conditions[0].body
+    assert isinstance(body, Is)
+    assert body.entity_type == "k8s::User"
+    assert isinstance(body.in_entity, EntityLit)
+
+
+def test_record_literal_and_string_index():
+    p = parse_policy(
+        'permit (principal, action, resource) when {'
+        ' principal.extra.contains({"key": "k", "values": [resource.name]}) &&'
+        ' resource["odd key"] == "v" };'
+    )
+    body = p.conditions[0].body
+    assert isinstance(body, And)
+    idx = body.right
+    assert isinstance(idx, Binary)
+    assert isinstance(idx.left, GetAttr) and idx.left.attr == "odd key"
+
+
+def test_if_then_else_and_arith():
+    p = parse_policy(
+        "permit (principal, action, resource) when "
+        "{ (if context.n > 2 then 3 * context.n - 1 else 0) >= 8 };"
+    )
+    assert p.conditions
+
+
+def test_comments_ignored():
+    ps = parse_policies(
+        "// leading comment\npermit (principal, action, resource); /* block\n comment */"
+    )
+    assert len(ps) == 1
+
+
+@pytest.mark.parametrize(
+    "src",
+    [
+        "permit (principal, action, resource)",  # missing semicolon
+        "allow (principal, action, resource);",  # bad effect
+        "permit (principal, action);",  # missing resource
+        'permit (principal, action, resource) when { resource.path like 3 };',
+        "permit (principal, action, resource) when { foo };",
+    ],
+)
+def test_parse_errors(src):
+    with pytest.raises(ParseError):
+        parse_policies(src)

@@ -1,0 +1,108 @@
+"""Regression tests for review findings on the language core."""
+
+import time
+
+import pytest
+
+from cedar_tpu.lang import (
+    CedarRecord,
+    Entity,
+    EntityMap,
+    EntityUID,
+    ParseError,
+    PolicySet,
+    Request,
+    parse_policies,
+    parse_policy,
+)
+from cedar_tpu.lang.ast import Pattern, WILDCARD
+from cedar_tpu.lang.eval import Env, evaluate
+
+
+def test_pattern_match_adversarial_is_fast():
+    # 12 wildcards against a 50-char non-matching string must not blow up
+    comps = []
+    for _ in range(12):
+        comps.append(WILDCARD)
+        comps.append("a")
+    pat = Pattern(tuple(comps))
+    start = time.monotonic()
+    assert pat.match("a" * 49 + "b") is False
+    assert pat.match("a" * 50) is True
+    assert time.monotonic() - start < 1.0
+
+
+def test_has_on_unknown_entity_is_false_not_error():
+    # cedar-go treats an entity absent from the store as attribute-less
+    ps = PolicySet.from_source(
+        "permit (principal, action, resource) when { !(principal has foo) };"
+    )
+    em = EntityMap()  # nothing in the store at all
+    req = Request(
+        EntityUID("k8s::User", "ghost"),
+        EntityUID("k8s::Action", "get"),
+        EntityUID("k8s::Resource", "/x"),
+        CedarRecord(),
+    )
+    decision, diag = ps.is_authorized(em, req)
+    assert decision == "allow"
+    assert diag.errors == []
+
+
+def test_getattr_on_unknown_entity_is_attr_not_found_error():
+    ps = PolicySet.from_source(
+        "permit (principal, action, resource) when { principal.foo == 1 };"
+    )
+    em = EntityMap()
+    req = Request(
+        EntityUID("k8s::User", "ghost"),
+        EntityUID("k8s::Action", "get"),
+        EntityUID("k8s::Resource", "/x"),
+        CedarRecord(),
+    )
+    decision, diag = ps.is_authorized(em, req)
+    assert decision == "deny"
+    assert len(diag.errors) == 1
+
+
+def _expr(src):
+    p = parse_policy(f"permit (principal, action, resource) when {{ {src} }};")
+    return p.conditions[0].body
+
+
+def _ev(src):
+    em = EntityMap()
+    req = Request(
+        EntityUID("U", "u"), EntityUID("A", "a"), EntityUID("R", "r"), CedarRecord()
+    )
+    return evaluate(_expr(src), Env(req, em))
+
+
+def test_ipaddr_keeps_host_bits():
+    # cedar-go netip.Prefix semantics: address+prefix, host bits preserved
+    assert _ev('ip("10.0.0.1/8") == ip("10.0.0.2/8")') is False
+    assert _ev('ip("10.0.0.1/8") == ip("10.0.0.1/8")') is True
+    assert _ev('ip("127.0.0.1/1").isLoopback()') is True
+    assert _ev('ip("10.0.0.1/8").isInRange(ip("10.0.0.0/8"))') is True
+
+
+@pytest.mark.parametrize(
+    "lit",
+    ['"\\u{zz}"', '"\\u{1F600"', '"\\u{110000}"'],
+)
+def test_bad_unicode_escape_is_parse_error(lit):
+    with pytest.raises(ParseError):
+        parse_policies(
+            f"permit (principal, action, resource) when {{ {lit} == \"x\" }};"
+        )
+
+
+def test_long_literal_out_of_i64_range_rejected():
+    with pytest.raises(ParseError):
+        parse_policies(
+            "permit (principal, action, resource) when { 9223372036854775808 > 0 };"
+        )
+    # max i64 still fine
+    parse_policies(
+        "permit (principal, action, resource) when { 9223372036854775807 > 0 };"
+    )
