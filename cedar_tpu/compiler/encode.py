@@ -1,0 +1,133 @@
+"""Host-side request encoder: (EntityMap, Request) -> active literal ids.
+
+Cost is O(slots touched + ancestors + hard literals) per request —
+independent of policy count, which is the whole point: the per-policy work
+happens on the TPU as a matmul (ops/match.py). A C++ fast path with the same
+contract lives in cedar_tpu/native.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..lang.entities import EntityMap
+from ..lang.eval import Env, Request, evaluate
+from ..lang.values import CedarRecord, CedarSet, EvalError, value_key
+from .pack import EncodePlan
+
+_MISSING = object()
+
+
+def _slot_value(plan_root, path):
+    cur = plan_root
+    for comp in path:
+        if not isinstance(cur, CedarRecord):
+            return _MISSING
+        if comp not in cur.attrs:
+            return _MISSING
+        cur = cur.attrs[comp]
+    return cur
+
+
+def _ancestors_or_self(entities: EntityMap, uid):
+    seen = {uid}
+    stack = [uid]
+    while stack:
+        cur = stack.pop()
+        ent = entities.get(cur)
+        if ent is None:
+            continue
+        for p in ent.parents:
+            if p not in seen:
+                seen.add(p)
+                stack.append(p)
+    return seen
+
+
+def encode_request(
+    plan: EncodePlan, entities: EntityMap, request: Request
+) -> List[int]:
+    active: set = set()
+    var_uids = {
+        "principal": request.principal,
+        "action": request.action,
+        "resource": request.resource,
+    }
+    roots = {}
+    for var, uid in var_uids.items():
+        ent = entities.get(uid)
+        roots[var] = ent.attrs if ent is not None else CedarRecord()
+    roots["context"] = request.context
+
+    # entity-level literals
+    for var, uid in var_uids.items():
+        key = (uid.type, uid.id)
+        for lid in plan.eq_entity_idx.get(var, {}).get(key, ()):
+            active.add(lid)
+        for t_lids in (plan.is_idx.get(var, {}).get(uid.type, ()),):
+            active.update(t_lids)
+        in_idx = plan.entity_in_idx.get(var)
+        if in_idx:
+            for anc in _ancestors_or_self(entities, uid):
+                for lid in in_idx.get((anc.type, anc.id), ()):
+                    active.add(lid)
+
+    # slot-based literals
+    for slot in plan.slots:
+        var, path = slot
+        v = _slot_value(roots.get(var), path)
+        if v is _MISSING:
+            continue
+        active.update(plan.has_idx.get(slot, ()))
+        eq = plan.eq_idx.get(slot)
+        inset = plan.inset_idx.get(slot)
+        if eq is not None or inset is not None:
+            try:
+                vk = value_key(v)
+            except EvalError:
+                vk = None
+            if vk is not None:
+                if eq is not None:
+                    active.update(eq.get(vk, ()))
+                if inset is not None:
+                    active.update(inset.get(vk, ()))
+        for lid, pattern in plan.like_idx.get(slot, ()):
+            if isinstance(v, str) and pattern.match(v):
+                active.add(lid)
+        for lid, op, c in plan.cmp_idx.get(slot, ()):
+            if type(v) is int:  # bools are type bool, never int, under type()
+                if (
+                    (op == "<" and v < c)
+                    or (op == "<=" and v <= c)
+                    or (op == ">" and v > c)
+                    or (op == ">=" and v >= c)
+                ):
+                    active.add(lid)
+        sh = plan.set_has_idx.get(slot)
+        if sh is not None and isinstance(v, CedarSet):
+            for elem in v:
+                try:
+                    ek = value_key(elem)
+                except EvalError:
+                    continue
+                for lid in sh.get(ek, ()):
+                    active.add(lid)
+
+    # hard literals: interpreter-evaluated. An EvalError activates the
+    # paired HARD_ERR indicator (the lowering guarantees negated hard
+    # literals cannot error); a non-bool result is a Cedar type error.
+    if plan.hard_lits:
+        env = Env(request, entities)
+        for lid, expr, err_lid in plan.hard_lits:
+            try:
+                v = evaluate(expr, env)
+                if v is True:
+                    if lid >= 0:
+                        active.add(lid)
+                elif type(v) is not bool and err_lid >= 0:
+                    active.add(err_lid)
+            except EvalError:
+                if err_lid >= 0:
+                    active.add(err_lid)
+
+    return sorted(active)

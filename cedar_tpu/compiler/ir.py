@@ -1,0 +1,152 @@
+"""Predicate IR for the TPU policy evaluator.
+
+The tensor evaluator's contract: a policy set is lowered to a flat list of
+RULES (one per ordered-DNF clause); each rule is a conjunction of LITERALS
+(possibly negated). Literals are host-evaluable primitive tests over a
+request's feature slots; the device combines literal bits into rule verdicts
+with one [batch, literals] x [literals, rules] matmul (see ops/match.py).
+
+Design notes
+------------
+* A *slot* is a (var, attr_path) pair, e.g. ("resource", ("resource",)) or
+  ("principal", ("extra",)). Slot values are extracted host-side from the
+  request's entity map.
+* Every literal carries `accesses`: the attribute paths whose retrieval can
+  raise a Cedar evaluation error, in evaluation order. Cedar skips a policy
+  whose condition errors (reference behavior: diagnostics at
+  /root/reference internal/server/store/store.go:31 via cedar-go); the
+  lowering preserves that semantics by requiring every NEGATED literal's
+  accesses to be presence-proven (guarded by earlier positive literals,
+  `has` checks, or schema-mandatory attributes) — otherwise the policy is
+  routed to the interpreter fallback. Positive literals are safe unproven:
+  a failed access makes the literal false, which makes the clause false,
+  which coincides with Cedar's no-match-on-error for that evaluation path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, List, Optional, Tuple
+
+from ..lang.ast import Expr, Pattern, Policy
+
+# slot = (var, path): var in {"principal", "action", "resource", "context"}
+Slot = Tuple[str, Tuple[str, ...]]
+
+# literal kinds
+EQ = "eq"  # slot value == constant (via value_key)
+HAS = "has"  # slot present
+LIKE = "like"  # slot string matches glob pattern
+CMP = "cmp"  # slot long <op> constant
+IN_SET = "in_set"  # slot value in constant set
+SET_HAS = "set_has"  # slot (a set) contains constant
+IS = "is"  # var entity type == type name
+EQ_ENTITY = "eq_entity"  # var uid == constant uid
+ENTITY_IN = "entity_in"  # var uid in (descendant-of) constant uid
+ENTITY_IN_ANY = "entity_in_any"  # var uid in any of constant uids
+HARD = "hard"  # arbitrary expr evaluated host-side by the interpreter
+HARD_ERR = "hard_err"  # host evaluation of the expr raised an EvalError
+TRUE = "true"  # constant true (from literal folding)
+
+
+class Unlowerable(Exception):
+    """Raised when a policy can't be lowered to the tensor IR; the policy is
+    then evaluated by the interpreter fallback (hybrid verdict merge)."""
+
+
+@dataclass(frozen=True)
+class Literal:
+    kind: str
+    var: str = ""  # for IS/EQ_ENTITY/ENTITY_IN*/slot.var
+    slot: Optional[Slot] = None
+    data: Any = None  # kind-specific payload (hashable)
+    # attribute paths whose retrieval may error, in evaluation order
+    accesses: Tuple[Slot, ...] = ()
+    # True if this literal can never raise (scope tests, bare `has`)
+    total: bool = True
+    # HARD only: the expression (frozen AST nodes are hashable)
+    expr: Optional[Expr] = None
+
+    def key(self):
+        return (self.kind, self.var, self.slot, self.data, self.expr)
+
+
+@dataclass(frozen=True)
+class ClauseLit:
+    lit: Literal
+    negated: bool
+
+
+# A clause is an ordered conjunction of literals (evaluation order preserved
+# from the source expression, which the error-safety analysis relies on).
+Clause = Tuple[ClauseLit, ...]
+
+
+@dataclass
+class LoweredPolicy:
+    policy: Policy
+    tier: int
+    effect: str
+    clauses: List[Clause]
+    # clauses that are true exactly when Cedar evaluation of this policy
+    # ERRORS on the request (prefix literals + missing-attribute / hard-error
+    # indicator). Errors are an explicit tier-stop signal in the reference
+    # (store.go:37) and are surfaced in diagnostics, so the device must
+    # detect them, not just fail to match.
+    error_clauses: List[Clause] = field(default_factory=list)
+
+
+@dataclass
+class FallbackPolicy:
+    policy: Policy
+    tier: int
+    reason: str
+
+
+@dataclass
+class CompiledPolicies:
+    """Host-side result of lowering a tiered policy set."""
+
+    lowered: List[LoweredPolicy] = field(default_factory=list)
+    fallback: List[FallbackPolicy] = field(default_factory=list)
+    n_tiers: int = 0
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "tiers": self.n_tiers,
+            "lowered_policies": len(self.lowered),
+            "fallback_policies": len(self.fallback),
+            "rules": sum(len(lp.clauses) for lp in self.lowered),
+        }
+
+
+# Mandatory (always-present) attributes per entity type, matching the entity
+# builders (cedar_tpu/entities): used to prove access safety for negated
+# literals when no explicit `has` guard exists.
+AUTHZ_MANDATORY_ATTRS: Dict[str, FrozenSet[str]] = {
+    "k8s::User": frozenset({"name"}),
+    "k8s::Node": frozenset({"name"}),
+    "k8s::ServiceAccount": frozenset({"name", "namespace"}),
+    "k8s::Group": frozenset({"name"}),
+    "k8s::Extra": frozenset({"key"}),
+    "k8s::PrincipalUID": frozenset(),
+    "k8s::Resource": frozenset({"apiGroup", "resource"}),
+    "k8s::NonResourceURL": frozenset({"path"}),
+}
+
+# Possible entity types per request variable on the authorization path
+# (resource may be any impersonation principal type as well).
+AUTHZ_VAR_TYPES: Dict[str, Tuple[str, ...]] = {
+    "principal": ("k8s::User", "k8s::Node", "k8s::ServiceAccount"),
+    "resource": (
+        "k8s::Resource",
+        "k8s::NonResourceURL",
+        "k8s::User",
+        "k8s::Group",
+        "k8s::ServiceAccount",
+        "k8s::Node",
+        "k8s::PrincipalUID",
+        "k8s::Extra",
+    ),
+    "action": ("k8s::Action",),
+}

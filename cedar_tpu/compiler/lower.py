@@ -1,0 +1,728 @@
+"""Lowering: Cedar policies -> ordered-DNF rules over primitive literals.
+
+The expansion is *evaluation-order preserving*: `a || b` becomes the clause
+set {[a], [!a, b]} (not {[a], [b]}), so every clause corresponds to exactly
+one short-circuit evaluation path of the original expression. This is what
+makes Cedar's error semantics tensorizable:
+
+  * a POSITIVE literal whose attribute access fails evaluates false on the
+    device, killing its clause — which coincides with Cedar skipping the
+    policy on that evaluation path;
+  * a NEGATED literal that could error would evaluate true on the device
+    while Cedar skips the policy, so negated literals must be proven
+    error-free (earlier positive literal on the same slot, earlier positive
+    `has`, or a schema-mandatory attribute). Unprovable policies fall back
+    to the interpreter.
+
+A same-slot exclusivity simplification keeps `x == "a" || x == "b" || ...`
+chains linear: the negated prefix literals are implied by any later positive
+equality on the same slot and are dropped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..lang import ast
+from ..lang.authorize import PolicySet
+from ..lang.values import (
+    CedarRecord,
+    CedarSet,
+    Decimal,
+    EntityUID,
+    EvalError,
+    IPAddr,
+    value_key,
+)
+from .ir import (
+    AUTHZ_MANDATORY_ATTRS,
+    AUTHZ_VAR_TYPES,
+    CMP,
+    Clause,
+    ClauseLit,
+    CompiledPolicies,
+    ENTITY_IN,
+    ENTITY_IN_ANY,
+    EQ,
+    EQ_ENTITY,
+    FallbackPolicy,
+    HARD,
+    HARD_ERR,
+    HAS,
+    IN_SET,
+    IS,
+    LIKE,
+    Literal,
+    LoweredPolicy,
+    SET_HAS,
+    Slot,
+    TRUE,
+    Unlowerable,
+)
+
+MAX_CLAUSES = 96
+MAX_LITERALS = 32
+
+# Coarse Cedar types for static safety analysis of the closed authz schema.
+STR, LONG, BOOL, SET, RECORD, ENTITY, UNKNOWN = (
+    "string",
+    "long",
+    "bool",
+    "set",
+    "record",
+    "entity",
+    "?",
+)
+
+AUTHZ_ATTR_TYPES: Dict[str, Dict[str, str]] = {
+    "k8s::User": {"name": STR, "extra": SET},
+    "k8s::Node": {"name": STR, "extra": SET},
+    "k8s::ServiceAccount": {"name": STR, "namespace": STR, "extra": SET},
+    "k8s::Group": {"name": STR},
+    "k8s::Extra": {"key": STR, "value": STR},
+    "k8s::PrincipalUID": {},
+    "k8s::Resource": {
+        "apiGroup": STR,
+        "resource": STR,
+        "name": STR,
+        "subresource": STR,
+        "namespace": STR,
+        "labelSelector": SET,
+        "fieldSelector": SET,
+    },
+    "k8s::NonResourceURL": {"path": STR},
+}
+
+
+@dataclass
+class SchemaInfo:
+    """What the lowerer may assume about request shapes."""
+
+    var_types: Dict[str, Tuple[str, ...]] = field(
+        default_factory=lambda: dict(AUTHZ_VAR_TYPES)
+    )
+    mandatory: Dict[str, FrozenSet[str]] = field(
+        default_factory=lambda: dict(AUTHZ_MANDATORY_ATTRS)
+    )
+    attr_types: Dict[str, Dict[str, str]] = field(
+        default_factory=lambda: dict(AUTHZ_ATTR_TYPES)
+    )
+
+    def attr_type(self, var_type: Optional[str], var: str, path: Tuple[str, ...]) -> str:
+        """Static type of var.path, or UNKNOWN. Only single-component paths
+        are typed in the closed authz schema."""
+        if var == "context" or len(path) != 1:
+            return UNKNOWN
+        attr = path[0]
+        candidates = (var_type,) if var_type else self.var_types.get(var, ())
+        seen: Set[str] = set()
+        for t in candidates:
+            table = self.attr_types.get(t, {})
+            if attr in table:
+                seen.add(table[attr])
+        if len(seen) == 1:
+            return next(iter(seen))
+        return UNKNOWN
+
+    def is_mandatory(
+        self, var_type: Optional[str], var: str, path: Tuple[str, ...]
+    ) -> bool:
+        if var == "context" or len(path) != 1:
+            return False
+        attr = path[0]
+        candidates = (var_type,) if var_type else self.var_types.get(var, ())
+        if not candidates:
+            return False
+        return all(attr in self.mandatory.get(t, frozenset()) for t in candidates)
+
+
+AUTHZ_SCHEMA_INFO = SchemaInfo()
+
+
+# ----------------------------------------------------------- expr utilities
+
+
+def slot_of(e: ast.Expr) -> Optional[Slot]:
+    """(var, attr-path) for GetAttr chains rooted at a request variable."""
+    path: List[str] = []
+    while isinstance(e, ast.GetAttr):
+        path.append(e.attr)
+        e = e.obj
+    if isinstance(e, ast.Var):
+        return (e.name, tuple(reversed(path)))
+    return None
+
+
+_NO_CONST = object()
+
+
+def const_of(e: ast.Expr):
+    """Compile-time constant value of an expression, or _NO_CONST."""
+    if isinstance(e, ast.Lit):
+        return e.value
+    if isinstance(e, ast.EntityLit):
+        return e.uid
+    if isinstance(e, ast.SetLit):
+        elems = [const_of(x) for x in e.elems]
+        if any(x is _NO_CONST for x in elems):
+            return _NO_CONST
+        return CedarSet(elems)
+    if isinstance(e, ast.RecordLit):
+        pairs = {}
+        for k, v in e.pairs:
+            cv = const_of(v)
+            if cv is _NO_CONST:
+                return _NO_CONST
+            pairs[k] = cv
+        return CedarRecord(pairs)
+    if isinstance(e, ast.ExtCall):
+        args = [const_of(a) for a in e.args]
+        if len(args) != 1 or not isinstance(args[0], str):
+            return _NO_CONST
+        try:
+            if e.func == "ip":
+                return IPAddr.parse(args[0])
+            if e.func == "decimal":
+                return Decimal.parse(args[0])
+        except EvalError:
+            return _NO_CONST
+    if isinstance(e, ast.Unary) and e.op == "neg":
+        v = const_of(e.arg)
+        if type(v) is int:
+            return -v
+    return _NO_CONST
+
+
+def slot_accesses(slot: Slot, include_last: bool = True) -> Tuple[Slot, ...]:
+    var, path = slot
+    end = len(path) if include_last else len(path) - 1
+    return tuple((var, path[: i + 1]) for i in range(end))
+
+
+# --------------------------------------------------------- literal building
+
+
+def leaf_literal(e: ast.Expr) -> Tuple[Literal, bool]:
+    """Lower a leaf boolean expression to (Literal, negated)."""
+    if isinstance(e, ast.Binary) and e.op in ("==", "!="):
+        neg = e.op == "!="
+        for a, b in ((e.left, e.right), (e.right, e.left)):
+            s = slot_of(a)
+            c = const_of(b)
+            if isinstance(a, ast.Var) and a.name != "context":
+                # bare request variable: compare UIDs, not attribute slots
+                if isinstance(c, EntityUID):
+                    return (Literal(EQ_ENTITY, var=a.name, data=(c.type, c.id)), neg)
+                if c is not _NO_CONST:
+                    # entity == non-entity: cross-type eq is constant False
+                    return (Literal(TRUE), not neg)
+                continue
+            if s is not None and s[1] and c is not _NO_CONST:
+                return (
+                    Literal(
+                        EQ,
+                        var=s[0],
+                        slot=s,
+                        data=value_key(c),
+                        accesses=slot_accesses(s),
+                        total=False,
+                    ),
+                    neg,
+                )
+        return _hard(e), False
+    if isinstance(e, ast.Binary) and e.op in ("<", "<=", ">", ">="):
+        s = slot_of(e.left)
+        c = const_of(e.right)
+        if s is not None and s[1] and type(c) is int:
+            return (
+                Literal(
+                    CMP,
+                    var=s[0],
+                    slot=s,
+                    data=(e.op, c),
+                    accesses=slot_accesses(s),
+                    total=False,
+                ),
+                False,
+            )
+        s = slot_of(e.right)
+        c = const_of(e.left)
+        if s is not None and s[1] and type(c) is int:
+            flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}[e.op]
+            return (
+                Literal(
+                    CMP,
+                    var=s[0],
+                    slot=s,
+                    data=(flip, c),
+                    accesses=slot_accesses(s),
+                    total=False,
+                ),
+                False,
+            )
+        return _hard(e), False
+    if isinstance(e, ast.Binary) and e.op == "in":
+        if isinstance(e.left, ast.Var) and e.left.name != "context":
+            var = e.left.name
+            if isinstance(e.right, ast.EntityLit):
+                u = e.right.uid
+                return (Literal(ENTITY_IN, var=var, data=(u.type, u.id)), False)
+            if isinstance(e.right, ast.SetLit) and all(
+                isinstance(x, ast.EntityLit) for x in e.right.elems
+            ):
+                uids = frozenset(
+                    (x.uid.type, x.uid.id) for x in e.right.elems
+                )
+                return (Literal(ENTITY_IN_ANY, var=var, data=uids), False)
+        return _hard(e), False
+    if isinstance(e, ast.HasAttr):
+        s = slot_of(e.obj)
+        if s is not None:
+            var, path = s
+            full = (var, path + (e.attr,))
+            return (
+                Literal(
+                    HAS,
+                    var=var,
+                    slot=full,
+                    accesses=slot_accesses(full, include_last=False),
+                    total=len(path) == 0,
+                ),
+                False,
+            )
+        return _hard(e), False
+    if isinstance(e, ast.Like):
+        s = slot_of(e.obj)
+        if s is not None and s[1]:
+            return (
+                Literal(
+                    LIKE,
+                    var=s[0],
+                    slot=s,
+                    data=e.pattern.components,
+                    accesses=slot_accesses(s),
+                    total=False,
+                ),
+                False,
+            )
+        return _hard(e), False
+    if isinstance(e, ast.Is):
+        # `x is T in e` is handled by the expansion (conjunction of two lits)
+        if isinstance(e.obj, ast.Var) and e.obj.name != "context":
+            return (Literal(IS, var=e.obj.name, data=e.entity_type), False)
+        return _hard(e), False
+    if isinstance(e, ast.MethodCall) and e.method == "contains" and len(e.args) == 1:
+        if isinstance(e.obj, ast.SetLit):
+            cset = const_of(e.obj)
+            s = slot_of(e.args[0])
+            if cset is not _NO_CONST and s is not None and s[1]:
+                keys = frozenset(value_key(x) for x in cset)
+                return (
+                    Literal(
+                        IN_SET,
+                        var=s[0],
+                        slot=s,
+                        data=keys,
+                        accesses=slot_accesses(s),
+                        total=False,
+                    ),
+                    False,
+                )
+        s = slot_of(e.obj)
+        c = const_of(e.args[0])
+        if s is not None and s[1] and c is not _NO_CONST:
+            return (
+                Literal(
+                    SET_HAS,
+                    var=s[0],
+                    slot=s,
+                    data=value_key(c),
+                    accesses=slot_accesses(s),
+                    total=False,
+                ),
+                False,
+            )
+        return _hard(e), False
+    return _hard(e), False
+
+
+def _hard(e: ast.Expr) -> Literal:
+    return Literal(HARD, expr=e, total=False, accesses=())
+
+
+# ------------------------------------------- ordered-DNF expansion (T and F)
+
+
+def _conj(prefixes: List[Clause], suffixes: List[Clause]) -> List[Clause]:
+    out = []
+    for p in prefixes:
+        for s in suffixes:
+            c = p + s
+            if len(c) > MAX_LITERALS:
+                raise Unlowerable("clause literal limit exceeded")
+            out.append(c)
+            if len(out) > MAX_CLAUSES:
+                raise Unlowerable("clause count limit exceeded")
+    return out
+
+
+def expand(e: ast.Expr, want: bool) -> List[Clause]:
+    """Clause set whose disjunction == (e evaluates to `want`), with each
+    clause one short-circuit evaluation path."""
+    if isinstance(e, ast.Lit) and type(e.value) is bool:
+        return [()] if e.value is want else []
+    if isinstance(e, ast.Unary) and e.op == "!":
+        return expand(e.arg, not want)
+    if isinstance(e, ast.And):
+        t_left = expand(e.left, True)
+        if want:
+            return _conj(t_left, expand(e.right, True))
+        return expand(e.left, False) + _conj(t_left, expand(e.right, False))
+    if isinstance(e, ast.Or):
+        f_left = expand(e.left, False)
+        if want:
+            return expand(e.left, True) + _conj(f_left, expand(e.right, True))
+        return _conj(f_left, expand(e.right, False))
+    if isinstance(e, ast.If):
+        t_c, f_c = expand(e.cond, True), expand(e.cond, False)
+        return _conj(t_c, expand(e.then, want)) + _conj(f_c, expand(e.els, want))
+    if isinstance(e, ast.Is) and e.in_entity is not None:
+        # x is T in y  ==  (x is T) && (x in y)
+        conj = ast.And(ast.Is(e.obj, e.entity_type), ast.Binary("in", e.obj, e.in_entity))
+        return expand(conj, want)
+    lit, neg = leaf_literal(e)
+    if lit.kind == TRUE:
+        # constant-folded leaf: (TRUE xor neg) == want?
+        return [()] if (not neg) == want else []
+    # leaf truth is (lit XOR neg); we want clauses for (e == want)
+    negated = neg if want else (not neg)
+    return [(ClauseLit(lit, negated),)]
+
+
+# ----------------------------------------------------------- simplification
+
+
+def simplify_clause(clause: Clause) -> Optional[Clause]:
+    """Dedupe, detect contradictions, and apply same-slot exclusivity:
+    a negated EQ/IN_SET is dropped when a positive EQ/IN_SET on the same slot
+    makes it redundant. Returns None if the clause is unsatisfiable."""
+    # positive equality facts per slot
+    pos_eq: Dict[Slot, object] = {}
+    pos_inset: Dict[Slot, FrozenSet] = {}
+    for cl in clause:
+        if not cl.negated and cl.lit.kind == EQ:
+            pos_eq[cl.lit.slot] = cl.lit.data
+        elif not cl.negated and cl.lit.kind == IN_SET:
+            pos_inset[cl.lit.slot] = cl.lit.data
+    out: List[ClauseLit] = []
+    seen: Set[Tuple] = set()
+    for cl in clause:
+        k = (cl.lit.key(), cl.negated)
+        if k in seen:
+            continue
+        nk = (cl.lit.key(), not cl.negated)
+        if nk in seen:
+            return None  # L and !L
+        if cl.negated and cl.lit.kind == EQ:
+            s = cl.lit.slot
+            if s in pos_eq and pos_eq[s] != cl.lit.data:
+                continue  # implied by the positive equality
+            if s in pos_eq and pos_eq[s] == cl.lit.data:
+                return None
+            if s in pos_inset and cl.lit.data not in pos_inset[s]:
+                continue
+        if cl.negated and cl.lit.kind == IN_SET:
+            s = cl.lit.slot
+            if s in pos_eq and pos_eq[s] not in cl.lit.data:
+                continue
+            if s in pos_eq and pos_eq[s] in cl.lit.data:
+                return None
+        seen.add(k)
+        out.append(cl)
+    return tuple(out)
+
+
+# -------------------------------------------------------- safety analysis
+
+
+def _expr_safe(
+    e: ast.Expr,
+    proven: Set[Slot],
+    type_ctx: Dict[str, Optional[str]],
+    schema: SchemaInfo,
+) -> Tuple[bool, str]:
+    """(is provably error-free, static type). Conservative."""
+
+    def rec(x) -> Tuple[bool, str]:
+        if isinstance(x, ast.Lit):
+            v = x.value
+            t = BOOL if type(v) is bool else LONG if type(v) is int else STR
+            return True, t
+        if isinstance(x, ast.EntityLit):
+            return True, ENTITY
+        if isinstance(x, ast.Var):
+            return True, RECORD if x.name == "context" else ENTITY
+        if isinstance(x, ast.GetAttr):
+            s = slot_of(x)
+            if s is None:
+                return False, UNKNOWN
+            for acc in slot_accesses(s):
+                if acc not in proven and not schema.is_mandatory(
+                    type_ctx.get(acc[0]), acc[0], acc[1]
+                ):
+                    return False, UNKNOWN
+            return True, schema.attr_type(type_ctx.get(s[0]), s[0], s[1])
+        if isinstance(x, ast.HasAttr):
+            s = slot_of(x.obj)
+            if s is None:
+                return False, UNKNOWN
+            for acc in slot_accesses(s):
+                if acc not in proven and not schema.is_mandatory(
+                    type_ctx.get(acc[0]), acc[0], acc[1]
+                ):
+                    return False, UNKNOWN
+            return True, BOOL
+        if isinstance(x, (ast.And, ast.Or)):
+            ok_l, t_l = rec(x.left)
+            ok_r, t_r = rec(x.right)
+            return ok_l and ok_r and t_l == BOOL and t_r == BOOL, BOOL
+        if isinstance(x, ast.Unary):
+            ok, t = rec(x.arg)
+            if x.op == "!":
+                return ok and t == BOOL, BOOL
+            return False, LONG  # negation can overflow on i64 min
+        if isinstance(x, ast.Binary):
+            ok_l, t_l = rec(x.left)
+            ok_r, t_r = rec(x.right)
+            if x.op in ("==", "!="):
+                return ok_l and ok_r, BOOL
+            if x.op in ("<", "<=", ">", ">="):
+                return ok_l and ok_r and t_l == LONG and t_r == LONG, BOOL
+            if x.op == "in":
+                return False, BOOL  # needs entity typing; keep conservative
+            return False, LONG  # arithmetic can overflow
+        if isinstance(x, ast.Like):
+            ok, t = rec(x.obj)
+            return ok and t == STR, BOOL
+        if isinstance(x, ast.Is):
+            ok, t = rec(x.obj)
+            if x.in_entity is not None:
+                return False, BOOL
+            return ok and t == ENTITY, BOOL
+        if isinstance(x, ast.SetLit):
+            return all(rec(el)[0] for el in x.elems), SET
+        if isinstance(x, ast.RecordLit):
+            return all(rec(v)[0] for _, v in x.pairs), RECORD
+        if isinstance(x, ast.If):
+            ok_c, t_c = rec(x.cond)
+            ok_t, t_t = rec(x.then)
+            ok_e, t_e = rec(x.els)
+            t = t_t if t_t == t_e else UNKNOWN
+            return ok_c and t_c == BOOL and ok_t and ok_e, t
+        if isinstance(x, ast.MethodCall):
+            ok_o, t_o = rec(x.obj)
+            args = [rec(a) for a in x.args]
+            ok_a = all(a[0] for a in args)
+            if x.method == "contains":
+                return ok_o and ok_a and t_o == SET, BOOL
+            if x.method in ("containsAll", "containsAny"):
+                return (
+                    ok_o and ok_a and t_o == SET and all(a[1] == SET for a in args),
+                    BOOL,
+                )
+            return False, UNKNOWN  # ip/decimal methods: keep conservative
+        if isinstance(x, ast.ExtCall):
+            return const_of(x) is not _NO_CONST, UNKNOWN
+        return False, UNKNOWN
+
+    return rec(e)
+
+
+def _has_lit(acc: Slot) -> Literal:
+    return Literal(
+        HAS,
+        var=acc[0],
+        slot=acc,
+        accesses=slot_accesses(acc, include_last=False),
+        total=len(acc[1]) == 1,
+    )
+
+
+def harden_clause(
+    clause: Clause, policy_type_ctx: Dict[str, Optional[str]], schema: SchemaInfo
+) -> Tuple[Clause, List[Clause]]:
+    """Make the clause error-exact w.r.t. Cedar semantics. Returns
+    (hardened match clause, error clauses).
+
+    Two mechanisms:
+
+    1. A negated literal whose attribute access could error would evaluate
+       true on the device while Cedar skips the policy; insert a synthetic
+       positive HAS guard immediately before it, killing the clause on the
+       same evaluation path Cedar kills the policy.
+    2. Cedar *errors* are an explicit signal (they stop tier descent and
+       appear in diagnostics), so for every literal access that isn't
+       presence-proven, emit an ERROR clause — the evaluation-path prefix
+       plus the negated HAS of the access — true exactly when Cedar's
+       evaluation of this policy errors there. Unlowerable hard
+       sub-expressions get a HARD_ERR indicator the host encoder activates
+       when interpretation raises.
+
+    Raises Unlowerable where neither helps: negated typed operations
+    (like/cmp/contains) on attributes of statically unknown type, and
+    negated opaque expressions that may error for non-presence reasons."""
+    proven: Set[Slot] = set()
+    type_ctx = dict(policy_type_ctx)
+    out: List[ClauseLit] = []
+    errors: List[Clause] = []
+    for cl in clause:
+        lit = cl.lit
+        # --- error paths for this literal's attribute accesses
+        guards: List[ClauseLit] = []
+        for acc in lit.accesses:
+            if acc in proven or schema.is_mandatory(
+                type_ctx.get(acc[0]), acc[0], acc[1]
+            ):
+                continue
+            errors.append(
+                tuple(out) + tuple(guards) + (ClauseLit(_has_lit(acc), True),)
+            )
+            guards.append(ClauseLit(_has_lit(acc), False))
+        if lit.kind == HARD:
+            ok, t = _expr_safe(lit.expr, proven, type_ctx, schema)
+            if not ok or t != BOOL:
+                if cl.negated:
+                    raise Unlowerable(
+                        "negated unlowerable expression may error at runtime"
+                    )
+                errors.append(
+                    tuple(out)
+                    + (ClauseLit(Literal(HARD_ERR, expr=lit.expr), False),)
+                )
+        if cl.negated and not lit.total and lit.kind != HARD:
+            # typed operations need the operand type to be static; a
+            # presence guard can't prevent a type error
+            if lit.kind in (LIKE, CMP, SET_HAS):
+                want = {LIKE: STR, CMP: LONG, SET_HAS: SET}[lit.kind]
+                got = schema.attr_type(type_ctx.get(lit.var), lit.var, lit.slot[1])
+                if got != want:
+                    raise Unlowerable(
+                        f"negated {lit.kind} on attribute of uncertain type"
+                    )
+            # presence guards keep the device path aligned with Cedar's
+            # error-skip on the negated literal
+            out.extend(guards)
+            proven.update(g.lit.slot for g in guards)
+        if not cl.negated:
+            if lit.kind == IS and lit.var in type_ctx and type_ctx[lit.var] is None:
+                type_ctx[lit.var] = lit.data
+            if lit.kind == HAS and lit.slot is not None:
+                proven.add(lit.slot)
+                proven.update(lit.accesses)
+            elif lit.accesses:
+                proven.update(lit.accesses)
+        out.append(cl)
+    if len(out) > MAX_LITERALS:
+        raise Unlowerable("clause literal limit exceeded after hardening")
+    return tuple(out), errors
+
+
+# ------------------------------------------------------------ policy level
+
+
+def scope_literals(policy: ast.Policy) -> Tuple[List[ClauseLit], Dict[str, Optional[str]]]:
+    lits: List[ClauseLit] = []
+    type_ctx: Dict[str, Optional[str]] = {
+        "principal": None,
+        "action": None,
+        "resource": None,
+    }
+    for var in ("principal", "action", "resource"):
+        sc: ast.Scope = getattr(policy, var)
+        if sc.op == "all":
+            continue
+        if sc.op == "eq":
+            lits.append(
+                ClauseLit(
+                    Literal(EQ_ENTITY, var=var, data=(sc.entity.type, sc.entity.id)),
+                    False,
+                )
+            )
+            type_ctx[var] = sc.entity.type
+        elif sc.op == "in":
+            if sc.entities:
+                uids = frozenset((u.type, u.id) for u in sc.entities)
+                lits.append(ClauseLit(Literal(ENTITY_IN_ANY, var=var, data=uids), False))
+            else:
+                lits.append(
+                    ClauseLit(
+                        Literal(
+                            ENTITY_IN, var=var, data=(sc.entity.type, sc.entity.id)
+                        ),
+                        False,
+                    )
+                )
+        elif sc.op == "is":
+            lits.append(ClauseLit(Literal(IS, var=var, data=sc.entity_type), False))
+            type_ctx[var] = sc.entity_type
+        elif sc.op == "is_in":
+            lits.append(ClauseLit(Literal(IS, var=var, data=sc.entity_type), False))
+            lits.append(
+                ClauseLit(
+                    Literal(ENTITY_IN, var=var, data=(sc.entity.type, sc.entity.id)),
+                    False,
+                )
+            )
+            type_ctx[var] = sc.entity_type
+    return lits, type_ctx
+
+
+def lower_policy(
+    policy: ast.Policy, tier: int, schema: SchemaInfo = AUTHZ_SCHEMA_INFO
+) -> LoweredPolicy:
+    prefix, type_ctx = scope_literals(policy)
+
+    # conditions are evaluated in order: when{c} == c, unless{c} == !c
+    cond_clauses: List[Clause] = [()]
+    for cond in policy.conditions:
+        body = cond.body if cond.kind == "when" else ast.Unary("!", cond.body)
+        cond_clauses = _conj(cond_clauses, expand(body, True))
+
+    clauses: List[Clause] = []
+    error_clauses: List[Clause] = []
+    seen_err: Set[Clause] = set()
+    for c in cond_clauses:
+        full = tuple(prefix) + c
+        simplified = simplify_clause(full)
+        if simplified is None:
+            continue
+        hardened, errs = harden_clause(simplified, type_ctx, schema)
+        clauses.append(hardened)
+        for ec in errs:
+            key = tuple((cl.lit.key(), cl.negated) for cl in ec)
+            if key not in seen_err:
+                seen_err.add(key)
+                error_clauses.append(ec)
+    return LoweredPolicy(
+        policy=policy,
+        tier=tier,
+        effect=policy.effect,
+        clauses=clauses,
+        error_clauses=error_clauses,
+    )
+
+
+def lower_tiers(
+    tiers: List[PolicySet], schema: SchemaInfo = AUTHZ_SCHEMA_INFO
+) -> CompiledPolicies:
+    out = CompiledPolicies(n_tiers=len(tiers))
+    for tier_idx, ps in enumerate(tiers):
+        for policy in ps.policies():
+            try:
+                out.lowered.append(lower_policy(policy, tier_idx, schema))
+            except Unlowerable as e:
+                out.fallback.append(
+                    FallbackPolicy(policy=policy, tier=tier_idx, reason=str(e))
+                )
+    return out

@@ -1,0 +1,266 @@
+"""Packing: lowered rules -> device tensors + host encode plan.
+
+The packed form is the TPU-native policy representation:
+
+  * ``W``      [L, R] int8   — +1 literal required true, -1 required false
+  * ``thresh`` [R] float32   — number of positive literals per rule; a rule is
+                               satisfied iff lit-vector @ W[:, r] >= thresh[r]
+  * ``rule_group``  [R]      — tier*2 + effect (0 permit / 1 forbid)
+  * ``rule_policy`` [R]      — index into the policy metadata list (reasons)
+
+Shapes are bucketed (L, R rounded up to power-of-two-ish buckets) so a policy
+reload of similar size is a pure device-buffer swap with no XLA recompile —
+the hot-swap analogue of the reference's RWMutex PolicySet update
+(/root/reference internal/server/store/crd.go:45-118).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+
+from ..lang.ast import Pattern, Policy
+from .ir import (
+    CMP,
+    CompiledPolicies,
+    ENTITY_IN,
+    ENTITY_IN_ANY,
+    EQ,
+    EQ_ENTITY,
+    HARD,
+    HARD_ERR,
+    HAS,
+    IN_SET,
+    IS,
+    LIKE,
+    Literal,
+    SET_HAS,
+    Slot,
+)
+
+PERMIT_IDX = 0
+FORBID_IDX = 1
+ERROR_IDX = 2
+GROUPS_PER_TIER = 3
+
+
+def _bucket(n: int, minimum: int = 128) -> int:
+    """Power-of-two buckets up to 2048, then multiples of 2048: coarse enough
+    that same-size policy reloads reuse compiled executables, fine enough not
+    to waste matmul columns on padding."""
+    b = minimum
+    while b < n and b < 2048:
+        b *= 2
+    if n <= b:
+        return b
+    return ((n + 2047) // 2048) * 2048
+
+
+@dataclass
+class PolicyMeta:
+    policy_id: str
+    filename: str
+    position: Tuple[int, int, int]
+    tier: int
+    effect: str
+
+
+@dataclass
+class EncodePlan:
+    """Inverted indices the host encoder uses to map one request to its
+    active literal ids in O(touched slots), independent of policy count."""
+
+    n_lits: int = 0
+    # scalar slots to extract (var, path) -> nothing; presence implied
+    slots: List[Slot] = field(default_factory=list)
+    eq_idx: Dict[Slot, Dict[object, List[int]]] = field(default_factory=dict)
+    has_idx: Dict[Slot, List[int]] = field(default_factory=dict)
+    like_idx: Dict[Slot, List[Tuple[int, Pattern]]] = field(default_factory=dict)
+    cmp_idx: Dict[Slot, List[Tuple[int, str, int]]] = field(default_factory=dict)
+    inset_idx: Dict[Slot, Dict[object, List[int]]] = field(default_factory=dict)
+    set_has_idx: Dict[Slot, Dict[object, List[int]]] = field(default_factory=dict)
+    eq_entity_idx: Dict[str, Dict[Tuple[str, str], List[int]]] = field(
+        default_factory=dict
+    )
+    entity_in_idx: Dict[str, Dict[Tuple[str, str], List[int]]] = field(
+        default_factory=dict
+    )
+    is_idx: Dict[str, Dict[str, List[int]]] = field(default_factory=dict)
+    # (lit id, expr, hard-error lit id or -1): the encoder activates the
+    # error id when interpretation of expr raises an EvalError
+    hard_lits: List[Tuple[int, object, int]] = field(default_factory=list)
+    # a safe upper bound on simultaneously-active literals per request
+    max_active: int = 0
+
+
+@dataclass
+class PackedPolicySet:
+    """Device-ready tensors (as numpy; the engine moves them to device)."""
+
+    W: np.ndarray  # [L, R] int8
+    thresh: np.ndarray  # [R] float32
+    rule_group: np.ndarray  # [R] int32
+    rule_policy: np.ndarray  # [R] int32
+    n_tiers: int
+    n_rules: int
+    n_lits: int
+    L: int  # bucketed literal dim
+    R: int  # bucketed rule dim
+    plan: EncodePlan
+    policy_meta: List[PolicyMeta]
+    fallback: list  # List[FallbackPolicy]
+
+    @property
+    def n_groups(self) -> int:
+        return self.n_tiers * GROUPS_PER_TIER
+
+
+class _LitRegistry:
+    def __init__(self):
+        self.by_key: Dict[tuple, int] = {}
+        self.lits: List[Literal] = []
+
+    def intern(self, lit: Literal) -> int:
+        k = lit.key()
+        idx = self.by_key.get(k)
+        if idx is None:
+            idx = len(self.lits)
+            self.by_key[k] = idx
+            self.lits.append(lit)
+        return idx
+
+
+def pack(compiled: CompiledPolicies) -> PackedPolicySet:
+    reg = _LitRegistry()
+    rules: List[Tuple[List[Tuple[int, bool]], int, int]] = []  # (lits, group, pmeta)
+    policy_meta: List[PolicyMeta] = []
+
+    for lp in compiled.lowered:
+        p: Policy = lp.policy
+        pm_idx = len(policy_meta)
+        policy_meta.append(
+            PolicyMeta(p.policy_id, p.filename, p.position, lp.tier, lp.effect)
+        )
+        effect_idx = FORBID_IDX if lp.effect == "forbid" else PERMIT_IDX
+        group = lp.tier * GROUPS_PER_TIER + effect_idx
+        for clause in lp.clauses:
+            lits = [(reg.intern(cl.lit), cl.negated) for cl in clause]
+            rules.append((lits, group, pm_idx))
+        err_group = lp.tier * GROUPS_PER_TIER + ERROR_IDX
+        for clause in lp.error_clauses:
+            lits = [(reg.intern(cl.lit), cl.negated) for cl in clause]
+            rules.append((lits, err_group, pm_idx))
+
+    n_lits = len(reg.lits)
+    n_rules = len(rules)
+    L = _bucket(max(n_lits, 1))
+    R = _bucket(max(n_rules, 1))
+
+    W = np.zeros((L, R), dtype=np.int8)
+    thresh = np.full((R,), 1e9, dtype=np.float32)  # padding never satisfied
+    rule_group = np.zeros((R,), dtype=np.int32)
+    rule_policy = np.full((R,), np.iinfo(np.int32).max, dtype=np.int32)
+
+    for r, (lits, group, pm_idx) in enumerate(rules):
+        npos = 0
+        for lit_id, negated in lits:
+            W[lit_id, r] = -1 if negated else 1
+            if not negated:
+                npos += 1
+        thresh[r] = float(npos)
+        rule_group[r] = group
+        rule_policy[r] = pm_idx
+
+    plan = _build_plan(reg.lits)
+    plan.n_lits = n_lits
+
+    return PackedPolicySet(
+        W=W,
+        thresh=thresh,
+        rule_group=rule_group,
+        rule_policy=rule_policy,
+        n_tiers=compiled.n_tiers,
+        n_rules=n_rules,
+        n_lits=n_lits,
+        L=L,
+        R=R,
+        plan=plan,
+        policy_meta=policy_meta,
+        fallback=list(compiled.fallback),
+    )
+
+
+def _build_plan(lits: List[Literal]) -> EncodePlan:
+    plan = EncodePlan()
+    slots = set()
+    max_active = 0
+    scalar_slots = set()
+    hard_ids: Dict[object, int] = {}
+    hard_err_ids: Dict[object, int] = {}
+    for i, lit in enumerate(lits):
+        if lit.kind == EQ:
+            plan.eq_idx.setdefault(lit.slot, {}).setdefault(lit.data, []).append(i)
+            slots.add(lit.slot)
+            scalar_slots.add(lit.slot)
+        elif lit.kind == HAS:
+            plan.has_idx.setdefault(lit.slot, []).append(i)
+            slots.add(lit.slot)
+            max_active += 1
+        elif lit.kind == LIKE:
+            plan.like_idx.setdefault(lit.slot, []).append((i, Pattern(lit.data)))
+            slots.add(lit.slot)
+            max_active += 1
+        elif lit.kind == CMP:
+            op, c = lit.data
+            plan.cmp_idx.setdefault(lit.slot, []).append((i, op, c))
+            slots.add(lit.slot)
+            max_active += 1
+        elif lit.kind == IN_SET:
+            d = plan.inset_idx.setdefault(lit.slot, {})
+            for vk in lit.data:
+                d.setdefault(vk, []).append(i)
+            slots.add(lit.slot)
+            max_active += 1
+        elif lit.kind == SET_HAS:
+            plan.set_has_idx.setdefault(lit.slot, {}).setdefault(
+                lit.data, []
+            ).append(i)
+            slots.add(lit.slot)
+            max_active += 1
+        elif lit.kind == EQ_ENTITY:
+            plan.eq_entity_idx.setdefault(lit.var, {}).setdefault(
+                lit.data, []
+            ).append(i)
+            max_active += 1
+        elif lit.kind == ENTITY_IN:
+            plan.entity_in_idx.setdefault(lit.var, {}).setdefault(
+                lit.data, []
+            ).append(i)
+            max_active += 1
+        elif lit.kind == ENTITY_IN_ANY:
+            d = plan.entity_in_idx.setdefault(lit.var, {})
+            for uid in lit.data:
+                d.setdefault(uid, []).append(i)
+            max_active += 1
+        elif lit.kind == IS:
+            plan.is_idx.setdefault(lit.var, {}).setdefault(lit.data, []).append(i)
+            max_active += 1
+        elif lit.kind == HARD:
+            hard_ids[lit.expr] = i
+            max_active += 1
+        elif lit.kind == HARD_ERR:
+            hard_err_ids[lit.expr] = i
+            max_active += 1
+    for expr, lid in hard_ids.items():
+        plan.hard_lits.append((lid, expr, hard_err_ids.pop(expr, -1)))
+    for expr, elid in hard_err_ids.items():
+        # HARD_ERR without a surviving HARD literal (e.g. the hard literal
+        # only appears in error clauses): still evaluate for the error bit
+        plan.hard_lits.append((-1, expr, elid))
+    plan.slots = sorted(slots)
+    # every scalar slot contributes at most one EQ hit and one IN_SET path
+    max_active += len(scalar_slots)
+    plan.max_active = max(max_active, 1)
+    return plan

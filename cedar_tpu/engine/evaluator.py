@@ -1,0 +1,222 @@
+"""TPU policy-evaluation engine: compile, hot-swap, batch-evaluate.
+
+The engine owns the compiled tensor form of a tiered policy set and evaluates
+micro-batches of requests on the device. It is a drop-in `evaluate` backend
+for CedarWebhookAuthorizer (same (entities, request) -> (decision,
+diagnostics) contract as TieredPolicyStores.is_authorized), with:
+
+  * hybrid verdict merge: policies the compiler can't lower are evaluated by
+    the interpreter per request, and the per-tier verdicts are OR-merged
+    before the tier walk — semantics stay exact while lowering coverage grows
+  * double-buffered hot swap: `load()` builds a fresh compiled set and swaps
+    one reference; bucketed shapes mean a same-bucket reload reuses the
+    compiled XLA executable (no retrace)
+  * diagnostics: the device reports the first matching policy per
+    (tier, effect); interpreter-backed tiers report exact reason lists. The
+    reference's reason *ordering* is not a contract (cedar-go iterates a Go
+    map), but callers that need the full matched set should use the
+    interpreter backend.
+
+Tier semantics mirror /root/reference internal/server/store/store.go:25-42:
+first tier with any explicit signal (reasons or errors) wins; the last
+tier's default applies.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..compiler.encode import encode_request
+from ..compiler.ir import CompiledPolicies
+from ..compiler.lower import AUTHZ_SCHEMA_INFO, SchemaInfo, lower_tiers
+from ..compiler.pack import (
+    ERROR_IDX,
+    FORBID_IDX,
+    GROUPS_PER_TIER,
+    PERMIT_IDX,
+    PackedPolicySet,
+    pack,
+)
+from ..lang.authorize import ALLOW, DENY, Diagnostics, PolicySet, Reason
+from ..lang.entities import EntityMap
+from ..lang.eval import Env, Request, policy_matches
+from ..lang.values import EvalError
+from ..ops.match import INT32_MAX, chunk_rules, match_rules_compact
+
+_BATCH_BUCKETS = (1, 8, 32, 128, 512, 1024, 2048, 4096, 8192, 16384, 32768)
+
+
+def _round_bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class _CompiledSet:
+    """Immutable device-resident compiled policy set (the swap unit)."""
+
+    def __init__(self, packed: PackedPolicySet, device=None):
+        self.packed = packed
+        kwargs = {"device": device} if device is not None else {}
+        W3, thresh_c, group_c, policy_c = chunk_rules(
+            packed.W.astype(np.float32), packed.thresh,
+            packed.rule_group, packed.rule_policy,
+        )
+        self.W_dev = jax.device_put(W3.astype(jax.numpy.bfloat16), **kwargs)
+        self.thresh_dev = jax.device_put(thresh_c, **kwargs)
+        self.rule_group_dev = jax.device_put(group_c, **kwargs)
+        self.rule_policy_dev = jax.device_put(policy_c, **kwargs)
+        # active-lit padding bucket: round the plan's bound up for stability
+        self.active_bucket = max(16, int(2 ** np.ceil(np.log2(packed.plan.max_active))))
+
+
+class TPUPolicyEngine:
+    def __init__(self, schema: Optional[SchemaInfo] = None, device=None):
+        self.schema = schema or AUTHZ_SCHEMA_INFO
+        self.device = device
+        self._compiled: Optional[_CompiledSet] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def load(self, tiers: Sequence[PolicySet]) -> dict:
+        """Compile + pack a tiered policy set and atomically swap it in.
+        Returns compile stats."""
+        compiled: CompiledPolicies = lower_tiers(list(tiers), self.schema)
+        packed = pack(compiled)
+        new = _CompiledSet(packed, self.device)
+        with self._lock:
+            self._compiled = new
+        return {**compiled.stats(), "L": packed.L, "R": packed.R}
+
+    @property
+    def loaded(self) -> bool:
+        return self._compiled is not None
+
+    @property
+    def stats(self) -> dict:
+        c = self._compiled
+        if c is None:
+            return {}
+        return {
+            "rules": c.packed.n_rules,
+            "lits": c.packed.n_lits,
+            "L": c.packed.L,
+            "R": c.packed.R,
+            "fallback_policies": len(c.packed.fallback),
+        }
+
+    # ----------------------------------------------------------- evaluation
+
+    def evaluate(
+        self, entities: EntityMap, request: Request
+    ) -> Tuple[str, Diagnostics]:
+        return self.evaluate_batch([(entities, request)])[0]
+
+    def evaluate_batch(
+        self, items: Sequence[Tuple[EntityMap, Request]]
+    ) -> List[Tuple[str, Diagnostics]]:
+        cs = self._compiled
+        if cs is None:
+            raise RuntimeError("TPUPolicyEngine: no policy set loaded")
+        packed = cs.packed
+        n = len(items)
+
+        actives = [
+            encode_request(packed.plan, em, req) for em, req in items
+        ]
+        first = self._device_match(cs, actives)
+
+        results: List[Tuple[str, Diagnostics]] = []
+        for i, (em, req) in enumerate(items):
+            results.append(self._finalize(packed, first[i], em, req))
+        return results
+
+    def _device_match(self, cs: _CompiledSet, actives: List[List[int]]):
+        """Returns first_policy [n, G] int32; INT32_MAX means no match."""
+        packed = cs.packed
+        n = len(actives)
+        B = _round_bucket(n, _BATCH_BUCKETS)
+        max_len = max((len(a) for a in actives), default=1)
+        A = _round_bucket(max(max_len, 1), (cs.active_bucket, 2 * cs.active_bucket,
+                                            4 * cs.active_bucket, 8 * cs.active_bucket))
+        pad_id = packed.L  # out-of-range -> dropped by the scatter
+        arr = np.full((B, A), pad_id, dtype=np.int32)
+        for i, a in enumerate(actives):
+            arr[i, : len(a)] = a[:A]
+        first = match_rules_compact(
+            arr,
+            cs.W_dev,
+            cs.thresh_dev,
+            cs.rule_group_dev,
+            cs.rule_policy_dev,
+            packed.n_groups,
+        )
+        return np.asarray(first)[:n]
+
+    # ------------------------------------------------- fallback + tier walk
+
+    def _finalize(
+        self,
+        packed: PackedPolicySet,
+        first_row: np.ndarray,
+        entities: EntityMap,
+        request: Request,
+    ) -> Tuple[str, Diagnostics]:
+        T = packed.n_tiers
+        fb_allow: List[List[Reason]] = [[] for _ in range(T)]
+        fb_deny: List[List[Reason]] = [[] for _ in range(T)]
+        fb_errors: List[List[str]] = [[] for _ in range(T)]
+        if packed.fallback:
+            env = Env(request, entities)
+            for fp in packed.fallback:
+                p = fp.policy
+                try:
+                    if not policy_matches(p, env):
+                        continue
+                except EvalError as e:
+                    fb_errors[fp.tier].append(
+                        f"while evaluating policy `{p.policy_id}`: {e}"
+                    )
+                    continue
+                reason = Reason(p.policy_id, p.filename, p.position)
+                (fb_deny if p.effect == "forbid" else fb_allow)[fp.tier].append(reason)
+
+        for t in range(T):
+            base = t * GROUPS_PER_TIER
+            permit_g, forbid_g, error_g = (
+                base + PERMIT_IDX,
+                base + FORBID_IDX,
+                base + ERROR_IDX,
+            )
+            deny_reasons = list(fb_deny[t])
+            if first_row[forbid_g] != INT32_MAX:
+                deny_reasons.insert(0, self._meta_reason(packed, first_row[forbid_g]))
+            allow_reasons = list(fb_allow[t])
+            if first_row[permit_g] != INT32_MAX:
+                allow_reasons.insert(0, self._meta_reason(packed, first_row[permit_g]))
+            errors = list(fb_errors[t])
+            if first_row[error_g] != INT32_MAX:
+                meta = packed.policy_meta[int(first_row[error_g])]
+                errors.insert(
+                    0,
+                    f"while evaluating policy `{meta.policy_id}`: evaluation error",
+                )
+            if deny_reasons:
+                return DENY, Diagnostics(reasons=deny_reasons, errors=errors)
+            if allow_reasons:
+                return ALLOW, Diagnostics(reasons=allow_reasons, errors=errors)
+            if errors:
+                # explicit signal: stops tier descent with a reasonless deny
+                return DENY, Diagnostics(reasons=[], errors=errors)
+        return DENY, Diagnostics()
+
+    @staticmethod
+    def _meta_reason(packed: PackedPolicySet, idx: int) -> Reason:
+        meta = packed.policy_meta[int(idx)]
+        return Reason(meta.policy_id, meta.filename, meta.position)

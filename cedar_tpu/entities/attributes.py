@@ -1,0 +1,77 @@
+"""Kubernetes authorization attributes — the webhook-side request model.
+
+A Python rendering of k8s.io/apiserver authorizer.Attributes as consumed by
+the reference webhook (GetAuthorizerAttributes at /root/reference
+internal/server/server.go:163), including parsed label/field selector
+requirements.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+READONLY_VERBS = frozenset({"get", "list", "watch"})
+
+
+@dataclass
+class UserInfo:
+    name: str = ""
+    uid: str = ""
+    groups: Tuple[str, ...] = ()
+    extra: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+
+    def effective_uid(self) -> str:
+        """The reference sets a user ID if absent so the user entity is
+        identifiable (UserInfoWrapper.GetUID, entities/user.go:19-24)."""
+        return self.uid if self.uid else self.name
+
+
+@dataclass(frozen=True)
+class LabelSelectorRequirement:
+    key: str
+    operator: str  # =, ==, in, !=, notin, exists, !
+    values: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class FieldSelectorRequirement:
+    field: str
+    operator: str  # =, ==, in  (k8s field selectors: =, ==, !=)
+    value: str = ""
+
+
+@dataclass
+class Attributes:
+    user: UserInfo = field(default_factory=UserInfo)
+    verb: str = ""
+    namespace: str = ""
+    api_group: str = ""
+    api_version: str = ""
+    resource: str = ""
+    subresource: str = ""
+    name: str = ""
+    resource_request: bool = False
+    path: str = ""
+    label_selector: Tuple[LabelSelectorRequirement, ...] = ()
+    field_selector: Tuple[FieldSelectorRequirement, ...] = ()
+
+    def is_read_only(self) -> bool:
+        return self.verb in READONLY_VERBS
+
+
+def resource_request_to_path(attributes: Attributes) -> str:
+    """Kubernetes URL for the given attributes; used as the Resource entity
+    ID (reference entities/authorization.go:13-30). Selectors are omitted."""
+    base = "/api"
+    if attributes.api_group:
+        base = "/apis/" + attributes.api_group
+    namespace = ""
+    if attributes.namespace:
+        namespace = "/namespaces/" + attributes.namespace
+    resp = f"{base}/{attributes.api_version}{namespace}/{attributes.resource}"
+    if attributes.name:
+        resp += "/" + attributes.name
+    if attributes.subresource:
+        resp += "/" + attributes.subresource
+    return resp

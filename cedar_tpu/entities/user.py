@@ -1,0 +1,50 @@
+"""Principal entity construction from Kubernetes user info.
+
+Behavior parity with reference internal/server/entities/user.go:35
+(UserToCedarEntity): group parent entities, principal type dispatch for
+nodes (`system:node:<name>`) and service accounts
+(`system:serviceaccount:<ns>:<name>`), and the extra map rendered as a Set of
+{key, values} records.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..lang.entities import Entity, EntityMap
+from ..lang.values import CedarRecord, CedarSet, EntityUID
+from ..schema import consts
+from .attributes import UserInfo
+
+
+def user_to_cedar_entity(user: UserInfo) -> Tuple[EntityUID, EntityMap]:
+    resp = EntityMap()
+
+    group_uids = []
+    for group in user.groups:
+        guid = EntityUID(consts.GROUP_ENTITY_TYPE, group)
+        resp.add(Entity(guid, CedarRecord({"name": group})))
+        group_uids.append(guid)
+
+    attrs = {"name": user.name}
+    principal_type = consts.USER_ENTITY_TYPE
+    if user.name.startswith("system:node:") and user.name.count(":") == 2:
+        principal_type = consts.NODE_ENTITY_TYPE
+        attrs["name"] = user.name.split(":")[2]
+    if user.name.startswith("system:serviceaccount:") and user.name.count(":") == 3:
+        principal_type = consts.SERVICE_ACCOUNT_ENTITY_TYPE
+        parts = user.name.split(":")
+        attrs["namespace"] = parts[2]
+        attrs["name"] = parts[3]
+
+    extra_values = []
+    for k, vals in user.extra.items():
+        extra_values.append(
+            CedarRecord({"key": k, "values": CedarSet(tuple(vals))})
+        )
+    if extra_values:
+        attrs["extra"] = CedarSet(extra_values)
+
+    principal_uid = EntityUID(principal_type, user.effective_uid())
+    resp.add(Entity(principal_uid, CedarRecord(attrs), parents=group_uids))
+    return principal_uid, resp

@@ -1,0 +1,114 @@
+"""Device kernel: batched rule matching as an MXU matmul.
+
+The policy set is a matrix W [L, R] over literals x rules (+1 required-true,
+-1 required-false) with per-rule positive-literal counts `thresh`. A request
+batch arrives as padded active-literal index lists [B, A]; the kernel:
+
+  1. scatters them into a {0,1} literal matrix lit [B, L] (bfloat16)
+  2. computes scores = lit @ W with float32 accumulation — one MXU matmul
+     that evaluates EVERY rule of EVERY request at once
+  3. sat = scores >= thresh  (a rule is satisfied iff all its positive
+     literals are active and none of its negated literals are)
+  4. reduces rules into per-(tier, effect) group verdicts and first-match
+     policy indices for diagnostics
+
+Scores are exact: lit entries are 0/1, W entries are +/-1, and row sums stay
+far below 2^24, so bf16 inputs with f32 accumulation lose nothing.
+
+This replaces the reference's per-request tree-walking interpreter loop
+(cedar-go PolicySet.IsAuthorized called at /root/reference
+internal/server/store/store.go:31) with a single data-parallel contraction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+INT32_MAX = 2**31 - 1
+
+
+def _lit_matrix(active, L: int):
+    B = active.shape[0]
+    lit = jnp.zeros((B, L), dtype=jnp.bfloat16)
+    rows = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None], active.shape)
+    return lit.at[rows, active].set(1.0, mode="drop")
+
+
+@functools.partial(jax.jit, static_argnames=("n_groups",))
+def match_rules_compact(active, W_chunks, thresh_c, group_c, policy_c, n_groups: int):
+    """Memory-bounded variant: rules are pre-chunked on the trailing axis and
+    the kernel scans chunks, keeping only the running per-group first-match.
+
+    W_chunks: [C, L, Rc] bf16;  thresh_c/group_c/policy_c: [C, Rc].
+    Returns first_policy [B, G] int32 — INT32_MAX means "no rule matched",
+    so the group-hit bit is simply first_policy != INT32_MAX. One compact
+    output keeps the host round trip to a single small fetch, which matters
+    when the device link has high latency.
+    """
+    B = active.shape[0]
+    L = W_chunks.shape[1]
+    lit = _lit_matrix(active, L)
+
+    def body(carry, xs):
+        Wc, tc, gc, pc = xs
+        scores = jnp.dot(lit, Wc, preferred_element_type=jnp.float32)  # [B, Rc]
+        sat = scores >= tc[None, :]
+        masked = jnp.where(sat, pc[None, :], INT32_MAX)  # [B, Rc]
+        mins = [
+            jnp.min(jnp.where((gc == g)[None, :], masked, INT32_MAX), axis=1)
+            for g in range(n_groups)
+        ]
+        return jnp.minimum(carry, jnp.stack(mins, axis=1)), None
+
+    init = jnp.full((B, n_groups), INT32_MAX, dtype=jnp.int32)
+    first, _ = jax.lax.scan(body, init, (W_chunks, thresh_c, group_c, policy_c))
+    return first
+
+
+def chunk_rules(W, thresh, rule_group, rule_policy, chunk: int = 4096):
+    """Host-side: reshape [L, R] rule tensors into scan chunks [C, L, Rc]."""
+    import numpy as np
+
+    L, R = W.shape
+    rc = min(chunk, R)
+    while R % rc:
+        rc //= 2
+    C = R // rc
+    W3 = np.ascontiguousarray(
+        W.reshape(L, C, rc).transpose(1, 0, 2)
+    )  # [C, L, Rc]
+    return (
+        W3,
+        thresh.reshape(C, rc),
+        rule_group.reshape(C, rc),
+        rule_policy.reshape(C, rc),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n_groups",))
+def match_rules(active, W_bf16, thresh, rule_group, rule_policy, n_groups: int):
+    """active: [B, A] int32 literal ids (pad with >= L to drop).
+    Returns (hits [B, G] bool, first_policy [B, G] int32)."""
+    L = W_bf16.shape[0]
+    lit = _lit_matrix(active, L)
+
+    scores = jnp.dot(lit, W_bf16, preferred_element_type=jnp.float32)  # [B, R]
+    sat = scores >= thresh[None, :]
+
+    group_onehot = jax.nn.one_hot(rule_group, n_groups, dtype=jnp.bfloat16)  # [R, G]
+    hit_counts = jnp.dot(
+        sat.astype(jnp.bfloat16), group_onehot, preferred_element_type=jnp.float32
+    )
+    hits = hit_counts > 0.0  # [B, G]
+
+    firsts = []
+    for g in range(n_groups):
+        mask = (rule_group == g)[None, :] & sat
+        firsts.append(
+            jnp.min(jnp.where(mask, rule_policy[None, :], INT32_MAX), axis=1)
+        )
+    first_policy = jnp.stack(firsts, axis=1)  # [B, G]
+    return hits, first_policy

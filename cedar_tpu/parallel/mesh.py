@@ -1,0 +1,96 @@
+"""Multi-chip sharding of the policy evaluator.
+
+The evaluation step shards over a 2-D device mesh:
+
+  * ``data`` axis — batch data parallelism over in-flight requests (the
+    moral successor of the reference's goroutine-per-HTTP-request model,
+    SURVEY.md §2.4)
+  * ``policy`` axis — tensor parallelism over the rule dimension of the
+    policy matrix W [L, R]: each device holds a rule shard, computes its
+    shard's verdicts, and the tiny per-(tier, effect) group reductions
+    all-reduce across the axis (an OR-reduction — associative, so
+    shard-and-reduce is exact)
+
+XLA inserts the collectives from sharding annotations; they ride ICI within
+a slice and DCN across hosts. There is no NCCL/MPI analogue to port — the
+reference has no distributed backend (SURVEY.md §2.4); this mesh IS the
+distributed communication design.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.match import match_rules
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    data_parallel: Optional[int] = None,
+) -> Mesh:
+    """Build a (data, policy) mesh over the first n_devices devices.
+
+    data_parallel defaults to a balanced split: enough data-parallel groups
+    to keep batch latency low while the policy axis splits the rule matmul.
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if data_parallel is None:
+        # favor policy parallelism; data axis gets the leftover factor
+        data_parallel = 1
+        for cand in (4, 2, 1):
+            if n % cand == 0 and n // cand >= 1:
+                data_parallel = cand if n >= 4 else 1
+                break
+    policy_parallel = n // data_parallel
+    arr = np.array(devices).reshape(data_parallel, policy_parallel)
+    return Mesh(arr, ("data", "policy"))
+
+
+def shard_policy_tensors(mesh: Mesh, W, thresh, rule_group, rule_policy):
+    """Place the packed policy tensors with the rule axis sharded."""
+    w_s = NamedSharding(mesh, P(None, "policy"))
+    r_s = NamedSharding(mesh, P("policy"))
+    return (
+        jax.device_put(W, w_s),
+        jax.device_put(thresh, r_s),
+        jax.device_put(rule_group, r_s),
+        jax.device_put(rule_policy, r_s),
+    )
+
+
+def sharded_match_fn(mesh: Mesh, n_groups: int):
+    """A jitted evaluation step with explicit input/output shardings.
+
+    Inputs: active [B, A] sharded over data; policy tensors sharded over the
+    policy axis. Outputs replicated on policy (XLA inserts the all-reduce
+    for the group-hit matmul and the cross-shard min for first-match)."""
+    in_shardings = (
+        NamedSharding(mesh, P("data", None)),  # active
+        NamedSharding(mesh, P(None, "policy")),  # W
+        NamedSharding(mesh, P("policy")),  # thresh
+        NamedSharding(mesh, P("policy")),  # rule_group
+        NamedSharding(mesh, P("policy")),  # rule_policy
+    )
+    out_shardings = (
+        NamedSharding(mesh, P("data", None)),  # hits
+        NamedSharding(mesh, P("data", None)),  # first_policy
+    )
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=in_shardings,
+        out_shardings=out_shardings,
+    )
+    def step(active, W, thresh, rule_group, rule_policy):
+        return match_rules(active, W, thresh, rule_group, rule_policy, n_groups)
+
+    return step

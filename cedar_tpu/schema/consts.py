@@ -1,0 +1,61 @@
+"""Entity-type and action-name constants for the k8s Cedar schema.
+
+Mirrors the constants in the reference's schema package
+(/root/reference/internal/schema/user_entities.go:15-19,
+internal/schema/authorization.go, internal/schema/admission_actions.go).
+"""
+
+USER_ENTITY_TYPE = "k8s::User"
+GROUP_ENTITY_TYPE = "k8s::Group"
+SERVICE_ACCOUNT_ENTITY_TYPE = "k8s::ServiceAccount"
+NODE_ENTITY_TYPE = "k8s::Node"
+PRINCIPAL_UID_ENTITY_TYPE = "k8s::PrincipalUID"
+EXTRA_VALUE_ENTITY_TYPE = "k8s::Extra"
+
+RESOURCE_ENTITY_TYPE = "k8s::Resource"
+NON_RESOURCE_URL_ENTITY_TYPE = "k8s::NonResourceURL"
+FIELD_REQUIREMENT_TYPE = "k8s::FieldRequirement"
+LABEL_REQUIREMENT_TYPE = "k8s::LabelRequirement"
+
+AUTHORIZATION_ACTION_ENTITY_TYPE = "k8s::Action"
+ADMISSION_ACTION_ENTITY_TYPE = "k8s::admission::Action"
+
+AUTHORIZATION_ACTION_IMPERSONATE = "impersonate"
+
+# The 19 authorization verbs in the hand-coded authz namespace
+# (reference internal/schema/authorization.go:109-128).
+AUTHORIZATION_VERBS = (
+    "get",
+    "list",
+    "watch",
+    "create",
+    "update",
+    "patch",
+    "delete",
+    "deletecollection",
+    "use",
+    "bind",
+    "impersonate",
+    "approve",
+    "sign",
+    "escalate",
+    "attest",
+    "put",
+    "post",
+    "head",
+    "options",
+)
+
+# Admission action ids (reference internal/server/entities/admission.go:23-29)
+ADMISSION_ACTION_ALL = "all"
+ADMISSION_ACTION_CREATE = "create"
+ADMISSION_ACTION_UPDATE = "update"
+ADMISSION_ACTION_DELETE = "delete"
+ADMISSION_ACTION_CONNECT = "connect"
+
+AUTHORIZATION_PRINCIPAL_TYPES = (
+    USER_ENTITY_TYPE,
+    GROUP_ENTITY_TYPE,
+    SERVICE_ACCOUNT_ENTITY_TYPE,
+    NODE_ENTITY_TYPE,
+)

@@ -1,0 +1,134 @@
+"""The authorization decision engine (SubjectAccessReview path).
+
+Behavior parity with reference internal/server/authorizer/authorizer.go:
+  * hard-coded self-allow for the authorizer's own policy/RBAC reads (:38-49)
+  * system:* users skipped (NoOpinion) except service accounts and nodes (:51-57)
+  * NoOpinion until every store reports initial load complete (:58-66)
+  * tiered evaluation and Allow/Deny/NoOpinion mapping (:73-84)
+
+The engine is backend-pluggable: the default path evaluates through the
+tiered stores' interpreter PolicySets; the TPU engine (cedar_tpu.engine)
+plugs in as a drop-in `evaluate` callable with identical semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Tuple
+
+from ..entities.attributes import Attributes
+from ..entities.builders import (
+    action_entities,
+    impersonated_resource_to_cedar_entity,
+    non_resource_to_cedar_entity,
+    resource_to_cedar_entity,
+)
+from ..entities.user import user_to_cedar_entity
+from ..lang.authorize import ALLOW, DENY, Diagnostics
+from ..lang.entities import EntityMap
+from ..lang.eval import Request
+from ..lang.values import CedarRecord
+from ..schema import consts
+from ..stores.store import TieredPolicyStores
+
+log = logging.getLogger(__name__)
+
+# Decisions mirror k8s.io/apiserver authorizer.Decision
+DECISION_ALLOW = "allow"
+DECISION_DENY = "deny"
+DECISION_NO_OPINION = "no_opinion"
+
+# The authorizer's own identity (reference options.go:14-15)
+CEDAR_AUTHORIZER_IDENTITY_NAME = "system:authorizer:cedar-authorizer"
+
+# Evaluate callable signature: (entities, request) -> (cedar decision, diagnostics)
+EvaluateFn = Callable[[EntityMap, Request], Tuple[str, Diagnostics]]
+
+
+def record_to_cedar_resource(attributes: Attributes) -> Tuple[EntityMap, Request]:
+    """Attributes -> (entity map, Cedar request). Parity with
+    RecordToCedarResource (reference authorizer.go:89-111)."""
+    action_uid, req_entities = action_entities(attributes.verb)
+    principal_uid, principal_entities = user_to_cedar_entity(attributes.user)
+    req_entities = req_entities.merged_with(principal_entities)
+
+    if attributes.resource_request:
+        if attributes.verb == consts.AUTHORIZATION_ACTION_IMPERSONATE:
+            entity = impersonated_resource_to_cedar_entity(attributes)
+        else:
+            entity = resource_to_cedar_entity(attributes)
+    else:
+        entity = non_resource_to_cedar_entity(attributes)
+    req_entities.add(entity)
+
+    req = Request(principal_uid, action_uid, entity.uid, CedarRecord())
+    return req_entities, req
+
+
+class CedarWebhookAuthorizer:
+    def __init__(
+        self,
+        stores: TieredPolicyStores,
+        evaluate: Optional[EvaluateFn] = None,
+    ):
+        self.stores = stores
+        self._stores_loaded = False
+        # pluggable evaluation backend; defaults to tiered interpreter eval
+        self._evaluate: EvaluateFn = evaluate or stores.is_authorized
+
+    def authorize(self, attributes: Attributes) -> Tuple[str, str]:
+        """Returns (decision, reason)."""
+        user_name = attributes.user.name
+        if (
+            user_name == CEDAR_AUTHORIZER_IDENTITY_NAME
+            and attributes.is_read_only()
+            and attributes.api_group == "cedar.k8s.aws"
+            and attributes.resource == "policies"
+        ):
+            return (
+                DECISION_ALLOW,
+                "cedar authorizer is always allowed to access policies",
+            )
+        if (
+            user_name == CEDAR_AUTHORIZER_IDENTITY_NAME
+            and attributes.is_read_only()
+            and attributes.api_group == "rbac.authorization.k8s.io"
+        ):
+            return (
+                DECISION_ALLOW,
+                "cedar authorizer is always allowed to read RBAC policies",
+            )
+
+        # Skip system users (internal identities) except SAs and nodes
+        if (
+            user_name.startswith("system:")
+            and not user_name.startswith("system:serviceaccount:")
+            and not user_name.startswith("system:node:")
+        ):
+            return DECISION_NO_OPINION, ""
+
+        if not self._stores_loaded:
+            for store in self.stores:
+                if not store.initial_policy_load_complete():
+                    log.info(
+                        "Policies not yet loaded, returning no opinion: store=%s",
+                        store.name(),
+                    )
+                    return DECISION_NO_OPINION, ""
+            self._stores_loaded = True
+
+        entities, request = record_to_cedar_resource(attributes)
+        decision, diagnostic = self._evaluate(entities, request)
+        if decision == ALLOW:
+            return DECISION_ALLOW, _diagnostic_to_reason(diagnostic)
+        if decision == DENY and diagnostic.reasons:
+            return DECISION_DENY, _diagnostic_to_reason(diagnostic)
+        if diagnostic.errors:
+            log.error("Authorize errors: %s", diagnostic.errors)
+        return DECISION_NO_OPINION, ""
+
+
+def _diagnostic_to_reason(diagnostic: Diagnostics) -> str:
+    if not diagnostic.reasons:
+        return ""
+    return diagnostic.to_json()

@@ -1,0 +1,70 @@
+"""Store configuration -> ordered tiered stores.
+
+Behavior parity with /root/reference internal/server/store/config.go:
+ParseConfig (YAML/JSON + validation) and CedarConfigStores (type switch
+building the ordered store list).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import yaml
+
+from ..apis.v1alpha1 import (
+    CedarConfig,
+    STORE_TYPE_CRD,
+    STORE_TYPE_DIRECTORY,
+    STORE_TYPE_VERIFIED_PERMISSIONS,
+)
+from .avp import VerifiedPermissionsPolicyStore
+from .crd import CRDPolicyStore
+from .directory import DirectoryPolicyStore
+from .store import TieredPolicyStores
+
+
+def parse_config(data: str) -> CedarConfig:
+    raw = yaml.safe_load(data)
+    if raw is None:
+        raw = {}
+    config = CedarConfig.from_dict(raw)
+    config.validate()
+    return config
+
+
+def cedar_config_stores(
+    config: Optional[CedarConfig],
+    kubeconfig_path: Optional[str] = None,
+    avp_client=None,
+) -> TieredPolicyStores:
+    if config is None:
+        return TieredPolicyStores([])
+    stores = []
+    for sd in config.stores:
+        if sd.type == STORE_TYPE_DIRECTORY:
+            stores.append(
+                DirectoryPolicyStore(
+                    sd.directory_store.path,
+                    refresh_interval_s=sd.directory_store.refresh_interval_ns / 1e9,
+                )
+            )
+        elif sd.type == STORE_TYPE_CRD:
+            stores.append(
+                CRDPolicyStore(
+                    kubeconfig_path=kubeconfig_path,
+                    kubeconfig_context=sd.crd_store.kubeconfig_context,
+                )
+            )
+        elif sd.type == STORE_TYPE_VERIFIED_PERMISSIONS:
+            stores.append(
+                VerifiedPermissionsPolicyStore(
+                    sd.verified_permissions_store.policy_store_id,
+                    client=avp_client,
+                    refresh_interval_s=(
+                        sd.verified_permissions_store.refresh_interval_ns / 1e9
+                    ),
+                    region=sd.verified_permissions_store.aws_region,
+                    profile=sd.verified_permissions_store.aws_profile,
+                )
+            )
+    return TieredPolicyStores(stores)

@@ -1,0 +1,327 @@
+"""CRD policy store: watches cedar.k8s.aws/v1alpha1 Policy objects.
+
+Behavior parity with /root/reference internal/server/store/crd.go:
+  * not ready until the initial list completes (crd.go:183-186); the store
+    first poll-waits for its kubeconfig file to exist (bootstrap circular
+    dependency with the apiserver, crd.go:130-144)
+  * add/update/delete events re-parse policy text into the shared set under
+    a lock; policy ids are "<name><idx>-<uid>" (crd.go:60)
+  * a parse error logs and skips that Policy object
+
+The watch transport is pluggable: KubeAPIWatchSource speaks list+watch to a
+real apiserver using a kubeconfig (stdlib TLS, no client library); tests
+drive a fake source directly.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import ssl
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, List, Optional, Protocol
+
+from ..apis.v1alpha1 import GROUP, PolicyObject, VERSION
+from ..lang.authorize import PolicySet
+from ..lang.lexer import ParseError
+from ..lang.parser import parse_policies
+
+log = logging.getLogger(__name__)
+
+Event = tuple  # (type: "ADDED"|"MODIFIED"|"DELETED"|"ERROR", PolicyObject)
+
+
+class WatchExpired(Exception):
+    """The watch's resourceVersion is no longer valid; a fresh list is
+    required (kube 410 Gone / ERROR watch event)."""
+
+
+class PolicyWatchSource(Protocol):
+    def list(self) -> List[PolicyObject]:
+        ...
+
+    def watch(self, on_event: Callable[[str, PolicyObject], None], stop) -> None:
+        """Blocks, delivering events until `stop` (threading.Event) is set."""
+        ...
+
+
+class CRDPolicyStore:
+    def __init__(
+        self,
+        source: Optional[PolicyWatchSource] = None,
+        kubeconfig_path: Optional[str] = None,
+        kubeconfig_context: str = "",
+        start: bool = True,
+    ):
+        self._source = source
+        self._kubeconfig_path = kubeconfig_path or os.environ.get("KUBECONFIG", "")
+        self._kubeconfig_context = kubeconfig_context
+        self._policies = PolicySet()
+        self._ids_by_object: dict = {}  # object name -> [policy ids]
+        self._lock = threading.Lock()
+        self._load_complete = False
+        self._stop = threading.Event()
+        if start:
+            threading.Thread(
+                target=self._populate_policies, name="crd-store", daemon=True
+            ).start()
+
+    def close(self) -> None:
+        self._stop.set()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _populate_policies(self) -> None:
+        if self._source is None:
+            # bootstrap: wait for the kubeconfig file to exist (5s poll)
+            while not self._stop.is_set():
+                if self._kubeconfig_path and os.path.exists(self._kubeconfig_path):
+                    break
+                log.info(
+                    "CRD store waiting for kubeconfig %s", self._kubeconfig_path
+                )
+                if self._stop.wait(5.0):
+                    return
+            try:
+                self._source = KubeAPIWatchSource(
+                    self._kubeconfig_path, self._kubeconfig_context
+                )
+            except Exception as e:  # pragma: no cover - env specific
+                log.error("CRD store: failed to build kube client: %s", e)
+                return
+        try:
+            self._relist()
+        except Exception as e:
+            log.error("CRD store: initial list failed: %s", e)
+            return
+        self._load_complete = True
+        while not self._stop.is_set():
+            try:
+                self._source.watch(self._dispatch, self._stop)
+            except WatchExpired as e:
+                # stale resourceVersion (apiserver compaction / 410 Gone):
+                # drop the bookmark and rebuild from a fresh list
+                log.warning("CRD store: watch expired (%s), relisting", e)
+                self._try_relist()
+            except Exception as e:
+                log.error("CRD store: watch error, retrying: %s", e)
+                if self._stop.wait(2.0):
+                    return
+                self._try_relist()
+
+    def _try_relist(self) -> None:
+        try:
+            reset = getattr(self._source, "reset_resource_version", None)
+            if reset is not None:
+                reset()
+            self._relist()
+        except Exception as e:
+            log.error("CRD store: relist failed: %s", e)
+            self._stop.wait(2.0)
+
+    def _relist(self) -> None:
+        objs = self._source.list()
+        with self._lock:
+            ps = PolicySet()
+            ids_by_object: dict = {}
+            for obj in objs:
+                policies = self._parse(obj)
+                if policies is None:
+                    continue
+                ids = []
+                for i, p in enumerate(policies):
+                    pid = f"{obj.name}{i}-{obj.uid}"
+                    ps.add(p, policy_id=pid)
+                    ids.append(pid)
+                ids_by_object[obj.name] = ids
+            self._policies = ps
+            self._ids_by_object = ids_by_object
+
+    def _dispatch(self, event_type: str, obj: PolicyObject) -> None:
+        if event_type == "ADDED":
+            self.on_add(obj)
+        elif event_type == "MODIFIED":
+            self.on_update(obj)
+        elif event_type == "DELETED":
+            self.on_delete(obj)
+        elif event_type == "ERROR":
+            raise WatchExpired("ERROR event from watch stream")
+
+    # -------------------------------------------------------- event handlers
+
+    def _parse(self, obj: PolicyObject):
+        try:
+            return parse_policies(obj.spec.content, obj.name)
+        except ParseError as e:
+            log.error("Error parsing policy %s: %s", obj.name, e)
+            return None
+
+    def _copy_on_write(self, mutate) -> None:
+        """Build a mutated copy and swap the reference — O(policies) per
+        event (rare), O(1) per read on the authorization hot path."""
+        with self._lock:
+            ps = PolicySet()
+            for p in self._policies.policies():
+                ps.add(p, policy_id=p.policy_id)
+            mutate(ps)
+            self._policies = ps
+
+    def on_add(self, obj: PolicyObject) -> None:
+        policies = self._parse(obj)
+        if policies is None:
+            return
+
+        def mutate(ps: PolicySet) -> None:
+            ids = []
+            for i, p in enumerate(policies):
+                pid = f"{obj.name}{i}-{obj.uid}"
+                ps.add(p, policy_id=pid)
+                ids.append(pid)
+            self._ids_by_object[obj.name] = ids
+
+        self._copy_on_write(mutate)
+
+    def on_update(self, obj: PolicyObject) -> None:
+        policies = self._parse(obj)
+        if policies is None:
+            return
+
+        def mutate(ps: PolicySet) -> None:
+            for pid in self._ids_by_object.pop(obj.name, []):
+                ps.remove(pid)
+            ids = []
+            for i, p in enumerate(policies):
+                pid = f"{obj.name}{i}-{obj.uid}"
+                ps.add(p, policy_id=pid)
+                ids.append(pid)
+            self._ids_by_object[obj.name] = ids
+
+        self._copy_on_write(mutate)
+
+    def on_delete(self, obj: PolicyObject) -> None:
+        def mutate(ps: PolicySet) -> None:
+            for pid in self._ids_by_object.pop(obj.name, []):
+                ps.remove(pid)
+
+        self._copy_on_write(mutate)
+
+    # -------------------------------------------------------------- protocol
+
+    def policy_set(self) -> PolicySet:
+        # the set is immutable once published (copy-on-write swap above)
+        return self._policies
+
+    def initial_policy_load_complete(self) -> bool:
+        return self._load_complete
+
+    def name(self) -> str:
+        return "CRDPolicyStore"
+
+
+# --------------------------------------------------------------- transport
+
+
+class KubeAPIWatchSource:
+    """Minimal list+watch client for the Policy CRD over HTTPS using a
+    kubeconfig — stdlib only (urllib + ssl)."""
+
+    def __init__(self, kubeconfig_path: str, context: str = ""):
+        import yaml
+
+        with open(kubeconfig_path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = context or cfg.get("current-context", "")
+        ctx = next(
+            c["context"] for c in cfg.get("contexts", []) if c["name"] == ctx_name
+        )
+        cluster = next(
+            c["cluster"]
+            for c in cfg.get("clusters", [])
+            if c["name"] == ctx["cluster"]
+        )
+        user = next(
+            u["user"] for u in cfg.get("users", []) if u["name"] == ctx["user"]
+        )
+        self.server = cluster["server"].rstrip("/")
+        self._ssl = ssl.create_default_context()
+        if cluster.get("certificate-authority-data"):
+            self._ssl.load_verify_locations(
+                cadata=base64.b64decode(
+                    cluster["certificate-authority-data"]
+                ).decode()
+            )
+        elif cluster.get("certificate-authority"):
+            self._ssl.load_verify_locations(cafile=cluster["certificate-authority"])
+        if cluster.get("insecure-skip-tls-verify"):
+            self._ssl.check_hostname = False
+            self._ssl.verify_mode = ssl.CERT_NONE
+        self._token = user.get("token", "")
+        self._cert_files = []
+        cert = user.get("client-certificate-data")
+        key = user.get("client-key-data")
+        if cert and key:
+            cf = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+            cf.write(base64.b64decode(cert))
+            cf.close()
+            kf = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+            kf.write(base64.b64decode(key))
+            kf.close()
+            self._ssl.load_cert_chain(cf.name, kf.name)
+            self._cert_files = [cf.name, kf.name]
+        elif user.get("client-certificate") and user.get("client-key"):
+            self._ssl.load_cert_chain(
+                user["client-certificate"], user["client-key"]
+            )
+        self._resource_version = ""
+
+    def _url(self, watch: bool = False) -> str:
+        base = f"{self.server}/apis/{GROUP}/{VERSION}/policies"
+        if watch:
+            rv = f"&resourceVersion={self._resource_version}" if self._resource_version else ""
+            return f"{base}?watch=true{rv}"
+        return base
+
+    def _open(self, url: str, timeout: Optional[float]):
+        req = urllib.request.Request(url)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        return urllib.request.urlopen(req, context=self._ssl, timeout=timeout)
+
+    def list(self) -> List[PolicyObject]:
+        with self._open(self._url(), timeout=30) as resp:
+            body = json.loads(resp.read())
+        self._resource_version = body.get("metadata", {}).get("resourceVersion", "")
+        return [PolicyObject.from_dict(item) for item in body.get("items", [])]
+
+    def reset_resource_version(self) -> None:
+        self._resource_version = ""
+
+    def watch(self, on_event, stop) -> None:
+        try:
+            resp = self._open(self._url(watch=True), timeout=300)
+        except urllib.error.HTTPError as e:
+            if e.code == 410:
+                raise WatchExpired("410 Gone") from None
+            raise
+        with resp:
+            for line in resp:
+                if stop.is_set():
+                    return
+                if not line.strip():
+                    continue
+                evt = json.loads(line)
+                if evt.get("type") == "ERROR":
+                    code = (evt.get("object") or {}).get("code")
+                    if code == 410:
+                        raise WatchExpired("410 Gone (ERROR event)")
+                obj = evt.get("object", {})
+                rv = obj.get("metadata", {}).get("resourceVersion")
+                if rv:
+                    self._resource_version = rv
+                on_event(evt.get("type", ""), PolicyObject.from_dict(obj))

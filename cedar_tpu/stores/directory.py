@@ -1,0 +1,88 @@
+"""Directory policy store: loads *.cedar files, full re-read on a ticker.
+
+Behavior parity with /root/reference internal/server/store/directory.go:
+ready immediately, errors logged-and-skipped per file, policy ids namespaced
+as "<filename>.policy<N>" (directory.go:75), atomic swap of the whole set.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from ..lang.authorize import PolicySet
+from ..lang.lexer import ParseError
+from ..lang.parser import parse_policies
+
+log = logging.getLogger(__name__)
+
+
+class DirectoryPolicyStore:
+    def __init__(
+        self,
+        directory: str,
+        refresh_interval_s: float = 60.0,
+        start_ticker: bool = True,
+        on_reload=None,
+    ):
+        self.directory = directory
+        self.refresh_interval_s = refresh_interval_s
+        self._policies = PolicySet()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._on_reload = on_reload
+        self.load_policies()
+        self._ticker: Optional[threading.Thread] = None
+        if start_ticker:
+            self._ticker = threading.Thread(
+                target=self._reload_loop, name="directory-store-reload", daemon=True
+            )
+            self._ticker.start()
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def _reload_loop(self) -> None:
+        while not self._stop.wait(self.refresh_interval_s):
+            self.load_policies()
+
+    def load_policies(self) -> None:
+        try:
+            entries = sorted(os.listdir(self.directory))
+        except OSError as e:
+            log.error("Error reading policy directory: %s", e)
+            return
+        ps = PolicySet()
+        for name in entries:
+            path = os.path.join(self.directory, name)
+            if not os.path.isfile(path) or not name.endswith(".cedar"):
+                continue
+            try:
+                with open(path, "r") as f:
+                    data = f.read()
+            except OSError as e:
+                log.error("Error reading policy file: %s", e)
+                continue
+            try:
+                policies = parse_policies(data, name)
+            except ParseError as e:
+                log.error("Error loading policy file %s: %s", name, e)
+                continue
+            for i, p in enumerate(policies):
+                ps.add(p, policy_id=f"{name}.policy{i}")
+        with self._lock:
+            self._policies = ps
+        if self._on_reload is not None:
+            self._on_reload(self)
+
+    def policy_set(self) -> PolicySet:
+        with self._lock:
+            return self._policies
+
+    def initial_policy_load_complete(self) -> bool:
+        return True
+
+    def name(self) -> str:
+        return "FilePolicyStore"

@@ -1,0 +1,85 @@
+"""Policy store interface and tiered evaluation semantics.
+
+Behavior parity with reference internal/server/store/store.go:
+  * PolicyStore = {initial_policy_load_complete, policy_set, name}
+  * TieredPolicyStores.is_authorized walks stores first-to-last and stops at
+    the first store yielding an explicit signal (any reasons OR any errors);
+    the last store's decision applies otherwise (store.go:25-42).
+"""
+
+from __future__ import annotations
+
+from typing import List, Protocol, Tuple, runtime_checkable
+
+from ..lang.authorize import DENY, Diagnostics, PolicySet
+from ..lang.entities import EntityMap
+from ..lang.eval import Request
+
+
+@runtime_checkable
+class PolicyStore(Protocol):
+    def initial_policy_load_complete(self) -> bool:
+        """While False the authorizer emits NoOpinion (admission allows)."""
+        ...
+
+    def policy_set(self) -> PolicySet:
+        ...
+
+    def name(self) -> str:
+        ...
+
+
+class TieredPolicyStores:
+    def __init__(self, stores: List[PolicyStore]):
+        self.stores = list(stores)
+
+    def __iter__(self):
+        return iter(self.stores)
+
+    def __len__(self):
+        return len(self.stores)
+
+    def is_authorized(
+        self, entities: EntityMap, req: Request
+    ) -> Tuple[str, Diagnostics]:
+        decision, diagnostic = DENY, Diagnostics()
+        for i, store in enumerate(self.stores):
+            decision, diagnostic = store.policy_set().is_authorized(entities, req)
+            if i == len(self.stores) - 1:
+                break
+            if decision == DENY and not diagnostic.reasons and not diagnostic.errors:
+                continue  # no explicit signal in this tier; fall through
+            break
+        return decision, diagnostic
+
+
+class MemoryStore:
+    """Immutable in-memory store, always or never ready — the test fake and
+    the building block for static policy holders (reference memory.go:17)."""
+
+    def __init__(self, name: str, policy_set: PolicySet, load_complete: bool = True):
+        self._name = name
+        self._policies = policy_set
+        self._load_complete = load_complete
+
+    @classmethod
+    def from_source(
+        cls, filename: str, document: str, load_complete: bool = True
+    ) -> "MemoryStore":
+        return cls(filename, PolicySet.from_source(document, filename), load_complete)
+
+    def policy_set(self) -> PolicySet:
+        return self._policies
+
+    def initial_policy_load_complete(self) -> bool:
+        return self._load_complete
+
+    def name(self) -> str:
+        return self._name
+
+
+class StaticStore(MemoryStore):
+    """A bare PolicySet holder, always ready (reference memory.go:42-54)."""
+
+    def __init__(self, policy_set: PolicySet):
+        super().__init__("StaticStore", policy_set, True)

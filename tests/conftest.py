@@ -2,14 +2,23 @@
 
 Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding paths
 can be exercised without TPU hardware (the driver's dryrun does the same via
-xla_force_host_platform_device_count). Must run before jax is imported.
+xla_force_host_platform_device_count).
+
+Note: this environment's sitecustomize registers the axon TPU plugin and has
+already imported jax with jax_platforms="axon,cpu" by the time conftest runs,
+so setting the env var alone is not enough — the config must be updated
+before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

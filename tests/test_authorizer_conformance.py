@@ -1,0 +1,396 @@
+"""Conformance suite for the authorization engine.
+
+Mirrors the decision tables of the reference's authorizer tests
+(internal/server/authorizer/authorizer_test.go): entity construction,
+impersonation typing, explicit deny, no-opinion, system-user skip,
+store-readiness gating, and self-allow — these (policy, request) -> decision
+pairs are the backend-independent oracle reused for interpreter-vs-TPU
+differential testing.
+"""
+
+import json
+
+import pytest
+
+from cedar_tpu.entities.attributes import (
+    Attributes,
+    LabelSelectorRequirement,
+    UserInfo,
+)
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.server.authorizer import (
+    CEDAR_AUTHORIZER_IDENTITY_NAME,
+    CedarWebhookAuthorizer,
+    DECISION_ALLOW,
+    DECISION_DENY,
+    DECISION_NO_OPINION,
+    record_to_cedar_resource,
+)
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+
+def make_authorizer(policy_src: str, store_complete: bool = True):
+    store = MemoryStore.from_source("test", policy_src, store_complete)
+    return CedarWebhookAuthorizer(TieredPolicyStores([store]))
+
+
+TEST_USER = UserInfo(
+    name="test-user",
+    uid="1234567890",
+    groups=("test-group",),
+    extra={"attr1": ("value1",)},
+)
+
+
+def pods_get(user=TEST_USER, verb="get", resource="pods", name="test-pod"):
+    return Attributes(
+        user=user,
+        verb=verb,
+        namespace="default",
+        api_group="",
+        api_version="v1",
+        resource=resource,
+        name=name,
+        resource_request=True,
+    )
+
+
+# ------------------------------------------------------ entity construction
+
+
+def test_record_to_cedar_resource_shapes():
+    entities, req = record_to_cedar_resource(pods_get())
+    assert req.principal.type == "k8s::User"
+    assert req.principal.id == "1234567890"  # uid, not name
+    assert req.action.type == "k8s::Action" and req.action.id == "get"
+    assert req.resource.type == "k8s::Resource"
+    assert req.resource.id == "/api/v1/namespaces/default/pods/test-pod"
+    principal = entities.get(req.principal)
+    assert principal.attrs.attrs["name"] == "test-user"
+    # groups become parent entities
+    from cedar_tpu.lang.values import EntityUID
+
+    assert EntityUID("k8s::Group", "test-group") in [p for p in principal.parents]
+    group_ent = entities.get(EntityUID("k8s::Group", "test-group"))
+    assert group_ent.attrs.attrs["name"] == "test-group"
+    # extra -> set of {key, values} records
+    extra = principal.attrs.attrs["extra"]
+    assert any(r.attrs["key"] == "attr1" for r in extra)
+    res = entities.get(req.resource)
+    assert res.attrs.attrs["resource"] == "pods"
+    assert res.attrs.attrs["apiGroup"] == ""
+    assert res.attrs.attrs["name"] == "test-pod"
+    assert "subresource" not in res.attrs.attrs
+
+
+def test_user_uid_defaults_to_name():
+    entities, req = record_to_cedar_resource(pods_get(user=UserInfo(name="alice")))
+    assert req.principal.id == "alice"
+
+
+def test_service_account_principal_typing():
+    sa = UserInfo(name="system:serviceaccount:kube-system:builder", uid="sa-uid")
+    entities, req = record_to_cedar_resource(pods_get(user=sa))
+    assert req.principal.type == "k8s::ServiceAccount"
+    p = entities.get(req.principal)
+    assert p.attrs.attrs["name"] == "builder"
+    assert p.attrs.attrs["namespace"] == "kube-system"
+
+
+def test_node_principal_typing():
+    node = UserInfo(name="system:node:node-1", uid="node-uid")
+    entities, req = record_to_cedar_resource(pods_get(user=node))
+    assert req.principal.type == "k8s::Node"
+    assert entities.get(req.principal).attrs.attrs["name"] == "node-1"
+
+
+def test_nonresource_entity():
+    attrs = Attributes(user=TEST_USER, verb="get", path="/healthz", resource_request=False)
+    entities, req = record_to_cedar_resource(attrs)
+    assert req.resource.type == "k8s::NonResourceURL"
+    assert req.resource.id == "/healthz"
+    assert entities.get(req.resource).attrs.attrs["path"] == "/healthz"
+
+
+def test_label_selector_records():
+    attrs = pods_get()
+    attrs.label_selector = (
+        LabelSelectorRequirement(key="owner", operator="=", values=("test-user",)),
+    )
+    entities, req = record_to_cedar_resource(attrs)
+    sel = entities.get(req.resource).attrs.attrs["labelSelector"]
+    rec = list(sel)[0]
+    assert rec.attrs["key"] == "owner"
+    assert rec.attrs["operator"] == "="
+    assert list(rec.attrs["values"]) == ["test-user"]
+
+
+# ---------------------------------------------------------- decision table
+
+
+def test_allow():
+    a = make_authorizer(
+        """
+permit (
+    principal,
+    action in [k8s::Action::"get", k8s::Action::"list", k8s::Action::"watch"],
+    resource is k8s::Resource
+) when {
+    principal.name == "test-user" &&
+    resource.resource == "pods"
+};"""
+    )
+    decision, reason = a.authorize(pods_get())
+    assert decision == DECISION_ALLOW
+    parsed = json.loads(reason)
+    assert parsed["reasons"][0]["policy"] == "policy0"
+    assert parsed["reasons"][0]["position"]["filename"] == "test"
+
+
+def test_allow_impersonate_uid():
+    a = make_authorizer(
+        """
+permit (
+    principal,
+    action == k8s::Action::"impersonate",
+    resource == k8s::PrincipalUID::"1234"
+) when { principal.name == "test-user" };"""
+    )
+    attrs = Attributes(
+        user=TEST_USER,
+        verb="impersonate",
+        resource="uids",
+        name="1234",
+        resource_request=True,
+    )
+    assert a.authorize(attrs)[0] == DECISION_ALLOW
+
+
+def test_allow_impersonate_serviceaccount():
+    a = make_authorizer(
+        """
+permit (
+    principal,
+    action == k8s::Action::"impersonate",
+    resource is k8s::ServiceAccount
+) when {
+    principal.name == "test-user" &&
+    resource.name == "default" &&
+    resource.namespace == "default"
+};"""
+    )
+    attrs = Attributes(
+        user=TEST_USER,
+        verb="impersonate",
+        namespace="default",
+        resource="serviceaccounts",
+        name="default",
+        resource_request=True,
+    )
+    assert a.authorize(attrs)[0] == DECISION_ALLOW
+
+
+def test_allow_impersonate_node():
+    a = make_authorizer(
+        """
+permit (
+    principal,
+    action == k8s::Action::"impersonate",
+    resource is k8s::Node
+) when { principal.name == "test-user" && resource.name == "node-1" };"""
+    )
+    attrs = Attributes(
+        user=TEST_USER,
+        verb="impersonate",
+        resource="users",
+        name="system:node:node-1",
+        resource_request=True,
+    )
+    assert a.authorize(attrs)[0] == DECISION_ALLOW
+
+
+def test_allow_impersonate_group():
+    a = make_authorizer(
+        """
+permit (
+    principal,
+    action == k8s::Action::"impersonate",
+    resource is k8s::Group
+) when { principal.name == "test-user" && resource.name == "developers" };"""
+    )
+    attrs = Attributes(
+        user=TEST_USER,
+        verb="impersonate",
+        resource="groups",
+        name="developers",
+        resource_request=True,
+    )
+    assert a.authorize(attrs)[0] == DECISION_ALLOW
+
+
+def test_allow_impersonate_extra():
+    a = make_authorizer(
+        """
+permit (
+    principal is k8s::User,
+    action == k8s::Action::"impersonate",
+    resource is k8s::Extra
+) when {
+    principal.name == "test-user" &&
+    resource.key == "test-key" &&
+    resource has value &&
+    resource.value == "test-value"
+};"""
+    )
+    attrs = Attributes(
+        user=TEST_USER,
+        verb="impersonate",
+        resource="userextras",
+        subresource="test-key",
+        name="test-value",
+        resource_request=True,
+    )
+    assert a.authorize(attrs)[0] == DECISION_ALLOW
+
+
+def test_explicit_deny():
+    a = make_authorizer(
+        """
+forbid (
+    principal,
+    action in [k8s::Action::"get", k8s::Action::"list", k8s::Action::"watch"],
+    resource is k8s::Resource
+) when {
+    principal.name == "test-user" &&
+    resource.resource == "pods"
+};"""
+    )
+    decision, reason = a.authorize(pods_get())
+    assert decision == DECISION_DENY
+    assert json.loads(reason)["reasons"][0]["policy"] == "policy0"
+
+
+def test_no_opinion_when_nothing_matches():
+    a = make_authorizer(
+        'permit (principal, action, resource) when { principal.name == "other" };'
+    )
+    decision, reason = a.authorize(pods_get())
+    assert decision == DECISION_NO_OPINION
+    assert reason == ""
+
+
+def test_system_user_skipped():
+    a = make_authorizer("permit (principal, action, resource);")
+    attrs = pods_get(user=UserInfo(name="system:kube-scheduler"))
+    assert a.authorize(attrs) == (DECISION_NO_OPINION, "")
+
+
+def test_system_sa_and_node_not_skipped():
+    a = make_authorizer("permit (principal, action, resource);")
+    sa = pods_get(user=UserInfo(name="system:serviceaccount:default:app"))
+    assert a.authorize(sa)[0] == DECISION_ALLOW
+    node = pods_get(user=UserInfo(name="system:node:n1"))
+    assert a.authorize(node)[0] == DECISION_ALLOW
+
+
+def test_store_not_ready_gives_no_opinion():
+    a = make_authorizer("permit (principal, action, resource);", store_complete=False)
+    assert a.authorize(pods_get()) == (DECISION_NO_OPINION, "")
+
+
+def test_self_allow_policy_read():
+    a = make_authorizer("forbid (principal, action, resource);")
+    attrs = Attributes(
+        user=UserInfo(name=CEDAR_AUTHORIZER_IDENTITY_NAME),
+        verb="list",
+        api_group="cedar.k8s.aws",
+        resource="policies",
+        resource_request=True,
+    )
+    decision, reason = a.authorize(attrs)
+    assert decision == DECISION_ALLOW
+    assert reason == "cedar authorizer is always allowed to access policies"
+
+
+def test_self_allow_rbac_read():
+    a = make_authorizer("forbid (principal, action, resource);")
+    attrs = Attributes(
+        user=UserInfo(name=CEDAR_AUTHORIZER_IDENTITY_NAME),
+        verb="watch",
+        api_group="rbac.authorization.k8s.io",
+        resource="clusterroles",
+        resource_request=True,
+    )
+    assert a.authorize(attrs)[0] == DECISION_ALLOW
+
+
+def test_group_membership_policy():
+    a = make_authorizer(
+        """
+permit (
+    principal in k8s::Group::"viewers",
+    action in [k8s::Action::"get", k8s::Action::"list", k8s::Action::"watch"],
+    resource is k8s::Resource
+) unless { resource.resource == "secrets" && resource.apiGroup == "" };"""
+    )
+    viewer = UserInfo(name="bob", groups=("viewers",))
+    assert a.authorize(pods_get(user=viewer))[0] == DECISION_ALLOW
+    assert (
+        a.authorize(pods_get(user=viewer, resource="secrets", name="s1"))[0]
+        == DECISION_NO_OPINION
+    )
+
+
+def test_label_selector_forbid_unless():
+    src = """
+forbid (
+    principal is k8s::User in k8s::Group::"requires-labels",
+    action in [k8s::Action::"list", k8s::Action::"watch"],
+    resource is k8s::Resource
+) unless {
+    resource has labelSelector &&
+    resource.labelSelector.containsAny([
+        {"key": "owner", "operator": "=", "values": [principal.name]},
+        {"key": "owner", "operator": "==", "values": [principal.name]},
+        {"key": "owner", "operator": "in", "values": [principal.name]}])
+};
+permit (principal, action, resource);
+"""
+    a = make_authorizer(src)
+    user = UserInfo(name="dev1", groups=("requires-labels",))
+    unselected = pods_get(user=user, verb="list", name="")
+    assert a.authorize(unselected)[0] == DECISION_DENY
+    selected = pods_get(user=user, verb="list", name="")
+    selected.label_selector = (
+        LabelSelectorRequirement(key="owner", operator="=", values=("dev1",)),
+    )
+    assert a.authorize(selected)[0] == DECISION_ALLOW
+
+
+def test_self_node_extra_contains_policy():
+    # the demo self-node policy: SA may only touch the node named in its token
+    src = """
+permit (
+    principal is k8s::ServiceAccount,
+    action == k8s::Action::"get",
+    resource is k8s::Resource
+) when {
+    principal.name == "default" &&
+    principal.namespace == "default" &&
+    resource.apiGroup == "" &&
+    resource.resource == "nodes" &&
+    resource has name &&
+    principal.extra.contains({
+        "key": "authentication.kubernetes.io/node-name",
+        "values": [resource.name]})
+};"""
+    a = make_authorizer(src)
+    sa = UserInfo(
+        name="system:serviceaccount:default:default",
+        uid="sa1",
+        extra={"authentication.kubernetes.io/node-name": ("node-a",)},
+    )
+    mine = pods_get(user=sa, resource="nodes", name="node-a")
+    other = pods_get(user=sa, resource="nodes", name="node-b")
+    assert a.authorize(mine)[0] == DECISION_ALLOW
+    assert a.authorize(other)[0] == DECISION_NO_OPINION

@@ -1,0 +1,133 @@
+"""Regression tests for review findings on the TPU compiler/engine."""
+
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+from cedar_tpu.entities.attributes import Attributes, UserInfo
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.server.authorizer import record_to_cedar_resource
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+
+def both(tier_sources, attrs):
+    engine = TPUPolicyEngine()
+    engine.load(
+        [PolicySet.from_source(s, f"t{i}") for i, s in enumerate(tier_sources)]
+    )
+    stores = TieredPolicyStores(
+        [MemoryStore.from_source(f"t{i}", s) for i, s in enumerate(tier_sources)]
+    )
+    em, req = record_to_cedar_resource(attrs)
+    return engine.evaluate(em, req), stores.is_authorized(em, req), engine
+
+
+def sar(name="alice", uid=None, verb="get", resource="pods", subresource=""):
+    return Attributes(
+        user=UserInfo(name=name, uid=uid or name),
+        verb=verb,
+        namespace="default",
+        api_version="v1",
+        resource=resource,
+        subresource=subresource,
+        resource_request=True,
+    )
+
+
+def test_bare_var_entity_equality_lowered_as_uid_compare():
+    src = 'permit (principal, action, resource) when { principal == k8s::User::"alice" };'
+    (tpu_d, _), (int_d, _), _ = both([src], sar("alice"))
+    assert tpu_d == int_d == "allow"
+    (tpu_d, _), (int_d, _), _ = both([src], sar("bob"))
+    assert tpu_d == int_d == "deny"
+
+
+def test_bare_var_entity_inequality_no_over_permit():
+    src = 'permit (principal, action, resource) when { principal != k8s::User::"evil" };'
+    (tpu_d, _), (int_d, _), _ = both([src], sar("evil"))
+    assert tpu_d == int_d == "deny"
+    (tpu_d, _), (int_d, _), _ = both([src], sar("good"))
+    assert tpu_d == int_d == "allow"
+
+
+def test_bare_var_vs_non_entity_is_constant_false():
+    src = 'permit (principal, action, resource) when { principal == "alice" };'
+    (tpu_d, _), (int_d, _), _ = both([src], sar("alice"))
+    assert tpu_d == int_d == "deny"
+    # and the negation is constant true
+    src2 = 'permit (principal, action, resource) when { principal != "alice" };'
+    (tpu_d, _), (int_d, _), _ = both([src2], sar("alice"))
+    assert tpu_d == int_d == "allow"
+
+
+def test_device_eval_errors_stop_tier_descent():
+    # tier 0 policy errors on requests without a subresource; the error is an
+    # explicit signal, so descent must stop with DENY (not fall to tier 1)
+    tiers = [
+        'permit (principal, action, resource) when { resource.subresource == "status" };',
+        "permit (principal, action, resource);",
+    ]
+    (tpu_d, tpu_diag), (int_d, int_diag), engine = both(tiers, sar())
+    assert engine.stats["fallback_policies"] == 0
+    assert int_d == "deny" and int_diag.errors
+    assert tpu_d == "deny"
+    assert tpu_diag.errors  # device-detected error
+    # with the subresource present the policy matches in tier 0
+    (tpu_d, _), (int_d, _), _ = both(tiers, sar(subresource="status"))
+    assert tpu_d == int_d == "allow"
+    # non-matching subresource: no error, no match -> falls through to tier 1
+    (tpu_d, _), (int_d, _), _ = both(tiers, sar(subresource="log"))
+    assert tpu_d == int_d == "allow"
+
+
+def test_hard_literal_error_detected_on_device_path():
+    # context arithmetic errors when context.n is a string; the hard-error
+    # indicator must stop tier descent like the interpreter does
+    tiers = [
+        "permit (principal, action, resource) when { context.n + 1 == 2 };",
+        "permit (principal, action, resource);",
+    ]
+    (tpu_d, tpu_diag), (int_d, int_diag), _ = both(tiers, sar())
+    # context has no attr n -> error in tier 0 -> deny, no fallthrough
+    assert int_d == "deny" and int_diag.errors
+    assert tpu_d == "deny" and tpu_diag.errors
+
+
+def test_crd_watch_expiry_relists():
+    import threading
+
+    from cedar_tpu.apis.v1alpha1 import PolicyObject
+    from cedar_tpu.stores.crd import CRDPolicyStore, WatchExpired
+
+    def pol(name, uid, content):
+        return PolicyObject.from_dict(
+            {"metadata": {"name": name, "uid": uid}, "spec": {"content": content}}
+        )
+
+    class ExpiringSource:
+        def __init__(self):
+            self.lists = 0
+            self.done = threading.Event()
+
+        def list(self):
+            self.lists += 1
+            if self.lists == 1:
+                return [pol("a", "u1", "permit (principal, action, resource);")]
+            return [
+                pol("a", "u1", "permit (principal, action, resource);"),
+                pol("b", "u2", "forbid (principal, action, resource);"),
+            ]
+
+        def reset_resource_version(self):
+            pass
+
+        def watch(self, on_event, stop):
+            if self.lists == 1:
+                raise WatchExpired("410 Gone")
+            self.done.set()
+            stop.wait(5)
+
+    src = ExpiringSource()
+    store = CRDPolicyStore(source=src, start=True)
+    assert src.done.wait(5)
+    assert src.lists == 2  # re-listed after expiry
+    ids = sorted(p.policy_id for p in store.policy_set().policies())
+    assert ids == ["a0-u1", "b0-u2"]
+    store.close()

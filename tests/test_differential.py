@@ -1,0 +1,381 @@
+"""Differential tests: the TPU tensor evaluator must produce identical
+decisions to the interpreter oracle on the same (policy set, request) pairs.
+
+This is the conformance mechanism SURVEY.md §4 calls for: the interpreter is
+the reference-semantics oracle; the compiled matmul path must agree decision-
+for-decision, including tier descent, error semantics, and default deny.
+"""
+
+import random
+
+import pytest
+
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+from cedar_tpu.entities.attributes import (
+    Attributes,
+    LabelSelectorRequirement,
+    UserInfo,
+)
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.server.authorizer import record_to_cedar_resource
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+
+def interp_decision(tier_sources, entities, request):
+    stores = TieredPolicyStores(
+        [MemoryStore.from_source(f"t{i}", s) for i, s in enumerate(tier_sources)]
+    )
+    return stores.is_authorized(entities, request)
+
+
+def tpu_decision(tier_sources, entities, request):
+    engine = TPUPolicyEngine()
+    engine.load(
+        [PolicySet.from_source(s, f"t{i}") for i, s in enumerate(tier_sources)]
+    )
+    return engine.evaluate(entities, request)
+
+
+def check(tier_sources, attributes_list):
+    """Assert interpreter and TPU paths agree for every request."""
+    engine = TPUPolicyEngine()
+    engine.load(
+        [PolicySet.from_source(s, f"t{i}") for i, s in enumerate(tier_sources)]
+    )
+    stores = TieredPolicyStores(
+        [MemoryStore.from_source(f"t{i}", s) for i, s in enumerate(tier_sources)]
+    )
+    items = [record_to_cedar_resource(a) for a in attributes_list]
+    tpu_results = engine.evaluate_batch(items)
+    for (em, req), (tpu_dec, tpu_diag), attrs in zip(
+        items, tpu_results, attributes_list
+    ):
+        int_dec, int_diag = stores.is_authorized(em, req)
+        assert tpu_dec == int_dec, (
+            f"decision mismatch for {attrs}: tpu={tpu_dec} interp={int_dec}"
+        )
+        assert bool(tpu_diag.reasons) == bool(int_diag.reasons), (
+            f"reason presence mismatch for {attrs}"
+        )
+    return engine
+
+
+USER = UserInfo(name="test-user", uid="u1", groups=("viewers", "devs"))
+SA = UserInfo(name="system:serviceaccount:default:default", uid="sa1",
+              extra={"authentication.kubernetes.io/node-name": ("node-a",)})
+
+
+def sar(user=USER, verb="get", resource="pods", name="", namespace="default",
+        api_group="", subresource="", path="", resource_request=True,
+        selector=None):
+    a = Attributes(
+        user=user, verb=verb, namespace=namespace, api_group=api_group,
+        api_version="v1", resource=resource, subresource=subresource,
+        name=name, resource_request=resource_request, path=path,
+    )
+    if selector:
+        a.label_selector = selector
+    return a
+
+
+DEMO = """
+permit (
+    principal,
+    action in [k8s::Action::"get", k8s::Action::"list", k8s::Action::"watch"],
+    resource is k8s::Resource
+) when { principal.name == "test-user" && resource.resource == "pods" };
+forbid (
+    principal,
+    action in [k8s::Action::"get", k8s::Action::"list", k8s::Action::"watch"],
+    resource is k8s::Resource
+) when { principal.name == "test-user" && resource.resource == "nodes" };
+permit (
+    principal in k8s::Group::"viewers",
+    action in [k8s::Action::"get", k8s::Action::"list", k8s::Action::"watch"],
+    resource is k8s::Resource
+) unless { resource.resource == "secrets" && resource.apiGroup == "" };
+"""
+
+
+def test_demo_policy_matrix():
+    cases = [
+        sar(verb="get", resource="pods"),
+        sar(verb="list", resource="pods"),
+        sar(verb="get", resource="nodes"),
+        sar(verb="delete", resource="pods"),
+        sar(verb="get", resource="secrets"),
+        sar(verb="get", resource="deployments", api_group="apps"),
+        sar(user=UserInfo(name="stranger", uid="s1"), verb="get", resource="pods"),
+        sar(user=UserInfo(name="bob", uid="b1", groups=("viewers",)),
+            verb="watch", resource="configmaps"),
+    ]
+    engine = check([DEMO], cases)
+    # everything in the demo set should be lowerable — no fallback
+    assert engine.stats["fallback_policies"] == 0
+
+
+def test_tier_stacks():
+    allow = 'permit (principal, action, resource) when { resource.resource == "pods" };'
+    deny = 'forbid (principal, action, resource) when { resource.resource == "pods" };'
+    nothing = 'permit (principal, action, resource) when { resource.resource == "zzz" };'
+    allow_all = "permit (principal, action, resource);"
+    for tiers in (
+        [allow, deny],
+        [deny, allow],
+        [nothing, allow_all],
+        [nothing, nothing],
+        [allow],
+        [nothing, deny, allow_all],
+    ):
+        check(tiers, [sar(), sar(resource="svc")])
+
+
+def test_like_patterns():
+    src = """
+permit (
+    principal,
+    action == k8s::Action::"get",
+    resource is k8s::NonResourceURL
+) when { resource.path like "/healthz/*" || resource.path == "/version" };
+"""
+    cases = [
+        sar(resource_request=False, path="/healthz/live", resource=""),
+        sar(resource_request=False, path="/healthz", resource=""),
+        sar(resource_request=False, path="/version", resource=""),
+        sar(resource_request=False, path="/metrics", resource=""),
+    ]
+    check([src], cases)
+
+
+def test_impersonation():
+    src = """
+permit (
+    principal,
+    action == k8s::Action::"impersonate",
+    resource is k8s::Node
+) when { principal.name == "test-user" && resource.name == "node-1" };
+permit (
+    principal,
+    action == k8s::Action::"impersonate",
+    resource == k8s::PrincipalUID::"1234"
+);
+"""
+    cases = [
+        sar(verb="impersonate", resource="users", name="system:node:node-1"),
+        sar(verb="impersonate", resource="users", name="system:node:node-2"),
+        sar(verb="impersonate", resource="users", name="alice"),
+        sar(verb="impersonate", resource="uids", name="1234"),
+        sar(verb="impersonate", resource="uids", name="999"),
+        sar(verb="impersonate", resource="groups", name="admins"),
+    ]
+    check([src], cases)
+
+
+def test_extra_contains_hard_literal():
+    src = """
+permit (
+    principal is k8s::ServiceAccount,
+    action == k8s::Action::"get",
+    resource is k8s::Resource
+) when {
+    principal.name == "default" &&
+    resource.resource == "nodes" &&
+    resource has name &&
+    principal.extra.contains({
+        "key": "authentication.kubernetes.io/node-name",
+        "values": [resource.name]})
+};
+"""
+    cases = [
+        sar(user=SA, resource="nodes", name="node-a", namespace=""),
+        sar(user=SA, resource="nodes", name="node-b", namespace=""),
+        sar(user=SA, resource="pods", name="p", namespace=""),
+        sar(resource="nodes", name="node-a", namespace=""),
+    ]
+    check([src], cases)
+
+
+def test_label_selector_forbid_unless():
+    src = """
+forbid (
+    principal is k8s::User in k8s::Group::"requires-labels",
+    action in [k8s::Action::"list", k8s::Action::"watch"],
+    resource is k8s::Resource
+) unless {
+    resource has labelSelector &&
+    resource.labelSelector.containsAny([
+        {"key": "owner", "operator": "=", "values": [principal.name]},
+        {"key": "owner", "operator": "==", "values": [principal.name]},
+        {"key": "owner", "operator": "in", "values": [principal.name]}])
+};
+permit (principal, action, resource);
+"""
+    u = UserInfo(name="dev1", uid="d1", groups=("requires-labels",))
+    sel = (LabelSelectorRequirement(key="owner", operator="=", values=("dev1",)),)
+    wrong = (LabelSelectorRequirement(key="owner", operator="=", values=("other",)),)
+    cases = [
+        sar(user=u, verb="list"),
+        sar(user=u, verb="list", selector=sel),
+        sar(user=u, verb="list", selector=wrong),
+        sar(user=u, verb="get"),
+        sar(verb="list"),
+    ]
+    engine = check([src], cases)
+    # the negated containsAny must have been lowered, not fallen back
+    assert engine.stats["fallback_policies"] == 0
+
+
+def test_unguarded_negation_hardened_with_has_guard():
+    # `resource.subresource != "status"` errors in Cedar when the attribute
+    # is missing; the compiler inserts a HAS guard instead of falling back
+    src = """
+permit (principal, action, resource)
+when { resource.subresource != "status" };
+permit (principal, action, resource)
+when { principal.name == "test-user" && resource.resource == "pods" };
+"""
+    cases = [
+        sar(),  # no subresource -> first policy errors in Cedar
+        sar(subresource="status"),
+        sar(subresource="log"),
+    ]
+    engine = check([src], cases)
+    assert engine.stats["fallback_policies"] == 0
+
+
+def test_unlowerable_negated_expression_goes_to_fallback():
+    # negated arithmetic can overflow-error: no guard can help -> interpreter
+    src = """
+permit (principal, action, resource)
+unless { context has n && context.n + 1 == 2 };
+permit (principal, action, resource)
+when { principal.name == "test-user" && resource.resource == "pods" };
+"""
+    cases = [sar(), sar(resource="svc")]
+    engine = check([src], cases)
+    assert engine.stats["fallback_policies"] >= 1
+
+
+def test_has_guard_lowered_not_fallback():
+    src = """
+permit (principal, action, resource)
+when { resource has subresource && resource.subresource == "status" };
+"""
+    engine = check(
+        [src], [sar(), sar(subresource="status"), sar(subresource="log")]
+    )
+    assert engine.stats["fallback_policies"] == 0
+
+
+def test_unless_has_negation():
+    src = """
+permit (principal, action, resource)
+when { principal.name == "test-user" }
+unless { resource has subresource };
+"""
+    check([src], [sar(), sar(subresource="status")])
+
+
+def test_or_chain_same_slot():
+    src = """
+permit (principal, action, resource)
+when {
+    resource.resource == "pods" ||
+    resource.resource == "services" ||
+    resource.resource == "endpoints" ||
+    ["batch", "apps"].contains(resource.apiGroup)
+};
+"""
+    cases = [
+        sar(resource="pods"),
+        sar(resource="services"),
+        sar(resource="endpoints"),
+        sar(resource="jobs", api_group="batch"),
+        sar(resource="deployments", api_group="apps"),
+        sar(resource="secrets"),
+    ]
+    engine = check([src], cases)
+    assert engine.stats["fallback_policies"] == 0
+
+
+def test_batch_mixed_requests():
+    users = [
+        USER,
+        SA,
+        UserInfo(name="bob", uid="b", groups=("viewers",)),
+        UserInfo(name="eve", uid="e"),
+    ]
+    verbs = ["get", "list", "create", "delete", "impersonate"]
+    resources = ["pods", "nodes", "secrets", "configmaps"]
+    rng = random.Random(42)
+    cases = []
+    for _ in range(64):
+        cases.append(
+            sar(
+                user=rng.choice(users),
+                verb=rng.choice(verbs),
+                resource=rng.choice(resources),
+                name=rng.choice(["", "obj-1", "node-a"]),
+                namespace=rng.choice(["", "default", "kube-system"]),
+                api_group=rng.choice(["", "apps"]),
+                subresource=rng.choice(["", "status"]),
+            )
+        )
+    check([DEMO], cases)
+
+
+def test_randomized_policies_differential():
+    rng = random.Random(7)
+    names = ["alice", "bob", "carol"]
+    resources = ["pods", "services", "secrets"]
+    verbs = ["get", "list", "create"]
+    groups = ["g1", "g2"]
+    policies = []
+    for i in range(40):
+        effect = rng.choice(["permit", "forbid"])
+        scope_p = rng.choice(
+            ["principal", 'principal in k8s::Group::"%s"' % rng.choice(groups),
+             "principal is k8s::User"]
+        )
+        scope_a = rng.choice(
+            ["action", 'action == k8s::Action::"%s"' % rng.choice(verbs),
+             'action in [k8s::Action::"get", k8s::Action::"list"]']
+        )
+        conds = []
+        if rng.random() < 0.8:
+            conds.append(
+                'principal.name == "%s"' % rng.choice(names)
+            )
+        if rng.random() < 0.8:
+            conds.append('resource.resource == "%s"' % rng.choice(resources))
+        if rng.random() < 0.3:
+            conds.append('resource has subresource && resource.subresource == "status"')
+        if rng.random() < 0.2:
+            conds.append(
+                '["%s", "%s"].contains(resource.resource)'
+                % (rng.choice(resources), rng.choice(resources))
+            )
+        body = " && ".join(conds) if conds else "true"
+        if rng.random() < 0.3 and conds:
+            body = body.replace(" && ", " || ", 1)
+        kind = rng.choice(["when", "unless"])
+        policies.append(
+            f"{effect} ({scope_p}, {scope_a}, resource is k8s::Resource) "
+            f"{kind} {{ {body} }};"
+        )
+    src = "\n".join(policies)
+    cases = []
+    for _ in range(80):
+        cases.append(
+            sar(
+                user=UserInfo(
+                    name=rng.choice(names + ["dave"]),
+                    uid="u",
+                    groups=tuple(rng.sample(groups, rng.randint(0, 2))),
+                ),
+                verb=rng.choice(verbs + ["delete"]),
+                resource=rng.choice(resources + ["nodes"]),
+                subresource=rng.choice(["", "status", "log"]),
+            )
+        )
+    check([src], cases)

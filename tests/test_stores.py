@@ -1,0 +1,235 @@
+"""Store tests: directory load/reload, CRD event handling and readiness,
+AVP fake-client rebuild, config parsing/validation/defaulting."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from cedar_tpu.apis.v1alpha1 import (
+    CedarConfig,
+    PolicyObject,
+    ValidationError,
+    duration_to_string,
+    parse_duration,
+)
+from cedar_tpu.stores.avp import VerifiedPermissionsPolicyStore
+from cedar_tpu.stores.crd import CRDPolicyStore
+from cedar_tpu.stores.config import cedar_config_stores, parse_config
+from cedar_tpu.stores.directory import DirectoryPolicyStore
+
+PERMIT = "permit (principal, action, resource);"
+FORBID = "forbid (principal, action, resource);"
+
+
+# ------------------------------------------------------------ duration json
+
+
+def test_parse_duration():
+    assert parse_duration("30s") == 30 * 10**9
+    assert parse_duration("1m") == 60 * 10**9
+    assert parse_duration("1h30m") == 5400 * 10**9
+    assert parse_duration("168h") == 168 * 3600 * 10**9
+    assert parse_duration(1_000_000_000) == 10**9
+    assert parse_duration("1.5s") == 1_500_000_000
+    with pytest.raises(ValidationError):
+        parse_duration("nonsense")
+    with pytest.raises(ValidationError):
+        parse_duration("1x")
+    assert duration_to_string(90 * 10**9) == "1m30s"
+    assert duration_to_string(0) == "0s"
+
+
+# -------------------------------------------------------------- directory
+
+
+def test_directory_store_loads_and_namespaces(tmp_path):
+    (tmp_path / "a.cedar").write_text(PERMIT)
+    (tmp_path / "b.cedar").write_text(PERMIT + "\n" + FORBID)
+    (tmp_path / "ignored.txt").write_text("not cedar")
+    (tmp_path / "bad.cedar").write_text("permit (oops;")
+    store = DirectoryPolicyStore(str(tmp_path), start_ticker=False)
+    ps = store.policy_set()
+    ids = sorted(p.policy_id for p in ps.policies())
+    assert ids == ["a.cedar.policy0", "b.cedar.policy0", "b.cedar.policy1"]
+    assert store.initial_policy_load_complete() is True
+    assert store.name() == "FilePolicyStore"
+
+
+def test_directory_store_reload_swaps(tmp_path):
+    (tmp_path / "a.cedar").write_text(PERMIT)
+    store = DirectoryPolicyStore(str(tmp_path), start_ticker=False)
+    assert len(store.policy_set()) == 1
+    (tmp_path / "a.cedar").write_text(PERMIT + "\n" + FORBID)
+    store.load_policies()
+    assert len(store.policy_set()) == 2
+
+
+def test_directory_store_missing_dir_keeps_old_set(tmp_path):
+    d = tmp_path / "policies"
+    d.mkdir()
+    (d / "a.cedar").write_text(PERMIT)
+    store = DirectoryPolicyStore(str(d), start_ticker=False)
+    assert len(store.policy_set()) == 1
+    (d / "a.cedar").unlink()
+    d.rmdir()
+    store.load_policies()  # error path: directory gone
+    assert len(store.policy_set()) == 1  # old set retained
+
+
+# --------------------------------------------------------------------- crd
+
+
+def pol(name, uid, content):
+    return PolicyObject.from_dict(
+        {
+            "metadata": {"name": name, "uid": uid},
+            "spec": {"content": content},
+        }
+    )
+
+
+def test_crd_store_event_handlers():
+    store = CRDPolicyStore(start=False)
+    assert store.initial_policy_load_complete() is False
+    store.on_add(pol("p1", "uid-1", PERMIT + "\n" + FORBID))
+    ids = sorted(p.policy_id for p in store.policy_set().policies())
+    assert ids == ["p10-uid-1", "p11-uid-1"]
+    store.on_update(pol("p1", "uid-1", PERMIT))
+    assert [p.policy_id for p in store.policy_set().policies()] == ["p10-uid-1"]
+    store.on_delete(pol("p1", "uid-1", ""))
+    assert len(store.policy_set()) == 0
+
+
+def test_crd_store_bad_policy_skipped():
+    store = CRDPolicyStore(start=False)
+    store.on_add(pol("bad", "u", "permit (nope;"))
+    assert len(store.policy_set()) == 0
+    # an update with a parse error leaves the previous content in place
+    store.on_add(pol("p", "u2", PERMIT))
+    store.on_update(pol("p", "u2", "syntax error"))
+    assert len(store.policy_set()) == 1
+
+
+class FakeSource:
+    def __init__(self, objects):
+        self.objects = objects
+        self.watched = threading.Event()
+
+    def list(self):
+        return self.objects
+
+    def watch(self, on_event, stop):
+        on_event("ADDED", pol("late", "u9", PERMIT))
+        self.watched.set()
+        stop.wait(5)
+
+
+def test_crd_store_lifecycle_with_source():
+    src = FakeSource([pol("p1", "u1", PERMIT), pol("p2", "u2", FORBID)])
+    store = CRDPolicyStore(source=src, start=True)
+    deadline = time.time() + 5
+    while not src.watched.is_set() and time.time() < deadline:
+        time.sleep(0.01)
+    assert store.initial_policy_load_complete() is True
+    ids = sorted(p.policy_id for p in store.policy_set().policies())
+    assert ids == ["late0-u9", "p10-u1", "p20-u2"]
+    store.close()
+
+
+# --------------------------------------------------------------------- avp
+
+
+class FakeAVP:
+    def __init__(self):
+        self.policies = {"pol-1": PERMIT, "pol-2": FORBID}
+
+    def list_policy_ids(self, store_id):
+        assert store_id == "store-1"
+        return list(self.policies)
+
+    def get_policy_statement(self, store_id, pid):
+        return self.policies[pid]
+
+
+def test_avp_store_with_fake_client():
+    store = VerifiedPermissionsPolicyStore(
+        "store-1", client=FakeAVP(), start_ticker=False
+    )
+    assert store.initial_policy_load_complete() is True
+    ids = sorted(p.policy_id for p in store.policy_set().policies())
+    assert ids == ["pol-1.policy0", "pol-2.policy0"]
+    assert store.name() == "VerifiedPermissionsStore"
+
+
+# ------------------------------------------------------------------ config
+
+
+def test_parse_config_yaml_defaults(tmp_path):
+    cfg = parse_config(
+        """
+apiVersion: cedar.k8s.aws/v1alpha1
+kind: CedarConfig
+spec:
+  stores:
+    - type: "directory"
+      directoryStore:
+        path: "/cedar-authorizer/policies"
+    - type: "crd"
+"""
+    )
+    assert len(cfg.stores) == 2
+    # defaulting: 1m for directory
+    assert cfg.stores[0].directory_store.refresh_interval_ns == 60 * 10**9
+
+
+def test_parse_config_validation_bounds():
+    with pytest.raises(ValidationError, match="at least 30s"):
+        parse_config(
+            """
+spec:
+  stores:
+    - type: directory
+      directoryStore: {path: /p, refreshInterval: 10s}
+"""
+        )
+    with pytest.raises(ValidationError, match="under 1 week"):
+        parse_config(
+            """
+spec:
+  stores:
+    - type: directory
+      directoryStore: {path: /p, refreshInterval: 169h}
+"""
+        )
+    with pytest.raises(ValidationError, match="invalid store type"):
+        parse_config("spec:\n  stores:\n    - type: bogus\n")
+    with pytest.raises(ValidationError, match="path is required"):
+        parse_config("spec:\n  stores:\n    - type: directory\n")
+    with pytest.raises(ValidationError, match="policy store id is required"):
+        parse_config("spec:\n  stores:\n    - type: verifiedPermissions\n")
+
+
+def test_parse_config_json():
+    cfg = parse_config(
+        '{"spec": {"stores": [{"type": "verifiedPermissions", '
+        '"verifiedPermissionsStore": {"policyStoreId": "abc", '
+        '"refreshInterval": "5m", "awsRegion": "us-west-2"}}]}}'
+    )
+    s = cfg.stores[0].verified_permissions_store
+    assert s.policy_store_id == "abc"
+    assert s.refresh_interval_ns == 300 * 10**9
+    assert s.aws_region == "us-west-2"
+
+
+def test_cedar_config_stores_builds_tiers(tmp_path):
+    d = tmp_path / "pols"
+    d.mkdir()
+    (d / "x.cedar").write_text(PERMIT)
+    cfg = parse_config(
+        f"spec:\n  stores:\n    - type: directory\n      directoryStore:\n        path: {d}\n"
+    )
+    tiers = cedar_config_stores(cfg)
+    assert len(tiers) == 1
+    assert len(tiers.stores[0].policy_set()) == 1

@@ -1,0 +1,79 @@
+"""Tier semantics tests (reference internal/server/store/store_test.go):
+first store with an explicit signal (reasons or errors) wins; the last
+store's default applies otherwise."""
+
+from cedar_tpu.lang import (
+    ALLOW,
+    DENY,
+    CedarRecord,
+    Entity,
+    EntityMap,
+    EntityUID,
+    Request,
+)
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+
+def fixture_env():
+    em = EntityMap()
+    u = EntityUID("k8s::User", "alice")
+    em.add(Entity(u, CedarRecord({"name": "alice"})))
+    a = EntityUID("k8s::Action", "get")
+    r = EntityUID("k8s::Resource", "/api/v1/pods")
+    em.add(Entity(r, CedarRecord({"resource": "pods"})))
+    return em, Request(u, a, r, CedarRecord())
+
+
+ALLOW_PODS = 'permit (principal, action, resource) when { resource.resource == "pods" };'
+DENY_PODS = 'forbid (principal, action, resource) when { resource.resource == "pods" };'
+NOTHING = 'permit (principal, action, resource) when { resource.resource == "other" };'
+ALLOW_ALL = "permit (principal, action, resource);"
+
+
+def tiers(*sources):
+    return TieredPolicyStores(
+        [MemoryStore.from_source(f"tier{i}", src) for i, src in enumerate(sources)]
+    )
+
+
+def test_first_tier_allow_wins_over_later_deny():
+    em, req = fixture_env()
+    decision, diag = tiers(ALLOW_PODS, DENY_PODS).is_authorized(em, req)
+    assert decision == ALLOW
+    assert diag.reasons[0].filename == "tier0"
+
+
+def test_first_tier_deny_wins_over_later_allow():
+    em, req = fixture_env()
+    decision, diag = tiers(DENY_PODS, ALLOW_PODS).is_authorized(em, req)
+    assert decision == DENY
+    assert diag.reasons
+
+
+def test_fallthrough_to_default_deny():
+    em, req = fixture_env()
+    decision, diag = tiers(NOTHING, NOTHING).is_authorized(em, req)
+    assert decision == DENY
+    assert diag.reasons == []
+
+
+def test_fallthrough_to_final_allow_all():
+    em, req = fixture_env()
+    decision, _ = tiers(NOTHING, ALLOW_ALL).is_authorized(em, req)
+    assert decision == ALLOW
+
+
+def test_error_in_tier_stops_descent():
+    # a tier whose only signal is an evaluation error must NOT fall through
+    erroring = "permit (principal, action, resource) when { principal.missing == 1 };"
+    em, req = fixture_env()
+    decision, diag = tiers(erroring, ALLOW_ALL).is_authorized(em, req)
+    assert decision == DENY
+    assert diag.errors
+    assert diag.reasons == []
+
+
+def test_single_store():
+    em, req = fixture_env()
+    assert tiers(ALLOW_PODS).is_authorized(em, req)[0] == ALLOW
+    assert tiers(DENY_PODS).is_authorized(em, req)[0] == DENY
