@@ -1,0 +1,319 @@
+"""Admission-path tests: unstructured→Record conversion, action entities,
+and handler semantics.
+
+Modeled on reference internal/server/entities/admission_test.go
+(TestUnstructuredToEntity) and the handler behaviors in
+internal/server/admission/handler.go.
+"""
+
+import json
+
+import pytest
+
+from cedar_tpu.entities.admission import (
+    AdmissionRequest,
+    GroupVersionKind,
+    GroupVersionResource,
+    admission_action_entities,
+    admission_action_uid,
+    resource_entity_from_admission_request,
+    unstructured_to_record,
+)
+from cedar_tpu.entities.attributes import UserInfo
+from cedar_tpu.lang.values import CedarRecord, CedarSet, EntityUID, IPAddr
+from cedar_tpu.server.admission import (
+    CedarAdmissionHandler,
+    allow_all_admission_policy_store,
+)
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+
+POD = {
+    "apiVersion": "v1",
+    "kind": "Pod",
+    "metadata": {
+        "name": "test-pod",
+        "namespace": "default",
+        "labels": {"app": "web", "tier": "frontend"},
+        "annotations": {"owner": "team-a"},
+    },
+    "spec": {
+        "nodeSelector": {"disktype": "ssd"},
+        "containers": [
+            {
+                "name": "web",
+                "image": "nginx:1.25",
+                "ports": [{"containerPort": 80}],
+            }
+        ],
+        "hostNetwork": False,
+        "priority": 10,
+    },
+    "status": {"podIP": "10.0.0.7", "phase": "Running"},
+}
+
+
+def _pod_request(operation="CREATE", **kw):
+    defaults = dict(
+        uid="review-uid-1",
+        kind=GroupVersionKind("", "v1", "Pod"),
+        resource=GroupVersionResource("", "v1", "pods"),
+        name="test-pod",
+        namespace="default",
+        operation=operation,
+        user_info=UserInfo(name="test-user", uid="u1", groups=("dev",)),
+        object=POD,
+    )
+    defaults.update(kw)
+    return AdmissionRequest(**defaults)
+
+
+class TestUnstructuredToRecord:
+    def test_labels_become_key_value_set(self):
+        rec = unstructured_to_record(POD, "core", "v1", "Pod")
+        labels = rec.attrs["metadata"].attrs["labels"]
+        assert labels == CedarSet(
+            [
+                CedarRecord({"key": "app", "value": "web"}),
+                CedarRecord({"key": "tier", "value": "frontend"}),
+            ]
+        )
+
+    def test_node_selector_is_gvk_scoped_key_value_set(self):
+        rec = unstructured_to_record(POD, "core", "v1", "Pod")
+        sel = rec.attrs["spec"].attrs["nodeSelector"]
+        assert sel == CedarSet([CedarRecord({"key": "disktype", "value": "ssd"})])
+        # same dict under a different kind stays a plain record
+        rec2 = unstructured_to_record(
+            {"nodeSelector": {"disktype": "ssd"}}, "core", "v1", "Deployment"
+        )
+        assert rec2.attrs["nodeSelector"] == CedarRecord({"disktype": "ssd"})
+
+    def test_ip_typed_fields(self):
+        rec = unstructured_to_record(POD, "core", "v1", "Pod")
+        ip = rec.attrs["status"].attrs["podIP"]
+        assert isinstance(ip, IPAddr)
+        assert ip == IPAddr.parse("10.0.0.7")
+        # non-parsable stays a string
+        rec2 = unstructured_to_record(
+            {"status": {"podIP": "not-an-ip"}}, "core", "v1", "Pod"
+        )
+        assert rec2.attrs["status"].attrs["podIP"] == "not-an-ip"
+
+    def test_scalars_lists_and_bools(self):
+        rec = unstructured_to_record(POD, "core", "v1", "Pod")
+        spec = rec.attrs["spec"]
+        assert spec.attrs["hostNetwork"] is False
+        assert spec.attrs["priority"] == 10
+        containers = spec.attrs["containers"]
+        assert isinstance(containers, CedarSet)
+        c0 = containers.elems[0]
+        assert c0.attrs["image"] == "nginx:1.25"
+        assert c0.attrs["ports"].elems[0].attrs["containerPort"] == 80
+
+    def test_empty_and_none_values_skipped(self):
+        rec = unstructured_to_record(
+            {"a": None, "b": {}, "c": {"inner": None}, "d": "x"},
+            "core",
+            "v1",
+            "Pod",
+        )
+        assert set(rec.attrs) == {"d"}
+
+    def test_secret_data_is_key_value_set(self):
+        rec = unstructured_to_record(
+            {"data": {"token": "YWJj"}}, "core", "v1", "Secret"
+        )
+        assert rec.attrs["data"] == CedarSet(
+            [CedarRecord({"key": "token", "value": "YWJj"})]
+        )
+
+    def test_extra_is_key_value_slice_set(self):
+        rec = unstructured_to_record(
+            {"extra": {"scopes": ["a", "b"]}},
+            "authentication",
+            "v1",
+            "UserInfo",
+        )
+        assert rec.attrs["extra"] == CedarSet(
+            [CedarRecord({"key": "scopes", "value": CedarSet(("a", "b"))})]
+        )
+
+    def test_float_is_an_error(self):
+        with pytest.raises(ValueError):
+            unstructured_to_record({"x": 1.5}, "core", "v1", "Pod")
+
+    def test_max_depth(self):
+        deep = cur = {}
+        for _ in range(40):
+            cur["n"] = {}
+            cur = cur["n"]
+        cur["leaf"] = "v"
+        with pytest.raises(ValueError, match="max depth"):
+            unstructured_to_record({"root": deep}, "core", "v1", "Pod")
+
+
+class TestActionEntities:
+    def test_all_parent(self):
+        em = admission_action_entities()
+        assert len(em) == 5
+        all_uid = EntityUID("k8s::admission::Action", "all")
+        for aid in ("create", "update", "delete", "connect"):
+            uid = EntityUID("k8s::admission::Action", aid)
+            assert em.is_ancestor_or_self(uid, all_uid)
+
+    def test_action_uid_and_unsupported(self):
+        assert admission_action_uid(_pod_request("UPDATE")) == EntityUID(
+            "k8s::admission::Action", "update"
+        )
+        with pytest.raises(ValueError):
+            admission_action_uid(_pod_request("BOGUS"))
+
+
+class TestResourceEntity:
+    def test_type_and_path_id(self):
+        ent = resource_entity_from_admission_request(_pod_request())
+        assert ent.uid.type == "core::v1::Pod"
+        assert ent.uid.id == "/api/v1/namespaces/default/pods/test-pod"
+
+    def test_group_in_type(self):
+        req = _pod_request(
+            kind=GroupVersionKind("apps", "v1", "Deployment"),
+            resource=GroupVersionResource("apps", "v1", "deployments"),
+            object={"apiVersion": "apps/v1", "kind": "Deployment"},
+        )
+        ent = resource_entity_from_admission_request(req)
+        assert ent.uid.type == "apps::v1::Deployment"
+        assert ent.uid.id == "/apis/apps/v1/namespaces/default/deployments/test-pod"
+
+    def test_missing_object_raises(self):
+        with pytest.raises(ValueError):
+            resource_entity_from_admission_request(_pod_request(object=None))
+
+
+def _handler(policy_src: str = "", ready: bool = True) -> CedarAdmissionHandler:
+    stores = [MemoryStore.from_source("test", policy_src, load_complete=ready)]
+    stores.append(allow_all_admission_policy_store())
+    return CedarAdmissionHandler(TieredPolicyStores(stores))
+
+
+class TestHandler:
+    def test_default_allow(self):
+        resp = _handler().handle(_pod_request())
+        assert resp.allowed and resp.uid == "review-uid-1"
+
+    def test_skipped_namespaces(self):
+        deny_all = 'forbid (principal, action, resource);'
+        h = _handler(deny_all)
+        assert h.handle(_pod_request(namespace="kube-system")).allowed
+        assert h.handle(_pod_request(namespace="cedar-k8s-authz-system")).allowed
+        assert not h.handle(_pod_request()).allowed
+
+    def test_allow_until_ready(self):
+        deny_all = 'forbid (principal, action, resource);'
+        h = _handler(deny_all, ready=False)
+        assert h.handle(_pod_request()).allowed
+
+    def test_deny_with_reasons(self):
+        src = (
+            'forbid (principal, action == k8s::admission::Action::"create", '
+            "resource is core::v1::Pod) when "
+            '{ resource.metadata.labels.contains({"key": "tier", "value": "frontend"}) };'
+        )
+        resp = _handler(src).handle(_pod_request())
+        assert not resp.allowed
+        reasons = json.loads(resp.message)
+        assert len(reasons) == 1
+
+    def test_action_in_all(self):
+        src = (
+            "forbid (principal, "
+            'action in k8s::admission::Action::"all", '
+            "resource is core::v1::Pod);"
+        )
+        for op in ("CREATE", "UPDATE"):
+            assert not _handler(src).handle(_pod_request(op)).allowed
+
+    def test_delete_uses_old_object(self):
+        src = (
+            'forbid (principal, action == k8s::admission::Action::"delete", '
+            'resource) when { resource.status.phase == "Terminating" };'
+        )
+        old = dict(POD, status={"phase": "Terminating"})
+        req = _pod_request("DELETE", object=None, old_object=old)
+        assert not _handler(src).handle(req).allowed
+        # non-matching old object is allowed
+        req2 = _pod_request("DELETE", object=None, old_object=POD)
+        assert _handler(src).handle(req2).allowed
+
+    def test_update_old_object_context(self):
+        # deny privilege escalation: hostNetwork flipped on in the update
+        src = (
+            'forbid (principal, action == k8s::admission::Action::"update", '
+            "resource is core::v1::Pod) when "
+            "{ resource.spec.hostNetwork && "
+            "!(context.oldObject.spec.hostNetwork) };"
+        )
+        new = json.loads(json.dumps(POD))
+        new["spec"]["hostNetwork"] = True
+        req = _pod_request("UPDATE", object=new, old_object=POD)
+        assert not _handler(src).handle(req).allowed
+        # no flip: allowed
+        req2 = _pod_request("UPDATE", object=POD, old_object=POD)
+        assert _handler(src).handle(req2).allowed
+
+    def test_update_old_object_entity_link(self):
+        # the resource's oldObject attr points at the old entity re-ID'd by
+        # the review UID; dereference it via the entity map
+        src = (
+            'forbid (principal, action == k8s::admission::Action::"update", '
+            "resource is core::v1::Pod) when "
+            '{ resource.oldObject.metadata.name == "test-pod" };'
+        )
+        new = json.loads(json.dumps(POD))
+        req = _pod_request("UPDATE", object=new, old_object=POD)
+        assert not _handler(src).handle(req).allowed
+
+    def test_conversion_error_is_errored_response(self):
+        req = _pod_request("CREATE", object=None)
+        # default allow_on_error=True: errored but admitted
+        resp = _handler().handle(req)
+        assert resp.error is not None and resp.allowed
+        assert resp.to_admission_review()["response"]["status"]["code"] == 500
+        # fail-closed handler denies on conversion errors
+        stores = TieredPolicyStores(
+            [MemoryStore.from_source("t", ""), allow_all_admission_policy_store()]
+        )
+        strict = CedarAdmissionHandler(stores, allow_on_error=False)
+        resp2 = strict.handle(req)
+        assert resp2.error is not None and not resp2.allowed
+
+    def test_from_admission_review_roundtrip(self):
+        body = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "abc-123",
+                "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                "resource": {"group": "", "version": "v1", "resource": "pods"},
+                "name": "test-pod",
+                "namespace": "default",
+                "operation": "CREATE",
+                "userInfo": {
+                    "username": "test-user",
+                    "uid": "u1",
+                    "groups": ["dev"],
+                    "extra": {"scopes": ["a"]},
+                },
+                "object": POD,
+            },
+        }
+        req = AdmissionRequest.from_admission_review(body)
+        assert req.uid == "abc-123"
+        assert req.kind.kind == "Pod"
+        assert req.user_info.extra == {"scopes": ("a",)}
+        resp = _handler().handle(req)
+        assert resp.allowed
+        review = resp.to_admission_review()
+        assert review["response"]["uid"] == "abc-123"
+        assert review["response"]["allowed"] is True
