@@ -330,6 +330,19 @@ def _rbac_to_cedar(binder: Binding, ruler: Role, namespace: str) -> PolicySet:
                 )
                 continue
 
+            if not rule.resources:
+                # a resource rule with no resources grants nothing in RBAC;
+                # skip instead of emitting an unconstrained permit (the
+                # reference would panic indexing rule.Resources[0] — it only
+                # ever sees apiserver-validated objects)
+                log.warning(
+                    "rule %02d of %s %s has no resources; skipping",
+                    ri,
+                    ruler.ruler_type,
+                    ruler.name,
+                )
+                continue
+
             is_full_wildcard = (
                 verbs
                 and verbs[0] == "*"

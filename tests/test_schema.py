@@ -1,0 +1,313 @@
+"""Schema system tests: model JSON marshal, hand-coded namespaces vs the
+reference's generated artifact, name mangling, OpenAPI conversion against the
+reference's recorded fixtures, and the cedarschema text renderers.
+
+The reference artifacts/fixtures under /root/reference are used read-only as
+parity oracles and drive inputs (never copied into the repo); tests that need
+them skip when the reference tree is absent.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from cedar_tpu.cli.schema_formatter import format_schema_text
+from cedar_tpu.cli.schema_generator import (
+    api_path_to_group_version,
+    generate_schema,
+)
+from cedar_tpu.schema import k8s
+from cedar_tpu.schema.convert.names import (
+    escape_docstrings,
+    parse_schema_name,
+    ref_to_relative_type_name,
+    schema_name_to_cedar,
+)
+from cedar_tpu.schema.convert.openapi import (
+    is_entity,
+    modify_schema_for_api_version,
+    ref_to_entity_shape,
+)
+from cedar_tpu.schema.format import format_schema
+from cedar_tpu.schema.model import (
+    Attribute,
+    AttributeElement,
+    CedarSchema,
+    RECORD_TYPE,
+)
+
+REFERENCE = pathlib.Path("/root/reference")
+needs_reference = pytest.mark.skipif(
+    not REFERENCE.exists(), reason="reference tree not mounted"
+)
+
+
+class TestModel:
+    def test_record_attribute_always_has_attributes_key(self):
+        attr = Attribute(type=RECORD_TYPE)
+        assert attr.to_json()["attributes"] == {}
+        attr2 = Attribute(type="String")
+        assert "attributes" not in attr2.to_json()
+        # required always serialized
+        assert attr2.to_json()["required"] is False
+
+    def test_get_entity_shape(self):
+        schema = CedarSchema()
+        schema.namespaces["k8s"] = k8s.get_authorization_namespace()
+        shape = schema.get_entity_shape("k8s::Resource")
+        assert shape is not None and "apiGroup" in shape.attributes
+        # common types are found too
+        assert schema.get_entity_shape("k8s::LabelRequirement") is not None
+        assert schema.get_entity_shape("k8s::Nope") is None
+        assert schema.get_entity_shape("nope::Resource") is None
+
+    def test_sort_action_entities(self):
+        schema = CedarSchema()
+        ns = schema.namespace("x")
+        from cedar_tpu.schema.model import ActionAppliesTo, ActionShape
+
+        ns.actions["a"] = ActionShape(
+            applies_to=ActionAppliesTo(
+                principal_types=["B", "A"], resource_types=["Z", "Y"]
+            )
+        )
+        schema.sort_action_entities()
+        assert ns.actions["a"].applies_to.principal_types == ["A", "B"]
+        assert ns.actions["a"].applies_to.resource_types == ["Y", "Z"]
+
+
+@needs_reference
+class TestAuthorizationNamespaceParity:
+    """The hand-coded k8s namespace must byte-match the reference's
+    generated JSON artifact (cedarschema/k8s-authorization.cedarschema.json),
+    modulo map ordering."""
+
+    @pytest.fixture(scope="class")
+    def reference_ns(self):
+        doc = json.loads(
+            (REFERENCE / "cedarschema/k8s-authorization.cedarschema.json").read_text()
+        )
+        return doc["k8s"]
+
+    @pytest.fixture(scope="class")
+    def ours(self):
+        schema = CedarSchema()
+        schema.namespaces["k8s"] = k8s.get_authorization_namespace("k8s", "k8s", "k8s")
+        schema.sort_action_entities()
+        return schema.to_json()["k8s"]
+
+    def test_entity_types_match(self, reference_ns, ours):
+        assert ours["entityTypes"] == reference_ns["entityTypes"]
+
+    def test_actions_match(self, reference_ns, ours):
+        assert ours["actions"] == reference_ns["actions"]
+
+    def test_common_types_match(self, reference_ns, ours):
+        assert ours["commonTypes"] == reference_ns["commonTypes"]
+
+
+class TestNameTransform:
+    @pytest.mark.parametrize(
+        "name,expected",
+        [
+            ("io.k8s.api.apps.v1.Deployment", ("apps::v1", "Deployment")),
+            ("io.k8s.api.core.v1.Pod", ("core::v1", "Pod")),
+            (
+                "io.k8s.apimachinery.pkg.apis.meta.v1.ObjectMeta",
+                ("meta::v1", "ObjectMeta"),
+            ),
+            (
+                "io.k8s.api.rbac.v1.ClusterRole",
+                ("rbac::v1", "ClusterRole"),
+            ),
+            (
+                "aws.k8s.cedar.v1alpha1.Policy",
+                ("aws::k8s::cedar::v1alpha1", "Policy"),
+            ),
+            (
+                "io.cert-manager.v1.Certificate",
+                ("io::cert_manager::v1", "Certificate"),
+            ),
+        ],
+    )
+    def test_schema_name_to_cedar(self, name, expected):
+        assert schema_name_to_cedar(name) == expected
+
+    def test_parse_schema_name_short(self):
+        assert parse_schema_name("a.b.c") == ("", "", "", "")
+
+    @pytest.mark.parametrize(
+        "current,ref,expected",
+        [
+            (
+                "io.k8s.api.apps.v1.DaemonSet",
+                "#/components/schemas/io.k8s.api.apps.v1.DaemonSetSpec",
+                "DaemonSetSpec",
+            ),
+            (
+                "io.k8s.api.apps.v1.Deployment",
+                "#/components/schemas/io.k8s.apimachinery.pkg.apis.meta.v1.ObjectMeta",
+                "meta::v1::ObjectMeta",
+            ),
+            (
+                "io.k8s.api.apps.v1.Deployment",
+                "#/components/schemas/io.k8s.apimachinery.pkg.apis.meta.v1.Time",
+                "String",
+            ),
+            (
+                "io.k8s.api.core.v1.Container",
+                "#/components/schemas/io.k8s.apimachinery.pkg.api.resource.Quantity",
+                "String",
+            ),
+        ],
+    )
+    def test_ref_to_relative_type_name(self, current, ref, expected):
+        assert ref_to_relative_type_name(current, ref) == expected
+
+    def test_escape_docstrings(self):
+        assert escape_docstrings("  text here  ") == "text here"
+        assert escape_docstrings("Endpoints doc. Example: looks like") == (
+            "Endpoints doc."
+        )
+
+
+@needs_reference
+class TestOpenAPIConversion:
+    """Drives the converter with the reference's recorded OpenAPI fixtures
+    (internal/schema/convert/testdata), asserting the same behaviors as the
+    reference's TestModifySchemaForAPIVersion (openapi_test.go:22-137)."""
+
+    FIXTURES = REFERENCE / "internal/schema/convert/testdata"
+
+    def _convert(self, name, group, version):
+        openapi = json.loads((self.FIXTURES / f"{name}.schema.json").read_text())
+        resources = json.loads(
+            (self.FIXTURES / f"{name}.resourcelist.json").read_text()
+        )
+        schema = CedarSchema()
+        k8s.add_admission_actions(schema, "k8s::admission", "k8s")
+        modify_schema_for_api_version(
+            resources, openapi, schema, group, version, "k8s::admission"
+        )
+        return schema
+
+    def test_apps_v1(self):
+        schema = self._convert("apis.apps.v1", "apps", "v1")
+        apps = schema.namespaces["apps::v1"]
+        # top-level kinds are entities
+        for kind in ("Deployment", "DaemonSet", "StatefulSet", "ReplicaSet"):
+            assert kind in apps.entity_types, kind
+        # list types dropped
+        assert "DeploymentList" not in apps.entity_types
+        assert "DeploymentList" not in apps.common_types
+        # spec types are common types
+        assert "DeploymentSpec" in apps.common_types
+        # updatable kinds get the self-referential oldObject attribute
+        old = apps.entity_types["Deployment"].shape.attributes["oldObject"]
+        assert old.type == "Entity" and old.name == "Deployment"
+        assert not old.required
+        # volumeClaimTemplates items are entity references (reference
+        # openapi_test.go:71-82)
+        sts_spec = apps.common_types["StatefulSetSpec"]
+        vct = sts_spec.attributes["volumeClaimTemplates"]
+        assert vct.type == "Set"
+        assert vct.element.type == "Entity"
+        assert vct.element.name == "core::v1::PersistentVolumeClaim"
+        # admission actions wired
+        admission = schema.namespaces["k8s::admission"]
+        assert "apps::v1::Deployment" in admission.actions["create"].applies_to.resource_types
+        assert "apps::v1::Deployment" in admission.actions["update"].applies_to.resource_types
+        assert "apps::v1::Deployment" in admission.actions["delete"].applies_to.resource_types
+        assert "apps::v1::Deployment" in admission.actions["all"].applies_to.resource_types
+
+    def test_core_v1(self):
+        schema = self._convert("api.v1", "core", "v1")
+        core = schema.namespaces["core::v1"]
+        assert "Pod" in core.entity_types
+        assert "PodSpec" in core.common_types
+        # nodeSelector on PodSpec becomes a KeyValue set
+        node_sel = core.common_types["PodSpec"].attributes["nodeSelector"]
+        assert node_sel.type == "Set"
+        assert node_sel.element.type == "meta::v1::KeyValue"
+        # Secret data becomes a KeyValue set
+        data = core.entity_types["Secret"].shape.attributes["data"]
+        assert data.element.type == "meta::v1::KeyValue"
+
+    def test_authentication_v1_extra(self):
+        schema = self._convert(
+            "apis.authentication.k8s.io.v1", "authentication.k8s.io", "v1"
+        )
+        ns = schema.namespaces["authentication::v1"]
+        extra = ns.common_types["UserInfo"].attributes["extra"]
+        assert extra.type == "Set"
+        assert extra.element.type == "meta::v1::KeyValueStringSlice"
+
+    def test_is_entity_requires_object_meta(self):
+        openapi = json.loads(
+            (self.FIXTURES / "apis.apps.v1.schema.json").read_text()
+        )
+        shape = ref_to_entity_shape(openapi, "io.k8s.api.apps.v1.Deployment")
+        assert is_entity(shape)
+        spec = ref_to_entity_shape(openapi, "io.k8s.api.apps.v1.DeploymentSpec")
+        assert not is_entity(spec)
+
+
+class TestGeneratorAndFormatters:
+    def test_api_path_parsing(self):
+        assert api_path_to_group_version("api.v1") == ("core", "v1")
+        assert api_path_to_group_version("apis.apps.v1") == ("apps", "v1")
+        assert api_path_to_group_version("apis.authentication.k8s.io.v1") == (
+            "authentication.k8s.io",
+            "v1",
+        )
+
+    def test_generate_authz_only(self):
+        schema = generate_schema(admission=False)
+        assert set(schema.namespaces) == {"k8s"}
+        assert len(schema.namespaces["k8s"].actions) == 19
+
+    def test_generate_rejects_same_namespaces(self):
+        with pytest.raises(ValueError):
+            generate_schema(authorization_ns="k8s", action_ns="k8s")
+
+    def test_generate_with_admission_has_connect(self):
+        schema = generate_schema()
+        admission = schema.namespaces["k8s::admission"]
+        assert set(admission.actions) == {
+            "all",
+            "create",
+            "update",
+            "delete",
+            "connect",
+        }
+        connect = admission.actions["connect"]
+        assert "core::v1::PodExecOptions" in connect.applies_to.resource_types
+        assert connect.member_of[0].id == "all"
+        assert "PodExecOptions" in schema.namespaces["core::v1"].entity_types
+
+    @needs_reference
+    def test_cedarschema_text_matches_reference_artifact(self):
+        """The native text renderer must agree with the reference's
+        Rust-translated artifact line-for-line on the authz-only schema."""
+        schema = generate_schema(admission=False)
+        ours = format_schema(schema)
+        theirs = (
+            REFERENCE / "cedarschema/k8s-authorization.cedarschema"
+        ).read_text()
+
+        def normalize(text):
+            return [ln.rstrip() for ln in text.strip().splitlines() if ln.strip()]
+
+        assert normalize(ours) == normalize(theirs)
+
+    def test_formatter_reindents(self):
+        packed = 'namespace k8s {\nentity Group = {"name": __cedar::String};\n}\n'
+        out = format_schema_text(packed)
+        assert out == (
+            "namespace k8s {\n"
+            "\tentity Group = {\n"
+            '\t\t"name": __cedar::String\n'
+            "\t};\n"
+            "}\n\n"
+        )
