@@ -83,7 +83,7 @@ def generate_schema(
                 modify_schema_for_api_version(
                     resources, openapi, schema, group, version, action_ns
                 )
-        k8s.add_connect_entities(schema, action_ns)
+        k8s.add_connect_entities(schema, action_ns, authorization_ns)
 
     schema.sort_action_entities()
     k8s.modify_object_meta_maps(schema)

@@ -1,0 +1,244 @@
+"""cedar-webhook: the authorization + admission webhook server CLI.
+
+Wiring parity with reference cmd/cedar-webhook/main.go:39-131: read the
+store config file, build the tiered stores, construct the authorizer and the
+admission handler (with the allow-all final tier and allow-on-error=true),
+start the TLS webhook server (self-signed certs generated when absent) and
+the plain health/metrics server.
+
+TPU-native addition: ``--backend tpu`` routes authorization evaluation
+through the compiled TPU engine (cedar_tpu.engine.TPUPolicyEngine) with a
+background recompile loop that hot-swaps the device tensors when any store's
+policies change; the interpreter remains the admission path and the
+correctness fallback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import logging
+import signal
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from ..server.admission import (
+    CedarAdmissionHandler,
+    allow_all_admission_policy_store,
+)
+from ..server.authorizer import CedarWebhookAuthorizer
+from ..server.certs import maybe_self_signed_certs
+from ..server.error_injector import ErrorInjectionConfig, ErrorInjector
+from ..server.http import (
+    DEFAULT_ADDRESS,
+    DEFAULT_PORT,
+    METRICS_PORT,
+    WebhookServer,
+)
+from ..server.recorder import RequestRecorder
+from ..stores.config import cedar_config_stores, parse_config
+from ..stores.store import TieredPolicyStores
+
+log = logging.getLogger(__name__)
+
+
+def _fingerprint(stores: TieredPolicyStores) -> str:
+    from ..lang.format import format_policy
+
+    h = hashlib.sha256()
+    for store in stores:
+        for p in store.policy_set().policies():
+            h.update(p.policy_id.encode())
+            h.update(format_policy(p).encode())
+    return h.hexdigest()
+
+
+class TPUReloader:
+    """Recompiles the TPU engine whenever store contents change (the
+    tensorized successor of the reference's RWMutex policy reload)."""
+
+    def __init__(self, engine, stores: TieredPolicyStores, interval_s: float = 5.0):
+        self.engine = engine
+        self.stores = stores
+        self.interval_s = interval_s
+        self._fp: Optional[str] = None
+        self._stop = threading.Event()
+
+    def reload_if_changed(self) -> bool:
+        if not all(s.initial_policy_load_complete() for s in self.stores):
+            return False
+        fp = _fingerprint(self.stores)
+        if fp == self._fp:
+            return False
+        stats = self.engine.load([s.policy_set() for s in self.stores])
+        self._fp = fp
+        log.info("TPU engine reloaded: %s", stats)
+        return True
+
+    def run_forever(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.reload_if_changed()
+            except Exception:
+                log.exception("TPU reload failed; serving previous compiled set")
+
+    def start(self) -> None:
+        threading.Thread(
+            target=self.run_forever, name="tpu-reloader", daemon=True
+        ).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def build_server(args) -> WebhookServer:
+    config = None
+    if args.config:
+        with open(args.config) as f:
+            config = parse_config(f.read())
+    stores = cedar_config_stores(config, kubeconfig_path=args.kubeconfig or None)
+    if not len(stores.stores):
+        log.warning("no policy stores configured; authorizer will no-opinion")
+
+    evaluate = None
+    if args.backend == "tpu" and not len(stores.stores):
+        log.warning("TPU backend requested but no stores configured; using interpreter")
+    elif args.backend == "tpu":
+        from ..engine.evaluator import TPUPolicyEngine
+
+        engine = TPUPolicyEngine()
+        reloader = TPUReloader(engine, stores, interval_s=args.tpu_reload_seconds)
+        reloader.reload_if_changed()
+        reloader.start()
+
+        def evaluate(entities, request):  # noqa: F811
+            if not engine.loaded:
+                return stores.is_authorized(entities, request)
+            return engine.evaluate(entities, request)
+
+    authorizer = CedarWebhookAuthorizer(stores, evaluate=evaluate)
+
+    # admission gets the allow-all final tier (main.go:111-116)
+    admission_stores = TieredPolicyStores(
+        list(stores.stores) + [allow_all_admission_policy_store()]
+    )
+    admission_handler = CedarAdmissionHandler(admission_stores, allow_on_error=True)
+
+    injector = ErrorInjector(
+        ErrorInjectionConfig(
+            enabled=(
+                args.confirm_non_prod_inject_errors
+                and (args.artificial_error_rate > 0 or args.artificial_deny_rate > 0)
+            ),
+            artificial_error_rate=args.artificial_error_rate,
+            artificial_deny_rate=args.artificial_deny_rate,
+        )
+    )
+    recorder = RequestRecorder(args.recording_dir) if args.enable_recording else None
+
+    certfile, keyfile = args.tls_cert_file, args.tls_private_key_file
+    if not args.insecure and not (certfile and keyfile):
+        certfile, keyfile = maybe_self_signed_certs(args.cert_dir)
+    if args.insecure:
+        certfile = keyfile = None
+
+    return WebhookServer(
+        authorizer=authorizer,
+        admission_handler=admission_handler,
+        error_injector=injector,
+        recorder=recorder,
+        enable_profiling=args.profiling,
+        address=args.bind_address,
+        port=args.secure_port,
+        metrics_port=args.metrics_port,
+        certfile=certfile,
+        keyfile=keyfile,
+    )
+
+
+def make_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="cedar-webhook",
+        description="Cedar authorization + admission webhook for Kubernetes",
+    )
+    cedar = parser.add_argument_group("cedar")
+    cedar.add_argument(
+        "--config", default="", help="Cedar store config file (YAML/JSON)"
+    )
+    cedar.add_argument(
+        "--kubeconfig", default="", help="kubeconfig for the CRD policy store"
+    )
+    cedar.add_argument(
+        "--backend",
+        default="interpreter",
+        choices=["interpreter", "tpu"],
+        help="authorization evaluation backend",
+    )
+    cedar.add_argument(
+        "--tpu-reload-seconds",
+        type=float,
+        default=5.0,
+        help="poll interval for TPU policy recompilation",
+    )
+
+    serving = parser.add_argument_group("secure serving")
+    serving.add_argument("--bind-address", default=DEFAULT_ADDRESS)
+    serving.add_argument("--secure-port", type=int, default=DEFAULT_PORT)
+    serving.add_argument("--metrics-port", type=int, default=METRICS_PORT)
+    serving.add_argument(
+        "--cert-dir",
+        default="/var/run/cedar-authorizer/certs",
+        help="directory for (generated) serving certs",
+    )
+    serving.add_argument("--tls-cert-file", default="")
+    serving.add_argument("--tls-private-key-file", default="")
+    serving.add_argument(
+        "--insecure",
+        action="store_true",
+        help="serve plain HTTP (testing only)",
+    )
+
+    gameday = parser.add_argument_group("gameday")
+    gameday.add_argument("--artificial-error-rate", type=float, default=0.0)
+    gameday.add_argument("--artificial-deny-rate", type=float, default=0.0)
+    gameday.add_argument(
+        "--confirm-non-prod-inject-errors",
+        action="store_true",
+        help="required gate for error injection (never set in production)",
+    )
+
+    debug = parser.add_argument_group("debug")
+    debug.add_argument("--profiling", action="store_true")
+    debug.add_argument("--enable-recording", action="store_true")
+    debug.add_argument("--recording-dir", default="/tmp/cedar-recordings")
+    debug.add_argument("-v", "--verbosity", type=int, default=0)
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbosity >= 5 else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    server = build_server(args)
+    server.start()
+
+    stop = threading.Event()
+
+    def _signal(signum, frame):
+        log.info("received signal %d, shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _signal)
+    signal.signal(signal.SIGINT, _signal)
+    while not stop.wait(1.0):
+        pass
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

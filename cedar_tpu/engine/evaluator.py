@@ -87,6 +87,8 @@ class TPUPolicyEngine:
     def load(self, tiers: Sequence[PolicySet]) -> dict:
         """Compile + pack a tiered policy set and atomically swap it in.
         Returns compile stats."""
+        if not tiers:
+            raise ValueError("TPUPolicyEngine.load: at least one tier required")
         compiled: CompiledPolicies = lower_tiers(list(tiers), self.schema)
         packed = pack(compiled)
         new = _CompiledSet(packed, self.device)

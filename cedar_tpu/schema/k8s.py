@@ -428,7 +428,9 @@ def _pod_exec_attach_shape() -> EntityShape:
 
 
 def add_connect_entities(
-    schema: CedarSchema, action_namespace: str = "k8s::admission"
+    schema: CedarSchema,
+    action_namespace: str = "k8s::admission",
+    principal_namespace: str = "k8s",
 ) -> None:
     """CONNECT option entities + the connect admission action wiring
     (reference AddConnectEntities, connect_entities.go:87-129). Divergence,
@@ -490,7 +492,7 @@ def add_connect_entities(
     admission = schema.namespace(action_namespace)
     admission.actions[ADMISSION_CONNECT_ACTION] = ActionShape(
         applies_to=ActionAppliesTo(
-            principal_types=admission_principal_types("k8s"),
+            principal_types=admission_principal_types(principal_namespace),
             resource_types=[
                 "core::v1::NodeProxyOptions",
                 "core::v1::PodAttachOptions",

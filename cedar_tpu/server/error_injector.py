@@ -1,0 +1,66 @@
+"""Gameday fault injection: rate-limited artificial errors and denies.
+
+Behavior parity with reference internal/server/error_injector.go: when
+enabled, a token-bucket limiter (burst 1) per failure kind swaps the real
+decision for a fake error (NoOpinion + error) or a fake deny, at most
+``rate`` times per second each. Gated by --confirm-non-prod-inject-errors
+(options.go:184-187).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+@dataclass
+class ErrorInjectionConfig:
+    enabled: bool = False
+    artificial_error_rate: float = 0.0
+    artificial_deny_rate: float = 0.0
+
+
+class RateLimiter:
+    """Token bucket: ``rate`` tokens/second, burst 1 (golang.org/x/time/rate
+    semantics as used by the reference with burst=1)."""
+
+    def __init__(self, rate: float, now=time.monotonic):
+        self.rate = rate
+        self._now = now
+        self._tokens = 1.0 if rate > 0 else 0.0
+        self._last = now()
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        if self.rate <= 0:
+            return False
+        with self._lock:
+            now = self._now()
+            self._tokens = min(1.0, self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
+class ErrorInjector:
+    def __init__(self, cfg: Optional[ErrorInjectionConfig], now=time.monotonic):
+        cfg = cfg or ErrorInjectionConfig()
+        self.enabled = cfg.enabled
+        self._error_limiter = RateLimiter(cfg.artificial_error_rate, now)
+        self._deny_limiter = RateLimiter(cfg.artificial_deny_rate, now)
+
+    def inject_if_enabled(
+        self, decision: str, reason: str, error: Optional[str] = None
+    ) -> Tuple[str, str, Optional[str]]:
+        """(decision, reason, error) pass-through unless a limiter fires."""
+        if not self.enabled:
+            return decision, reason, error
+        if self._error_limiter.allow():
+            decision, reason, error = "no_opinion", "", "encountered error"
+        if self._deny_limiter.allow():
+            decision, reason, error = "deny", "Authorization denied", None
+        return decision, reason, error

@@ -1,0 +1,395 @@
+"""The webhook HTTP servers.
+
+Behavior parity with reference internal/server/server.go + health.go:
+  * TLS server (default 127.0.0.1:10288) serving ``/v1/authorize``
+    (SubjectAccessReview → decision; decode errors yield NoOpinion with an
+    evaluationError, :104-107) and ``/v1/admit`` (AdmissionReview)
+  * per-request metrics: decision-labelled counter + latency histogram, with
+    ``<error>`` as the decision label on errors (:78-91)
+  * optional request recording middleware and debug endpoints behind the
+    profiling flag (the Python analogue of net/http/pprof: live thread
+    dumps and a timed cProfile capture)
+  * plain-HTTP health/metrics server (default 127.0.0.1:10289) with
+    always-200 /healthz + /readyz stubs and /metrics (health.go:14-36)
+  * SubjectAccessReview → Attributes conversion incl. label/field selector
+    requirement parsing (GetAuthorizerAttributes, :163-214; the selector
+    conversion mirrors the upstream-k8s helpers copied at :221-309)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..entities.admission import AdmissionRequest
+from ..entities.attributes import (
+    Attributes,
+    FieldSelectorRequirement,
+    LabelSelectorRequirement,
+    UserInfo,
+)
+from . import metrics
+from .admission import AdmissionResponse, CedarAdmissionHandler
+from .authorizer import (
+    DECISION_ALLOW,
+    DECISION_DENY,
+    DECISION_NO_OPINION,
+    CedarWebhookAuthorizer,
+)
+from .error_injector import ErrorInjector
+from .recorder import RequestRecorder
+
+log = logging.getLogger(__name__)
+
+DEFAULT_ADDRESS = "127.0.0.1"
+DEFAULT_PORT = 10288
+METRICS_PORT = 10289
+
+_DECISION_LABEL = {
+    DECISION_ALLOW: "Allow",
+    DECISION_DENY: "Deny",
+    DECISION_NO_OPINION: "NoOpinion",
+}
+
+# metav1.LabelSelectorOperator -> k8s selection.Operator strings
+# (reference server.go:221-226)
+_LABEL_OPS = {"In": "in", "NotIn": "notin", "Exists": "exists", "DoesNotExist": "!"}
+
+
+def convert_extra(extra: Optional[dict]) -> dict:
+    """Extra keys are lower-cased (reference convertExtraForAuthorizerAttributes,
+    server.go:205-214)."""
+    if not extra:
+        return {}
+    return {k.lower(): tuple(v) for k, v in extra.items()}
+
+
+def label_selector_requirements(requirements: list) -> tuple:
+    """metav1.LabelSelectorRequirement list → parsed requirements; invalid
+    operators are dropped (ANDed semantics make that strictly broader,
+    reference server.go:228-261)."""
+    out = []
+    for req in requirements or []:
+        op = _LABEL_OPS.get(req.get("operator", ""))
+        if op is None:
+            log.error(
+                "%r is not a valid label selector operator", req.get("operator")
+            )
+            continue
+        out.append(
+            LabelSelectorRequirement(
+                key=req.get("key", ""),
+                operator=op,
+                values=tuple(req.get("values") or ()),
+            )
+        )
+    return tuple(out)
+
+
+def field_selector_requirements(requirements: list) -> tuple:
+    """metav1.FieldSelectorRequirement list → parsed requirements; only
+    single-valued In/NotIn convert (to =/!=), like the upstream helper
+    (reference server.go:263-309)."""
+    out = []
+    for req in requirements or []:
+        values = req.get("values") or []
+        op = req.get("operator", "")
+        if op == "In" and len(values) == 1:
+            out.append(
+                FieldSelectorRequirement(
+                    field=req.get("key", ""), operator="=", value=values[0]
+                )
+            )
+        elif op == "NotIn" and len(values) == 1:
+            out.append(
+                FieldSelectorRequirement(
+                    field=req.get("key", ""), operator="!=", value=values[0]
+                )
+            )
+        else:
+            log.error("unsupported field selector requirement: %r", req)
+    return tuple(out)
+
+
+def get_authorizer_attributes(sar: dict) -> Attributes:
+    """Decoded SubjectAccessReview → Attributes (reference
+    GetAuthorizerAttributes, server.go:163-203)."""
+    spec = sar.get("spec") or {}
+    attributes = Attributes(
+        user=UserInfo(
+            name=spec.get("user", ""),
+            uid=spec.get("uid", ""),
+            groups=tuple(spec.get("groups") or ()),
+            extra=convert_extra(spec.get("extra")),
+        )
+    )
+    ra = spec.get("resourceAttributes")
+    if ra:
+        attributes.verb = ra.get("verb", "")
+        attributes.namespace = ra.get("namespace", "")
+        attributes.api_group = ra.get("group", "")
+        attributes.api_version = ra.get("version", "")
+        attributes.resource = ra.get("resource", "")
+        attributes.subresource = ra.get("subresource", "")
+        attributes.name = ra.get("name", "")
+        attributes.resource_request = True
+        fs = ra.get("fieldSelector") or {}
+        if fs.get("requirements"):
+            attributes.field_selector = field_selector_requirements(
+                fs["requirements"]
+            )
+        ls = ra.get("labelSelector") or {}
+        if ls.get("requirements"):
+            attributes.label_selector = label_selector_requirements(
+                ls["requirements"]
+            )
+    nra = spec.get("nonResourceAttributes")
+    if nra:
+        attributes.path = nra.get("path", "")
+        attributes.resource_request = False
+        attributes.verb = nra.get("verb", "")
+    return attributes
+
+
+def sar_response(
+    decision: str, reason: str, error: Optional[str] = None
+) -> dict:
+    resp = {
+        "apiVersion": "authorization.k8s.io/v1",
+        "kind": "SubjectAccessReview",
+        "status": {
+            "allowed": decision == DECISION_ALLOW,
+            "denied": decision == DECISION_DENY,
+            "reason": reason,
+        },
+    }
+    if error:
+        resp["status"]["evaluationError"] = error
+    return resp
+
+
+class WebhookServer:
+    """Owns the TLS webhook server and the plain health/metrics server."""
+
+    def __init__(
+        self,
+        authorizer: CedarWebhookAuthorizer,
+        admission_handler: CedarAdmissionHandler,
+        error_injector: Optional[ErrorInjector] = None,
+        recorder: Optional[RequestRecorder] = None,
+        enable_profiling: bool = False,
+        address: str = DEFAULT_ADDRESS,
+        port: int = DEFAULT_PORT,
+        metrics_port: int = METRICS_PORT,
+        certfile: Optional[str] = None,
+        keyfile: Optional[str] = None,
+    ):
+        self.authorizer = authorizer
+        self.admission_handler = admission_handler
+        self.error_injector = error_injector or ErrorInjector(None)
+        self.recorder = recorder
+        self.enable_profiling = enable_profiling
+        self.address = address
+        self.port = port
+        self.metrics_port = metrics_port
+        self.certfile = certfile
+        self.keyfile = keyfile
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._metrics_httpd: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------- handlers
+
+    def handle_authorize(self, body: bytes) -> dict:
+        start = time.monotonic()
+        request_id = str(uuid.uuid4())
+        decision, reason, error = DECISION_NO_OPINION, "", None
+        try:
+            try:
+                sar = json.loads(body)
+            except (ValueError, TypeError) as e:
+                error = f"failed parsing request body: {e}"
+                return sar_response(
+                    DECISION_NO_OPINION, "Encountered decoding error", error
+                )
+            attributes = get_authorizer_attributes(sar)
+            decision, reason = self.authorizer.authorize(attributes)
+            decision, reason, error = self.error_injector.inject_if_enabled(
+                decision, reason
+            )
+            return sar_response(decision, reason, error)
+        finally:
+            label = "<error>" if error else _DECISION_LABEL[decision]
+            latency = time.monotonic() - start
+            metrics.record_request_total(label)
+            metrics.record_request_latency(label, latency)
+            log.info(
+                "authorize requestId=%s decision=%s latency=%.6fs",
+                request_id,
+                label,
+                latency,
+            )
+
+    def handle_admit(self, body: bytes) -> dict:
+        try:
+            review = json.loads(body)
+        except (ValueError, TypeError) as e:
+            return AdmissionResponse(
+                uid="", allowed=False, code=400, error=f"failed parsing body: {e}"
+            ).to_admission_review()
+        req = AdmissionRequest.from_admission_review(review)
+        return self.admission_handler.handle(req).to_admission_review()
+
+    # -------------------------------------------------------------- serving
+
+    def _make_handler(server):  # noqa: N805 — bound as a class closure
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("%s %s", self.address_string(), fmt % args)
+
+            def _write_json(self, doc: dict, code: int = 200):
+                data = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                if server.recorder is not None:
+                    server.recorder.record(self.path, body)
+                if self.path == "/v1/authorize":
+                    self._write_json(server.handle_authorize(body))
+                elif self.path == "/v1/admit":
+                    self._write_json(server.handle_admit(body))
+                else:
+                    self.send_error(404)
+
+            def do_GET(self):
+                if server.enable_profiling and self.path.startswith(
+                    "/debug/pprof"
+                ):
+                    self._debug(self.path)
+                else:
+                    self.send_error(404)
+
+            def _debug(self, path: str):
+                import io
+
+                if path.startswith("/debug/pprof/profile"):
+                    import cProfile
+                    import pstats
+
+                    prof = cProfile.Profile()
+                    prof.enable()
+                    time.sleep(1.0)
+                    prof.disable()
+                    buf = io.StringIO()
+                    pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(50)
+                    data = buf.getvalue().encode()
+                else:
+                    import traceback
+                    import sys
+
+                    buf = io.StringIO()
+                    frames = sys._current_frames()
+                    for tid, frame in frames.items():
+                        buf.write(f"--- thread {tid}\n")
+                        traceback.print_stack(frame, file=buf)
+                    data = buf.getvalue().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        return Handler
+
+    def _make_metrics_handler(server):  # noqa: N805
+        class MetricsHandler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("%s %s", self.address_string(), fmt % args)
+
+            def do_GET(self):
+                if self.path in ("/healthz", "/readyz"):
+                    # always-200 stubs (reference health.go:22-26)
+                    self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                elif self.path == "/metrics":
+                    data = metrics.REGISTRY.expose().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                else:
+                    self.send_error(404)
+
+        return MetricsHandler
+
+    def start(self) -> None:
+        """Start both servers on background threads."""
+        self._httpd = ThreadingHTTPServer(
+            (self.address, self.port), self._make_handler()
+        )
+        self._httpd.daemon_threads = True
+        if self.certfile and self.keyfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.certfile, self.keyfile)
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
+        threading.Thread(
+            target=self._httpd.serve_forever, name="webhook-server", daemon=True
+        ).start()
+
+        self._metrics_httpd = ThreadingHTTPServer(
+            (self.address, self.metrics_port), self._make_metrics_handler()
+        )
+        self._metrics_httpd.daemon_threads = True
+        threading.Thread(
+            target=self._metrics_httpd.serve_forever,
+            name="metrics-server",
+            daemon=True,
+        ).start()
+        scheme = "https" if self.certfile else "http"
+        log.info(
+            "serving on %s://%s:%d (metrics http://%s:%d)",
+            scheme,
+            self.address,
+            self.port,
+            self.address,
+            self.metrics_port,
+        )
+
+    def stop(self) -> None:
+        for httpd in (self._httpd, self._metrics_httpd):
+            if httpd is not None:
+                httpd.shutdown()
+                httpd.server_close()
+        self._httpd = None
+        self._metrics_httpd = None
+
+    @property
+    def bound_port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def bound_metrics_port(self) -> Optional[int]:
+        return (
+            self._metrics_httpd.server_address[1] if self._metrics_httpd else None
+        )

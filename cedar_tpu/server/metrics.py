@@ -1,0 +1,166 @@
+"""Prometheus metrics for the webhook, with text exposition.
+
+Metric names/labels/buckets parity with reference
+internal/server/metrics/metrics.go:
+  * ``cedar_authorizer_request_total{decision}`` counter (:28-36)
+  * ``cedar_authorizer_request_duration_seconds{decision}`` histogram,
+    buckets 0.25/0.5/0.7/1/1.5/3/5/10 (:38-47)
+  * ``cedar_authorizer_e2e_latency_seconds{filename}`` histogram,
+    exponential buckets 2*2^i, 8 buckets (:49-58)
+
+The registry renders the Prometheus text exposition format directly (the
+reference leans on client_golang + component-base legacyregistry).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+SUBSYSTEM = "cedar_authorizer"
+
+
+def _fmt_label(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == int(v):
+        return str(int(v))
+    return repr(v)
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str, label_names: Sequence[str]):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple((k, labels.get(k, "")) for k in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key in sorted(self._values):
+                out.append(
+                    f"{self.name}{_fmt_label(key)} {_fmt_value(self._values[key])}"
+                )
+        return out
+
+
+class Histogram:
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        label_names: Sequence[str],
+        buckets: Sequence[float],
+    ):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
+        self._sums: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._totals: Dict[Tuple[Tuple[str, str], ...], int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple((k, labels.get(k, "")) for k in self.label_names)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key in sorted(self._counts):
+                for i, b in enumerate(self.buckets):
+                    labels = key + (("le", _fmt_value(b)),)
+                    out.append(
+                        f"{self.name}_bucket{_fmt_label(labels)} "
+                        f"{self._counts[key][i]}"
+                    )
+                inf_labels = key + (("le", "+Inf"),)
+                out.append(
+                    f"{self.name}_bucket{_fmt_label(inf_labels)} "
+                    f"{self._totals[key]}"
+                )
+                out.append(
+                    f"{self.name}_sum{_fmt_label(key)} "
+                    f"{_fmt_value(self._sums[key])}"
+                )
+                out.append(f"{self.name}_count{_fmt_label(key)} {self._totals[key]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List = []
+        self._lock = threading.Lock()
+
+    def register(self, metric):
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+request_total = REGISTRY.register(
+    Counter(
+        f"{SUBSYSTEM}_request_total",
+        "Number of HTTP requests partitioned by authorization decision.",
+        ["decision"],
+    )
+)
+
+request_latency = REGISTRY.register(
+    Histogram(
+        f"{SUBSYSTEM}_request_duration_seconds",
+        "Request latency in seconds partitioned by authorization decision.",
+        ["decision"],
+        [0.25, 0.5, 0.7, 1, 1.5, 3, 5, 10],
+    )
+)
+
+e2e_latency = REGISTRY.register(
+    Histogram(
+        f"{SUBSYSTEM}_e2e_latency_seconds",
+        "End to end latency in seconds partitioned by filename.",
+        ["filename"],
+        [2.0 * (2.0**i) for i in range(8)],
+    )
+)
+
+
+def record_request_total(decision: str) -> None:
+    request_total.inc(decision=decision)
+
+
+def record_request_latency(decision: str, latency_s: float) -> None:
+    request_latency.observe(latency_s, decision=decision)
+
+
+def record_e2e_latency(filename: str, latency_s: float) -> None:
+    e2e_latency.observe(latency_s, filename=filename)

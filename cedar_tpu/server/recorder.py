@@ -1,0 +1,37 @@
+"""Request-recording middleware: persist every POST body for replay.
+
+Behavior parity with reference internal/server/recorder.go: bodies are
+written to ``<dir>/req-<path basename>-<unixnano>.json``; the directory is
+created if missing and validated to be a directory.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pathlib
+import time
+
+log = logging.getLogger(__name__)
+
+
+class RequestRecorder:
+    def __init__(self, recording_dir: str):
+        path = pathlib.Path(recording_dir)
+        if path.exists() and not path.is_dir():
+            raise ValueError(
+                f"Recording directory is not a directory: {recording_dir}"
+            )
+        path.mkdir(parents=True, exist_ok=True)
+        self.dir = path
+
+    def record(self, url_path: str, body: bytes) -> None:
+        if not body:
+            return
+        filename = self.dir / (
+            f"req-{os.path.basename(url_path)}-{time.time_ns()}.json"
+        )
+        try:
+            filename.write_bytes(body)
+        except OSError as e:
+            log.error("failed to write request file %s: %s", filename, e)

@@ -1,0 +1,329 @@
+"""Serving-plane tests: SAR conversion, HTTP endpoints end-to-end over a
+real (loopback, plain-HTTP) server, metrics exposition, error injector,
+recorder, and the TPU-backend wiring.
+
+Modeled on the reference's webhook behaviors (internal/server/server.go,
+health.go, error_injector.go, recorder.go).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from cedar_tpu.server import metrics
+from cedar_tpu.server.admission import (
+    CedarAdmissionHandler,
+    allow_all_admission_policy_store,
+)
+from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+from cedar_tpu.server.error_injector import (
+    ErrorInjectionConfig,
+    ErrorInjector,
+    RateLimiter,
+)
+from cedar_tpu.server.http import (
+    WebhookServer,
+    field_selector_requirements,
+    get_authorizer_attributes,
+    label_selector_requirements,
+)
+from cedar_tpu.server.recorder import RequestRecorder
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+DEMO_POLICY = """
+permit (
+    principal,
+    action in [k8s::Action::"get", k8s::Action::"list", k8s::Action::"watch"],
+    resource is k8s::Resource
+) when { principal.name == "test-user" && resource.resource == "pods" };
+forbid (
+    principal is k8s::User,
+    action == k8s::Action::"get",
+    resource is k8s::Resource
+) when { principal.name == "test-user" && resource.resource == "nodes" };
+"""
+
+
+def make_sar(user="test-user", verb="get", resource="pods", **ra_extra):
+    return {
+        "apiVersion": "authorization.k8s.io/v1",
+        "kind": "SubjectAccessReview",
+        "spec": {
+            "user": user,
+            "uid": "u1",
+            "groups": ["dev"],
+            "resourceAttributes": {
+                "verb": verb,
+                "resource": resource,
+                "version": "v1",
+                **ra_extra,
+            },
+        },
+    }
+
+
+class TestGetAuthorizerAttributes:
+    def test_resource_attributes(self):
+        attrs = get_authorizer_attributes(
+            make_sar(namespace="web", group="apps", subresource="status", name="x")
+        )
+        assert attrs.user.name == "test-user"
+        assert attrs.resource_request
+        assert attrs.namespace == "web"
+        assert attrs.api_group == "apps"
+        assert attrs.subresource == "status"
+        assert attrs.name == "x"
+
+    def test_extra_keys_lowercased(self):
+        sar = make_sar()
+        sar["spec"]["extra"] = {"ScopeS": ["a"]}
+        attrs = get_authorizer_attributes(sar)
+        assert attrs.user.extra == {"scopes": ("a",)}
+
+    def test_non_resource(self):
+        sar = {
+            "spec": {
+                "user": "u",
+                "nonResourceAttributes": {"path": "/healthz", "verb": "get"},
+            }
+        }
+        attrs = get_authorizer_attributes(sar)
+        assert not attrs.resource_request
+        assert attrs.path == "/healthz"
+        assert attrs.verb == "get"
+
+    def test_label_selector_conversion(self):
+        reqs = label_selector_requirements(
+            [
+                {"key": "env", "operator": "In", "values": ["prod", "dev"]},
+                {"key": "tier", "operator": "Exists"},
+                {"key": "x", "operator": "DoesNotExist"},
+                {"key": "bad", "operator": "Bogus"},
+            ]
+        )
+        assert [(r.key, r.operator) for r in reqs] == [
+            ("env", "in"),
+            ("tier", "exists"),
+            ("x", "!"),
+        ]
+        assert reqs[0].values == ("prod", "dev")
+
+    def test_field_selector_conversion(self):
+        reqs = field_selector_requirements(
+            [
+                {"key": "spec.nodeName", "operator": "In", "values": ["n1"]},
+                {"key": "status.phase", "operator": "NotIn", "values": ["Failed"]},
+                {"key": "two", "operator": "In", "values": ["a", "b"]},
+                {"key": "ex", "operator": "Exists"},
+            ]
+        )
+        assert [(r.field, r.operator, r.value) for r in reqs] == [
+            ("spec.nodeName", "=", "n1"),
+            ("status.phase", "!=", "Failed"),
+        ]
+
+
+@pytest.fixture
+def server():
+    stores = TieredPolicyStores([MemoryStore.from_source("demo", DEMO_POLICY)])
+    admission_stores = TieredPolicyStores(
+        [MemoryStore.from_source("demo", DEMO_POLICY), allow_all_admission_policy_store()]
+    )
+    srv = WebhookServer(
+        authorizer=CedarWebhookAuthorizer(stores),
+        admission_handler=CedarAdmissionHandler(admission_stores),
+        address="127.0.0.1",
+        port=0,
+        metrics_port=0,
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def post(port, path, doc):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+class TestWebhookHTTP:
+    def test_authorize_allow(self, server):
+        resp = post(server.bound_port, "/v1/authorize", make_sar())
+        assert resp["status"]["allowed"] is True
+        assert resp["status"]["denied"] is False
+        assert resp["apiVersion"] == "authorization.k8s.io/v1"
+
+    def test_authorize_deny_with_reason(self, server):
+        resp = post(server.bound_port, "/v1/authorize", make_sar(resource="nodes"))
+        assert resp["status"]["denied"] is True
+        assert "policy" in resp["status"]["reason"]
+
+    def test_authorize_no_opinion(self, server):
+        resp = post(
+            server.bound_port, "/v1/authorize", make_sar(resource="secrets")
+        )
+        assert resp["status"]["allowed"] is False
+        assert resp["status"]["denied"] is False
+
+    def test_decode_error(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.bound_port}/v1/authorize",
+            data=b"{not json",
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            doc = json.loads(resp.read())
+        assert doc["status"]["reason"] == "Encountered decoding error"
+        assert "evaluationError" in doc["status"]
+
+    def test_admit(self, server):
+        review = {
+            "request": {
+                "uid": "w1",
+                "kind": {"group": "", "version": "v1", "kind": "ConfigMap"},
+                "resource": {"group": "", "version": "v1", "resource": "configmaps"},
+                "name": "cm",
+                "namespace": "default",
+                "operation": "CREATE",
+                "userInfo": {"username": "test-user", "uid": "u"},
+                "object": {
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {"name": "cm", "namespace": "default"},
+                },
+            }
+        }
+        resp = post(server.bound_port, "/v1/admit", review)
+        assert resp["response"]["allowed"] is True
+        assert resp["response"]["uid"] == "w1"
+
+    def test_health_and_metrics(self, server):
+        port = server.bound_metrics_port
+        for path in ("/healthz", "/readyz"):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as resp:
+                assert resp.status == 200
+        post(server.bound_port, "/v1/authorize", make_sar())
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as resp:
+            text = resp.read().decode()
+        assert 'cedar_authorizer_request_total{decision="Allow"}' in text
+        assert "cedar_authorizer_request_duration_seconds_bucket" in text
+
+    def test_404(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.bound_port}/nope", data=b"{}", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 404
+
+
+class TestErrorInjector:
+    def test_disabled_passthrough(self):
+        inj = ErrorInjector(ErrorInjectionConfig(enabled=False))
+        assert inj.inject_if_enabled("allow", "r") == ("allow", "r", None)
+
+    def test_rate_limited_injection(self):
+        clock = [0.0]
+        inj = ErrorInjector(
+            ErrorInjectionConfig(enabled=True, artificial_deny_rate=1.0),
+            now=lambda: clock[0],
+        )
+        # first call injects (burst 1), next immediate call passes through
+        assert inj.inject_if_enabled("allow", "")[0] == "deny"
+        assert inj.inject_if_enabled("allow", "")[0] == "allow"
+        clock[0] += 1.1  # refill
+        assert inj.inject_if_enabled("allow", "")[0] == "deny"
+
+    def test_rate_limiter_zero_rate_never_allows(self):
+        rl = RateLimiter(0.0)
+        assert not rl.allow()
+
+
+class TestRecorder:
+    def test_records_post_bodies(self, tmp_path):
+        rec = RequestRecorder(str(tmp_path / "recs"))
+        rec.record("/v1/authorize", b'{"x":1}')
+        rec.record("/v1/admit", b"")  # empty bodies skipped
+        files = list((tmp_path / "recs").iterdir())
+        assert len(files) == 1
+        assert files[0].name.startswith("req-authorize-")
+        assert files[0].read_bytes() == b'{"x":1}'
+
+    def test_rejects_non_directory(self, tmp_path):
+        f = tmp_path / "afile"
+        f.write_text("x")
+        with pytest.raises(ValueError):
+            RequestRecorder(str(f))
+
+
+class TestMetricsExposition:
+    def test_histogram_buckets(self):
+        h = metrics.Histogram("t_h", "help", ["l"], [1, 5])
+        h.observe(0.5, l="a")
+        h.observe(3, l="a")
+        h.observe(10, l="a")
+        text = "\n".join(h.collect())
+        assert 't_h_bucket{l="a",le="1"} 1' in text
+        assert 't_h_bucket{l="a",le="5"} 2' in text
+        assert 't_h_bucket{l="a",le="+Inf"} 3' in text
+        assert 't_h_count{l="a"} 3' in text
+
+
+class TestTPUBackendWiring:
+    def test_webhook_cli_build_with_tpu_backend(self, tmp_path):
+        from cedar_tpu.cli.webhook import build_server, make_parser
+
+        policy_dir = tmp_path / "policies"
+        policy_dir.mkdir()
+        (policy_dir / "demo.cedar").write_text(DEMO_POLICY)
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text(
+            "apiVersion: cedar.k8s.aws/v1alpha1\n"
+            "kind: CedarConfig\n"
+            "spec:\n"
+            "  stores:\n"
+            f'    - type: "directory"\n'
+            f"      directoryStore:\n"
+            f'        path: "{policy_dir}"\n'
+        )
+        args = make_parser().parse_args(
+            [
+                "--config",
+                str(cfg),
+                "--backend",
+                "tpu",
+                "--insecure",
+                "--secure-port",
+                "0",
+                "--metrics-port",
+                "0",
+            ]
+        )
+        server = build_server(args)
+        server.start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                resp = post(server.bound_port, "/v1/authorize", make_sar())
+                if resp["status"]["allowed"]:
+                    break
+                time.sleep(0.2)
+            assert resp["status"]["allowed"] is True
+            resp = post(
+                server.bound_port, "/v1/authorize", make_sar(resource="nodes")
+            )
+            assert resp["status"]["denied"] is True
+        finally:
+            server.stop()
