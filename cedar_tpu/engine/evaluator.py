@@ -11,6 +11,14 @@ diagnostics) contract as TieredPolicyStores.is_authorized), with:
   * double-buffered hot swap: `load()` builds a fresh compiled set and swaps
     one reference; bucketed shapes mean a same-bucket reload reuses the
     compiled XLA executable (no retrace)
+  * packed fast path: when no interpreter fallback is needed the tier walk
+    runs ON DEVICE (ops/match.py `_tier_walk`) and the readback is one
+    uint32 per request. The full per-(tier, effect) matrix is fetched only
+    when a verdict word carries the err bit (a policy errored alongside a
+    real match — rare) or fallback policies exist.
+  * pipelined batching: large batches are split into sub-batches whose
+    transfers/compute/readbacks overlap (`copy_to_host_async`), hiding the
+    host<->device round-trip latency.
   * diagnostics: the device reports the first matching policy per
     (tier, effect); interpreter-backed tiers report exact reason lists. The
     reference's reason *ordering* is not a contract (cedar-go iterates a Go
@@ -45,9 +53,22 @@ from ..lang.authorize import ALLOW, DENY, Diagnostics, PolicySet, Reason
 from ..lang.entities import EntityMap
 from ..lang.eval import Env, Request, policy_matches
 from ..lang.values import EvalError
-from ..ops.match import INT32_MAX, chunk_rules, match_rules_compact
+from ..ops.match import (
+    CODE_ALLOW,
+    CODE_DENY,
+    CODE_ERROR,
+    CODE_NONE,
+    INT32_MAX,
+    POLICY_NONE,
+    chunk_rules,
+    match_rules_device,
+)
 
 _BATCH_BUCKETS = (1, 8, 32, 128, 512, 1024, 2048, 4096, 8192, 16384, 32768)
+# sub-batch size for the pipelined path: large enough to amortize the
+# per-call device round trip, small enough to keep several in flight
+_PIPELINE_SB = 32768
+_PIPELINE_MIN = 8192  # don't split batches smaller than this
 
 
 def _round_bucket(n: int, buckets) -> int:
@@ -73,6 +94,9 @@ class _CompiledSet:
         self.rule_policy_dev = jax.device_put(policy_c, **kwargs)
         # active-lit padding bucket: round the plan's bound up for stability
         self.active_bucket = max(16, int(2 ** np.ceil(np.log2(packed.plan.max_active))))
+        # literal ids fit int16 whenever the bucketed literal dim allows the
+        # pad id (== L) — halves the per-request transfer
+        self.active_dtype = np.int16 if packed.L < 32767 else np.int32
 
 
 class TPUPolicyEngine:
@@ -127,43 +151,100 @@ class TPUPolicyEngine:
         if cs is None:
             raise RuntimeError("TPUPolicyEngine: no policy set loaded")
         packed = cs.packed
-        n = len(items)
 
-        actives = [
-            encode_request(packed.plan, em, req) for em, req in items
-        ]
-        first = self._device_match(cs, actives)
+        actives = [encode_request(packed.plan, em, req) for em, req in items]
+        want_full = bool(packed.fallback)
+        words, full = self._device_match(cs, actives, want_full)
+
+        if not want_full and bool(np.any((words >> 29) & 0x1)):
+            # a policy errored alongside a real match: refetch per-group
+            # matrix for exact error attribution (rare)
+            words, full = self._device_match(cs, actives, True)
 
         results: List[Tuple[str, Diagnostics]] = []
         for i, (em, req) in enumerate(items):
-            results.append(self._finalize(packed, first[i], em, req))
+            if full is not None:
+                results.append(self._finalize_full(packed, full[i], em, req))
+            else:
+                results.append(self._finalize_packed(packed, int(words[i])))
         return results
 
-    def _device_match(self, cs: _CompiledSet, actives: List[List[int]]):
-        """Returns first_policy [n, G] int32; INT32_MAX means no match."""
+    # ---------------------------------------------------------- device path
+
+    def _encode_batch_array(
+        self, cs: _CompiledSet, actives: List[List[int]], B: int
+    ) -> np.ndarray:
+        """Pad active-id lists into a [B, A] device-ready array."""
         packed = cs.packed
-        n = len(actives)
-        B = _round_bucket(n, _BATCH_BUCKETS)
         max_len = max((len(a) for a in actives), default=1)
-        A = _round_bucket(max(max_len, 1), (cs.active_bucket, 2 * cs.active_bucket,
-                                            4 * cs.active_bucket, 8 * cs.active_bucket))
-        pad_id = packed.L  # out-of-range -> dropped by the scatter
-        arr = np.full((B, A), pad_id, dtype=np.int32)
+        A = _round_bucket(
+            max(max_len, 1),
+            (cs.active_bucket, 2 * cs.active_bucket,
+             4 * cs.active_bucket, 8 * cs.active_bucket),
+        )
+        pad_id = packed.L  # never matches the literal iota
+        arr = np.full((B, A), pad_id, dtype=cs.active_dtype)
         for i, a in enumerate(actives):
             arr[i, : len(a)] = a[:A]
-        first = match_rules_compact(
-            arr,
-            cs.W_dev,
-            cs.thresh_dev,
-            cs.rule_group_dev,
-            cs.rule_policy_dev,
-            packed.n_groups,
+        return arr
+
+    def _device_match(
+        self, cs: _CompiledSet, actives: List[List[int]], want_full: bool
+    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Returns (packed verdict words [n] uint32, full [n, G] int32 or
+        None). Pipelines sub-batches so transfers overlap compute."""
+        packed = cs.packed
+        n = len(actives)
+        args = (cs.W_dev, cs.thresh_dev, cs.rule_group_dev, cs.rule_policy_dev)
+
+        if n <= _PIPELINE_MIN:
+            B = _round_bucket(n, _BATCH_BUCKETS)
+            arr = self._encode_batch_array(cs, actives, B)
+            w, f = match_rules_device(arr, *args, packed.n_tiers, want_full)
+            words = np.asarray(w)[:n]
+            return words, (np.asarray(f)[:n] if want_full else None)
+
+        outs = []
+        for lo in range(0, n, _PIPELINE_SB):
+            chunk = actives[lo : lo + _PIPELINE_SB]
+            B = _round_bucket(len(chunk), _BATCH_BUCKETS)
+            arr = self._encode_batch_array(cs, chunk, B)
+            w, f = match_rules_device(arr, *args, packed.n_tiers, want_full)
+            w.copy_to_host_async()
+            if f is not None:
+                f.copy_to_host_async()
+            outs.append((len(chunk), w, f))
+        words = np.concatenate([np.asarray(w)[:m] for m, w, _ in outs])
+        full = (
+            np.concatenate([np.asarray(f)[:m] for m, _, f in outs])
+            if want_full
+            else None
         )
-        return np.asarray(first)[:n]
+        return words, full
 
     # ------------------------------------------------- fallback + tier walk
 
-    def _finalize(
+    def _finalize_packed(
+        self, packed: PackedPolicySet, word: int
+    ) -> Tuple[str, Diagnostics]:
+        """Decode one device verdict word (no-fallback fast path)."""
+        code = (word >> 30) & 0x3
+        pol = word & POLICY_NONE
+        if code == CODE_NONE:
+            return DENY, Diagnostics()
+        meta = packed.policy_meta[pol]
+        if code == CODE_ERROR:
+            return DENY, Diagnostics(
+                reasons=[],
+                errors=[
+                    f"while evaluating policy `{meta.policy_id}`: evaluation error"
+                ],
+            )
+        reason = Reason(meta.policy_id, meta.filename, meta.position)
+        decision = DENY if code == CODE_DENY else ALLOW
+        return decision, Diagnostics(reasons=[reason])
+
+    def _finalize_full(
         self,
         packed: PackedPolicySet,
         first_row: np.ndarray,

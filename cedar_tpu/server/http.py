@@ -217,8 +217,13 @@ class WebhookServer:
                 return sar_response(
                     DECISION_NO_OPINION, "Encountered decoding error", error
                 )
-            attributes = get_authorizer_attributes(sar)
-            decision, reason = self.authorizer.authorize(attributes)
+            try:
+                attributes = get_authorizer_attributes(sar)
+                decision, reason = self.authorizer.authorize(attributes)
+            except Exception as e:  # noqa: BLE001 — always answer the apiserver
+                log.exception("authorize requestId=%s failed", request_id)
+                error = f"evaluation error: {e}"
+                return sar_response(DECISION_NO_OPINION, "", error)
             decision, reason, error = self.error_injector.inject_if_enabled(
                 decision, reason
             )
@@ -242,8 +247,22 @@ class WebhookServer:
             return AdmissionResponse(
                 uid="", allowed=False, code=400, error=f"failed parsing body: {e}"
             ).to_admission_review()
-        req = AdmissionRequest.from_admission_review(review)
-        return self.admission_handler.handle(req).to_admission_review()
+        try:
+            req = AdmissionRequest.from_admission_review(review)
+            return self.admission_handler.handle(req).to_admission_review()
+        except Exception as e:  # noqa: BLE001 — fail-open like the reference
+            # allow-on-error posture (/root/reference
+            # internal/server/admission/handler.go:90-104 with
+            # allowOnError=true): a conversion/evaluation crash must not
+            # block the cluster's write path
+            log.exception("admit failed")
+            uid = ""
+            if isinstance(review, dict):
+                uid = (review.get("request") or {}).get("uid", "") or ""
+            return AdmissionResponse(
+                uid=uid, allowed=True, code=200,
+                error=f"evaluation error (allowed on error): {e}",
+            ).to_admission_review()
 
     # -------------------------------------------------------------- serving
 
@@ -286,15 +305,34 @@ class WebhookServer:
                 import io
 
                 if path.startswith("/debug/pprof/profile"):
-                    import cProfile
-                    import pstats
+                    # statistical whole-process sampler (Go's pprof.Profile
+                    # samples every thread; cProfile would only see this
+                    # handler thread sleeping)
+                    import collections
+                    import sys
+                    import traceback
 
-                    prof = cProfile.Profile()
-                    prof.enable()
-                    time.sleep(1.0)
-                    prof.disable()
+                    me = threading.get_ident()
+                    counts: collections.Counter = collections.Counter()
+                    deadline = time.monotonic() + 1.0
+                    samples = 0
+                    while time.monotonic() < deadline:
+                        for tid, frame in sys._current_frames().items():
+                            if tid == me:
+                                continue
+                            stack = tuple(
+                                f"{fr.filename.rsplit('/', 1)[-1]}:{fr.lineno} {fr.name}"
+                                for fr, _ in traceback.walk_stack(frame)
+                            )[::-1]
+                            counts[stack] += 1
+                        samples += 1
+                        time.sleep(0.01)
                     buf = io.StringIO()
-                    pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(50)
+                    buf.write(f"# {samples} samples over 1s, 10ms interval\n")
+                    for stack, n in counts.most_common(50):
+                        buf.write(f"\n{n} samples:\n")
+                        for line in stack:
+                            buf.write(f"  {line}\n")
                     data = buf.getvalue().encode()
                 else:
                     import traceback

@@ -184,6 +184,35 @@ class TestWebhookHTTP:
         assert doc["status"]["reason"] == "Encountered decoding error"
         assert "evaluationError" in doc["status"]
 
+    def test_authorize_non_object_body_still_answers(self, server):
+        # valid JSON but not a SAR object: the handler must still write a
+        # SubjectAccessReview response (NoOpinion + evaluationError), never
+        # drop the connection
+        for body in (b"[1]", b'{"spec": 5}', b'"str"'):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.bound_port}/v1/authorize",
+                data=body,
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                doc = json.loads(resp.read())
+            assert doc["status"]["allowed"] is False
+            assert doc["status"]["denied"] is False
+            assert "evaluationError" in doc["status"]
+
+    def test_admit_malformed_request_allows_on_error(self, server):
+        # fail-open admission: a body that crashes conversion yields
+        # allowed=true with the error recorded, mirroring allowOnError=true
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.bound_port}/v1/admit",
+            data=b'{"request": {"uid": "u-err", "operation": 42}}',
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            doc = json.loads(resp.read())
+        assert doc["response"]["allowed"] is True
+        assert doc["response"]["uid"] == "u-err"
+
     def test_admit(self, server):
         review = {
             "request": {
