@@ -111,6 +111,7 @@ class PackedPolicySet:
     plan: EncodePlan
     policy_meta: List[PolicyMeta]
     fallback: list  # List[FallbackPolicy]
+    table: object = None  # compiler.table.FeatureTable
 
     @property
     def n_groups(self) -> int:
@@ -175,8 +176,12 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
 
     plan = _build_plan(reg.lits)
     plan.n_lits = n_lits
+    from .table import build_table
+
+    table = build_table(plan, n_lits, L)
 
     return PackedPolicySet(
+        table=table,
         W=W,
         thresh=thresh,
         rule_group=rule_group,

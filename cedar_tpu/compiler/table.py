@@ -1,0 +1,287 @@
+"""Feature table: dictionary-coded request features -> literal activations.
+
+The actives-list encoder (compiler/encode.py) ships every active literal id
+to the device, so the per-request payload grows with how many policies share
+a matching predicate (~40 ids at 10k policies). This module compiles the
+inverted indices of the EncodePlan into a device-resident ACTIVATION TABLE
+instead:
+
+  * each request feature (principal uid, each group, each scalar attribute)
+    is dictionary-coded host-side into one int16 ROW INDEX;
+  * the device gathers the rows — precomputed {0,1} literal activation
+    vectors [L] — and ORs them into the request's literal vector;
+  * anything not expressible as a function of a single feature value
+    (set-contains tests, interpreter-evaluated hard literals, vocabulary
+    misses with `like`/comparison tests) rides in a short per-request
+    EXTRAS list of raw literal ids.
+
+The per-request payload becomes a fixed [n_slots] code vector plus a few
+extras — independent of policy count — and the host encoder drops to a
+handful of dict lookups. This is the "integer-coded attribute tests over a
+dictionary-encoded feature vector" design of SURVEY.md §7, with the
+expansion moved onto the TPU.
+
+Row 0 is all-zeros: it encodes "feature missing" and "value no policy
+references" (which by construction activates nothing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..lang.eval import Env, evaluate
+from ..lang.values import CedarRecord, CedarSet, EvalError, value_key
+from .encode import _MISSING, _ancestors_or_self, _slot_value
+from .ir import Slot
+
+# ancestor slots per request variable (beyond these, entity-in activations
+# overflow into the extras list)
+ANCESTOR_SLOTS = {"principal": 8, "action": 2, "resource": 4}
+
+_VARS = ("principal", "action", "resource")
+
+
+@dataclass
+class FeatureTable:
+    """Compiled activation table + slot layout (host side; the engine puts
+    `rows` on device)."""
+
+    n_slots: int
+    rows: np.ndarray  # [n_rows, L] uint8; row 0 all-zero
+    # encoder vocabularies -> row index
+    type_vocab: Dict[Tuple[str, str], int]  # (var, entity type) -> row
+    uid_vocab: Dict[Tuple[str, str, str], int]  # (var, type, id) -> row (self)
+    anc_vocab: Dict[Tuple[str, str, str], int]  # (var, type, id) -> row
+    # (ancestors: entity_in literals only)
+    scalar_vocab: Dict[Slot, Dict[object, int]]  # slot -> value_key -> row
+    present_row: Dict[Slot, int]  # slot -> row for present-but-unknown value
+    # slot layout
+    var_type_slot: Dict[str, int] = field(default_factory=dict)
+    var_uid_slot: Dict[str, int] = field(default_factory=dict)
+    anc_slots: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    scalar_slot_of: Dict[Slot, int] = field(default_factory=dict)
+
+    @property
+    def n_rows(self) -> int:
+        return self.rows.shape[0]
+
+    @property
+    def code_dtype(self):
+        return np.int16 if self.n_rows <= 32767 else np.int32
+
+
+class _RowBuilder:
+    def __init__(self, n_lits: int):
+        self.n_lits = n_lits
+        self.rows: List[List[int]] = [[]]  # row 0 = zero row
+
+    def add(self, lit_ids) -> int:
+        ids = sorted(set(lit_ids))
+        if not ids:
+            return 0
+        self.rows.append(ids)
+        return len(self.rows) - 1
+
+    def materialize(self, L: int) -> np.ndarray:
+        out = np.zeros((len(self.rows), L), dtype=np.uint8)
+        for r, ids in enumerate(self.rows):
+            for i in ids:
+                out[r, i] = 1
+        return out
+
+
+def build_table(plan, n_lits: int, L: int) -> FeatureTable:
+    """Compile an EncodePlan's inverted indices into a FeatureTable.
+
+    `plan` is compiler.pack.EncodePlan; `L` the bucketed literal dim (table
+    columns match the device W layout directly)."""
+    rb = _RowBuilder(n_lits)
+    type_vocab: Dict[Tuple[str, str], int] = {}
+    uid_vocab: Dict[Tuple[str, str, str], int] = {}
+    scalar_vocab: Dict[Slot, Dict[object, int]] = {}
+    present_row: Dict[Slot, int] = {}
+
+    # ---- entity type rows: `principal is T` style tests
+    for var, by_type in plan.is_idx.items():
+        for tname, lids in by_type.items():
+            type_vocab[(var, tname)] = rb.add(lids)
+
+    # ---- entity uid rows: == / in tests. The uid slot (self) activates
+    # both eq_entity and entity_in literals (Cedar `in` includes self); the
+    # ancestor slots must activate ONLY entity_in literals — an `==` test
+    # never matches a mere ancestor.
+    anc_vocab: Dict[Tuple[str, str, str], int] = {}
+    uid_keys = set()
+    for var in _VARS:
+        for key in plan.eq_entity_idx.get(var, {}):
+            uid_keys.add((var, key))
+        for key in plan.entity_in_idx.get(var, {}):
+            uid_keys.add((var, key))
+    for var, (etype, eid) in sorted(uid_keys):
+        eq_lids = list(plan.eq_entity_idx.get(var, {}).get((etype, eid), ()))
+        in_lids = list(plan.entity_in_idx.get(var, {}).get((etype, eid), ()))
+        uid_vocab[(var, etype, eid)] = rb.add(eq_lids + in_lids)
+        if in_lids:
+            anc_vocab[(var, etype, eid)] = rb.add(in_lids)
+
+    # ---- scalar slot rows: eq / in-set / like / cmp / has, folded per value
+    for slot in plan.slots:
+        has_lids = list(plan.has_idx.get(slot, ()))
+        eq = plan.eq_idx.get(slot, {})
+        inset = plan.inset_idx.get(slot, {})
+        like = plan.like_idx.get(slot, ())
+        cmp_tests = plan.cmp_idx.get(slot, ())
+        vocab: Dict[object, int] = {}
+        for vk in sorted(set(eq) | set(inset), key=repr):
+            lids = list(eq.get(vk, ())) + list(inset.get(vk, ())) + has_lids
+            if vk[0] == "s":
+                s = vk[1]
+                lids += [lid for lid, pat in like if pat.match(s)]
+            elif vk[0] == "l":
+                v = vk[1]
+                lids += [
+                    lid
+                    for lid, op, c in cmp_tests
+                    if (op == "<" and v < c)
+                    or (op == "<=" and v <= c)
+                    or (op == ">" and v > c)
+                    or (op == ">=" and v >= c)
+                ]
+            vocab[vk] = rb.add(lids)
+        scalar_vocab[slot] = vocab
+        # present-but-out-of-vocab: `has` always fires; like/cmp are
+        # host-evaluated into extras by the encoder
+        present_row[slot] = rb.add(has_lids)
+
+    table = FeatureTable(
+        n_slots=0,
+        rows=rb.materialize(L),
+        type_vocab=type_vocab,
+        uid_vocab=uid_vocab,
+        anc_vocab=anc_vocab,
+        scalar_vocab=scalar_vocab,
+        present_row=present_row,
+    )
+
+    # ---- slot layout
+    s = 0
+    for var in _VARS:
+        if any(v == var for (v, _t) in type_vocab):
+            table.var_type_slot[var] = s
+            s += 1
+        if any(v == var for (v, _t, _i) in uid_vocab):
+            table.var_uid_slot[var] = s
+            s += 1
+        if plan.entity_in_idx.get(var):
+            k = ANCESTOR_SLOTS[var]
+            table.anc_slots[var] = tuple(range(s, s + k))
+            s += k
+    for slot in plan.slots:
+        table.scalar_slot_of[slot] = s
+        s += 1
+    table.n_slots = max(s, 1)
+    return table
+
+
+def encode_request_codes(
+    plan, table: FeatureTable, entities, request
+) -> Tuple[List[int], List[int]]:
+    """(EntityMap, Request) -> (codes [n_slots], extras [k]).
+
+    Semantics identical to compiler.encode.encode_request: the union of the
+    literal activations of `codes` (via table rows) and `extras` equals the
+    actives list the old encoder would produce."""
+    codes = [0] * table.n_slots
+    extras: List[int] = []
+
+    var_uids = {
+        "principal": request.principal,
+        "action": request.action,
+        "resource": request.resource,
+    }
+    roots = {}
+    for var, uid in var_uids.items():
+        ent = entities.get(uid)
+        roots[var] = ent.attrs if ent is not None else CedarRecord()
+    roots["context"] = request.context
+
+    for var, uid in var_uids.items():
+        ts = table.var_type_slot.get(var)
+        if ts is not None:
+            codes[ts] = table.type_vocab.get((var, uid.type), 0)
+        us = table.var_uid_slot.get(var)
+        if us is not None:
+            codes[us] = table.uid_vocab.get((var, uid.type, uid.id), 0)
+        anc = table.anc_slots.get(var)
+        if anc:
+            i = 0
+            for a in _ancestors_or_self(entities, uid):
+                if a == uid:
+                    continue  # self handled by the uid slot
+                row = table.anc_vocab.get((var, a.type, a.id), 0)
+                if row == 0:
+                    continue
+                if i < len(anc):
+                    codes[anc[i]] = row
+                    i += 1
+                else:  # ancestor overflow -> extras
+                    extras.extend(
+                        plan.entity_in_idx.get(var, {}).get((a.type, a.id), ())
+                    )
+
+    for slot, sidx in table.scalar_slot_of.items():
+        var, _path = slot
+        v = _slot_value(roots.get(var), slot[1])
+        if v is _MISSING:
+            continue
+        try:
+            vk = value_key(v)
+        except EvalError:
+            vk = None
+        row = table.scalar_vocab[slot].get(vk) if vk is not None else None
+        if row is not None:
+            codes[sidx] = row
+        else:
+            # out-of-vocabulary value: `has` fires via the present row;
+            # like/cmp tests are host-evaluated
+            codes[sidx] = table.present_row[slot]
+            for lid, pattern in plan.like_idx.get(slot, ()):
+                if isinstance(v, str) and pattern.match(v):
+                    extras.append(lid)
+            for lid, op, c in plan.cmp_idx.get(slot, ()):
+                if type(v) is int:
+                    if (
+                        (op == "<" and v < c)
+                        or (op == "<=" and v <= c)
+                        or (op == ">" and v > c)
+                        or (op == ">=" and v >= c)
+                    ):
+                        extras.append(lid)
+        # set-contains tests depend on every element: host-side always
+        sh = plan.set_has_idx.get(slot)
+        if sh is not None and isinstance(v, CedarSet):
+            for elem in v:
+                try:
+                    ek = value_key(elem)
+                except EvalError:
+                    continue
+                extras.extend(sh.get(ek, ()))
+
+    if plan.hard_lits:
+        env = Env(request, entities)
+        for lid, expr, err_lid in plan.hard_lits:
+            try:
+                val = evaluate(expr, env)
+                if val is True:
+                    if lid >= 0:
+                        extras.append(lid)
+                elif type(val) is not bool and err_lid >= 0:
+                    extras.append(err_lid)
+            except EvalError:
+                if err_lid >= 0:
+                    extras.append(err_lid)
+
+    return codes, extras

@@ -38,7 +38,6 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from ..compiler.encode import encode_request
 from ..compiler.ir import CompiledPolicies
 from ..compiler.lower import AUTHZ_SCHEMA_INFO, SchemaInfo, lower_tiers
 from ..compiler.pack import (
@@ -53,6 +52,7 @@ from ..lang.authorize import ALLOW, DENY, Diagnostics, PolicySet, Reason
 from ..lang.entities import EntityMap
 from ..lang.eval import Env, Request, policy_matches
 from ..lang.values import EvalError
+from ..compiler.table import encode_request_codes
 from ..ops.match import (
     CODE_ALLOW,
     CODE_DENY,
@@ -61,7 +61,7 @@ from ..ops.match import (
     INT32_MAX,
     POLICY_NONE,
     chunk_rules,
-    match_rules_device,
+    match_rules_codes,
 )
 
 _BATCH_BUCKETS = (1, 8, 32, 128, 512, 1024, 2048, 4096, 8192, 16384, 32768)
@@ -92,11 +92,11 @@ class _CompiledSet:
         self.thresh_dev = jax.device_put(thresh_c, **kwargs)
         self.rule_group_dev = jax.device_put(group_c, **kwargs)
         self.rule_policy_dev = jax.device_put(policy_c, **kwargs)
-        # active-lit padding bucket: round the plan's bound up for stability
-        self.active_bucket = max(16, int(2 ** np.ceil(np.log2(packed.plan.max_active))))
-        # literal ids fit int16 whenever the bucketed literal dim allows the
-        # pad id (== L) — halves the per-request transfer
+        self.act_rows_dev = jax.device_put(packed.table.rows, **kwargs)
+        # literal/code ids fit int16 whenever the id space allows — halves
+        # the per-request transfer
         self.active_dtype = np.int16 if packed.L < 32767 else np.int32
+        self.code_dtype = packed.table.code_dtype
 
 
 class TPUPolicyEngine:
@@ -152,14 +152,17 @@ class TPUPolicyEngine:
             raise RuntimeError("TPUPolicyEngine: no policy set loaded")
         packed = cs.packed
 
-        actives = [encode_request(packed.plan, em, req) for em, req in items]
+        encoded = [
+            encode_request_codes(packed.plan, packed.table, em, req)
+            for em, req in items
+        ]
         want_full = bool(packed.fallback)
-        words, full = self._device_match(cs, actives, want_full)
+        words, full = self._device_match(cs, encoded, want_full)
 
         if not want_full and bool(np.any((words >> 29) & 0x1)):
             # a policy errored alongside a real match: refetch per-group
             # matrix for exact error attribution (rare)
-            words, full = self._device_match(cs, actives, True)
+            words, full = self._device_match(cs, encoded, True)
 
         results: List[Tuple[str, Diagnostics]] = []
         for i, (em, req) in enumerate(items):
@@ -171,45 +174,59 @@ class TPUPolicyEngine:
 
     # ---------------------------------------------------------- device path
 
-    def _encode_batch_array(
-        self, cs: _CompiledSet, actives: List[List[int]], B: int
-    ) -> np.ndarray:
-        """Pad active-id lists into a [B, A] device-ready array."""
+    def _encode_batch_arrays(
+        self, cs: _CompiledSet, encoded, B: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Pad (codes, extras) pairs into [B, S] and [B, E] arrays."""
         packed = cs.packed
-        max_len = max((len(a) for a in actives), default=1)
-        A = _round_bucket(
-            max(max_len, 1),
-            (cs.active_bucket, 2 * cs.active_bucket,
-             4 * cs.active_bucket, 8 * cs.active_bucket),
-        )
-        pad_id = packed.L  # never matches the literal iota
-        arr = np.full((B, A), pad_id, dtype=cs.active_dtype)
-        for i, a in enumerate(actives):
-            arr[i, : len(a)] = a[:A]
-        return arr
+        S = packed.table.n_slots
+        codes_arr = np.zeros((B, S), dtype=cs.code_dtype)
+        max_e = max((len(e) for _, e in encoded), default=0)
+        if max_e == 0:
+            E = 0
+        elif max_e <= 256:
+            E = _round_bucket(max_e, (8, 16, 32, 64, 128, 256))
+        else:  # never truncate: dropping an extra would drop an activation
+            E = -(-max_e // 128) * 128
+        extras_arr = np.full((B, max(E, 1)), packed.L, dtype=cs.active_dtype)
+        for i, (c, e) in enumerate(encoded):
+            codes_arr[i] = c
+            if e:
+                extras_arr[i, : len(e)] = e
+        return codes_arr, extras_arr
 
     def _device_match(
-        self, cs: _CompiledSet, actives: List[List[int]], want_full: bool
+        self, cs: _CompiledSet, encoded, want_full: bool
     ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """Returns (packed verdict words [n] uint32, full [n, G] int32 or
         None). Pipelines sub-batches so transfers overlap compute."""
         packed = cs.packed
-        n = len(actives)
-        args = (cs.W_dev, cs.thresh_dev, cs.rule_group_dev, cs.rule_policy_dev)
+        n = len(encoded)
+        args = (
+            cs.act_rows_dev,
+            cs.W_dev,
+            cs.thresh_dev,
+            cs.rule_group_dev,
+            cs.rule_policy_dev,
+        )
 
         if n <= _PIPELINE_MIN:
             B = _round_bucket(n, _BATCH_BUCKETS)
-            arr = self._encode_batch_array(cs, actives, B)
-            w, f = match_rules_device(arr, *args, packed.n_tiers, want_full)
+            codes_arr, extras_arr = self._encode_batch_arrays(cs, encoded, B)
+            w, f = match_rules_codes(
+                codes_arr, extras_arr, *args, packed.n_tiers, want_full
+            )
             words = np.asarray(w)[:n]
             return words, (np.asarray(f)[:n] if want_full else None)
 
         outs = []
         for lo in range(0, n, _PIPELINE_SB):
-            chunk = actives[lo : lo + _PIPELINE_SB]
+            chunk = encoded[lo : lo + _PIPELINE_SB]
             B = _round_bucket(len(chunk), _BATCH_BUCKETS)
-            arr = self._encode_batch_array(cs, chunk, B)
-            w, f = match_rules_device(arr, *args, packed.n_tiers, want_full)
+            codes_arr, extras_arr = self._encode_batch_arrays(cs, chunk, B)
+            w, f = match_rules_codes(
+                codes_arr, extras_arr, *args, packed.n_tiers, want_full
+            )
             w.copy_to_host_async()
             if f is not None:
                 f.copy_to_host_async()

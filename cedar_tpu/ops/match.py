@@ -135,6 +135,47 @@ def match_rules_device(
     return (packed, first) if want_full else (packed, None)
 
 
+def _lit_matrix_codes(codes, extras, act_rows):
+    """codes [B, S] int (row indices into act_rows [V, L] uint8) + extras
+    [B, E] int (raw literal ids, pad >= L) -> {0,1} literal matrix [B, L]
+    bf16. The activation table turns each dictionary-coded request feature
+    into its precomputed literal-activation row; rows are OR-combined (a
+    literal activated by two features must count once, not twice)."""
+    L = act_rows.shape[1]
+    S = codes.shape[1]
+    acc = jnp.take(act_rows, codes[:, 0].astype(jnp.int32), axis=0)  # [B, L]
+    for s in range(1, S):
+        acc = acc | jnp.take(act_rows, codes[:, s].astype(jnp.int32), axis=0)
+    if extras is not None and extras.shape[1] > 0:
+        e32 = extras.astype(jnp.int32)
+        iota = jnp.arange(L, dtype=jnp.int32)
+        lit_e = (e32[:, :, None] == iota[None, None, :]).any(axis=1)
+        acc = acc | lit_e.astype(acc.dtype)
+    return acc.astype(jnp.bfloat16)
+
+
+@functools.partial(jax.jit, static_argnames=("n_tiers", "want_full"))
+def match_rules_codes(
+    codes,
+    extras,
+    act_rows,
+    W_chunks,
+    thresh_c,
+    group_c,
+    policy_c,
+    n_tiers: int,
+    want_full: bool,
+):
+    """Feature-code variant of match_rules_device: the literal expansion
+    happens ON DEVICE from the activation table, so the host ships one
+    int16 code per feature slot (+ a few extras) instead of every active
+    literal id. See compiler/table.py."""
+    lit = _lit_matrix_codes(codes, extras, act_rows)
+    first = _first_match(lit, W_chunks, thresh_c, group_c, policy_c, n_tiers * _GPT)
+    packed = _tier_walk(first, n_tiers)
+    return (packed, first) if want_full else (packed, None)
+
+
 @functools.partial(jax.jit, static_argnames=("n_groups",))
 def match_rules_compact(active, W_chunks, thresh_c, group_c, policy_c, n_groups: int):
     """Full per-(tier, effect) first-match matrix [B, G] int32; INT32_MAX

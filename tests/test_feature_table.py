@@ -1,0 +1,177 @@
+"""Feature-table equivalence: the dictionary-code encoder + activation-table
+expansion must activate exactly the literal set the actives-list encoder
+produces, for every request. This pins the device OR-of-gathers semantics
+(ops/match.py `_lit_matrix_codes`) to the oracle encoder host-side."""
+
+import random
+
+import numpy as np
+import pytest
+
+from cedar_tpu.compiler.encode import encode_request
+from cedar_tpu.compiler.lower import lower_tiers
+from cedar_tpu.compiler.pack import pack
+from cedar_tpu.compiler.table import encode_request_codes
+from cedar_tpu.entities.attributes import (
+    Attributes,
+    LabelSelectorRequirement,
+    UserInfo,
+)
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.server.authorizer import record_to_cedar_resource
+
+
+def expand(packed, codes, extras):
+    """Host-side replica of the device expansion."""
+    active = set()
+    for c in codes:
+        active.update(np.nonzero(packed.table.rows[c])[0].tolist())
+    active.update(e for e in extras if e < packed.L)
+    return sorted(active)
+
+
+def check_equiv(sources, attributes_list):
+    packed = pack(lower_tiers([PolicySet.from_source(s, f"t{i}") for i, s in enumerate(sources)]))
+    for attrs in attributes_list:
+        em, req = record_to_cedar_resource(attrs)
+        oracle = encode_request(packed.plan, em, req)
+        codes, extras = encode_request_codes(packed.plan, packed.table, em, req)
+        assert len(codes) == packed.table.n_slots
+        assert expand(packed, codes, extras) == sorted(oracle), (
+            f"encoder mismatch for {attrs}"
+        )
+
+
+def sar(user="test-user", verb="get", resource="pods", groups=(), ns="",
+        subresource="", name="", api_group="", selector=()):
+    a = Attributes(
+        user=UserInfo(name=user, uid="u1", groups=tuple(groups)),
+        verb=verb,
+        namespace=ns,
+        api_group=api_group,
+        api_version="v1",
+        resource=resource,
+        subresource=subresource,
+        name=name,
+        resource_request=True,
+    )
+    if selector:
+        a.label_selector = tuple(selector)
+    return a
+
+
+def test_eq_and_scope_literals():
+    src = """
+permit (principal, action == k8s::Action::"get", resource is k8s::Resource)
+when { principal.name == "test-user" && resource.resource == "pods" };
+forbid (principal, action, resource is k8s::Resource)
+when { resource.resource == "nodes" };
+"""
+    check_equiv([src], [sar(), sar(resource="nodes"), sar(user="other"),
+                        sar(verb="list")])
+
+
+def test_group_membership_and_ancestors():
+    src = """
+permit (principal in k8s::Group::"viewers", action, resource is k8s::Resource)
+unless { resource.resource == "secrets" };
+permit (principal in k8s::Group::"editors", action, resource);
+"""
+    check_equiv(
+        [src],
+        [
+            sar(groups=["viewers"]),
+            sar(groups=["editors", "viewers"]),
+            sar(groups=["other"]),
+            sar(groups=[f"g{i}" for i in range(12)] + ["viewers"]),  # overflow
+            sar(),
+        ],
+    )
+
+
+def test_like_and_unknown_values():
+    src = """
+permit (principal, action, resource is k8s::NonResourceURL)
+when { resource.path like "/api/*" };
+permit (principal, action, resource is k8s::Resource)
+when { resource has namespace && resource.namespace like "prod-*" };
+"""
+    nr = Attributes(
+        user=UserInfo(name="u", uid="u1"), verb="get", path="/api/v1/pods",
+        resource_request=False,
+    )
+    nr2 = Attributes(
+        user=UserInfo(name="u", uid="u1"), verb="get", path="/healthz",
+        resource_request=False,
+    )
+    check_equiv([src], [sar(ns="prod-east"), sar(ns="dev"), sar()])
+    check_equiv([src], [nr, nr2])
+
+
+def test_selector_set_has_goes_to_extras():
+    src = """
+permit (principal, action == k8s::Action::"list", resource is k8s::Resource)
+when {
+  resource.labelSelector.containsAny([
+    {"key": "owner", "operator": "=", "values": ["me"]}])
+};
+"""
+    sel = (LabelSelectorRequirement(key="owner", operator="=", values=("me",)),)
+    check_equiv([src], [sar(verb="list", selector=sel), sar(verb="list")])
+
+
+def test_eq_entity_does_not_fire_for_ancestors():
+    # `principal == Group::"viewers"` must match only when the principal IS
+    # that group entity — not when a user merely belongs to it. The ancestor
+    # slots must use entity_in-only activation rows.
+    src = """
+permit (principal == k8s::Group::"viewers", action, resource);
+forbid (principal in k8s::Group::"viewers", action, resource is k8s::Resource)
+when { resource.resource == "secrets" };
+"""
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    cases = [
+        sar(groups=["viewers"]),  # member, not the group itself
+        sar(groups=["viewers"], resource="secrets"),
+        sar(),
+    ]
+    check_equiv([src], cases)
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "t")])
+    stores = TieredPolicyStores([MemoryStore.from_source("t", src)])
+    for attrs in cases:
+        em, req = record_to_cedar_resource(attrs)
+        assert engine.evaluate(em, req)[0] == stores.is_authorized(em, req)[0]
+
+
+def test_multi_tier_and_random_stream():
+    t0 = """
+forbid (principal, action, resource is k8s::Resource)
+when { resource.resource == "secrets" && principal.name == "mallory" };
+"""
+    t1 = """
+permit (principal, action in [k8s::Action::"get", k8s::Action::"list"],
+        resource is k8s::Resource)
+when { resource has namespace && resource.namespace == "default" };
+"""
+    rng = random.Random(7)
+    reqs = [
+        sar(
+            user=rng.choice(["alice", "mallory", "bob"]),
+            verb=rng.choice(["get", "list", "create"]),
+            resource=rng.choice(["pods", "secrets", "configmaps"]),
+            ns=rng.choice(["default", "kube-system", ""]),
+            groups=rng.sample(["viewers", "editors", "ops"], rng.randint(0, 3)),
+        )
+        for _ in range(50)
+    ]
+    check_equiv([t0, t1], reqs)
+
+
+def test_code_dtype_and_zero_row():
+    src = 'permit (principal, action, resource) when { principal.name == "x" };'
+    packed = pack(lower_tiers([PolicySet.from_source(src, "t")]))
+    assert not packed.table.rows[0].any()  # row 0 must stay all-zero
+    assert packed.table.code_dtype == np.int16
