@@ -103,6 +103,7 @@ def build_server(args) -> WebhookServer:
         log.warning("no policy stores configured; authorizer will no-opinion")
 
     evaluate = None
+    engine = None
     if args.backend == "tpu" and not len(stores.stores):
         log.warning("TPU backend requested but no stores configured; using interpreter")
     elif args.backend == "tpu":
@@ -119,6 +120,20 @@ def build_server(args) -> WebhookServer:
             return engine.evaluate(entities, request)
 
     authorizer = CedarWebhookAuthorizer(stores, evaluate=evaluate)
+
+    fastpath = None
+    if engine is not None and not args.no_native:
+        from ..engine.fastpath import SARFastPath
+        from ..native import native_available, native_error
+
+        if native_available():
+            fastpath = SARFastPath(engine, authorizer)
+            log.info("native SAR fast path enabled")
+        else:
+            log.warning(
+                "native SAR fast path unavailable (%s); using python encode",
+                native_error(),
+            )
 
     # admission gets the allow-all final tier (main.go:111-116)
     admission_stores = TieredPolicyStores(
@@ -155,6 +170,8 @@ def build_server(args) -> WebhookServer:
         metrics_port=args.metrics_port,
         certfile=certfile,
         keyfile=keyfile,
+        fastpath=fastpath,
+        batch_window_s=args.batch_window_us / 1e6,
     )
 
 
@@ -181,6 +198,17 @@ def make_parser() -> argparse.ArgumentParser:
         type=float,
         default=5.0,
         help="poll interval for TPU policy recompilation",
+    )
+    cedar.add_argument(
+        "--no-native",
+        action="store_true",
+        help="disable the C++ SAR fast path (python encode only)",
+    )
+    cedar.add_argument(
+        "--batch-window-us",
+        type=float,
+        default=200.0,
+        help="micro-batch forming window for the TPU fast path",
     )
 
     serving = parser.add_argument_group("secure serving")

@@ -1,0 +1,107 @@
+"""Micro-batching bridge between request threads and the batch evaluator.
+
+The webhook serves one HTTP request per thread (the moral equivalent of the
+reference's goroutine-per-request, /root/reference internal/server/server.go),
+but the TPU engine wants batches. The MicroBatcher collects items submitted
+by concurrent request threads inside a short window and hands them to the
+batch function in one call; each submitter blocks until its own result is
+ready. This is the micro-batching gRPC-link design of SURVEY.md §5.8,
+in-process.
+
+Latency shape: a lone request waits at most ``window_s`` (default 200µs)
+before the batch fires — well inside the p99 < 2ms budget — while a
+saturated server naturally forms large batches (up to ``max_batch``) and
+rides the device's throughput curve.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Sequence, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+class _Slot:
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        fn: Callable[[Sequence[T]], List[R]],
+        max_batch: int = 8192,
+        window_s: float = 0.0002,
+    ):
+        self._fn = fn
+        self.max_batch = max_batch
+        self.window_s = window_s
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: List[tuple] = []
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, name="micro-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, item: T) -> R:
+        """Enqueue one item and block until its result is available."""
+        slot = _Slot()
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("MicroBatcher is stopped")
+            self._queue.append((item, slot))
+            self._cv.notify()
+        slot.event.wait()
+        if slot.error is not None:
+            raise slot.error
+        return slot.result
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+        self._thread.join(timeout=2.0)
+
+    # ------------------------------------------------------------- internals
+
+    def _run(self) -> None:
+        import time
+
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopped:
+                    self._cv.wait()
+                if self._stopped and not self._queue:
+                    return
+                # batch-forming window: let concurrent submitters pile in
+                deadline = time.monotonic() + self.window_s
+                while len(self._queue) < self.max_batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+                batch = self._queue[: self.max_batch]
+                del self._queue[: self.max_batch]
+            items = [it for it, _ in batch]
+            try:
+                results = self._fn(items)
+                if len(results) != len(items):
+                    raise RuntimeError(
+                        f"batch fn returned {len(results)} results for "
+                        f"{len(items)} items"
+                    )
+                for (_, slot), res in zip(batch, results):
+                    slot.result = res
+                    slot.event.set()
+            except BaseException as e:  # noqa: BLE001 — propagate per-item
+                for _, slot in batch:
+                    slot.error = e
+                    slot.event.set()

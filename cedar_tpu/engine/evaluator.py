@@ -172,6 +172,71 @@ class TPUPolicyEngine:
                 results.append(self._finalize_packed(packed, int(words[i])))
         return results
 
+    def match_arrays(
+        self,
+        codes_arr: np.ndarray,
+        extras_arr: np.ndarray,
+        want_full: bool = False,
+        cs: Optional["_CompiledSet"] = None,
+    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Device-match pre-encoded feature codes (e.g. from the native
+        encoder): codes [n, S], extras [n, E] (padded with >= L). Returns
+        (packed verdict words [n] uint32, full [n, G] int32 or None).
+        Handles batch bucketing, dtype narrowing, and sub-batch pipelining.
+
+        `cs` pins the compiled set the codes were encoded against — callers
+        that encoded against a snapshot MUST pass it, or a concurrent policy
+        hot swap would gather the codes through the new set's tables."""
+        cs = cs or self._compiled
+        if cs is None:
+            raise RuntimeError("TPUPolicyEngine: no policy set loaded")
+        packed = cs.packed
+        n = codes_arr.shape[0]
+        args = (
+            cs.act_rows_dev,
+            cs.W_dev,
+            cs.thresh_dev,
+            cs.rule_group_dev,
+            cs.rule_policy_dev,
+        )
+        codes_arr = codes_arr.astype(cs.code_dtype, copy=False)
+        extras_arr = extras_arr.astype(cs.active_dtype, copy=False)
+
+        def one(chunk_c, chunk_e):
+            m = chunk_c.shape[0]
+            B = _round_bucket(m, _BATCH_BUCKETS)
+            if B != m:
+                pc = np.zeros((B, chunk_c.shape[1]), dtype=chunk_c.dtype)
+                pc[:m] = chunk_c
+                pe = np.full(
+                    (B, chunk_e.shape[1]), packed.L, dtype=chunk_e.dtype
+                )
+                pe[:m] = chunk_e
+                chunk_c, chunk_e = pc, pe
+            return match_rules_codes(
+                chunk_c, chunk_e, *args, packed.n_tiers, want_full
+            )
+
+        if n <= _PIPELINE_MIN:
+            w, f = one(codes_arr, extras_arr)
+            return np.asarray(w)[:n], (np.asarray(f)[:n] if want_full else None)
+
+        outs = []
+        for lo in range(0, n, _PIPELINE_SB):
+            hi = min(lo + _PIPELINE_SB, n)
+            w, f = one(codes_arr[lo:hi], extras_arr[lo:hi])
+            w.copy_to_host_async()
+            if f is not None:
+                f.copy_to_host_async()
+            outs.append((hi - lo, w, f))
+        words = np.concatenate([np.asarray(w)[:m] for m, w, _ in outs])
+        full = (
+            np.concatenate([np.asarray(f)[:m] for m, _, f in outs])
+            if want_full
+            else None
+        )
+        return words, full
+
     # ---------------------------------------------------------- device path
 
     def _encode_batch_arrays(
@@ -199,45 +264,11 @@ class TPUPolicyEngine:
         self, cs: _CompiledSet, encoded, want_full: bool
     ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """Returns (packed verdict words [n] uint32, full [n, G] int32 or
-        None). Pipelines sub-batches so transfers overlap compute."""
-        packed = cs.packed
-        n = len(encoded)
-        args = (
-            cs.act_rows_dev,
-            cs.W_dev,
-            cs.thresh_dev,
-            cs.rule_group_dev,
-            cs.rule_policy_dev,
+        None). Builds padded arrays and delegates to match_arrays."""
+        codes_arr, extras_arr = self._encode_batch_arrays(
+            cs, encoded, len(encoded)
         )
-
-        if n <= _PIPELINE_MIN:
-            B = _round_bucket(n, _BATCH_BUCKETS)
-            codes_arr, extras_arr = self._encode_batch_arrays(cs, encoded, B)
-            w, f = match_rules_codes(
-                codes_arr, extras_arr, *args, packed.n_tiers, want_full
-            )
-            words = np.asarray(w)[:n]
-            return words, (np.asarray(f)[:n] if want_full else None)
-
-        outs = []
-        for lo in range(0, n, _PIPELINE_SB):
-            chunk = encoded[lo : lo + _PIPELINE_SB]
-            B = _round_bucket(len(chunk), _BATCH_BUCKETS)
-            codes_arr, extras_arr = self._encode_batch_arrays(cs, chunk, B)
-            w, f = match_rules_codes(
-                codes_arr, extras_arr, *args, packed.n_tiers, want_full
-            )
-            w.copy_to_host_async()
-            if f is not None:
-                f.copy_to_host_async()
-            outs.append((len(chunk), w, f))
-        words = np.concatenate([np.asarray(w)[:m] for m, w, _ in outs])
-        full = (
-            np.concatenate([np.asarray(f)[:m] for m, _, f in outs])
-            if want_full
-            else None
-        )
-        return words, full
+        return self.match_arrays(codes_arr, extras_arr, want_full, cs=cs)
 
     # ------------------------------------------------- fallback + tier walk
 

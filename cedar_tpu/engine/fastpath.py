@@ -1,0 +1,235 @@
+"""SAR fast path: raw request bytes -> decisions, native end to end.
+
+Fuses the C++ encoder (cedar_tpu/native) with the device matcher: the host
+never materializes Python entity objects for well-formed requests. Per
+request the host work is one C++ JSON parse + a handful of hash lookups;
+the device work rides the batched matmul kernel; the readback is 4 bytes.
+
+Semantics are identical to CedarWebhookAuthorizer.authorize over the TPU
+engine (the gates run inside the C++ encoder in the same order as
+/root/reference internal/server/authorizer/authorizer.go:38-66); rows the
+native path cannot prove equivalent (parse quirks, extras overflow, or a
+policy set with interpreter-fallback policies) are re-run through the exact
+Python path.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..native import (
+    F_EXTRAS_OVERFLOW,
+    F_OK,
+    F_PARSE_ERROR,
+    F_SELF_ALLOW_POLICIES,
+    F_SELF_ALLOW_RBAC,
+    F_SYSTEM_SKIP,
+    NativeEncoder,
+)
+from ..server.authorizer import (
+    DECISION_ALLOW,
+    DECISION_DENY,
+    DECISION_NO_OPINION,
+    CedarWebhookAuthorizer,
+    _diagnostic_to_reason,
+)
+from ..lang.authorize import ALLOW, DENY
+from .evaluator import TPUPolicyEngine
+
+log = logging.getLogger(__name__)
+
+# (decision, reason, error) results for gate flags (authorizer.go:38-57)
+_GATE_RESULTS = {
+    F_SELF_ALLOW_POLICIES: (
+        DECISION_ALLOW,
+        "cedar authorizer is always allowed to access policies",
+        None,
+    ),
+    F_SELF_ALLOW_RBAC: (
+        DECISION_ALLOW,
+        "cedar authorizer is always allowed to read RBAC policies",
+        None,
+    ),
+    F_SYSTEM_SKIP: (DECISION_NO_OPINION, "", None),
+}
+
+# (decision, reason, error): error non-None mirrors the webhook handler's
+# decode-error / evaluation-error response shapes (server/http.py)
+Result = Tuple[str, str, Optional[str]]
+
+
+class SARFastPath:
+    """Batch evaluator over raw SubjectAccessReview JSON bodies."""
+
+    def __init__(
+        self,
+        engine: TPUPolicyEngine,
+        authorizer: CedarWebhookAuthorizer,
+        fallback: Optional[Callable[[bytes], Result]] = None,
+    ):
+        self.engine = engine
+        self.authorizer = authorizer
+        self._fallback = fallback or self._python_fallback
+        self._encoder: Optional[NativeEncoder] = None
+        self._encoder_for = None  # the _CompiledSet the encoder was built on
+        self._reason_cache: dict = {}  # policy index -> reason JSON
+
+    # ---------------------------------------------------------- availability
+
+    def _current_encoder(self) -> Optional[NativeEncoder]:
+        """(Re)build the native encoder when the compiled set changes (policy
+        hot swap); None when the set or environment rules the fast path out."""
+        cs = self.engine._compiled
+        if cs is None:
+            return None
+        if cs.packed.fallback:
+            # interpreter-fallback policies need Python entities per request
+            return None
+        if self._encoder_for is not cs:
+            try:
+                self._encoder = NativeEncoder.create(cs.packed)
+            except Exception:  # noqa: BLE001 — cache the failure, don't loop
+                log.exception("native encoder build failed; python path only")
+                self._encoder = None
+            self._encoder_for = cs
+            self._reason_cache = {}
+        return self._encoder
+
+    def _reason(self, packed, pol: int) -> str:
+        """Reason JSON for a single-policy match; cached — it depends only
+        on the policy index within one compiled set."""
+        r = self._reason_cache.get(pol)
+        if r is None:
+            from ..lang.authorize import Diagnostics, Reason
+
+            meta = packed.policy_meta[pol]
+            r = _diagnostic_to_reason(
+                Diagnostics(
+                    reasons=[Reason(meta.policy_id, meta.filename, meta.position)]
+                )
+            )
+            self._reason_cache[pol] = r
+        return r
+
+    @property
+    def available(self) -> bool:
+        return self._current_encoder() is not None
+
+    # ------------------------------------------------------------ evaluation
+
+    def _python_fallback(self, body: bytes) -> Result:
+        import json
+
+        from ..server.http import get_authorizer_attributes
+
+        try:
+            sar = json.loads(body)
+        except (ValueError, TypeError) as e:
+            return (
+                DECISION_NO_OPINION,
+                "Encountered decoding error",
+                f"failed parsing request body: {e}",
+            )
+        try:
+            attributes = get_authorizer_attributes(sar)
+            decision, reason = self.authorizer.authorize(attributes)
+        except Exception as e:  # noqa: BLE001 — always answer the apiserver
+            log.exception("fastpath python fallback failed")
+            return DECISION_NO_OPINION, "", f"evaluation error: {e}"
+        return decision, reason, None
+
+    def authorize_raw(self, bodies: Sequence[bytes]) -> List[Result]:
+        """Evaluate a batch of raw SAR JSON bodies -> (decision, reason)."""
+        encoder = self._current_encoder()
+        # snapshot the compiled set the encoder was built on: a policy hot
+        # swap mid-batch must not re-map codes through the new set's tables
+        cs = self._encoder_for
+        if encoder is None:
+            return [self._fallback(b) for b in bodies]
+        if not self.authorizer.ready():
+            # NoOpinion until every store's initial load completes
+            # (authorizer.go:58-66); gates still apply, so run the exact path
+            return [self._fallback(b) for b in bodies]
+
+        codes, extras, _counts, flags = encoder.encode_batch(bodies)
+        results: List[Optional[Result]] = [None] * len(bodies)
+
+        ok = flags == F_OK
+        for flag, res in _GATE_RESULTS.items():
+            for i in np.nonzero(flags == flag)[0]:
+                results[i] = res
+        for i in np.nonzero((flags == F_PARSE_ERROR) | (flags == F_EXTRAS_OVERFLOW))[0]:
+            results[i] = self._fallback(bodies[i])
+
+        n_ok = int(ok.sum())
+        if n_ok:
+            all_ok = n_ok == len(bodies)
+            idx = np.arange(len(bodies)) if all_ok else np.nonzero(ok)[0]
+            ok_codes = codes if all_ok else codes[idx]
+            # trim the extras buffer to the live width (bucketed to avoid
+            # retraces): most requests carry zero extras, and every padded
+            # column costs a [B, E, L] broadcast-compare on device
+            from .evaluator import _round_bucket
+
+            max_e = int(_counts.max(initial=0) if all_ok else _counts[idx].max(initial=0))
+            if max_e == 0:
+                E = 1
+            else:
+                E = min(
+                    _round_bucket(max_e, (8, 16, 32, 64, 128, 256)),
+                    extras.shape[1],
+                )
+            ok_extras = extras[:, :E] if all_ok else extras[idx, :E]
+            words, _ = self.engine.match_arrays(ok_codes, ok_extras, cs=cs)
+            packed = cs.packed
+            if bool(np.any((words >> 29) & 0x1)):
+                # rare: a policy errored alongside a real match; refetch the
+                # per-group matrix for exact error attribution
+                _, full = self.engine.match_arrays(
+                    ok_codes, ok_extras, want_full=True, cs=cs
+                )
+                for k, i in enumerate(idx):
+                    decision, diag = self.engine._finalize_full(
+                        packed, full[k], None, None
+                    )
+                    results[i] = self._map_decision(decision, diag)
+            else:
+                # vectorized verdict decode: one tuple per row, reason JSON
+                # from the per-policy cache; plain-list iteration beats numpy
+                # scalar indexing at this row count
+                w = words.astype(np.uint32)
+                vcodes = ((w >> 30) & 0x3).tolist()
+                pols = (w & 0xFFFFFF).tolist()
+                noop = (DECISION_NO_OPINION, "", None)
+                reason = self._reason
+                for k, i in enumerate(idx.tolist()):
+                    c = vcodes[k]
+                    if c == 1:
+                        results[i] = (DECISION_ALLOW, reason(packed, pols[k]), None)
+                    elif c == 2:
+                        results[i] = (DECISION_DENY, reason(packed, pols[k]), None)
+                    elif c == 3:
+                        meta = packed.policy_meta[pols[k]]
+                        log.error(
+                            "Authorize errors: while evaluating policy `%s`:"
+                            " evaluation error",
+                            meta.policy_id,
+                        )
+                        results[i] = noop
+                    else:
+                        results[i] = noop
+        return results  # type: ignore[return-value]
+
+    @staticmethod
+    def _map_decision(decision: str, diag) -> Result:
+        """Cedar decision -> webhook decision (authorizer.go:75-84)."""
+        if decision == ALLOW:
+            return DECISION_ALLOW, _diagnostic_to_reason(diag), None
+        if decision == DENY and diag.reasons:
+            return DECISION_DENY, _diagnostic_to_reason(diag), None
+        if diag.errors:
+            log.error("Authorize errors: %s", diag.errors)
+        return DECISION_NO_OPINION, "", None

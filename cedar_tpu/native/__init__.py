@@ -1,0 +1,318 @@
+"""Native (C++) host runtime: the SAR fast path.
+
+The hot host-side step of the serving plane — raw SubjectAccessReview JSON →
+dictionary-coded feature vector — is implemented in C++ (encoder.cpp) and
+bound via ctypes. The library is compiled on first use with the system g++
+(no pip deps) and cached next to the package; ``NativeEncoder`` is the
+Python-facing handle.
+
+Falls back cleanly: if no C++ toolchain is available, or the compiled policy
+set needs per-request interpretation (hard literals), ``NativeEncoder.create``
+returns None and callers keep the pure-Python encode path.
+
+Blob format (little-endian; must match BlobReader in encoder.cpp):
+
+  i32 magic "CTB1" (0x43544231)
+  i32 n_slots
+  3x var sections (principal, action, resource):
+      i32 type_slot, i32 uid_slot, i32 n_anc, i32 anc_slots[...]
+  type_map:  i32 count, { str key, i32 row }       key = "<v>\\x1f<type>"
+  uid_map:   i32 count, { str key, i32 row }       key = "<v>\\x1f<type>\\x1f<id>"
+  anc_map:   i32 count, { str key, i32 row, i32 nlits, i32 lits[] }
+  slots:     i32 count, { u8 var, u8 deep, str attr, i32 sidx,
+                          i32 present_row,
+                          vocab:   i32 count, { str canon, i32 row }
+                          likes:   i32 count, { i32 lit, i32 ncomps,
+                                                { u8 wild, [str chunk] } }
+                          cmps:    i32 count, { i32 lit, u8 op, i64 c }
+                          set_has: i32 count, { str canon, i32 n, i32 lits[] } }
+
+  (str = i32 length + bytes)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..lang.ast import WILDCARD
+
+# flags mirrored from encoder.cpp
+F_OK = 0
+F_PARSE_ERROR = 1
+F_SELF_ALLOW_POLICIES = 2
+F_SELF_ALLOW_RBAC = 3
+F_SYSTEM_SKIP = 4
+F_EXTRAS_OVERFLOW = 5
+
+_VAR_IDX = {"principal": 0, "action": 1, "resource": 2, "context": 3}
+_CMP_OPS = {"<": 0, "<=": 1, ">": 2, ">=": 3}
+
+
+def _canon(vk) -> bytes:
+    """Canonical byte string for a value_key; must stay in sync with the
+    canon_* helpers in encoder.cpp."""
+    tag = vk[0]
+    if tag == "b":
+        return b"t" if vk[1] else b"f"
+    if tag == "l":
+        return b"l%d" % vk[1]
+    if tag == "s":
+        return b"s" + vk[1].encode("utf-8", "surrogatepass")
+    if tag == "e":
+        return b"e" + vk[1].encode() + b"\x1f" + vk[2].encode()
+    if tag == "S":
+        return b"S{" + b"\x1f".join(sorted(_canon(e) for e in vk[1])) + b"}"
+    if tag == "R":
+        return (
+            b"R{"
+            + b"\x1f".join(k.encode() + b"\x1d" + _canon(v) for k, v in vk[1])
+            + b"}"
+        )
+    raise ValueError(f"cannot canonicalize value key {vk!r}")
+
+
+class _BlobWriter:
+    def __init__(self):
+        self.parts: List[bytes] = []
+
+    def u8(self, v: int):
+        self.parts.append(struct.pack("<B", v))
+
+    def i32(self, v: int):
+        self.parts.append(struct.pack("<i", v))
+
+    def i64(self, v: int):
+        self.parts.append(struct.pack("<q", v))
+
+    def s(self, b) -> None:
+        if isinstance(b, str):
+            b = b.encode("utf-8", "surrogatepass")
+        self.parts.append(struct.pack("<i", len(b)))
+        self.parts.append(b)
+
+    def blob(self) -> bytes:
+        return b"".join(self.parts)
+
+
+def serialize_table(plan, table) -> Optional[bytes]:
+    """FeatureTable + EncodePlan -> native blob, or None when the set is not
+    natively encodable (hard literals need the interpreter per request, and
+    value kinds the canon format doesn't cover fall back to Python)."""
+    if plan.hard_lits:
+        return None
+    try:
+        return _serialize_table(plan, table)
+    except ValueError:
+        return None
+
+
+def _serialize_table(plan, table) -> bytes:
+    w = _BlobWriter()
+    w.i32(0x43544231)
+    w.i32(table.n_slots)
+
+    vars3 = ("principal", "action", "resource")
+    for var in vars3:
+        w.i32(table.var_type_slot.get(var, -1))
+        w.i32(table.var_uid_slot.get(var, -1))
+        anc = table.anc_slots.get(var, ())
+        w.i32(len(anc))
+        for a in anc:
+            w.i32(a)
+
+    def var_key(var: str, *rest: str) -> bytes:
+        return b"\x1f".join(
+            [str(_VAR_IDX[var]).encode()] + [r.encode() for r in rest]
+        )
+
+    w.i32(len(table.type_vocab))
+    for (var, tname), row in table.type_vocab.items():
+        w.s(var_key(var, tname))
+        w.i32(row)
+
+    w.i32(len(table.uid_vocab))
+    for (var, tname, eid), row in table.uid_vocab.items():
+        w.s(var_key(var, tname, eid))
+        w.i32(row)
+
+    w.i32(len(table.anc_vocab))
+    for (var, tname, eid), row in table.anc_vocab.items():
+        w.s(var_key(var, tname, eid))
+        w.i32(row)
+        lits = plan.entity_in_idx.get(var, {}).get((tname, eid), ())
+        w.i32(len(lits))
+        for lid in lits:
+            w.i32(lid)
+
+    w.i32(len(table.scalar_slot_of))
+    for slot, sidx in table.scalar_slot_of.items():
+        var, path = slot
+        w.u8(_VAR_IDX.get(var, 3))
+        w.u8(1 if len(path) != 1 else 0)
+        w.s(path[0] if len(path) == 1 else "\x1f".join(path))
+        w.i32(sidx)
+        w.i32(table.present_row[slot])
+
+        vocab = table.scalar_vocab.get(slot, {})
+        w.i32(len(vocab))
+        for vk, row in vocab.items():
+            w.s(_canon(vk))
+            w.i32(row)
+
+        likes = plan.like_idx.get(slot, ())
+        w.i32(len(likes))
+        for lid, pattern in likes:
+            w.i32(lid)
+            w.i32(len(pattern.components))
+            for comp in pattern.components:
+                if comp is WILDCARD:
+                    w.u8(1)
+                else:
+                    w.u8(0)
+                    w.s(comp)
+
+        cmps = plan.cmp_idx.get(slot, ())
+        w.i32(len(cmps))
+        for lid, op, c in cmps:
+            w.i32(lid)
+            w.u8(_CMP_OPS[op])
+            w.i64(c)
+
+        sh = plan.set_has_idx.get(slot, {})
+        w.i32(len(sh))
+        for vk, lits in sh.items():
+            w.s(_canon(vk))
+            w.i32(len(lits))
+            for lid in lits:
+                w.i32(lid)
+
+    return w.blob()
+
+
+_lib = None
+_lib_error: Optional[str] = None
+
+
+def _load_library():
+    global _lib, _lib_error
+    if _lib is not None or _lib_error is not None:
+        return _lib
+    try:
+        from .build import ensure_built
+
+        path = ensure_built()
+        lib = ctypes.CDLL(str(path))
+        lib.ce_load_table.restype = ctypes.c_void_p
+        lib.ce_load_table.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.ce_free_table.argtypes = [ctypes.c_void_p]
+        lib.ce_n_slots.restype = ctypes.c_int32
+        lib.ce_n_slots.argtypes = [ctypes.c_void_p]
+        lib.ce_encode_sar_batch.restype = None
+        lib.ce_encode_sar_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int32,
+        ]
+        _lib = lib
+    except Exception as e:  # no toolchain / build failure => python path
+        _lib_error = str(e)
+        return None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_library() is not None
+
+
+def native_error() -> Optional[str]:
+    _load_library()
+    return _lib_error
+
+
+class NativeEncoder:
+    """Owns one loaded native activation table; encodes raw SAR JSON batches."""
+
+    DEFAULT_EXTRAS_CAP = 32
+
+    def __init__(self, handle: int, n_slots: int, pad_value: int):
+        self._handle = handle
+        self.n_slots = n_slots
+        self.pad_value = pad_value
+
+    @classmethod
+    def create(cls, packed) -> Optional["NativeEncoder"]:
+        """Build a NativeEncoder for a PackedPolicySet, or None if the set
+        (hard literals) or the environment (no g++) rules it out."""
+        lib = _load_library()
+        if lib is None:
+            return None
+        blob = serialize_table(packed.plan, packed.table)
+        if blob is None:
+            return None
+        handle = lib.ce_load_table(blob, len(blob))
+        if not handle:
+            raise RuntimeError("native table load failed (blob format skew?)")
+        return cls(handle, packed.table.n_slots, packed.L)
+
+    def __del__(self):
+        lib = _lib
+        if lib is not None and getattr(self, "_handle", None):
+            lib.ce_free_table(self._handle)
+            self._handle = None
+
+    def encode_batch(
+        self,
+        bodies: Sequence[bytes],
+        extras_cap: int = DEFAULT_EXTRAS_CAP,
+        n_threads: int = 0,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Raw SAR JSON bodies -> (codes [n, S] int32, extras [n, cap] int32
+        pre-padded with pad_value, extras_count [n], flags [n]).
+
+        flags: F_OK rows are device-ready; gate rows (self-allow / system
+        skip) carry the decision; F_PARSE_ERROR / F_EXTRAS_OVERFLOW rows
+        need the caller's Python fallback."""
+        lib = _load_library()
+        assert lib is not None
+        n = len(bodies)
+        codes = np.zeros((n, self.n_slots), dtype=np.int32)
+        extras = np.full((n, extras_cap), self.pad_value, dtype=np.int32)
+        counts = np.zeros((n,), dtype=np.int32)
+        flags = np.zeros((n,), dtype=np.uint8)
+        if n == 0:
+            return codes, extras, counts, flags
+
+        buf = b"".join(bodies)
+        lens = np.fromiter((len(b) for b in bodies), dtype=np.uint64, count=n)
+        offsets = np.zeros((n,), dtype=np.uint64)
+        np.cumsum(lens[:-1], out=offsets[1:])
+        if n_threads <= 0:
+            import os
+
+            n_threads = min(max(os.cpu_count() or 1, 1), 16)
+        lib.ce_encode_sar_batch(
+            self._handle,
+            n,
+            buf,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            extras.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            extras_cap,
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n_threads,
+        )
+        return codes, extras, counts, flags

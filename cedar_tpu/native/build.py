@@ -1,0 +1,63 @@
+"""On-demand build of the native encoder library.
+
+Compiles encoder.cpp with the system C++ toolchain into a shared library
+cached under ``cedar_tpu/native/_build/`` keyed by a source hash, so edits
+to the .cpp transparently rebuild and repeated imports are free. No pip
+dependencies: plain g++ (or $CXX) + ctypes."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pathlib
+import subprocess
+import threading
+
+_HERE = pathlib.Path(__file__).resolve().parent
+_SRC = _HERE / "encoder.cpp"
+_BUILD_DIR = _HERE / "_build"
+_LOCK = threading.Lock()
+
+
+def _source_hash() -> str:
+    return hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+
+
+def library_path() -> pathlib.Path:
+    return _BUILD_DIR / f"libcedar_native_{_source_hash()}.so"
+
+
+def ensure_built() -> pathlib.Path:
+    """Compile (once) and return the shared-library path."""
+    out = library_path()
+    if out.exists():
+        return out
+    with _LOCK:
+        if out.exists():
+            return out
+        _BUILD_DIR.mkdir(exist_ok=True)
+        cxx = os.environ.get("CXX", "g++")
+        tmp = out.with_suffix(".so.tmp")
+        cmd = [
+            cxx,
+            "-O3",
+            "-march=native",
+            "-fno-plt",
+            "-std=c++17",
+            "-shared",
+            "-fPIC",
+            "-pthread",
+            str(_SRC),
+            "-o",
+            str(tmp),
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)
+        # drop stale builds of older source revisions
+        for old in _BUILD_DIR.glob("libcedar_native_*.so"):
+            if old != out:
+                try:
+                    old.unlink()
+                except OSError:
+                    pass
+    return out

@@ -1,0 +1,1052 @@
+// Native SAR fast path: raw SubjectAccessReview JSON -> feature codes.
+//
+// This is the TPU framework's host-side hot loop in C++: it fuses the work
+// of the Python pipeline (server/http.py get_authorizer_attributes ->
+// server/authorizer.py record_to_cedar_resource -> compiler/table.py
+// encode_request_codes) into one pass over the raw request bytes, producing
+// the [n_slots] dictionary-code vector + extras list the device kernel
+// consumes. Behavior parity with the Python path is enforced by
+// tests/test_native_encoder.py (randomized differential tests).
+//
+// Designed for allocation-free steady state: the JSON DOM is pointer-linked
+// nodes bump-allocated from a reusable arena, string values are views into
+// the request buffer (escaped strings — rare in SARs — are materialized
+// into arena-owned storage), and hash-map probe keys are composed into
+// reused scratch buffers.
+//
+// Reference behaviors mirrored (cites are to /root/reference):
+//   * SAR -> attributes: internal/server/server.go:163-309
+//   * principal typing + group parents: internal/server/entities/user.go:35
+//   * action/resource/non-resource/impersonation entities:
+//     internal/server/authorizer/entitiy_builders.go:13-143
+//   * authorizer gates (self-allow, system:* skip):
+//     internal/server/authorizer/authorizer.go:38-57
+//
+// The activation-table blob is serialized by cedar_tpu/native/__init__.py
+// (format documented there); canonical value-key strings must stay in sync
+// with _canon() on the Python side.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <string>
+#include <string_view>
+#include <thread>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace {
+
+using sv = std::string_view;
+
+// ----------------------------------------------------------- tiny JSON DOM
+
+struct JVal {
+  enum Kind : uint8_t { NUL, BOOL, NUM, STR, ARR, OBJ } kind = NUL;
+  bool b = false;
+  sv str;        // STR payload
+  sv key;        // member key when this node is an object member
+  JVal *child = nullptr;  // first child (ARR/OBJ)
+  JVal *next = nullptr;   // next sibling
+
+  const JVal *get(sv k) const {
+    if (kind != OBJ) return nullptr;
+    // duplicate keys resolve to the last one, matching Python json.loads
+    const JVal *found = nullptr;
+    for (const JVal *c = child; c; c = c->next)
+      if (c->key == k) found = c;
+    return found;
+  }
+};
+
+// Bump allocator with stable addresses, reusable across requests.
+class Arena {
+ public:
+  JVal *alloc() {
+    if (used_ == kChunk * chunks_.size()) chunks_.emplace_back(new JVal[kChunk]);
+    JVal *v = &chunks_[used_ / kChunk][used_ % kChunk];
+    ++used_;
+    *v = JVal{};
+    return v;
+  }
+  // arena-owned storage for escaped strings
+  sv own(std::string &&s) {
+    if (n_owned_ == owned_.size()) owned_.emplace_back();
+    std::string &slot = owned_[n_owned_++];
+    slot = std::move(s);
+    return sv(slot);
+  }
+  void reset() {
+    used_ = 0;
+    n_owned_ = 0;
+  }
+
+ private:
+  static constexpr size_t kChunk = 128;
+  std::vector<std::unique_ptr<JVal[]>> chunks_;
+  std::vector<std::string> owned_;
+  size_t used_ = 0, n_owned_ = 0;
+};
+
+class JsonParser {
+ public:
+  JsonParser(const char *p, size_t n, Arena &arena)
+      : p_(p), end_(p + n), arena_(arena) {}
+
+  JVal *parse() {
+    JVal *v = value();
+    if (!v) return nullptr;
+    ws();
+    if (p_ != end_) return nullptr;  // trailing garbage
+    return v;
+  }
+
+ private:
+  const char *p_, *end_;
+  Arena &arena_;
+
+  void ws() {
+    while (p_ < end_ && (*p_ == ' ' || *p_ == '\t' || *p_ == '\n' || *p_ == '\r'))
+      ++p_;
+  }
+  bool lit(const char *s, size_t n) {
+    if (size_t(end_ - p_) < n || memcmp(p_, s, n) != 0) return false;
+    p_ += n;
+    return true;
+  }
+
+  JVal *value() {
+    ws();
+    if (p_ >= end_) return nullptr;
+    switch (*p_) {
+      case '{': return container(true);
+      case '[': return container(false);
+      case '"': {
+        JVal *v = arena_.alloc();
+        v->kind = JVal::STR;
+        if (!string(v->str)) return nullptr;
+        return v;
+      }
+      case 't': {
+        if (!lit("true", 4)) return nullptr;
+        JVal *v = arena_.alloc();
+        v->kind = JVal::BOOL;
+        v->b = true;
+        return v;
+      }
+      case 'f': {
+        if (!lit("false", 5)) return nullptr;
+        JVal *v = arena_.alloc();
+        v->kind = JVal::BOOL;
+        return v;
+      }
+      case 'n': {
+        if (!lit("null", 4)) return nullptr;
+        return arena_.alloc();
+      }
+      default: return number();
+    }
+  }
+
+  JVal *number() {
+    if (p_ < end_ && *p_ == '-') ++p_;
+    if (p_ >= end_ || *p_ < '0' || *p_ > '9') return nullptr;
+    while (p_ < end_ && ((*p_ >= '0' && *p_ <= '9') || *p_ == '.' || *p_ == 'e' ||
+                         *p_ == 'E' || *p_ == '+' || *p_ == '-'))
+      ++p_;
+    JVal *v = arena_.alloc();
+    v->kind = JVal::NUM;
+    return v;
+  }
+
+  static void utf8_append(std::string &out, uint32_t cp) {
+    if (cp < 0x80) {
+      out.push_back(char(cp));
+    } else if (cp < 0x800) {
+      out.push_back(char(0xC0 | (cp >> 6)));
+      out.push_back(char(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out.push_back(char(0xE0 | (cp >> 12)));
+      out.push_back(char(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(char(0x80 | (cp & 0x3F)));
+    } else {
+      out.push_back(char(0xF0 | (cp >> 18)));
+      out.push_back(char(0x80 | ((cp >> 12) & 0x3F)));
+      out.push_back(char(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(char(0x80 | (cp & 0x3F)));
+    }
+  }
+
+  bool hex4(uint32_t &out) {
+    if (end_ - p_ < 4) return false;
+    out = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = *p_++;
+      out <<= 4;
+      if (c >= '0' && c <= '9') out |= uint32_t(c - '0');
+      else if (c >= 'a' && c <= 'f') out |= uint32_t(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') out |= uint32_t(c - 'A' + 10);
+      else return false;
+    }
+    return true;
+  }
+
+  // Fast path: no escapes -> a view into the input buffer, zero copies.
+  bool string(sv &out) {
+    ++p_;  // opening quote
+    const char *start = p_;
+    while (p_ < end_ && *p_ != '"' && *p_ != '\\') ++p_;
+    if (p_ >= end_) return false;
+    if (*p_ == '"') {
+      out = sv(start, size_t(p_ - start));
+      ++p_;
+      return true;
+    }
+    // slow path: materialize with escape processing
+    std::string buf(start, size_t(p_ - start));
+    while (p_ < end_) {
+      char c = *p_;
+      if (c == '"') {
+        ++p_;
+        out = arena_.own(std::move(buf));
+        return true;
+      }
+      if (c == '\\') {
+        ++p_;
+        if (p_ >= end_) return false;
+        char e = *p_++;
+        switch (e) {
+          case '"': buf.push_back('"'); break;
+          case '\\': buf.push_back('\\'); break;
+          case '/': buf.push_back('/'); break;
+          case 'b': buf.push_back('\b'); break;
+          case 'f': buf.push_back('\f'); break;
+          case 'n': buf.push_back('\n'); break;
+          case 'r': buf.push_back('\r'); break;
+          case 't': buf.push_back('\t'); break;
+          case 'u': {
+            uint32_t cp;
+            if (!hex4(cp)) return false;
+            if (cp >= 0xD800 && cp <= 0xDBFF && end_ - p_ >= 6 && p_[0] == '\\' &&
+                p_[1] == 'u') {
+              const char *save = p_;
+              p_ += 2;
+              uint32_t lo;
+              if (!hex4(lo)) return false;
+              if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              } else {
+                p_ = save;  // lone high surrogate; encode as-is (WTF-8)
+              }
+            }
+            utf8_append(buf, cp);
+            break;
+          }
+          default: return false;
+        }
+      } else {
+        buf.push_back(c);
+        ++p_;
+      }
+    }
+    return false;  // unterminated
+  }
+
+  JVal *container(bool is_obj) {
+    ++p_;  // '{' or '['
+    JVal *v = arena_.alloc();
+    v->kind = is_obj ? JVal::OBJ : JVal::ARR;
+    char close = is_obj ? '}' : ']';
+    ws();
+    if (p_ < end_ && *p_ == close) {
+      ++p_;
+      return v;
+    }
+    JVal *tail = nullptr;
+    while (true) {
+      sv key;
+      if (is_obj) {
+        ws();
+        if (p_ >= end_ || *p_ != '"' || !string(key)) return nullptr;
+        ws();
+        if (p_ >= end_ || *p_ != ':') return nullptr;
+        ++p_;
+      }
+      JVal *mv = value();
+      if (!mv) return nullptr;
+      mv->key = key;
+      if (tail) tail->next = mv;
+      else v->child = mv;
+      tail = mv;
+      ws();
+      if (p_ < end_ && *p_ == ',') {
+        ++p_;
+        continue;
+      }
+      if (p_ < end_ && *p_ == close) {
+        ++p_;
+        return v;
+      }
+      return nullptr;
+    }
+  }
+};
+
+// --------------------------------------------------------- encoder tables
+
+struct LikeComp {
+  bool wild;
+  std::string s;
+};
+
+struct LikeTest {
+  int32_t lit;
+  std::vector<LikeComp> comps;
+};
+
+struct CmpTest {
+  int32_t lit;
+  uint8_t op;  // 0 '<', 1 '<=', 2 '>', 3 '>='
+  int64_t c;
+};
+
+// string hash usable for string_view probes without key construction
+struct SvHash {
+  using is_transparent = void;
+  size_t operator()(sv s) const { return std::hash<sv>{}(s); }
+  size_t operator()(const std::string &s) const { return std::hash<sv>{}(s); }
+};
+struct SvEq {
+  using is_transparent = void;
+  bool operator()(sv a, sv b) const { return a == b; }
+};
+
+template <class V>
+using SvMap = std::unordered_map<std::string, V, SvHash, SvEq>;
+
+template <class V>
+const V *sv_find(const SvMap<V> &m, sv key) {
+#if defined(__cpp_lib_generic_unordered_lookup) && \
+    __cpp_lib_generic_unordered_lookup >= 201811L
+  auto it = m.find(key);
+#else
+  thread_local std::string scratch;
+  scratch.assign(key.data(), key.size());
+  auto it = m.find(scratch);
+#endif
+  return it == m.end() ? nullptr : &it->second;
+}
+
+struct ScalarSlot {
+  uint8_t var;       // 0 principal, 1 action, 2 resource, 3 context/other
+  bool deep;         // multi-component path => value always missing (authz)
+  std::string attr;  // single-component attribute path
+  int32_t sidx;
+  int32_t present_row;
+  SvMap<int32_t> vocab;  // canon(value) -> row
+  std::vector<LikeTest> likes;
+  std::vector<CmpTest> cmps;
+  SvMap<std::vector<int32_t>> set_has;
+};
+
+struct Table {
+  int32_t n_slots = 0;
+  int32_t type_slot[3] = {-1, -1, -1};
+  int32_t uid_slot[3] = {-1, -1, -1};
+  std::vector<int32_t> anc_slots[3];
+  SvMap<int32_t> type_map;  // v \x1f type
+  SvMap<int32_t> uid_map;   // v \x1f type \x1f id
+  SvMap<std::pair<int32_t, std::vector<int32_t>>> anc_map;
+  std::vector<ScalarSlot> slots;
+};
+
+class BlobReader {
+ public:
+  BlobReader(const uint8_t *p, size_t n) : p_(p), end_(p + n) {}
+  bool ok() const { return ok_; }
+
+  uint8_t u8() { return ok_ && p_ < end_ ? *p_++ : (ok_ = false, 0); }
+  int32_t i32() {
+    if (!ok_ || end_ - p_ < 4) return ok_ = false, 0;
+    int32_t v;
+    memcpy(&v, p_, 4);
+    p_ += 4;
+    return v;
+  }
+  int64_t i64() {
+    if (!ok_ || end_ - p_ < 8) return ok_ = false, 0;
+    int64_t v;
+    memcpy(&v, p_, 8);
+    p_ += 8;
+    return v;
+  }
+  std::string str() {
+    int32_t n = i32();
+    if (!ok_ || n < 0 || end_ - p_ < n) return ok_ = false, std::string();
+    std::string s((const char *)p_, size_t(n));
+    p_ += n;
+    return s;
+  }
+
+ private:
+  const uint8_t *p_, *end_;
+  bool ok_ = true;
+};
+
+Table *load_table(const uint8_t *blob, size_t len) {
+  BlobReader r(blob, len);
+  if (r.i32() != 0x43544231) return nullptr;  // "CTB1"
+  auto t = std::make_unique<Table>();
+  t->n_slots = r.i32();
+  for (int v = 0; v < 3; ++v) {
+    t->type_slot[v] = r.i32();
+    t->uid_slot[v] = r.i32();
+    int32_t n = r.i32();
+    for (int32_t i = 0; i < n; ++i) t->anc_slots[v].push_back(r.i32());
+  }
+  int32_t n = r.i32();
+  for (int32_t i = 0; i < n; ++i) {
+    std::string k = r.str();
+    t->type_map[std::move(k)] = r.i32();
+  }
+  n = r.i32();
+  for (int32_t i = 0; i < n; ++i) {
+    std::string k = r.str();
+    t->uid_map[std::move(k)] = r.i32();
+  }
+  n = r.i32();
+  for (int32_t i = 0; i < n; ++i) {
+    std::string k = r.str();
+    int32_t row = r.i32();
+    int32_t nl = r.i32();
+    std::vector<int32_t> lits(size_t(nl >= 0 ? nl : 0));
+    for (auto &l : lits) l = r.i32();
+    t->anc_map[std::move(k)] = {row, std::move(lits)};
+  }
+  n = r.i32();
+  for (int32_t i = 0; i < n; ++i) {
+    ScalarSlot s;
+    s.var = r.u8();
+    s.deep = r.u8() != 0;
+    s.attr = r.str();
+    s.sidx = r.i32();
+    s.present_row = r.i32();
+    int32_t nv = r.i32();
+    for (int32_t j = 0; j < nv; ++j) {
+      std::string k = r.str();
+      s.vocab[std::move(k)] = r.i32();
+    }
+    int32_t nl = r.i32();
+    for (int32_t j = 0; j < nl; ++j) {
+      LikeTest lt;
+      lt.lit = r.i32();
+      int32_t nc = r.i32();
+      for (int32_t c = 0; c < nc; ++c) {
+        LikeComp comp;
+        comp.wild = r.u8() != 0;
+        if (!comp.wild) comp.s = r.str();
+        lt.comps.push_back(std::move(comp));
+      }
+      s.likes.push_back(std::move(lt));
+    }
+    int32_t ncmp = r.i32();
+    for (int32_t j = 0; j < ncmp; ++j) {
+      CmpTest c;
+      c.lit = r.i32();
+      c.op = r.u8();
+      c.c = r.i64();
+      s.cmps.push_back(c);
+    }
+    int32_t nsh = r.i32();
+    for (int32_t j = 0; j < nsh; ++j) {
+      std::string k = r.str();
+      int32_t cnt = r.i32();
+      std::vector<int32_t> lits(size_t(cnt >= 0 ? cnt : 0));
+      for (auto &l : lits) l = r.i32();
+      s.set_has[std::move(k)] = std::move(lits);
+    }
+    t->slots.push_back(std::move(s));
+  }
+  if (!r.ok()) return nullptr;
+  return t.release();
+}
+
+// ------------------------------------------------------- like-glob matcher
+
+// Mirrors cedar_tpu/lang/ast.py _match_components: DP over (component,
+// position); components are literal chunks and wildcards.
+bool like_match(const std::vector<LikeComp> &comps, sv s) {
+  size_t n = s.size();
+  thread_local std::vector<uint8_t> cur, next;
+  cur.assign(n + 1, 0);
+  next.assign(n + 1, 0);
+  cur[0] = 1;
+  for (const auto &comp : comps) {
+    std::fill(next.begin(), next.end(), 0);
+    if (comp.wild) {
+      // wildcard: any reachable position reaches all later positions
+      uint8_t reach = 0;
+      for (size_t i = 0; i <= n; ++i) {
+        reach |= cur[i];
+        next[i] = reach;
+      }
+    } else {
+      size_t m = comp.s.size();
+      for (size_t i = 0; i + m <= n; ++i)
+        if (cur[i] && memcmp(s.data() + i, comp.s.data(), m) == 0)
+          next[i + m] = 1;
+    }
+    std::swap(cur, next);
+  }
+  return cur[n] != 0;
+}
+
+// --------------------------------------------------- canonical value keys
+
+// Must stay byte-identical with _canon() in cedar_tpu/native/__init__.py.
+void canon_str_into(std::string &out, sv s) {
+  out.push_back('s');
+  out.append(s.data(), s.size());
+}
+
+void canon_set_into(std::string &out, std::vector<std::string> &elems) {
+  std::sort(elems.begin(), elems.end());
+  out += "S{";
+  for (size_t i = 0; i < elems.size(); ++i) {
+    if (i) out.push_back('\x1f');
+    out += elems[i];
+  }
+  out.push_back('}');
+}
+
+// record with keys pre-sorted by the caller
+std::string canon_record(
+    std::initializer_list<std::pair<const char *, const std::string *>> fields) {
+  std::string out = "R{";
+  bool first = true;
+  for (const auto &f : fields) {
+    if (!first) out.push_back('\x1f');
+    first = false;
+    out += f.first;
+    out.push_back('\x1d');
+    out += *f.second;
+  }
+  out.push_back('}');
+  return out;
+}
+
+// -------------------------------------------------------- request features
+
+// A slot value: authz-domain values are strings or sets-of-records.
+struct Value {
+  enum Kind { MISSING, STRV, SETV } kind = MISSING;
+  sv str;
+  std::vector<std::string> *elems = nullptr;  // element canon strings
+};
+
+struct Features {
+  // principal
+  sv p_type, p_id;
+  std::vector<std::pair<sv, sv>> p_attrs;  // name / namespace
+  std::vector<sv> groups;
+  std::vector<std::string> extra_elem_canons;
+  bool has_extra = false;
+  // action
+  sv verb;
+  // resource entity
+  sv r_type, r_id;
+  std::vector<std::pair<sv, sv>> r_attrs;
+  std::vector<std::string> label_elem_canons, field_elem_canons;
+  bool has_label = false, has_field = false;
+  // owned storage for composed strings (SA ids, resource paths, lowered keys)
+  std::string own0, own1;
+
+  void reset() {
+    p_attrs.clear();
+    groups.clear();
+    extra_elem_canons.clear();
+    has_extra = false;
+    r_attrs.clear();
+    label_elem_canons.clear();
+    field_elem_canons.clear();
+    has_label = has_field = false;
+    own0.clear();
+    own1.clear();
+    p_type = p_id = verb = r_type = r_id = sv();
+  }
+};
+
+constexpr sv kUser = "k8s::User";
+constexpr sv kGroup = "k8s::Group";
+constexpr sv kSA = "k8s::ServiceAccount";
+constexpr sv kNode = "k8s::Node";
+constexpr sv kPrincipalUID = "k8s::PrincipalUID";
+constexpr sv kExtra = "k8s::Extra";
+constexpr sv kResource = "k8s::Resource";
+constexpr sv kNonResource = "k8s::NonResourceURL";
+constexpr sv kAction = "k8s::Action";
+
+int count_colons(sv s) {
+  int n = 0;
+  for (char c : s)
+    if (c == ':') ++n;
+  return n;
+}
+
+bool starts_with(sv s, sv prefix) {
+  return s.size() >= prefix.size() && s.compare(0, prefix.size(), prefix) == 0;
+}
+
+sv str_field(const JVal *o, sv k) {
+  const JVal *v = o ? o->get(k) : nullptr;
+  return v && v->kind == JVal::STR ? v->str : sv();
+}
+
+// flags returned per request
+enum : uint8_t {
+  F_OK = 0,
+  F_PARSE_ERROR = 1,
+  F_SELF_ALLOW_POLICIES = 2,
+  F_SELF_ALLOW_RBAC = 3,
+  F_SYSTEM_SKIP = 4,
+  F_EXTRAS_OVERFLOW = 5,
+};
+
+constexpr sv kAuthorizerIdentity = "system:authorizer:cedar-authorizer";
+
+bool is_read_only(sv verb) {
+  return verb == "get" || verb == "list" || verb == "watch";
+}
+
+// Build all request features from the parsed SAR. Returns a gate flag or
+// F_OK. Mirrors get_authorizer_attributes + record_to_cedar_resource.
+uint8_t build_features(const JVal *root, Features &f) {
+  const JVal *spec = root->get("spec");
+  if (spec && spec->kind != JVal::OBJ) spec = nullptr;
+
+  sv user_name = str_field(spec, "user");
+  sv user_uid = str_field(spec, "uid");
+
+  const JVal *ra = spec ? spec->get("resourceAttributes") : nullptr;
+  if (ra && ra->kind != JVal::OBJ) ra = nullptr;
+  const JVal *nra = spec ? spec->get("nonResourceAttributes") : nullptr;
+  if (nra && nra->kind != JVal::OBJ) nra = nullptr;
+
+  sv verb, ns, group, version, resource, subresource, name, path;
+  bool resource_request = false;
+  if (ra) {
+    verb = str_field(ra, "verb");
+    ns = str_field(ra, "namespace");
+    group = str_field(ra, "group");
+    version = str_field(ra, "version");
+    resource = str_field(ra, "resource");
+    subresource = str_field(ra, "subresource");
+    name = str_field(ra, "name");
+    resource_request = true;
+  }
+  if (nra) {  // nonResourceAttributes wins last, like the Python builder
+    path = str_field(nra, "path");
+    verb = str_field(nra, "verb");
+    resource_request = false;
+  }
+
+  // ------- authorizer gates (authorizer.go:38-57)
+  if (user_name == kAuthorizerIdentity && is_read_only(verb)) {
+    if (group == "cedar.k8s.aws" && resource == "policies")
+      return F_SELF_ALLOW_POLICIES;
+    if (group == "rbac.authorization.k8s.io") return F_SELF_ALLOW_RBAC;
+  }
+  if (starts_with(user_name, "system:") &&
+      !starts_with(user_name, "system:serviceaccount:") &&
+      !starts_with(user_name, "system:node:"))
+    return F_SYSTEM_SKIP;
+
+  // ------- principal (user.go:35)
+  f.p_type = kUser;
+  sv p_name = user_name;
+  if (starts_with(user_name, "system:node:") && count_colons(user_name) == 2) {
+    f.p_type = kNode;
+    p_name = user_name.substr(strlen("system:node:"));
+  }
+  if (starts_with(user_name, "system:serviceaccount:") &&
+      count_colons(user_name) == 3) {
+    f.p_type = kSA;
+    size_t a = strlen("system:serviceaccount:");
+    size_t b = user_name.find(':', a);
+    f.p_attrs.emplace_back("namespace", user_name.substr(a, b - a));
+    p_name = user_name.substr(b + 1);
+  }
+  f.p_attrs.emplace_back("name", p_name);
+  f.p_id = user_uid.empty() ? user_name : user_uid;
+
+  const JVal *groups = spec ? spec->get("groups") : nullptr;
+  if (groups && groups->kind == JVal::ARR)
+    for (const JVal *g = groups->child; g; g = g->next)
+      if (g->kind == JVal::STR) f.groups.push_back(g->str);
+
+  const JVal *extra = spec ? spec->get("extra") : nullptr;
+  if (extra && extra->kind == JVal::OBJ && extra->child) {
+    f.has_extra = true;
+    for (const JVal *kv = extra->child; kv; kv = kv->next) {
+      // convertExtra lower-cases keys (server.go:205)
+      std::string key = "s";
+      key.reserve(kv->key.size() + 1);
+      for (char c : kv->key)
+        key.push_back(c >= 'A' && c <= 'Z' ? char(c + 32) : c);
+      std::vector<std::string> vals;
+      if (kv->kind == JVal::ARR)
+        for (const JVal *v = kv->child; v; v = v->next)
+          if (v->kind == JVal::STR) {
+            std::string c;
+            canon_str_into(c, v->str);
+            vals.push_back(std::move(c));
+          }
+      std::string vset;
+      canon_set_into(vset, vals);
+      f.extra_elem_canons.push_back(
+          canon_record({{"key", &key}, {"values", &vset}}));
+    }
+  }
+
+  f.verb = verb;
+
+  // ------- resource entity (entitiy_builders.go)
+  if (resource_request && verb == "impersonate") {
+    if (resource == "serviceaccounts") {
+      f.r_type = kSA;
+      f.own0.assign("system:serviceaccount:");
+      f.own0.append(ns.data(), ns.size());
+      f.own0.push_back(':');
+      f.own0.append(name.data(), name.size());
+      f.r_id = f.own0;
+      f.r_attrs.emplace_back("name", name);
+      f.r_attrs.emplace_back("namespace", ns);
+    } else if (resource == "uids") {
+      f.r_type = kPrincipalUID;
+      f.r_id = name;
+    } else if (resource == "users") {
+      f.r_type = kUser;
+      sv rname = name;
+      if (starts_with(name, "system:node:") && count_colons(name) == 2) {
+        f.r_type = kNode;
+        rname = name.substr(strlen("system:node:"));
+      }
+      f.r_attrs.emplace_back("name", rname);
+      f.r_id = name;
+    } else if (resource == "groups") {
+      f.r_type = kGroup;
+      f.r_id = name;
+      f.r_attrs.emplace_back("name", name);
+    } else if (resource == "userextras") {
+      f.r_type = kExtra;
+      f.r_id = subresource;
+      f.r_attrs.emplace_back("key", subresource);
+      if (!name.empty()) f.r_attrs.emplace_back("value", name);
+    } else {
+      f.r_type = sv();
+      f.r_id = sv();
+    }
+  } else if (resource_request) {
+    f.r_type = kResource;
+    std::string &p = f.own0;
+    if (group.empty()) {
+      p.assign("/api/");
+    } else {
+      p.assign("/apis/");
+      p.append(group.data(), group.size());
+      p.push_back('/');
+    }
+    p.append(version.data(), version.size());
+    if (!ns.empty()) {
+      p.append("/namespaces/");
+      p.append(ns.data(), ns.size());
+    }
+    p.push_back('/');
+    p.append(resource.data(), resource.size());
+    if (!name.empty()) {
+      p.push_back('/');
+      p.append(name.data(), name.size());
+    }
+    if (!subresource.empty()) {
+      p.push_back('/');
+      p.append(subresource.data(), subresource.size());
+    }
+    f.r_id = p;
+    f.r_attrs.emplace_back("apiGroup", group);
+    f.r_attrs.emplace_back("resource", resource);
+    if (!name.empty()) f.r_attrs.emplace_back("name", name);
+    if (!subresource.empty()) f.r_attrs.emplace_back("subresource", subresource);
+    if (!ns.empty()) f.r_attrs.emplace_back("namespace", ns);
+
+    // selectors (server.go:221-309)
+    const JVal *ls = ra->get("labelSelector");
+    const JVal *reqs =
+        ls && ls->kind == JVal::OBJ ? ls->get("requirements") : nullptr;
+    if (reqs && reqs->kind == JVal::ARR && reqs->child) {
+      for (const JVal *rq = reqs->child; rq; rq = rq->next) {
+        if (rq->kind != JVal::OBJ) continue;
+        sv op = str_field(rq, "operator");
+        const char *mapped = nullptr;
+        if (op == "In") mapped = "in";
+        else if (op == "NotIn") mapped = "notin";
+        else if (op == "Exists") mapped = "exists";
+        else if (op == "DoesNotExist") mapped = "!";
+        if (!mapped) continue;  // invalid operators dropped
+        std::vector<std::string> vals;
+        const JVal *vv = rq->get("values");
+        if (vv && vv->kind == JVal::ARR)
+          for (const JVal *v = vv->child; v; v = v->next)
+            if (v->kind == JVal::STR) {
+              std::string c;
+              canon_str_into(c, v->str);
+              vals.push_back(std::move(c));
+            }
+        std::string key, ops, vset;
+        canon_str_into(key, str_field(rq, "key"));
+        canon_str_into(ops, mapped);
+        canon_set_into(vset, vals);
+        f.label_elem_canons.push_back(canon_record(
+            {{"key", &key}, {"operator", &ops}, {"values", &vset}}));
+      }
+      f.has_label = !f.label_elem_canons.empty();
+    }
+    const JVal *fs = ra->get("fieldSelector");
+    const JVal *freqs =
+        fs && fs->kind == JVal::OBJ ? fs->get("requirements") : nullptr;
+    if (freqs && freqs->kind == JVal::ARR && freqs->child) {
+      for (const JVal *rq = freqs->child; rq; rq = rq->next) {
+        if (rq->kind != JVal::OBJ) continue;
+        sv op = str_field(rq, "operator");
+        const JVal *vv = rq->get("values");
+        size_t nvals = 0;
+        const JVal *first_val = nullptr;
+        if (vv && vv->kind == JVal::ARR)
+          for (const JVal *v = vv->child; v; v = v->next) {
+            if (!first_val) first_val = v;
+            ++nvals;
+          }
+        const char *mapped = nullptr;
+        if (op == "In" && nvals == 1) mapped = "=";
+        else if (op == "NotIn" && nvals == 1) mapped = "!=";
+        if (!mapped) continue;
+        sv val = first_val && first_val->kind == JVal::STR ? first_val->str : sv();
+        std::string fld, ops, vc;
+        canon_str_into(fld, str_field(rq, "key"));
+        canon_str_into(ops, mapped);
+        canon_str_into(vc, val);
+        f.field_elem_canons.push_back(canon_record(
+            {{"field", &fld}, {"operator", &ops}, {"value", &vc}}));
+      }
+      f.has_field = !f.field_elem_canons.empty();
+    }
+  } else {
+    f.r_type = kNonResource;
+    f.r_id = path;
+    f.r_attrs.emplace_back("path", path);
+  }
+  return F_OK;
+}
+
+// ------------------------------------------------------------ slot lookup
+
+struct ExtrasOut {
+  int32_t *buf;
+  int32_t cap;
+  int32_t n = 0;
+  bool overflow = false;
+  void push(int32_t v) {
+    if (n < cap) buf[n++] = v;
+    else overflow = true;
+  }
+};
+
+Value slot_value(Features &f, const ScalarSlot &s) {
+  Value v;
+  if (s.deep || s.var == 3) return v;  // context is empty for authz; deep
+                                       // paths never resolve in this domain
+  if (s.var == 0) {  // principal
+    for (const auto &kv : f.p_attrs)
+      if (kv.first == s.attr) {
+        v.kind = Value::STRV;
+        v.str = kv.second;
+        return v;
+      }
+    if (s.attr == "extra" && f.has_extra) {
+      v.kind = Value::SETV;
+      v.elems = &f.extra_elem_canons;
+    }
+    return v;
+  }
+  if (s.var == 1) return v;  // action entities carry no attributes
+  // resource
+  for (const auto &kv : f.r_attrs)
+    if (kv.first == s.attr) {
+      v.kind = Value::STRV;
+      v.str = kv.second;
+      return v;
+    }
+  if (s.attr == "labelSelector" && f.has_label) {
+    v.kind = Value::SETV;
+    v.elems = &f.label_elem_canons;
+  } else if (s.attr == "fieldSelector" && f.has_field) {
+    v.kind = Value::SETV;
+    v.elems = &f.field_elem_canons;
+  }
+  return v;
+}
+
+void encode_one(const Table &t, Features &f, int32_t *codes, ExtrasOut &extras,
+                std::string &scratch) {
+  for (int32_t i = 0; i < t.n_slots; ++i) codes[i] = 0;
+
+  const sv types[3] = {f.p_type, kAction, f.r_type};
+  const sv ids[3] = {f.p_id, f.verb, f.r_id};
+
+  const char vtag[3] = {'0', '1', '2'};
+  for (int v = 0; v < 3; ++v) {
+    if (t.type_slot[v] >= 0) {
+      scratch.clear();
+      scratch.push_back(vtag[v]);
+      scratch.push_back('\x1f');
+      scratch.append(types[v].data(), types[v].size());
+      const int32_t *row = sv_find(t.type_map, scratch);
+      codes[t.type_slot[v]] = row ? *row : 0;
+    }
+    if (t.uid_slot[v] >= 0) {
+      scratch.clear();
+      scratch.push_back(vtag[v]);
+      scratch.push_back('\x1f');
+      scratch.append(types[v].data(), types[v].size());
+      scratch.push_back('\x1f');
+      scratch.append(ids[v].data(), ids[v].size());
+      const int32_t *row = sv_find(t.uid_map, scratch);
+      codes[t.uid_slot[v]] = row ? *row : 0;
+    }
+  }
+
+  // principal ancestors: group parent entities (user.go:23-27). Actions and
+  // resources have no parents in the authz domain.
+  if (!t.anc_slots[0].empty() && !f.groups.empty()) {
+    size_t filled = 0;
+    const auto &slots = t.anc_slots[0];
+    for (sv g : f.groups) {
+      scratch.assign("0\x1f");
+      scratch.append(kGroup.data(), kGroup.size());
+      scratch.push_back('\x1f');
+      scratch.append(g.data(), g.size());
+      const auto *entry = sv_find(t.anc_map, scratch);
+      if (!entry || entry->first == 0) continue;
+      if (filled < slots.size()) {
+        codes[slots[filled++]] = entry->first;
+      } else {
+        for (int32_t lid : entry->second) extras.push(lid);
+      }
+    }
+  }
+
+  for (const auto &s : t.slots) {
+    Value v = slot_value(f, s);
+    if (v.kind == Value::MISSING) continue;
+
+    scratch.clear();
+    if (v.kind == Value::STRV) {
+      canon_str_into(scratch, v.str);
+    } else {
+      canon_set_into(scratch, *v.elems);  // sorts elems in place (stable key)
+    }
+    const int32_t *row = sv_find(s.vocab, scratch);
+    if (row) {
+      codes[s.sidx] = *row;
+    } else {
+      codes[s.sidx] = s.present_row;
+      if (v.kind == Value::STRV) {
+        for (const auto &lt : s.likes)
+          if (like_match(lt.comps, v.str)) extras.push(lt.lit);
+        // cmp tests only apply to longs; authz values are strings
+      }
+    }
+    if (v.kind == Value::SETV && !s.set_has.empty()) {
+      for (const auto &ec : *v.elems) {
+        const auto *lits = sv_find(s.set_has, ec);
+        if (lits)
+          for (int32_t lid : *lits) extras.push(lid);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+// ------------------------------------------------------------------ C API
+
+extern "C" {
+
+void *ce_load_table(const uint8_t *blob, uint64_t len) {
+  return load_table(blob, size_t(len));
+}
+
+void ce_free_table(void *handle) { delete static_cast<Table *>(handle); }
+
+// bodies are packed back to back in `buf`; request i spans
+// [offsets[i], offsets[i] + lens[i]). codes: [n, n_slots] int32 (row
+// indices); extras: [n, extras_cap] int32 pre-filled by the CALLER with the
+// pad value; extras_count: [n] int32; flags: [n] uint8 (see F_* above).
+void ce_encode_sar_batch(void *handle, uint64_t n, const uint8_t *buf,
+                         const uint64_t *offsets, const uint64_t *lens,
+                         int32_t *codes, int32_t *extras, int32_t extras_cap,
+                         int32_t *extras_count, uint8_t *flags,
+                         int32_t n_threads) {
+  const Table &t = *static_cast<Table *>(handle);
+  auto work = [&](uint64_t lo, uint64_t hi) {
+    Arena arena;
+    Features f;
+    std::string scratch;
+    for (uint64_t i = lo; i < hi; ++i) {
+      int32_t *c = codes + i * uint64_t(t.n_slots);
+      ExtrasOut eo{extras + i * uint64_t(extras_cap), extras_cap};
+      arena.reset();
+      JsonParser parser((const char *)buf + offsets[i], size_t(lens[i]), arena);
+      JVal *root = parser.parse();
+      if (!root || root->kind != JVal::OBJ) {
+        for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
+        extras_count[i] = 0;
+        flags[i] = F_PARSE_ERROR;
+        continue;
+      }
+      f.reset();
+      uint8_t gate = build_features(root, f);
+      if (gate != F_OK) {
+        for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
+        extras_count[i] = 0;
+        flags[i] = gate;
+        continue;
+      }
+      encode_one(t, f, c, eo, scratch);
+      extras_count[i] = eo.n;
+      flags[i] = eo.overflow ? F_EXTRAS_OVERFLOW : F_OK;
+    }
+  };
+  if (n_threads <= 1 || n < 64) {
+    work(0, n);
+    return;
+  }
+  uint64_t nt = uint64_t(n_threads);
+  if (nt > n) nt = n;
+  std::vector<std::thread> threads;
+  uint64_t chunk = (n + nt - 1) / nt;
+  for (uint64_t k = 0; k < nt; ++k) {
+    uint64_t lo = k * chunk, hi = lo + chunk > n ? n : lo + chunk;
+    if (lo >= hi) break;
+    threads.emplace_back(work, lo, hi);
+  }
+  for (auto &th : threads) th.join();
+}
+
+int32_t ce_n_slots(void *handle) {
+  return static_cast<Table *>(handle)->n_slots;
+}
+
+}  // extern "C"

@@ -76,6 +76,22 @@ class CedarWebhookAuthorizer:
         # pluggable evaluation backend; defaults to tiered interpreter eval
         self._evaluate: EvaluateFn = evaluate or stores.is_authorized
 
+    def ready(self) -> bool:
+        """True once every store reports initial load complete; latches
+        (reference authorizer.go:58-66 — the latch is benignly racy there
+        too)."""
+        if self._stores_loaded:
+            return True
+        for store in self.stores:
+            if not store.initial_policy_load_complete():
+                log.info(
+                    "Policies not yet loaded, returning no opinion: store=%s",
+                    store.name(),
+                )
+                return False
+        self._stores_loaded = True
+        return True
+
     def authorize(self, attributes: Attributes) -> Tuple[str, str]:
         """Returns (decision, reason)."""
         user_name = attributes.user.name
@@ -107,15 +123,8 @@ class CedarWebhookAuthorizer:
         ):
             return DECISION_NO_OPINION, ""
 
-        if not self._stores_loaded:
-            for store in self.stores:
-                if not store.initial_policy_load_complete():
-                    log.info(
-                        "Policies not yet loaded, returning no opinion: store=%s",
-                        store.name(),
-                    )
-                    return DECISION_NO_OPINION, ""
-            self._stores_loaded = True
+        if not self.ready():
+            return DECISION_NO_OPINION, ""
 
         entities, request = record_to_cedar_resource(attributes)
         decision, diagnostic = self._evaluate(entities, request)

@@ -189,9 +189,25 @@ class WebhookServer:
         metrics_port: int = METRICS_PORT,
         certfile: Optional[str] = None,
         keyfile: Optional[str] = None,
+        fastpath=None,
+        batch_window_s: float = 0.0002,
+        max_batch: int = 8192,
     ):
         self.authorizer = authorizer
         self.admission_handler = admission_handler
+        # native SAR fast path (engine/fastpath.py): request threads funnel
+        # raw bodies through a micro-batcher into the C++ encoder + device
+        # matcher; unavailable configurations fall back per request
+        self.fastpath = fastpath
+        self._batcher = None
+        if fastpath is not None:
+            from ..engine.batcher import MicroBatcher
+
+            self._batcher = MicroBatcher(
+                fastpath.authorize_raw,
+                max_batch=max_batch,
+                window_s=batch_window_s,
+            )
         self.error_injector = error_injector or ErrorInjector(None)
         self.recorder = recorder
         self.enable_profiling = enable_profiling
@@ -210,6 +226,28 @@ class WebhookServer:
         request_id = str(uuid.uuid4())
         decision, reason, error = DECISION_NO_OPINION, "", None
         try:
+            try:
+                use_fastpath = (
+                    self._batcher is not None and self.fastpath.available
+                )
+            except Exception:  # noqa: BLE001 — degrade to the python path
+                log.exception("fastpath availability check failed")
+                use_fastpath = False
+            if use_fastpath:
+                try:
+                    decision, reason, error = self._batcher.submit(body)
+                except Exception as e:  # noqa: BLE001 — always answer
+                    log.exception(
+                        "fastpath authorize requestId=%s failed", request_id
+                    )
+                    error = f"evaluation error: {e}"
+                    return sar_response(DECISION_NO_OPINION, "", error)
+                if error is not None:
+                    return sar_response(decision, reason, error)
+                decision, reason, error = self.error_injector.inject_if_enabled(
+                    decision, reason
+                )
+                return sar_response(decision, reason, error)
             try:
                 sar = json.loads(body)
             except (ValueError, TypeError) as e:

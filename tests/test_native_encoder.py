@@ -1,0 +1,366 @@
+"""Differential tests: native (C++) SAR fast path vs the Python pipeline.
+
+The native encoder must produce the same feature codes / extras activations
+as compiler.table.encode_request_codes over the full Python entity pipeline,
+and SARFastPath must produce byte-identical decisions to
+CedarWebhookAuthorizer.authorize, across randomized SubjectAccessReviews
+covering principal typing, impersonation, selectors, non-resource paths,
+and gate short-circuits.
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+from cedar_tpu.engine.fastpath import SARFastPath
+from cedar_tpu.native import F_OK, NativeEncoder, native_available
+from cedar_tpu.compiler.table import encode_request_codes
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+from cedar_tpu.server.http import get_authorizer_attributes
+from cedar_tpu.server.authorizer import record_to_cedar_resource
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain for the native encoder"
+)
+
+POLICIES = """
+permit (
+    principal,
+    action in [k8s::Action::"get", k8s::Action::"list", k8s::Action::"watch"],
+    resource is k8s::Resource
+) when { principal.name == "test-user" && resource.resource == "pods" };
+
+forbid (principal, action, resource is k8s::Resource)
+    when { resource.resource == "nodes" && principal.name like "dev-*" };
+
+permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
+        resource is k8s::Resource)
+    unless { resource.resource == "secrets" && resource.apiGroup == "" };
+
+permit (principal is k8s::ServiceAccount, action, resource is k8s::Resource)
+    when { principal.namespace == "kube-system" };
+
+permit (principal is k8s::Node, action == k8s::Action::"get",
+        resource is k8s::Resource)
+    when { resource has namespace && resource.namespace == "ns-1" };
+
+permit (principal, action == k8s::Action::"get", resource is k8s::NonResourceURL)
+    when { resource.path == "/healthz" };
+
+permit (principal, action == k8s::Action::"get", resource is k8s::NonResourceURL)
+    when { resource.path like "/metrics*" };
+
+permit (principal, action == k8s::Action::"impersonate",
+        resource is k8s::ServiceAccount)
+    when { resource.namespace == "default" };
+
+permit (principal, action == k8s::Action::"impersonate", resource is k8s::Node);
+
+forbid (principal, action in [k8s::Action::"list", k8s::Action::"watch"],
+        resource is k8s::Resource)
+    when {
+        resource.resource == "secrets" &&
+        !(resource has labelSelector &&
+          resource.labelSelector.contains({
+              key: "confidentiality", operator: "in", values: ["public"]}))
+    };
+
+permit (principal == k8s::User::"exact-uid-user", action, resource is k8s::Resource)
+    when { resource.resource == "configmaps" };
+
+permit (principal, action, resource is k8s::Resource)
+    when { ["pods", "services"].contains(resource.resource) &&
+           principal.name == "multi" };
+"""
+
+
+def _policy_tiers():
+    return [PolicySet.from_source(POLICIES, "native-test")]
+
+
+USERS = [
+    {"user": "test-user", "uid": "u1", "groups": ["viewers", "devs"]},
+    {"user": "dev-alice", "uid": "", "groups": ["devs"]},
+    {"user": "multi", "uid": "m", "groups": []},
+    {"user": "exact-uid-user", "uid": "exact-uid-user", "groups": ["g%d" % i for i in range(12)]},
+    {"user": "system:serviceaccount:kube-system:builder", "uid": "sa9",
+     "groups": ["system:serviceaccounts"]},
+    {"user": "system:serviceaccount:default:app", "uid": "", "groups": []},
+    {"user": "system:node:node-7", "uid": "n7", "groups": ["system:nodes"]},
+    {"user": "system:kube-scheduler", "uid": "", "groups": []},  # gate: skip
+    {"user": "system:authorizer:cedar-authorizer", "uid": "", "groups": []},
+    {"user": "üser-ünïcode", "uid": "", "groups": ["tëam"]},
+]
+
+RESOURCES = ["pods", "nodes", "secrets", "configmaps", "services", "zzz"]
+VERBS = ["get", "list", "watch", "create", "delete", "impersonate"]
+NAMESPACES = ["", "default", "ns-1", "kube-system"]
+
+
+def _random_sar(rng: random.Random) -> dict:
+    user = rng.choice(USERS)
+    spec = {
+        "user": user["user"],
+        "uid": user["uid"],
+        "groups": list(user["groups"]),
+    }
+    if rng.random() < 0.3:
+        spec["extra"] = {
+            "Authentication.K8s.IO/Node-Name": ["node-%d" % rng.randint(0, 3)],
+            "scopes": ["a", "b"] if rng.random() < 0.5 else [],
+        }
+    kind = rng.random()
+    if kind < 0.15:  # non-resource
+        spec["nonResourceAttributes"] = {
+            "path": rng.choice(["/healthz", "/metrics", "/metrics/cadvisor", "/version"]),
+            "verb": rng.choice(["get", "post"]),
+        }
+    else:
+        verb = rng.choice(VERBS)
+        ra = {
+            "verb": verb,
+            "version": "v1",
+            "resource": rng.choice(
+                ["serviceaccounts", "users", "groups", "uids", "userextras", "pods"]
+            )
+            if verb == "impersonate"
+            else rng.choice(RESOURCES),
+            "group": rng.choice(["", "apps", "cedar.k8s.aws", "rbac.authorization.k8s.io"]),
+        }
+        ns = rng.choice(NAMESPACES)
+        if ns:
+            ra["namespace"] = ns
+        if rng.random() < 0.5:
+            ra["name"] = rng.choice(["app-1", "system:node:node-7", "policies"])
+        if rng.random() < 0.3:
+            ra["subresource"] = rng.choice(["status", "log", "node-name"])
+        if rng.random() < 0.35:
+            ra["labelSelector"] = {
+                "requirements": [
+                    {
+                        "key": "confidentiality",
+                        "operator": rng.choice(
+                            ["In", "NotIn", "Exists", "DoesNotExist", "Bogus"]
+                        ),
+                        "values": rng.choice(
+                            [["public"], ["secret", "public"], []]
+                        ),
+                    }
+                ]
+            }
+        if rng.random() < 0.2:
+            ra["fieldSelector"] = {
+                "requirements": [
+                    {
+                        "key": "spec.nodeName",
+                        "operator": rng.choice(["In", "NotIn", "Exists"]),
+                        "values": rng.choice([["node-7"], ["a", "b"], []]),
+                    }
+                ]
+            }
+        spec["resourceAttributes"] = ra
+    return {
+        "apiVersion": "authorization.k8s.io/v1",
+        "kind": "SubjectAccessReview",
+        "spec": spec,
+    }
+
+
+def _gate_flag_expected(sar: dict) -> bool:
+    """True when the Python authorizer would short-circuit before encoding."""
+    spec = sar.get("spec", {})
+    name = spec.get("user", "")
+    if name.startswith("system:") and not name.startswith(
+        ("system:serviceaccount:", "system:node:")
+    ):
+        return True
+    return name == "system:authorizer:cedar-authorizer"
+
+
+def test_encoder_parity_randomized():
+    engine = TPUPolicyEngine()
+    engine.load(_policy_tiers())
+    packed = engine._compiled.packed
+    encoder = NativeEncoder.create(packed)
+    assert encoder is not None
+
+    rng = random.Random(7)
+    sars = [_random_sar(rng) for _ in range(600)]
+    bodies = [json.dumps(s).encode() for s in sars]
+    codes, extras, counts, flags = encoder.encode_batch(bodies)
+
+    for i, sar in enumerate(sars):
+        if _gate_flag_expected(sar):
+            assert flags[i] != F_OK, f"expected gate flag for {sar}"
+            continue
+        assert flags[i] == F_OK, f"unexpected flag {flags[i]} for {sar}"
+        attrs = get_authorizer_attributes(sar)
+        em, req = record_to_cedar_resource(attrs)
+        py_codes, py_extras = encode_request_codes(
+            packed.plan, packed.table, em, req
+        )
+        assert codes[i].tolist() == py_codes, f"codes mismatch for {sar}"
+        native_extras = set(extras[i, : counts[i]].tolist())
+        assert native_extras == set(py_extras), f"extras mismatch for {sar}"
+
+
+def test_fastpath_decision_parity():
+    engine = TPUPolicyEngine()
+    engine.load(_policy_tiers())
+    stores = TieredPolicyStores(
+        [MemoryStore.from_source("t0", POLICIES)]
+    )
+    authorizer = CedarWebhookAuthorizer(stores)
+    tpu_authorizer = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, tpu_authorizer)
+    assert fastpath.available
+
+    rng = random.Random(21)
+    sars = [_random_sar(rng) for _ in range(400)]
+    bodies = [json.dumps(s).encode() for s in sars]
+    results = fastpath.authorize_raw(bodies)
+
+    for sar, (decision, reason, error) in zip(sars, results):
+        assert error is None
+        attrs = get_authorizer_attributes(sar)
+        exp_decision, exp_reason = authorizer.authorize(attrs)
+        assert decision == exp_decision, (
+            f"decision mismatch for {sar}: fast={decision} py={exp_decision}"
+        )
+        # reasons carry policy ids; presence must agree (ordering of multiple
+        # matches is not a contract — cedar-go map iteration isn't either)
+        assert bool(reason) == bool(exp_reason), f"reason mismatch for {sar}"
+
+
+def test_fastpath_parse_error_falls_back():
+    engine = TPUPolicyEngine()
+    engine.load(_policy_tiers())
+    stores = TieredPolicyStores([MemoryStore.from_source("t0", POLICIES)])
+    authorizer = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, authorizer)
+    res = fastpath.authorize_raw([b"{not json", b'{"spec": {"user": "x"}}'])
+    assert res[0][0] == "no_opinion"
+    assert res[0][1] == "Encountered decoding error"
+    assert "failed parsing request body" in res[0][2]
+    assert res[1][0] in ("allow", "deny", "no_opinion")
+    assert res[1][2] is None
+
+
+def test_fastpath_unready_stores():
+    class NeverReady(MemoryStore):
+        def initial_policy_load_complete(self):
+            return False
+
+    engine = TPUPolicyEngine()
+    engine.load(_policy_tiers())
+    stores = TieredPolicyStores([NeverReady.from_source("t0", POLICIES)])
+    authorizer = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, authorizer)
+    body = json.dumps(_random_sar(random.Random(0))).encode()
+    assert fastpath.authorize_raw([body])[0] == ("no_opinion", "", None)
+
+
+def test_fastpath_unsupported_value_kinds_fall_back():
+    """Policies with decimal/ip constants can't ride the native canon format;
+    the fast path must degrade to the exact Python pipeline, not crash."""
+    src = (
+        POLICIES
+        + '\npermit (principal, action, resource is k8s::Resource)'
+        + ' when { resource.cost == decimal("1.5") };'
+    )
+    tiers = [PolicySet.from_source(src, "dec-test")]
+    engine = TPUPolicyEngine()
+    engine.load(tiers)
+    stores = TieredPolicyStores([MemoryStore.from_source("t0", src)])
+    authorizer = CedarWebhookAuthorizer(stores)
+    tpu_auth = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, tpu_auth)
+    rng = random.Random(11)
+    sars = [_random_sar(rng) for _ in range(60)]
+    results = fastpath.authorize_raw([json.dumps(s).encode() for s in sars])
+    for sar, (decision, _reason, _err) in zip(sars, results):
+        exp_decision, _ = authorizer.authorize(get_authorizer_attributes(sar))
+        assert decision == exp_decision, f"mismatch for {sar}"
+
+
+def test_microbatcher_batches_and_returns_in_order():
+    import threading
+
+    from cedar_tpu.engine.batcher import MicroBatcher
+
+    calls = []
+
+    def fn(items):
+        calls.append(len(items))
+        return [i * 2 for i in items]
+
+    mb = MicroBatcher(fn, max_batch=64, window_s=0.005)
+    results = {}
+
+    def worker(i):
+        results[i] = mb.submit(i)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(40)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mb.stop()
+    assert results == {i: i * 2 for i in range(40)}
+    # the forming window should have coalesced concurrent submitters
+    assert max(calls) > 1
+
+
+def test_microbatcher_propagates_errors():
+    from cedar_tpu.engine.batcher import MicroBatcher
+
+    def fn(items):
+        raise ValueError("boom")
+
+    mb = MicroBatcher(fn, window_s=0.0001)
+    with pytest.raises(ValueError):
+        mb.submit(1)
+    mb.stop()
+
+
+def test_webhook_server_uses_fastpath():
+    """handle_authorize through the fastpath yields the same SAR response
+    JSON as the pure-python handler."""
+    from cedar_tpu.server.admission import (
+        CedarAdmissionHandler,
+        allow_all_admission_policy_store,
+    )
+    from cedar_tpu.server.http import WebhookServer
+
+    engine = TPUPolicyEngine()
+    engine.load(_policy_tiers())
+    stores = TieredPolicyStores([MemoryStore.from_source("t0", POLICIES)])
+    authorizer = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    admission = CedarAdmissionHandler(
+        TieredPolicyStores(
+            [MemoryStore.from_source("t0", POLICIES),
+             allow_all_admission_policy_store()]
+        ),
+        allow_on_error=True,
+    )
+    fast_server = WebhookServer(
+        authorizer=authorizer,
+        admission_handler=admission,
+        fastpath=SARFastPath(engine, authorizer),
+    )
+    plain_server = WebhookServer(authorizer=authorizer, admission_handler=admission)
+    rng = random.Random(5)
+    try:
+        for _ in range(50):
+            body = json.dumps(_random_sar(rng)).encode()
+            a = fast_server.handle_authorize(body)
+            b = plain_server.handle_authorize(body)
+            assert a["status"]["allowed"] == b["status"]["allowed"]
+            assert a["status"].get("denied") == b["status"].get("denied")
+    finally:
+        fast_server._batcher.stop()
