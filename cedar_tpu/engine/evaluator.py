@@ -62,6 +62,7 @@ from ..ops.match import (
     POLICY_NONE,
     chunk_rules,
     match_rules_codes,
+    match_rules_codes_pallas,
 )
 
 _BATCH_BUCKETS = (1, 8, 32, 128, 512, 1024, 2048, 4096, 8192, 16384, 32768)
@@ -81,7 +82,7 @@ def _round_bucket(n: int, buckets) -> int:
 class _CompiledSet:
     """Immutable device-resident compiled policy set (the swap unit)."""
 
-    def __init__(self, packed: PackedPolicySet, device=None):
+    def __init__(self, packed: PackedPolicySet, device=None, use_pallas=False):
         self.packed = packed
         kwargs = {"device": device} if device is not None else {}
         W3, thresh_c, group_c, policy_c = chunk_rules(
@@ -97,12 +98,45 @@ class _CompiledSet:
         # the per-request transfer
         self.active_dtype = np.int16 if packed.L < 32767 else np.int32
         self.code_dtype = packed.table.code_dtype
+        # optional pallas layout: unchunked [L, R] W + [1, R] rule tensors
+        # for the fused match kernel (ops/pallas_match.py)
+        self.pallas_args = None
+        if use_pallas:
+            from ..ops.pallas_match import pallas_supported
+
+            if pallas_supported(0, packed.L, packed.R):
+                self.pallas_args = (
+                    jax.device_put(
+                        jax.numpy.asarray(packed.W, jax.numpy.bfloat16),
+                        **kwargs,
+                    ),
+                    jax.device_put(packed.thresh[None, :], **kwargs),
+                    jax.device_put(packed.rule_group[None, :], **kwargs),
+                    jax.device_put(packed.rule_policy[None, :], **kwargs),
+                )
 
 
 class TPUPolicyEngine:
-    def __init__(self, schema: Optional[SchemaInfo] = None, device=None):
+    def __init__(
+        self,
+        schema: Optional[SchemaInfo] = None,
+        device=None,
+        use_pallas: Optional[bool] = None,
+    ):
+        import os
+
         self.schema = schema or AUTHZ_SCHEMA_INFO
         self.device = device
+        if use_pallas is None:
+            use_pallas = os.environ.get("CEDAR_TPU_PALLAS", "0") == "1"
+        # interpret mode lets the pallas path run (and be tested) on CPU;
+        # other non-TPU backends (e.g. GPU) can't lower the Mosaic kernel —
+        # keep the XLA path there
+        backend = jax.default_backend()
+        self._pallas_interpret = backend == "cpu"
+        if use_pallas and backend not in ("cpu", "tpu", "axon"):
+            use_pallas = False
+        self.use_pallas = use_pallas
         self._compiled: Optional[_CompiledSet] = None
         self._lock = threading.Lock()
 
@@ -115,7 +149,7 @@ class TPUPolicyEngine:
             raise ValueError("TPUPolicyEngine.load: at least one tier required")
         compiled: CompiledPolicies = lower_tiers(list(tiers), self.schema)
         packed = pack(compiled)
-        new = _CompiledSet(packed, self.device)
+        new = _CompiledSet(packed, self.device, use_pallas=self.use_pallas)
         with self._lock:
             self._compiled = new
         return {**compiled.stats(), "L": packed.L, "R": packed.R}
@@ -213,6 +247,18 @@ class TPUPolicyEngine:
                 )
                 pe[:m] = chunk_e
                 chunk_c, chunk_e = pc, pe
+            if cs.pallas_args is not None:
+                # L/R were validated at load time; only B varies per call
+                if B % 256 == 0 or B in (8, 16, 32, 64, 128):
+                    return match_rules_codes_pallas(
+                        chunk_c,
+                        chunk_e,
+                        cs.act_rows_dev,
+                        *cs.pallas_args,
+                        packed.n_tiers,
+                        want_full,
+                        self._pallas_interpret,
+                    )
             return match_rules_codes(
                 chunk_c, chunk_e, *args, packed.n_tiers, want_full
             )

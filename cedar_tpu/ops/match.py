@@ -176,6 +176,35 @@ def match_rules_codes(
     return (packed, first) if want_full else (packed, None)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("n_tiers", "want_full", "interpret")
+)
+def match_rules_codes_pallas(
+    codes,
+    extras,
+    act_rows,
+    W2,
+    thresh_r,
+    group_r,
+    policy_r,
+    n_tiers: int,
+    want_full: bool,
+    interpret: bool = False,
+):
+    """Pallas-kernel variant of match_rules_codes: the scores matmul and the
+    per-group first-match reduction run fused in VMEM (ops/pallas_match.py),
+    so the [B, R] score matrix never reaches HBM. Layouts: W2 [L, R] bf16
+    (unchunked), thresh_r/group_r/policy_r [1, R]."""
+    from .pallas_match import pallas_first_match
+
+    lit = _lit_matrix_codes(codes, extras, act_rows)
+    first = pallas_first_match(
+        lit, W2, thresh_r, group_r, policy_r, n_tiers * _GPT, interpret
+    )
+    packed = _tier_walk(first, n_tiers)
+    return (packed, first) if want_full else (packed, None)
+
+
 @functools.partial(jax.jit, static_argnames=("n_groups",))
 def match_rules_compact(active, W_chunks, thresh_c, group_c, policy_c, n_groups: int):
     """Full per-(tier, effect) first-match matrix [B, G] int32; INT32_MAX

@@ -1,0 +1,163 @@
+"""Pallas TPU kernel: fused rule-match + first-match reduction.
+
+The XLA path (ops/match.py) computes scores = lit @ W, then derives
+per-(tier, effect) first-match policy indices with G masked min-reductions —
+each a separate pass over the [B, Rc] f32 score matrix, which XLA may
+materialize to HBM between passes. This kernel fuses the matmul epilogue:
+score tiles live only in VMEM/registers, the satisfaction compare and all G
+group-min reductions happen right after the MXU contraction, and the only
+HBM output is the tiny [B, G] first-match matrix.
+
+Grid: (B tiles, R tiles, L tiles) with the L (contraction) dimension
+innermost; an f32 VMEM scratch accumulates partial scores across L tiles,
+and an int32 VMEM scratch carries the running per-group minima across R
+tiles for each B tile. Rules are padded with thresh=1e9 (never satisfied),
+so padding never contributes a match — same invariant as the XLA path.
+
+Layouts (host side, prepared once per compiled policy set):
+  lit     [B, L]  bfloat16   {0, 1} literal activation matrix
+  W       [L, R]  bfloat16   +1 required-true / -1 required-false
+  thresh  [1, R]  float32    positive-literal count (1e9 padding)
+  group   [1, R]  int32      tier * 3 + effect group id
+  policy  [1, R]  int32      policy metadata index (INT32_MAX padding)
+Returns first [B, G] int32 (INT32_MAX = no match), identical to
+ops.match._first_match.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+INT32_MAX = 2**31 - 1
+
+# tile sizes: TB x TK lit tile (1MB bf16), TK x TR W tile (2MB bf16),
+# TB x TR f32 score tile (512KB) -> comfortably inside ~16MB VMEM with
+# double buffering
+_TB = 256
+_TR = 512
+_TK = 2048
+
+
+def _kernel(
+    lit_ref, w_ref, thresh_ref, group_ref, policy_ref, out_ref,
+    score_ref, acc_ref, *, n_groups: int, g_pad: int
+):
+    k = pl.program_id(2)
+    nk = pl.num_programs(2)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(k == 0)
+    def _():
+        score_ref[:] = jnp.zeros_like(score_ref)
+
+    @pl.when(jnp.logical_and(j == 0, k == 0))
+    def _():
+        acc_ref[:] = jnp.full_like(acc_ref, INT32_MAX)
+
+    # MXU contraction for this (B, R, L) tile, f32 accumulation in VMEM
+    score_ref[:] += jnp.dot(
+        lit_ref[:], w_ref[:], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(k == nk - 1)
+    def _():
+        # fused epilogue: satisfaction + per-group first-match minima,
+        # all in VMEM — the score matrix never reaches HBM. All operands
+        # kept 2D (TPU vector layout).
+        sat = score_ref[:] >= thresh_ref[0:1, :]  # [TB, TR]
+        masked = jnp.where(
+            sat, jnp.broadcast_to(policy_ref[0:1, :], sat.shape), INT32_MAX
+        )
+        grp = group_ref[0:1, :]  # [1, TR]
+        tb = masked.shape[0]
+        mins = []
+        for g in range(n_groups):  # static unroll; G = 3 * tiers, tiny
+            mins.append(
+                jnp.min(
+                    jnp.where(grp == g, masked, INT32_MAX),
+                    axis=1,
+                    keepdims=True,
+                )
+            )
+        for g in range(n_groups, g_pad):
+            mins.append(jnp.full((tb, 1), INT32_MAX, jnp.int32))
+        tile_min = jnp.concatenate(mins, axis=1)  # [TB, g_pad]
+        acc_ref[:] = jnp.minimum(acc_ref[:], tile_min)
+
+    @pl.when(jnp.logical_and(j == nj - 1, k == nk - 1))
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_groups", "interpret")
+)
+def pallas_first_match(
+    lit, W, thresh_r, group_r, policy_r, n_groups: int, interpret: bool = False
+):
+    """lit [B, L] bf16, W [L, R] bf16, thresh_r/group_r/policy_r [1, R].
+    Returns first [B, n_groups] int32. Shapes must tile: B % TB == 0 (or
+    B <= TB), R % TR == 0, L % TK == 0 (or L <= TK)."""
+    B, L = lit.shape
+    R = W.shape[1]
+    tb = min(_TB, B)
+    tk = min(_TK, L)
+    tr = min(_TR, R)
+    g_pad = -(-n_groups // 8) * 8  # int32 sublane-friendly output width
+
+    grid = (B // tb, R // tr, L // tk)
+    kernel = functools.partial(_kernel, n_groups=n_groups, g_pad=g_pad)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, g_pad), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (tb, tk), lambda i, j, k: (i, k), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (tk, tr), lambda i, j, k: (k, j), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, tr), lambda i, j, k: (0, j), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, tr), lambda i, j, k: (0, j), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, tr), lambda i, j, k: (0, j), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tb, g_pad), lambda i, j, k: (i, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tb, tr), jnp.float32),
+            pltpu.VMEM((tb, g_pad), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * B * L * R,
+            bytes_accessed=B * L * 2 + L * R * 2 + B * g_pad * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(lit, W, thresh_r, group_r, policy_r)
+    return out[:, :n_groups]
+
+
+def pallas_supported(B: int, L: int, R: int) -> bool:
+    """Shapes the kernel tiles cleanly; callers fall back to XLA otherwise."""
+    ok_b = B % _TB == 0 or B in (8, 16, 32, 64, 128)
+    ok_l = L % _TK == 0 or (L <= _TK and L % 128 == 0)
+    ok_r = R % _TR == 0 or (R <= _TR and R % 128 == 0)
+    return ok_b and ok_l and ok_r
