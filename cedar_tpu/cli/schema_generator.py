@@ -48,9 +48,8 @@ def generate_schema(
 ) -> CedarSchema:
     schema = CedarSchema()
     if source_schema:
-        raise NotImplementedError(
-            "loading a source schema JSON is not supported yet"
-        )
+        # seed from a previously generated schema JSON (merge-in workflow)
+        schema = CedarSchema.from_json(source_schema)
 
     schema.namespaces[authorization_ns] = k8s.get_authorization_namespace(
         authorization_ns, authorization_ns, authorization_ns
@@ -116,6 +115,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="Directory of recorded <api>.schema.json/<api>.resourcelist.json "
         "OpenAPI fixtures (offline replacement for the live /openapi/v3)",
     )
+    parser.add_argument(
+        "--source-schema",
+        default="",
+        help="Seed from a previously generated schema JSON before adding "
+        "namespaces (merge-in workflow)",
+    )
     parser.add_argument("--output", default="", help="File to write schema to")
     parser.add_argument(
         "--format",
@@ -131,6 +136,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             action_ns=args.admission_action_namespace,
             admission=args.admission,
             openapi_dir=args.openapi_dir or None,
+            source_schema=(
+                json.loads(pathlib.Path(args.source_schema).read_text())
+                if args.source_schema
+                else None
+            ),
         )
     except ValueError as e:
         print(str(e), file=sys.stderr)

@@ -248,8 +248,9 @@ class TPUPolicyEngine:
                 pe[:m] = chunk_e
                 chunk_c, chunk_e = pc, pe
             if cs.pallas_args is not None:
-                # L/R were validated at load time; only B varies per call
-                if B % 256 == 0 or B in (8, 16, 32, 64, 128):
+                from ..ops.pallas_match import pallas_supported
+
+                if pallas_supported(B, packed.L, packed.R):
                     return match_rules_codes_pallas(
                         chunk_c,
                         chunk_e,

@@ -36,6 +36,10 @@ class AttributeElement:
             out["name"] = self.name
         return out
 
+    @classmethod
+    def from_json(cls, doc: dict) -> "AttributeElement":
+        return cls(type=doc.get("type", ""), name=doc.get("name", ""))
+
 
 @dataclass
 class Attribute:
@@ -65,6 +69,21 @@ class Attribute:
             out["attributes"] = {}
         return out
 
+    @classmethod
+    def from_json(cls, doc: dict) -> "Attribute":
+        elem = doc.get("element")
+        return cls(
+            type=doc.get("type", ""),
+            name=doc.get("name", ""),
+            required=bool(doc.get("required", False)),
+            element=AttributeElement.from_json(elem) if elem else None,
+            attributes={
+                k: Attribute.from_json(v)
+                for k, v in (doc.get("attributes") or {}).items()
+            },
+            annotations=dict(doc.get("annotations") or {}),
+        )
+
 
 @dataclass
 class EntityShape:
@@ -79,6 +98,17 @@ class EntityShape:
         out["type"] = self.type
         out["attributes"] = {k: v.to_json() for k, v in self.attributes.items()}
         return out
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "EntityShape":
+        return cls(
+            type=doc.get("type", RECORD_TYPE),
+            attributes={
+                k: Attribute.from_json(v)
+                for k, v in (doc.get("attributes") or {}).items()
+            },
+            annotations=dict(doc.get("annotations") or {}),
+        )
 
 
 @dataclass
@@ -95,6 +125,14 @@ class Entity:
         if self.member_of_types:
             out["memberOfTypes"] = list(self.member_of_types)
         return out
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "Entity":
+        return cls(
+            shape=EntityShape.from_json(doc.get("shape") or {}),
+            member_of_types=list(doc.get("memberOfTypes") or []),
+            annotations=dict(doc.get("annotations") or {}),
+        )
 
 
 @dataclass
@@ -120,6 +158,15 @@ class ActionAppliesTo:
             out["context"] = self.context.to_json()
         return out
 
+    @classmethod
+    def from_json(cls, doc: dict) -> "ActionAppliesTo":
+        ctx = doc.get("context")
+        return cls(
+            principal_types=list(doc.get("principalTypes") or []),
+            resource_types=list(doc.get("resourceTypes") or []),
+            context=EntityShape.from_json(ctx) if ctx else None,
+        )
+
 
 @dataclass
 class ActionShape:
@@ -135,6 +182,17 @@ class ActionShape:
         if self.member_of:
             out["memberOf"] = [m.to_json() for m in self.member_of]
         return out
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "ActionShape":
+        return cls(
+            applies_to=ActionAppliesTo.from_json(doc.get("appliesTo") or {}),
+            member_of=[
+                ActionMember(id=m.get("id", ""))
+                for m in (doc.get("memberOf") or [])
+            ],
+            annotations=dict(doc.get("annotations") or {}),
+        )
 
 
 @dataclass
@@ -158,6 +216,24 @@ class Namespace:
             }
         return out
 
+    @classmethod
+    def from_json(cls, doc: dict) -> "Namespace":
+        return cls(
+            entity_types={
+                k: Entity.from_json(v)
+                for k, v in (doc.get("entityTypes") or {}).items()
+            },
+            actions={
+                k: ActionShape.from_json(v)
+                for k, v in (doc.get("actions") or {}).items()
+            },
+            common_types={
+                k: EntityShape.from_json(v)
+                for k, v in (doc.get("commonTypes") or {}).items()
+            },
+            annotations=dict(doc.get("annotations") or {}),
+        )
+
 
 class CedarSchema:
     """namespace name → Namespace."""
@@ -173,6 +249,13 @@ class CedarSchema:
 
     def to_json(self) -> dict:
         return {k: v.to_json() for k, v in self.namespaces.items()}
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "CedarSchema":
+        schema = cls()
+        for name, ns_doc in doc.items():
+            schema.namespaces[name] = Namespace.from_json(ns_doc or {})
+        return schema
 
     def sort_action_entities(self) -> None:
         for ns in self.namespaces.values():
