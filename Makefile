@@ -1,0 +1,113 @@
+# cedar_tpu build/test/demo targets (role parity with the reference
+# Makefile: build, test, schema generation, policy validation/formatting,
+# kind demo wiring).
+
+PYTHON ?= python
+IMAGE ?= cedar-tpu-webhook:latest
+# Recorded OpenAPI fixtures for full-schema generation. Defaults to the
+# mounted reference snapshot; point FIXTURES at any directory of
+# <api>.schema.json/<api>.resourcelist.json recordings (or at a live
+# cluster's recordings) elsewhere.
+FIXTURES ?= /root/reference/internal/schema/convert/testdata
+CERT_DIR ?= mount/certs
+
+.PHONY: all
+all: native test
+
+##@ Build
+
+.PHONY: native
+native: ## Compile the C++ SAR fast-path encoder
+	$(PYTHON) -c "from cedar_tpu.native.build import ensure_built; print(ensure_built())"
+
+.PHONY: image
+image: ## Build the webhook container image
+	docker build -t $(IMAGE) .
+
+##@ Test
+
+.PHONY: test
+test: ## Run the unit + differential test suite (virtual CPU devices)
+	$(PYTHON) -m pytest tests/ -q
+
+.PHONY: bench
+bench: ## Run the headline benchmark on the attached device
+	$(PYTHON) bench.py
+
+.PHONY: graft-check
+graft-check: ## Compile-check the jittable entry + multi-chip dry run
+	$(PYTHON) __graft_entry__.py
+
+##@ Schema & policies
+
+.PHONY: generate-schemas
+generate-schemas: ## Regenerate cedarschema/ artifacts
+	@test -d $(FIXTURES) || { \
+	  echo "FIXTURES=$(FIXTURES) not found; point FIXTURES at a directory" \
+	       "of recorded OpenAPI <api>.schema.json/<api>.resourcelist.json"; \
+	  exit 1; }
+	$(PYTHON) -m cedar_tpu.cli.schema_generator --no-admission \
+	    --format cedarschema --output cedarschema/k8s-authorization.cedarschema
+	$(PYTHON) -m cedar_tpu.cli.schema_generator --no-admission \
+	    --format json --output cedarschema/k8s-authorization.cedarschema.json
+	$(PYTHON) -m cedar_tpu.cli.schema_generator --openapi-dir $(FIXTURES) \
+	    --format cedarschema --output cedarschema/k8s-full.cedarschema
+	$(PYTHON) -m cedar_tpu.cli.schema_generator --openapi-dir $(FIXTURES) \
+	    --format json --output cedarschema/k8s-full.cedarschema.json
+
+.PHONY: validate-policies
+validate-policies: ## Validate every .cedar file against the full schema
+	$(PYTHON) -m cedar_tpu.cli.validator \
+	    --schema cedarschema/k8s-full.cedarschema.json \
+	    $$(find . -name '*.cedar' -not -path './.git/*')
+
+.PHONY: convert-rbac
+convert-rbac: ## Convert the cluster's RBAC to Cedar (needs kubeconfig)
+	$(PYTHON) -m cedar_tpu.cli.converter clusterrolebindings --output cedar
+
+##@ Demo
+
+.PHONY: demo-server
+demo-server: ## Run the webhook locally against the demo policies
+	mkdir -p /tmp/cedar-demo/policies
+	$(PYTHON) -c "import yaml,pathlib; \
+	  docs=[d for p in ('demo/authorization-policy.yaml',) \
+	        for d in yaml.safe_load_all(open(p)) if d]; \
+	  pathlib.Path('/tmp/cedar-demo/policies/demo.cedar').write_text( \
+	      chr(10).join(d['spec']['content'] for d in docs))"
+	printf 'apiVersion: cedar.k8s.aws/v1alpha1\nkind: StoreConfig\nspec:\n  stores:\n    - type: "directory"\n      directoryStore:\n        path: "/tmp/cedar-demo/policies"\n' \
+	    > /tmp/cedar-demo/config.yaml
+	$(PYTHON) -m cedar_tpu.cli.webhook --config /tmp/cedar-demo/config.yaml \
+	    --backend tpu --cert-dir /tmp/cedar-demo/certs
+
+.PHONY: demo-policies
+demo-policies: ## Render demo/*.yaml Policy content into mount/policies/
+	$(PYTHON) -c "import yaml,pathlib; \
+	  docs=[d for d in yaml.safe_load_all(open('demo/authorization-policy.yaml')) if d]; \
+	  pathlib.Path('mount/policies/demo.cedar').write_text( \
+	      chr(10).join(d['spec']['content'] for d in docs))"
+
+.PHONY: kind
+kind: image demo-policies ## Create a kind cluster serving the webhook static pod
+	kind create cluster --config kind.yaml
+	kind load docker-image $(IMAGE)
+	kubectl apply -k config/default
+	@echo "webhook static pod manifest is mounted at"
+	@echo "/etc/kubernetes/manifests/ (see kind.yaml extraMounts); policies"
+	@echo "live in mount/policies/ (directory store, 1m refresh)"
+
+.PHONY: deploy-admission-webhook
+deploy-admission-webhook: ## Apply the ValidatingWebhookConfiguration with the serving CA injected
+	@test -f $(CERT_DIR)/cedar-authorizer-server.crt || { \
+	  echo "no serving cert at $(CERT_DIR)/cedar-authorizer-server.crt (start the" \
+	       "webhook once to self-sign, or set CERT_DIR)"; exit 1; }
+	sed "s/CA_BUNDLE/$$(base64 -w0 < $(CERT_DIR)/cedar-authorizer-server.crt)/" \
+	    manifests/admission-webhook.yaml | kubectl apply -f -
+
+##@ General
+
+.PHONY: help
+help: ## Show this help
+	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_0-9-]+:.*?##/ \
+	  { printf "  \033[36m%-22s\033[0m %s\n", $$1, $$2 } /^##@/ \
+	  { printf "\n\033[1m%s\033[0m\n", substr($$0, 5) }' $(MAKEFILE_LIST)

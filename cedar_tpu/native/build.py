@@ -20,7 +20,10 @@ _LOCK = threading.Lock()
 
 
 def _source_hash() -> str:
-    return hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    arch = os.environ.get("CEDAR_NATIVE_ARCH", "native")
+    h = hashlib.sha256(_SRC.read_bytes())
+    h.update(arch.encode())
+    return h.hexdigest()[:16]
 
 
 def library_path() -> pathlib.Path:
@@ -37,11 +40,15 @@ def ensure_built() -> pathlib.Path:
             return out
         _BUILD_DIR.mkdir(exist_ok=True)
         cxx = os.environ.get("CXX", "g++")
+        # CEDAR_NATIVE_ARCH=x86-64 (etc.) builds a portable binary — set it
+        # for container images so the .so survives a host-CPU change; the
+        # default tunes for the build machine
+        arch = os.environ.get("CEDAR_NATIVE_ARCH", "native")
         tmp = out.with_suffix(".so.tmp")
         cmd = [
             cxx,
             "-O3",
-            "-march=native",
+            f"-march={arch}",
             "-fno-plt",
             "-std=c++17",
             "-shared",

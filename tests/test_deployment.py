@@ -1,0 +1,186 @@
+"""Deployment-surface checks: every committed manifest/config artifact must
+be loadable, internally consistent with the code, and the demo policies must
+parse and validate against the committed schema artifacts (SURVEY.md §2.5
+behavioral surface)."""
+
+import json
+import pathlib
+
+import pytest
+import yaml
+
+from cedar_tpu.apis import v1alpha1
+from cedar_tpu.cli.validator import validate_policy
+from cedar_tpu.lang import parse_policies
+from cedar_tpu.schema.model import CedarSchema
+from cedar_tpu.stores.config import parse_config
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _docs(path):
+    return [d for d in yaml.safe_load_all((REPO / path).read_text()) if d]
+
+
+ALL_YAML = [
+    "kind.yaml",
+    "mount/authorization-config.yaml",
+    "mount/authorization-webhook.yaml",
+    "mount/cedar-config.yaml",
+    "mount/audit-policy.yaml",
+    "manifests/cedar-authorization-webhook.yaml",
+    "manifests/admission-webhook.yaml",
+    "config/crd/bases/cedar.k8s.aws_policies.yaml",
+    "config/crd/kustomization.yaml",
+    "config/rbac/role.yaml",
+    "config/rbac/role_binding.yaml",
+    "config/rbac/kustomization.yaml",
+    "config/prometheus/monitor.yaml",
+    "config/default/kustomization.yaml",
+    "demo/authorization-policy.yaml",
+    "demo/admission-policy.yaml",
+]
+
+
+@pytest.mark.parametrize("path", ALL_YAML)
+def test_yaml_loads(path):
+    assert _docs(path), path
+
+
+def test_store_config_parses():
+    cfg = parse_config((REPO / "mount/cedar-config.yaml").read_text())
+    types = [s.type for s in cfg.stores]
+    assert types == ["directory", "crd"]
+    assert cfg.stores[0].directory_store.path == "/cedar-authorizer/policies"
+
+
+def test_crd_matches_api_types():
+    crd = _docs("config/crd/bases/cedar.k8s.aws_policies.yaml")[0]
+    assert crd["spec"]["group"] == v1alpha1.GROUP
+    version_names = [v["name"] for v in crd["spec"]["versions"]]
+    assert v1alpha1.VERSION in version_names
+    assert crd["spec"]["names"]["kind"] == "Policy"
+    assert crd["spec"]["scope"] == "Cluster"
+    spec_props = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
+        "properties"
+    ]["spec"]["properties"]
+    assert set(spec_props) == {"content", "validation"}
+    modes = spec_props["validation"]["properties"]["validationMode"]["enum"]
+    assert set(modes) == {
+        v1alpha1.VALIDATION_MODE_STRICT,
+        v1alpha1.VALIDATION_MODE_PERMISSIVE,
+        v1alpha1.VALIDATION_MODE_PARTIAL,
+    }
+
+
+def test_authorization_config_chain():
+    doc = _docs("mount/authorization-config.yaml")[0]
+    types = [a["type"] for a in doc["authorizers"]]
+    assert types == ["Node", "Webhook", "RBAC"]
+    hook = doc["authorizers"][1]["webhook"]
+    assert hook["failurePolicy"] == "NoOpinion"
+    assert hook["timeout"] == "3s"
+
+
+def test_webhook_kubeconfig_targets_authorize_endpoint():
+    doc = _docs("mount/authorization-webhook.yaml")[0]
+    server = doc["clusters"][0]["cluster"]["server"]
+    assert server == "https://127.0.0.1:10288/v1/authorize"
+
+
+def test_admission_webhook_targets_admit_endpoint():
+    doc = _docs("manifests/admission-webhook.yaml")[0]
+    hook = doc["webhooks"][0]
+    assert hook["clientConfig"]["url"] == "https://127.0.0.1:10288/v1/admit"
+    assert hook["failurePolicy"] == "Ignore"  # allow-on-error posture
+
+
+def test_static_pod_flags_match_cli():
+    from cedar_tpu.cli.webhook import make_parser
+
+    pod = _docs("manifests/cedar-authorization-webhook.yaml")[0]
+    args = pod["spec"]["containers"][0]["args"]
+    parser = make_parser()
+    parsed = parser.parse_args(args)
+    assert parsed.backend == "tpu"
+    assert parsed.secure_port == 10288
+    assert parsed.metrics_port == 10289
+
+
+def test_demo_policies_parse_and_validate():
+    schema = CedarSchema.from_json(
+        json.loads((REPO / "cedarschema/k8s-full.cedarschema.json").read_text())
+    )
+    n = 0
+    for path in ("demo/authorization-policy.yaml", "demo/admission-policy.yaml"):
+        for doc in _docs(path):
+            assert doc["apiVersion"] == v1alpha1.GROUP_VERSION
+            policy = v1alpha1.PolicyObject.from_dict(doc)
+            policies = parse_policies(policy.spec.content, filename=policy.name)
+            assert policies
+            for p in policies:
+                findings = validate_policy(schema, p, policy.name)
+                assert not findings, [str(f) for f in findings]
+                n += 1
+    assert n >= 7
+
+
+def test_demo_decisions():
+    """The demo authorization policies drive the documented scenario matrix
+    through the real TPU engine."""
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.entities.attributes import (
+        Attributes,
+        LabelSelectorRequirement,
+        UserInfo,
+    )
+    from cedar_tpu.lang import PolicySet
+    from cedar_tpu.server.authorizer import (
+        CedarWebhookAuthorizer,
+        DECISION_ALLOW,
+        DECISION_DENY,
+        DECISION_NO_OPINION,
+    )
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    src = "\n".join(
+        v1alpha1.PolicyObject.from_dict(d).spec.content
+        for d in _docs("demo/authorization-policy.yaml")
+    )
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "demo")])
+    authorizer = CedarWebhookAuthorizer(
+        TieredPolicyStores([MemoryStore.from_source("demo", src)]),
+        evaluate=engine.evaluate,
+    )
+
+    sam = UserInfo(name="sam", uid="s1")
+    plat = UserInfo(name="pat", uid="p1", groups=("platform-team",))
+
+    def go(user, verb, resource, selector=()):
+        a = Attributes(
+            user=user, verb=verb, resource=resource, api_version="v1",
+            namespace="default", resource_request=True,
+            label_selector=tuple(selector),
+        )
+        return authorizer.authorize(a)[0]
+
+    assert go(sam, "get", "pods") == DECISION_ALLOW
+    assert go(sam, "list", "nodes") == DECISION_DENY
+    assert go(sam, "get", "secrets") == DECISION_NO_OPINION
+    assert go(plat, "get", "configmaps") == DECISION_ALLOW
+    assert go(plat, "list", "secrets") == DECISION_NO_OPINION
+    assert (
+        go(
+            plat,
+            "list",
+            "secrets",
+            [LabelSelectorRequirement(key="confidentiality", operator="=",
+                                      values=("public",))],
+        )
+        == DECISION_ALLOW
+    )
+    assert (
+        go(UserInfo(name="ops-lead", uid="o1"), "impersonate", "users")
+        == DECISION_NO_OPINION
+    )
