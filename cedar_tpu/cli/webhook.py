@@ -56,12 +56,22 @@ def _fingerprint(stores: TieredPolicyStores) -> str:
 
 
 class TPUReloader:
-    """Recompiles the TPU engine whenever store contents change (the
-    tensorized successor of the reference's RWMutex policy reload)."""
+    """Recompiles TPU engines whenever store contents change (the tensorized
+    successor of the reference's RWMutex policy reload).
 
-    def __init__(self, engine, stores: TieredPolicyStores, interval_s: float = 5.0):
-        self.engine = engine
-        self.stores = stores
+    One reloader drives any number of (engine, tier stores) targets off a
+    single fingerprint pass over the shared dynamic stores — the authz and
+    admission tier stacks differ only by a compile-time-constant allow-all
+    tail, so fingerprinting the corpus twice would be pure waste."""
+
+    def __init__(
+        self,
+        stores: TieredPolicyStores,
+        targets=None,
+        interval_s: float = 5.0,
+    ):
+        self.stores = stores  # dynamic stores: fingerprint + readiness gate
+        self.targets = list(targets or [])  # [(engine, tier_stores)]
         self.interval_s = interval_s
         self._fp: Optional[str] = None
         self._stop = threading.Event()
@@ -72,9 +82,10 @@ class TPUReloader:
         fp = _fingerprint(self.stores)
         if fp == self._fp:
             return False
-        stats = self.engine.load([s.policy_set() for s in self.stores])
+        for engine, tier_stores in self.targets:
+            stats = engine.load([s.policy_set() for s in tier_stores])
+            log.info("TPU engine reloaded: %s", stats)
         self._fp = fp
-        log.info("TPU engine reloaded: %s", stats)
         return True
 
     def run_forever(self) -> None:
@@ -102,22 +113,32 @@ def build_server(args) -> WebhookServer:
     if not len(stores.stores):
         log.warning("no policy stores configured; authorizer will no-opinion")
 
+    def _tpu_backend(tier_stores: TieredPolicyStores):
+        """(engine, evaluate) for a tier stack: compiled eval with an
+        interpreter guard until the first successful load."""
+        from ..engine.evaluator import TPUPolicyEngine
+
+        tier_engine = TPUPolicyEngine()
+
+        def evaluate(entities, request):
+            if not tier_engine.loaded:
+                return tier_stores.is_authorized(entities, request)
+            return tier_engine.evaluate(entities, request)
+
+        return tier_engine, evaluate
+
     evaluate = None
     engine = None
+    reloader = None
     if args.backend == "tpu" and not len(stores.stores):
         log.warning("TPU backend requested but no stores configured; using interpreter")
     elif args.backend == "tpu":
-        from ..engine.evaluator import TPUPolicyEngine
-
-        engine = TPUPolicyEngine()
-        reloader = TPUReloader(engine, stores, interval_s=args.tpu_reload_seconds)
-        reloader.reload_if_changed()
-        reloader.start()
-
-        def evaluate(entities, request):  # noqa: F811
-            if not engine.loaded:
-                return stores.is_authorized(entities, request)
-            return engine.evaluate(entities, request)
+        engine, evaluate = _tpu_backend(stores)
+        reloader = TPUReloader(
+            stores,
+            targets=[(engine, stores)],
+            interval_s=args.tpu_reload_seconds,
+        )
 
     authorizer = CedarWebhookAuthorizer(stores, evaluate=evaluate)
 
@@ -139,7 +160,22 @@ def build_server(args) -> WebhookServer:
     admission_stores = TieredPolicyStores(
         list(stores.stores) + [allow_all_admission_policy_store()]
     )
-    admission_handler = CedarAdmissionHandler(admission_stores, allow_on_error=True)
+    admission_evaluate = None
+    if engine is not None:
+        # the admission tier stack (same stores + the constant allow-all
+        # final tier) compiles into its own engine; unlowerable admission
+        # predicates fall back per policy with exact verdict merging. Both
+        # engines ride the one reloader's fingerprint pass.
+        admission_engine, admission_evaluate = _tpu_backend(admission_stores)
+        reloader.targets.append((admission_engine, admission_stores))
+
+    if reloader is not None:
+        reloader.reload_if_changed()
+        reloader.start()
+
+    admission_handler = CedarAdmissionHandler(
+        admission_stores, allow_on_error=True, evaluate=admission_evaluate
+    )
 
     injector = ErrorInjector(
         ErrorInjectionConfig(
