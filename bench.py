@@ -67,6 +67,204 @@ def build_policy_set(n_policies: int = 10_000):
     return PolicySet.from_source("\n".join(pols), "bench"), users, nss, resources, verbs, groups
 
 
+def build_selector_policy_set(n_policies: int = 1000):
+    """BASELINE config 3: mixed authz policies with when/unless conditions
+    incl. label-selector set-contains tests."""
+    from cedar_tpu.lang import PolicySet
+
+    rng = random.Random(7)
+    pols = []
+    for i in range(n_policies):
+        team = f"team-{rng.randint(0, 40)}"
+        res = rng.choice(["pods", "secrets", "configmaps", "deployments"])
+        kind = rng.random()
+        if kind < 0.4:
+            pols.append(
+                f'permit (principal in k8s::Group::"{team}", action in '
+                '[k8s::Action::"list", k8s::Action::"watch"], '
+                "resource is k8s::Resource) when { "
+                f'resource.resource == "{res}" && '
+                "resource has labelSelector && "
+                "resource.labelSelector.contains({key: \"owner\", "
+                f'operator: "=", values: ["{team}"]}}) }};'
+            )
+        elif kind < 0.7:
+            pols.append(
+                f'forbid (principal, action == k8s::Action::"list", '
+                "resource is k8s::Resource) when { "
+                f'resource.resource == "{res}" }} unless {{ '
+                "resource has namespace && "
+                f'resource.namespace == "ns-{rng.randint(0, 20)}" }};'
+            )
+        else:
+            pols.append(
+                f'permit (principal, action == k8s::Action::"get", '
+                "resource is k8s::Resource) when { "
+                f'principal.name == "user-{rng.randint(0, 100)}" && '
+                f'resource.resource == "{res}" }};'
+            )
+    return PolicySet.from_source("\n".join(pols), "selbench")
+
+
+def bench_config_matrix():
+    """Quick measurements for BASELINE.json configs 1-4 (config 5 is the
+    headline). Returns a dict merged into the result's extra."""
+    import time as _t
+
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.entities.attributes import (
+        Attributes,
+        LabelSelectorRequirement,
+        UserInfo,
+    )
+    from cedar_tpu.lang import PolicySet
+    from cedar_tpu.server.authorizer import record_to_cedar_resource
+
+    out = {}
+    rng = random.Random(9)
+
+    # -- config 1: demo replay (3 policies, single-request latency)
+    demo_src = """
+permit (principal, action in [k8s::Action::"get", k8s::Action::"list",
+        k8s::Action::"watch"], resource is k8s::Resource)
+  when { principal.name == "test-user" && resource.resource == "pods" };
+forbid (principal, action in [k8s::Action::"get", k8s::Action::"list",
+        k8s::Action::"watch"], resource is k8s::Resource)
+  when { principal.name == "test-user" && resource.resource == "nodes" };
+permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
+        resource is k8s::Resource)
+  unless { resource.resource == "secrets" };
+"""
+    eng = TPUPolicyEngine()
+    eng.load([PolicySet.from_source(demo_src, "demo")])
+    item = record_to_cedar_resource(
+        Attributes(
+            user=UserInfo(name="test-user", uid="u"), verb="get",
+            resource="pods", api_version="v1", namespace="default",
+            resource_request=True,
+        )
+    )
+    eng.evaluate_batch([item])  # warm
+    lats = []
+    for _ in range(30):
+        t = _t.time()
+        eng.evaluate_batch([item])
+        lats.append(_t.time() - t)
+    lats.sort()
+    out["demo_single_p50_ms"] = round(lats[len(lats) // 2] * 1e3, 2)
+    out["demo_single_p99_ms"] = round(lats[int(len(lats) * 0.99)] * 1e3, 2)
+
+    # -- config 2: ~200 policies (stock-RBAC scale)
+    ps200, users, nss, resources, verbs, groups = build_policy_set(200)
+
+    def sar_items(n, with_selectors=False):
+        items = []
+        for _ in range(n):
+            sel = ()
+            if with_selectors and rng.random() < 0.4:
+                sel = (
+                    LabelSelectorRequirement(
+                        key="owner", operator="=",
+                        values=(f"team-{rng.randint(0, 50)}",),
+                    ),
+                )
+            items.append(
+                record_to_cedar_resource(
+                    Attributes(
+                        user=UserInfo(
+                            name=rng.choice(users), uid="u",
+                            groups=(f"team-{rng.randint(0, 50)}",),
+                        ),
+                        verb=rng.choice(verbs),
+                        namespace=rng.choice(nss),
+                        api_version="v1",
+                        resource=rng.choice(resources),
+                        resource_request=True,
+                        label_selector=sel,
+                    )
+                )
+            )
+        return items
+
+    for key, ps, with_sel in (
+        ("rbac200", ps200, False),
+        ("selector1k", build_selector_policy_set(1000), True),
+    ):
+        eng = TPUPolicyEngine()
+        eng.load([ps])
+        items = sar_items(2048, with_sel)
+        eng.evaluate_batch(items)  # warm
+        t = _t.time()
+        eng.evaluate_batch(items)
+        out[f"{key}_e2e_rate"] = round(2048 / (_t.time() - t))
+        out[f"{key}_fallback"] = eng.stats["fallback_policies"]
+
+    # -- config 4: admission path (demo admission policies + object walk)
+    import pathlib
+
+    import yaml
+
+    from cedar_tpu.entities.admission import AdmissionRequest
+    from cedar_tpu.server.admission import (
+        ALLOW_ALL_ADMISSION_POLICY_SOURCE,
+        CedarAdmissionHandler,
+        allow_all_admission_policy_store,
+    )
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    adm_docs = [
+        d
+        for d in yaml.safe_load_all(
+            pathlib.Path("demo/admission-policy.yaml").read_text()
+        )
+        if d
+    ]
+    adm_src = "\n".join(d["spec"]["content"] for d in adm_docs if d.get("spec"))
+    eng = TPUPolicyEngine()
+    eng.load(
+        [
+            PolicySet.from_source(adm_src, "adm"),
+            PolicySet.from_source(ALLOW_ALL_ADMISSION_POLICY_SOURCE, "aa"),
+        ]
+    )
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores(
+            [MemoryStore.from_source("adm", adm_src),
+             allow_all_admission_policy_store()]
+        ),
+        evaluate=eng.evaluate,
+        evaluate_batch=eng.evaluate_batch,
+    )
+
+    def review(i):
+        labels = {"owner": "bob"} if i % 2 else {}
+        return AdmissionRequest.from_admission_review(
+            {
+                "request": {
+                    "uid": f"u{i}", "operation": "CREATE",
+                    "userInfo": {"username": "bob", "groups": ["tenants"]},
+                    "kind": {"group": "", "version": "v1", "kind": "ConfigMap"},
+                    "namespace": "default",
+                    "object": {
+                        "apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {
+                            "name": f"cm-{i}", "namespace": "default",
+                            "labels": labels,
+                        },
+                        "data": {f"k{j}": "v" for j in range(8)},
+                    },
+                }
+            }
+        )
+
+    reviews = [review(i) for i in range(512)]
+    handler.handle_batch(reviews[:32])  # warm
+    t = _t.time()
+    handler.handle_batch(reviews)
+    out["admission_e2e_rate"] = round(512 / (_t.time() - t))
+    return out
+
+
 def main():
     import jax
 
@@ -235,6 +433,11 @@ def main():
 
     p99_batch_ms = dt / n_pipeline * 1000  # per-super-batch pipelined latency
 
+    try:
+        config_matrix = bench_config_matrix()
+    except Exception as e:  # the headline must survive a matrix failure
+        config_matrix = {"error": str(e)}
+
     result = {
         "metric": "SAR decisions/sec @10k policies (TPU batch eval)",
         "value": round(device_rate),
@@ -258,6 +461,7 @@ def main():
             "R": stats["R"],
             "fallback_policies": stats["fallback_policies"],
             "platform": jax.devices()[0].platform,
+            "configs": config_matrix,
         },
     }
     print(json.dumps(result))

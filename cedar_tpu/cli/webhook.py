@@ -73,20 +73,31 @@ class TPUReloader:
         self.stores = stores  # dynamic stores: fingerprint + readiness gate
         self.targets = list(targets or [])  # [(engine, tier_stores)]
         self.interval_s = interval_s
-        self._fp: Optional[str] = None
+        # fingerprint each target last loaded successfully — tracked per
+        # target so one target's persistent load failure doesn't force the
+        # healthy engines to recompile every tick
+        self._fps: dict = {}
         self._stop = threading.Event()
 
     def reload_if_changed(self) -> bool:
         if not all(s.initial_policy_load_complete() for s in self.stores):
             return False
         fp = _fingerprint(self.stores)
-        if fp == self._fp:
-            return False
-        for engine, tier_stores in self.targets:
-            stats = engine.load([s.policy_set() for s in tier_stores])
-            log.info("TPU engine reloaded: %s", stats)
-        self._fp = fp
-        return True
+        changed = False
+        for idx, (engine, tier_stores) in enumerate(self.targets):
+            if self._fps.get(idx) == fp:
+                continue
+            try:
+                stats = engine.load([s.policy_set() for s in tier_stores])
+            except Exception:
+                log.exception(
+                    "TPU engine [%d] reload failed; serving previous set", idx
+                )
+                continue
+            self._fps[idx] = fp
+            changed = True
+            log.info("TPU engine [%d] reloaded: %s", idx, stats)
+        return changed
 
     def run_forever(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -114,8 +125,8 @@ def build_server(args) -> WebhookServer:
         log.warning("no policy stores configured; authorizer will no-opinion")
 
     def _tpu_backend(tier_stores: TieredPolicyStores):
-        """(engine, evaluate) for a tier stack: compiled eval with an
-        interpreter guard until the first successful load."""
+        """(engine, evaluate, evaluate_batch) for a tier stack: compiled
+        eval with an interpreter guard until the first successful load."""
         from ..engine.evaluator import TPUPolicyEngine
 
         tier_engine = TPUPolicyEngine()
@@ -125,7 +136,12 @@ def build_server(args) -> WebhookServer:
                 return tier_stores.is_authorized(entities, request)
             return tier_engine.evaluate(entities, request)
 
-        return tier_engine, evaluate
+        def evaluate_batch(items):
+            if not tier_engine.loaded:
+                return [tier_stores.is_authorized(em, r) for em, r in items]
+            return tier_engine.evaluate_batch(items)
+
+        return tier_engine, evaluate, evaluate_batch
 
     evaluate = None
     engine = None
@@ -133,7 +149,7 @@ def build_server(args) -> WebhookServer:
     if args.backend == "tpu" and not len(stores.stores):
         log.warning("TPU backend requested but no stores configured; using interpreter")
     elif args.backend == "tpu":
-        engine, evaluate = _tpu_backend(stores)
+        engine, evaluate, _ = _tpu_backend(stores)
         reloader = TPUReloader(
             stores,
             targets=[(engine, stores)],
@@ -161,12 +177,15 @@ def build_server(args) -> WebhookServer:
         list(stores.stores) + [allow_all_admission_policy_store()]
     )
     admission_evaluate = None
+    admission_evaluate_batch = None
     if engine is not None:
         # the admission tier stack (same stores + the constant allow-all
         # final tier) compiles into its own engine; unlowerable admission
         # predicates fall back per policy with exact verdict merging. Both
         # engines ride the one reloader's fingerprint pass.
-        admission_engine, admission_evaluate = _tpu_backend(admission_stores)
+        admission_engine, admission_evaluate, admission_evaluate_batch = (
+            _tpu_backend(admission_stores)
+        )
         reloader.targets.append((admission_engine, admission_stores))
 
     if reloader is not None:
@@ -174,7 +193,10 @@ def build_server(args) -> WebhookServer:
         reloader.start()
 
     admission_handler = CedarAdmissionHandler(
-        admission_stores, allow_on_error=True, evaluate=admission_evaluate
+        admission_stores,
+        allow_on_error=True,
+        evaluate=admission_evaluate,
+        evaluate_batch=admission_evaluate_batch,
     )
 
     injector = ErrorInjector(

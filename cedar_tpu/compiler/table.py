@@ -50,7 +50,8 @@ class FeatureTable:
     `rows` on device)."""
 
     n_slots: int
-    rows: np.ndarray  # [n_rows, L] uint8; row 0 all-zero
+    rows: np.ndarray  # [n_rows, L] uint8; row 0 all-zero (padded height)
+    n_rows_real: int  # live rows before bucket padding
     # encoder vocabularies -> row index
     type_vocab: Dict[Tuple[str, str], int]  # (var, entity type) -> row
     uid_vocab: Dict[Tuple[str, str, str], int]  # (var, type, id) -> row (self)
@@ -70,7 +71,9 @@ class FeatureTable:
 
     @property
     def code_dtype(self):
-        return np.int16 if self.n_rows <= 32767 else np.int32
+        # real row count, not padded height: padding must not widen the
+        # per-request code transfer a bucket early
+        return np.int16 if self.n_rows_real <= 32767 else np.int32
 
 
 class _RowBuilder:
@@ -86,7 +89,14 @@ class _RowBuilder:
         return len(self.rows) - 1
 
     def materialize(self, L: int) -> np.ndarray:
-        out = np.zeros((len(self.rows), L), dtype=np.uint8)
+        from .pack import _bucket
+
+        # bucket the row count too: the activation table is a jitted-kernel
+        # argument, so a stable shape across same-sized policy reloads is
+        # what keeps hot swap retrace-free (padding rows are all-zero and
+        # unreachable — no code ever points at them)
+        V = _bucket(len(self.rows), minimum=64)
+        out = np.zeros((V, L), dtype=np.uint8)
         for r, ids in enumerate(self.rows):
             for i in ids:
                 out[r, i] = 1
@@ -159,6 +169,7 @@ def build_table(plan, n_lits: int, L: int) -> FeatureTable:
     table = FeatureTable(
         n_slots=0,
         rows=rb.materialize(L),
+        n_rows_real=len(rb.rows),
         type_vocab=type_vocab,
         uid_vocab=uid_vocab,
         anc_vocab=anc_vocab,

@@ -27,7 +27,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.match import match_rules
+from ..ops.match import (
+    INT32_MAX,
+    _lit_matrix_codes,
+    _tier_walk,
+    match_rules,
+)
 
 
 def make_mesh(
@@ -92,5 +97,75 @@ def sharded_match_fn(mesh: Mesh, n_groups: int):
     )
     def step(active, W, thresh, rule_group, rule_policy):
         return match_rules(active, W, thresh, rule_group, rule_policy, n_groups)
+
+    return step
+
+
+# --------------------------------------------------- production codes path
+
+
+def shard_codes_tensors(mesh: Mesh, act_rows, W, thresh, rule_group, rule_policy):
+    """Place the feature-code evaluation tensors: activation table
+    replicated (every shard expands the same request features), rule axis
+    sharded."""
+    rep = NamedSharding(mesh, P(None, None))
+    w_s = NamedSharding(mesh, P(None, "policy"))
+    r_s = NamedSharding(mesh, P("policy"))
+    return (
+        jax.device_put(act_rows, rep),
+        jax.device_put(W, w_s),
+        jax.device_put(thresh, r_s),
+        jax.device_put(rule_group, r_s),
+        jax.device_put(rule_policy, r_s),
+    )
+
+
+def sharded_codes_match_fn(mesh: Mesh, n_tiers: int):
+    """The production evaluation step, sharded: feature codes in, packed
+    uint32 verdict words out.
+
+    - codes/extras shard over ``data`` (batch parallelism);
+    - W [L, R] + rule tensors shard over ``policy`` (rule parallelism);
+    - each shard computes its local per-(tier, effect) first-match minima;
+      the cross-shard combine is a min all-reduce XLA inserts from the
+      sharding annotations — first-match is a min-reduction, so
+      shard-and-reduce is exact;
+    - the tier walk runs on the replicated [B, G] minima, and the readback
+      is 4 bytes per request, sharded over data.
+    """
+    G = n_tiers * 3
+    in_shardings = (
+        NamedSharding(mesh, P("data", None)),  # codes [B, S]
+        NamedSharding(mesh, P("data", None)),  # extras [B, E]
+        NamedSharding(mesh, P(None, None)),  # act_rows [V, L]
+        NamedSharding(mesh, P(None, "policy")),  # W [L, R]
+        NamedSharding(mesh, P("policy")),  # thresh [R]
+        NamedSharding(mesh, P("policy")),  # rule_group [R]
+        NamedSharding(mesh, P("policy")),  # rule_policy [R]
+    )
+    out_shardings = (
+        NamedSharding(mesh, P("data")),  # packed words [B]
+        NamedSharding(mesh, P("data", None)),  # first [B, G]
+    )
+
+    @functools.partial(
+        jax.jit, in_shardings=in_shardings, out_shardings=out_shardings
+    )
+    def step(codes, extras, act_rows, W, thresh, rule_group, rule_policy):
+        lit = _lit_matrix_codes(codes, extras, act_rows)  # [B, L]
+        scores = jnp.dot(
+            lit, W.astype(jnp.bfloat16), preferred_element_type=jnp.float32
+        )  # [B, R] — R sharded
+        sat = scores >= thresh[None, :]
+        masked = jnp.where(sat, rule_policy[None, :], INT32_MAX)
+        firsts = [
+            jnp.min(
+                jnp.where((rule_group == g)[None, :], masked, INT32_MAX),
+                axis=1,  # cross-shard min all-reduce over the policy axis
+            )
+            for g in range(G)
+        ]
+        first = jnp.stack(firsts, axis=1)  # [B, G] replicated on policy
+        return _tier_walk(first, n_tiers), first
 
     return step

@@ -101,43 +101,102 @@ class CedarAdmissionHandler:
         stores: TieredPolicyStores,
         allow_on_error: bool = True,
         evaluate=None,
+        evaluate_batch=None,
     ):
         self.stores = stores
         self.allow_on_error = allow_on_error
         self._all_stores_ready = False
         # pluggable evaluation backend (TPU engine); defaults to interpreter
         self._evaluate = evaluate or stores.is_authorized
+        # optional batched backend: [(entities, request)] -> [(decision,
+        # diagnostics)] — lets the server micro-batch admission reviews
+        # into one device call
+        self._evaluate_batch = evaluate_batch
+
+    @property
+    def supports_batch(self) -> bool:
+        """True when a batched evaluation backend is wired; the server keys
+        admission micro-batching on this."""
+        return self._evaluate_batch is not None
+
+    def _ready(self) -> bool:
+        if self._all_stores_ready:
+            return True
+        for i, store in enumerate(self.stores):
+            if not store.initial_policy_load_complete():
+                log.info(
+                    "policy store [%d] (%s) not ready, emitting allow response",
+                    i,
+                    store.name(),
+                )
+                return False
+        self._all_stores_ready = True
+        return True
 
     def handle(self, req: AdmissionRequest) -> AdmissionResponse:
-        if req.namespace in SKIPPED_NAMESPACES:
-            return AdmissionResponse(uid=req.uid, allowed=True)
+        return self.handle_batch([req])[0]
 
-        if not self._all_stores_ready:
-            for i, store in enumerate(self.stores):
-                if not store.initial_policy_load_complete():
-                    log.info(
-                        "policy store [%d] (%s) not ready, emitting allow response",
-                        i,
-                        store.name(),
+    def handle_batch(self, reqs) -> list:
+        """Evaluate a batch of AdmissionRequests in one device call where a
+        batch backend is available; per-request semantics are identical to
+        handle()."""
+        responses: list = [None] * len(reqs)
+        ready = self._ready() if reqs else True
+        build: list = []  # (index, entities, cedar_request)
+        for i, req in enumerate(reqs):
+            if req.namespace in SKIPPED_NAMESPACES or not ready:
+                responses[i] = AdmissionResponse(uid=req.uid, allowed=True)
+                continue
+            try:
+                entities, cedar_req = self._build(req)
+            except Exception as e:  # conversion error
+                log.error("error during review: %s", e)
+                responses[i] = AdmissionResponse(
+                    uid=req.uid, allowed=self.allow_on_error, code=500,
+                    error=str(e),
+                )
+                continue
+            build.append((i, entities, cedar_req))
+
+        if build:
+            try:
+                if self._evaluate_batch is not None:
+                    verdicts = self._evaluate_batch(
+                        [(em, cr) for _, em, cr in build]
                     )
-                    return AdmissionResponse(uid=req.uid, allowed=True)
-            self._all_stores_ready = True
+                else:
+                    verdicts = [
+                        self._evaluate(em, cr) for _, em, cr in build
+                    ]
+            except Exception as e:  # evaluation plumbing error
+                log.error("error during review: %s", e)
+                for i, _, _ in build:
+                    responses[i] = AdmissionResponse(
+                        uid=reqs[i].uid, allowed=self.allow_on_error,
+                        code=500, error=str(e),
+                    )
+                return responses
+            for (i, _, _), (decision, diagnostics) in zip(build, verdicts):
+                responses[i] = self._decide(reqs[i], decision, diagnostics)
+        return responses
 
-        try:
-            allowed, diagnostics = self._review(req)
-        except Exception as e:  # conversion/evaluation plumbing error
-            log.error("error during review: %s", e)
-            return AdmissionResponse(
-                uid=req.uid, allowed=self.allow_on_error, code=500, error=str(e)
-            )
-        message = ""
-        if diagnostics is not None and diagnostics.reasons:
-            message = json.dumps(
-                [r.to_dict() for r in diagnostics.reasons], separators=(",", ":")
-            )
-        return AdmissionResponse(uid=req.uid, allowed=allowed, message=message)
+    def _decide(self, req, decision, diagnostics) -> AdmissionResponse:
+        if decision == DENY:
+            if not diagnostics.reasons and not diagnostics.errors:
+                log.error(
+                    "request denied without reasons; the default permit "
+                    "policy was not evaluated"
+                )
+            message = ""
+            if diagnostics.reasons:
+                message = json.dumps(
+                    [r.to_dict() for r in diagnostics.reasons],
+                    separators=(",", ":"),
+                )
+            return AdmissionResponse(uid=req.uid, allowed=False, message=message)
+        return AdmissionResponse(uid=req.uid, allowed=True)
 
-    def _review(self, req: AdmissionRequest):
+    def _build(self, req: AdmissionRequest):
         principal_uid, request_entities = principal_entities_from_admission_request(
             req
         )
@@ -174,12 +233,4 @@ class CedarAdmissionHandler:
         cedar_req = Request(
             principal_uid, action_uid, resource_entity.uid, CedarRecord(context)
         )
-        decision, diagnostics = self._evaluate(request_entities, cedar_req)
-        if decision == DENY:
-            if not diagnostics.reasons and not diagnostics.errors:
-                log.error(
-                    "request denied without reasons; the default permit policy "
-                    "was not evaluated"
-                )
-            return False, diagnostics
-        return True, None
+        return request_entities, cedar_req

@@ -208,6 +208,17 @@ class WebhookServer:
                 max_batch=max_batch,
                 window_s=batch_window_s,
             )
+        # admission reviews micro-batch into one device call when the
+        # handler has a batched evaluation backend
+        self._admission_batcher = None
+        if admission_handler is not None and admission_handler.supports_batch:
+            from ..engine.batcher import MicroBatcher
+
+            self._admission_batcher = MicroBatcher(
+                admission_handler.handle_batch,
+                max_batch=max_batch,
+                window_s=batch_window_s,
+            )
         self.error_injector = error_injector or ErrorInjector(None)
         self.recorder = recorder
         self.enable_profiling = enable_profiling
@@ -287,6 +298,8 @@ class WebhookServer:
             ).to_admission_review()
         try:
             req = AdmissionRequest.from_admission_review(review)
+            if self._admission_batcher is not None:
+                return self._admission_batcher.submit(req).to_admission_review()
             return self.admission_handler.handle(req).to_admission_review()
         except Exception as e:  # noqa: BLE001 — fail-open like the reference
             # allow-on-error posture (/root/reference
@@ -459,6 +472,9 @@ class WebhookServer:
                 httpd.server_close()
         self._httpd = None
         self._metrics_httpd = None
+        for batcher in (self._batcher, self._admission_batcher):
+            if batcher is not None:
+                batcher.stop()
 
     @property
     def bound_port(self) -> Optional[int]:

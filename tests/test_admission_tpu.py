@@ -105,6 +105,42 @@ def test_admission_tpu_matches_interpreter_and_expectation(case):
     assert b.allowed is expected, f"unexpected decision on {case}"
 
 
+def test_handle_batch_matches_per_request_handle():
+    """One batched device call must yield identical responses to the
+    per-request path, including skipped namespaces and conversion-safe
+    ordering."""
+    src = _demo_admission_source()
+    h_int, h_tpu, engine = _handlers(src)
+    h_batch = CedarAdmissionHandler(
+        h_tpu.stores, evaluate=engine.evaluate,
+        evaluate_batch=engine.evaluate_batch,
+    )
+    reqs = [_review(*c[:6]) for c in CASES]
+    # mix in a skipped-namespace request
+    reqs.append(
+        _review("CREATE", _cm(ns="kube-system"), None, "bob", ("tenants",),
+                "kube-system")
+    )
+    singles = [h_tpu.handle(r) for r in reqs]
+    batched = h_batch.handle_batch(reqs)
+    assert len(batched) == len(singles)
+    for s, b in zip(singles, batched):
+        assert (s.allowed, s.message, s.error) == (b.allowed, b.message, b.error)
+
+
+def test_handle_batch_unready_stores_allows():
+    class NeverReady(MemoryStore):
+        def initial_policy_load_complete(self):
+            return False
+
+    stores = TieredPolicyStores(
+        [NeverReady.from_source("adm", _demo_admission_source())]
+    )
+    h = CedarAdmissionHandler(stores)
+    out = h.handle_batch([_review("CREATE", _cm(), None, "bob", ("tenants",))])
+    assert out[0].allowed is True
+
+
 def test_admission_engine_compiles_with_bounded_fallback():
     _, _, engine = _handlers(_demo_admission_source())
     stats = engine.stats

@@ -131,3 +131,49 @@ def test_crd_watch_expiry_relists():
     ids = sorted(p.policy_id for p in store.policy_set().policies())
     assert ids == ["a0-u1", "b0-u2"]
     store.close()
+
+
+def test_same_bucket_reload_keeps_device_shapes():
+    """Policy hot swap within a size bucket must keep every device tensor
+    shape (and dtype) identical — that is the invariant that makes a reload
+    a buffer update instead of an XLA recompile (compiler/pack.py bucketing;
+    SURVEY.md §7 'hot policy swap without jit recompilation')."""
+    import random
+
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.lang import PolicySet
+
+    def make_set(seed, n):
+        rng = random.Random(seed)
+        pols = [
+            f'permit (principal, action == k8s::Action::"get", '
+            "resource is k8s::Resource) when { "
+            f'principal.name == "u{rng.randint(0, 50)}" && '
+            f'resource.resource == "r{rng.randint(0, 9)}" }};'
+            for _ in range(n)
+        ]
+        return PolicySet.from_source("\n".join(pols), f"swap{seed}")
+
+    engine = TPUPolicyEngine()
+    engine.load([make_set(1, 500)])
+    cs1 = engine._compiled
+    shapes1 = {
+        "W": cs1.W_dev.shape,
+        "thresh": cs1.thresh_dev.shape,
+        "group": cs1.rule_group_dev.shape,
+        "policy": cs1.rule_policy_dev.shape,
+        "act_rows": cs1.act_rows_dev.shape,
+    }
+    # +1 policy: same bucket, so identical device shapes
+    engine.load([make_set(2, 501)])
+    cs2 = engine._compiled
+    assert cs2 is not cs1  # double-buffered swap, not in-place mutation
+    shapes2 = {
+        "W": cs2.W_dev.shape,
+        "thresh": cs2.thresh_dev.shape,
+        "group": cs2.rule_group_dev.shape,
+        "policy": cs2.rule_policy_dev.shape,
+        "act_rows": cs2.act_rows_dev.shape,
+    }
+    assert shapes1 == shapes2
+    assert cs1.code_dtype == cs2.code_dtype
