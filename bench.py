@@ -109,8 +109,6 @@ def build_selector_policy_set(n_policies: int = 1000):
 def bench_config_matrix():
     """Quick measurements for BASELINE.json configs 1-4 (config 5 is the
     headline). Returns a dict merged into the result's extra."""
-    import time as _t
-
     from cedar_tpu.engine.evaluator import TPUPolicyEngine
     from cedar_tpu.entities.attributes import (
         Attributes,
@@ -147,9 +145,9 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
     eng.evaluate_batch([item])  # warm
     lats = []
     for _ in range(30):
-        t = _t.time()
+        t = time.time()
         eng.evaluate_batch([item])
-        lats.append(_t.time() - t)
+        lats.append(time.time() - t)
     lats.sort()
     out["demo_single_p50_ms"] = round(lats[len(lats) // 2] * 1e3, 2)
     out["demo_single_p99_ms"] = round(lats[int(len(lats) * 0.99)] * 1e3, 2)
@@ -194,9 +192,9 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
         eng.load([ps])
         items = sar_items(2048, with_sel)
         eng.evaluate_batch(items)  # warm
-        t = _t.time()
+        t = time.time()
         eng.evaluate_batch(items)
-        out[f"{key}_e2e_rate"] = round(2048 / (_t.time() - t))
+        out[f"{key}_e2e_rate"] = round(2048 / (time.time() - t))
         out[f"{key}_fallback"] = eng.stats["fallback_policies"]
 
     # -- config 4: admission path (demo admission policies + object walk)
@@ -259,9 +257,9 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
 
     reviews = [review(i) for i in range(512)]
     handler.handle_batch(reviews[:32])  # warm
-    t = _t.time()
+    t = time.time()
     handler.handle_batch(reviews)
-    out["admission_e2e_rate"] = round(512 / (_t.time() - t))
+    out["admission_e2e_rate"] = round(512 / (time.time() - t))
     return out
 
 

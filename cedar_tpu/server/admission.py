@@ -159,25 +159,34 @@ class CedarAdmissionHandler:
             build.append((i, entities, cedar_req))
 
         if build:
-            try:
-                if self._evaluate_batch is not None:
+            verdicts = None
+            if self._evaluate_batch is not None:
+                try:
                     verdicts = self._evaluate_batch(
                         [(em, cr) for _, em, cr in build]
                     )
-                else:
-                    verdicts = [
-                        self._evaluate(em, cr) for _, em, cr in build
-                    ]
-            except Exception as e:  # evaluation plumbing error
-                log.error("error during review: %s", e)
-                for i, _, _ in build:
-                    responses[i] = AdmissionResponse(
-                        uid=reqs[i].uid, allowed=self.allow_on_error,
-                        code=500, error=str(e),
+                except Exception as e:
+                    # one bad item must not fail the whole micro-batch open:
+                    # re-evaluate each member independently below so only
+                    # the genuinely failing request gets the error response
+                    log.error(
+                        "batched review failed (%s); retrying per request", e
                     )
-                return responses
-            for (i, _, _), (decision, diagnostics) in zip(build, verdicts):
-                responses[i] = self._decide(reqs[i], decision, diagnostics)
+            if verdicts is not None:
+                for (i, _, _), (decision, diagnostics) in zip(build, verdicts):
+                    responses[i] = self._decide(reqs[i], decision, diagnostics)
+            else:
+                for i, em, cr in build:
+                    try:
+                        decision, diagnostics = self._evaluate(em, cr)
+                    except Exception as e:  # evaluation plumbing error
+                        log.error("error during review: %s", e)
+                        responses[i] = AdmissionResponse(
+                            uid=reqs[i].uid, allowed=self.allow_on_error,
+                            code=500, error=str(e),
+                        )
+                        continue
+                    responses[i] = self._decide(reqs[i], decision, diagnostics)
         return responses
 
     def _decide(self, req, decision, diagnostics) -> AdmissionResponse:

@@ -148,3 +148,28 @@ def test_admission_engine_compiles_with_bounded_fallback():
     # rest of the admission demo must lower
     assert stats["fallback_policies"] <= 1
     assert stats["rules"] >= 2
+
+
+def test_handle_batch_isolates_evaluation_failures():
+    """A failing batched evaluation must degrade to per-request evaluation
+    so only genuinely failing requests get the allow-on-error response."""
+    src = _demo_admission_source()
+    _, _, engine = _handlers(src)
+    stores = TieredPolicyStores(
+        [MemoryStore.from_source("adm", src), allow_all_admission_policy_store()]
+    )
+
+    def exploding_batch(items):
+        raise RuntimeError("device fell over")
+
+    h = CedarAdmissionHandler(
+        stores, evaluate=engine.evaluate, evaluate_batch=exploding_batch
+    )
+    reqs = [
+        _review("CREATE", _cm(), None, "bob", ("tenants",)),       # deny
+        _review("CREATE", _cm(labels={"owner": "bob"}), None, "bob", ("tenants",)),  # allow
+    ]
+    out = h.handle_batch(reqs)
+    assert out[0].allowed is False  # the deny still lands
+    assert out[1].allowed is True
+    assert out[0].error is None and out[1].error is None
