@@ -1,0 +1,189 @@
+"""cedar-replay: re-drive recorded webhook requests for gameday analysis.
+
+The recorder middleware (server/recorder.py, reference recorder.go:25)
+writes every POST body to ``req-<path>-<unixnano>.json``; this CLI replays
+those files — either in-process against a policy set (offline decision
+audit: did the new policy set change any recorded decision?) or against a
+live webhook over HTTPS — and reports per-file decisions plus a latency
+summary. It is also the in-repo caller of the
+``cedar_authorizer_e2e_latency_seconds`` metric, which the reference
+declares but never invokes (reference metrics.go:78-86,
+policy_types.go:90-95).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import ssl
+import sys
+import time
+import urllib.request
+from typing import List, Optional, Tuple
+
+from ..server import metrics
+
+
+def _load_recordings(paths) -> List[Tuple[str, str, bytes]]:
+    """[(filename, endpoint, body)] — endpoint inferred from the recorded
+    name (req-authorize-*.json / req-admit-*.json)."""
+    files: List[pathlib.Path] = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.glob("req-*.json")))
+        else:
+            files.append(path)
+    out = []
+    for f in files:
+        endpoint = "authorize" if "authorize" in f.name else "admit"
+        out.append((f.name, endpoint, f.read_bytes()))
+    return out
+
+
+def _replay_local(recordings, config_path: str):
+    """Offline replay: build the store stack from a StoreConfig and decide
+    every recorded request in-process (interpreter backend — the oracle)."""
+    from ..server.admission import (
+        CedarAdmissionHandler,
+        allow_all_admission_policy_store,
+    )
+    from ..server.authorizer import CedarWebhookAuthorizer
+    from ..server.http import get_authorizer_attributes
+    from ..entities.admission import AdmissionRequest
+    from ..stores.config import cedar_config_stores, parse_config
+    from ..stores.store import TieredPolicyStores
+
+    with open(config_path) as f:
+        config = parse_config(f.read())
+    stores = cedar_config_stores(config)
+    deadline = time.time() + 30
+    while not all(s.initial_policy_load_complete() for s in stores):
+        if time.time() > deadline:
+            print("stores not ready after 30s", file=sys.stderr)
+            return 1
+        time.sleep(0.2)
+    authorizer = CedarWebhookAuthorizer(stores)
+    admission = CedarAdmissionHandler(
+        TieredPolicyStores(
+            list(stores.stores) + [allow_all_admission_policy_store()]
+        )
+    )
+
+    results = []
+    for name, endpoint, body in recordings:
+        start = time.monotonic()
+        try:
+            doc = json.loads(body)
+            if endpoint == "authorize":
+                decision, reason = authorizer.authorize(
+                    get_authorizer_attributes(doc)
+                )
+                outcome = decision
+            else:
+                resp = admission.handle(
+                    AdmissionRequest.from_admission_review(doc)
+                )
+                outcome = "allow" if resp.allowed else "deny"
+                reason = resp.message
+        except Exception as e:  # noqa: BLE001 — report per file, keep going
+            outcome, reason = "<error>", str(e)
+        latency = time.monotonic() - start
+        metrics.record_e2e_latency(name, latency)
+        results.append((name, endpoint, outcome, reason, latency))
+    return _report(results)
+
+
+def _replay_remote(recordings, server: str, ca_cert: Optional[str] = None):
+    if ca_cert:
+        ctx = ssl.create_default_context(cafile=ca_cert)
+    else:
+        # default matches the apiserver's own demo wiring
+        # (insecure-skip-tls-verify against the self-signed serving cert);
+        # pass --ca-cert to verify
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    results = []
+    for name, endpoint, body in recordings:
+        url = f"{server.rstrip('/')}/v1/{endpoint}"
+        start = time.monotonic()
+        try:
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"}
+            )
+            with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+                doc = json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 — report per file, keep going
+            results.append((name, endpoint, "<error>", str(e), 0.0))
+            continue
+        latency = time.monotonic() - start
+        metrics.record_e2e_latency(name, latency)
+        if endpoint == "authorize":
+            status = doc.get("status", {})
+            outcome = (
+                "allow"
+                if status.get("allowed")
+                else ("deny" if status.get("denied") else "no_opinion")
+            )
+            reason = status.get("reason", "")
+        else:
+            response = doc.get("response", {})
+            outcome = "allow" if response.get("allowed") else "deny"
+            reason = (response.get("status") or {}).get("message", "")
+        results.append((name, endpoint, outcome, reason, latency))
+    return _report(results)
+
+
+def _report(results) -> int:
+    lat = sorted(r[4] for r in results if r[2] != "<error>")
+    for name, endpoint, outcome, _reason, latency in results:
+        print(f"{name}\t{endpoint}\t{outcome}\t{latency * 1e3:.2f}ms")
+    n_err = sum(1 for r in results if r[2] == "<error>")
+    summary = f"# {len(results)} requests, {n_err} errors"
+    if lat:
+        p50 = lat[len(lat) // 2]
+        p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+        summary += f", p50 {p50 * 1e3:.2f}ms, p99 {p99 * 1e3:.2f}ms"
+    print(summary, file=sys.stderr)
+    return 1 if n_err else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cedar-replay",
+        description="Replay recorded webhook requests (gameday analysis)",
+    )
+    parser.add_argument(
+        "paths", nargs="+",
+        help="recording files or directories (req-*.json)",
+    )
+    mode = parser.add_mutually_exclusive_group(required=True)
+    mode.add_argument(
+        "--config",
+        help="StoreConfig for offline in-process replay (interpreter oracle)",
+    )
+    mode.add_argument(
+        "--server",
+        help="live webhook base URL, e.g. https://127.0.0.1:10288",
+    )
+    parser.add_argument(
+        "--ca-cert",
+        default="",
+        help="CA bundle to verify the server's TLS cert (remote mode; "
+        "default skips verification, matching the demo's self-signed wiring)",
+    )
+    args = parser.parse_args(argv)
+
+    recordings = _load_recordings(args.paths)
+    if not recordings:
+        print("no recordings found", file=sys.stderr)
+        return 1
+    if args.config:
+        return _replay_local(recordings, args.config)
+    return _replay_remote(recordings, args.server, ca_cert=args.ca_cert or None)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

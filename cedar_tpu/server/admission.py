@@ -172,6 +172,13 @@ class CedarAdmissionHandler:
                     log.error(
                         "batched review failed (%s); retrying per request", e
                     )
+                else:
+                    if len(verdicts) != len(build):
+                        log.error(
+                            "batch backend returned %d verdicts for %d items;"
+                            " retrying per request", len(verdicts), len(build),
+                        )
+                        verdicts = None
             if verdicts is not None:
                 for (i, _, _), (decision, diagnostics) in zip(build, verdicts):
                     responses[i] = self._decide(reqs[i], decision, diagnostics)

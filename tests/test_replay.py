@@ -1,0 +1,98 @@
+"""Replay CLI: offline in-process replay of recorded webhook requests."""
+
+import json
+
+from cedar_tpu.cli.replay import main as replay_main
+
+
+def test_local_replay(tmp_path, capsys):
+    policies = tmp_path / "policies"
+    policies.mkdir()
+    (policies / "p.cedar").write_text(
+        "permit (principal, action, resource is k8s::Resource)"
+        ' when { principal.name == "sam" && resource.resource == "pods" };\n'
+        "forbid (principal, action, resource is k8s::Resource)"
+        ' when { resource.resource == "nodes" };'
+    )
+    config = tmp_path / "config.yaml"
+    config.write_text(
+        "apiVersion: cedar.k8s.aws/v1alpha1\n"
+        "kind: StoreConfig\n"
+        "spec:\n"
+        "  stores:\n"
+        '    - type: "directory"\n'
+        "      directoryStore:\n"
+        f'        path: "{policies}"\n'
+    )
+    rec = tmp_path / "rec"
+    rec.mkdir()
+    (rec / "req-authorize-1.json").write_text(
+        json.dumps(
+            {
+                "spec": {
+                    "user": "sam",
+                    "uid": "s1",
+                    "resourceAttributes": {
+                        "verb": "get", "resource": "pods", "version": "v1",
+                        "namespace": "default",
+                    },
+                }
+            }
+        )
+    )
+    (rec / "req-authorize-2.json").write_text(
+        json.dumps(
+            {
+                "spec": {
+                    "user": "sam",
+                    "uid": "s1",
+                    "resourceAttributes": {
+                        "verb": "get", "resource": "nodes", "version": "v1",
+                    },
+                }
+            }
+        )
+    )
+    (rec / "req-admit-3.json").write_text(
+        json.dumps(
+            {
+                "request": {
+                    "uid": "u3", "operation": "CREATE",
+                    "userInfo": {"username": "sam"},
+                    "kind": {"group": "", "version": "v1", "kind": "ConfigMap"},
+                    "namespace": "default",
+                    "object": {
+                        "apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {"name": "c", "namespace": "default"},
+                    },
+                }
+            }
+        )
+    )
+    rc = replay_main([str(rec), "--config", str(config)])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    outcomes = {line.split("\t")[0]: line.split("\t")[2] for line in out}
+    assert outcomes["req-authorize-1.json"] == "allow"
+    assert outcomes["req-authorize-2.json"] == "deny"
+    assert outcomes["req-admit-3.json"] == "allow"  # allow-all final tier
+
+
+def test_replay_reports_parse_errors(tmp_path, capsys):
+    policies = tmp_path / "policies"
+    policies.mkdir()
+    (policies / "p.cedar").write_text("permit (principal, action, resource);")
+    config = tmp_path / "config.yaml"
+    config.write_text(
+        "apiVersion: cedar.k8s.aws/v1alpha1\nkind: StoreConfig\nspec:\n"
+        "  stores:\n"
+        '    - type: "directory"\n'
+        "      directoryStore:\n"
+        f'        path: "{policies}"\n'
+    )
+    rec = tmp_path / "rec"
+    rec.mkdir()
+    (rec / "req-authorize-bad.json").write_text("{not json")
+    rc = replay_main([str(rec), "--config", str(config)])
+    assert rc == 1
+    assert "<error>" in capsys.readouterr().out
