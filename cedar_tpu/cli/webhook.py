@@ -45,14 +45,24 @@ log = logging.getLogger(__name__)
 
 
 def _fingerprint(stores: TieredPolicyStores) -> str:
-    from ..lang.format import format_policy
-
-    h = hashlib.sha256()
+    """Cheap change detector: stores expose a content generation counter
+    bumped only on real content change, so a steady-state tick costs a few
+    method calls instead of re-formatting the whole policy corpus. Stores
+    without the counter fall back to the content hash."""
+    parts = []
     for store in stores:
+        gen = getattr(store, "content_generation", None)
+        if gen is not None:
+            parts.append(f"{store.name()}@{gen()}")
+            continue
+        h = hashlib.sha256()
+        from ..lang.format import format_policy
+
         for p in store.policy_set().policies():
             h.update(p.policy_id.encode())
             h.update(format_policy(p).encode())
-    return h.hexdigest()
+        parts.append(h.hexdigest())
+    return "|".join(parts)
 
 
 class TPUReloader:

@@ -81,6 +81,7 @@ class VerifiedPermissionsPolicyStore:
         self._client = client or Boto3AVPClient(region, profile)
         self.refresh_interval_s = refresh_interval_s
         self._policies = PolicySet()
+        self._generation = 0
         self._lock = threading.Lock()
         self._load_complete = False
         self._stop = threading.Event()
@@ -98,15 +99,22 @@ class VerifiedPermissionsPolicyStore:
             self.load_policies()
 
     def load_policies(self) -> None:
+        import hashlib
+
         ps = PolicySet()
+        digest = hashlib.sha256()
         try:
-            ids = self._client.list_policy_ids(self.policy_store_id)
+            # sorted: ListPolicies pagination order is not canonical, and
+            # the digest must not depend on it
+            ids = sorted(self._client.list_policy_ids(self.policy_store_id))
             for pid in ids:
                 statement = self._client.get_policy_statement(
                     self.policy_store_id, pid
                 )
                 if not statement:
                     continue
+                digest.update(pid.encode())
+                digest.update(statement.encode())
                 try:
                     for i, p in enumerate(parse_policies(statement, pid)):
                         ps.add(p, policy_id=f"{pid}.policy{i}")
@@ -115,8 +123,12 @@ class VerifiedPermissionsPolicyStore:
         except Exception as e:
             log.error("AVP store load failed: %s", e)
             return
+        fp = digest.hexdigest()
         with self._lock:
             self._policies = ps
+            if fp != getattr(self, "_content_digest", None):
+                self._content_digest = fp
+                self._generation += 1
         self._load_complete = True
 
     def policy_set(self) -> PolicySet:
@@ -128,3 +140,7 @@ class VerifiedPermissionsPolicyStore:
 
     def name(self) -> str:
         return "VerifiedPermissionsStore"
+
+    def content_generation(self) -> int:
+        with self._lock:
+            return self._generation

@@ -64,6 +64,11 @@ class CRDPolicyStore:
         self._kubeconfig_context = kubeconfig_context
         self._policies = PolicySet()
         self._ids_by_object: dict = {}  # object name -> [policy ids]
+        # object name -> (uid, content): generation bumps ONLY when this
+        # map changes, so watch reconnect relists and metadata-only
+        # MODIFIED events never trigger a TPU recompile
+        self._content_by_object: dict = {}
+        self._generation = 0
         self._lock = threading.Lock()
         self._load_complete = False
         self._stop = threading.Event()
@@ -130,6 +135,7 @@ class CRDPolicyStore:
         with self._lock:
             ps = PolicySet()
             ids_by_object: dict = {}
+            content_by_object: dict = {}
             for obj in objs:
                 policies = self._parse(obj)
                 if policies is None:
@@ -140,8 +146,12 @@ class CRDPolicyStore:
                     ps.add(p, policy_id=pid)
                     ids.append(pid)
                 ids_by_object[obj.name] = ids
+                content_by_object[obj.name] = (obj.uid, obj.spec.content)
             self._policies = ps
             self._ids_by_object = ids_by_object
+            if content_by_object != self._content_by_object:
+                self._content_by_object = content_by_object
+                self._generation += 1
 
     def _dispatch(self, event_type: str, obj: PolicyObject) -> None:
         if event_type == "ADDED":
@@ -171,23 +181,20 @@ class CRDPolicyStore:
                 ps.add(p, policy_id=p.policy_id)
             mutate(ps)
             self._policies = ps
+            self._generation += 1
 
     def on_add(self, obj: PolicyObject) -> None:
-        policies = self._parse(obj)
-        if policies is None:
-            return
-
-        def mutate(ps: PolicySet) -> None:
-            ids = []
-            for i, p in enumerate(policies):
-                pid = f"{obj.name}{i}-{obj.uid}"
-                ps.add(p, policy_id=pid)
-                ids.append(pid)
-            self._ids_by_object[obj.name] = ids
-
-        self._copy_on_write(mutate)
+        self._upsert(obj)
 
     def on_update(self, obj: PolicyObject) -> None:
+        self._upsert(obj)
+
+    def _upsert(self, obj: PolicyObject) -> None:
+        """ADDED/MODIFIED share the semantics: replace the object's policies.
+        Metadata-only MODIFIED events (same uid + content) are no-ops — no
+        set rebuild, no generation bump, no recompile downstream."""
+        if self._content_by_object.get(obj.name) == (obj.uid, obj.spec.content):
+            return
         policies = self._parse(obj)
         if policies is None:
             return
@@ -201,13 +208,18 @@ class CRDPolicyStore:
                 ps.add(p, policy_id=pid)
                 ids.append(pid)
             self._ids_by_object[obj.name] = ids
+            self._content_by_object[obj.name] = (obj.uid, obj.spec.content)
 
         self._copy_on_write(mutate)
 
     def on_delete(self, obj: PolicyObject) -> None:
+        if obj.name not in self._ids_by_object:
+            return  # unknown object: nothing to remove, nothing changed
+
         def mutate(ps: PolicySet) -> None:
             for pid in self._ids_by_object.pop(obj.name, []):
                 ps.remove(pid)
+            self._content_by_object.pop(obj.name, None)
 
         self._copy_on_write(mutate)
 
@@ -222,6 +234,10 @@ class CRDPolicyStore:
 
     def name(self) -> str:
         return "CRDPolicyStore"
+
+    def content_generation(self) -> int:
+        with self._lock:
+            return self._generation
 
 
 # --------------------------------------------------------------- transport

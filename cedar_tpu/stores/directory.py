@@ -3,14 +3,20 @@
 Behavior parity with /root/reference internal/server/store/directory.go:
 ready immediately, errors logged-and-skipped per file, policy ids namespaced
 as "<filename>.policy<N>" (directory.go:75), atomic swap of the whole set.
+
+Parse results are cached per file by content hash, so a steady-state ticker
+reload of an unchanged 100k-policy directory costs file reads + hashes
+(~ms) instead of a full re-parse (~40s at that scale) — the parse-once
+analogue of the compiled-set hot-swap bucketing.
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import threading
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 from ..lang.authorize import PolicySet
 from ..lang.lexer import ParseError
@@ -30,6 +36,10 @@ class DirectoryPolicyStore:
         self.directory = directory
         self.refresh_interval_s = refresh_interval_s
         self._policies = PolicySet()
+        # (filename -> (content sha256, parsed policies)); entries for
+        # removed files are dropped each reload
+        self._parse_cache: Dict[str, Tuple[str, list]] = {}
+        self._generation = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._on_reload = on_reload
@@ -55,6 +65,7 @@ class DirectoryPolicyStore:
             log.error("Error reading policy directory: %s", e)
             return
         ps = PolicySet()
+        new_cache: Dict[str, Tuple[str, list]] = {}
         for name in entries:
             path = os.path.join(self.directory, name)
             if not os.path.isfile(path) or not name.endswith(".cedar"):
@@ -65,15 +76,27 @@ class DirectoryPolicyStore:
             except OSError as e:
                 log.error("Error reading policy file: %s", e)
                 continue
-            try:
-                policies = parse_policies(data, name)
-            except ParseError as e:
-                log.error("Error loading policy file %s: %s", name, e)
-                continue
+            digest = hashlib.sha256(data.encode()).hexdigest()
+            cached = self._parse_cache.get(name)
+            if cached is not None and cached[0] == digest:
+                policies = cached[1]
+            else:
+                try:
+                    policies = parse_policies(data, name)
+                except ParseError as e:
+                    log.error("Error loading policy file %s: %s", name, e)
+                    continue
+            new_cache[name] = (digest, policies)
             for i, p in enumerate(policies):
                 ps.add(p, policy_id=f"{name}.policy{i}")
+        changed = {n: d for n, (d, _) in new_cache.items()} != {
+            n: d for n, (d, _) in self._parse_cache.items()
+        }
+        self._parse_cache = new_cache
         with self._lock:
             self._policies = ps
+            if changed:
+                self._generation += 1
         if self._on_reload is not None:
             self._on_reload(self)
 
@@ -86,3 +109,7 @@ class DirectoryPolicyStore:
 
     def name(self) -> str:
         return "FilePolicyStore"
+
+    def content_generation(self) -> int:
+        with self._lock:
+            return self._generation

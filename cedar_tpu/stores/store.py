@@ -77,6 +77,12 @@ class MemoryStore:
     def name(self) -> str:
         return self._name
 
+    def content_generation(self) -> int:
+        """Monotonic counter bumped on content change; immutable stores are
+        always generation 0. Reloaders key recompilation on this instead of
+        re-hashing the policy corpus every tick."""
+        return 0
+
 
 class StaticStore(MemoryStore):
     """A bare PolicySet holder, always ready (reference memory.go:42-54)."""

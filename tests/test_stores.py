@@ -233,3 +233,96 @@ def test_cedar_config_stores_builds_tiers(tmp_path):
     tiers = cedar_config_stores(cfg)
     assert len(tiers) == 1
     assert len(tiers.stores[0].policy_set()) == 1
+
+
+def test_directory_store_parse_cache_and_generation(tmp_path):
+    """Unchanged files reuse parsed policy objects across ticker reloads
+    (the 40s-at-100k-policies parse is paid once), and the content
+    generation bumps only on real change."""
+    from cedar_tpu.stores.directory import DirectoryPolicyStore
+
+    (tmp_path / "a.cedar").write_text(
+        "permit (principal, action, resource);"
+    )
+    store = DirectoryPolicyStore(str(tmp_path), start_ticker=False)
+    gen0 = store.content_generation()
+    p0 = store.policy_set().policies()[0]
+
+    store.load_policies()  # no change: cached parse, same generation
+    assert store.content_generation() == gen0
+    assert store.policy_set().policies()[0] is p0
+
+    (tmp_path / "a.cedar").write_text(
+        "forbid (principal, action, resource);"
+    )
+    store.load_policies()
+    assert store.content_generation() == gen0 + 1
+    assert store.policy_set().policies()[0].effect == "forbid"
+
+    (tmp_path / "b.cedar").write_text(
+        "permit (principal, action, resource);"
+    )
+    store.load_policies()
+    assert store.content_generation() == gen0 + 2
+    (tmp_path / "b.cedar").unlink()
+    store.load_policies()  # removal is a content change too
+    assert store.content_generation() == gen0 + 3
+    store.close()
+
+
+def test_reloader_fingerprint_uses_generations(tmp_path):
+    """The webhook reloader's fingerprint keys on store generations and
+    changes exactly when a store's content changes."""
+    from cedar_tpu.cli.webhook import _fingerprint
+    from cedar_tpu.stores.directory import DirectoryPolicyStore
+    from cedar_tpu.stores.store import TieredPolicyStores
+
+    (tmp_path / "a.cedar").write_text("permit (principal, action, resource);")
+    store = DirectoryPolicyStore(str(tmp_path), start_ticker=False)
+    stores = TieredPolicyStores([store])
+    fp1 = _fingerprint(stores)
+    store.load_policies()
+    assert _fingerprint(stores) == fp1  # unchanged content, unchanged fp
+    (tmp_path / "a.cedar").write_text("forbid (principal, action, resource);")
+    store.load_policies()
+    assert _fingerprint(stores) != fp1
+    store.close()
+
+
+def test_crd_store_generation_only_on_content_change():
+    """Metadata-only MODIFIED events, deletes of unknown objects, and
+    unchanged relists must NOT bump the content generation — every bump is
+    a full TPU recompile downstream."""
+    store = CRDPolicyStore(start=False)
+    g0 = store.content_generation()
+    store.on_add(pol("p1", "uid-1", PERMIT))
+    assert store.content_generation() == g0 + 1
+    # metadata-only MODIFIED (same uid + content): no-op
+    store.on_update(pol("p1", "uid-1", PERMIT))
+    assert store.content_generation() == g0 + 1
+    # real content change
+    store.on_update(pol("p1", "uid-1", FORBID))
+    assert store.content_generation() == g0 + 2
+    # delete of an unknown object: no-op
+    store.on_delete(pol("ghost", "uid-9", ""))
+    assert store.content_generation() == g0 + 2
+    store.on_delete(pol("p1", "uid-1", ""))
+    assert store.content_generation() == g0 + 3
+
+
+def test_crd_store_relist_same_content_no_bump():
+    class StaticSource:
+        def list(self):
+            return [pol("a", "u1", PERMIT)]
+
+        def reset_resource_version(self):
+            pass
+
+        def watch(self, on_event, stop):
+            stop.wait(0.1)
+
+    store = CRDPolicyStore(source=StaticSource(), start=False)
+    store._relist()
+    g1 = store.content_generation()
+    store._relist()  # watch-reconnect relist, identical content
+    assert store.content_generation() == g1
