@@ -101,8 +101,8 @@ class VerifiedPermissionsPolicyStore:
     def load_policies(self) -> None:
         import hashlib
 
-        ps = PolicySet()
         digest = hashlib.sha256()
+        statements = []
         try:
             # sorted: ListPolicies pagination order is not canonical, and
             # the digest must not depend on it
@@ -113,22 +113,32 @@ class VerifiedPermissionsPolicyStore:
                 )
                 if not statement:
                     continue
+                # length prefixes keep (pid, statement) boundaries
+                # unambiguous in the digest
+                digest.update(f"{len(pid)}:".encode())
                 digest.update(pid.encode())
+                digest.update(f"{len(statement)}:".encode())
                 digest.update(statement.encode())
-                try:
-                    for i, p in enumerate(parse_policies(statement, pid)):
-                        ps.add(p, policy_id=f"{pid}.policy{i}")
-                except ParseError as e:
-                    log.error("AVP policy %s parse error: %s", pid, e)
+                statements.append((pid, statement))
         except Exception as e:
             log.error("AVP store load failed: %s", e)
             return
         fp = digest.hexdigest()
+        if fp == getattr(self, "_content_digest", None):
+            # unchanged corpus: skip the re-parse entirely
+            self._load_complete = True
+            return
+        ps = PolicySet()
+        for pid, statement in statements:
+            try:
+                for i, p in enumerate(parse_policies(statement, pid)):
+                    ps.add(p, policy_id=f"{pid}.policy{i}")
+            except ParseError as e:
+                log.error("AVP policy %s parse error: %s", pid, e)
         with self._lock:
             self._policies = ps
-            if fp != getattr(self, "_content_digest", None):
-                self._content_digest = fp
-                self._generation += 1
+            self._content_digest = fp
+            self._generation += 1
         self._load_complete = True
 
     def policy_set(self) -> PolicySet:

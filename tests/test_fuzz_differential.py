@@ -57,9 +57,17 @@ def _gen_condition(rng: random.Random) -> str:
         )
     if kind < 0.85:
         return "resource has subresource"
-    if kind < 0.93:
+    if kind < 0.9:
         # interpreter-fallback join: two request-time unknowns
         return "resource has name && resource.name == principal.name"
+    if kind < 0.96:
+        # UNGUARDED optional-attribute access: errors when the attribute is
+        # absent — exercises Cedar's policy-error semantics (the policy is
+        # skipped but surfaces in diagnostics) through the error clauses
+        return (
+            f'resource.{rng.choice(["namespace", "name", "subresource"])} == '
+            f'"{rng.choice(NAMESPACES[1:] + ["alice"])}"'
+        )
     return 'principal.name == "alice" && context has nothing'
 
 
