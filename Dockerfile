@@ -21,6 +21,10 @@ COPY --from=build /src/cedar_tpu /app/cedar_tpu
 COPY cedarschema/ /app/cedarschema/
 WORKDIR /app
 ENV PYTHONUNBUFFERED=1
+# must match the build stage: ensure_built() keys the .so filename on the
+# arch, and the runtime image has no g++ to rebuild — without this the
+# webhook would silently fall back to the pure-Python path
+ENV CEDAR_NATIVE_ARCH=x86-64
 EXPOSE 10288 10289
 ENTRYPOINT ["python", "-m", "cedar_tpu.cli.webhook"]
 CMD ["--config", "/cedar-authorizer/cedar-config.yaml", "--backend", "tpu", \

@@ -102,6 +102,11 @@ class MicroBatcher:
                     slot.result = res
                     slot.event.set()
             except BaseException as e:  # noqa: BLE001 — propagate per-item
+                # one fresh exception per slot: sharing a single exception
+                # object (and its traceback) across request threads interleaves
+                # tracebacks and leaks one request's error text into others
                 for _, slot in batch:
-                    slot.error = e
+                    err = RuntimeError(f"batch evaluation failed: {e!r}")
+                    err.__cause__ = e  # keep the original traceback reachable
+                    slot.error = err
                     slot.event.set()

@@ -16,7 +16,8 @@ Python path.
 from __future__ import annotations
 
 import logging
-from typing import Callable, List, Optional, Sequence, Tuple
+import threading
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -61,6 +62,19 @@ _GATE_RESULTS = {
 Result = Tuple[str, str, Optional[str]]
 
 
+class _Snapshot(NamedTuple):
+    """Immutable (encoder, compiled set, reason cache) triple.
+
+    Request threads and the batcher thread both read it with one attribute
+    load, so a policy hot swap can never pair the old encoder's codes with
+    the new compiled set's activation tables, and reason-cache entries can
+    never leak across swaps (each snapshot owns its cache dict)."""
+
+    encoder: Optional[NativeEncoder]
+    cs: object  # the _CompiledSet the encoder was built on
+    reason_cache: dict  # policy index -> reason JSON (guarded by GIL appends)
+
+
 class SARFastPath:
     """Batch evaluator over raw SubjectAccessReview JSON bodies."""
 
@@ -73,50 +87,62 @@ class SARFastPath:
         self.engine = engine
         self.authorizer = authorizer
         self._fallback = fallback or self._python_fallback
-        self._encoder: Optional[NativeEncoder] = None
-        self._encoder_for = None  # the _CompiledSet the encoder was built on
-        self._reason_cache: dict = {}  # policy index -> reason JSON
+        self._snap: Optional[_Snapshot] = None
+        self._build_lock = threading.Lock()
 
     # ---------------------------------------------------------- availability
 
-    def _current_encoder(self) -> Optional[NativeEncoder]:
-        """(Re)build the native encoder when the compiled set changes (policy
-        hot swap); None when the set or environment rules the fast path out."""
+    def _current_snapshot(self) -> Optional[_Snapshot]:
+        """Atomic snapshot for the engine's current compiled set, rebuilding
+        the native encoder when the set changes (policy hot swap); None when
+        the set or environment rules the fast path out."""
         cs = self.engine._compiled
         if cs is None:
             return None
         if cs.packed.fallback:
             # interpreter-fallback policies need Python entities per request
             return None
-        if self._encoder_for is not cs:
-            try:
-                self._encoder = NativeEncoder.create(cs.packed)
-            except Exception:  # noqa: BLE001 — cache the failure, don't loop
-                log.exception("native encoder build failed; python path only")
-                self._encoder = None
-            self._encoder_for = cs
-            self._reason_cache = {}
-        return self._encoder
+        snap = self._snap  # lock-free fast path: one atomic attribute read
+        if snap is not None and snap.cs is cs:
+            return snap if snap.encoder is not None else None
+        with self._build_lock:
+            # re-read under the lock: a hot swap may have landed (and another
+            # thread may have built its snapshot) while we waited; building
+            # for the stale cs would evict the fresh snapshot and thrash
+            cs = self.engine._compiled
+            if cs is None or cs.packed.fallback:
+                return None
+            snap = self._snap
+            if snap is None or snap.cs is not cs:
+                try:
+                    encoder = NativeEncoder.create(cs.packed)
+                except Exception:  # noqa: BLE001 — cache the failure, don't loop
+                    log.exception("native encoder build failed; python path only")
+                    encoder = None
+                snap = _Snapshot(encoder, cs, {})
+                self._snap = snap
+        return snap if snap.encoder is not None else None
 
-    def _reason(self, packed, pol: int) -> str:
-        """Reason JSON for a single-policy match; cached — it depends only
-        on the policy index within one compiled set."""
-        r = self._reason_cache.get(pol)
+    @staticmethod
+    def _reason(snap: _Snapshot, pol: int) -> str:
+        """Reason JSON for a single-policy match; cached on the snapshot — it
+        depends only on the policy index within that compiled set."""
+        r = snap.reason_cache.get(pol)
         if r is None:
             from ..lang.authorize import Diagnostics, Reason
 
-            meta = packed.policy_meta[pol]
+            meta = snap.cs.packed.policy_meta[pol]
             r = _diagnostic_to_reason(
                 Diagnostics(
                     reasons=[Reason(meta.policy_id, meta.filename, meta.position)]
                 )
             )
-            self._reason_cache[pol] = r
+            snap.reason_cache[pol] = r
         return r
 
     @property
     def available(self) -> bool:
-        return self._current_encoder() is not None
+        return self._current_snapshot() is not None
 
     # ------------------------------------------------------------ evaluation
 
@@ -127,7 +153,7 @@ class SARFastPath:
 
         try:
             sar = json.loads(body)
-        except (ValueError, TypeError) as e:
+        except (ValueError, TypeError, RecursionError) as e:
             return (
                 DECISION_NO_OPINION,
                 "Encountered decoding error",
@@ -143,12 +169,10 @@ class SARFastPath:
 
     def authorize_raw(self, bodies: Sequence[bytes]) -> List[Result]:
         """Evaluate a batch of raw SAR JSON bodies -> (decision, reason)."""
-        encoder = self._current_encoder()
-        # snapshot the compiled set the encoder was built on: a policy hot
-        # swap mid-batch must not re-map codes through the new set's tables
-        cs = self._encoder_for
-        if encoder is None:
+        snap = self._current_snapshot()
+        if snap is None:
             return [self._fallback(b) for b in bodies]
+        encoder, cs = snap.encoder, snap.cs
         if not self.authorizer.ready():
             # NoOpinion until every store's initial load completes
             # (authorizer.go:58-66); gates still apply, so run the exact path
@@ -208,9 +232,9 @@ class SARFastPath:
                 for k, i in enumerate(idx.tolist()):
                     c = vcodes[k]
                     if c == 1:
-                        results[i] = (DECISION_ALLOW, reason(packed, pols[k]), None)
+                        results[i] = (DECISION_ALLOW, reason(snap, pols[k]), None)
                     elif c == 2:
-                        results[i] = (DECISION_DENY, reason(packed, pols[k]), None)
+                        results[i] = (DECISION_DENY, reason(snap, pols[k]), None)
                     elif c == 3:
                         meta = packed.policy_meta[pols[k]]
                         log.error(

@@ -255,7 +255,23 @@ class JsonParser {
     return false;  // unterminated
   }
 
+  // Nesting cap: a hostile body of 1MB of '[' would otherwise recurse once
+  // per byte and overflow the native stack (no RecursionError here — the
+  // whole webhook process would segfault). Beyond the cap the parse fails,
+  // the row gets F_PARSE_ERROR, and the caller falls back to the Python
+  // path, whose json.loads raises a handled RecursionError.
+  static constexpr int kMaxDepth = 256;
+  int depth_ = 0;
+
   JVal *container(bool is_obj) {
+    if (depth_ >= kMaxDepth) return nullptr;
+    ++depth_;
+    JVal *v = container_body(is_obj);
+    --depth_;
+    return v;
+  }
+
+  JVal *container_body(bool is_obj) {
     ++p_;  // '{' or '['
     JVal *v = arena_.alloc();
     v->kind = is_obj ? JVal::OBJ : JVal::ARR;

@@ -50,6 +50,10 @@ log = logging.getLogger(__name__)
 DEFAULT_ADDRESS = "127.0.0.1"
 DEFAULT_PORT = 10288
 METRICS_PORT = 10289
+# Accepted POST body cap; the apiserver caps its own request payloads at
+# ~3MiB, so 8MiB leaves headroom while bounding hostile bodies (which could
+# otherwise drive deep-nesting parse attacks or exhaust memory).
+MAX_BODY_BYTES = 8 * 1024 * 1024
 
 _DECISION_LABEL = {
     DECISION_ALLOW: "Allow",
@@ -261,7 +265,7 @@ class WebhookServer:
                 return sar_response(decision, reason, error)
             try:
                 sar = json.loads(body)
-            except (ValueError, TypeError) as e:
+            except (ValueError, TypeError, RecursionError) as e:
                 error = f"failed parsing request body: {e}"
                 return sar_response(
                     DECISION_NO_OPINION, "Encountered decoding error", error
@@ -292,7 +296,7 @@ class WebhookServer:
     def handle_admit(self, body: bytes) -> dict:
         try:
             review = json.loads(body)
-        except (ValueError, TypeError) as e:
+        except (ValueError, TypeError, RecursionError) as e:
             return AdmissionResponse(
                 uid="", allowed=False, code=400, error=f"failed parsing body: {e}"
             ).to_admission_review()
@@ -333,7 +337,17 @@ class WebhookServer:
                 self.wfile.write(data)
 
             def do_POST(self):
-                length = int(self.headers.get("Content-Length") or 0)
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    self.send_error(400, "bad Content-Length")
+                    return
+                if length < 0 or length > MAX_BODY_BYTES:
+                    # 413 rather than reading an unbounded body into memory;
+                    # real SAR/AdmissionReview payloads are far below the cap
+                    # (apiserver itself limits request sizes to ~3MB).
+                    self.send_error(413, "request body too large")
+                    return
                 body = self.rfile.read(length) if length else b""
                 if server.recorder is not None:
                     server.recorder.record(self.path, body)

@@ -251,6 +251,26 @@ def test_fastpath_parse_error_falls_back():
     assert res[1][2] is None
 
 
+def test_native_parser_depth_limit_no_crash():
+    """A deeply nested body (1M of '[') must not overflow the C++ stack: the
+    native parse fails at the depth cap, the row gets F_PARSE_ERROR, and the
+    fast path answers through the Python fallback instead of segfaulting."""
+    engine = TPUPolicyEngine()
+    engine.load(_policy_tiers())
+    stores = TieredPolicyStores([MemoryStore.from_source("t0", POLICIES)])
+    authorizer = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, authorizer)
+    deep = b"[" * 1_000_000
+    # also a value-position bomb nested inside an otherwise-valid SAR
+    nested = b'{"spec": {"extra": {"k": ' + b"[" * 500_000 + b"x"
+    good = json.dumps(_random_sar(random.Random(3))).encode()
+    res = fastpath.authorize_raw([deep, nested, good])
+    assert res[0][0] == "no_opinion"
+    assert res[0][2] is not None  # decode error reported, process alive
+    assert res[1][0] == "no_opinion"
+    assert res[2][2] is None
+
+
 def test_fastpath_unready_stores():
     class NeverReady(MemoryStore):
         def initial_policy_load_complete(self):
@@ -323,7 +343,9 @@ def test_microbatcher_propagates_errors():
         raise ValueError("boom")
 
     mb = MicroBatcher(fn, window_s=0.0001)
-    with pytest.raises(ValueError):
+    # each submitter gets a fresh wrapper exception (no shared traceback
+    # state across request threads), carrying the original cause text
+    with pytest.raises(RuntimeError, match="batch evaluation failed.*boom"):
         mb.submit(1)
     mb.stop()
 

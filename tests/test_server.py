@@ -200,6 +200,34 @@ class TestWebhookHTTP:
             assert doc["status"]["denied"] is False
             assert "evaluationError" in doc["status"]
 
+    def test_oversized_body_rejected_413(self, server):
+        # bodies beyond MAX_BODY_BYTES are refused before being read into
+        # memory (deep-nesting / memory-exhaustion DoS hardening)
+        from cedar_tpu.server.http import MAX_BODY_BYTES
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.bound_port}/v1/authorize",
+            data=b"x" * 16,
+            headers={"Content-Length": str(MAX_BODY_BYTES + 1)},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc_info.value.code == 413
+
+    def test_deeply_nested_body_answered(self, server):
+        # 500k of '[' parses to a RecursionError in json.loads; the handler
+        # must answer with a decode-error SAR response, not drop the thread
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.bound_port}/v1/authorize",
+            data=b"[" * 500_000,
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert doc["status"]["allowed"] is False
+        assert "evaluationError" in doc["status"]
+
     def test_admit_malformed_request_allows_on_error(self, server):
         # fail-open admission: a body that crashes conversion yields
         # allowed=true with the error recorded, mirroring allowOnError=true
