@@ -19,11 +19,15 @@ diagnostics) contract as TieredPolicyStores.is_authorized), with:
   * pipelined batching: large batches are split into sub-batches whose
     transfers/compute/readbacks overlap (`copy_to_host_async`), hiding the
     host<->device round-trip latency.
-  * diagnostics: the device reports the first matching policy per
-    (tier, effect); interpreter-backed tiers report exact reason lists. The
-    reference's reason *ordering* is not a contract (cedar-go iterates a Go
-    map), but callers that need the full matched set should use the
-    interpreter backend.
+  * diagnostics: EXACT matched-policy sets, like cedar-go's
+    Diagnostic.Reasons (/root/reference internal/server/store/store.go:31,
+    rendered into admission deny messages at
+    internal/server/admission/handler.go:157-164). The verdict word's multi
+    bit flags rows where more than one policy matched the deciding group;
+    only those rows (plus err-bit rows) pay a second device call for the
+    per-rule bitset (ops/match.py match_rules_codes_bits), from which the
+    host recovers every determining policy. Reason *ordering* is not a
+    contract (cedar-go iterates a Go map); sets are exact.
 
 Tier semantics mirror /root/reference internal/server/store/store.go:25-42:
 first tier with any explicit signal (reasons or errors) wins; the last
@@ -60,8 +64,11 @@ from ..ops.match import (
     CODE_NONE,
     INT32_MAX,
     POLICY_NONE,
+    WORD_ERR,
+    WORD_MULTI,
     chunk_rules,
     match_rules_codes,
+    match_rules_codes_bits,
     match_rules_codes_pallas,
 )
 
@@ -150,6 +157,16 @@ class TPUPolicyEngine:
         compiled: CompiledPolicies = lower_tiers(list(tiers), self.schema)
         packed = pack(compiled)
         new = _CompiledSet(packed, self.device, use_pallas=self.use_pallas)
+        # warm the diagnostics bitset kernel now: its first caller is an
+        # unpredictable live request (the first multi-match/err row), and a
+        # fresh XLA trace+compile inside the webhook's deadline would stall
+        # that batch — bound the cost to load time instead
+        try:
+            warm_c = np.zeros((1, packed.table.n_slots), dtype=new.code_dtype)
+            warm_e = np.full((1, 1), packed.L, dtype=new.active_dtype)
+            self.match_bits_arrays(warm_c, warm_e, cs=new)
+        except Exception:  # noqa: BLE001 — warmup must never block a swap
+            pass
         with self._lock:
             self._compiled = new
         return {**compiled.stats(), "L": packed.L, "R": packed.R}
@@ -190,21 +207,92 @@ class TPUPolicyEngine:
             encode_request_codes(packed.plan, packed.table, em, req)
             for em, req in items
         ]
-        want_full = bool(packed.fallback)
-        words, full = self._device_match(cs, encoded, want_full)
+        codes_arr, extras_arr = self._encode_batch_arrays(
+            cs, encoded, len(encoded)
+        )
 
-        if not want_full and bool(np.any((words >> 29) & 0x1)):
-            # a policy errored alongside a real match: refetch per-group
-            # matrix for exact error attribution (rare)
-            words, full = self._device_match(cs, encoded, True)
+        if packed.fallback:
+            # interpreter-fallback policies can flip earlier tiers, so the
+            # device tier walk is not authoritative: walk tiers host-side.
+            # The (first, last) matrices give exact per-group sets wherever
+            # min == max (at most one distinct policy); only genuinely multi
+            # rows pay the [*, R/32] bitset fetch.
+            _, full = self.match_arrays(
+                codes_arr, extras_arr, want_full=True, cs=cs
+            )
+            first, last = full
+            multi = np.nonzero(
+                ((first != last) & (first != INT32_MAX)).any(axis=1)
+            )[0]
+            bits_groups = {}
+            if multi.size:
+                bits = self.match_bits_arrays(
+                    codes_arr[multi], extras_arr[multi], cs=cs
+                )
+                for k, i in enumerate(multi.tolist()):
+                    bits_groups[i] = self._bits_groups(packed, bits[k])
+            return [
+                self._finalize_sets(
+                    packed,
+                    bits_groups.get(i) or self._first_groups(packed, first[i]),
+                    em,
+                    req,
+                )
+                for i, (em, req) in enumerate(items)
+            ]
+
+        words, _ = self.match_arrays(codes_arr, extras_arr, cs=cs)
+        resolved = self.resolve_flagged(words, codes_arr, extras_arr, cs=cs)
 
         results: List[Tuple[str, Diagnostics]] = []
-        for i, (em, req) in enumerate(items):
-            if full is not None:
-                results.append(self._finalize_full(packed, full[i], em, req))
+        for i in range(len(items)):
+            if i in resolved:
+                results.append(resolved[i])
             else:
                 results.append(self._finalize_packed(packed, int(words[i])))
         return results
+
+    def resolve_flagged(
+        self,
+        words: np.ndarray,
+        codes_arr: np.ndarray,
+        extras_arr: np.ndarray,
+        cs: Optional["_CompiledSet"] = None,
+    ) -> dict:
+        """Resolve rows whose verdict word cannot carry complete
+        diagnostics — multiple distinct policies matched the deciding group
+        (multi bit) or a policy errored alongside a real match (err bit) —
+        by fetching rule bitsets for JUST those rows. Returns {row index:
+        (decision, Diagnostics)} with the full reason/error sets; rows not
+        in the dict are exactly described by their 4-byte word."""
+        cs = cs or self._compiled
+        packed = cs.packed
+        w = words.astype(np.uint32)
+        need = np.nonzero((w & (WORD_ERR | WORD_MULTI)) != 0)[0]
+        out: dict = {}
+        if need.size:
+            bits = self.match_bits_arrays(
+                codes_arr[need], extras_arr[need], cs=cs
+            )
+            for k, i in enumerate(need.tolist()):
+                groups = self._bits_groups(packed, bits[k])
+                out[i] = self._finalize_sets(packed, groups, None, None)
+        return out
+
+    @staticmethod
+    def _pad_to_bucket(chunk_c, chunk_e, pad_L: int):
+        """Pad a (codes, extras) chunk up to the next batch bucket (bucketed
+        shapes keep the jitted executables retrace-free). Extras pad with
+        >= L so padding rows activate nothing."""
+        m = chunk_c.shape[0]
+        B = _round_bucket(m, _BATCH_BUCKETS)
+        if B == m:
+            return chunk_c, chunk_e
+        pc = np.zeros((B, chunk_c.shape[1]), dtype=chunk_c.dtype)
+        pc[:m] = chunk_c
+        pe = np.full((B, chunk_e.shape[1]), pad_L, dtype=chunk_e.dtype)
+        pe[:m] = chunk_e
+        return pc, pe
 
     def match_arrays(
         self,
@@ -212,10 +300,11 @@ class TPUPolicyEngine:
         extras_arr: np.ndarray,
         want_full: bool = False,
         cs: Optional["_CompiledSet"] = None,
-    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    ):
         """Device-match pre-encoded feature codes (e.g. from the native
         encoder): codes [n, S], extras [n, E] (padded with >= L). Returns
-        (packed verdict words [n] uint32, full [n, G] int32 or None).
+        (packed verdict words [n] uint32, full) where full is None or, with
+        want_full, an ([n, G] first-match, [n, G] match-count) int32 pair.
         Handles batch bucketing, dtype narrowing, and sub-batch pipelining.
 
         `cs` pins the compiled set the codes were encoded against — callers
@@ -237,16 +326,8 @@ class TPUPolicyEngine:
         extras_arr = extras_arr.astype(cs.active_dtype, copy=False)
 
         def one(chunk_c, chunk_e):
-            m = chunk_c.shape[0]
-            B = _round_bucket(m, _BATCH_BUCKETS)
-            if B != m:
-                pc = np.zeros((B, chunk_c.shape[1]), dtype=chunk_c.dtype)
-                pc[:m] = chunk_c
-                pe = np.full(
-                    (B, chunk_e.shape[1]), packed.L, dtype=chunk_e.dtype
-                )
-                pe[:m] = chunk_e
-                chunk_c, chunk_e = pc, pe
+            chunk_c, chunk_e = self._pad_to_bucket(chunk_c, chunk_e, packed.L)
+            B = chunk_c.shape[0]
             if cs.pallas_args is not None:
                 from ..ops.pallas_match import pallas_supported
 
@@ -264,9 +345,12 @@ class TPUPolicyEngine:
                 chunk_c, chunk_e, *args, packed.n_tiers, want_full
             )
 
+        def trim_full(f, m):
+            return (np.asarray(f[0])[:m], np.asarray(f[1])[:m])
+
         if n <= _PIPELINE_MIN:
             w, f = one(codes_arr, extras_arr)
-            return np.asarray(w)[:n], (np.asarray(f)[:n] if want_full else None)
+            return np.asarray(w)[:n], (trim_full(f, n) if want_full else None)
 
         outs = []
         for lo in range(0, n, _PIPELINE_SB):
@@ -274,15 +358,58 @@ class TPUPolicyEngine:
             w, f = one(codes_arr[lo:hi], extras_arr[lo:hi])
             w.copy_to_host_async()
             if f is not None:
-                f.copy_to_host_async()
+                f[0].copy_to_host_async()
+                f[1].copy_to_host_async()
             outs.append((hi - lo, w, f))
         words = np.concatenate([np.asarray(w)[:m] for m, w, _ in outs])
-        full = (
-            np.concatenate([np.asarray(f)[:m] for m, _, f in outs])
-            if want_full
-            else None
-        )
+        full = None
+        if want_full:
+            parts = [trim_full(f, m) for m, _, f in outs]
+            full = (
+                np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]),
+            )
         return words, full
+
+    def match_bits_arrays(
+        self,
+        codes_arr: np.ndarray,
+        extras_arr: np.ndarray,
+        cs: Optional["_CompiledSet"] = None,
+    ) -> np.ndarray:
+        """Per-rule satisfaction bitsets [n, R // 32] uint32 for the given
+        pre-encoded rows. Diagnostic path only — callers select the few rows
+        whose verdict words carry the multi/err flags first. Batches beyond
+        the top bucket split into pipelined sub-batches like match_arrays."""
+        cs = cs or self._compiled
+        if cs is None:
+            raise RuntimeError("TPUPolicyEngine: no policy set loaded")
+        packed = cs.packed
+        n = codes_arr.shape[0]
+        codes_arr = codes_arr.astype(cs.code_dtype, copy=False)
+        extras_arr = extras_arr.astype(cs.active_dtype, copy=False)
+
+        def one(chunk_c, chunk_e):
+            chunk_c, chunk_e = self._pad_to_bucket(chunk_c, chunk_e, packed.L)
+            return match_rules_codes_bits(
+                chunk_c,
+                chunk_e,
+                cs.act_rows_dev,
+                cs.W_dev,
+                cs.thresh_dev,
+                cs.rule_group_dev,
+                cs.rule_policy_dev,
+            )
+
+        if n <= _PIPELINE_SB:
+            return np.asarray(one(codes_arr, extras_arr))[:n]
+        outs = []
+        for lo in range(0, n, _PIPELINE_SB):
+            hi = min(lo + _PIPELINE_SB, n)
+            b = one(codes_arr[lo:hi], extras_arr[lo:hi])
+            b.copy_to_host_async()
+            outs.append((hi - lo, b))
+        return np.concatenate([np.asarray(b)[:m] for m, b in outs])
 
     # ---------------------------------------------------------- device path
 
@@ -307,16 +434,6 @@ class TPUPolicyEngine:
                 extras_arr[i, : len(e)] = e
         return codes_arr, extras_arr
 
-    def _device_match(
-        self, cs: _CompiledSet, encoded, want_full: bool
-    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
-        """Returns (packed verdict words [n] uint32, full [n, G] int32 or
-        None). Builds padded arrays and delegates to match_arrays."""
-        codes_arr, extras_arr = self._encode_batch_arrays(
-            cs, encoded, len(encoded)
-        )
-        return self.match_arrays(codes_arr, extras_arr, want_full, cs=cs)
-
     # ------------------------------------------------- fallback + tier walk
 
     def _finalize_packed(
@@ -339,18 +456,49 @@ class TPUPolicyEngine:
         decision = DENY if code == CODE_DENY else ALLOW
         return decision, Diagnostics(reasons=[reason])
 
-    def _finalize_full(
+    @staticmethod
+    def _first_groups(packed: PackedPolicySet, first_row: np.ndarray) -> dict:
+        """{group id: [policy index]} from one first-match row — exact when
+        every group matched at most one rule (the caller checks counts)."""
+        return {
+            g: [int(p)]
+            for g, p in enumerate(first_row.tolist())
+            if p != INT32_MAX
+        }
+
+    @staticmethod
+    def _bits_groups(packed: PackedPolicySet, bits_row: np.ndarray) -> dict:
+        """Decode one rule bitset row -> {group id: [policy indices,
+        ascending]} with every matched policy (deduped across the several
+        DNF rules one policy may lower to)."""
+        mask = np.unpackbits(
+            np.ascontiguousarray(bits_row).view(np.uint8), bitorder="little"
+        )[: packed.R].astype(bool)
+        idx = np.nonzero(mask)[0]
+        pols = packed.rule_policy[idx]
+        grps = packed.rule_group[idx]
+        valid = pols != INT32_MAX  # padding rules can never match, belt+braces
+        out: dict = {}
+        for g, p in zip(grps[valid].tolist(), pols[valid].tolist()):
+            out.setdefault(g, set()).add(p)
+        return {g: sorted(s) for g, s in out.items()}
+
+    def _finalize_sets(
         self,
         packed: PackedPolicySet,
-        first_row: np.ndarray,
-        entities: EntityMap,
-        request: Request,
+        groups: dict,
+        entities: Optional[EntityMap],
+        request: Optional[Request],
     ) -> Tuple[str, Diagnostics]:
+        """Host tier walk over COMPLETE per-group policy sets (from
+        _bits_groups), merged with interpreter-fallback verdicts when
+        entities/request are given. Mirrors PolicySet.is_authorized +
+        TieredPolicyStores semantics with full reason lists."""
         T = packed.n_tiers
         fb_allow: List[List[Reason]] = [[] for _ in range(T)]
         fb_deny: List[List[Reason]] = [[] for _ in range(T)]
         fb_errors: List[List[str]] = [[] for _ in range(T)]
-        if packed.fallback:
+        if packed.fallback and entities is not None:
             env = Env(request, entities)
             for fp in packed.fallback:
                 p = fp.policy
@@ -367,24 +515,19 @@ class TPUPolicyEngine:
 
         for t in range(T):
             base = t * GROUPS_PER_TIER
-            permit_g, forbid_g, error_g = (
-                base + PERMIT_IDX,
-                base + FORBID_IDX,
-                base + ERROR_IDX,
-            )
-            deny_reasons = list(fb_deny[t])
-            if first_row[forbid_g] != INT32_MAX:
-                deny_reasons.insert(0, self._meta_reason(packed, first_row[forbid_g]))
-            allow_reasons = list(fb_allow[t])
-            if first_row[permit_g] != INT32_MAX:
-                allow_reasons.insert(0, self._meta_reason(packed, first_row[permit_g]))
-            errors = list(fb_errors[t])
-            if first_row[error_g] != INT32_MAX:
-                meta = packed.policy_meta[int(first_row[error_g])]
-                errors.insert(
-                    0,
-                    f"while evaluating policy `{meta.policy_id}`: evaluation error",
-                )
+            deny_reasons = [
+                self._meta_reason(packed, i)
+                for i in groups.get(base + FORBID_IDX, ())
+            ] + fb_deny[t]
+            allow_reasons = [
+                self._meta_reason(packed, i)
+                for i in groups.get(base + PERMIT_IDX, ())
+            ] + fb_allow[t]
+            errors = [
+                f"while evaluating policy "
+                f"`{packed.policy_meta[i].policy_id}`: evaluation error"
+                for i in groups.get(base + ERROR_IDX, ())
+            ] + fb_errors[t]
             if deny_reasons:
                 return DENY, Diagnostics(reasons=deny_reasons, errors=errors)
             if allow_reasons:

@@ -209,42 +209,43 @@ class SARFastPath:
             ok_extras = extras[:, :E] if all_ok else extras[idx, :E]
             words, _ = self.engine.match_arrays(ok_codes, ok_extras, cs=cs)
             packed = cs.packed
-            if bool(np.any((words >> 29) & 0x1)):
-                # rare: a policy errored alongside a real match; refetch the
-                # per-group matrix for exact error attribution
-                _, full = self.engine.match_arrays(
-                    ok_codes, ok_extras, want_full=True, cs=cs
-                )
-                for k, i in enumerate(idx):
-                    decision, diag = self.engine._finalize_full(
-                        packed, full[k], None, None
+            w = words.astype(np.uint32)
+            # rows whose 4-byte word can't carry complete diagnostics
+            # (multiple matched policies in the deciding group, or an error
+            # alongside a real match): the engine fetches rule bitsets for
+            # JUST those rows and renders the full set like cedar-go does
+            resolved = self.engine.resolve_flagged(
+                words, ok_codes, ok_extras, cs=cs
+            )
+            handled = set()
+            for sel, (decision, diag) in resolved.items():
+                results[int(idx[sel])] = self._map_decision(decision, diag)
+                handled.add(sel)
+            # vectorized verdict decode for the rest: one tuple per row,
+            # reason JSON from the per-policy cache; plain-list iteration
+            # beats numpy scalar indexing at this row count
+            vcodes = ((w >> 30) & 0x3).tolist()
+            pols = (w & 0xFFFFFF).tolist()
+            noop = (DECISION_NO_OPINION, "", None)
+            reason = self._reason
+            for k, i in enumerate(idx.tolist()):
+                if k in handled:
+                    continue
+                c = vcodes[k]
+                if c == 1:
+                    results[i] = (DECISION_ALLOW, reason(snap, pols[k]), None)
+                elif c == 2:
+                    results[i] = (DECISION_DENY, reason(snap, pols[k]), None)
+                elif c == 3:
+                    meta = packed.policy_meta[pols[k]]
+                    log.error(
+                        "Authorize errors: while evaluating policy `%s`:"
+                        " evaluation error",
+                        meta.policy_id,
                     )
-                    results[i] = self._map_decision(decision, diag)
-            else:
-                # vectorized verdict decode: one tuple per row, reason JSON
-                # from the per-policy cache; plain-list iteration beats numpy
-                # scalar indexing at this row count
-                w = words.astype(np.uint32)
-                vcodes = ((w >> 30) & 0x3).tolist()
-                pols = (w & 0xFFFFFF).tolist()
-                noop = (DECISION_NO_OPINION, "", None)
-                reason = self._reason
-                for k, i in enumerate(idx.tolist()):
-                    c = vcodes[k]
-                    if c == 1:
-                        results[i] = (DECISION_ALLOW, reason(snap, pols[k]), None)
-                    elif c == 2:
-                        results[i] = (DECISION_DENY, reason(snap, pols[k]), None)
-                    elif c == 3:
-                        meta = packed.policy_meta[pols[k]]
-                        log.error(
-                            "Authorize errors: while evaluating policy `%s`:"
-                            " evaluation error",
-                            meta.policy_id,
-                        )
-                        results[i] = noop
-                    else:
-                        results[i] = noop
+                    results[i] = noop
+                else:
+                    results[i] = noop
         return results  # type: ignore[return-value]
 
     @staticmethod

@@ -32,7 +32,16 @@ Packed verdict word layout (uint32):
                            no permit/forbid matched in the winning tier)
     bit  29      err:  the winning tier ALSO had an error-group match
                        (only meaningful for code 1/2; the erroring policy
-                       index requires the full per-group matrix)
+                       index requires the rule bitset)
+    bit  28      multi: MORE than one policy matched in the group that
+                       produced the verdict (code 1/2: the reason group;
+                       code 3: the error group). cedar-go reports every
+                       determining policy in Diagnostic.Reasons
+                       (/root/reference internal/server/store/store.go:31),
+                       so a caller rendering diagnostics must fetch the
+                       rule bitset (match_rules_codes_bits) for this row;
+                       without the bit the single packed policy IS the
+                       complete reason set.
     bits 0..23   policy index into PackedPolicySet.policy_meta
                  (POLICY_NONE = 0xFFFFFF when no policy applies)
 
@@ -54,6 +63,9 @@ CODE_NONE = 0
 CODE_ALLOW = 1
 CODE_DENY = 2
 CODE_ERROR = 3
+# verdict-word flag masks (see module docstring)
+WORD_ERR = 1 << 29
+WORD_MULTI = 1 << 28
 
 # group-per-tier layout (mirrors compiler.pack)
 _PERMIT, _FORBID, _ERROR = 0, 1, 2
@@ -69,33 +81,53 @@ def _lit_matrix(active, L: int):
 
 
 def _first_match(lit, W_chunks, thresh_c, group_c, policy_c, n_groups: int):
-    """Scan rule chunks; running per-group first-match policy index [B, G]."""
+    """Scan rule chunks; running per-group (min, max) matched policy index —
+    first [B, G] int32 (INT32_MAX = none), last [B, G] int32 (-1 = none).
+    min != max detects multiple DISTINCT matched policies exactly: a single
+    policy lowered to several DNF rules shares one policy index, so it never
+    false-positives the multi flag."""
     B = lit.shape[0]
 
     def body(carry, xs):
+        first_acc, last_acc = carry
         Wc, tc, gc, pc = xs
         scores = jnp.dot(lit, Wc, preferred_element_type=jnp.float32)  # [B, Rc]
         sat = scores >= tc[None, :]
-        masked = jnp.where(sat, pc[None, :], INT32_MAX)  # [B, Rc]
+        masked_min = jnp.where(sat, pc[None, :], INT32_MAX)  # [B, Rc]
+        masked_max = jnp.where(sat, pc[None, :], -1)
         mins = [
-            jnp.min(jnp.where((gc == g)[None, :], masked, INT32_MAX), axis=1)
+            jnp.min(jnp.where((gc == g)[None, :], masked_min, INT32_MAX), axis=1)
             for g in range(n_groups)
         ]
-        return jnp.minimum(carry, jnp.stack(mins, axis=1)), None
+        maxs = [
+            jnp.max(jnp.where((gc == g)[None, :], masked_max, -1), axis=1)
+            for g in range(n_groups)
+        ]
+        return (
+            jnp.minimum(first_acc, jnp.stack(mins, axis=1)),
+            jnp.maximum(last_acc, jnp.stack(maxs, axis=1)),
+        ), None
 
-    init = jnp.full((B, n_groups), INT32_MAX, dtype=jnp.int32)
-    first, _ = jax.lax.scan(body, init, (W_chunks, thresh_c, group_c, policy_c))
-    return first
+    init = (
+        jnp.full((B, n_groups), INT32_MAX, dtype=jnp.int32),
+        jnp.full((B, n_groups), -1, dtype=jnp.int32),
+    )
+    (first, last), _ = jax.lax.scan(
+        body, init, (W_chunks, thresh_c, group_c, policy_c)
+    )
+    return first, last
 
 
-def _tier_walk(first, n_tiers: int):
+def _tier_walk(first, last, n_tiers: int):
     """Walk tiers on device -> packed uint32 verdict word per request.
     Mirrors TieredPolicyStores semantics (/root/reference
     internal/server/store/store.go:25-42): first tier with any explicit
-    signal (reason or error) wins."""
+    signal (reason or error) wins. `last` may be None (first-match-only
+    callers); then the multi bit is never set."""
     B = first.shape[0]
     code = jnp.zeros((B,), jnp.uint32)
     err = jnp.zeros((B,), jnp.uint32)
+    multi = jnp.zeros((B,), jnp.uint32)
     pol = jnp.full((B,), POLICY_NONE, dtype=jnp.uint32)
     done = jnp.zeros((B,), jnp.bool_)
     for t in range(n_tiers):
@@ -114,8 +146,25 @@ def _tier_walk(first, n_tiers: int):
         code = jnp.where(new, c_t, code)
         pol = jnp.where(new, pol_t, pol)
         err = jnp.where(new & has_e & (has_p | has_f), jnp.uint32(1), err)
+        if last is not None:
+            # distinct-policy multi-match in the group that decides this
+            # row's verdict (min != max): the complete reason set needs the
+            # rule bitset — flag the row
+            l_p = last[:, t * _GPT + _PERMIT]
+            l_f = last[:, t * _GPT + _FORBID]
+            l_e = last[:, t * _GPT + _ERROR]
+            win_first = jnp.where(has_f, f_f, jnp.where(has_p, p_f, e_f))
+            win_last = jnp.where(has_f, l_f, jnp.where(has_p, l_p, l_e))
+            multi = jnp.where(
+                new & sig & (win_first != win_last), jnp.uint32(1), multi
+            )
         done = done | sig
-    return (code << 30) | (err << 29) | (pol & jnp.uint32(POLICY_NONE))
+    return (
+        (code << 30)
+        | (err << 29)
+        | (multi << 28)
+        | (pol & jnp.uint32(POLICY_NONE))
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("n_tiers", "want_full"))
@@ -125,14 +174,16 @@ def match_rules_device(
     """active: [B, A] int16/int32 literal ids (pad with >= L to drop).
     W_chunks: [C, L, Rc] bf16; thresh_c/group_c/policy_c: [C, Rc].
 
-    Returns (packed uint32 [B], first [B, G] int32 or None). The full
-    matrix is only materialized to the host when the caller needs it
-    (interpreter-fallback merge or error attribution)."""
+    Returns (packed uint32 [B], (first, last) [B, G] int32 pair or None).
+    The full matrices are only materialized to the host when the caller
+    needs them (interpreter-fallback merge or error attribution)."""
     L = W_chunks.shape[1]
     lit = _lit_matrix(active, L)
-    first = _first_match(lit, W_chunks, thresh_c, group_c, policy_c, n_tiers * _GPT)
-    packed = _tier_walk(first, n_tiers)
-    return (packed, first) if want_full else (packed, None)
+    first, last = _first_match(
+        lit, W_chunks, thresh_c, group_c, policy_c, n_tiers * _GPT
+    )
+    packed = _tier_walk(first, last, n_tiers)
+    return (packed, (first, last)) if want_full else (packed, None)
 
 
 def _lit_matrix_codes(codes, extras, act_rows):
@@ -169,11 +220,18 @@ def match_rules_codes(
     """Feature-code variant of match_rules_device: the literal expansion
     happens ON DEVICE from the activation table, so the host ships one
     int16 code per feature slot (+ a few extras) instead of every active
-    literal id. See compiler/table.py."""
+    literal id. See compiler/table.py.
+
+    want_full returns (packed, (first [B, G], last [B, G])): the exact
+    per-group min/max matched policy indices, letting the host render
+    complete diagnostics without a bitset fetch for rows where every group
+    matched at most one distinct policy (min == max)."""
     lit = _lit_matrix_codes(codes, extras, act_rows)
-    first = _first_match(lit, W_chunks, thresh_c, group_c, policy_c, n_tiers * _GPT)
-    packed = _tier_walk(first, n_tiers)
-    return (packed, first) if want_full else (packed, None)
+    first, last = _first_match(
+        lit, W_chunks, thresh_c, group_c, policy_c, n_tiers * _GPT
+    )
+    packed = _tier_walk(first, last, n_tiers)
+    return (packed, (first, last)) if want_full else (packed, None)
 
 
 @functools.partial(
@@ -198,11 +256,11 @@ def match_rules_codes_pallas(
     from .pallas_match import pallas_first_match
 
     lit = _lit_matrix_codes(codes, extras, act_rows)
-    first = pallas_first_match(
+    first, last = pallas_first_match(
         lit, W2, thresh_r, group_r, policy_r, n_tiers * _GPT, interpret
     )
-    packed = _tier_walk(first, n_tiers)
-    return (packed, first) if want_full else (packed, None)
+    packed = _tier_walk(first, last, n_tiers)
+    return (packed, (first, last)) if want_full else (packed, None)
 
 
 @functools.partial(jax.jit, static_argnames=("n_groups",))
@@ -212,7 +270,44 @@ def match_rules_compact(active, W_chunks, thresh_c, group_c, policy_c, n_groups:
     attribution (tests, fallback-heavy sets)."""
     L = W_chunks.shape[1]
     lit = _lit_matrix(active, L)
-    return _first_match(lit, W_chunks, thresh_c, group_c, policy_c, n_groups)
+    first, _ = _first_match(lit, W_chunks, thresh_c, group_c, policy_c, n_groups)
+    return first
+
+
+def _pack_sat_bits(sat):
+    """sat [B, Rc] bool -> [B, Rc // 32] uint32, little-endian bit order
+    (rule r lives in word r // 32, bit r % 32). Rc is always a multiple of
+    128 (compiler.pack buckets R), so the reshape is exact."""
+    B, Rc = sat.shape
+    s = sat.reshape(B, Rc // 32, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, None, :]
+    return jnp.sum(s * weights, axis=2, dtype=jnp.uint32)
+
+
+@functools.partial(jax.jit)
+def match_rules_codes_bits(
+    codes, extras, act_rows, W_chunks, thresh_c, group_c, policy_c
+):
+    """Per-rule satisfaction bitset [B, R // 32] uint32 for diagnostic
+    rendering: the host maps set bits through rule_policy / rule_group to
+    recover the COMPLETE matched-policy set per (tier, effect) — every
+    determining policy, like cedar-go's Diagnostic.Reasons (/root/reference
+    internal/server/store/store.go:31). Runs only for rows whose verdict
+    word carries the multi or err flag, so the [B, R/32] readback never
+    rides the hot path."""
+    lit = _lit_matrix_codes(codes, extras, act_rows)
+
+    def body(_, xs):
+        Wc, tc, _gc, _pc = xs
+        scores = jnp.dot(lit, Wc, preferred_element_type=jnp.float32)
+        sat = scores >= tc[None, :]
+        return None, _pack_sat_bits(sat)
+
+    _, bits = jax.lax.scan(body, None, (W_chunks, thresh_c, group_c, policy_c))
+    # scan stacks per-chunk [B, Rc/32] -> [C, B, Rc/32]; rules are chunked
+    # contiguously, so transpose + reshape restores rule order
+    C, B, w = bits.shape
+    return jnp.transpose(bits, (1, 0, 2)).reshape(B, C * w)
 
 
 def chunk_rules(W, thresh, rule_group, rule_policy, chunk: int = 4096):

@@ -44,8 +44,8 @@ _TK = 2048
 
 
 def _kernel(
-    lit_ref, w_ref, thresh_ref, group_ref, policy_ref, out_ref,
-    score_ref, acc_ref, *, n_groups: int, g_pad: int
+    lit_ref, w_ref, thresh_ref, group_ref, policy_ref, out_ref, last_out_ref,
+    score_ref, acc_ref, last_ref, *, n_groups: int, g_pad: int
 ):
     k = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -59,6 +59,7 @@ def _kernel(
     @pl.when(jnp.logical_and(j == 0, k == 0))
     def _():
         acc_ref[:] = jnp.full_like(acc_ref, INT32_MAX)
+        last_ref[:] = jnp.full_like(last_ref, -1)
 
     # MXU contraction for this (B, R, L) tile, f32 accumulation in VMEM
     score_ref[:] += jnp.dot(
@@ -67,32 +68,42 @@ def _kernel(
 
     @pl.when(k == nk - 1)
     def _():
-        # fused epilogue: satisfaction + per-group first-match minima,
-        # all in VMEM — the score matrix never reaches HBM. All operands
-        # kept 2D (TPU vector layout).
+        # fused epilogue: satisfaction + per-group first/last-match
+        # min/max, all in VMEM — the score matrix never reaches HBM.
+        # All operands kept 2D (TPU vector layout).
         sat = score_ref[:] >= thresh_ref[0:1, :]  # [TB, TR]
-        masked = jnp.where(
-            sat, jnp.broadcast_to(policy_ref[0:1, :], sat.shape), INT32_MAX
-        )
+        pol_b = jnp.broadcast_to(policy_ref[0:1, :], sat.shape)
+        masked_min = jnp.where(sat, pol_b, INT32_MAX)
+        masked_max = jnp.where(sat, pol_b, -1)
         grp = group_ref[0:1, :]  # [1, TR]
-        tb = masked.shape[0]
+        tb = sat.shape[0]
         mins = []
+        maxs = []
         for g in range(n_groups):  # static unroll; G = 3 * tiers, tiny
+            in_g = grp == g
             mins.append(
                 jnp.min(
-                    jnp.where(grp == g, masked, INT32_MAX),
+                    jnp.where(in_g, masked_min, INT32_MAX),
                     axis=1,
                     keepdims=True,
                 )
             )
+            maxs.append(
+                jnp.max(
+                    jnp.where(in_g, masked_max, -1), axis=1, keepdims=True
+                )
+            )
         for g in range(n_groups, g_pad):
             mins.append(jnp.full((tb, 1), INT32_MAX, jnp.int32))
+            maxs.append(jnp.full((tb, 1), -1, jnp.int32))
         tile_min = jnp.concatenate(mins, axis=1)  # [TB, g_pad]
         acc_ref[:] = jnp.minimum(acc_ref[:], tile_min)
+        last_ref[:] = jnp.maximum(last_ref[:], jnp.concatenate(maxs, axis=1))
 
     @pl.when(jnp.logical_and(j == nj - 1, k == nk - 1))
     def _():
         out_ref[:] = acc_ref[:]
+        last_out_ref[:] = last_ref[:]
 
 
 @functools.partial(
@@ -102,8 +113,9 @@ def pallas_first_match(
     lit, W, thresh_r, group_r, policy_r, n_groups: int, interpret: bool = False
 ):
     """lit [B, L] bf16, W [L, R] bf16, thresh_r/group_r/policy_r [1, R].
-    Returns first [B, n_groups] int32. Shapes must tile: B % TB == 0 (or
-    B <= TB), R % TR == 0, L % TK == 0 (or L <= TK)."""
+    Returns (first [B, n_groups] int32, last [B, n_groups] int32) — the
+    same (min, max) matched-policy contract as ops.match._first_match. Shapes must tile: B % TB == 0
+    (or B <= TB), R % TR == 0, L % TK == 0 (or L <= TK)."""
     B, L = lit.shape
     R = W.shape[1]
     tb = min(_TB, B)
@@ -114,9 +126,12 @@ def pallas_first_match(
     grid = (B // tb, R // tr, L // tk)
     kernel = functools.partial(_kernel, n_groups=n_groups, g_pad=g_pad)
 
-    out = pl.pallas_call(
+    out, last = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B, g_pad), jnp.int32),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, g_pad), jnp.int32),
+            jax.ShapeDtypeStruct((B, g_pad), jnp.int32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -135,11 +150,17 @@ def pallas_first_match(
                 (1, tr), lambda i, j, k: (0, j), memory_space=pltpu.VMEM
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (tb, g_pad), lambda i, j, k: (i, 0), memory_space=pltpu.VMEM
-        ),
+        out_specs=[
+            pl.BlockSpec(
+                (tb, g_pad), lambda i, j, k: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (tb, g_pad), lambda i, j, k: (i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
         scratch_shapes=[
             pltpu.VMEM((tb, tr), jnp.float32),
+            pltpu.VMEM((tb, g_pad), jnp.int32),
             pltpu.VMEM((tb, g_pad), jnp.int32),
         ],
         compiler_params=pltpu.CompilerParams(
@@ -147,12 +168,12 @@ def pallas_first_match(
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * B * L * R,
-            bytes_accessed=B * L * 2 + L * R * 2 + B * g_pad * 4,
+            bytes_accessed=B * L * 2 + L * R * 2 + 2 * B * g_pad * 4,
             transcendentals=0,
         ),
         interpret=interpret,
     )(lit, W, thresh_r, group_r, policy_r)
-    return out[:, :n_groups]
+    return out[:, :n_groups], last[:, :n_groups]
 
 
 def pallas_supported(B: int, L: int, R: int) -> bool:

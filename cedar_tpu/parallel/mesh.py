@@ -157,15 +157,24 @@ def sharded_codes_match_fn(mesh: Mesh, n_tiers: int):
             lit, W.astype(jnp.bfloat16), preferred_element_type=jnp.float32
         )  # [B, R] — R sharded
         sat = scores >= thresh[None, :]
-        masked = jnp.where(sat, rule_policy[None, :], INT32_MAX)
-        firsts = [
-            jnp.min(
-                jnp.where((rule_group == g)[None, :], masked, INT32_MAX),
-                axis=1,  # cross-shard min all-reduce over the policy axis
+        masked_min = jnp.where(sat, rule_policy[None, :], INT32_MAX)
+        masked_max = jnp.where(sat, rule_policy[None, :], -1)
+        firsts = []
+        lasts = []
+        for g in range(G):
+            in_g = (rule_group == g)[None, :]
+            firsts.append(
+                jnp.min(
+                    jnp.where(in_g, masked_min, INT32_MAX),
+                    axis=1,  # cross-shard min all-reduce over the policy axis
+                )
             )
-            for g in range(G)
-        ]
+            lasts.append(
+                # cross-shard max all-reduce; min != max flags multi-match
+                jnp.max(jnp.where(in_g, masked_max, -1), axis=1)
+            )
         first = jnp.stack(firsts, axis=1)  # [B, G] replicated on policy
-        return _tier_walk(first, n_tiers), first
+        last = jnp.stack(lasts, axis=1)
+        return _tier_walk(first, last, n_tiers), first
 
     return step

@@ -36,8 +36,20 @@ def tpu_decision(tier_sources, entities, request):
     return engine.evaluate(entities, request)
 
 
+def _err_policies(errors):
+    """Erroring policy ids from diagnostics messages (the message TEXT
+    differs between paths — the device only knows 'evaluation error' — but
+    the SET of erroring policies must be identical)."""
+    import re
+
+    return {m.group(1) for m in (re.search(r"`([^`]+)`", e) for e in errors) if m}
+
+
 def check(tier_sources, attributes_list):
-    """Assert interpreter and TPU paths agree for every request."""
+    """Assert interpreter and TPU paths agree for every request: decision,
+    complete reason SET (every determining policy, like cedar-go's
+    Diagnostic.Reasons at /root/reference internal/server/store/store.go:31),
+    and erroring-policy set. Ordering is not a contract."""
     engine = TPUPolicyEngine()
     engine.load(
         [PolicySet.from_source(s, f"t{i}") for i, s in enumerate(tier_sources)]
@@ -54,8 +66,15 @@ def check(tier_sources, attributes_list):
         assert tpu_dec == int_dec, (
             f"decision mismatch for {attrs}: tpu={tpu_dec} interp={int_dec}"
         )
-        assert bool(tpu_diag.reasons) == bool(int_diag.reasons), (
-            f"reason presence mismatch for {attrs}"
+        tpu_reasons = {r.policy for r in tpu_diag.reasons}
+        int_reasons = {r.policy for r in int_diag.reasons}
+        assert tpu_reasons == int_reasons, (
+            f"reason-set mismatch for {attrs}: "
+            f"tpu={sorted(tpu_reasons)} interp={sorted(int_reasons)}"
+        )
+        assert _err_policies(tpu_diag.errors) == _err_policies(int_diag.errors), (
+            f"error-set mismatch for {attrs}: "
+            f"tpu={tpu_diag.errors} interp={int_diag.errors}"
         )
     return engine
 
@@ -112,6 +131,91 @@ def test_demo_policy_matrix():
     engine = check([DEMO], cases)
     # everything in the demo set should be lowerable — no fallback
     assert engine.stats["fallback_policies"] == 0
+
+
+def test_multi_match_reason_sets():
+    """Several policies matching the same request must ALL be reported —
+    cedar-go returns every determining policy (store.go:31), and admission
+    deny messages render the whole list (handler.go:157-164)."""
+    src = """
+permit (principal, action, resource) when { principal.name == "test-user" };
+permit (principal, action, resource) when { resource.resource == "pods" };
+permit (principal in k8s::Group::"viewers", action, resource);
+forbid (principal, action, resource) when { resource.resource == "nodes" };
+forbid (principal, action, resource)
+    when { principal.name == "test-user" && resource.resource == "nodes" };
+"""
+    cases = [
+        sar(),  # 3 permits match -> allow with 3 reasons
+        sar(resource="nodes"),  # 2 forbids + permits -> deny with 2 reasons
+        sar(user=UserInfo(name="x", uid="x"), resource="configmaps"),  # none
+        sar(user=UserInfo(name="x", uid="x", groups=("viewers",))),  # 2 permits
+    ]
+    engine = check([src], cases)
+    assert engine.stats["fallback_policies"] == 0
+    # sanity: the multi-match rows really do produce >1 reason
+    em, req = record_to_cedar_resource(cases[0])
+    _, diag = engine.evaluate(em, req)
+    assert len(diag.reasons) == 3
+
+
+def test_multi_match_across_tiers():
+    """Multi-match resolution respects tier boundaries: only the winning
+    tier's matches are reported."""
+    t0 = 'permit (principal, action, resource) when { resource.resource == "pods" };'
+    t1 = """
+permit (principal, action, resource);
+forbid (principal, action, resource) when { resource.resource == "nodes" };
+forbid (principal, action, resource) when { principal.name == "test-user" };
+"""
+    check([t0, t1], [sar(), sar(resource="nodes"), sar(resource="svc")])
+
+
+def test_error_set_with_multiple_erroring_policies():
+    """More than one policy erroring on the same request: the complete
+    erroring-policy set must surface (multi bit on the error group)."""
+    src = """
+permit (principal, action, resource) when { resource.subresource == "a" };
+permit (principal, action, resource) when { resource.subresource == "b" };
+permit (principal, action, resource) when { principal.name == "test-user" &&
+                                            resource.resource == "pods" };
+"""
+    # without a subresource both unguarded accesses error... unless the
+    # compiler has-guards them; either way sets must agree with the oracle
+    check([src], [sar(), sar(subresource="a"), sar(subresource="c")])
+
+
+def test_match_bits_arrays_splits_large_batches(monkeypatch):
+    """Batches beyond the pipeline sub-batch size must split, not crash on
+    the bucket clamp (buckets top out at 32768)."""
+    import numpy as np
+
+    from cedar_tpu.engine import evaluator as ev
+
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(DEMO, "t0")])
+    cs = engine._compiled
+    items = [record_to_cedar_resource(sar()) for _ in range(5)]
+    from cedar_tpu.compiler.table import encode_request_codes
+
+    packed = cs.packed
+    encoded = [
+        encode_request_codes(packed.plan, packed.table, em, req)
+        for em, req in items
+    ]
+    codes, extras = engine._encode_batch_arrays(cs, encoded, len(encoded))
+    # replicate rows beyond a (shrunken) sub-batch size and compare with the
+    # unsplit result row-by-row
+    reps = 9
+    big_c = np.repeat(codes, reps, axis=0)
+    big_e = np.repeat(extras, reps, axis=0)
+    small = engine.match_bits_arrays(codes, extras, cs=cs)
+    monkeypatch.setattr(ev, "_PIPELINE_SB", 8)
+    big = engine.match_bits_arrays(big_c, big_e, cs=cs)
+    assert big.shape[0] == len(items) * reps
+    for i in range(len(items)):
+        for r in range(reps):
+            assert (big[i * reps + r] == small[i]).all()
 
 
 def test_tier_stacks():

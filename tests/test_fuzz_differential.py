@@ -180,6 +180,12 @@ def test_fuzz_interpreter_vs_tpu(seed):
             f"seed={seed} decision mismatch: tpu={tpu_dec} interp={int_dec}\n"
             f"attrs={attrs}\npolicies:\n" + "\n---tier---\n".join(tiers_src)
         )
-        assert bool(tpu_diag.reasons) == bool(int_diag.reasons), (
-            f"seed={seed} reason-presence mismatch for {attrs}"
+        # full matched-SET parity, not just presence: every determining
+        # policy must be reported, like cedar-go's Diagnostic.Reasons
+        tpu_reasons = {r.policy for r in tpu_diag.reasons}
+        int_reasons = {r.policy for r in int_diag.reasons}
+        assert tpu_reasons == int_reasons, (
+            f"seed={seed} reason-set mismatch: tpu={sorted(tpu_reasons)} "
+            f"interp={sorted(int_reasons)}\nattrs={attrs}\npolicies:\n"
+            + "\n---tier---\n".join(tiers_src)
         )

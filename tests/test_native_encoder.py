@@ -251,6 +251,48 @@ def test_fastpath_parse_error_falls_back():
     assert res[1][2] is None
 
 
+def test_fastpath_multi_match_reason_sets():
+    """Raw-JSON fast path reports EVERY determining policy when several
+    match (the multi bit routes those rows through the rule bitset)."""
+    import json as _json
+
+    src = """
+permit (principal, action, resource) when { principal.name == "mm-user" };
+permit (principal, action, resource) when { resource.resource == "pods" };
+forbid (principal, action, resource) when { resource.resource == "nodes" };
+forbid (principal, action, resource)
+    when { principal.name == "mm-user" && resource.resource == "nodes" };
+"""
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "mm")])
+    stores = TieredPolicyStores([MemoryStore.from_source("mm", src)])
+    authorizer = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, authorizer)
+
+    def body(resource):
+        return _json.dumps(
+            {"spec": {"user": "mm-user", "uid": "u",
+                      "resourceAttributes": {"verb": "get", "version": "v1",
+                                             "resource": resource}}}
+        ).encode()
+
+    res = fastpath.authorize_raw([body("pods"), body("nodes"), body("zzz")])
+    allow = res[0]
+    assert allow[0] == "allow"
+    assert {"policy0", "policy1"} == {
+        r["policy"] for r in _json.loads(allow[1])["reasons"]
+    }
+    deny = res[1]
+    assert deny[0] == "deny"
+    assert {"policy2", "policy3"} == {
+        r["policy"] for r in _json.loads(deny[1])["reasons"]
+    }
+    assert res[2][0] == "allow"  # only policy0 matches
+    assert {"policy0"} == {
+        r["policy"] for r in _json.loads(res[2][1])["reasons"]
+    }
+
+
 def test_native_parser_depth_limit_no_crash():
     """A deeply nested body (1M of '[') must not overflow the C++ stack: the
     native parse fails at the depth cap, the row gets F_PARSE_ERROR, and the

@@ -60,7 +60,7 @@ def test_sharded_codes_step_matches_single_device():
         packed.W.astype(np.float32), packed.thresh,
         packed.rule_group, packed.rule_policy,
     )
-    ref_words, ref_first = match_rules_codes(
+    ref_words, (ref_first, _ref_count) = match_rules_codes(
         jnp.asarray(codes, jnp.int16),
         jnp.asarray(extras, jnp.int16),
         jnp.asarray(table.rows),
