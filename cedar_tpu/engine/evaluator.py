@@ -146,30 +146,61 @@ class TPUPolicyEngine:
         self.use_pallas = use_pallas
         self._compiled: Optional[_CompiledSet] = None
         self._lock = threading.Lock()
+        self._warm_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ lifecycle
 
-    def load(self, tiers: Sequence[PolicySet]) -> dict:
+    def load(self, tiers: Sequence[PolicySet], warm: str = "async") -> dict:
         """Compile + pack a tiered policy set and atomically swap it in.
-        Returns compile stats."""
+        Returns compile stats.
+
+        warm: "async" (default) kicks kernel warm-up onto a background
+        daemon thread so readiness is NOT delayed by XLA compiles (the
+        reference populates stores asynchronously too, /root/reference
+        internal/server/store/crd.go:207); "sync" joins it (tests);
+        "off" skips it. Diagnostics bitsets ride the main match call
+        (ops/match.py want_bits), so there is no separate diagnostics
+        kernel left to compile on a live request — warm-up only
+        front-loads the small-batch shapes a fresh server sees first."""
         if not tiers:
             raise ValueError("TPUPolicyEngine.load: at least one tier required")
         compiled: CompiledPolicies = lower_tiers(list(tiers), self.schema)
         packed = pack(compiled)
         new = _CompiledSet(packed, self.device, use_pallas=self.use_pallas)
-        # warm the diagnostics bitset kernel now: its first caller is an
-        # unpredictable live request (the first multi-match/err row), and a
-        # fresh XLA trace+compile inside the webhook's deadline would stall
-        # that batch — bound the cost to load time instead
-        try:
-            warm_c = np.zeros((1, packed.table.n_slots), dtype=new.code_dtype)
-            warm_e = np.full((1, 1), packed.L, dtype=new.active_dtype)
-            self.match_bits_arrays(warm_c, warm_e, cs=new)
-        except Exception:  # noqa: BLE001 — warmup must never block a swap
-            pass
         with self._lock:
             self._compiled = new
+        if warm == "sync":
+            self._warm_kernels(new)
+        elif warm != "off":
+            threading.Thread(
+                target=self._warm_kernels, args=(new,), daemon=True
+            ).start()
         return {**compiled.stats(), "L": packed.L, "R": packed.R}
+
+    def _warm_kernels(self, cs: "_CompiledSet") -> None:
+        """Trace+compile the first-hit serving shapes off the critical path:
+        single-request and small-batch buckets with the no-extras width
+        (what a webhook sees at startup), plus the one fixed shape of the
+        standalone bits kernel (compaction overflow / pallas diagnostics).
+        Larger buckets compile on first use exactly as before; every
+        compile here is one the first live requests would otherwise pay.
+        Bails out as soon as a hot swap supersedes `cs` — on the 1-core
+        serving host an orphan compile steals the request thread's CPU."""
+        packed = cs.packed
+        shapes = [(b, self.match_arrays) for b in (1, 8, 32)]
+        shapes.append((1, self.match_bits_arrays))
+        for b, fn in shapes:
+            if self._compiled is not cs:
+                return
+            try:
+                warm_c = np.zeros((b, packed.table.n_slots), dtype=cs.code_dtype)
+                warm_e = np.full((b, 1), packed.L, dtype=cs.active_dtype)
+                if fn is self.match_arrays:
+                    fn(warm_c, warm_e, cs=cs, want_bits=True)
+                else:
+                    fn(warm_c, warm_e, cs=cs)
+            except Exception:  # noqa: BLE001 — warm-up must never take down a swap
+                return
 
     @property
     def loaded(self) -> bool:
@@ -215,22 +246,26 @@ class TPUPolicyEngine:
             # interpreter-fallback policies can flip earlier tiers, so the
             # device tier walk is not authoritative: walk tiers host-side.
             # The (first, last) matrices give exact per-group sets wherever
-            # min == max (at most one distinct policy); only genuinely multi
-            # rows pay the [*, R/32] bitset fetch.
-            _, full = self.match_arrays(
-                codes_arr, extras_arr, want_full=True, cs=cs
+            # min == max (at most one distinct policy); genuinely multi rows
+            # read their rule bitsets from the compacted in-call payload
+            # (ops/match.py want_bits) — no second device round trip.
+            _, full, bitmap = self.match_arrays(
+                codes_arr, extras_arr, want_full=True, cs=cs, want_bits=True
             )
             first, last = full
             multi = np.nonzero(
                 ((first != last) & (first != INT32_MAX)).any(axis=1)
             )[0]
             bits_groups = {}
-            if multi.size:
+            missing = [i for i in multi.tolist() if i not in bitmap]
+            if missing:  # compaction overflow (> BITS_TOPK flagged rows)
                 bits = self.match_bits_arrays(
-                    codes_arr[multi], extras_arr[multi], cs=cs
+                    codes_arr[missing], extras_arr[missing], cs=cs
                 )
-                for k, i in enumerate(multi.tolist()):
-                    bits_groups[i] = self._bits_groups(packed, bits[k])
+                for k, i in enumerate(missing):
+                    bitmap[i] = bits[k]
+            for i in multi.tolist():
+                bits_groups[i] = self._bits_groups(packed, bitmap[i])
             return [
                 self._finalize_sets(
                     packed,
@@ -241,8 +276,12 @@ class TPUPolicyEngine:
                 for i, (em, req) in enumerate(items)
             ]
 
-        words, _ = self.match_arrays(codes_arr, extras_arr, cs=cs)
-        resolved = self.resolve_flagged(words, codes_arr, extras_arr, cs=cs)
+        words, _, bitmap = self.match_arrays(
+            codes_arr, extras_arr, cs=cs, want_bits=True
+        )
+        resolved = self.resolve_flagged(
+            words, codes_arr, extras_arr, cs=cs, bitmap=bitmap
+        )
 
         results: List[Tuple[str, Diagnostics]] = []
         for i in range(len(items)):
@@ -258,11 +297,15 @@ class TPUPolicyEngine:
         codes_arr: np.ndarray,
         extras_arr: np.ndarray,
         cs: Optional["_CompiledSet"] = None,
+        bitmap: Optional[dict] = None,
     ) -> dict:
         """Resolve rows whose verdict word cannot carry complete
         diagnostics — multiple distinct policies matched the deciding group
-        (multi bit) or a policy errored alongside a real match (err bit) —
-        by fetching rule bitsets for JUST those rows. Returns {row index:
+        (multi bit) or a policy errored alongside a real match (err bit).
+        `bitmap` ({row index: bitset row}) is the compacted payload a
+        want_bits match call already fetched with the words; rows it covers
+        cost nothing extra, rows it misses (compaction overflow, pallas
+        path) fetch their bitsets in one batched call. Returns {row index:
         (decision, Diagnostics)} with the full reason/error sets; rows not
         in the dict are exactly described by their 4-byte word."""
         cs = cs or self._compiled
@@ -270,22 +313,29 @@ class TPUPolicyEngine:
         w = words.astype(np.uint32)
         need = np.nonzero((w & (WORD_ERR | WORD_MULTI)) != 0)[0]
         out: dict = {}
-        if need.size:
+        if not need.size:
+            return out
+        bitmap = dict(bitmap) if bitmap else {}
+        missing = [i for i in need.tolist() if i not in bitmap]
+        if missing:
             bits = self.match_bits_arrays(
-                codes_arr[need], extras_arr[need], cs=cs
+                codes_arr[missing], extras_arr[missing], cs=cs
             )
-            for k, i in enumerate(need.tolist()):
-                groups = self._bits_groups(packed, bits[k])
-                out[i] = self._finalize_sets(packed, groups, None, None)
+            for k, i in enumerate(missing):
+                bitmap[i] = bits[k]
+        for i in need.tolist():
+            groups = self._bits_groups(packed, bitmap[i])
+            out[i] = self._finalize_sets(packed, groups, None, None)
         return out
 
     @staticmethod
-    def _pad_to_bucket(chunk_c, chunk_e, pad_L: int):
-        """Pad a (codes, extras) chunk up to the next batch bucket (bucketed
-        shapes keep the jitted executables retrace-free). Extras pad with
-        >= L so padding rows activate nothing."""
+    def _pad_to_bucket(chunk_c, chunk_e, pad_L: int, target: Optional[int] = None):
+        """Pad a (codes, extras) chunk up to the next batch bucket — or to
+        an explicit `target` row count (the fixed-shape bits kernel).
+        Bucketed shapes keep the jitted executables retrace-free. Extras
+        pad with >= L so padding rows activate nothing."""
         m = chunk_c.shape[0]
-        B = _round_bucket(m, _BATCH_BUCKETS)
+        B = target if target is not None else _round_bucket(m, _BATCH_BUCKETS)
         if B == m:
             return chunk_c, chunk_e
         pc = np.zeros((B, chunk_c.shape[1]), dtype=chunk_c.dtype)
@@ -300,12 +350,20 @@ class TPUPolicyEngine:
         extras_arr: np.ndarray,
         want_full: bool = False,
         cs: Optional["_CompiledSet"] = None,
+        want_bits: bool = False,
     ):
         """Device-match pre-encoded feature codes (e.g. from the native
         encoder): codes [n, S], extras [n, E] (padded with >= L). Returns
         (packed verdict words [n] uint32, full) where full is None or, with
         want_full, an ([n, G] first-match, [n, G] match-count) int32 pair.
         Handles batch bucketing, dtype narrowing, and sub-batch pipelining.
+
+        With want_bits a third element is returned: {row index: [R/32]
+        uint32 bitset} for every flagged row (multi/err verdicts, or any
+        multi-distinct group under want_full), compacted on device and
+        fetched with the words — the diagnostics payload costs no extra
+        device round trip (ops/match.py BITS_TOPK). The pallas path has no
+        bits plane; there the map is empty and resolve_flagged falls back.
 
         `cs` pins the compiled set the codes were encoded against — callers
         that encoded against a snapshot MUST pass it, or a concurrent policy
@@ -326,13 +384,15 @@ class TPUPolicyEngine:
         extras_arr = extras_arr.astype(cs.active_dtype, copy=False)
 
         def one(chunk_c, chunk_e):
+            """-> (words_dev, full_dev_or_None, pack_dev_or_None)"""
+            m = chunk_c.shape[0]
             chunk_c, chunk_e = self._pad_to_bucket(chunk_c, chunk_e, packed.L)
             B = chunk_c.shape[0]
             if cs.pallas_args is not None:
                 from ..ops.pallas_match import pallas_supported
 
                 if pallas_supported(B, packed.L, packed.R):
-                    return match_rules_codes_pallas(
+                    w, f = match_rules_codes_pallas(
                         chunk_c,
                         chunk_e,
                         cs.act_rows_dev,
@@ -341,35 +401,79 @@ class TPUPolicyEngine:
                         want_full,
                         self._pallas_interpret,
                     )
-            return match_rules_codes(
-                chunk_c, chunk_e, *args, packed.n_tiers, want_full
+                    return w, f, None
+            out = match_rules_codes(
+                chunk_c, chunk_e, *args, packed.n_tiers, want_full,
+                want_bits, np.int32(m) if want_bits else None,
             )
+            return out if want_bits else (*out, None)
 
         def trim_full(f, m):
             return (np.asarray(f[0])[:m], np.asarray(f[1])[:m])
 
+        def any_flagged(words_h, full_h):
+            """Host-side gate before materializing the [K, R/32] compaction
+            payload: words (and full, when requested) are already fetched,
+            so a clean batch — the overwhelming majority — skips the
+            payload transfer entirely."""
+            if full_h is not None:
+                first, last = full_h
+                return bool(((first != last) & (first != INT32_MAX)).any())
+            return bool(
+                (words_h.astype(np.uint32) & (WORD_ERR | WORD_MULTI)).any()
+            )
+
+        def pack_rows(pack, lo, bitmap):
+            if pack is None:
+                return
+            vals, idx, kbits = (np.asarray(a) for a in pack)
+            live = vals > 0
+            for r, b in zip(idx[live].tolist(), kbits[live]):
+                bitmap[lo + r] = b
+
+        bitmap: dict = {}
         if n <= _PIPELINE_MIN:
-            w, f = one(codes_arr, extras_arr)
-            return np.asarray(w)[:n], (trim_full(f, n) if want_full else None)
+            w, f, p = one(codes_arr, extras_arr)
+            words = np.asarray(w)[:n]
+            full = trim_full(f, n) if want_full else None
+            if want_bits:
+                if any_flagged(words, full):
+                    pack_rows(p, 0, bitmap)
+                return words, full, bitmap
+            return words, full
 
         outs = []
         for lo in range(0, n, _PIPELINE_SB):
             hi = min(lo + _PIPELINE_SB, n)
-            w, f = one(codes_arr[lo:hi], extras_arr[lo:hi])
+            w, f, p = one(codes_arr[lo:hi], extras_arr[lo:hi])
             w.copy_to_host_async()
             if f is not None:
                 f[0].copy_to_host_async()
                 f[1].copy_to_host_async()
-            outs.append((hi - lo, w, f))
-        words = np.concatenate([np.asarray(w)[:m] for m, w, _ in outs])
+            outs.append((lo, hi - lo, w, f, p))
+        host = [
+            (lo, np.asarray(w)[:m], trim_full(f, m) if want_full else None, p)
+            for lo, m, w, f, p in outs
+        ]
+        words = np.concatenate([wh for _, wh, _, _ in host])
         full = None
         if want_full:
-            parts = [trim_full(f, m) for m, _, f in outs]
             full = (
-                np.concatenate([p[0] for p in parts]),
-                np.concatenate([p[1] for p in parts]),
+                np.concatenate([fh[0] for _, _, fh, _ in host]),
+                np.concatenate([fh[1] for _, _, fh, _ in host]),
             )
+        if want_bits:
+            for lo, wh, fh, p in host:
+                if p is not None and any_flagged(wh, fh):
+                    pack_rows(p, lo, bitmap)
+            return words, full, bitmap
         return words, full
+
+    # fixed row count for the standalone bitset kernel: every call pads to
+    # exactly this many rows, so the kernel has ONE batch shape per extras
+    # width — a cold call can't hit a fresh trace+compile at an arbitrary
+    # bucket inside a request deadline (the r02 selector1k collapse)
+    _BITS_CHUNK = 128
 
     def match_bits_arrays(
         self,
@@ -378,19 +482,26 @@ class TPUPolicyEngine:
         cs: Optional["_CompiledSet"] = None,
     ) -> np.ndarray:
         """Per-rule satisfaction bitsets [n, R // 32] uint32 for the given
-        pre-encoded rows. Diagnostic path only — callers select the few rows
-        whose verdict words carry the multi/err flags first. Batches beyond
-        the top bucket split into pipelined sub-batches like match_arrays."""
+        pre-encoded rows. Overflow/fallback diagnostic path only — the hot
+        path gets its bitsets compacted into the main match call
+        (match_arrays want_bits); this one runs when that payload missed
+        (compaction overflow, pallas plane). Rows process in fixed
+        _BITS_CHUNK-sized pieces, pipelined."""
         cs = cs or self._compiled
         if cs is None:
             raise RuntimeError("TPUPolicyEngine: no policy set loaded")
         packed = cs.packed
         n = codes_arr.shape[0]
+        if n == 0:
+            return np.zeros((0, packed.R // 32), dtype=np.uint32)
         codes_arr = codes_arr.astype(cs.code_dtype, copy=False)
         extras_arr = extras_arr.astype(cs.active_dtype, copy=False)
+        CH = self._BITS_CHUNK
 
         def one(chunk_c, chunk_e):
-            chunk_c, chunk_e = self._pad_to_bucket(chunk_c, chunk_e, packed.L)
+            chunk_c, chunk_e = self._pad_to_bucket(
+                chunk_c, chunk_e, packed.L, target=CH
+            )
             return match_rules_codes_bits(
                 chunk_c,
                 chunk_e,
@@ -401,11 +512,9 @@ class TPUPolicyEngine:
                 cs.rule_policy_dev,
             )
 
-        if n <= _PIPELINE_SB:
-            return np.asarray(one(codes_arr, extras_arr))[:n]
         outs = []
-        for lo in range(0, n, _PIPELINE_SB):
-            hi = min(lo + _PIPELINE_SB, n)
+        for lo in range(0, n, CH):
+            hi = min(lo + CH, n)
             b = one(codes_arr[lo:hi], extras_arr[lo:hi])
             b.copy_to_host_async()
             outs.append((hi - lo, b))

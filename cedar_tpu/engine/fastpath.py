@@ -207,15 +207,17 @@ class SARFastPath:
                     extras.shape[1],
                 )
             ok_extras = extras[:, :E] if all_ok else extras[idx, :E]
-            words, _ = self.engine.match_arrays(ok_codes, ok_extras, cs=cs)
+            # want_bits: rule bitsets for multi/err rows arrive compacted
+            # IN the same device call (zero extra round trips over the
+            # high-RTT link); resolve_flagged renders the complete
+            # reason/error sets from that payload like cedar-go does
+            words, _, bitmap = self.engine.match_arrays(
+                ok_codes, ok_extras, cs=cs, want_bits=True
+            )
             packed = cs.packed
             w = words.astype(np.uint32)
-            # rows whose 4-byte word can't carry complete diagnostics
-            # (multiple matched policies in the deciding group, or an error
-            # alongside a real match): the engine fetches rule bitsets for
-            # JUST those rows and renders the full set like cedar-go does
             resolved = self.engine.resolve_flagged(
-                words, ok_codes, ok_extras, cs=cs
+                words, ok_codes, ok_extras, cs=cs, bitmap=bitmap
             )
             handled = set()
             for sel, (decision, diag) in resolved.items():

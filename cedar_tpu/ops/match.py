@@ -80,12 +80,19 @@ def _lit_matrix(active, L: int):
     return (a32[:, :, None] == iota[None, None, :]).any(axis=1).astype(jnp.bfloat16)
 
 
-def _first_match(lit, W_chunks, thresh_c, group_c, policy_c, n_groups: int):
+def _first_match(
+    lit, W_chunks, thresh_c, group_c, policy_c, n_groups: int,
+    want_bits: bool = False,
+):
     """Scan rule chunks; running per-group (min, max) matched policy index —
     first [B, G] int32 (INT32_MAX = none), last [B, G] int32 (-1 = none).
     min != max detects multiple DISTINCT matched policies exactly: a single
     policy lowered to several DNF rules shares one policy index, so it never
-    false-positives the multi flag."""
+    false-positives the multi flag.
+
+    With want_bits the scan ALSO emits the packed per-rule satisfaction
+    bitset [B, R // 32] uint32 (the diagnostics payload) from the same
+    scores matmul — no second device pass."""
     B = lit.shape[0]
 
     def body(carry, xs):
@@ -103,19 +110,25 @@ def _first_match(lit, W_chunks, thresh_c, group_c, policy_c, n_groups: int):
             jnp.max(jnp.where((gc == g)[None, :], masked_max, -1), axis=1)
             for g in range(n_groups)
         ]
+        y = _pack_sat_bits(sat) if want_bits else None
         return (
             jnp.minimum(first_acc, jnp.stack(mins, axis=1)),
             jnp.maximum(last_acc, jnp.stack(maxs, axis=1)),
-        ), None
+        ), y
 
     init = (
         jnp.full((B, n_groups), INT32_MAX, dtype=jnp.int32),
         jnp.full((B, n_groups), -1, dtype=jnp.int32),
     )
-    (first, last), _ = jax.lax.scan(
+    (first, last), bits = jax.lax.scan(
         body, init, (W_chunks, thresh_c, group_c, policy_c)
     )
-    return first, last
+    if want_bits:
+        # scan stacks per-chunk [B, Rc/32] -> [C, B, Rc/32]; rules are
+        # chunked contiguously, so transpose + reshape restores rule order
+        C, Bb, w = bits.shape
+        bits = jnp.transpose(bits, (1, 0, 2)).reshape(Bb, C * w)
+    return first, last, bits
 
 
 def _tier_walk(first, last, n_tiers: int):
@@ -179,7 +192,7 @@ def match_rules_device(
     needs them (interpreter-fallback merge or error attribution)."""
     L = W_chunks.shape[1]
     lit = _lit_matrix(active, L)
-    first, last = _first_match(
+    first, last, _ = _first_match(
         lit, W_chunks, thresh_c, group_c, policy_c, n_tiers * _GPT
     )
     packed = _tier_walk(first, last, n_tiers)
@@ -205,7 +218,36 @@ def _lit_matrix_codes(codes, extras, act_rows):
     return acc.astype(jnp.bfloat16)
 
 
-@functools.partial(jax.jit, static_argnames=("n_tiers", "want_full"))
+# flagged-row compaction width: the kernel returns rule bitsets for up to
+# this many flagged rows per call, fetched WITH the verdict words in the
+# same async readback — the diagnostics path costs zero extra round trips
+# (the tunnel RTT here is ~67ms, which r02's second-call design paid on
+# every batch containing a multi-match row). Overflow rows (> K flagged)
+# fall back to match_rules_codes_bits; at 512 that needs >1.5% of a full
+# 32k sub-batch to be multi-match, which no realistic policy set produces.
+BITS_TOPK = 512
+
+
+def _compact_flagged_bits(bits, flagged, n_valid):
+    """Gather the bitset rows of flagged requests into a fixed [K, R/32]
+    buffer on device: top_k over a keep-key compacts the (dynamic) flagged
+    set into a static shape XLA can emit in the same executable. Returns
+    (vals [K] int32 — >0 means the slot is live, idx [K] int32 row indices,
+    kbits [K, R/32] uint32). Rows at or beyond n_valid (bucket padding) are
+    never selected."""
+    B = bits.shape[0]
+    K = min(B, BITS_TOPK)
+    iota = jnp.arange(B, dtype=jnp.int32)
+    if n_valid is not None:
+        flagged = flagged & (iota < jnp.asarray(n_valid, jnp.int32))
+    key = jnp.where(flagged, jnp.int32(B) - iota, jnp.int32(0))
+    vals, idx = jax.lax.top_k(key, K)
+    return vals, idx, jnp.take(bits, idx, axis=0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_tiers", "want_full", "want_bits")
+)
 def match_rules_codes(
     codes,
     extras,
@@ -216,6 +258,8 @@ def match_rules_codes(
     policy_c,
     n_tiers: int,
     want_full: bool,
+    want_bits: bool = False,
+    n_valid=None,
 ):
     """Feature-code variant of match_rules_device: the literal expansion
     happens ON DEVICE from the activation table, so the host ships one
@@ -225,13 +269,32 @@ def match_rules_codes(
     want_full returns (packed, (first [B, G], last [B, G])): the exact
     per-group min/max matched policy indices, letting the host render
     complete diagnostics without a bitset fetch for rows where every group
-    matched at most one distinct policy (min == max)."""
+    matched at most one distinct policy (min == max).
+
+    want_bits appends a (vals, idx, kbits) triple (_compact_flagged_bits):
+    rule bitsets for the rows whose verdict cannot be rendered from the
+    word/first matrices alone, computed in the SAME scan and fetched with
+    the words — the diagnostics contract of cedar-go (/root/reference
+    internal/server/store/store.go:31) without a second device call.
+    n_valid (dynamic scalar) masks bucket-padding rows out of the
+    compaction."""
     lit = _lit_matrix_codes(codes, extras, act_rows)
-    first, last = _first_match(
-        lit, W_chunks, thresh_c, group_c, policy_c, n_tiers * _GPT
+    first, last, bits = _first_match(
+        lit, W_chunks, thresh_c, group_c, policy_c, n_tiers * _GPT,
+        want_bits=want_bits,
     )
     packed = _tier_walk(first, last, n_tiers)
-    return (packed, (first, last)) if want_full else (packed, None)
+    if not want_bits:
+        return (packed, (first, last)) if want_full else (packed, None)
+    if want_full:
+        # the host walks tiers itself (interpreter-fallback merge): ANY
+        # group with >1 distinct matched policy may end up deciding, so
+        # flag on the full min != max test, not the device walk's verdict
+        flagged = ((first != last) & (first != INT32_MAX)).any(axis=1)
+    else:
+        flagged = (packed & jnp.uint32(WORD_ERR | WORD_MULTI)) != 0
+    pack = _compact_flagged_bits(bits, flagged, n_valid)
+    return (packed, (first, last) if want_full else None, pack)
 
 
 @functools.partial(
@@ -270,7 +333,7 @@ def match_rules_compact(active, W_chunks, thresh_c, group_c, policy_c, n_groups:
     attribution (tests, fallback-heavy sets)."""
     L = W_chunks.shape[1]
     lit = _lit_matrix(active, L)
-    first, _ = _first_match(lit, W_chunks, thresh_c, group_c, policy_c, n_groups)
+    first, _, _ = _first_match(lit, W_chunks, thresh_c, group_c, policy_c, n_groups)
     return first
 
 

@@ -186,8 +186,8 @@ permit (principal, action, resource) when { principal.name == "test-user" &&
 
 
 def test_match_bits_arrays_splits_large_batches(monkeypatch):
-    """Batches beyond the pipeline sub-batch size must split, not crash on
-    the bucket clamp (buckets top out at 32768)."""
+    """Batches beyond the fixed chunk size must split into multiple kernel
+    calls whose concatenated rows match the single-chunk result."""
     import numpy as np
 
     from cedar_tpu.engine import evaluator as ev
@@ -210,7 +210,7 @@ def test_match_bits_arrays_splits_large_batches(monkeypatch):
     big_c = np.repeat(codes, reps, axis=0)
     big_e = np.repeat(extras, reps, axis=0)
     small = engine.match_bits_arrays(codes, extras, cs=cs)
-    monkeypatch.setattr(ev, "_PIPELINE_SB", 8)
+    monkeypatch.setattr(ev.TPUPolicyEngine, "_BITS_CHUNK", 8)
     big = engine.match_bits_arrays(big_c, big_e, cs=cs)
     assert big.shape[0] == len(items) * reps
     for i in range(len(items)):
@@ -483,3 +483,63 @@ def test_randomized_policies_differential():
             )
         )
     check([src], cases)
+
+
+def test_want_bits_bitmap_matches_bits_kernel():
+    """The compacted in-call bits payload (match_arrays want_bits) must be
+    row-identical to the standalone bitset kernel, cover exactly the
+    flagged rows, and never report bucket-padding rows."""
+    import numpy as np
+
+    from cedar_tpu.compiler.table import encode_request_codes
+    from cedar_tpu.ops.match import WORD_ERR, WORD_MULTI
+
+    src = """
+permit (principal, action, resource) when { principal.name == "test-user" };
+permit (principal, action, resource) when { resource.resource == "pods" };
+forbid (principal, action, resource) when { resource.resource == "nodes" };
+"""
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "t0")], warm="off")
+    cs = engine._compiled
+    packed = cs.packed
+    cases = [
+        sar(),  # multi-allow (2 permits)
+        sar(user=UserInfo(name="x", uid="x"), resource="configmaps"),  # none
+        sar(resource="nodes"),  # single forbid
+    ]
+    encoded = [
+        encode_request_codes(packed.plan, packed.table, *record_to_cedar_resource(a))
+        for a in cases
+    ]
+    codes, extras = engine._encode_batch_arrays(cs, encoded, len(encoded))
+    words, _, bitmap = engine.match_arrays(codes, extras, cs=cs, want_bits=True)
+    flagged = set(
+        np.nonzero((words.astype(np.uint32) & (WORD_ERR | WORD_MULTI)) != 0)[0].tolist()
+    )
+    assert set(bitmap) == flagged
+    assert all(0 <= i < len(cases) for i in bitmap)  # no padding rows
+    ref = engine.match_bits_arrays(codes, extras, cs=cs)
+    for i, row in bitmap.items():
+        assert (row == ref[i]).all()
+
+
+def test_bits_compaction_overflow_falls_back():
+    """More flagged rows than the device compaction carries (BITS_TOPK):
+    the overflow rows must still render exact reason sets via the
+    standalone bitset kernel."""
+    from cedar_tpu.ops.match import BITS_TOPK
+
+    src = """
+permit (principal, action, resource) when { resource.resource == "pods" };
+permit (principal, action, resource) when { principal.name == "test-user" };
+"""
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "t0")], warm="off")
+    n = BITS_TOPK + 88  # > K once the batch bucket exceeds BITS_TOPK
+    items = [record_to_cedar_resource(sar()) for _ in range(n)]
+    results = engine.evaluate_batch(items)
+    assert len(results) == n
+    for decision, diag in results:
+        assert decision == "allow"
+        assert len(diag.reasons) == 2

@@ -39,7 +39,7 @@ def test_pallas_first_match_parity(B, L, R, G):
     lit = _lit_matrix(jnp.asarray(active), L)
 
     W3, t3, g3, p3 = chunk_rules(W, thresh, group, policy)
-    ref = _first_match(
+    ref_first, ref_last, _ = _first_match(
         lit,
         jnp.asarray(W3, jnp.bfloat16),
         jnp.asarray(t3),
@@ -47,6 +47,7 @@ def test_pallas_first_match_parity(B, L, R, G):
         jnp.asarray(p3),
         G,
     )
+    ref = (ref_first, ref_last)
     out = pallas_first_match(
         lit,
         jnp.asarray(W, jnp.bfloat16),
