@@ -134,7 +134,7 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
   unless { resource.resource == "secrets" };
 """
     eng = TPUPolicyEngine()
-    eng.load([PolicySet.from_source(demo_src, "demo")])
+    eng.load([PolicySet.from_source(demo_src, "demo")], warm="off")
     item = record_to_cedar_resource(
         Attributes(
             user=UserInfo(name="test-user", uid="u"), verb="get",
@@ -184,18 +184,78 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
             )
         return items
 
-    for key, ps, with_sel in (
+    # configs 2/3 time the SERVING path: raw SAR JSON through the C++
+    # encoder + device matcher (engine/fastpath.py) — what the webhook
+    # actually runs per request. The python evaluate_batch rate is kept as
+    # a secondary column.
+    from cedar_tpu.engine.fastpath import SARFastPath
+    from cedar_tpu.native import native_available
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    def sar_bodies(n, with_selectors=False):
+        bodies = []
+        for _ in range(n):
+            ra = {
+                "verb": rng.choice(verbs),
+                "version": "v1",
+                "resource": rng.choice(resources),
+                "namespace": rng.choice(nss),
+            }
+            if with_selectors and rng.random() < 0.4:
+                ra["labelSelector"] = {
+                    "requirements": [
+                        {
+                            "key": "owner",
+                            "operator": "=",
+                            "values": [f"team-{rng.randint(0, 50)}"],
+                        }
+                    ]
+                }
+            bodies.append(
+                json.dumps(
+                    {
+                        "apiVersion": "authorization.k8s.io/v1",
+                        "kind": "SubjectAccessReview",
+                        "spec": {
+                            "user": rng.choice(users),
+                            "uid": "u",
+                            "groups": [f"team-{rng.randint(0, 50)}"],
+                            "resourceAttributes": ra,
+                        },
+                    }
+                ).encode()
+            )
+        return bodies
+
+    for key, ps_src, with_sel in (
         ("rbac200", ps200, False),
         ("selector1k", build_selector_policy_set(1000), True),
     ):
         eng = TPUPolicyEngine()
-        eng.load([ps])
+        eng.load([ps_src], warm="off")
         items = sar_items(2048, with_sel)
         eng.evaluate_batch(items)  # warm
         t = time.time()
         eng.evaluate_batch(items)
-        out[f"{key}_e2e_rate"] = round(2048 / (time.time() - t))
+        out[f"{key}_python_rate"] = round(2048 / (time.time() - t))
         out[f"{key}_fallback"] = eng.stats["fallback_policies"]
+        if native_available() and not eng.stats["fallback_policies"]:
+            store = MemoryStore(key, ps_src)
+            auth = CedarWebhookAuthorizer(
+                TieredPolicyStores([store]), evaluate=eng.evaluate
+            )
+            fast = SARFastPath(eng, auth)
+            bodies = sar_bodies(8192, with_sel)
+            fast.authorize_raw(bodies)  # warm (compile + encoder build)
+            best = 0.0
+            for _ in range(3):
+                t = time.time()
+                fast.authorize_raw(bodies)
+                best = max(best, 8192 / (time.time() - t))
+            out[f"{key}_e2e_rate"] = round(best)
+        else:
+            out[f"{key}_e2e_rate"] = out[f"{key}_python_rate"]
 
     # -- config 4: admission path (demo admission policies + object walk)
     import pathlib
@@ -223,7 +283,8 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
         [
             PolicySet.from_source(adm_src, "adm"),
             PolicySet.from_source(ALLOW_ALL_ADMISSION_POLICY_SOURCE, "aa"),
-        ]
+        ],
+        warm="off",
     )
     handler = CedarAdmissionHandler(
         TieredPolicyStores(
@@ -234,33 +295,66 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
         evaluate_batch=eng.evaluate_batch,
     )
 
-    def review(i):
+    def review_body(i):
         labels = {"owner": "bob"} if i % 2 else {}
-        return AdmissionRequest.from_admission_review(
-            {
-                "request": {
-                    "uid": f"u{i}", "operation": "CREATE",
-                    "userInfo": {"username": "bob", "groups": ["tenants"]},
-                    "kind": {"group": "", "version": "v1", "kind": "ConfigMap"},
-                    "namespace": "default",
-                    "object": {
-                        "apiVersion": "v1", "kind": "ConfigMap",
-                        "metadata": {
-                            "name": f"cm-{i}", "namespace": "default",
-                            "labels": labels,
-                        },
-                        "data": {f"k{j}": "v" for j in range(8)},
+        return {
+            "request": {
+                "uid": f"u{i}", "operation": "CREATE",
+                "userInfo": {"username": "bob", "groups": ["tenants"]},
+                "kind": {"group": "", "version": "v1", "kind": "ConfigMap"},
+                "resource": {"group": "", "version": "v1",
+                             "resource": "configmaps"},
+                "namespace": "default",
+                "object": {
+                    "apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {
+                        "name": f"cm-{i}", "namespace": "default",
+                        "labels": labels,
                     },
-                }
+                    "data": {f"k{j}": "v" for j in range(8)},
+                },
             }
-        )
+        }
 
-    reviews = [review(i) for i in range(512)]
+    # python handler path (entity build + batched device eval)
+    reviews = [
+        AdmissionRequest.from_admission_review(review_body(i))
+        for i in range(512)
+    ]
     handler.handle_batch(reviews[:32])  # warm
     t = time.time()
     handler.handle_batch(reviews)
-    out["admission_e2e_rate"] = round(512 / (time.time() - t))
+    out["admission_python_rate"] = round(512 / (time.time() - t))
+
+    # serving path: raw AdmissionReview JSON through the native fast path
+    # (C++ object walk + device kernel); falls back to the python handler
+    # when the set carries interpreter-fallback policies
+    from cedar_tpu.engine.fastpath import AdmissionFastPath
+    from cedar_tpu.native import native_available
+
+    fast = AdmissionFastPath(eng, handler)
+    out["admission_native_available"] = bool(
+        native_available() and fast.available
+    )
+    if out["admission_native_available"]:
+        NB = 16384
+        bodies = [json.dumps(review_body(i)).encode() for i in range(NB)]
+        fast.handle_raw(bodies)  # warm
+        best = 0.0
+        for _ in range(3):
+            t = time.time()
+            fast.handle_raw(bodies)
+            best = max(best, NB / (time.time() - t))
+        out["admission_e2e_rate"] = round(best)
+    else:
+        out["admission_e2e_rate"] = out["admission_python_rate"]
     return out
+
+
+def _timed(fn):
+    t = time.time()
+    fn()
+    return time.time() - t
 
 
 def main():
@@ -273,7 +367,10 @@ def main():
     t0 = time.time()
     ps, users, nss, resources, verbs, groups = build_policy_set()
     engine = TPUPolicyEngine()
-    stats = engine.load([ps])
+    # warm="off": the bench warms the shapes it times explicitly;
+    # background warm threads would contend with the timed trials for the
+    # single host core and the tunnel
+    stats = engine.load([ps], warm="off")
     compile_s = time.time() - t0
 
     rng = random.Random(1)
@@ -371,6 +468,114 @@ def main():
         np.asarray(w)
     resident_rate = SB * n_pipeline / (time.time() - t2)
 
+    # ---- per-stage budget for one SB-row super-batch (VERDICT r2 #4).
+    # block_until_ready does not sync through this tunnel; every stage is
+    # timed by forcing a (tiny) readback and subtracting the null RTT.
+    def _p50(samples):
+        s = sorted(samples)
+        return s[len(s) // 2]
+
+    # fresh device result per probe: jax.Array caches its host copy, so
+    # re-fetching the SAME array is free and would report a ~0 RTT
+    tiny = jax.device_put(np.zeros(1, np.int32))
+    np.asarray(tiny + np.int32(1))  # warm the add
+    null_rtt_ms = _p50(
+        [_timed(lambda i=i: np.asarray(tiny + np.int32(i))) for i in range(20)]
+    ) * 1e3
+
+    def h2d_once():
+        c = jax.device_put(codes_base)
+        e = jax.device_put(extras_base)
+        np.asarray(c[:1, :1]), np.asarray(e[:1, :1])
+
+    h2d_ms = max(
+        _p50([_timed(h2d_once) for _ in range(5)]) * 1e3 - 2 * null_rtt_ms, 0.0
+    )
+
+    def compute_chain():
+        acc = jnp_zero
+        for c, e in dev_batches:
+            w, _ = match_rules_codes(c, e, *args, packed.n_tiers, False)
+            acc = acc + w.astype(np.int32).sum()
+        np.asarray(acc)
+
+    import jax.numpy as jnp
+
+    jnp_zero = jnp.zeros((), jnp.int32)
+    compute_chain()  # warm the fused sum shape
+    compute_ms = max(
+        (_p50([_timed(compute_chain) for _ in range(5)]) * 1e3 - null_rtt_ms)
+        / n_pipeline,
+        0.0,
+    )
+
+    fresh_words = [
+        match_rules_codes(c, e, *args, packed.n_tiers, False)[0]
+        for c, e in dev_batches
+    ]
+    d2h_samples = []
+    for w in fresh_words:  # distinct arrays: jax caches host copies
+        d2h_samples.append(_timed(lambda w=w: np.asarray(w)))
+    d2h_ms = max(_p50(d2h_samples) * 1e3 - null_rtt_ms, 0.0)
+
+    stage_budget = {
+        "null_rtt_ms": round(null_rtt_ms, 3),
+        "h2d_ms_per_superbatch": round(h2d_ms, 2),
+        "device_compute_ms_per_superbatch": round(compute_ms, 2),
+        "d2h_words_ms_per_superbatch": round(d2h_ms, 2),
+        "encode_us_per_req_python": round(encode_us, 1),
+        "superbatch_rows": SB,
+    }
+
+    # ---- tunnel-independent small-batch latency (VERDICT r2 #6): device
+    # p50/p99 at serving batch sizes, null-RTT-subtracted, plus the host
+    # encode cost — the number an attached-TPU deployment would see.
+    latency = {}
+    for b_lat in (1, 64, 256):
+        cb = np.ascontiguousarray(codes_base[:b_lat])
+        eb = np.ascontiguousarray(extras_base[:b_lat])
+        w, _ = match_rules_codes(cb, eb, *args, packed.n_tiers, False)
+        np.asarray(w)  # compile this exact shape
+        # through-tunnel percentiles (what THIS deployment sees)
+        samp = []
+        for _ in range(40):
+            t = time.time()
+            w, _ = match_rules_codes(cb, eb, *args, packed.n_tiers, False)
+            np.asarray(w)
+            samp.append(time.time() - t)
+        samp.sort()
+        latency[f"tunnel_p50_ms_b{b_lat}"] = round(samp[len(samp) // 2] * 1e3, 2)
+        latency[f"tunnel_p99_ms_b{b_lat}"] = round(
+            samp[int(len(samp) * 0.99)] * 1e3, 2
+        )
+        # device-only execution: chain K dispatches, fetch once — the single
+        # fetch pays the tunnel RTT once, so (total - RTT) / K isolates
+        # per-call device execution + dispatch (the attached-host number)
+        K = 32
+        cbd, ebd = jax.device_put(cb), jax.device_put(eb)
+        np.asarray(cbd[:1, :1])
+
+        def chain():
+            ws = [
+                match_rules_codes(cbd, ebd, *args, packed.n_tiers, False)[0]
+                for _ in range(K)
+            ]
+            np.asarray(ws[-1])
+            return ws
+
+        chain()  # warm
+        exec_ms = max(
+            (_p50([_timed(chain) for _ in range(5)]) * 1e3 - null_rtt_ms) / K,
+            0.0,
+        )
+        latency[f"device_exec_ms_b{b_lat}"] = round(exec_ms, 3)
+    # supported iff device execution + native host encode/decode fits the
+    # reference's 2ms webhook latency bucket
+    # (/root/reference/internal/server/metrics/metrics.go:43) with 3x
+    # headroom for scheduling jitter on an attached host
+    worst_exec = max(latency[f"device_exec_ms_b{b}"] for b in (1, 64, 256))
+    latency["p99_under_2ms_attached"] = bool(worst_exec * 3 + 0.2 < 2.0)
+
     # end-to-end python path (encode + device + finalize), single thread
     engine.evaluate_batch(items[:1024])  # warm the bucket
     t3 = time.time()
@@ -419,7 +624,13 @@ def main():
 
             NB = 65536
             bodies = [mk_sar_body() for _ in range(NB)]
-            fast.authorize_raw(bodies[:1024])  # warm
+            fast.authorize_raw(bodies)  # warm every sub-batch shape
+            snap = fast._current_snapshot()
+            t_enc = time.time()
+            snap.encoder.encode_batch(bodies)
+            stage_budget["encode_us_per_req_native"] = round(
+                (time.time() - t_enc) / NB * 1e6, 2
+            )
             best = 0.0
             for _ in range(3):
                 t4 = time.time()
@@ -450,6 +661,8 @@ def main():
             "e2e_python_rate": round(e2e_rate),
             "e2e_native_rate": round(native_e2e_rate),
             "compile_s": round(compile_s, 2),
+            "stage_budget": stage_budget,
+            "latency": latency,
             "input_bytes_per_req": int(
                 codes_base.dtype.itemsize * S + extras_base.dtype.itemsize * E
             ),

@@ -209,6 +209,17 @@ def build_server(args) -> WebhookServer:
         evaluate_batch=admission_evaluate_batch,
     )
 
+    admission_fastpath = None
+    if admission_evaluate is not None and not args.no_native:
+        from ..engine.fastpath import AdmissionFastPath
+        from ..native import native_available
+
+        if native_available():
+            admission_fastpath = AdmissionFastPath(
+                admission_engine, admission_handler
+            )
+            log.info("native admission fast path enabled")
+
     injector = ErrorInjector(
         ErrorInjectionConfig(
             enabled=(
@@ -239,6 +250,7 @@ def build_server(args) -> WebhookServer:
         certfile=certfile,
         keyfile=keyfile,
         fastpath=fastpath,
+        admission_fastpath=admission_fastpath,
         batch_window_s=args.batch_window_us / 1e6,
     )
 

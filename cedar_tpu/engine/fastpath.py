@@ -22,6 +22,8 @@ from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 import numpy as np
 
 from ..native import (
+    F_ADM_ERROR,
+    F_ADM_NS_SKIP,
     F_EXTRAS_OVERFLOW,
     F_OK,
     F_PARSE_ERROR,
@@ -260,3 +262,217 @@ class SARFastPath:
         if diag.errors:
             log.error("Authorize errors: %s", diag.errors)
         return DECISION_NO_OPINION, "", None
+
+
+class AdmissionFastPath:
+    """Batch evaluator over raw AdmissionReview JSON bodies — the admission
+    analogue of SARFastPath. The C++ encoder parses the review, walks the
+    (old)object into feature codes (native/encoder.cpp build_adm, mirroring
+    entities/admission.py and reference
+    internal/server/entities/admission.go:160-369), and the batched device
+    kernel produces the verdicts; deny messages carry the complete
+    matched-policy list like the reference's handler
+    (internal/server/admission/handler.go:157-164). Rows the native walk
+    can't prove identical (parse quirks, unsupported leaf shapes, extras
+    overflow) re-run through the exact Python handler."""
+
+    def __init__(self, engine: TPUPolicyEngine, handler):
+        self.engine = engine
+        self.handler = handler  # CedarAdmissionHandler: fallback + readiness
+        self._snap: Optional[_Snapshot] = None
+        self._build_lock = threading.Lock()
+
+    def _current_snapshot(self) -> Optional[_Snapshot]:
+        cs = self.engine._compiled
+        if cs is None or cs.packed.fallback:
+            return None
+        snap = self._snap
+        if snap is not None and snap.cs is cs:
+            return snap if snap.encoder is not None else None
+        with self._build_lock:
+            cs = self.engine._compiled
+            if cs is None or cs.packed.fallback:
+                return None
+            snap = self._snap
+            if snap is None or snap.cs is not cs:
+                try:
+                    encoder = NativeEncoder.create(cs.packed)
+                except Exception:  # noqa: BLE001 — cache the failure
+                    log.exception(
+                        "native admission encoder build failed; python path only"
+                    )
+                    encoder = None
+                snap = _Snapshot(encoder, cs, {})
+                self._snap = snap
+        return snap if snap.encoder is not None else None
+
+    @property
+    def available(self) -> bool:
+        return self._current_snapshot() is not None
+
+    def _py_one(self, body: bytes):
+        """Exact Python path for one raw body; response parity with
+        WebhookServer.handle_admit."""
+        import json
+
+        from ..entities.admission import AdmissionRequest
+        from ..server.admission import AdmissionResponse
+
+        review = None
+        try:
+            review = json.loads(body)
+            req = AdmissionRequest.from_admission_review(review)
+            return self.handler.handle(req)
+        except (ValueError, TypeError, RecursionError) as e:
+            if review is None:
+                return AdmissionResponse(
+                    uid="",
+                    allowed=False,
+                    code=400,
+                    error=f"failed parsing body: {e}",
+                )
+            return self._allow_on_error(review, e)
+        except Exception as e:  # noqa: BLE001 — fail-open like the reference
+            log.exception("admission fastpath fallback failed")
+            return self._allow_on_error(review, e)
+
+    @staticmethod
+    def _allow_on_error(review, e):
+        from ..server.admission import AdmissionResponse
+
+        uid = ""
+        if isinstance(review, dict):
+            uid = (review.get("request") or {}).get("uid", "") or ""
+        return AdmissionResponse(
+            uid=uid,
+            allowed=True,
+            code=200,
+            error=f"evaluation error (allowed on error): {e}",
+        )
+
+    def _deny_message(self, snap: _Snapshot, pols) -> str:
+        """Compact JSON list of reason dicts — byte-identical to the
+        handler's _decide rendering (Reason.to_dict per matched policy)."""
+        import json
+
+        from ..lang.authorize import Reason
+
+        key = ("adm", tuple(pols))
+        msg = snap.reason_cache.get(key)
+        if msg is None:
+            packed = snap.cs.packed
+            msg = json.dumps(
+                [
+                    Reason(
+                        packed.policy_meta[p].policy_id,
+                        packed.policy_meta[p].filename,
+                        packed.policy_meta[p].position,
+                    ).to_dict()
+                    for p in pols
+                ],
+                separators=(",", ":"),
+            )
+            snap.reason_cache[key] = msg
+        return msg
+
+    def handle_raw(self, bodies: Sequence[bytes]) -> list:
+        from ..server.admission import AdmissionResponse
+
+        snap = self._current_snapshot()
+        if snap is None or not self.handler._ready():
+            # unready stores answer allow in handler.handle_batch; keep the
+            # exact path for both cases
+            return [self._py_one(b) for b in bodies]
+        encoder, cs = snap.encoder, snap.cs
+        codes, extras, _counts, flags, uids = encoder.encode_adm_batch(bodies)
+        results: list = [None] * len(bodies)
+
+        for i in np.nonzero(flags == F_ADM_NS_SKIP)[0]:
+            results[i] = AdmissionResponse(uid=uids[i], allowed=True)
+        need_py = (
+            (flags == F_PARSE_ERROR)
+            | (flags == F_ADM_ERROR)
+            | (flags == F_EXTRAS_OVERFLOW)
+        )
+        for i in np.nonzero(need_py)[0]:
+            results[i] = self._py_one(bodies[i])
+
+        ok = flags == F_OK
+        n_ok = int(ok.sum())
+        if n_ok:
+            all_ok = n_ok == len(bodies)
+            idx = np.arange(len(bodies)) if all_ok else np.nonzero(ok)[0]
+            ok_codes = codes if all_ok else codes[idx]
+            from .evaluator import _round_bucket
+
+            max_e = int(
+                _counts.max(initial=0) if all_ok else _counts[idx].max(initial=0)
+            )
+            if max_e == 0:
+                E = 1
+            else:
+                E = min(
+                    _round_bucket(max_e, (8, 16, 32, 64, 128, 256)),
+                    extras.shape[1],
+                )
+            ok_extras = extras[:, :E] if all_ok else extras[idx, :E]
+            words, _, bitmap = self.engine.match_arrays(
+                ok_codes, ok_extras, cs=cs, want_bits=True
+            )
+            resolved = self.engine.resolve_flagged(
+                words, ok_codes, ok_extras, cs=cs, bitmap=bitmap
+            )
+            packed = cs.packed
+            w = words.astype(np.uint32)
+            vcodes = ((w >> 30) & 0x3).tolist()
+            pols = (w & 0xFFFFFF).tolist()
+            for k, i in enumerate(idx.tolist()):
+                uid = uids[i]
+                if k in resolved:
+                    decision, diag = resolved[k]
+                    if decision == DENY and diag.reasons:
+                        import json as _json
+
+                        results[i] = AdmissionResponse(
+                            uid=uid,
+                            allowed=False,
+                            message=_json.dumps(
+                                [r.to_dict() for r in diag.reasons],
+                                separators=(",", ":"),
+                            ),
+                        )
+                    elif decision == DENY:
+                        if diag.errors:
+                            log.error("admission errors: %s", diag.errors)
+                        results[i] = AdmissionResponse(
+                            uid=uid, allowed=False, message=""
+                        )
+                    else:
+                        results[i] = AdmissionResponse(uid=uid, allowed=True)
+                    continue
+                c = vcodes[k]
+                if c == 1:
+                    results[i] = AdmissionResponse(uid=uid, allowed=True)
+                elif c == 2:
+                    results[i] = AdmissionResponse(
+                        uid=uid,
+                        allowed=False,
+                        message=self._deny_message(snap, (pols[k],)),
+                    )
+                elif c == 3:
+                    meta = packed.policy_meta[pols[k]]
+                    log.error(
+                        "admission errors: while evaluating policy `%s`:"
+                        " evaluation error",
+                        meta.policy_id,
+                    )
+                    results[i] = AdmissionResponse(
+                        uid=uid, allowed=False, message=""
+                    )
+                else:  # no signal: the allow-all final tier should preclude
+                    log.error(
+                        "request denied without reasons; the default permit "
+                        "policy was not evaluated"
+                    )
+                    results[i] = AdmissionResponse(uid=uid, allowed=False)
+        return results

@@ -47,6 +47,8 @@ F_SELF_ALLOW_POLICIES = 2
 F_SELF_ALLOW_RBAC = 3
 F_SYSTEM_SKIP = 4
 F_EXTRAS_OVERFLOW = 5
+F_ADM_NS_SKIP = 6  # admission: kube-system/cedar-k8s-authz-system -> allow
+F_ADM_ERROR = 7  # admission: conversion error/unsupported shape -> py path
 
 _VAR_IDX = {"principal": 0, "action": 1, "resource": 2, "context": 3}
 _CMP_OPS = {"<": 0, "<=": 1, ">": 2, ">=": 3}
@@ -225,6 +227,22 @@ def _load_library():
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_int32,
         ]
+        lib.ce_encode_adm_batch.restype = None
+        lib.ce_encode_adm_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
         _lib = lib
     except Exception as e:  # no toolchain / build failure => python path
         _lib_error = str(e)
@@ -316,3 +334,55 @@ class NativeEncoder:
             n_threads,
         )
         return codes, extras, counts, flags
+
+    def encode_adm_batch(
+        self,
+        bodies: Sequence[bytes],
+        extras_cap: int = DEFAULT_EXTRAS_CAP,
+        n_threads: int = 0,
+    ):
+        """Raw AdmissionReview JSON bodies -> (codes, extras, extras_count,
+        flags, uids). Same contract as encode_batch plus: uids[i] is the
+        review uid (str) for F_OK / F_ADM_NS_SKIP rows; F_PARSE_ERROR /
+        F_ADM_ERROR / F_EXTRAS_OVERFLOW rows need the Python fallback."""
+        lib = _load_library()
+        assert lib is not None
+        n = len(bodies)
+        codes = np.zeros((n, self.n_slots), dtype=np.int32)
+        extras = np.full((n, extras_cap), self.pad_value, dtype=np.int32)
+        counts = np.zeros((n,), dtype=np.int32)
+        flags = np.zeros((n,), dtype=np.uint8)
+        uid_buf = ctypes.create_string_buffer(max(n, 1) * 256)
+        uid_lens = np.zeros((n,), dtype=np.int32)
+        if n == 0:
+            return codes, extras, counts, flags, []
+
+        buf = b"".join(bodies)
+        lens = np.fromiter((len(b) for b in bodies), dtype=np.uint64, count=n)
+        offsets = np.zeros((n,), dtype=np.uint64)
+        np.cumsum(lens[:-1], out=offsets[1:])
+        if n_threads <= 0:
+            import os
+
+            n_threads = min(max(os.cpu_count() or 1, 1), 16)
+        lib.ce_encode_adm_batch(
+            self._handle,
+            n,
+            buf,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            extras.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            extras_cap,
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            uid_buf,
+            uid_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_threads,
+        )
+        raw = uid_buf.raw
+        uids = [
+            raw[i * 256 : i * 256 + uid_lens[i]].decode("utf-8", "replace")
+            for i in range(n)
+        ]
+        return codes, extras, counts, flags, uids

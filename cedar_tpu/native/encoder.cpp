@@ -26,10 +26,15 @@
 // (format documented there); canonical value-key strings must stay in sync
 // with _canon() on the Python side.
 
+#include <arpa/inet.h>
+
 #include <algorithm>
+#include <charconv>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <memory>
 #include <string>
 #include <string_view>
@@ -152,6 +157,7 @@ class JsonParser {
   }
 
   JVal *number() {
+    const char *start = p_;
     if (p_ < end_ && *p_ == '-') ++p_;
     if (p_ >= end_ || *p_ < '0' || *p_ > '9') return nullptr;
     while (p_ < end_ && ((*p_ >= '0' && *p_ <= '9') || *p_ == '.' || *p_ == 'e' ||
@@ -159,6 +165,7 @@ class JsonParser {
       ++p_;
     JVal *v = arena_.alloc();
     v->kind = JVal::NUM;
+    v->str = sv(start, size_t(p_ - start));  // token kept for the admission walk
     return v;
   }
 
@@ -358,8 +365,10 @@ const V *sv_find(const SvMap<V> &m, sv key) {
 
 struct ScalarSlot {
   uint8_t var;       // 0 principal, 1 action, 2 resource, 3 context/other
-  bool deep;         // multi-component path => value always missing (authz)
-  std::string attr;  // single-component attribute path
+  bool deep;         // multi-component path => value always missing (authz;
+                     // the admission walk navigates `comps` instead)
+  std::string attr;  // attribute path, components joined with \x1f
+  std::vector<std::string> comps;  // split path (admission navigation)
   int32_t sidx;
   int32_t present_row;
   SvMap<int32_t> vocab;  // canon(value) -> row
@@ -448,6 +457,16 @@ Table *load_table(const uint8_t *blob, size_t len) {
     s.var = r.u8();
     s.deep = r.u8() != 0;
     s.attr = r.str();
+    {
+      size_t start = 0;
+      for (;;) {
+        size_t sep = s.attr.find('\x1f', start);
+        s.comps.push_back(s.attr.substr(
+            start, sep == std::string::npos ? sep : sep - start));
+        if (sep == std::string::npos) break;
+        start = sep + 1;
+      }
+    }
     s.sidx = r.i32();
     s.present_row = r.i32();
     int32_t nv = r.i32();
@@ -529,7 +548,10 @@ void canon_str_into(std::string &out, sv s) {
 }
 
 void canon_set_into(std::string &out, std::vector<std::string> &elems) {
+  // sets canonicalize as a FROZENSET of element keys (lang/values.py
+  // set_key): sort AND dedupe, or a duplicated element would change the key
   std::sort(elems.begin(), elems.end());
+  elems.erase(std::unique(elems.begin(), elems.end()), elems.end());
   out += "S{";
   for (size_t i = 0; i < elems.size(); ++i) {
     if (i) out.push_back('\x1f');
@@ -621,7 +643,7 @@ sv str_field(const JVal *o, sv k) {
   return v && v->kind == JVal::STR ? v->str : sv();
 }
 
-// flags returned per request
+// flags returned per request; mirrored in cedar_tpu/native/__init__.py
 enum : uint8_t {
   F_OK = 0,
   F_PARSE_ERROR = 1,
@@ -629,6 +651,8 @@ enum : uint8_t {
   F_SELF_ALLOW_RBAC = 3,
   F_SYSTEM_SKIP = 4,
   F_EXTRAS_OVERFLOW = 5,
+  F_ADM_NS_SKIP = 6,  // admission: skipped namespace -> allow
+  F_ADM_ERROR = 7,    // admission: shape/conversion issue -> python path
 };
 
 constexpr sv kAuthorizerIdentity = "system:authorizer:cedar-authorizer";
@@ -994,6 +1018,742 @@ void encode_one(const Table &t, Features &f, int32_t *codes, ExtrasOut &extras,
   }
 }
 
+// ======================= admission encoding ==============================
+// Raw AdmissionReview JSON -> feature codes over the same activation table,
+// mirroring cedar_tpu/entities/admission.py + server/admission.py (reference
+// internal/server/entities/admission.go:160-369). Rows the native walk
+// cannot prove identical to the Python path (unsupported leaf types, parse
+// quirks, pathological shapes) are flagged for the exact Python fallback.
+
+struct CVal {
+  enum Kind : uint8_t { STRV, LONGV, BOOLV, IPV, SETV, RECV, ENTV } kind = STRV;
+  sv str;       // STRV payload / IPV raw text / ENTV id
+  sv ent_type;  // ENTV type
+  int64_t l = 0;
+  bool b = false;
+  std::vector<std::pair<sv, CVal *>> fields;  // RECV
+  std::vector<CVal *> elems;                  // SETV
+};
+
+class CPool {
+ public:
+  CVal *make(CVal::Kind k) {
+    if (used_ == pool_.size()) pool_.emplace_back();
+    CVal *v = &pool_[used_++];
+    v->kind = k;
+    v->str = sv();
+    v->ent_type = sv();
+    v->l = 0;
+    v->b = false;
+    v->fields.clear();
+    v->elems.clear();
+    return v;
+  }
+  void reset() { used_ = 0; }
+
+ private:
+  std::deque<CVal> pool_;
+  size_t used_ = 0;
+};
+
+// g/v/k-conditional map attributes; MUST stay in sync with
+// KNOWN_KEY_VALUE_STRING_MAP_ATTRIBUTES / .._SLICE_.. in
+// cedar_tpu/entities/admission.py (reference admission.go:195-295).
+const SvMap<char> &kv_string_attrs() {
+  static const SvMap<char> m = [] {
+    SvMap<char> t;
+    auto add = [&](const char *g, const char *v, const char *k,
+                   std::initializer_list<const char *> attrs) {
+      for (const char *a : attrs) {
+        std::string key;
+        (((key += g) += '\x1f') += v) += '\x1f';
+        ((key += k) += '\x1f') += a;
+        t[std::move(key)] = 1;
+      }
+    };
+    add("core", "v1", "ConfigMap", {"data", "binaryData"});
+    add("core", "v1", "CSIPersistentVolumeSource", {"volumeAttributes"});
+    add("core", "v1", "CSIVolumeSource", {"volumeAttributes"});
+    add("core", "v1", "FlexPersistentVolumeSource", {"options"});
+    add("core", "v1", "FlexVolumeSource", {"options"});
+    add("core", "v1", "PersistentVolumeClaimStatus",
+        {"allocatedResourceStatuses"});
+    add("core", "v1", "Pod", {"nodeSelector"});
+    add("core", "v1", "ReplicationController", {"selector"});
+    add("core", "v1", "Secret", {"data", "stringData"});
+    add("core", "v1", "Service", {"selector"});
+    add("discovery", "v1", "Endpoint", {"deprecatedTopology"});
+    add("node", "v1", "Scheduling", {"nodeSelectors"});
+    add("storage", "v1", "StorageClass", {"parameters"});
+    add("storage", "v1", "VolumeAttachmentStatus", {"attachmentMetadata"});
+    add("meta", "v1", "LabelSelector", {"matchLabels"});
+    add("meta", "v1", "ObjectMeta", {"annotations", "labels"});
+    return t;
+  }();
+  return m;
+}
+
+const SvMap<char> &kv_slice_attrs() {
+  static const SvMap<char> m = [] {
+    SvMap<char> t;
+    auto add = [&](const char *g, const char *v, const char *k, const char *a) {
+      std::string key;
+      (((key += g) += '\x1f') += v) += '\x1f';
+      ((key += k) += '\x1f') += a;
+      t[std::move(key)] = 1;
+    };
+    add("authentication", "v1", "UserInfo", "extra");
+    add("authorization", "v1", "SubjectAccessReview", "extra");
+    add("certificates", "v1", "CertificateSigningRequest", "extra");
+    return t;
+  }();
+  return m;
+}
+
+bool is_ip_key(sv k) {
+  return k == "podIP" || k == "clusterIP" || k == "loadBalancerIP" ||
+         k == "hostIP" || k == "ip" || k == "podIPs" || k == "hostIPs";
+}
+
+// python int(str): optional surrounding whitespace, optional sign, digits
+// with single underscores BETWEEN digits. Returns false when python would
+// raise ValueError.
+bool py_int_parse(sv s, long long *out) {
+  size_t a = 0, b = s.size();
+  auto is_ws = [](char c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+           c == '\v';
+  };
+  while (a < b && is_ws(s[a])) ++a;
+  while (b > a && is_ws(s[b - 1])) --b;
+  if (a == b) return false;
+  bool neg = false;
+  if (s[a] == '+' || s[a] == '-') {
+    neg = s[a] == '-';
+    ++a;
+  }
+  if (a == b) return false;
+  long long v = 0;
+  bool last_digit = false;
+  for (size_t i = a; i < b; ++i) {
+    char c = s[i];
+    if (c == '_') {
+      if (!last_digit || i + 1 == b) return false;
+      last_digit = false;
+      continue;
+    }
+    if (c < '0' || c > '9') return false;
+    if (v > (1ll << 40)) return false;  // far past any prefix length
+    v = v * 10 + (c - '0');
+    last_digit = true;
+  }
+  if (!last_digit) return false;
+  *out = neg ? -v : v;
+  return true;
+}
+
+// 0 = not an ip (python IPAddr.parse raises -> raw string kept),
+// 1 = ip, 2 = can't prove parity (scoped IPv6 etc.) -> python fallback
+int classify_ip(sv s) {
+  sv addr = s;
+  size_t slash = s.rfind('/');
+  if (slash != sv::npos) {
+    addr = s.substr(0, slash);
+    sv pfx = s.substr(slash + 1);
+    long long p;
+    if (!py_int_parse(pfx, &p)) return 0;  // int(p) raises -> raw string
+    bool v6 = addr.find(':') != sv::npos;
+    if (p < 0 || p > (v6 ? 128 : 32)) return 0;  // bad prefix -> raw string
+  }
+  if (addr.find('%') != sv::npos) return 2;  // python 3.9+ parses zone ids
+  if (addr.find(':') != sv::npos) {
+    char buf[16];
+    std::string z(addr);
+    return inet_pton(AF_INET6, z.c_str(), buf) == 1 ? 1 : 0;
+  }
+  // strict dotted-quad: 4 decimal octets, 0-255, no leading zeros
+  int octets = 0;
+  size_t i = 0;
+  while (i < addr.size()) {
+    size_t start = i;
+    int v = 0;
+    while (i < addr.size() && addr[i] >= '0' && addr[i] <= '9') {
+      v = v * 10 + (addr[i] - '0');
+      if (v > 255) return 0;
+      ++i;
+    }
+    size_t len = i - start;
+    if (len == 0 || len > 3) return 0;
+    if (len > 1 && addr[start] == '0') return 0;
+    ++octets;
+    if (i == addr.size()) break;
+    if (addr[i] != '.') return 0;
+    ++i;
+    if (i == addr.size()) return 0;  // trailing dot
+  }
+  return octets == 4 ? 1 : 0;
+}
+
+struct AdmCtx {
+  CPool *cp;
+  sv group, kversion, kkind;  // request g/v/k for the known-map tables
+  bool error = false;         // -> F_ADM_ERROR (python fallback re-raises)
+};
+
+void dedupe_insert(std::vector<std::pair<sv, CVal *>> &fields, sv key,
+                   CVal *val) {
+  // python dicts deduplicate JSON keys (last value wins)
+  for (auto &f : fields)
+    if (f.first == key) {
+      f.second = val;
+      return;
+    }
+  fields.emplace_back(key, val);
+}
+
+// Resolve duplicate JSON keys BEFORE any per-value filtering: python's
+// json.loads builds the dict first (last value wins, whatever its type),
+// then the walk filters — filtering before dedup would let a skipped later
+// duplicate resurrect an earlier value.
+void dedupe_children(const JVal *obj,
+                     std::vector<const JVal *> &out) {
+  out.clear();
+  for (const JVal *kv = obj->child; kv; kv = kv->next) {
+    bool replaced = false;
+    for (auto &existing : out)
+      if (existing->key == kv->key) {
+        existing = kv;
+        replaced = true;
+        break;
+      }
+    if (!replaced) out.push_back(kv);
+  }
+}
+
+CVal *key_value_set(AdmCtx &c, const JVal *obj) {
+  // map[string]string -> Set<{key, value}>; non-string values skip the key
+  CVal *s = c.cp->make(CVal::SETV);
+  std::vector<const JVal *> kids;
+  dedupe_children(obj, kids);
+  for (const JVal *kv : kids) {
+    if (kv->kind != JVal::STR) continue;
+    CVal *r = c.cp->make(CVal::RECV);
+    CVal *k = c.cp->make(CVal::STRV);
+    k->str = kv->key;
+    CVal *v = c.cp->make(CVal::STRV);
+    v->str = kv->str;
+    r->fields.emplace_back("key", k);
+    r->fields.emplace_back("value", v);
+    s->elems.push_back(r);
+  }
+  return s;
+}
+
+CVal *key_value_slice_set(AdmCtx &c, const JVal *obj) {
+  // map[string][]string -> Set<{key, value: Set<String>}>
+  CVal *s = c.cp->make(CVal::SETV);
+  std::vector<const JVal *> kids;
+  dedupe_children(obj, kids);
+  for (const JVal *kv : kids) {
+    if (kv->kind != JVal::ARR) continue;
+    CVal *vals = c.cp->make(CVal::SETV);
+    for (const JVal *e = kv->child; e; e = e->next)
+      if (e->kind == JVal::STR) {
+        CVal *ev = c.cp->make(CVal::STRV);
+        ev->str = e->str;
+        vals->elems.push_back(ev);
+      }
+    CVal *r = c.cp->make(CVal::RECV);
+    CVal *k = c.cp->make(CVal::STRV);
+    k->str = kv->key;
+    r->fields.emplace_back("key", k);
+    r->fields.emplace_back("value", vals);
+    s->elems.push_back(r);
+  }
+  return s;
+}
+
+CVal *adm_walk(AdmCtx &c, int depth, sv key, const JVal *v) {
+  if (depth == 0) {
+    c.error = true;  // python raises "max depth reached"
+    return nullptr;
+  }
+  switch (v->kind) {
+    case JVal::NUL:
+      return nullptr;
+    case JVal::OBJ: {
+      thread_local std::string k;
+      k.assign(c.group.data(), c.group.size());
+      k += '\x1f';
+      k.append(c.kversion.data(), c.kversion.size());
+      k += '\x1f';
+      k.append(c.kkind.data(), c.kkind.size());
+      k += '\x1f';
+      k.append(key.data(), key.size());
+      if (sv_find(kv_string_attrs(), k)) return key_value_set(c, v);
+      if (sv_find(kv_slice_attrs(), k)) return key_value_slice_set(c, v);
+      if (key == "labels" || key == "annotations") return key_value_set(c, v);
+      CVal *r = c.cp->make(CVal::RECV);
+      std::vector<const JVal *> kids;
+      dedupe_children(v, kids);
+      for (const JVal *kv : kids) {
+        CVal *val = adm_walk(c, depth - 1, kv->key, kv);
+        if (c.error) return nullptr;
+        if (!val) continue;  // nulls and empty nested records are skipped
+        r->fields.emplace_back(kv->key, val);
+      }
+      if (r->fields.empty()) return nullptr;
+      return r;
+    }
+    case JVal::ARR: {
+      CVal *s = c.cp->make(CVal::SETV);
+      for (const JVal *it = v->child; it; it = it->next) {
+        CVal *e = adm_walk(c, depth - 1, key, it);
+        if (c.error) return nullptr;
+        if (e) s->elems.push_back(e);
+      }
+      return s;
+    }
+    case JVal::STR: {
+      if (is_ip_key(key)) {
+        int cls = classify_ip(v->str);
+        if (cls == 2) {
+          c.error = true;
+          return nullptr;
+        }
+        if (cls == 1) {
+          CVal *x = c.cp->make(CVal::IPV);
+          x->str = v->str;
+          return x;
+        }
+      }
+      CVal *x = c.cp->make(CVal::STRV);
+      x->str = v->str;
+      return x;
+    }
+    case JVal::BOOL: {
+      CVal *x = c.cp->make(CVal::BOOLV);
+      x->b = v->b;
+      return x;
+    }
+    case JVal::NUM: {
+      sv t = v->str;
+      for (char ch : t)
+        if (ch == '.' || ch == 'e' || ch == 'E') {
+          c.error = true;  // python json gives float -> walk raises
+          return nullptr;
+        }
+      int64_t x = 0;
+      auto res = std::from_chars(t.data(), t.data() + t.size(), x);
+      if (res.ec != std::errc() || res.ptr != t.data() + t.size()) {
+        c.error = true;  // out-of-int64 (python bigint) or malformed
+        return nullptr;
+      }
+      CVal *n = c.cp->make(CVal::LONGV);
+      n->l = x;
+      return n;
+    }
+  }
+  c.error = true;
+  return nullptr;
+}
+
+// top-level object -> record: per-field walk with a fresh depth budget
+// (entities/admission.py unstructured_to_record); empty top records are
+// kept (only NESTED empties drop)
+CVal *adm_top_record(AdmCtx &c, const JVal *obj) {
+  CVal *r = c.cp->make(CVal::RECV);
+  std::vector<const JVal *> kids;
+  dedupe_children(obj, kids);
+  for (const JVal *kv : kids) {
+    if (kv->kind == JVal::NUL) continue;
+    CVal *val = adm_walk(c, 32, kv->key, kv);  // MAX_WALK_DEPTH
+    if (c.error) return nullptr;
+    if (!val) continue;
+    r->fields.emplace_back(kv->key, val);
+  }
+  return r;
+}
+
+void canon_cval(const CVal *v, std::string &out) {
+  switch (v->kind) {
+    case CVal::STRV:
+      out.push_back('s');
+      out.append(v->str.data(), v->str.size());
+      return;
+    case CVal::LONGV: {
+      char buf[24];
+      int n = snprintf(buf, sizeof buf, "l%lld", (long long)v->l);
+      out.append(buf, size_t(n));
+      return;
+    }
+    case CVal::BOOLV:
+      out.push_back(v->b ? 't' : 'f');
+      return;
+    case CVal::IPV:
+      // value_key tag "i": _canon() refuses it, so no vocab/set_has key can
+      // ever hold one — any distinct prefix is correct (never matches)
+      out.push_back('i');
+      out.append(v->str.data(), v->str.size());
+      return;
+    case CVal::ENTV:
+      out.push_back('e');
+      out.append(v->ent_type.data(), v->ent_type.size());
+      out.push_back('\x1f');
+      out.append(v->str.data(), v->str.size());
+      return;
+    case CVal::SETV: {
+      std::vector<std::string> es;
+      es.reserve(v->elems.size());
+      for (const CVal *e : v->elems) {
+        std::string ec;
+        canon_cval(e, ec);
+        es.push_back(std::move(ec));
+      }
+      canon_set_into(out, es);
+      return;
+    }
+    case CVal::RECV: {
+      std::vector<const std::pair<sv, CVal *> *> fs;
+      fs.reserve(v->fields.size());
+      for (const auto &f : v->fields) fs.push_back(&f);
+      std::sort(fs.begin(), fs.end(),
+                [](const auto *a, const auto *b) { return a->first < b->first; });
+      out += "R{";
+      for (size_t i = 0; i < fs.size(); ++i) {
+        if (i) out.push_back('\x1f');
+        out.append(fs[i]->first.data(), fs[i]->first.size());
+        out.push_back('\x1d');
+        canon_cval(fs[i]->second, out);
+      }
+      out.push_back('}');
+      return;
+    }
+  }
+}
+
+const CVal *cval_nav(const CVal *root, const std::vector<std::string> &comps) {
+  // compiler/encode.py _slot_value: records only; anything else is MISSING
+  const CVal *cur = root;
+  for (const auto &comp : comps) {
+    if (!cur || cur->kind != CVal::RECV) return nullptr;
+    const CVal *nxt = nullptr;
+    for (const auto &f : cur->fields)
+      if (f.first == comp) nxt = f.second;
+    cur = nxt;
+    if (!cur) return nullptr;
+  }
+  return cur;
+}
+
+constexpr sv kAdmAction = "k8s::admission::Action";
+constexpr sv kSkipNs1 = "kube-system";
+constexpr sv kSkipNs2 = "cedar-k8s-authz-system";
+
+struct AdmFeatures {
+  sv uid, op, action_id;
+  sv p_type, p_id;
+  std::vector<sv> groups;
+  CVal *p_rec = nullptr;
+  std::string r_type;  // <group or core>::<kind version>::<Kind>
+  std::string r_path;  // kubernetes URL path (the resource entity id)
+  CVal *res = nullptr;
+  CVal *ctx = nullptr;  // {oldObject: <old attrs>} on UPDATE-style requests
+
+  void reset() {
+    groups.clear();
+    p_rec = res = ctx = nullptr;
+    r_type.clear();
+    r_path.clear();
+    uid = op = action_id = p_type = p_id = sv();
+  }
+};
+
+// present-but-not-a-string: python's dataclass kwargs accept the value and
+// a later string operation raises (caught into the allow-on-error
+// response) — the native path can't reproduce those, so it flags the row
+bool str_if_present(const JVal *o, sv k) {
+  const JVal *v = o ? o->get(k) : nullptr;
+  return !v || v->kind == JVal::STR;
+}
+
+// request.kind / request.resource must be exactly the GroupVersion{Kind,
+// Resource} shape: python constructs the dataclass with **dict, so an
+// extra key or non-string value raises TypeError server-side
+bool gv_shape_ok(const JVal *o, sv third_key) {
+  if (!o || o->kind == JVal::NUL) return true;  // `or {}` -> defaults
+  if (o->kind != JVal::OBJ) return false;
+  for (const JVal *kv = o->child; kv; kv = kv->next) {
+    if (kv->key != "group" && kv->key != "version" && kv->key != third_key)
+      return false;
+    if (kv->kind != JVal::STR) return false;
+  }
+  return true;
+}
+
+uint8_t build_adm(const JVal *root, AdmFeatures &f, AdmCtx &c, Arena &arena) {
+  const JVal *req = root->get("request");
+  if (!req || req->kind != JVal::OBJ) return F_ADM_ERROR;
+  if (!str_if_present(req, "uid") || !str_if_present(req, "namespace") ||
+      !str_if_present(req, "name") || !str_if_present(req, "subResource"))
+    return F_ADM_ERROR;
+  f.uid = str_field(req, "uid");
+  if (f.uid.size() > 255) return F_ADM_ERROR;  // uid passback buffer bound
+  sv ns = str_field(req, "namespace");
+  if (ns == kSkipNs1 || ns == kSkipNs2) return F_ADM_NS_SKIP;
+  f.op = str_field(req, "operation");
+  if (f.op == "CREATE") f.action_id = "create";
+  else if (f.op == "UPDATE") f.action_id = "update";
+  else if (f.op == "DELETE") f.action_id = "delete";
+  else if (f.op == "CONNECT") f.action_id = "connect";
+  else return F_ADM_ERROR;  // python raises "unsupported operation"
+
+  // ---- principal (entities/user.py user_to_cedar_entity; admission keeps
+  // extra keys as-is — no convertExtra lower-casing on this path)
+  const JVal *ui = req->get("userInfo");
+  if (ui && ui->kind == JVal::NUL) ui = nullptr;  // `or {}`
+  if (ui && ui->kind != JVal::OBJ) return F_ADM_ERROR;
+  if (!str_if_present(ui, "username") || !str_if_present(ui, "uid"))
+    return F_ADM_ERROR;
+  sv uname = str_field(ui, "username");
+  sv uuid = str_field(ui, "uid");
+  f.p_type = kUser;
+  sv p_name = uname;
+  sv p_ns;
+  if (starts_with(uname, "system:node:") && count_colons(uname) == 2) {
+    f.p_type = kNode;
+    p_name = uname.substr(strlen("system:node:"));
+  }
+  if (starts_with(uname, "system:serviceaccount:") && count_colons(uname) == 3) {
+    f.p_type = kSA;
+    size_t a = strlen("system:serviceaccount:");
+    size_t b = uname.find(':', a);
+    p_ns = uname.substr(a, b - a);
+    p_name = uname.substr(b + 1);
+  }
+  f.p_id = uuid.empty() ? uname : uuid;
+  const JVal *groups = ui ? ui->get("groups") : nullptr;
+  if (groups && groups->kind != JVal::NUL) {
+    if (groups->kind != JVal::ARR) return F_ADM_ERROR;
+    for (const JVal *g = groups->child; g; g = g->next) {
+      if (g->kind != JVal::STR) return F_ADM_ERROR;
+      f.groups.push_back(g->str);
+    }
+  }
+  f.p_rec = c.cp->make(CVal::RECV);
+  {
+    CVal *nm = c.cp->make(CVal::STRV);
+    nm->str = p_name;
+    if (!p_ns.empty()) {
+      CVal *nsv = c.cp->make(CVal::STRV);
+      nsv->str = p_ns;
+      f.p_rec->fields.emplace_back("namespace", nsv);
+    }
+    f.p_rec->fields.emplace_back("name", nm);
+    const JVal *extra = ui ? ui->get("extra") : nullptr;
+    if (extra && extra->kind != JVal::NUL) {
+      if (extra->kind != JVal::OBJ) return F_ADM_ERROR;
+      if (extra->child) {
+        CVal *set = c.cp->make(CVal::SETV);
+        for (const JVal *kv = extra->child; kv; kv = kv->next) {
+          if (kv->kind != JVal::ARR) return F_ADM_ERROR;
+          CVal *vals = c.cp->make(CVal::SETV);
+          for (const JVal *e = kv->child; e; e = e->next) {
+            if (e->kind != JVal::STR) return F_ADM_ERROR;
+            CVal *ev = c.cp->make(CVal::STRV);
+            ev->str = e->str;
+            vals->elems.push_back(ev);
+          }
+          CVal *r = c.cp->make(CVal::RECV);
+          CVal *k = c.cp->make(CVal::STRV);
+          k->str = kv->key;
+          r->fields.emplace_back("key", k);
+          r->fields.emplace_back("values", vals);
+          set->elems.push_back(r);
+        }
+        f.p_rec->fields.emplace_back("extra", set);
+      }
+    }
+  }
+
+  // ---- resource entity type + id (entities/admission.py:207-224)
+  const JVal *kind = req->get("kind");
+  if (!gv_shape_ok(kind, "kind")) return F_ADM_ERROR;
+  if (kind && kind->kind != JVal::OBJ) kind = nullptr;
+  const JVal *gvr = req->get("resource");
+  if (!gv_shape_ok(gvr, "resource")) return F_ADM_ERROR;
+  if (gvr && gvr->kind != JVal::OBJ) gvr = nullptr;
+  sv kver = str_field(kind, "version"), kkind = str_field(kind, "kind");
+  sv rgroup = str_field(gvr, "group"), rver = str_field(gvr, "version");
+  sv rres = str_field(gvr, "resource");
+  sv name = str_field(req, "name"), subres = str_field(req, "subResource");
+  sv egroup = rgroup.empty() ? sv("core") : rgroup;
+  f.r_type.assign(egroup.data(), egroup.size());
+  f.r_type += "::";
+  f.r_type.append(kver.data(), kver.size());
+  f.r_type += "::";
+  f.r_type.append(kkind.data(), kkind.size());
+  c.group = egroup;
+  c.kversion = kver;
+  c.kkind = kkind;
+  std::string &p = f.r_path;
+  if (rgroup.empty()) {
+    p.assign("/api/");
+  } else {
+    p.assign("/apis/");
+    p.append(rgroup.data(), rgroup.size());
+    p.push_back('/');
+  }
+  p.append(rver.data(), rver.size());
+  if (!ns.empty()) {
+    p.append("/namespaces/");
+    p.append(ns.data(), ns.size());
+  }
+  p.push_back('/');
+  p.append(rres.data(), rres.size());
+  if (!name.empty()) {
+    p.push_back('/');
+    p.append(name.data(), name.size());
+  }
+  if (!subres.empty()) {
+    p.push_back('/');
+    p.append(subres.data(), subres.size());
+  }
+
+  // ---- object walk (oldObject for DELETE, handler.go:95-99)
+  bool obj_bad = false;
+  auto load_obj = [&](const char *key) -> const JVal * {
+    const JVal *o = req->get(key);
+    if (!o || o->kind == JVal::NUL) return nullptr;
+    if (o->kind == JVal::STR) {  // JSON-string payload: python json.loads
+      JsonParser nested(o->str.data(), o->str.size(), arena);
+      const JVal *parsed = nested.parse();
+      if (!parsed) obj_bad = true;  // python raises -> allow-on-error
+      return parsed;
+    }
+    return o;
+  };
+  const JVal *obj = load_obj("object");
+  const JVal *oldo = load_obj("oldObject");
+  if (obj_bad) return F_ADM_ERROR;
+  const JVal *main_obj = (f.op == "DELETE") ? oldo : obj;
+  if (!main_obj || main_obj->kind != JVal::OBJ)
+    return F_ADM_ERROR;  // "unstructured data is nil" / non-object payload
+  f.res = adm_top_record(c, main_obj);
+  if (c.error) return F_ADM_ERROR;
+  if (oldo && f.op != "DELETE") {
+    if (oldo->kind != JVal::OBJ) return F_ADM_ERROR;
+    CVal *old_rec = adm_top_record(c, oldo);
+    if (c.error) return F_ADM_ERROR;
+    // old entity re-IDed by the review uid; linked from the new object and
+    // exposed as context.oldObject (handler.go:107-139)
+    CVal *ent = c.cp->make(CVal::ENTV);
+    ent->ent_type = sv(f.r_type);
+    ent->str = f.uid;
+    dedupe_insert(f.res->fields, "oldObject", ent);
+    f.ctx = c.cp->make(CVal::RECV);
+    if (old_rec) f.ctx->fields.emplace_back("oldObject", old_rec);
+  }
+  return F_OK;
+}
+
+void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
+                    ExtrasOut &extras, std::string &scratch) {
+  for (int32_t i = 0; i < t.n_slots; ++i) codes[i] = 0;
+
+  const sv types[3] = {f.p_type, kAdmAction, sv(f.r_type)};
+  const sv ids[3] = {f.p_id, f.action_id, sv(f.r_path)};
+  const char vtag[3] = {'0', '1', '2'};
+  for (int v = 0; v < 3; ++v) {
+    if (t.type_slot[v] >= 0) {
+      scratch.clear();
+      scratch.push_back(vtag[v]);
+      scratch.push_back('\x1f');
+      scratch.append(types[v].data(), types[v].size());
+      const int32_t *row = sv_find(t.type_map, scratch);
+      codes[t.type_slot[v]] = row ? *row : 0;
+    }
+    if (t.uid_slot[v] >= 0) {
+      scratch.clear();
+      scratch.push_back(vtag[v]);
+      scratch.push_back('\x1f');
+      scratch.append(types[v].data(), types[v].size());
+      scratch.push_back('\x1f');
+      scratch.append(ids[v].data(), ids[v].size());
+      const int32_t *row = sv_find(t.uid_map, scratch);
+      codes[t.uid_slot[v]] = row ? *row : 0;
+    }
+  }
+
+  // principal ancestors: the group parents
+  if (!t.anc_slots[0].empty() && !f.groups.empty()) {
+    size_t filled = 0;
+    const auto &slots = t.anc_slots[0];
+    for (sv g : f.groups) {
+      scratch.assign("0\x1f");
+      scratch.append(kGroup.data(), kGroup.size());
+      scratch.push_back('\x1f');
+      scratch.append(g.data(), g.size());
+      const auto *entry = sv_find(t.anc_map, scratch);
+      if (!entry || entry->first == 0) continue;
+      if (filled < slots.size()) {
+        codes[slots[filled++]] = entry->first;
+      } else {
+        for (int32_t lid : entry->second) extras.push(lid);
+      }
+    }
+  }
+  // action ancestor: create/update/delete/connect all parent to "all"
+  // (entities/admission.py admission_action_entities)
+  if (!t.anc_slots[1].empty()) {
+    scratch.assign("1\x1f");
+    scratch.append(kAdmAction.data(), kAdmAction.size());
+    scratch.append("\x1f" "all");
+    const auto *entry = sv_find(t.anc_map, scratch);
+    if (entry && entry->first != 0) codes[t.anc_slots[1][0]] = entry->first;
+  }
+
+  for (const auto &s : t.slots) {
+    const CVal *root = s.var == 0   ? f.p_rec
+                       : s.var == 2 ? f.res
+                       : s.var == 3 ? f.ctx
+                                    : nullptr;
+    if (!root) continue;
+    const CVal *v = cval_nav(root, s.comps);
+    if (!v) continue;
+    scratch.clear();
+    canon_cval(v, scratch);
+    const int32_t *row = sv_find(s.vocab, scratch);
+    if (row) {
+      codes[s.sidx] = *row;
+    } else {
+      codes[s.sidx] = s.present_row;
+      if (v->kind == CVal::STRV) {
+        for (const auto &lt : s.likes)
+          if (like_match(lt.comps, v->str)) extras.push(lt.lit);
+      } else if (v->kind == CVal::LONGV) {
+        for (const auto &ct : s.cmps) {
+          int64_t x = v->l;
+          bool hit = ct.op == 0   ? x < ct.c
+                     : ct.op == 1 ? x <= ct.c
+                     : ct.op == 2 ? x > ct.c
+                                  : x >= ct.c;
+          if (hit) extras.push(ct.lit);
+        }
+      }
+    }
+    if (v->kind == CVal::SETV && !s.set_has.empty()) {
+      for (const CVal *e : v->elems) {
+        std::string ec;
+        canon_cval(e, ec);
+        const auto *lits = sv_find(s.set_has, ec);
+        if (lits)
+          for (int32_t lid : *lits) extras.push(lid);
+      }
+    }
+  }
+}
+
 }  // namespace
 
 // ------------------------------------------------------------------ C API
@@ -1063,6 +1823,72 @@ void ce_encode_sar_batch(void *handle, uint64_t n, const uint8_t *buf,
 
 int32_t ce_n_slots(void *handle) {
   return static_cast<Table *>(handle)->n_slots;
+}
+
+// AdmissionReview variant of ce_encode_sar_batch. Additional outputs: the
+// review uid of each request is copied into uids[i * 256 .. ] (uid_lens[i]
+// bytes) for F_OK / F_ADM_NS_SKIP rows so the caller can build responses
+// without re-parsing; fallback rows (parse error / F_ADM_ERROR / overflow)
+// re-run through the exact Python path instead.
+void ce_encode_adm_batch(void *handle, uint64_t n, const uint8_t *buf,
+                         const uint64_t *offsets, const uint64_t *lens,
+                         int32_t *codes, int32_t *extras, int32_t extras_cap,
+                         int32_t *extras_count, uint8_t *flags, char *uids,
+                         int32_t *uid_lens, int32_t n_threads) {
+  const Table &t = *static_cast<Table *>(handle);
+  auto work = [&](uint64_t lo, uint64_t hi) {
+    Arena arena;
+    CPool cpool;
+    AdmFeatures f;
+    std::string scratch;
+    for (uint64_t i = lo; i < hi; ++i) {
+      int32_t *c = codes + i * uint64_t(t.n_slots);
+      ExtrasOut eo{extras + i * uint64_t(extras_cap), extras_cap};
+      extras_count[i] = 0;
+      uid_lens[i] = 0;
+      arena.reset();
+      cpool.reset();
+      JsonParser parser((const char *)buf + offsets[i], size_t(lens[i]), arena);
+      JVal *root = parser.parse();
+      if (!root || root->kind != JVal::OBJ) {
+        for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
+        flags[i] = F_PARSE_ERROR;
+        continue;
+      }
+      f.reset();
+      AdmCtx ctx;
+      ctx.cp = &cpool;
+      uint8_t gate = build_adm(root, f, ctx, arena);
+      if (gate != F_OK) {
+        for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
+        flags[i] = gate;
+        if (gate == F_ADM_NS_SKIP) {
+          memcpy(uids + i * 256, f.uid.data(), f.uid.size());
+          uid_lens[i] = int32_t(f.uid.size());
+        }
+        continue;
+      }
+      encode_adm_one(t, f, c, eo, scratch);
+      extras_count[i] = eo.n;
+      flags[i] = eo.overflow ? F_EXTRAS_OVERFLOW : F_OK;
+      memcpy(uids + i * 256, f.uid.data(), f.uid.size());
+      uid_lens[i] = int32_t(f.uid.size());
+    }
+  };
+  if (n_threads <= 1 || n < 64) {
+    work(0, n);
+    return;
+  }
+  uint64_t nt = uint64_t(n_threads);
+  if (nt > n) nt = n;
+  std::vector<std::thread> threads;
+  uint64_t chunk = (n + nt - 1) / nt;
+  for (uint64_t k = 0; k < nt; ++k) {
+    uint64_t lo = k * chunk, hi = lo + chunk > n ? n : lo + chunk;
+    if (lo >= hi) break;
+    threads.emplace_back(work, lo, hi);
+  }
+  for (auto &th : threads) th.join();
 }
 
 }  // extern "C"

@@ -194,6 +194,7 @@ class WebhookServer:
         certfile: Optional[str] = None,
         keyfile: Optional[str] = None,
         fastpath=None,
+        admission_fastpath=None,
         batch_window_s: float = 0.0002,
         max_batch: int = 8192,
     ):
@@ -220,6 +221,19 @@ class WebhookServer:
 
             self._admission_batcher = MicroBatcher(
                 admission_handler.handle_batch,
+                max_batch=max_batch,
+                window_s=batch_window_s,
+            )
+        # native admission fast path: raw AdmissionReview bodies through the
+        # C++ object walk + device matcher (engine/fastpath.py
+        # AdmissionFastPath); rows it can't prove fall back per request
+        self.admission_fastpath = admission_fastpath
+        self._adm_raw_batcher = None
+        if admission_fastpath is not None:
+            from ..engine.batcher import MicroBatcher
+
+            self._adm_raw_batcher = MicroBatcher(
+                admission_fastpath.handle_raw,
                 max_batch=max_batch,
                 window_s=batch_window_s,
             )
@@ -294,6 +308,19 @@ class WebhookServer:
             )
 
     def handle_admit(self, body: bytes) -> dict:
+        try:
+            use_fast = (
+                self._adm_raw_batcher is not None
+                and self.admission_fastpath.available
+            )
+        except Exception:  # noqa: BLE001 — degrade to the python path
+            log.exception("admission fastpath availability check failed")
+            use_fast = False
+        if use_fast:
+            try:
+                return self._adm_raw_batcher.submit(body).to_admission_review()
+            except Exception:  # noqa: BLE001 — python path below still answers
+                log.exception("admission fastpath failed; python path")
         try:
             review = json.loads(body)
         except (ValueError, TypeError, RecursionError) as e:

@@ -1,0 +1,407 @@
+"""Native admission encoder differential tests.
+
+The C++ AdmissionReview walk (native/encoder.cpp build_adm/adm_walk) and
+AdmissionFastPath must produce response-identical results to the Python
+handler path (entities/admission.py walk + TPU engine) on the same bodies —
+including deny messages (complete matched-policy lists), namespace skips,
+allow-on-error conversion failures, and DELETE/UPDATE oldObject semantics
+(reference internal/server/entities/admission.go:160-369,
+internal/server/admission/handler.go:45-166).
+"""
+
+import json
+import random
+
+import pytest
+
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+from cedar_tpu.engine.fastpath import AdmissionFastPath
+from cedar_tpu.entities.admission import AdmissionRequest
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.native import native_available
+from cedar_tpu.server.admission import (
+    ALLOW_ALL_ADMISSION_POLICY_SOURCE,
+    CedarAdmissionHandler,
+    allow_all_admission_policy_store,
+)
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no native toolchain"
+)
+
+ADM_POLICIES = """
+forbid (
+    principal,
+    action == k8s::admission::Action::"create",
+    resource is core::v1::ConfigMap
+) when {
+    resource.metadata has labels &&
+    resource.metadata.labels.contains({key: "env", value: "prod"})
+};
+forbid (
+    principal in k8s::Group::"tenants",
+    action in [k8s::admission::Action::"update", k8s::admission::Action::"delete"],
+    resource is core::v1::Secret
+) when {
+    resource.metadata has namespace &&
+    resource.metadata.namespace == "protected"
+};
+forbid (
+    principal,
+    action == k8s::admission::Action::"update",
+    resource is apps::v1::Deployment
+) when {
+    resource has spec && resource.spec has replicas &&
+    resource.spec.replicas > 50
+};
+forbid (
+    principal,
+    action == k8s::admission::Action::"update",
+    resource is core::v1::ConfigMap
+) when {
+    context has oldObject && context.oldObject has metadata &&
+    context.oldObject.metadata has namespace &&
+    context.oldObject.metadata.namespace == "locked"
+};
+forbid (
+    principal is k8s::ServiceAccount,
+    action in k8s::admission::Action::"all",
+    resource is core::v1::Pod
+) when {
+    resource.spec has hostNetwork && resource.spec.hostNetwork == true
+};
+"""
+
+
+def _build():
+    engine = TPUPolicyEngine()
+    stats = engine.load(
+        [
+            PolicySet.from_source(ADM_POLICIES, "adm"),
+            PolicySet.from_source(ALLOW_ALL_ADMISSION_POLICY_SOURCE, "aa"),
+        ],
+        warm="off",
+    )
+    assert stats["fallback_policies"] == 0, "test set must be device-pure"
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores(
+            [
+                MemoryStore.from_source("adm", ADM_POLICIES),
+                allow_all_admission_policy_store(),
+            ]
+        ),
+        evaluate=engine.evaluate,
+        evaluate_batch=engine.evaluate_batch,
+    )
+    fast = AdmissionFastPath(engine, handler)
+    return engine, handler, fast
+
+
+def review(
+    op="CREATE",
+    gvk=("", "v1", "ConfigMap"),
+    resource=None,
+    ns="default",
+    obj=None,
+    old=None,
+    user="bob",
+    groups=("tenants",),
+    uid="r-1",
+    extra=None,
+):
+    group, version, kind = gvk
+    req = {
+        "uid": uid,
+        "operation": op,
+        "userInfo": {"username": user, "uid": "u-" + user, "groups": list(groups)},
+        "kind": {"group": group, "version": version, "kind": kind},
+        "resource": {
+            "group": group,
+            "version": version,
+            "resource": resource or (kind.lower() + "s"),
+        },
+        "namespace": ns,
+        "name": (obj or {}).get("metadata", {}).get("name", "x"),
+    }
+    if extra is not None:
+        req["userInfo"]["extra"] = extra
+    if obj is not None:
+        req["object"] = obj
+    if old is not None:
+        req["oldObject"] = old
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview", "request": req}
+
+
+def obj_cm(name="cm", ns="default", labels=None, data=None):
+    o = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": ns},
+    }
+    if labels is not None:
+        o["metadata"]["labels"] = labels
+    if data is not None:
+        o["data"] = data
+    return o
+
+
+def _oracle(handler, body: bytes) -> dict:
+    """The exact python path, shaped like WebhookServer.handle_admit."""
+    from cedar_tpu.server.admission import AdmissionResponse
+
+    review_doc = None
+    try:
+        review_doc = json.loads(body)
+        req = AdmissionRequest.from_admission_review(review_doc)
+        return handler.handle(req).to_admission_review()
+    except (ValueError, TypeError, RecursionError) as e:
+        if review_doc is None:
+            return AdmissionResponse(
+                uid="", allowed=False, code=400,
+                error=f"failed parsing body: {e}",
+            ).to_admission_review()
+        uid = (review_doc.get("request") or {}).get("uid", "") or ""
+        return AdmissionResponse(
+            uid=uid, allowed=True, code=200,
+            error=f"evaluation error (allowed on error): {e}",
+        ).to_admission_review()
+
+
+def assert_parity(fast, handler, bodies):
+    got = [r.to_admission_review() for r in fast.handle_raw(bodies)]
+    want = [_oracle(handler, b) for b in bodies]
+    for g, w, b in zip(got, want, bodies):
+        assert g == w, f"mismatch for {b[:200]!r}:\n native={g}\n python={w}"
+
+
+def test_admission_fastpath_directed_cases():
+    engine, handler, fast = _build()
+    assert fast.available
+    bodies = [
+        # deny: prod label on create
+        json.dumps(review(obj=obj_cm(labels={"env": "prod"}))).encode(),
+        # allow: different label
+        json.dumps(review(obj=obj_cm(labels={"env": "dev"}))).encode(),
+        # allow: no labels at all (empty metadata sub-record drops)
+        json.dumps(review(obj=obj_cm())).encode(),
+        # deny: protected secret update by tenant group member
+        json.dumps(
+            review(
+                op="UPDATE",
+                gvk=("", "v1", "Secret"),
+                obj={
+                    "apiVersion": "v1",
+                    "kind": "Secret",
+                    "metadata": {"name": "s", "namespace": "protected"},
+                    "data": {"k": "dmFsdWU="},
+                },
+                old={
+                    "apiVersion": "v1",
+                    "kind": "Secret",
+                    "metadata": {"name": "s", "namespace": "protected"},
+                },
+                ns="protected",
+            )
+        ).encode(),
+        # allow: same update by non-tenant
+        json.dumps(
+            review(
+                op="UPDATE",
+                gvk=("", "v1", "Secret"),
+                obj={
+                    "apiVersion": "v1",
+                    "kind": "Secret",
+                    "metadata": {"name": "s", "namespace": "protected"},
+                },
+                old={"apiVersion": "v1", "kind": "Secret"},
+                ns="protected",
+                groups=("admins",),
+            )
+        ).encode(),
+        # deny: replicas cmp over a long
+        json.dumps(
+            review(
+                op="UPDATE",
+                gvk=("apps", "v1", "Deployment"),
+                obj={
+                    "apiVersion": "apps/v1",
+                    "kind": "Deployment",
+                    "metadata": {"name": "d"},
+                    "spec": {"replicas": 51},
+                },
+                old={"apiVersion": "apps/v1", "kind": "Deployment"},
+            )
+        ).encode(),
+        # allow: replicas at the boundary
+        json.dumps(
+            review(
+                op="UPDATE",
+                gvk=("apps", "v1", "Deployment"),
+                obj={
+                    "apiVersion": "apps/v1",
+                    "kind": "Deployment",
+                    "spec": {"replicas": 50},
+                },
+                old={"apiVersion": "apps/v1", "kind": "Deployment"},
+            )
+        ).encode(),
+        # deny: context.oldObject namespace (UPDATE links the old object)
+        json.dumps(
+            review(
+                op="UPDATE",
+                obj=obj_cm(ns="default"),
+                old=obj_cm(ns="locked"),
+            )
+        ).encode(),
+        # DELETE evaluates the oldObject as the resource
+        json.dumps(
+            review(
+                op="DELETE",
+                gvk=("", "v1", "Secret"),
+                obj=None,
+                old={
+                    "apiVersion": "v1",
+                    "kind": "Secret",
+                    "metadata": {"name": "s", "namespace": "protected"},
+                },
+                ns="protected",
+            )
+        ).encode(),
+        # hostNetwork pod by a service account (bool leaf + SA principal)
+        json.dumps(
+            review(
+                gvk=("", "v1", "Pod"),
+                obj={
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"name": "p"},
+                    "spec": {"hostNetwork": True, "containers": []},
+                },
+                user="system:serviceaccount:default:deployer",
+            )
+        ).encode(),
+        # namespace skip
+        json.dumps(
+            review(ns="kube-system", obj=obj_cm(labels={"env": "prod"}))
+        ).encode(),
+        # unknown operation -> python error path (allow on error)
+        json.dumps(review(op="EVICT", obj=obj_cm())).encode(),
+        # float leaf -> conversion error -> allow on error
+        json.dumps(
+            review(obj={"apiVersion": "v1", "kind": "ConfigMap",
+                        "metadata": {"name": "f"}, "weird": 1.5})
+        ).encode(),
+        # missing object -> conversion error
+        json.dumps(review(obj=None)).encode(),
+        # parse error
+        b"{not json",
+    ]
+    assert_parity(fast, handler, bodies)
+
+
+def test_admission_fastpath_randomized():
+    engine, handler, fast = _build()
+    rng = random.Random(42)
+    kinds = [("", "v1", "ConfigMap"), ("", "v1", "Secret"),
+             ("apps", "v1", "Deployment"), ("", "v1", "Pod")]
+    users = ["bob", "alice", "system:serviceaccount:ns1:sa1",
+             "system:node:node-1"]
+    bodies = []
+    for i in range(300):
+        gvk = rng.choice(kinds)
+        op = rng.choice(["CREATE", "UPDATE", "DELETE", "CONNECT"])
+        labels = rng.choice(
+            [None, {}, {"env": rng.choice(["prod", "dev"])},
+             {"env": "prod", "team": "a"}, {"owner": "bob"}]
+        )
+        o = {
+            "apiVersion": "v1",
+            "kind": gvk[2],
+            "metadata": {
+                "name": f"o{i}",
+                "namespace": rng.choice(["default", "protected", "locked"]),
+            },
+        }
+        if labels is not None:
+            o["metadata"]["labels"] = labels
+        if gvk[2] == "Deployment":
+            o["spec"] = {"replicas": rng.choice([0, 1, 50, 51, 500])}
+        if gvk[2] == "Pod":
+            o["spec"] = {
+                "hostNetwork": rng.choice([True, False]),
+                "nodeSelector": {"disk": "ssd"},
+            }
+            if rng.random() < 0.5:
+                o["status"] = {"podIP": rng.choice(
+                    ["10.0.0.1", "not-an-ip", "fe80::1", "10.0.0.1/8"]
+                )}
+        if gvk[2] == "ConfigMap" and rng.random() < 0.5:
+            o["data"] = {f"k{j}": f"v{j}" for j in range(rng.randint(0, 4))}
+        if rng.random() < 0.2:
+            o["metadata"]["annotations"] = {"note": "x", "n": "y"}
+        old = None
+        if op == "DELETE" or (op == "UPDATE" or rng.random() < 0.2):
+            old = {
+                "apiVersion": "v1",
+                "kind": gvk[2],
+                "metadata": {
+                    "name": f"o{i}",
+                    "namespace": rng.choice(["default", "locked"]),
+                },
+            }
+        extra = None
+        if rng.random() < 0.2:
+            extra = {"scopes": ["a", "b"], "Upper-Key": ["c"]}
+        bodies.append(
+            json.dumps(
+                review(
+                    op=op,
+                    gvk=gvk,
+                    ns=rng.choice(["default", "protected", "kube-system"]),
+                    obj=None if op == "DELETE" else o,
+                    old=old,
+                    user=rng.choice(users),
+                    groups=rng.choice([(), ("tenants",), ("tenants", "dev")]),
+                    uid=f"u-{i}",
+                    extra=extra,
+                )
+            ).encode()
+        )
+    assert_parity(fast, handler, bodies)
+
+
+def test_admission_fastpath_rules_out_fallback_sets():
+    """Sets with interpreter-fallback policies must not claim the native
+    path (the demo's principal-referencing contains is one)."""
+    src = """
+forbid (principal, action == k8s::admission::Action::"create",
+        resource is core::v1::ConfigMap)
+  unless {
+    resource.metadata has labels &&
+    resource.metadata.labels.contains({key: "owner", value: principal.name})
+  };
+"""
+    engine = TPUPolicyEngine()
+    engine.load(
+        [
+            PolicySet.from_source(src, "adm"),
+            PolicySet.from_source(ALLOW_ALL_ADMISSION_POLICY_SOURCE, "aa"),
+        ],
+        warm="off",
+    )
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores(
+            [MemoryStore.from_source("adm", src), allow_all_admission_policy_store()]
+        ),
+        evaluate=engine.evaluate,
+        evaluate_batch=engine.evaluate_batch,
+    )
+    fast = AdmissionFastPath(engine, handler)
+    assert not fast.available
+    # ... and the python path still answers correctly through handle_raw
+    body = json.dumps(
+        review(obj=obj_cm(labels={"owner": "bob"}))
+    ).encode()
+    [resp] = fast.handle_raw([body])
+    assert resp.allowed
