@@ -146,7 +146,6 @@ class TPUPolicyEngine:
         self.use_pallas = use_pallas
         self._compiled: Optional[_CompiledSet] = None
         self._lock = threading.Lock()
-        self._warm_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -157,8 +156,8 @@ class TPUPolicyEngine:
         warm: "async" (default) kicks kernel warm-up onto a background
         daemon thread so readiness is NOT delayed by XLA compiles (the
         reference populates stores asynchronously too, /root/reference
-        internal/server/store/crd.go:207); "sync" joins it (tests);
-        "off" skips it. Diagnostics bitsets ride the main match call
+        internal/server/store/crd.go:207); "sync" runs warm-up inline
+        before returning (tests); "off" skips it. Diagnostics bitsets ride the main match call
         (ops/match.py want_bits), so there is no separate diagnostics
         kernel left to compile on a live request — warm-up only
         front-loads the small-batch shapes a fresh server sees first."""

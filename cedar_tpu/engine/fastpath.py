@@ -336,18 +336,18 @@ class AdmissionFastPath:
             log.exception("admission fastpath fallback failed")
             return self._allow_on_error(review, e)
 
-    @staticmethod
-    def _allow_on_error(review, e):
+    def _allow_on_error(self, review, e):
         from ..server.admission import AdmissionResponse
 
         uid = ""
         if isinstance(review, dict):
             uid = (review.get("request") or {}).get("uid", "") or ""
+        allowed = bool(getattr(self.handler, "allow_on_error", True))
         return AdmissionResponse(
             uid=uid,
-            allowed=True,
+            allowed=allowed,
             code=200,
-            error=f"evaluation error (allowed on error): {e}",
+            error=f"evaluation error ({'allowed' if allowed else 'denied'} on error): {e}",
         )
 
     def _deny_message(self, snap: _Snapshot, pols) -> str:

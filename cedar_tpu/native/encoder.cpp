@@ -661,6 +661,8 @@ bool is_read_only(sv verb) {
   return verb == "get" || verb == "list" || verb == "watch";
 }
 
+void dedupe_children(const JVal *obj, std::vector<const JVal *> &out);
+
 // Build all request features from the parsed SAR. Returns a gate flag or
 // F_OK. Mirrors get_authorizer_attributes + record_to_cedar_resource.
 uint8_t build_features(const JVal *root, Features &f) {
@@ -730,12 +732,29 @@ uint8_t build_features(const JVal *root, Features &f) {
   const JVal *extra = spec ? spec->get("extra") : nullptr;
   if (extra && extra->kind == JVal::OBJ && extra->child) {
     f.has_extra = true;
-    for (const JVal *kv = extra->child; kv; kv = kv->next) {
+    // json.loads dedupes raw keys (dict: first position, last value), then
+    // convertExtra's {k.lower(): v} comprehension dedupes again on the
+    // lower-cased key with the same dict semantics (server/http.py:74)
+    std::vector<const JVal *> kids;
+    dedupe_children(extra, kids);
+    std::vector<std::pair<std::string, const JVal *>> lkids;
+    for (const JVal *kv : kids) {
       // convertExtra lower-cases keys (server.go:205)
       std::string key = "s";
       key.reserve(kv->key.size() + 1);
       for (char c : kv->key)
         key.push_back(c >= 'A' && c <= 'Z' ? char(c + 32) : c);
+      bool replaced = false;
+      for (auto &e : lkids)
+        if (e.first == key) {
+          e.second = kv;
+          replaced = true;
+          break;
+        }
+      if (!replaced) lkids.emplace_back(std::move(key), kv);
+    }
+    for (auto &e : lkids) {
+      const JVal *kv = e.second;
       std::vector<std::string> vals;
       if (kv->kind == JVal::ARR)
         for (const JVal *v = kv->child; v; v = v->next)
@@ -747,7 +766,7 @@ uint8_t build_features(const JVal *root, Features &f) {
       std::string vset;
       canon_set_into(vset, vals);
       f.extra_elem_canons.push_back(
-          canon_record({{"key", &key}, {"values", &vset}}));
+          canon_record({{"key", &e.first}, {"values", &vset}}));
     }
   }
 
@@ -1555,7 +1574,11 @@ uint8_t build_adm(const JVal *root, AdmFeatures &f, AdmCtx &c, Arena &arena) {
       if (extra->kind != JVal::OBJ) return F_ADM_ERROR;
       if (extra->child) {
         CVal *set = c.cp->make(CVal::SETV);
-        for (const JVal *kv = extra->child; kv; kv = kv->next) {
+        // duplicate extra keys: python's json.loads keeps only the last
+        // value per key (dict), like every other object walk here
+        std::vector<const JVal *> extra_kids;
+        dedupe_children(extra, extra_kids);
+        for (const JVal *kv : extra_kids) {
           if (kv->kind != JVal::ARR) return F_ADM_ERROR;
           CVal *vals = c.cp->make(CVal::SETV);
           for (const JVal *e = kv->child; e; e = e->next) {

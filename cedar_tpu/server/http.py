@@ -341,9 +341,13 @@ class WebhookServer:
             uid = ""
             if isinstance(review, dict):
                 uid = (review.get("request") or {}).get("uid", "") or ""
+            allowed = bool(
+                getattr(self.admission_handler, "allow_on_error", True)
+            )
             return AdmissionResponse(
-                uid=uid, allowed=True, code=200,
-                error=f"evaluation error (allowed on error): {e}",
+                uid=uid, allowed=allowed, code=200,
+                error="evaluation error "
+                f"({'allowed' if allowed else 'denied'} on error): {e}",
             ).to_admission_review()
 
     # -------------------------------------------------------------- serving
