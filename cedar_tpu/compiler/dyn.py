@@ -1,0 +1,103 @@
+"""Dynamic set-contains templates: hard expressions the NATIVE encoder can
+evaluate per request without the Python interpreter.
+
+The restricted class is ``<slot>.contains(<template>)`` where the slot is a
+GetAttr chain over principal/resource/context and the template's leaves are
+compile-time constants or principal string attributes (``principal.name`` /
+``principal.namespace``) — the shape of the reference demo's
+
+    resource.metadata.labels.contains({key: "owner", value: principal.name})
+
+(/root/reference demo/admission-policy.yaml). A policy whose only hard
+literals are in this class keeps the whole native fast path: the C++ encoder
+(native/encoder.cpp dyn tests) resolves the template against the request,
+builds the probe's canonical value key, and tests membership against the
+slot's element canons — byte-identical to interpreting the expression.
+
+The Python encode path (compiler/table.py) always evaluates the full
+expression with the interpreter; this module only decides whether the native
+twin can do the same, and hands it a serializable template.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from ..lang import ast
+from ..lang.values import EvalError, value_key
+from .ir import Slot
+
+# template node: ("const", value_key) | ("pattr", attr-name)
+#              | ("record", tuple of (field-name, node) sorted by name)
+#              | ("set", tuple of nodes — canonicalized per request)
+Tmpl = Tuple
+
+# principal attributes every builder materializes as plain strings
+# (entities/user.py; native/encoder.cpp build_features / build_adm)
+_PRINCIPAL_STR_ATTRS = frozenset({"name", "namespace"})
+
+
+@dataclass(frozen=True)
+class DynContains:
+    slot: Slot  # the (var, path) the set value is read from
+    tmpl: Tmpl  # template for the probe value
+
+
+def _tmpl_of(e: ast.Expr) -> Optional[Tmpl]:
+    from .lower import _NO_CONST, const_of, slot_of
+
+    c = const_of(e)
+    if c is not _NO_CONST:
+        try:
+            return ("const", value_key(c))
+        except EvalError:
+            return None
+    if isinstance(e, ast.GetAttr):
+        s = slot_of(e)
+        if (
+            s is not None
+            and s[0] == "principal"
+            and len(s[1]) == 1
+            and s[1][0] in _PRINCIPAL_STR_ATTRS
+        ):
+            return ("pattr", s[1][0])
+        return None
+    if isinstance(e, ast.RecordLit):
+        fields = {}
+        for k, v in e.pairs:
+            t = _tmpl_of(v)
+            if t is None:
+                return None
+            fields[k] = t  # duplicate keys: last wins, like the evaluator
+        return ("record", tuple(sorted(fields.items())))
+    if isinstance(e, ast.SetLit):
+        elems = []
+        for x in e.elems:
+            t = _tmpl_of(x)
+            if t is None:
+                return None
+            elems.append(t)
+        # element order is irrelevant: the canon sorts + dedupes at
+        # resolution time (native canon_set_into / value_key set_key)
+        return ("set", tuple(elems))
+    return None
+
+
+def dyn_spec(expr: ast.Expr) -> Optional[DynContains]:
+    """DynContains for a natively-evaluable hard expression, else None."""
+    from .lower import slot_of
+
+    if not (
+        isinstance(expr, ast.MethodCall)
+        and expr.method == "contains"
+        and len(expr.args) == 1
+    ):
+        return None
+    s = slot_of(expr.obj)
+    if s is None or not s[1]:
+        return None
+    t = _tmpl_of(expr.args[0])
+    if t is None:
+        return None
+    return DynContains(s, t)

@@ -114,20 +114,24 @@ def encode_request(
                     active.add(lid)
 
     # hard literals: interpreter-evaluated. An EvalError activates the
-    # paired HARD_ERR indicator (the lowering guarantees negated hard
-    # literals cannot error); a non-bool result is a Cedar type error.
+    # paired HARD_ERR indicator; a bool result activates the HARD_OK guard
+    # (negated hard literals require it, lower.harden_clause); a non-bool
+    # result is a Cedar type error.
     if plan.hard_lits:
         env = Env(request, entities)
-        for lid, expr, err_lid in plan.hard_lits:
+        for lid, ok_lid, expr, err_lid in plan.hard_lits:
             try:
                 v = evaluate(expr, env)
-                if v is True:
-                    if lid >= 0:
-                        active.add(lid)
-                elif type(v) is not bool and err_lid >= 0:
-                    active.add(err_lid)
             except EvalError:
                 if err_lid >= 0:
                     active.add(err_lid)
+                continue
+            if type(v) is bool:
+                if ok_lid >= 0:
+                    active.add(ok_lid)
+                if v and lid >= 0:
+                    active.add(lid)
+            elif err_lid >= 0:
+                active.add(err_lid)
 
     return sorted(active)

@@ -46,6 +46,10 @@ ENTITY_IN = "entity_in"  # var uid in (descendant-of) constant uid
 ENTITY_IN_ANY = "entity_in_any"  # var uid in any of constant uids
 HARD = "hard"  # arbitrary expr evaluated host-side by the interpreter
 HARD_ERR = "hard_err"  # host evaluation of the expr raised an EvalError
+HARD_OK = "hard_ok"  # host evaluation produced a bool (no error): the
+# positive guard that makes NEGATED hard literals error-exact — on an
+# evaluation error the guard stays inactive, killing the clause on the same
+# path Cedar skips the policy (see lower.harden_clause)
 TRUE = "true"  # constant true (from literal folding)
 
 

@@ -49,6 +49,7 @@ from .ir import (
     FallbackPolicy,
     HARD,
     HARD_ERR,
+    HARD_OK,
     HAS,
     IN_SET,
     IS,
@@ -367,6 +368,25 @@ def _conj(prefixes: List[Clause], suffixes: List[Clause]) -> List[Clause]:
     return out
 
 
+def _rewrite_elem_total(e: ast.Expr) -> bool:
+    """True when evaluating this containsAny/containsAll element can never
+    raise: constants, principal.name (mandatory on every principal type —
+    ir.AUTHZ_MANDATORY_ATTRS — and materialized by every entity builder),
+    and records/sets thereof. Cedar evaluates the argument set of
+    containsAny/containsAll eagerly, while the contains-chain rewrite
+    short-circuits — equivalent only when no element can error."""
+    if const_of(e) is not _NO_CONST:
+        return True
+    if isinstance(e, ast.GetAttr):
+        s = slot_of(e)
+        return s is not None and s[0] == "principal" and s[1] == ("name",)
+    if isinstance(e, ast.RecordLit):
+        return all(_rewrite_elem_total(v) for _, v in e.pairs)
+    if isinstance(e, ast.SetLit):
+        return all(_rewrite_elem_total(x) for x in e.elems)
+    return False
+
+
 def expand(e: ast.Expr, want: bool) -> List[Clause]:
     """Clause set whose disjunction == (e evaluates to `want`), with each
     clause one short-circuit evaluation path."""
@@ -391,6 +411,26 @@ def expand(e: ast.Expr, want: bool) -> List[Clause]:
         # x is T in y  ==  (x is T) && (x in y)
         conj = ast.And(ast.Is(e.obj, e.entity_type), ast.Binary("in", e.obj, e.in_entity))
         return expand(conj, want)
+    if (
+        isinstance(e, ast.MethodCall)
+        and e.method in ("containsAny", "containsAll")
+        and len(e.args) == 1
+        and isinstance(e.args[0], ast.SetLit)
+        and e.args[0].elems
+        and all(_rewrite_elem_total(x) for x in e.args[0].elems)
+    ):
+        # s.containsAny([a, b]) == s.contains(a) || s.contains(b) (resp.
+        # containsAll / &&) — each contains lowers through the normal
+        # machinery (SET_HAS for constants, dyn templates for
+        # principal-referencing elements, e.g. the reference demo's
+        # /root/reference demo/authorization-policy.yaml:118-121). Gated on
+        # error-free elements so the chain's short-circuit matches Cedar's
+        # eager argument evaluation.
+        op = ast.Or if e.method == "containsAny" else ast.And
+        chain: ast.Expr = ast.MethodCall(e.obj, "contains", (e.args[0].elems[0],))
+        for el in e.args[0].elems[1:]:
+            chain = op(chain, ast.MethodCall(e.obj, "contains", (el,)))
+        return expand(chain, want)
     lit, neg = leaf_literal(e)
     if lit.kind == TRUE:
         # constant-folded leaf: (TRUE xor neg) == want?
@@ -593,13 +633,29 @@ def harden_clause(
             ok, t = _expr_safe(lit.expr, proven, type_ctx, schema)
             if not ok or t != BOOL:
                 if cl.negated:
-                    raise Unlowerable(
-                        "negated unlowerable expression may error at runtime"
-                    )
+                    # a negated hard literal that errors would evaluate true
+                    # on the device while Cedar skips the policy. For the
+                    # native-evaluable dyn class we insert a positive
+                    # HARD_OK guard (active iff host evaluation produced a
+                    # bool) right before it — error kills the clause on the
+                    # same path Cedar kills the policy. Anything else stays
+                    # interpreter-fallback (hybrid gate).
+                    from .dyn import dyn_spec
+
+                    if dyn_spec(lit.expr) is None:
+                        raise Unlowerable(
+                            "negated unlowerable expression may error at runtime"
+                        )
+                # the error clause must NOT include the HARD_OK guard: the
+                # guard is active exactly when no error occurred
                 errors.append(
                     tuple(out)
                     + (ClauseLit(Literal(HARD_ERR, expr=lit.expr), False),)
                 )
+                if cl.negated:
+                    out.append(
+                        ClauseLit(Literal(HARD_OK, expr=lit.expr), False)
+                    )
         if cl.negated and not lit.total and lit.kind != HARD:
             # typed operations need the operand type to be static; a
             # presence guard can't prevent a type error

@@ -31,6 +31,7 @@ from .ir import (
     EQ_ENTITY,
     HARD,
     HARD_ERR,
+    HARD_OK,
     HAS,
     IN_SET,
     IS,
@@ -44,6 +45,14 @@ PERMIT_IDX = 0
 FORBID_IDX = 1
 ERROR_IDX = 2
 GROUPS_PER_TIER = 3
+# Fallback-scope gate rules live in ONE extra group past the tier groups
+# (index n_tiers * GROUPS_PER_TIER): a gate rule is the scope conjunction of
+# one interpreter-fallback policy. A request matching no gate rule provably
+# matches (and errors on) no fallback policy — the device verdict word is
+# authoritative for it, so the fast paths only re-route gate-flagged rows to
+# the exact Python path (the hybrid successor of disabling the native plane
+# whenever any fallback policy exists).
+GATE_RULE_POLICY = 0  # rule_policy for gate rules: any value != INT32_MAX
 
 
 def _bucket(n: int, minimum: int = 128) -> int:
@@ -88,9 +97,13 @@ class EncodePlan:
         default_factory=dict
     )
     is_idx: Dict[str, Dict[str, List[int]]] = field(default_factory=dict)
-    # (lit id, expr, hard-error lit id or -1): the encoder activates the
-    # error id when interpretation of expr raises an EvalError
-    hard_lits: List[Tuple[int, object, int]] = field(default_factory=list)
+    # (lit id, ok lit id, expr, error lit id) — each id -1 when absent. The
+    # encoder evaluates expr per request: a bool result activates ok (and
+    # lit when True); an EvalError or non-bool result activates the error id
+    hard_lits: List[Tuple[int, int, object, int]] = field(default_factory=list)
+    # parallel to hard_lits: compiler.dyn.DynContains when the native
+    # encoder can evaluate the expr itself, else None (native plane off)
+    dyn_specs: List[object] = field(default_factory=list)
     # a safe upper bound on simultaneously-active literals per request
     max_active: int = 0
 
@@ -112,10 +125,12 @@ class PackedPolicySet:
     policy_meta: List[PolicyMeta]
     fallback: list  # List[FallbackPolicy]
     table: object = None  # compiler.table.FeatureTable
+    # True when fallback-scope gate rules were packed (group n_tiers * 3)
+    has_gate: bool = False
 
     @property
     def n_groups(self) -> int:
-        return self.n_tiers * GROUPS_PER_TIER
+        return self.n_tiers * GROUPS_PER_TIER + (1 if self.has_gate else 0)
 
 
 class _LitRegistry:
@@ -153,6 +168,22 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
         for clause in lp.error_clauses:
             lits = [(reg.intern(cl.lit), cl.negated) for cl in clause]
             rules.append((lits, err_group, pm_idx))
+
+    # Fallback-scope gate rules: one rule per interpreter-fallback policy,
+    # testing just the policy's scope (principal/action/resource heads —
+    # always lowerable, total, error-free). Group = n_tiers * 3; a request
+    # with no gate hit cannot match or error on any fallback policy, so its
+    # device verdict needs no interpreter merge.
+    has_gate = False
+    if compiled.fallback:
+        from .lower import scope_literals
+
+        gate_group = compiled.n_tiers * GROUPS_PER_TIER
+        for fp in compiled.fallback:
+            gate_lits, _ = scope_literals(fp.policy)
+            lits = [(reg.intern(cl.lit), cl.negated) for cl in gate_lits]
+            rules.append((lits, gate_group, GATE_RULE_POLICY))
+        has_gate = True
 
     n_lits = len(reg.lits)
     n_rules = len(rules)
@@ -194,6 +225,7 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
         plan=plan,
         policy_meta=policy_meta,
         fallback=list(compiled.fallback),
+        has_gate=has_gate,
     )
 
 
@@ -204,6 +236,7 @@ def _build_plan(lits: List[Literal]) -> EncodePlan:
     scalar_slots = set()
     hard_ids: Dict[object, int] = {}
     hard_err_ids: Dict[object, int] = {}
+    hard_ok_ids: Dict[object, int] = {}
     for i, lit in enumerate(lits):
         if lit.kind == EQ:
             plan.eq_idx.setdefault(lit.slot, {}).setdefault(lit.data, []).append(i)
@@ -258,12 +291,28 @@ def _build_plan(lits: List[Literal]) -> EncodePlan:
         elif lit.kind == HARD_ERR:
             hard_err_ids[lit.expr] = i
             max_active += 1
+        elif lit.kind == HARD_OK:
+            hard_ok_ids[lit.expr] = i
+            max_active += 1
     for expr, lid in hard_ids.items():
-        plan.hard_lits.append((lid, expr, hard_err_ids.pop(expr, -1)))
+        plan.hard_lits.append(
+            (lid, hard_ok_ids.pop(expr, -1), expr, hard_err_ids.pop(expr, -1))
+        )
     for expr, elid in hard_err_ids.items():
         # HARD_ERR without a surviving HARD literal (e.g. the hard literal
         # only appears in error clauses): still evaluate for the error bit
-        plan.hard_lits.append((-1, expr, elid))
+        plan.hard_lits.append((-1, hard_ok_ids.pop(expr, -1), expr, elid))
+    for expr, okid in hard_ok_ids.items():
+        plan.hard_lits.append((-1, okid, expr, -1))
+    from .dyn import dyn_spec
+
+    for _lid, _okid, expr, _elid in plan.hard_lits:
+        spec = dyn_spec(expr)
+        plan.dyn_specs.append(spec)
+        if spec is not None:
+            # the probe slot must be extracted even when no other literal
+            # references it (the native evaluator reads it per request)
+            slots.add(spec.slot)
     plan.slots = sorted(slots)
     # every scalar slot contributes at most one EQ hit and one IN_SET path
     max_active += len(scalar_slots)

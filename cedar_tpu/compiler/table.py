@@ -283,16 +283,21 @@ def encode_request_codes(
 
     if plan.hard_lits:
         env = Env(request, entities)
-        for lid, expr, err_lid in plan.hard_lits:
+        for lid, ok_lid, expr, err_lid in plan.hard_lits:
             try:
                 val = evaluate(expr, env)
-                if val is True:
-                    if lid >= 0:
-                        extras.append(lid)
-                elif type(val) is not bool and err_lid >= 0:
-                    extras.append(err_lid)
             except EvalError:
                 if err_lid >= 0:
                     extras.append(err_lid)
+                continue
+            if type(val) is bool:
+                # ok = "evaluation produced a bool": the positive guard
+                # negated hard literals require (lower.harden_clause)
+                if ok_lid >= 0:
+                    extras.append(ok_lid)
+                if val and lid >= 0:
+                    extras.append(lid)
+            elif err_lid >= 0:  # non-bool in a boolean position: type error
+                extras.append(err_lid)
 
     return codes, extras

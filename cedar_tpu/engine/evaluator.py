@@ -65,6 +65,7 @@ from ..ops.match import (
     INT32_MAX,
     POLICY_NONE,
     WORD_ERR,
+    WORD_GATE,
     WORD_MULTI,
     chunk_rules,
     match_rules_codes,
@@ -310,7 +311,11 @@ class TPUPolicyEngine:
         cs = cs or self._compiled
         packed = cs.packed
         w = words.astype(np.uint32)
-        need = np.nonzero((w & (WORD_ERR | WORD_MULTI)) != 0)[0]
+        # gated rows (fallback scope hit) re-run the exact Python path in
+        # their caller — their diagnostics never come from the word/bits
+        need = np.nonzero(
+            ((w & (WORD_ERR | WORD_MULTI)) != 0) & ((w & WORD_GATE) == 0)
+        )[0]
         out: dict = {}
         if not need.size:
             return out
@@ -399,11 +404,13 @@ class TPUPolicyEngine:
                         packed.n_tiers,
                         want_full,
                         self._pallas_interpret,
+                        packed.has_gate,
                     )
                     return w, f, None
             out = match_rules_codes(
                 chunk_c, chunk_e, *args, packed.n_tiers, want_full,
                 want_bits, np.int32(m) if want_bits else None,
+                packed.has_gate,
             )
             return out if want_bits else (*out, None)
 

@@ -40,6 +40,7 @@ from ..server.authorizer import (
     _diagnostic_to_reason,
 )
 from ..lang.authorize import ALLOW, DENY
+from ..ops.match import WORD_GATE
 from .evaluator import TPUPolicyEngine
 
 log = logging.getLogger(__name__)
@@ -97,12 +98,14 @@ class SARFastPath:
     def _current_snapshot(self) -> Optional[_Snapshot]:
         """Atomic snapshot for the engine's current compiled set, rebuilding
         the native encoder when the set changes (policy hot swap); None when
-        the set or environment rules the fast path out."""
+        the set or environment rules the fast path out.
+
+        Interpreter-fallback policies no longer disable the native plane:
+        their scopes are packed as device gate rules (compiler.pack), and
+        rows whose verdict word carries WORD_GATE re-run through the exact
+        Python path — everything else stays native."""
         cs = self.engine._compiled
         if cs is None:
-            return None
-        if cs.packed.fallback:
-            # interpreter-fallback policies need Python entities per request
             return None
         snap = self._snap  # lock-free fast path: one atomic attribute read
         if snap is not None and snap.cs is cs:
@@ -112,7 +115,7 @@ class SARFastPath:
             # thread may have built its snapshot) while we waited; building
             # for the stale cs would evict the fresh snapshot and thrash
             cs = self.engine._compiled
-            if cs is None or cs.packed.fallback:
+            if cs is None:
                 return None
             snap = self._snap
             if snap is None or snap.cs is not cs:
@@ -169,6 +172,52 @@ class SARFastPath:
             return DECISION_NO_OPINION, "", f"evaluation error: {e}"
         return decision, reason, None
 
+    def _gated_batch(self, bodies: Sequence[bytes]) -> List[Result]:
+        """Exact Python path for gate-flagged rows, but with ONE batched
+        device call instead of a per-row engine.evaluate dispatch. The rows
+        already passed the native gates (self-allow / system-skip fire
+        before encoding) and readiness was checked by the caller, so the
+        remaining work is entity build + hybrid evaluation + mapping —
+        semantics identical to authorizer.authorize per row."""
+        import json
+
+        from ..server.authorizer import record_to_cedar_resource
+        from ..server.http import get_authorizer_attributes
+
+        results: List[Optional[Result]] = [None] * len(bodies)
+        items = []  # (row, entities, request)
+        for i, body in enumerate(bodies):
+            try:
+                sar = json.loads(body)
+            except (ValueError, TypeError, RecursionError) as e:
+                results[i] = (
+                    DECISION_NO_OPINION,
+                    "Encountered decoding error",
+                    f"failed parsing request body: {e}",
+                )
+                continue
+            try:
+                attributes = get_authorizer_attributes(sar)
+                entities, request = record_to_cedar_resource(attributes)
+            except Exception as e:  # noqa: BLE001 — always answer
+                log.exception("fastpath gated entity build failed")
+                results[i] = (DECISION_NO_OPINION, "", f"evaluation error: {e}")
+                continue
+            items.append((i, entities, request))
+        if items:
+            try:
+                verdicts = self.engine.evaluate_batch(
+                    [(em, req) for _, em, req in items]
+                )
+            except Exception:  # noqa: BLE001 — re-run rows independently
+                log.exception("gated batch evaluation failed; per-row path")
+                for i, _, _ in items:
+                    results[i] = self._fallback(bodies[i])
+            else:
+                for (i, _, _), (decision, diag) in zip(items, verdicts):
+                    results[i] = self._map_decision(decision, diag)
+        return results  # type: ignore[return-value]
+
     def authorize_raw(self, bodies: Sequence[bytes]) -> List[Result]:
         """Evaluate a batch of raw SAR JSON bodies -> (decision, reason)."""
         snap = self._current_snapshot()
@@ -218,11 +267,32 @@ class SARFastPath:
             )
             packed = cs.packed
             w = words.astype(np.uint32)
+            handled = set()
+            # gate rows: a fallback policy's scope matched, so the word is
+            # not authoritative — re-run those rows through the exact Python
+            # path, batched into one device call (hybrid merge happens
+            # inside engine.evaluate_batch)
+            if packed.has_gate:
+                gate_rows = np.nonzero((w & WORD_GATE) != 0)[0].tolist()
+                if gate_rows:
+                    if self._fallback == self._python_fallback:
+                        gated = self._gated_batch(
+                            [bodies[int(idx[k])] for k in gate_rows]
+                        )
+                    else:  # honor an injected custom fallback per row
+                        gated = [
+                            self._fallback(bodies[int(idx[k])])
+                            for k in gate_rows
+                        ]
+                    for k, res in zip(gate_rows, gated):
+                        results[int(idx[k])] = res
+                        handled.add(k)
             resolved = self.engine.resolve_flagged(
                 words, ok_codes, ok_extras, cs=cs, bitmap=bitmap
             )
-            handled = set()
             for sel, (decision, diag) in resolved.items():
+                if sel in handled:
+                    continue
                 results[int(idx[sel])] = self._map_decision(decision, diag)
                 handled.add(sel)
             # vectorized verdict decode for the rest: one tuple per row,
@@ -284,14 +354,14 @@ class AdmissionFastPath:
 
     def _current_snapshot(self) -> Optional[_Snapshot]:
         cs = self.engine._compiled
-        if cs is None or cs.packed.fallback:
+        if cs is None:
             return None
         snap = self._snap
         if snap is not None and snap.cs is cs:
             return snap if snap.encoder is not None else None
         with self._build_lock:
             cs = self.engine._compiled
-            if cs is None or cs.packed.fallback:
+            if cs is None:
                 return None
             snap = self._snap
             if snap is None or snap.cs is not cs:
@@ -310,8 +380,10 @@ class AdmissionFastPath:
     def available(self) -> bool:
         return self._current_snapshot() is not None
 
-    def _py_one(self, body: bytes):
-        """Exact Python path for one raw body; response parity with
+    def _parse_one(self, body: bytes):
+        """Parse one raw body into an AdmissionRequest. Returns
+        (request, review, None) on success or (None, review, error
+        response) with the exact error semantics of
         WebhookServer.handle_admit."""
         import json
 
@@ -321,17 +393,28 @@ class AdmissionFastPath:
         review = None
         try:
             review = json.loads(body)
-            req = AdmissionRequest.from_admission_review(review)
-            return self.handler.handle(req)
+            return AdmissionRequest.from_admission_review(review), review, None
         except (ValueError, TypeError, RecursionError) as e:
             if review is None:
-                return AdmissionResponse(
+                return None, None, AdmissionResponse(
                     uid="",
                     allowed=False,
                     code=400,
                     error=f"failed parsing body: {e}",
                 )
-            return self._allow_on_error(review, e)
+            return None, review, self._allow_on_error(review, e)
+        except Exception as e:  # noqa: BLE001 — fail-open like the reference
+            log.exception("admission fastpath conversion failed")
+            return None, review, self._allow_on_error(review, e)
+
+    def _py_one(self, body: bytes):
+        """Exact Python path for one raw body; response parity with
+        WebhookServer.handle_admit."""
+        req, review, err = self._parse_one(body)
+        if err is not None:
+            return err
+        try:
+            return self.handler.handle(req)
         except Exception as e:  # noqa: BLE001 — fail-open like the reference
             log.exception("admission fastpath fallback failed")
             return self._allow_on_error(review, e)
@@ -349,6 +432,31 @@ class AdmissionFastPath:
             code=200,
             error=f"evaluation error ({'allowed' if allowed else 'denied'} on error): {e}",
         )
+
+    def _gated_batch(self, bodies: Sequence[bytes]) -> list:
+        """Exact Python path for gate-flagged rows with ONE batched
+        handler.handle_batch call instead of per-row handle dispatches;
+        per-row parse/conversion error semantics shared with _py_one
+        (_parse_one)."""
+        results: list = [None] * len(bodies)
+        reqs = []  # (row, AdmissionRequest)
+        for i, body in enumerate(bodies):
+            req, _review, err = self._parse_one(body)
+            if err is not None:
+                results[i] = err
+            else:
+                reqs.append((i, req))
+        if reqs:
+            try:
+                responses = self.handler.handle_batch([r for _, r in reqs])
+            except Exception:  # noqa: BLE001 — re-run rows independently
+                log.exception("gated admission batch failed; per-row path")
+                for i, _ in reqs:
+                    results[i] = self._py_one(bodies[i])
+            else:
+                for (i, _), resp in zip(reqs, responses):
+                    results[i] = resp
+        return results
 
     def _deny_message(self, snap: _Snapshot, pols) -> str:
         """Compact JSON list of reason dicts — byte-identical to the
@@ -419,15 +527,30 @@ class AdmissionFastPath:
             words, _, bitmap = self.engine.match_arrays(
                 ok_codes, ok_extras, cs=cs, want_bits=True
             )
+            packed = cs.packed
+            w = words.astype(np.uint32)
+            gated = set()
+            if packed.has_gate:
+                # fallback-scope hit: the word is not authoritative for
+                # these rows — exact Python path, batched into one
+                # handle_batch call (hybrid merge inside)
+                gate_rows = np.nonzero((w & WORD_GATE) != 0)[0].tolist()
+                if gate_rows:
+                    g_res = self._gated_batch(
+                        [bodies[int(idx[k])] for k in gate_rows]
+                    )
+                    for k, res in zip(gate_rows, g_res):
+                        results[int(idx[k])] = res
+                        gated.add(k)
             resolved = self.engine.resolve_flagged(
                 words, ok_codes, ok_extras, cs=cs, bitmap=bitmap
             )
-            packed = cs.packed
-            w = words.astype(np.uint32)
             vcodes = ((w >> 30) & 0x3).tolist()
             pols = (w & 0xFFFFFF).tolist()
             for k, i in enumerate(idx.tolist()):
                 uid = uids[i]
+                if k in gated:
+                    continue
                 if k in resolved:
                     decision, diag = resolved[k]
                     if decision == DENY and diag.reasons:

@@ -12,7 +12,7 @@ returns None and callers keep the pure-Python encode path.
 
 Blob format (little-endian; must match BlobReader in encoder.cpp):
 
-  i32 magic "CTB1" (0x43544231)
+  i32 magic "CTB2" (0x43544232)
   i32 n_slots
   3x var sections (principal, action, resource):
       i32 type_slot, i32 uid_slot, i32 n_anc, i32 anc_slots[...]
@@ -25,7 +25,13 @@ Blob format (little-endian; must match BlobReader in encoder.cpp):
                           likes:   i32 count, { i32 lit, i32 ncomps,
                                                 { u8 wild, [str chunk] } }
                           cmps:    i32 count, { i32 lit, u8 op, i64 c }
-                          set_has: i32 count, { str canon, i32 n, i32 lits[] } }
+                          set_has: i32 count, { str canon, i32 n, i32 lits[] }
+                          dyns:    i32 count, { i32 lit, i32 ok, i32 err,
+                                                tmpl } }
+  tmpl = u8 kind: 0 const  { str canon }
+                | 1 pattr  { str principal-attr }
+                | 2 record { i32 n, { str name, tmpl } }   (names sorted)
+                | 3 set    { i32 n, { tmpl } }             (sorted at runtime)
 
   (str = i32 length + bytes)
 """
@@ -102,9 +108,13 @@ class _BlobWriter:
 
 def serialize_table(plan, table) -> Optional[bytes]:
     """FeatureTable + EncodePlan -> native blob, or None when the set is not
-    natively encodable (hard literals need the interpreter per request, and
-    value kinds the canon format doesn't cover fall back to Python)."""
-    if plan.hard_lits:
+    natively encodable: a hard literal outside the dyn-contains class
+    (compiler/dyn.py) needs the Python interpreter per request, and value
+    kinds the canon format doesn't cover fall back to Python."""
+    if plan.hard_lits and (
+        len(plan.dyn_specs) != len(plan.hard_lits)
+        or any(s is None for s in plan.dyn_specs)
+    ):
         return None
     try:
         return _serialize_table(plan, table)
@@ -112,9 +122,32 @@ def serialize_table(plan, table) -> Optional[bytes]:
         return None
 
 
+def _write_tmpl(w: "_BlobWriter", t) -> None:
+    kind = t[0]
+    if kind == "const":
+        w.u8(0)
+        w.s(_canon(t[1]))
+    elif kind == "pattr":
+        w.u8(1)
+        w.s(t[1])
+    elif kind == "record":
+        w.u8(2)
+        w.i32(len(t[1]))
+        for name, child in t[1]:  # pre-sorted by dyn._tmpl_of
+            w.s(name)
+            _write_tmpl(w, child)
+    elif kind == "set":
+        w.u8(3)
+        w.i32(len(t[1]))
+        for child in t[1]:
+            _write_tmpl(w, child)
+    else:
+        raise ValueError(f"unknown template node {t!r}")
+
+
 def _serialize_table(plan, table) -> bytes:
     w = _BlobWriter()
-    w.i32(0x43544231)
+    w.i32(0x43544232)
     w.i32(table.n_slots)
 
     vars3 = ("principal", "action", "resource")
@@ -191,6 +224,20 @@ def _serialize_table(plan, table) -> bytes:
             w.i32(len(lits))
             for lid in lits:
                 w.i32(lid)
+
+        dyns = [
+            (lid, okid, elid, spec.tmpl)
+            for (lid, okid, _expr, elid), spec in zip(
+                plan.hard_lits, plan.dyn_specs
+            )
+            if spec is not None and spec.slot == slot
+        ]
+        w.i32(len(dyns))
+        for lid, okid, elid, tmpl in dyns:
+            w.i32(lid)
+            w.i32(okid)
+            w.i32(elid)
+            _write_tmpl(w, tmpl)
 
     return w.blob()
 
@@ -272,7 +319,8 @@ class NativeEncoder:
     @classmethod
     def create(cls, packed) -> Optional["NativeEncoder"]:
         """Build a NativeEncoder for a PackedPolicySet, or None if the set
-        (hard literals) or the environment (no g++) rules it out."""
+        (hard literals outside the dyn-contains class) or the environment
+        (no g++) rules it out."""
         lib = _load_library()
         if lib is None:
             return None

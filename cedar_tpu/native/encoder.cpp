@@ -77,7 +77,11 @@ class Arena {
     *v = JVal{};
     return v;
   }
-  // arena-owned storage for escaped strings
+  // arena-owned storage for escaped strings. Deque, NOT vector: growth must
+  // never relocate the string objects — short strings store their bytes
+  // inline (SSO), so a vector reallocation would dangle every sv previously
+  // returned for a short escaped string (two escaped labels in one document
+  // were enough to corrupt the first one's view).
   sv own(std::string &&s) {
     if (n_owned_ == owned_.size()) owned_.emplace_back();
     std::string &slot = owned_[n_owned_++];
@@ -92,7 +96,7 @@ class Arena {
  private:
   static constexpr size_t kChunk = 128;
   std::vector<std::unique_ptr<JVal[]>> chunks_;
-  std::vector<std::string> owned_;
+  std::deque<std::string> owned_;
   size_t used_ = 0, n_owned_ = 0;
 };
 
@@ -363,6 +367,20 @@ const V *sv_find(const SvMap<V> &m, sv key) {
   return it == m.end() ? nullptr : &it->second;
 }
 
+// dyn-contains template node (compiler/dyn.py): the probe value of a
+// <slot>.contains(<template>) hard expression, resolved per request.
+struct Tmpl {
+  uint8_t kind;   // 0 const canon, 1 principal attr, 2 record, 3 set
+  std::string s;  // const: pre-canonicalized bytes; pattr: attribute name
+  std::vector<std::pair<std::string, Tmpl>> fields;  // record (names sorted)
+                                                     // set: names unused
+};
+
+struct DynTest {
+  int32_t lit, ok_lit, err_lit;  // -1 when absent
+  Tmpl tmpl;
+};
+
 struct ScalarSlot {
   uint8_t var;       // 0 principal, 1 action, 2 resource, 3 context/other
   bool deep;         // multi-component path => value always missing (authz;
@@ -375,6 +393,7 @@ struct ScalarSlot {
   std::vector<LikeTest> likes;
   std::vector<CmpTest> cmps;
   SvMap<std::vector<int32_t>> set_has;
+  std::vector<DynTest> dyns;
 };
 
 struct Table {
@@ -421,9 +440,26 @@ class BlobReader {
   bool ok_ = true;
 };
 
+bool read_tmpl(BlobReader &r, Tmpl &t, int depth = 0) {
+  if (depth > 8) return false;
+  t.kind = r.u8();
+  if (t.kind == 0 || t.kind == 1) {
+    t.s = r.str();
+    return r.ok();
+  }
+  if (t.kind != 2 && t.kind != 3) return false;
+  int32_t n = r.i32();
+  if (!r.ok() || n < 0 || n > 1024) return false;
+  for (int32_t i = 0; i < n; ++i) {
+    t.fields.emplace_back(t.kind == 2 ? r.str() : std::string(), Tmpl{});
+    if (!read_tmpl(r, t.fields.back().second, depth + 1)) return false;
+  }
+  return r.ok();
+}
+
 Table *load_table(const uint8_t *blob, size_t len) {
   BlobReader r(blob, len);
-  if (r.i32() != 0x43544231) return nullptr;  // "CTB1"
+  if (r.i32() != 0x43544232) return nullptr;  // "CTB2"
   auto t = std::make_unique<Table>();
   t->n_slots = r.i32();
   for (int v = 0; v < 3; ++v) {
@@ -502,6 +538,15 @@ Table *load_table(const uint8_t *blob, size_t len) {
       std::vector<int32_t> lits(size_t(cnt >= 0 ? cnt : 0));
       for (auto &l : lits) l = r.i32();
       s.set_has[std::move(k)] = std::move(lits);
+    }
+    int32_t nd = r.i32();
+    for (int32_t j = 0; j < nd; ++j) {
+      DynTest d;
+      d.lit = r.i32();
+      d.ok_lit = r.i32();
+      d.err_lit = r.i32();
+      if (!read_tmpl(r, d.tmpl)) return nullptr;
+      s.dyns.push_back(std::move(d));
     }
     t->slots.push_back(std::move(s));
   }
@@ -922,6 +967,74 @@ struct ExtrasOut {
   }
 };
 
+// Resolve a dyn template into the probe's canonical value key. `lookup`
+// is `bool(sv attr, sv &out)` returning the principal's string attribute
+// or false when absent (a Cedar attribute-access error). Returns false on
+// any error — the caller activates the test's err_lit, mirroring the
+// interpreter raising from the same expression.
+template <class F>
+bool tmpl_canon(const Tmpl &t, F &&lookup, std::string &out) {
+  if (t.kind == 0) {  // pre-canonicalized constant
+    out += t.s;
+    return true;
+  }
+  if (t.kind == 1) {  // principal string attribute
+    sv val;
+    if (!lookup(sv(t.s), val)) return false;
+    out.push_back('s');
+    out.append(val.data(), val.size());
+    return true;
+  }
+  if (t.kind == 3) {  // set: canonicalize children, sort + dedupe
+    std::vector<std::string> es;
+    es.reserve(t.fields.size());
+    for (const auto &f : t.fields) {
+      std::string ec;
+      if (!tmpl_canon(f.second, lookup, ec)) return false;
+      es.push_back(std::move(ec));
+    }
+    canon_set_into(out, es);
+    return true;
+  }
+  // record: field names pre-sorted at serialize time (canon_cval parity)
+  out += "R{";
+  for (size_t i = 0; i < t.fields.size(); ++i) {
+    if (i) out.push_back('\x1f');
+    out += t.fields[i].first;
+    out.push_back('\x1d');
+    if (!tmpl_canon(t.fields[i].second, lookup, out)) return false;
+  }
+  out.push_back('}');
+  return true;
+}
+
+// Evaluate a slot's dyn-contains tests given the slot's element canons
+// (nullptr => the slot path is missing / not a set: every test errors,
+// exactly where the interpreter raises evaluating the same expression).
+template <class F>
+void eval_dyns(const ScalarSlot &s, const std::vector<std::string> *elems,
+               F &&lookup, ExtrasOut &extras, std::string &scratch) {
+  for (const auto &d : s.dyns) {
+    if (!elems) {
+      if (d.err_lit >= 0) extras.push(d.err_lit);
+      continue;
+    }
+    scratch.clear();
+    if (!tmpl_canon(d.tmpl, lookup, scratch)) {
+      if (d.err_lit >= 0) extras.push(d.err_lit);
+      continue;
+    }
+    if (d.ok_lit >= 0) extras.push(d.ok_lit);
+    bool member = false;
+    for (const auto &ec : *elems)
+      if (ec == scratch) {
+        member = true;
+        break;
+      }
+    if (member && d.lit >= 0) extras.push(d.lit);
+  }
+}
+
 Value slot_value(Features &f, const ScalarSlot &s) {
   Value v;
   if (s.deep || s.var == 3) return v;  // context is empty for authz; deep
@@ -1008,6 +1121,18 @@ void encode_one(const Table &t, Features &f, int32_t *codes, ExtrasOut &extras,
 
   for (const auto &s : t.slots) {
     Value v = slot_value(f, s);
+    if (!s.dyns.empty()) {
+      auto lookup = [&f](sv attr, sv &out) {
+        for (const auto &kv : f.p_attrs)
+          if (kv.first == attr) {
+            out = kv.second;
+            return true;
+          }
+        return false;
+      };
+      eval_dyns(s, v.kind == Value::SETV ? v.elems : nullptr, lookup, extras,
+                scratch);
+    }
     if (v.kind == Value::MISSING) continue;
 
     scratch.clear();
@@ -1741,8 +1866,30 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
                        : s.var == 2 ? f.res
                        : s.var == 3 ? f.ctx
                                     : nullptr;
-    if (!root) continue;
-    const CVal *v = cval_nav(root, s.comps);
+    const CVal *v = root ? cval_nav(root, s.comps) : nullptr;
+    if (!s.dyns.empty()) {
+      auto lookup = [&f](sv attr, sv &out) {
+        if (!f.p_rec) return false;
+        for (const auto &fl : f.p_rec->fields)
+          if (fl.first == attr && fl.second->kind == CVal::STRV) {
+            out = fl.second->str;
+            return true;
+          }
+        return false;
+      };
+      std::vector<std::string> ecs;
+      const std::vector<std::string> *elems = nullptr;
+      if (v && v->kind == CVal::SETV) {
+        ecs.reserve(v->elems.size());
+        for (const CVal *e : v->elems) {
+          std::string ec;
+          canon_cval(e, ec);
+          ecs.push_back(std::move(ec));
+        }
+        elems = &ecs;
+      }
+      eval_dyns(s, elems, lookup, extras, scratch);
+    }
     if (!v) continue;
     scratch.clear();
     canon_cval(v, scratch);

@@ -66,6 +66,13 @@ CODE_ERROR = 3
 # verdict-word flag masks (see module docstring)
 WORD_ERR = 1 << 29
 WORD_MULTI = 1 << 28
+# bit 27: at least one fallback-scope GATE rule matched (compiler.pack packs
+# one scope-conjunction rule per interpreter-fallback policy into group
+# n_tiers * 3). A gated row may match/error on a fallback policy, so its
+# word is not authoritative — callers re-route it to the exact Python path.
+# Rows without the bit are fully decided by the word even when fallback
+# policies exist.
+WORD_GATE = 1 << 27
 
 # group-per-tier layout (mirrors compiler.pack)
 _PERMIT, _FORBID, _ERROR = 0, 1, 2
@@ -246,7 +253,7 @@ def _compact_flagged_bits(bits, flagged, n_valid):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_tiers", "want_full", "want_bits")
+    jax.jit, static_argnames=("n_tiers", "want_full", "want_bits", "has_gate")
 )
 def match_rules_codes(
     codes,
@@ -260,6 +267,7 @@ def match_rules_codes(
     want_full: bool,
     want_bits: bool = False,
     n_valid=None,
+    has_gate: bool = False,
 ):
     """Feature-code variant of match_rules_device: the literal expansion
     happens ON DEVICE from the activation table, so the host ships one
@@ -277,13 +285,21 @@ def match_rules_codes(
     the words — the diagnostics contract of cedar-go (/root/reference
     internal/server/store/store.go:31) without a second device call.
     n_valid (dynamic scalar) masks bucket-padding rows out of the
-    compaction."""
+    compaction.
+
+    has_gate: the packed set carries fallback-scope gate rules in group
+    n_tiers * 3; rows with a gate hit get WORD_GATE set in their word (and
+    an extra trailing column in the want_full matrices)."""
+    n_groups = n_tiers * _GPT + (1 if has_gate else 0)
     lit = _lit_matrix_codes(codes, extras, act_rows)
     first, last, bits = _first_match(
-        lit, W_chunks, thresh_c, group_c, policy_c, n_tiers * _GPT,
+        lit, W_chunks, thresh_c, group_c, policy_c, n_groups,
         want_bits=want_bits,
     )
     packed = _tier_walk(first, last, n_tiers)
+    if has_gate:
+        gate = (first[:, n_tiers * _GPT] != INT32_MAX).astype(jnp.uint32)
+        packed = packed | (gate << 27)
     if not want_bits:
         return (packed, (first, last)) if want_full else (packed, None)
     if want_full:
@@ -298,7 +314,7 @@ def match_rules_codes(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_tiers", "want_full", "interpret")
+    jax.jit, static_argnames=("n_tiers", "want_full", "interpret", "has_gate")
 )
 def match_rules_codes_pallas(
     codes,
@@ -311,6 +327,7 @@ def match_rules_codes_pallas(
     n_tiers: int,
     want_full: bool,
     interpret: bool = False,
+    has_gate: bool = False,
 ):
     """Pallas-kernel variant of match_rules_codes: the scores matmul and the
     per-group first-match reduction run fused in VMEM (ops/pallas_match.py),
@@ -318,11 +335,15 @@ def match_rules_codes_pallas(
     (unchunked), thresh_r/group_r/policy_r [1, R]."""
     from .pallas_match import pallas_first_match
 
+    n_groups = n_tiers * _GPT + (1 if has_gate else 0)
     lit = _lit_matrix_codes(codes, extras, act_rows)
     first, last = pallas_first_match(
-        lit, W2, thresh_r, group_r, policy_r, n_tiers * _GPT, interpret
+        lit, W2, thresh_r, group_r, policy_r, n_groups, interpret
     )
     packed = _tier_walk(first, last, n_tiers)
+    if has_gate:
+        gate = (first[:, n_tiers * _GPT] != INT32_MAX).astype(jnp.uint32)
+        packed = packed | (gate << 27)
     return (packed, (first, last)) if want_full else (packed, None)
 
 

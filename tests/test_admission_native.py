@@ -371,19 +371,9 @@ def test_admission_fastpath_randomized():
     assert_parity(fast, handler, bodies)
 
 
-def test_admission_fastpath_rules_out_fallback_sets():
-    """Sets with interpreter-fallback policies must not claim the native
-    path (the demo's principal-referencing contains is one)."""
-    src = """
-forbid (principal, action == k8s::admission::Action::"create",
-        resource is core::v1::ConfigMap)
-  unless {
-    resource.metadata has labels &&
-    resource.metadata.labels.contains({key: "owner", value: principal.name})
-  };
-"""
+def _build_fallback_set(src):
     engine = TPUPolicyEngine()
-    engine.load(
+    stats = engine.load(
         [
             PolicySet.from_source(src, "adm"),
             PolicySet.from_source(ALLOW_ALL_ADMISSION_POLICY_SOURCE, "aa"),
@@ -397,11 +387,203 @@ forbid (principal, action == k8s::admission::Action::"create",
         evaluate=engine.evaluate,
         evaluate_batch=engine.evaluate_batch,
     )
-    fast = AdmissionFastPath(engine, handler)
-    assert not fast.available
-    # ... and the python path still answers correctly through handle_raw
-    body = json.dumps(
-        review(obj=obj_cm(labels={"owner": "bob"}))
-    ).encode()
-    [resp] = fast.handle_raw([body])
-    assert resp.allowed
+    return engine, handler, AdmissionFastPath(engine, handler), stats
+
+
+def test_admission_fastpath_hybrid_with_fallback_policies():
+    """A set with interpreter-fallback policies keeps the native plane: the
+    fallback scopes become device gate rules (compiler.pack), gate-flagged
+    rows re-run the exact Python path, and every other row stays native —
+    one unlowerable policy no longer disables the whole fast path."""
+    # the two-slot != join under `unless` is a negated unlowerable
+    # expression — a genuine interpreter-fallback policy (equivalent to
+    # forbidding when principal.namespace == resource namespace)
+    src = """
+forbid (principal is k8s::ServiceAccount,
+        action == k8s::admission::Action::"create",
+        resource is core::v1::ConfigMap)
+  unless { principal.namespace != resource.metadata.namespace };
+forbid (principal, action == k8s::admission::Action::"create",
+        resource is core::v1::ConfigMap)
+  when {
+    resource.metadata has labels &&
+    resource.metadata.labels.contains({key: "env", value: "prod"})
+  };
+"""
+    engine, handler, fast, stats = _build_fallback_set(src)
+    assert stats["fallback_policies"] >= 1
+    assert fast.available  # hybrid: fallback no longer rules the plane out
+    sa = "system:serviceaccount:default:builder"
+    bodies = [
+        # gated + fallback policy matches (SA creating in its own namespace)
+        json.dumps(review(obj=obj_cm(), user=sa, groups=())).encode(),
+        # gated, fallback policy does NOT match (different namespace)
+        json.dumps(
+            review(obj=obj_cm(ns="other"), ns="other", user=sa, groups=())
+        ).encode(),
+        # not gated (plain user): native verdict from the lowered policy
+        json.dumps(review(obj=obj_cm(labels={"env": "prod"}))).encode(),
+        json.dumps(review(obj=obj_cm(labels={"env": "dev"}))).encode(),
+        # not gated, different resource kind entirely
+        json.dumps(
+            review(
+                op="DELETE",
+                gvk=("", "v1", "Pod"),
+                old={"apiVersion": "v1", "kind": "Pod",
+                     "metadata": {"name": "p", "namespace": "default"}},
+            )
+        ).encode(),
+    ]
+    assert_parity(fast, handler, bodies)
+    # the device word really does carry the gate bit for the SA rows only
+    from cedar_tpu.ops.match import WORD_GATE
+
+    snap = fast._current_snapshot()
+    codes, extras, _c, flags, _u = snap.encoder.encode_adm_batch(bodies)
+    words, _, _ = engine.match_arrays(codes, extras, cs=snap.cs, want_bits=True)
+    gate = (words.astype("uint32") & WORD_GATE) != 0
+    assert list(gate) == [True, True, False, False, False]
+
+
+def test_admission_fastpath_dyn_contains_demo_policy():
+    """The reference demo's principal-referencing contains
+    (demo/admission-policy.yaml: labels must carry {owner: principal.name})
+    lowers to a native dyn test — the whole set stays device-pure and the
+    C++ path must agree with the interpreter across label shapes."""
+    import pathlib
+
+    import yaml
+
+    docs = [
+        d
+        for d in yaml.safe_load_all(
+            pathlib.Path("demo/admission-policy.yaml").read_text()
+        )
+        if d
+    ]
+    src = "\n".join(d["spec"]["content"] for d in docs if d.get("spec"))
+    engine, handler, fast, stats = _build_fallback_set(src)
+    assert stats["fallback_policies"] == 0  # dyn lowering: no fallback left
+    assert fast.available
+
+    def cm(labels):
+        return review(obj=obj_cm(labels=labels))
+
+    sa = "system:serviceaccount:team-a:robot"
+    bodies = [
+        json.dumps(c).encode()
+        for c in [
+            cm({"owner": "bob"}),  # allow: label matches principal.name
+            cm({"owner": "alice"}),  # deny: wrong owner
+            cm({}),  # deny: no labels (metadata.labels drops)
+            cm(None),  # deny: no labels key at all
+            cm({"owner": "bob", "env": "prod"}),  # allow: extra labels fine
+            cm({"Owner": "bob"}),  # deny: key case-sensitive
+            review(obj=obj_cm(labels={"owner": "bob"}), user=sa, groups=("tenants",)),
+            # allow: not in tenants group -> policy scope misses
+            review(obj=obj_cm(), groups=("admins",)),
+            # allow: different kind -> scope misses
+            review(
+                op="CREATE",
+                gvk=("", "v1", "Pod"),
+                obj={"apiVersion": "v1", "kind": "Pod",
+                     "metadata": {"name": "p", "namespace": "default"}},
+            ),
+            # unicode owner value
+            review(obj=obj_cm(labels={"owner": "üser-ünïcode"}), user="üser-ünïcode"),
+        ]
+    ]
+    assert_parity(fast, handler, bodies)
+
+
+def test_admission_fastpath_dyn_contains_randomized():
+    """Fuzzed parity over the dyn-contains path: random label maps, owner
+    values, principal names, and operations."""
+    import random
+
+    import pathlib
+
+    import yaml
+
+    docs = [
+        d
+        for d in yaml.safe_load_all(
+            pathlib.Path("demo/admission-policy.yaml").read_text()
+        )
+        if d
+    ]
+    src = "\n".join(d["spec"]["content"] for d in docs if d.get("spec"))
+    _engine, handler, fast, _stats = _build_fallback_set(src)
+    assert fast.available
+    rng = random.Random(97)
+    users = ["bob", "alice", "ci-bot", "üni", "system:serviceaccount:ns:sa"]
+    bodies = []
+    for i in range(300):
+        user = rng.choice(users)
+        labels = None
+        if rng.random() < 0.8:
+            labels = {}
+            for _ in range(rng.randint(0, 3)):
+                labels[rng.choice(["owner", "env", "team", "Owner"])] = rng.choice(
+                    users + ["prod", "dev", ""]
+                )
+        op = rng.choice(["CREATE", "CREATE", "CREATE", "UPDATE", "DELETE"])
+        kind = rng.choice([("", "v1", "ConfigMap"), ("", "v1", "Pod")])
+        obj = {
+            "apiVersion": "v1",
+            "kind": kind[2],
+            "metadata": {"name": f"x-{i}", "namespace": "default"},
+        }
+        if labels is not None:
+            obj["metadata"]["labels"] = labels
+        kwargs = dict(
+            op=op, gvk=kind, user=user,
+            groups=("tenants",) if rng.random() < 0.7 else ("admins",),
+            uid=f"r-{i}",
+        )
+        if op == "DELETE":
+            kwargs["old"] = obj
+        else:
+            kwargs["obj"] = obj
+            if op == "UPDATE":
+                kwargs["old"] = obj
+        bodies.append(json.dumps(review(**kwargs)).encode())
+    assert_parity(fast, handler, bodies)
+
+
+def test_admission_fastpath_gate_respects_hot_swap():
+    """Hot-swapping from a fallback-bearing set to a device-pure set drops
+    the gate plane (and vice versa) without rebuild races."""
+    src_fb = """
+forbid (principal is k8s::ServiceAccount,
+        action == k8s::admission::Action::"create",
+        resource is core::v1::ConfigMap)
+  unless { principal.namespace != resource.metadata.namespace };
+"""
+    src_pure = """
+forbid (principal, action == k8s::admission::Action::"create",
+        resource is core::v1::ConfigMap)
+  when { resource.metadata has labels &&
+         resource.metadata.labels.contains({key: "env", value: "prod"}) };
+"""
+    engine, handler, fast, stats = _build_fallback_set(src_fb)
+    assert stats["fallback_policies"] == 1
+    sa = "system:serviceaccount:default:builder"
+    body_sa = json.dumps(review(obj=obj_cm(), user=sa, groups=())).encode()
+    body_prod = json.dumps(review(obj=obj_cm(labels={"env": "prod"}))).encode()
+    [r1, r2] = fast.handle_raw([body_sa, body_prod])
+    assert not r1.allowed  # fallback policy, via the gated python path
+    assert r2.allowed  # prod-label policy absent from this set
+
+    engine.load(
+        [
+            PolicySet.from_source(src_pure, "adm"),
+            PolicySet.from_source(ALLOW_ALL_ADMISSION_POLICY_SOURCE, "aa"),
+        ],
+        warm="off",
+    )
+    assert engine.stats["fallback_policies"] == 0
+    assert fast.available
+    [r1, r2] = fast.handle_raw([body_sa, body_prod])
+    assert r1.allowed  # join policy gone
+    assert not r2.allowed  # prod label now forbidden, fully native

@@ -237,6 +237,101 @@ def test_fastpath_decision_parity():
         assert bool(reason) == bool(exp_reason), f"reason mismatch for {sar}"
 
 
+def test_fastpath_hybrid_with_fallback_policy():
+    """A SAR set with one interpreter-fallback policy keeps the native fast
+    path: its scope becomes a device gate rule, gated rows re-run the exact
+    Python path (hybrid merge), every other row stays native — decision
+    parity must hold across both kinds of row."""
+    src = POLICIES + """
+permit (principal in k8s::Group::"fbgroup", action == k8s::Action::"get",
+        resource is k8s::Resource)
+  unless { principal.name != resource.name };
+"""
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "hybrid")], warm="off")
+    assert engine.stats["fallback_policies"] == 1
+    stores = TieredPolicyStores([MemoryStore.from_source("hybrid", src)])
+    authorizer = CedarWebhookAuthorizer(stores)
+    tpu_auth = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, tpu_auth)
+    assert fastpath.available  # fallback no longer disables the plane
+
+    rng = random.Random(31)
+    sars = [_random_sar(rng) for _ in range(300)]
+    # force a mix of gated rows: some in fbgroup with matching/mismatching
+    # resource names (the join only the interpreter can evaluate)
+    for i, s in enumerate(sars):
+        if i % 3 == 0:
+            s["spec"].setdefault("groups", []).append("fbgroup")
+        if i % 6 == 0:
+            ra = s["spec"].setdefault("resourceAttributes", {"verb": "get"})
+            ra["name"] = s["spec"]["user"]
+    bodies = [json.dumps(s).encode() for s in sars]
+    results = fastpath.authorize_raw(bodies)
+    for sar, (decision, reason, error) in zip(sars, results):
+        attrs = get_authorizer_attributes(sar)
+        exp_decision, exp_reason = authorizer.authorize(attrs)
+        assert decision == exp_decision, (
+            f"decision mismatch for {sar}: fast={decision} py={exp_decision}"
+        )
+        assert bool(reason) == bool(exp_reason), f"reason mismatch for {sar}"
+
+
+def test_fastpath_dyn_contains_any_selector_policy():
+    """The reference demo's dynamic selector policy (containsAny over
+    =/==/in with values [principal.name], /root/reference
+    demo/authorization-policy.yaml:117-121) lowers to native dyn tests via
+    the contains-chain rewrite — no fallback, full native parity."""
+    src = """
+permit (principal is k8s::User,
+        action in [k8s::Action::"list", k8s::Action::"watch"],
+        resource is k8s::Resource)
+  when {
+    resource.resource == "secrets" &&
+    resource.apiGroup == "" &&
+    resource has labelSelector &&
+    resource.labelSelector.containsAny([
+        {key: "owner", operator: "=", values: [principal.name]},
+        {key: "owner", operator: "==", values: [principal.name]},
+        {key: "owner", operator: "in", values: [principal.name]}])
+  };
+"""
+    engine = TPUPolicyEngine()
+    stats = engine.load([PolicySet.from_source(src, "sel")], warm="off")
+    assert stats["fallback_policies"] == 0
+    stores = TieredPolicyStores([MemoryStore.from_source("sel", src)])
+    authorizer = CedarWebhookAuthorizer(stores)
+    tpu_auth = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, tpu_auth)
+    assert fastpath.available
+
+    def body(user, owner, op="In"):
+        return json.dumps(
+            {"spec": {"user": user, "uid": "u",
+                      "resourceAttributes": {
+                          "verb": "list", "resource": "secrets",
+                          "version": "v1",
+                          "labelSelector": {"requirements": [
+                              {"key": "owner", "operator": op,
+                               "values": [owner]}]}}}}
+        ).encode()
+
+    cases = [
+        body("sam", "sam"),          # allow: pins own name
+        body("sam", "alice"),        # no_opinion: someone else's
+        body("alice", "alice"),      # allow
+        body("sam", "sam", "NotIn"), # no_opinion: wrong operator
+        body("üni", "üni"),          # unicode through escapes
+    ]
+    results = fastpath.authorize_raw(cases)
+    expected = ["allow", "no_opinion", "allow", "no_opinion", "allow"]
+    for b, (decision, _r, _e), exp in zip(cases, results, expected):
+        assert decision == exp, f"{b}: {decision} != {exp}"
+        sar = json.loads(b)
+        attrs = get_authorizer_attributes(sar)
+        assert authorizer.authorize(attrs)[0] == decision
+
+
 def test_fastpath_parse_error_falls_back():
     engine = TPUPolicyEngine()
     engine.load(_policy_tiers())
