@@ -36,6 +36,7 @@ tier's default applies.
 
 from __future__ import annotations
 
+import atexit
 import threading
 from typing import List, Optional, Sequence, Tuple
 
@@ -78,6 +79,22 @@ _BATCH_BUCKETS = (1, 8, 32, 128, 512, 1024, 2048, 4096, 8192, 16384, 32768)
 # per-call device round trip, small enough to keep several in flight
 _PIPELINE_SB = 32768
 _PIPELINE_MIN = 8192  # don't split batches smaller than this
+
+# Daemon warm-up threads must not be inside an XLA call when the
+# interpreter finalizes: pthread teardown mid-C++-exception aborts the
+# whole process ("FATAL: exception not rethrown"). atexit runs before
+# interpreter teardown, so flag shutdown and join the stragglers there.
+_shutdown = threading.Event()
+_live_warm_threads: set = set()
+
+
+def _join_warm_threads_at_exit() -> None:
+    _shutdown.set()
+    for t in list(_live_warm_threads):
+        t.join(timeout=120)
+
+
+atexit.register(_join_warm_threads_at_exit)
 
 
 def _round_bucket(n: int, buckets) -> int:
@@ -172,10 +189,18 @@ class TPUPolicyEngine:
         if warm == "sync":
             self._warm_kernels(new)
         elif warm != "off":
-            threading.Thread(
-                target=self._warm_kernels, args=(new,), daemon=True
-            ).start()
+            t = threading.Thread(
+                target=self._warm_thread_main, args=(new,), daemon=True
+            )
+            _live_warm_threads.add(t)
+            t.start()
         return {**compiled.stats(), "L": packed.L, "R": packed.R}
+
+    def _warm_thread_main(self, cs: "_CompiledSet") -> None:
+        try:
+            self._warm_kernels(cs)
+        finally:
+            _live_warm_threads.discard(threading.current_thread())
 
     def _warm_kernels(self, cs: "_CompiledSet") -> None:
         """Trace+compile the first-hit serving shapes off the critical path:
@@ -190,7 +215,7 @@ class TPUPolicyEngine:
         shapes = [(b, self.match_arrays) for b in (1, 8, 32)]
         shapes.append((1, self.match_bits_arrays))
         for b, fn in shapes:
-            if self._compiled is not cs:
+            if self._compiled is not cs or _shutdown.is_set():
                 return
             try:
                 warm_c = np.zeros((b, packed.table.n_slots), dtype=cs.code_dtype)
@@ -356,10 +381,27 @@ class TPUPolicyEngine:
         cs: Optional["_CompiledSet"] = None,
         want_bits: bool = False,
     ):
+        """Launch + materialize in one call (see match_arrays_launch)."""
+        return self.match_arrays_launch(
+            codes_arr, extras_arr, want_full=want_full, cs=cs,
+            want_bits=want_bits,
+        )()
+
+    def match_arrays_launch(
+        self,
+        codes_arr: np.ndarray,
+        extras_arr: np.ndarray,
+        want_full: bool = False,
+        cs: Optional["_CompiledSet"] = None,
+        want_bits: bool = False,
+    ):
         """Device-match pre-encoded feature codes (e.g. from the native
-        encoder): codes [n, S], extras [n, E] (padded with >= L). Returns
-        (packed verdict words [n] uint32, full) where full is None or, with
-        want_full, an ([n, G] first-match, [n, G] match-count) int32 pair.
+        encoder): codes [n, S], extras [n, E] (padded with >= L). Dispatches
+        every sub-batch asynchronously and returns a ``finish()`` callable;
+        finish materializes (packed verdict words [n] uint32, full) where
+        full is None or, with want_full, an ([n, G] first-match, [n, G]
+        last-match) int32 pair. Callers overlap host work (encoding the
+        next chunk) between launch and finish.
         Handles batch bucketing, dtype narrowing, and sub-batch pipelining.
 
         With want_bits a third element is returned: {row index: [R/32]
@@ -437,43 +479,46 @@ class TPUPolicyEngine:
             for r, b in zip(idx[live].tolist(), kbits[live]):
                 bitmap[lo + r] = b
 
-        bitmap: dict = {}
-        if n <= _PIPELINE_MIN:
-            w, f, p = one(codes_arr, extras_arr)
-            words = np.asarray(w)[:n]
-            full = trim_full(f, n) if want_full else None
-            if want_bits:
-                if any_flagged(words, full):
-                    pack_rows(p, 0, bitmap)
-                return words, full, bitmap
-            return words, full
-
+        # ---- launch: dispatch every sub-batch asynchronously. The returned
+        # finish() materializes — callers that interleave host work (e.g.
+        # SARFastPath encoding the next chunk) overlap it with the device.
         outs = []
         for lo in range(0, n, _PIPELINE_SB):
             hi = min(lo + _PIPELINE_SB, n)
-            w, f, p = one(codes_arr[lo:hi], extras_arr[lo:hi])
+            if lo == 0 and hi == n:
+                w, f, p = one(codes_arr, extras_arr)
+            else:
+                w, f, p = one(codes_arr[lo:hi], extras_arr[lo:hi])
             w.copy_to_host_async()
             if f is not None:
                 f[0].copy_to_host_async()
                 f[1].copy_to_host_async()
             outs.append((lo, hi - lo, w, f, p))
-        host = [
-            (lo, np.asarray(w)[:m], trim_full(f, m) if want_full else None, p)
-            for lo, m, w, f, p in outs
-        ]
-        words = np.concatenate([wh for _, wh, _, _ in host])
-        full = None
-        if want_full:
-            full = (
-                np.concatenate([fh[0] for _, _, fh, _ in host]),
-                np.concatenate([fh[1] for _, _, fh, _ in host]),
-            )
-        if want_bits:
-            for lo, wh, fh, p in host:
-                if p is not None and any_flagged(wh, fh):
-                    pack_rows(p, lo, bitmap)
-            return words, full, bitmap
-        return words, full
+
+        def finish():
+            bitmap: dict = {}
+            host = [
+                (lo, np.asarray(w)[:m], trim_full(f, m) if want_full else None, p)
+                for lo, m, w, f, p in outs
+            ]
+            if len(host) == 1:
+                _, words, full, _ = host[0]
+            else:
+                words = np.concatenate([wh for _, wh, _, _ in host])
+                full = None
+                if want_full:
+                    full = (
+                        np.concatenate([fh[0] for _, _, fh, _ in host]),
+                        np.concatenate([fh[1] for _, _, fh, _ in host]),
+                    )
+            if want_bits:
+                for lo, wh, fh, p in host:
+                    if p is not None and any_flagged(wh, fh):
+                        pack_rows(p, lo, bitmap)
+                return words, full, bitmap
+            return words, full
+
+        return finish
 
     # fixed row count for the standalone bitset kernel: every call pads to
     # exactly this many rows, so the kernel has ONE batch shape per extras
@@ -487,19 +532,30 @@ class TPUPolicyEngine:
         extras_arr: np.ndarray,
         cs: Optional["_CompiledSet"] = None,
     ) -> np.ndarray:
+        """Launch + materialize in one call (see match_bits_arrays_launch)."""
+        return self.match_bits_arrays_launch(codes_arr, extras_arr, cs=cs)()
+
+    def match_bits_arrays_launch(
+        self,
+        codes_arr: np.ndarray,
+        extras_arr: np.ndarray,
+        cs: Optional["_CompiledSet"] = None,
+    ):
         """Per-rule satisfaction bitsets [n, R // 32] uint32 for the given
-        pre-encoded rows. Overflow/fallback diagnostic path only — the hot
-        path gets its bitsets compacted into the main match call
-        (match_arrays want_bits); this one runs when that payload missed
-        (compaction overflow, pallas plane). Rows process in fixed
-        _BITS_CHUNK-sized pieces, pipelined."""
+        pre-encoded rows, as a launch + ``finish()`` pair (callers overlap
+        host/device work between the two). Diagnostics path only — small
+        batches get their bitsets compacted into the main match call
+        (match_arrays want_bits); this one runs for large-batch flagged
+        rows, compaction overflow, and the pallas plane. Rows process in
+        fixed _BITS_CHUNK-sized pieces, pipelined."""
         cs = cs or self._compiled
         if cs is None:
             raise RuntimeError("TPUPolicyEngine: no policy set loaded")
         packed = cs.packed
         n = codes_arr.shape[0]
         if n == 0:
-            return np.zeros((0, packed.R // 32), dtype=np.uint32)
+            empty = np.zeros((0, packed.R // 32), dtype=np.uint32)
+            return lambda: empty
         codes_arr = codes_arr.astype(cs.code_dtype, copy=False)
         extras_arr = extras_arr.astype(cs.active_dtype, copy=False)
         CH = self._BITS_CHUNK
@@ -524,7 +580,11 @@ class TPUPolicyEngine:
             b = one(codes_arr[lo:hi], extras_arr[lo:hi])
             b.copy_to_host_async()
             outs.append((hi - lo, b))
-        return np.concatenate([np.asarray(b)[:m] for m, b in outs])
+
+        def finish():
+            return np.concatenate([np.asarray(b)[:m] for m, b in outs])
+
+        return finish
 
     # ---------------------------------------------------------- device path
 

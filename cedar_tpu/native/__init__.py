@@ -62,24 +62,33 @@ _CMP_OPS = {"<": 0, "<=": 1, ">": 2, ">=": 3}
 
 def _canon(vk) -> bytes:
     """Canonical byte string for a value_key; must stay in sync with the
-    canon_* helpers in encoder.cpp."""
+    canon_* helpers in encoder.cpp.
+
+    Strings (and entity type/id, and record field names) are LENGTH-
+    PREFIXED: request-controlled bytes may contain the \\x1f/\\x1d
+    structure separators, and without the prefix a crafted value like
+    "x\\x1fsy" would alias a different composite value's canon — a
+    decision-flipping false match on the native membership paths."""
     tag = vk[0]
     if tag == "b":
         return b"t" if vk[1] else b"f"
     if tag == "l":
         return b"l%d" % vk[1]
     if tag == "s":
-        return b"s" + vk[1].encode("utf-8", "surrogatepass")
+        b = vk[1].encode("utf-8", "surrogatepass")
+        return b"s%d:%s" % (len(b), b)
     if tag == "e":
-        return b"e" + vk[1].encode() + b"\x1f" + vk[2].encode()
+        t = vk[1].encode()
+        i = vk[2].encode("utf-8", "surrogatepass")
+        return b"e%d:%s%d:%s" % (len(t), t, len(i), i)
     if tag == "S":
         return b"S{" + b"\x1f".join(sorted(_canon(e) for e in vk[1])) + b"}"
     if tag == "R":
-        return (
-            b"R{"
-            + b"\x1f".join(k.encode() + b"\x1d" + _canon(v) for k, v in vk[1])
-            + b"}"
-        )
+        parts = []
+        for k, v in vk[1]:
+            kb = k.encode("utf-8", "surrogatepass")
+            parts.append(b"%d:%s\x1d%s" % (len(kb), kb, _canon(v)))
+        return b"R{" + b"\x1f".join(parts) + b"}"
     raise ValueError(f"cannot canonicalize value key {vk!r}")
 
 

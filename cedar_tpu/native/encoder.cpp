@@ -587,8 +587,18 @@ bool like_match(const std::vector<LikeComp> &comps, sv s) {
 // --------------------------------------------------- canonical value keys
 
 // Must stay byte-identical with _canon() in cedar_tpu/native/__init__.py.
+// Strings are length-prefixed ("s<len>:<bytes>"): request-controlled bytes
+// may contain the \x1f/\x1d structure separators, and without the prefix a
+// crafted value could alias a different composite value's canon.
+void canon_len_prefix(std::string &out, size_t n) {
+  char buf[24];
+  int w = snprintf(buf, sizeof buf, "%zu:", n);
+  out.append(buf, size_t(w));
+}
+
 void canon_str_into(std::string &out, sv s) {
   out.push_back('s');
+  canon_len_prefix(out, s.size());
   out.append(s.data(), s.size());
 }
 
@@ -613,6 +623,7 @@ std::string canon_record(
   for (const auto &f : fields) {
     if (!first) out.push_back('\x1f');
     first = false;
+    canon_len_prefix(out, strlen(f.first));
     out += f.first;
     out.push_back('\x1d');
     out += *f.second;
@@ -784,9 +795,10 @@ uint8_t build_features(const JVal *root, Features &f) {
     dedupe_children(extra, kids);
     std::vector<std::pair<std::string, const JVal *>> lkids;
     for (const JVal *kv : kids) {
-      // convertExtra lower-cases keys (server.go:205)
-      std::string key = "s";
-      key.reserve(kv->key.size() + 1);
+      // convertExtra lower-cases keys (server.go:205); canon applied after
+      // the dedupe below
+      std::string key;
+      key.reserve(kv->key.size());
       for (char c : kv->key)
         key.push_back(c >= 'A' && c <= 'Z' ? char(c + 32) : c);
       bool replaced = false;
@@ -808,10 +820,11 @@ uint8_t build_features(const JVal *root, Features &f) {
             canon_str_into(c, v->str);
             vals.push_back(std::move(c));
           }
-      std::string vset;
+      std::string kc, vset;
+      canon_str_into(kc, e.first);
       canon_set_into(vset, vals);
       f.extra_elem_canons.push_back(
-          canon_record({{"key", &e.first}, {"values", &vset}}));
+          canon_record({{"key", &kc}, {"values", &vset}}));
     }
   }
 
@@ -981,8 +994,7 @@ bool tmpl_canon(const Tmpl &t, F &&lookup, std::string &out) {
   if (t.kind == 1) {  // principal string attribute
     sv val;
     if (!lookup(sv(t.s), val)) return false;
-    out.push_back('s');
-    out.append(val.data(), val.size());
+    canon_str_into(out, val);
     return true;
   }
   if (t.kind == 3) {  // set: canonicalize children, sort + dedupe
@@ -1000,6 +1012,7 @@ bool tmpl_canon(const Tmpl &t, F &&lookup, std::string &out) {
   out += "R{";
   for (size_t i = 0; i < t.fields.size(); ++i) {
     if (i) out.push_back('\x1f');
+    canon_len_prefix(out, t.fields[i].first.size());
     out += t.fields[i].first;
     out.push_back('\x1d');
     if (!tmpl_canon(t.fields[i].second, lookup, out)) return false;
@@ -1522,8 +1535,7 @@ CVal *adm_top_record(AdmCtx &c, const JVal *obj) {
 void canon_cval(const CVal *v, std::string &out) {
   switch (v->kind) {
     case CVal::STRV:
-      out.push_back('s');
-      out.append(v->str.data(), v->str.size());
+      canon_str_into(out, v->str);
       return;
     case CVal::LONGV: {
       char buf[24];
@@ -1542,8 +1554,9 @@ void canon_cval(const CVal *v, std::string &out) {
       return;
     case CVal::ENTV:
       out.push_back('e');
+      canon_len_prefix(out, v->ent_type.size());
       out.append(v->ent_type.data(), v->ent_type.size());
-      out.push_back('\x1f');
+      canon_len_prefix(out, v->str.size());
       out.append(v->str.data(), v->str.size());
       return;
     case CVal::SETV: {
@@ -1566,6 +1579,7 @@ void canon_cval(const CVal *v, std::string &out) {
       out += "R{";
       for (size_t i = 0; i < fs.size(); ++i) {
         if (i) out.push_back('\x1f');
+        canon_len_prefix(out, fs[i]->first.size());
         out.append(fs[i]->first.data(), fs[i]->first.size());
         out.push_back('\x1d');
         canon_cval(fs[i]->second, out);

@@ -332,6 +332,53 @@ permit (principal is k8s::User,
         assert authorizer.authorize(attrs)[0] == decision
 
 
+def test_canon_separator_injection_no_alias():
+    """Request strings carrying the \\x1f/\\x1d canon separators must NOT
+    alias a different composite value: the canon length-prefixes every
+    string, so a crafted selector value like 'x\\x1fsy' cannot forge the
+    two-element set {x, y} and flip a set_has/dyn membership test."""
+    src = """
+permit (principal, action == k8s::Action::"list", resource is k8s::Resource)
+  when {
+    resource has labelSelector &&
+    resource.labelSelector.contains({
+        key: "owner", operator: "in", values: ["x", "y"]})
+  };
+"""
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "inj")], warm="off")
+    stores = TieredPolicyStores([MemoryStore.from_source("inj", src)])
+    authorizer = CedarWebhookAuthorizer(stores)
+    fastpath = SARFastPath(
+        engine, CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    )
+    assert fastpath.available
+
+    def body(values):
+        return json.dumps(
+            {"spec": {"user": "u1", "uid": "u",
+                      "resourceAttributes": {
+                          "verb": "list", "resource": "pods", "version": "v1",
+                          "labelSelector": {"requirements": [
+                              {"key": "owner", "operator": "In",
+                               "values": values}]}}}}
+        ).encode()
+
+    crafted = [
+        body(["x\x1fs3:y"]),      # forged set-separator splice
+        body(["x\x1fsy"]),        # pre-fix era splice shape
+        body(["x", "y"]),         # the genuine match
+        body(["x\x1dsy"]),        # record-separator splice
+    ]
+    results = fastpath.authorize_raw(crafted)
+    expected = ["no_opinion", "no_opinion", "allow", "no_opinion"]
+    for b, (decision, _r, _e), exp in zip(crafted, results, expected):
+        sar = json.loads(b)
+        attrs = get_authorizer_attributes(sar)
+        py = authorizer.authorize(attrs)[0]
+        assert decision == py == exp, f"{b}: native={decision} py={py} exp={exp}"
+
+
 def test_fastpath_parse_error_falls_back():
     engine = TPUPolicyEngine()
     engine.load(_policy_tiers())
