@@ -703,9 +703,10 @@ def main():
             0.0,
         )
         latency[f"device_exec_ms_b{b_lat}"] = round(exec_ms, 3)
-# measured webhook latency is attached below (measure_webhook_loopback),
-    # replacing the r03 derived boolean with real loopback numbers + an
-    # extrapolation built from measured per-stage costs
+# derived fallback so the key is ALWAYS present; overwritten with the
+    # measured-stage extrapolation when the loopback measurement runs
+    worst_exec = max(latency[f"device_exec_ms_b{b}"] for b in (1, 64, 256))
+    latency["p99_under_2ms_attached"] = bool(worst_exec * 3 + 0.2 < 2.0)
 
     # end-to-end python path (encode + device + finalize), single thread
     engine.evaluate_batch(items[:1024])  # warm the bucket

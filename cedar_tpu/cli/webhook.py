@@ -134,12 +134,31 @@ def build_server(args) -> WebhookServer:
     if not len(stores.stores):
         log.warning("no policy stores configured; authorizer will no-opinion")
 
+    mesh = None
+    if getattr(args, "mesh", ""):
+        # "--mesh DATAxPOLICY" (e.g. 1x8, 2x4) or a bare device count
+        # (policy-only split): the explicit (data, policy) factorization of
+        # the device mesh the engines evaluate over
+        from ..parallel.mesh import make_mesh
+
+        spec = args.mesh.lower()
+        if "x" in spec:
+            d, p = (int(x) for x in spec.split("x", 1))
+            mesh = make_mesh(d * p, shape=(d, p))
+        else:
+            mesh = make_mesh(int(spec))
+        log.info(
+            "device mesh: data=%d policy=%d",
+            mesh.shape["data"],
+            mesh.shape["policy"],
+        )
+
     def _tpu_backend(tier_stores: TieredPolicyStores):
         """(engine, evaluate, evaluate_batch) for a tier stack: compiled
         eval with an interpreter guard until the first successful load."""
         from ..engine.evaluator import TPUPolicyEngine
 
-        tier_engine = TPUPolicyEngine()
+        tier_engine = TPUPolicyEngine(mesh=mesh)
 
         def evaluate(entities, request):
             if not tier_engine.loaded:
@@ -266,6 +285,12 @@ def make_parser() -> argparse.ArgumentParser:
     )
     cedar.add_argument(
         "--kubeconfig", default="", help="kubeconfig for the CRD policy store"
+    )
+    cedar.add_argument(
+        "--mesh",
+        default="",
+        help="device mesh for the TPU backend as DATAxPOLICY (e.g. 2x4) or "
+        "a device count for a policy-only split; empty = single device",
     )
     cedar.add_argument(
         "--backend",

@@ -107,8 +107,38 @@ def _round_bucket(n: int, buckets) -> int:
 class _CompiledSet:
     """Immutable device-resident compiled policy set (the swap unit)."""
 
-    def __init__(self, packed: PackedPolicySet, device=None, use_pallas=False):
+    def __init__(
+        self, packed: PackedPolicySet, device=None, use_pallas=False, mesh=None
+    ):
         self.packed = packed
+        self.mesh = mesh
+        # literal/code ids fit int16 whenever the id space allows — halves
+        # the per-request transfer
+        self.active_dtype = np.int16 if packed.L < 32767 else np.int32
+        self.code_dtype = packed.table.code_dtype
+        self.pallas_args = None
+        if mesh is not None:
+            # multi-chip: unchunked tensors placed with the (data, policy)
+            # shardings; the engine routes evaluation through the pjit
+            # steps in parallel/mesh.py. No chunked/pallas planes — the
+            # policy axis shards replace the scan chunking.
+            from ..parallel.mesh import shard_codes_tensors
+
+            (
+                self.act_rows_dev,
+                self.W_dev,
+                self.thresh_dev,
+                self.rule_group_dev,
+                self.rule_policy_dev,
+            ) = shard_codes_tensors(
+                mesh,
+                packed.table.rows,
+                jax.numpy.asarray(packed.W, jax.numpy.bfloat16),
+                packed.thresh,
+                packed.rule_group,
+                packed.rule_policy,
+            )
+            return
         kwargs = {"device": device} if device is not None else {}
         W3, thresh_c, group_c, policy_c = chunk_rules(
             packed.W.astype(np.float32), packed.thresh,
@@ -119,13 +149,8 @@ class _CompiledSet:
         self.rule_group_dev = jax.device_put(group_c, **kwargs)
         self.rule_policy_dev = jax.device_put(policy_c, **kwargs)
         self.act_rows_dev = jax.device_put(packed.table.rows, **kwargs)
-        # literal/code ids fit int16 whenever the id space allows — halves
-        # the per-request transfer
-        self.active_dtype = np.int16 if packed.L < 32767 else np.int32
-        self.code_dtype = packed.table.code_dtype
         # optional pallas layout: unchunked [L, R] W + [1, R] rule tensors
         # for the fused match kernel (ops/pallas_match.py)
-        self.pallas_args = None
         if use_pallas:
             from ..ops.pallas_match import pallas_supported
 
@@ -147,11 +172,18 @@ class TPUPolicyEngine:
         schema: Optional[SchemaInfo] = None,
         device=None,
         use_pallas: Optional[bool] = None,
+        mesh=None,
     ):
+        """mesh: an optional jax.sharding.Mesh with ("data", "policy") axes
+        (parallel.mesh.make_mesh). When set, compiled sets are placed with
+        the (data, policy) shardings and every device call routes through
+        the pjit steps — batch rows shard over `data`, the rule matmul over
+        `policy`, with XLA inserting the cross-shard min/max reductions."""
         import os
 
         self.schema = schema or AUTHZ_SCHEMA_INFO
         self.device = device
+        self.mesh = mesh
         if use_pallas is None:
             use_pallas = os.environ.get("CEDAR_TPU_PALLAS", "0") == "1"
         # interpret mode lets the pallas path run (and be tested) on CPU;
@@ -161,9 +193,13 @@ class TPUPolicyEngine:
         self._pallas_interpret = backend == "cpu"
         if use_pallas and backend not in ("cpu", "tpu", "axon"):
             use_pallas = False
+        if mesh is not None:
+            use_pallas = False  # the sharded pjit plane replaces pallas
         self.use_pallas = use_pallas
         self._compiled: Optional[_CompiledSet] = None
         self._lock = threading.Lock()
+        self._mesh_steps: dict = {}  # (n_tiers, has_gate) -> pjit step
+        self._mesh_bits_step = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -183,7 +219,9 @@ class TPUPolicyEngine:
             raise ValueError("TPUPolicyEngine.load: at least one tier required")
         compiled: CompiledPolicies = lower_tiers(list(tiers), self.schema)
         packed = pack(compiled)
-        new = _CompiledSet(packed, self.device, use_pallas=self.use_pallas)
+        new = _CompiledSet(
+            packed, self.device, use_pallas=self.use_pallas, mesh=self.mesh
+        )
         with self._lock:
             self._compiled = new
         if warm == "sync":
@@ -226,6 +264,18 @@ class TPUPolicyEngine:
                     fn(warm_c, warm_e, cs=cs)
             except Exception:  # noqa: BLE001 — warm-up must never take down a swap
                 return
+
+    def _mesh_step(self, packed: PackedPolicySet):
+        """The cached pjit evaluation step for this mesh + set shape."""
+        key = (packed.n_tiers, packed.has_gate)
+        fn = self._mesh_steps.get(key)
+        if fn is None:
+            from ..parallel.mesh import sharded_codes_match_fn
+
+            fn = self._mesh_steps[key] = sharded_codes_match_fn(
+                self.mesh, packed.n_tiers, packed.has_gate
+            )
+        return fn
 
     @property
     def loaded(self) -> bool:
@@ -358,13 +408,23 @@ class TPUPolicyEngine:
         return out
 
     @staticmethod
-    def _pad_to_bucket(chunk_c, chunk_e, pad_L: int, target: Optional[int] = None):
+    def _pad_to_bucket(
+        chunk_c,
+        chunk_e,
+        pad_L: int,
+        target: Optional[int] = None,
+        data_mult: int = 1,
+    ):
         """Pad a (codes, extras) chunk up to the next batch bucket — or to
         an explicit `target` row count (the fixed-shape bits kernel).
         Bucketed shapes keep the jitted executables retrace-free. Extras
-        pad with >= L so padding rows activate nothing."""
+        pad with >= L so padding rows activate nothing. data_mult rounds
+        the row count up to a multiple of the mesh's data axis so the
+        batch shards evenly."""
         m = chunk_c.shape[0]
         B = target if target is not None else _round_bucket(m, _BATCH_BUCKETS)
+        if data_mult > 1:
+            B = -(-B // data_mult) * data_mult
         if B == m:
             return chunk_c, chunk_e
         pc = np.zeros((B, chunk_c.shape[1]), dtype=chunk_c.dtype)
@@ -432,6 +492,25 @@ class TPUPolicyEngine:
         def one(chunk_c, chunk_e):
             """-> (words_dev, full_dev_or_None, pack_dev_or_None)"""
             m = chunk_c.shape[0]
+            if cs.mesh is not None:
+                # multi-chip: the pjit step (parallel/mesh.py) shards the
+                # batch over `data` and the rule matmul over `policy`; the
+                # diagnostics bitsets come from the sharded bits step via
+                # resolve_flagged instead of an in-call payload
+                chunk_c, chunk_e = self._pad_to_bucket(
+                    chunk_c, chunk_e, packed.L,
+                    data_mult=cs.mesh.shape["data"],
+                )
+                w, f, last = self._mesh_step(packed)(
+                    chunk_c,
+                    chunk_e,
+                    cs.act_rows_dev,
+                    cs.W_dev,
+                    cs.thresh_dev,
+                    cs.rule_group_dev,
+                    cs.rule_policy_dev,
+                )
+                return w, ((f, last) if want_full else None), None
             chunk_c, chunk_e = self._pad_to_bucket(chunk_c, chunk_e, packed.L)
             B = chunk_c.shape[0]
             if cs.pallas_args is not None:
@@ -559,8 +638,24 @@ class TPUPolicyEngine:
         codes_arr = codes_arr.astype(cs.code_dtype, copy=False)
         extras_arr = extras_arr.astype(cs.active_dtype, copy=False)
         CH = self._BITS_CHUNK
+        if cs.mesh is not None and self._mesh_bits_step is None:
+            from ..parallel.mesh import sharded_codes_bits_fn
+
+            self._mesh_bits_step = sharded_codes_bits_fn(self.mesh)
 
         def one(chunk_c, chunk_e):
+            if cs.mesh is not None:
+                chunk_c, chunk_e = self._pad_to_bucket(
+                    chunk_c, chunk_e, packed.L, target=CH,
+                    data_mult=cs.mesh.shape["data"],
+                )
+                return self._mesh_bits_step(
+                    chunk_c,
+                    chunk_e,
+                    cs.act_rows_dev,
+                    cs.W_dev,
+                    cs.thresh_dev,
+                )
             chunk_c, chunk_e = self._pad_to_bucket(
                 chunk_c, chunk_e, packed.L, target=CH
             )

@@ -65,6 +65,48 @@ _GATE_RESULTS = {
 Result = Tuple[str, str, Optional[str]]
 
 
+def _rescue_evicted(engine, snap, ctxs, decode_bits) -> None:
+    """Materialize each chunk's async bits fetch (into ctx["_fetched"]),
+    then rescue rows whose cache entry was evicted between launch and
+    resolve with ONE batched fetch (not a serial per-row round trip),
+    decoding straight into the cache so duplicate keys resolve once."""
+    cache = snap.word_cache
+    for ctx in ctxs:
+        fetched: dict = {}
+        if ctx["bits_fin"] is not None:
+            bits = ctx["bits_fin"]()  # launched back in _finish_words
+            for j, k in enumerate(ctx["bits_rows"]):
+                fetched[k] = bits[j]
+        ctx["_fetched"] = fetched
+    sync_rows: list = []
+    sync_keys: set = set()
+    for ctx in ctxs:
+        bm = ctx["bitmap"]
+        for k in ctx["flag_rows"]:
+            if bm and k in bm:
+                continue
+            key = ctx["flag_keys"][k]
+            if key in cache or k in ctx["_fetched"] or key in sync_keys:
+                continue
+            sync_keys.add(key)
+            sync_rows.append((ctx, k, key))
+    if not sync_rows:
+        return
+    packed = snap.cs.packed
+    E = max(ctx["ok_extras"].shape[1] for ctx, _k, _key in sync_rows)
+    codes_rows = np.stack([ctx["ok_codes"][k] for ctx, k, _ in sync_rows])
+    extras_rows = np.full(
+        (len(sync_rows), E), packed.L,
+        dtype=sync_rows[0][0]["ok_extras"].dtype,
+    )
+    for j, (ctx, k, _) in enumerate(sync_rows):
+        row = ctx["ok_extras"][k]
+        extras_rows[j, : row.shape[0]] = row
+    bits = engine.match_bits_arrays(codes_rows, extras_rows, cs=snap.cs)
+    for j, (_ctx, _k, key) in enumerate(sync_rows):
+        cache[key] = decode_bits(bits[j])
+
+
 class _Snapshot(NamedTuple):
     """Immutable (encoder, compiled set, caches) tuple.
 
@@ -375,14 +417,16 @@ class SARFastPath:
         if len(cache) > 200_000:  # adversarial-traffic growth bound;
             cache.clear()  # evict BEFORE the membership checks below
         miss = []
+        miss_keys = set()  # dedupe repeats WITHIN the chunk too
         fkeys = ctx["flag_keys"] = {}
         for k in ctx["flag_rows"]:
             if bitmap and k in bitmap:
                 continue
             key = ok_codes[k].tobytes() + ok_extras[k].tobytes()
             fkeys[k] = key
-            if key not in cache:
+            if key not in cache and key not in miss_keys:
                 miss.append(k)
+                miss_keys.add(key)
         if miss:
             ctx["bits_rows"] = miss
             ctx["bits_fin"] = self.engine.match_bits_arrays_launch(
@@ -432,14 +476,11 @@ class SARFastPath:
             )
             return self._map_decision(decision, diag)
 
+        _rescue_evicted(self.engine, snap, ctxs, decode_bits)
         for ctx in ctxs:
             if not ctx["flag_rows"]:
                 continue
-            fetched: dict = {}
-            if ctx["bits_fin"] is not None:
-                bits = ctx["bits_fin"]()  # launched back in _finish_words
-                for j, k in enumerate(ctx["bits_rows"]):
-                    fetched[k] = bits[j]
+            fetched = ctx.get("_fetched") or {}
             bm = ctx["bitmap"]
             fkeys = ctx["flag_keys"]
             for k in ctx["flag_rows"]:
@@ -449,14 +490,6 @@ class SARFastPath:
                     key = fkeys[k]
                     r = cache.get(key)
                     if r is None:
-                        if k not in fetched:
-                            # cache entry evicted between launch and
-                            # resolve (concurrent caller): fetch now
-                            fetched[k] = self.engine.match_bits_arrays(
-                                ctx["ok_codes"][k : k + 1],
-                                ctx["ok_extras"][k : k + 1],
-                                cs=snap.cs,
-                            )[0]
                         r = cache[key] = decode_bits(fetched[k])
                 ctx["results"][int(ctx["idx"][k])] = r
 
@@ -755,14 +788,16 @@ class AdmissionFastPath:
         if len(cache) > 200_000:  # adversarial-traffic growth bound;
             cache.clear()  # evict BEFORE the membership checks below
         miss = []
+        miss_keys = set()  # dedupe repeats WITHIN the chunk too
         fkeys = ctx["flag_keys"]
         for k in ctx["flag_rows"]:
             if bitmap and k in bitmap:
                 continue
             key = ok_codes[k].tobytes() + ok_extras[k].tobytes()
             fkeys[k] = key
-            if key not in cache:
+            if key not in cache and key not in miss_keys:
                 miss.append(k)
+                miss_keys.add(key)
         if miss:
             ctx["bits_rows"] = miss
             ctx["bits_fin"] = self.engine.match_bits_arrays_launch(
@@ -819,14 +854,11 @@ class AdmissionFastPath:
                 return (False, "")
             return (True, "")
 
+        _rescue_evicted(self.engine, snap, ctxs, decode_bits)
         for ctx in ctxs:
             if not ctx["flag_rows"]:
                 continue
-            fetched: dict = {}
-            if ctx["bits_fin"] is not None:
-                bits = ctx["bits_fin"]()  # launched back in _finish_words
-                for j, k in enumerate(ctx["bits_rows"]):
-                    fetched[k] = bits[j]
+            fetched = ctx.get("_fetched") or {}
             bm = ctx["bitmap"]
             fkeys = ctx["flag_keys"]
             for k in ctx["flag_rows"]:
@@ -836,13 +868,6 @@ class AdmissionFastPath:
                     key = fkeys[k]
                     payload = cache.get(key)
                     if payload is None:
-                        if k not in fetched:
-                            # evicted between launch and resolve: fetch now
-                            fetched[k] = self.engine.match_bits_arrays(
-                                ctx["ok_codes"][k : k + 1],
-                                ctx["ok_extras"][k : k + 1],
-                                cs=snap.cs,
-                            )[0]
                         payload = cache[key] = decode_bits(fetched[k])
                 i = int(ctx["idx"][k])
                 ctx["results"][i] = AdmissionResponse(

@@ -37,25 +37,34 @@ from ..ops.match import (
 
 def make_mesh(
     n_devices: Optional[int] = None,
-    data_parallel: Optional[int] = None,
+    shape: Optional[Tuple[int, int]] = None,
 ) -> Mesh:
-    """Build a (data, policy) mesh over the first n_devices devices.
+    """Build a (data, policy) mesh.
 
-    data_parallel defaults to a balanced split: enough data-parallel groups
-    to keep batch latency low while the policy axis splits the rule matmul.
+    ``shape`` is the EXPLICIT (data_parallel, policy_parallel)
+    factorization — the deployment chooses it from its workload (wide
+    batches want data shards; huge policy sets want rule shards). When
+    omitted, every device goes to the policy axis: the rule dimension
+    (R ~ policies x clauses) is the axis that outgrows one chip first,
+    batch data parallelism is already amortized by micro-batching, and a
+    policy-only split needs no cross-shard reduction of the request axis.
     """
     devices = jax.devices()
     if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"mesh needs {n_devices} devices, have {len(devices)}"
+            )
         devices = devices[:n_devices]
     n = len(devices)
-    if data_parallel is None:
-        # favor policy parallelism; data axis gets the leftover factor
-        data_parallel = 1
-        for cand in (4, 2, 1):
-            if n % cand == 0 and n // cand >= 1:
-                data_parallel = cand if n >= 4 else 1
-                break
-    policy_parallel = n // data_parallel
+    if shape is None:
+        shape = (1, n)
+    data_parallel, policy_parallel = shape
+    if data_parallel * policy_parallel != n:
+        raise ValueError(
+            f"mesh shape {shape} needs {data_parallel * policy_parallel} "
+            f"devices, have {n}"
+        )
     arr = np.array(devices).reshape(data_parallel, policy_parallel)
     return Mesh(arr, ("data", "policy"))
 
@@ -120,20 +129,25 @@ def shard_codes_tensors(mesh: Mesh, act_rows, W, thresh, rule_group, rule_policy
     )
 
 
-def sharded_codes_match_fn(mesh: Mesh, n_tiers: int):
+def sharded_codes_match_fn(mesh: Mesh, n_tiers: int, has_gate: bool = False):
     """The production evaluation step, sharded: feature codes in, packed
-    uint32 verdict words out.
+    uint32 verdict words out. This is the step TPUPolicyEngine.match_arrays
+    routes through when the engine owns a mesh.
 
     - codes/extras shard over ``data`` (batch parallelism);
     - W [L, R] + rule tensors shard over ``policy`` (rule parallelism);
-    - each shard computes its local per-(tier, effect) first-match minima;
-      the cross-shard combine is a min all-reduce XLA inserts from the
-      sharding annotations — first-match is a min-reduction, so
+    - each shard computes its local per-(tier, effect) first/last-match
+      extrema; the cross-shard combine is a min/max all-reduce XLA inserts
+      from the sharding annotations — first-match is a min-reduction, so
       shard-and-reduce is exact;
-    - the tier walk runs on the replicated [B, G] minima, and the readback
+    - the tier walk runs on the replicated [B, G] extrema, and the readback
       is 4 bytes per request, sharded over data.
-    """
-    G = n_tiers * 3
+
+    Returns (packed words [B], (first [B, G], last [B, G])) — the same
+    surface as ops.match.match_rules_codes(want_full=True); has_gate adds
+    the fallback-scope gate column and the WORD_GATE bit exactly like the
+    single-device kernel."""
+    G = n_tiers * 3 + (1 if has_gate else 0)
     in_shardings = (
         NamedSharding(mesh, P("data", None)),  # codes [B, S]
         NamedSharding(mesh, P("data", None)),  # extras [B, E]
@@ -146,6 +160,7 @@ def sharded_codes_match_fn(mesh: Mesh, n_tiers: int):
     out_shardings = (
         NamedSharding(mesh, P("data")),  # packed words [B]
         NamedSharding(mesh, P("data", None)),  # first [B, G]
+        NamedSharding(mesh, P("data", None)),  # last [B, G]
     )
 
     @functools.partial(
@@ -175,6 +190,40 @@ def sharded_codes_match_fn(mesh: Mesh, n_tiers: int):
             )
         first = jnp.stack(firsts, axis=1)  # [B, G] replicated on policy
         last = jnp.stack(lasts, axis=1)
-        return _tier_walk(first, last, n_tiers), first
+        packed = _tier_walk(first, last, n_tiers)
+        if has_gate:
+            gate = (first[:, n_tiers * 3] != INT32_MAX).astype(jnp.uint32)
+            packed = packed | (gate << 27)
+        return packed, first, last
+
+    return step
+
+
+def sharded_codes_bits_fn(mesh: Mesh):
+    """Sharded twin of ops.match.match_rules_codes_bits: per-rule
+    satisfaction bitsets [B, R // 32] for diagnostic rendering. Each shard
+    packs its contiguous rule range; the output sharding along the rule-word
+    axis makes the host concatenation implicit."""
+    from ..ops.match import _pack_sat_bits
+
+    in_shardings = (
+        NamedSharding(mesh, P("data", None)),  # codes
+        NamedSharding(mesh, P("data", None)),  # extras
+        NamedSharding(mesh, P(None, None)),  # act_rows
+        NamedSharding(mesh, P(None, "policy")),  # W
+        NamedSharding(mesh, P("policy")),  # thresh
+    )
+    out_shardings = NamedSharding(mesh, P("data", "policy"))
+
+    @functools.partial(
+        jax.jit, in_shardings=in_shardings, out_shardings=out_shardings
+    )
+    def step(codes, extras, act_rows, W, thresh):
+        lit = _lit_matrix_codes(codes, extras, act_rows)
+        scores = jnp.dot(
+            lit, W.astype(jnp.bfloat16), preferred_element_type=jnp.float32
+        )
+        sat = scores >= thresh[None, :]
+        return _pack_sat_bits(sat)
 
     return step
