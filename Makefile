@@ -8,7 +8,10 @@ IMAGE ?= cedar-tpu-webhook:latest
 # mounted reference snapshot; point FIXTURES at any directory of
 # <api>.schema.json/<api>.resourcelist.json recordings (or at a live
 # cluster's recordings) elsewhere.
-FIXTURES ?= /root/reference/internal/schema/convert/testdata
+# ":"-separated fixture directories: the reference's four recorded groups
+# (core/apps/authentication/rbac) + this repo's generated fixtures for the
+# remaining API groups (tools/gen_openapi_fixtures.py)
+FIXTURES ?= /root/reference/internal/schema/convert/testdata:tests/testdata/openapi
 CERT_DIR ?= mount/certs
 
 .PHONY: all
@@ -42,10 +45,12 @@ graft-check: ## Compile-check the jittable entry + multi-chip dry run
 
 .PHONY: generate-schemas
 generate-schemas: ## Regenerate cedarschema/ artifacts
-	@test -d $(FIXTURES) || { \
-	  echo "FIXTURES=$(FIXTURES) not found; point FIXTURES at a directory" \
-	       "of recorded OpenAPI <api>.schema.json/<api>.resourcelist.json"; \
-	  exit 1; }
+	@for d in $$(echo "$(FIXTURES)" | tr ':' ' '); do \
+	  test -d $$d || { \
+	    echo "fixture dir $$d not found; point FIXTURES at ':'-separated" \
+	         "directories of <api>.schema.json/<api>.resourcelist.json"; \
+	    exit 1; }; \
+	done
 	$(PYTHON) -m cedar_tpu.cli.schema_generator --no-admission \
 	    --format cedarschema --output cedarschema/k8s-authorization.cedarschema
 	$(PYTHON) -m cedar_tpu.cli.schema_generator --no-admission \

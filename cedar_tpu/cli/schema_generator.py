@@ -63,8 +63,19 @@ def generate_schema(
         k8s.add_admission_actions(schema, action_ns, authorization_ns)
 
         if openapi_dir:
-            root = pathlib.Path(openapi_dir)
-            specs = sorted(root.glob("*.schema.json"))
+            # ":"-separated list of fixture directories. First writer wins
+            # per namespace type, and EARLIER directories process first —
+            # list the richest recordings first; later directories only
+            # extend the namespace set
+            specs = []
+            for d in str(openapi_dir).split(":"):
+                if d:
+                    specs.extend(
+                        sorted(
+                            pathlib.Path(d).glob("*.schema.json"),
+                            key=lambda p: p.name,
+                        )
+                    )
             for spec_path in specs:
                 name = spec_path.name[: -len(".schema.json")]
                 group, version = api_path_to_group_version(name)

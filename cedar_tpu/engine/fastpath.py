@@ -65,33 +65,35 @@ _GATE_RESULTS = {
 Result = Tuple[str, str, Optional[str]]
 
 
-def _rescue_evicted(engine, snap, ctxs, decode_bits) -> None:
-    """Materialize each chunk's async bits fetch (into ctx["_fetched"]),
-    then rescue rows whose cache entry was evicted between launch and
-    resolve with ONE batched fetch (not a serial per-row round trip),
-    decoding straight into the cache so duplicate keys resolve once."""
+def _gather_flag_bits(engine, snap, ctxs) -> dict:
+    """Materialize each chunk's async bits fetch and return {feature key:
+    bitset row} for EVERY flagged row that is not covered by an in-call
+    bitmap or a launch-time cache-value snapshot (ctx["flag_cached"]) —
+    duplicate keys within/across chunks share one entry, and rows whose
+    cache entry was evicted between launch and resolve are rescued with
+    ONE extra batched fetch (never a serial per-row round trip)."""
     cache = snap.word_cache
+    key_bits: dict = {}
     for ctx in ctxs:
-        fetched: dict = {}
         if ctx["bits_fin"] is not None:
             bits = ctx["bits_fin"]()  # launched back in _finish_words
+            fkeys = ctx["flag_keys"]
             for j, k in enumerate(ctx["bits_rows"]):
-                fetched[k] = bits[j]
-        ctx["_fetched"] = fetched
+                key_bits[fkeys[k]] = bits[j]
     sync_rows: list = []
-    sync_keys: set = set()
     for ctx in ctxs:
         bm = ctx["bitmap"]
+        fc = ctx["flag_cached"]
         for k in ctx["flag_rows"]:
-            if bm and k in bm:
+            if (bm and k in bm) or k in fc:
                 continue
             key = ctx["flag_keys"][k]
-            if key in cache or k in ctx["_fetched"] or key in sync_keys:
+            if key in key_bits or key in cache:
                 continue
-            sync_keys.add(key)
+            key_bits[key] = None  # claimed; filled below
             sync_rows.append((ctx, k, key))
     if not sync_rows:
-        return
+        return key_bits
     packed = snap.cs.packed
     E = max(ctx["ok_extras"].shape[1] for ctx, _k, _key in sync_rows)
     codes_rows = np.stack([ctx["ok_codes"][k] for ctx, k, _ in sync_rows])
@@ -104,7 +106,8 @@ def _rescue_evicted(engine, snap, ctxs, decode_bits) -> None:
         extras_rows[j, : row.shape[0]] = row
     bits = engine.match_bits_arrays(codes_rows, extras_rows, cs=snap.cs)
     for j, (_ctx, _k, key) in enumerate(sync_rows):
-        cache[key] = decode_bits(bits[j])
+        key_bits[key] = bits[j]
+    return key_bits
 
 
 class _Snapshot(NamedTuple):
@@ -386,6 +389,7 @@ class SARFastPath:
             "gate_rows": [],
             "flag_rows": [],
             "flag_keys": {},
+            "flag_cached": {},
             "bits_rows": [],
             "bits_fin": None,
         }
@@ -419,12 +423,18 @@ class SARFastPath:
         miss = []
         miss_keys = set()  # dedupe repeats WITHIN the chunk too
         fkeys = ctx["flag_keys"] = {}
+        fc = ctx["flag_cached"]
         for k in ctx["flag_rows"]:
             if bitmap and k in bitmap:
                 continue
             key = ok_codes[k].tobytes() + ok_extras[k].tobytes()
             fkeys[k] = key
-            if key not in cache and key not in miss_keys:
+            cached = cache.get(key)
+            if cached is not None:
+                # snapshot the VALUE now: a concurrent eviction between
+                # launch and resolve must not strand the row
+                fc[k] = cached
+            elif key not in miss_keys:
                 miss.append(k)
                 miss_keys.add(key)
         if miss:
@@ -476,21 +486,23 @@ class SARFastPath:
             )
             return self._map_decision(decision, diag)
 
-        _rescue_evicted(self.engine, snap, ctxs, decode_bits)
+        key_bits = _gather_flag_bits(self.engine, snap, ctxs)
         for ctx in ctxs:
             if not ctx["flag_rows"]:
                 continue
-            fetched = ctx.get("_fetched") or {}
             bm = ctx["bitmap"]
+            fc = ctx["flag_cached"]
             fkeys = ctx["flag_keys"]
             for k in ctx["flag_rows"]:
                 if bm and k in bm:
                     r = decode_bits(bm[k])
+                elif k in fc:
+                    r = fc[k]
                 else:
                     key = fkeys[k]
                     r = cache.get(key)
                     if r is None:
-                        r = cache[key] = decode_bits(fetched[k])
+                        r = cache[key] = decode_bits(key_bits[key])
                 ctx["results"][int(ctx["idx"][k])] = r
 
     def _decode_word(self, snap: _Snapshot, word: int) -> Result:
@@ -765,6 +777,7 @@ class AdmissionFastPath:
             "gate_rows": [],
             "flag_rows": [],
             "flag_keys": {},
+            "flag_cached": {},
             "bits_rows": [],
             "bits_fin": None,
         }
@@ -790,12 +803,16 @@ class AdmissionFastPath:
         miss = []
         miss_keys = set()  # dedupe repeats WITHIN the chunk too
         fkeys = ctx["flag_keys"]
+        fc = ctx["flag_cached"]
         for k in ctx["flag_rows"]:
             if bitmap and k in bitmap:
                 continue
             key = ok_codes[k].tobytes() + ok_extras[k].tobytes()
             fkeys[k] = key
-            if key not in cache and key not in miss_keys:
+            cached = cache.get(key)
+            if cached is not None:
+                fc[k] = cached  # value snapshot: immune to eviction races
+            elif key not in miss_keys:
                 miss.append(k)
                 miss_keys.add(key)
         if miss:
@@ -854,21 +871,23 @@ class AdmissionFastPath:
                 return (False, "")
             return (True, "")
 
-        _rescue_evicted(self.engine, snap, ctxs, decode_bits)
+        key_bits = _gather_flag_bits(self.engine, snap, ctxs)
         for ctx in ctxs:
             if not ctx["flag_rows"]:
                 continue
-            fetched = ctx.get("_fetched") or {}
             bm = ctx["bitmap"]
+            fc = ctx["flag_cached"]
             fkeys = ctx["flag_keys"]
             for k in ctx["flag_rows"]:
                 if bm and k in bm:
                     payload = decode_bits(bm[k])
+                elif k in fc:
+                    payload = fc[k]
                 else:
                     key = fkeys[k]
                     payload = cache.get(key)
                     if payload is None:
-                        payload = cache[key] = decode_bits(fetched[k])
+                        payload = cache[key] = decode_bits(key_bits[key])
                 i = int(ctx["idx"][k])
                 ctx["results"][i] = AdmissionResponse(
                     uid=ctx["uids"][i],

@@ -1,0 +1,1178 @@
+"""Generate recorded OpenAPI v3 fixtures for the API groups the reference's
+in-tree testdata does not cover.
+
+The reference generated cedarschema/k8s-full.cedarschema.json from a LIVE
+cluster's /openapi/v3 (cmd/schema-generator/main.go:113-137); its committed
+testdata has only four groups (core, apps, authentication, rbac). To make
+`make schemas` reproducible offline for the FULL namespace set, this tool
+emits `<api>.schema.json` + `<api>.resourcelist.json` fixture pairs for the
+remaining groups into tests/testdata/openapi/, written from the public
+Kubernetes API type definitions (field names/types are k8s API facts; the
+shapes here carry the fields admission policies actually reach — deep
+status plumbing is trimmed).
+
+Usage: python tools/gen_openapi_fixtures.py [outdir]
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+S = {"type": "string"}
+I = {"type": "integer", "format": "int32"}
+B = {"type": "boolean"}
+
+
+def ref(name: str) -> dict:
+    return {"allOf": [{"$ref": f"#/components/schemas/{name}"}]}
+
+
+def arr(item: dict) -> dict:
+    return {"type": "array", "items": item}
+
+
+def arr_ref(name: str) -> dict:
+    return {"type": "array", "items": {"$ref": f"#/components/schemas/{name}"}}
+
+
+def strmap() -> dict:
+    return {"type": "object", "additionalProperties": {"type": "string"}}
+
+
+def strslicemap() -> dict:
+    return {
+        "type": "object",
+        "additionalProperties": {"type": "array", "items": {"type": "string"}},
+    }
+
+
+def obj(**props) -> dict:
+    return {"type": "object", "properties": props}
+
+
+META = "io.k8s.apimachinery.pkg.apis.meta.v1."
+
+
+def top(pkg: str, kind: str, **spec_like) -> dict:
+    """A top-level API object: apiVersion/kind/metadata + extra fields."""
+    props = {
+        "apiVersion": S,
+        "kind": S,
+        "metadata": {"default": {}, "allOf": [{"$ref": f"#/components/schemas/{META}ObjectMeta"}]},
+    }
+    props.update(spec_like)
+    return {"type": "object", "properties": props}
+
+
+def apimachinery() -> dict:
+    """The meta::v1 types fixtures reference. Emitted into every document
+    (real /openapi/v3 documents embed them too); the schema generator's
+    first-writer-wins rule keeps the core document's richer versions."""
+    return {
+        META + "ObjectMeta": obj(
+            annotations=strmap(),
+            creationTimestamp=ref(META + "Time"),
+            deletionGracePeriodSeconds={"type": "integer", "format": "int64"},
+            deletionTimestamp=ref(META + "Time"),
+            finalizers=arr(S),
+            generateName=S,
+            generation={"type": "integer", "format": "int64"},
+            labels=strmap(),
+            managedFields=arr_ref(META + "ManagedFieldsEntry"),
+            name=S,
+            namespace=S,
+            ownerReferences=arr_ref(META + "OwnerReference"),
+            resourceVersion=S,
+            selfLink=S,
+            uid=S,
+        ),
+        META + "ManagedFieldsEntry": obj(
+            apiVersion=S,
+            fieldsType=S,
+            fieldsV1=ref(META + "FieldsV1"),
+            manager=S,
+            operation=S,
+            subresource=S,
+            time=ref(META + "Time"),
+        ),
+        META + "FieldsV1": {"type": "object"},
+        META + "OwnerReference": obj(
+            apiVersion=S,
+            blockOwnerDeletion=B,
+            controller=B,
+            kind=S,
+            name=S,
+            uid=S,
+        ),
+        META + "Time": {"type": "string", "format": "date-time"},
+        META + "MicroTime": {"type": "string", "format": "date-time"},
+        META + "LabelSelector": obj(
+            matchExpressions=arr_ref(META + "LabelSelectorRequirement"),
+            matchLabels=strmap(),
+        ),
+        META + "LabelSelectorRequirement": obj(
+            key=S, operator=S, values=arr(S)
+        ),
+        META + "FieldSelectorRequirement": obj(
+            key=S, operator=S, values=arr(S)
+        ),
+        META + "ListMeta": obj(
+            **{
+                "continue": S,
+                "remainingItemCount": {"type": "integer", "format": "int64"},
+                "resourceVersion": S,
+                "selfLink": S,
+            }
+        ),
+        META + "Condition": obj(
+            lastTransitionTime=ref(META + "Time"),
+            message=S,
+            observedGeneration={"type": "integer", "format": "int64"},
+            reason=S,
+            status=S,
+            type=S,
+        ),
+    }
+
+
+def group_doc(schemas: dict) -> dict:
+    merged = dict(apimachinery())
+    merged.update(schemas)
+    return {
+        "openapi": "3.0.0",
+        "info": {"title": "Kubernetes", "version": "unversioned"},
+        "paths": {},
+        "components": {"schemas": merged},
+    }
+
+
+def rlist(group_version: str, resources: list) -> dict:
+    out = []
+    for name, kind, namespaced, verbs in resources:
+        out.append(
+            {
+                "name": name,
+                "singularName": name.rstrip("s"),
+                "namespaced": namespaced,
+                "kind": kind,
+                "verbs": verbs,
+            }
+        )
+    return {
+        "kind": "APIResourceList",
+        "apiVersion": "v1",
+        "groupVersion": group_version,
+        "resources": out,
+    }
+
+
+ALL_VERBS = [
+    "create", "delete", "deletecollection", "get", "list", "patch",
+    "update", "watch",
+]
+
+FIXTURES: dict = {}
+
+
+def fixture(api_path: str, group_version: str, resources, schemas):
+    FIXTURES[api_path] = (group_doc(schemas), rlist(group_version, resources))
+
+
+# -------------------------------------------------- admissionregistration/v1
+_ADM = "io.k8s.api.admissionregistration.v1."
+fixture(
+    "apis.admissionregistration.k8s.io.v1",
+    "admissionregistration.k8s.io/v1",
+    [
+        ("mutatingwebhookconfigurations", "MutatingWebhookConfiguration", False, ALL_VERBS),
+        ("validatingwebhookconfigurations", "ValidatingWebhookConfiguration", False, ALL_VERBS),
+        ("validatingadmissionpolicies", "ValidatingAdmissionPolicy", False, ALL_VERBS),
+        ("validatingadmissionpolicybindings", "ValidatingAdmissionPolicyBinding", False, ALL_VERBS),
+    ],
+    {
+        _ADM + "MutatingWebhookConfiguration": top(
+            _ADM, "MutatingWebhookConfiguration",
+            webhooks=arr_ref(_ADM + "MutatingWebhook"),
+        ),
+        _ADM + "ValidatingWebhookConfiguration": top(
+            _ADM, "ValidatingWebhookConfiguration",
+            webhooks=arr_ref(_ADM + "ValidatingWebhook"),
+        ),
+        _ADM + "ValidatingAdmissionPolicy": top(
+            _ADM, "ValidatingAdmissionPolicy",
+            spec=ref(_ADM + "ValidatingAdmissionPolicySpec"),
+            status=ref(_ADM + "ValidatingAdmissionPolicyStatus"),
+        ),
+        _ADM + "ValidatingAdmissionPolicyBinding": top(
+            _ADM, "ValidatingAdmissionPolicyBinding",
+            spec=ref(_ADM + "ValidatingAdmissionPolicyBindingSpec"),
+        ),
+        _ADM + "MutatingWebhook": obj(
+            admissionReviewVersions=arr(S),
+            clientConfig=ref(_ADM + "WebhookClientConfig"),
+            failurePolicy=S,
+            matchConditions=arr_ref(_ADM + "MatchCondition"),
+            matchPolicy=S,
+            name=S,
+            namespaceSelector=ref(META + "LabelSelector"),
+            objectSelector=ref(META + "LabelSelector"),
+            reinvocationPolicy=S,
+            rules=arr_ref(_ADM + "RuleWithOperations"),
+            sideEffects=S,
+            timeoutSeconds=I,
+        ),
+        _ADM + "ValidatingWebhook": obj(
+            admissionReviewVersions=arr(S),
+            clientConfig=ref(_ADM + "WebhookClientConfig"),
+            failurePolicy=S,
+            matchConditions=arr_ref(_ADM + "MatchCondition"),
+            matchPolicy=S,
+            name=S,
+            namespaceSelector=ref(META + "LabelSelector"),
+            objectSelector=ref(META + "LabelSelector"),
+            rules=arr_ref(_ADM + "RuleWithOperations"),
+            sideEffects=S,
+            timeoutSeconds=I,
+        ),
+        _ADM + "WebhookClientConfig": obj(
+            caBundle=S, service=ref(_ADM + "ServiceReference"), url=S
+        ),
+        _ADM + "ServiceReference": obj(name=S, namespace=S, path=S, port=I),
+        _ADM + "RuleWithOperations": obj(
+            apiGroups=arr(S),
+            apiVersions=arr(S),
+            operations=arr(S),
+            resources=arr(S),
+            scope=S,
+        ),
+        _ADM + "MatchCondition": obj(expression=S, name=S),
+        _ADM + "ValidatingAdmissionPolicySpec": obj(
+            auditAnnotations=arr_ref(_ADM + "AuditAnnotation"),
+            failurePolicy=S,
+            matchConditions=arr_ref(_ADM + "MatchCondition"),
+            matchConstraints=ref(_ADM + "MatchResources"),
+            paramKind=ref(_ADM + "ParamKind"),
+            validations=arr_ref(_ADM + "Validation"),
+            variables=arr_ref(_ADM + "Variable"),
+        ),
+        _ADM + "ValidatingAdmissionPolicyStatus": obj(
+            conditions=arr_ref(META + "Condition"),
+            observedGeneration={"type": "integer", "format": "int64"},
+            typeChecking=ref(_ADM + "TypeChecking"),
+        ),
+        _ADM + "ValidatingAdmissionPolicyBindingSpec": obj(
+            matchResources=ref(_ADM + "MatchResources"),
+            paramRef=ref(_ADM + "ParamRef"),
+            policyName=S,
+            validationActions=arr(S),
+        ),
+        _ADM + "MatchResources": obj(
+            excludeResourceRules=arr_ref(_ADM + "NamedRuleWithOperations"),
+            matchPolicy=S,
+            namespaceSelector=ref(META + "LabelSelector"),
+            objectSelector=ref(META + "LabelSelector"),
+            resourceRules=arr_ref(_ADM + "NamedRuleWithOperations"),
+        ),
+        _ADM + "NamedRuleWithOperations": obj(
+            apiGroups=arr(S),
+            apiVersions=arr(S),
+            operations=arr(S),
+            resourceNames=arr(S),
+            resources=arr(S),
+            scope=S,
+        ),
+        _ADM + "ParamKind": obj(apiVersion=S, kind=S),
+        _ADM + "ParamRef": obj(
+            name=S,
+            namespace=S,
+            parameterNotFoundAction=S,
+            selector=ref(META + "LabelSelector"),
+        ),
+        _ADM + "Validation": obj(
+            expression=S, message=S, messageExpression=S, reason=S
+        ),
+        _ADM + "Variable": obj(expression=S, name=S),
+        _ADM + "AuditAnnotation": obj(key=S, valueExpression=S),
+        _ADM + "TypeChecking": obj(
+            expressionWarnings=arr_ref(_ADM + "ExpressionWarning")
+        ),
+        _ADM + "ExpressionWarning": obj(fieldRef=S, warning=S),
+    },
+)
+
+# ----------------------------------------------------------- authorization/v1
+_AUTHZ = "io.k8s.api.authorization.v1."
+_authz_common = {
+    _AUTHZ + "ResourceAttributes": obj(
+        fieldSelector=ref(_AUTHZ + "FieldSelectorAttributes"),
+        group=S,
+        labelSelector=ref(_AUTHZ + "LabelSelectorAttributes"),
+        name=S,
+        namespace=S,
+        resource=S,
+        subresource=S,
+        verb=S,
+        version=S,
+    ),
+    _AUTHZ + "NonResourceAttributes": obj(path=S, verb=S),
+    _AUTHZ + "FieldSelectorAttributes": obj(
+        rawSelector=S,
+        requirements=arr_ref(META + "FieldSelectorRequirement"),
+    ),
+    _AUTHZ + "LabelSelectorAttributes": obj(
+        rawSelector=S,
+        requirements=arr_ref(META + "LabelSelectorRequirement"),
+    ),
+    _AUTHZ + "SubjectAccessReviewSpec": obj(
+        extra=strslicemap(),
+        groups=arr(S),
+        nonResourceAttributes=ref(_AUTHZ + "NonResourceAttributes"),
+        resourceAttributes=ref(_AUTHZ + "ResourceAttributes"),
+        uid=S,
+        user=S,
+    ),
+    _AUTHZ + "SelfSubjectAccessReviewSpec": obj(
+        nonResourceAttributes=ref(_AUTHZ + "NonResourceAttributes"),
+        resourceAttributes=ref(_AUTHZ + "ResourceAttributes"),
+    ),
+    _AUTHZ + "SubjectAccessReviewStatus": obj(
+        allowed=B, denied=B, evaluationError=S, reason=S
+    ),
+    _AUTHZ + "SelfSubjectRulesReviewSpec": obj(namespace=S),
+    _AUTHZ + "SubjectRulesReviewStatus": obj(
+        evaluationError=S,
+        incomplete=B,
+        nonResourceRules=arr_ref(_AUTHZ + "NonResourceRule"),
+        resourceRules=arr_ref(_AUTHZ + "ResourceRule"),
+    ),
+    _AUTHZ + "NonResourceRule": obj(nonResourceURLs=arr(S), verbs=arr(S)),
+    _AUTHZ + "ResourceRule": obj(
+        apiGroups=arr(S), resourceNames=arr(S), resources=arr(S), verbs=arr(S)
+    ),
+}
+fixture(
+    "apis.authorization.k8s.io.v1",
+    "authorization.k8s.io/v1",
+    [
+        ("localsubjectaccessreviews", "LocalSubjectAccessReview", True, ["create"]),
+        ("selfsubjectaccessreviews", "SelfSubjectAccessReview", False, ["create"]),
+        ("selfsubjectrulesreviews", "SelfSubjectRulesReview", False, ["create"]),
+        ("subjectaccessreviews", "SubjectAccessReview", False, ["create"]),
+    ],
+    {
+        _AUTHZ + "SubjectAccessReview": top(
+            _AUTHZ, "SubjectAccessReview",
+            spec=ref(_AUTHZ + "SubjectAccessReviewSpec"),
+            status=ref(_AUTHZ + "SubjectAccessReviewStatus"),
+        ),
+        _AUTHZ + "LocalSubjectAccessReview": top(
+            _AUTHZ, "LocalSubjectAccessReview",
+            spec=ref(_AUTHZ + "SubjectAccessReviewSpec"),
+            status=ref(_AUTHZ + "SubjectAccessReviewStatus"),
+        ),
+        _AUTHZ + "SelfSubjectAccessReview": top(
+            _AUTHZ, "SelfSubjectAccessReview",
+            spec=ref(_AUTHZ + "SelfSubjectAccessReviewSpec"),
+            status=ref(_AUTHZ + "SubjectAccessReviewStatus"),
+        ),
+        _AUTHZ + "SelfSubjectRulesReview": top(
+            _AUTHZ, "SelfSubjectRulesReview",
+            spec=ref(_AUTHZ + "SelfSubjectRulesReviewSpec"),
+            status=ref(_AUTHZ + "SubjectRulesReviewStatus"),
+        ),
+        **_authz_common,
+    },
+)
+
+# -------------------------------------------------------------- autoscaling/v2
+_AS = "io.k8s.api.autoscaling.v2."
+fixture(
+    "apis.autoscaling.v2",
+    "autoscaling/v2",
+    [("horizontalpodautoscalers", "HorizontalPodAutoscaler", True, ALL_VERBS)],
+    {
+        _AS + "HorizontalPodAutoscaler": top(
+            _AS, "HorizontalPodAutoscaler",
+            spec=ref(_AS + "HorizontalPodAutoscalerSpec"),
+            status=ref(_AS + "HorizontalPodAutoscalerStatus"),
+        ),
+        _AS + "HorizontalPodAutoscalerSpec": obj(
+            behavior=ref(_AS + "HorizontalPodAutoscalerBehavior"),
+            maxReplicas=I,
+            metrics=arr_ref(_AS + "MetricSpec"),
+            minReplicas=I,
+            scaleTargetRef=ref(_AS + "CrossVersionObjectReference"),
+        ),
+        _AS + "HorizontalPodAutoscalerStatus": obj(
+            conditions=arr_ref(_AS + "HorizontalPodAutoscalerCondition"),
+            currentMetrics=arr_ref(_AS + "MetricStatus"),
+            currentReplicas=I,
+            desiredReplicas=I,
+            lastScaleTime=ref(META + "Time"),
+            observedGeneration={"type": "integer", "format": "int64"},
+        ),
+        _AS + "HorizontalPodAutoscalerBehavior": obj(
+            scaleDown=ref(_AS + "HPAScalingRules"),
+            scaleUp=ref(_AS + "HPAScalingRules"),
+        ),
+        _AS + "HPAScalingRules": obj(
+            policies=arr_ref(_AS + "HPAScalingPolicy"),
+            selectPolicy=S,
+            stabilizationWindowSeconds=I,
+        ),
+        _AS + "HPAScalingPolicy": obj(periodSeconds=I, type=S, value=I),
+        _AS + "CrossVersionObjectReference": obj(apiVersion=S, kind=S, name=S),
+        _AS + "MetricSpec": obj(
+            containerResource=ref(_AS + "ContainerResourceMetricSource"),
+            external=ref(_AS + "ExternalMetricSource"),
+            object=ref(_AS + "ObjectMetricSource"),
+            pods=ref(_AS + "PodsMetricSource"),
+            resource=ref(_AS + "ResourceMetricSource"),
+            type=S,
+        ),
+        _AS + "MetricStatus": obj(
+            containerResource=ref(_AS + "ContainerResourceMetricStatus"),
+            external=ref(_AS + "ExternalMetricStatus"),
+            object=ref(_AS + "ObjectMetricStatus"),
+            pods=ref(_AS + "PodsMetricStatus"),
+            resource=ref(_AS + "ResourceMetricStatus"),
+            type=S,
+        ),
+        _AS + "MetricTarget": obj(
+            averageUtilization=I, averageValue=S, type=S, value=S
+        ),
+        _AS + "MetricValueStatus": obj(
+            averageUtilization=I, averageValue=S, value=S
+        ),
+        _AS + "MetricIdentifier": obj(
+            name=S, selector=ref(META + "LabelSelector")
+        ),
+        _AS + "ResourceMetricSource": obj(
+            name=S, target=ref(_AS + "MetricTarget")
+        ),
+        _AS + "ResourceMetricStatus": obj(
+            current=ref(_AS + "MetricValueStatus"), name=S
+        ),
+        _AS + "ContainerResourceMetricSource": obj(
+            container=S, name=S, target=ref(_AS + "MetricTarget")
+        ),
+        _AS + "ContainerResourceMetricStatus": obj(
+            container=S, current=ref(_AS + "MetricValueStatus"), name=S
+        ),
+        _AS + "PodsMetricSource": obj(
+            metric=ref(_AS + "MetricIdentifier"),
+            target=ref(_AS + "MetricTarget"),
+        ),
+        _AS + "PodsMetricStatus": obj(
+            current=ref(_AS + "MetricValueStatus"),
+            metric=ref(_AS + "MetricIdentifier"),
+        ),
+        _AS + "ObjectMetricSource": obj(
+            describedObject=ref(_AS + "CrossVersionObjectReference"),
+            metric=ref(_AS + "MetricIdentifier"),
+            target=ref(_AS + "MetricTarget"),
+        ),
+        _AS + "ObjectMetricStatus": obj(
+            current=ref(_AS + "MetricValueStatus"),
+            describedObject=ref(_AS + "CrossVersionObjectReference"),
+            metric=ref(_AS + "MetricIdentifier"),
+        ),
+        _AS + "ExternalMetricSource": obj(
+            metric=ref(_AS + "MetricIdentifier"),
+            target=ref(_AS + "MetricTarget"),
+        ),
+        _AS + "ExternalMetricStatus": obj(
+            current=ref(_AS + "MetricValueStatus"),
+            metric=ref(_AS + "MetricIdentifier"),
+        ),
+        _AS + "HorizontalPodAutoscalerCondition": obj(
+            lastTransitionTime=ref(META + "Time"),
+            message=S,
+            reason=S,
+            status=S,
+            type=S,
+        ),
+    },
+)
+
+# --------------------------------------------------------------------- batch/v1
+_BATCH = "io.k8s.api.batch.v1."
+_CORE = "io.k8s.api.core.v1."
+fixture(
+    "apis.batch.v1",
+    "batch/v1",
+    [
+        ("cronjobs", "CronJob", True, ALL_VERBS),
+        ("jobs", "Job", True, ALL_VERBS),
+    ],
+    {
+        _BATCH + "Job": top(
+            _BATCH, "Job",
+            spec=ref(_BATCH + "JobSpec"),
+            status=ref(_BATCH + "JobStatus"),
+        ),
+        _BATCH + "CronJob": top(
+            _BATCH, "CronJob",
+            spec=ref(_BATCH + "CronJobSpec"),
+            status=ref(_BATCH + "CronJobStatus"),
+        ),
+        _BATCH + "JobSpec": obj(
+            activeDeadlineSeconds={"type": "integer", "format": "int64"},
+            backoffLimit=I,
+            backoffLimitPerIndex=I,
+            completionMode=S,
+            completions=I,
+            managedBy=S,
+            manualSelector=B,
+            maxFailedIndexes=I,
+            parallelism=I,
+            podFailurePolicy=ref(_BATCH + "PodFailurePolicy"),
+            podReplacementPolicy=S,
+            selector=ref(META + "LabelSelector"),
+            successPolicy=ref(_BATCH + "SuccessPolicy"),
+            suspend=B,
+            template=ref(_CORE + "PodTemplateSpec"),
+            ttlSecondsAfterFinished=I,
+        ),
+        _BATCH + "JobStatus": obj(
+            active=I,
+            completedIndexes=S,
+            completionTime=ref(META + "Time"),
+            conditions=arr_ref(_BATCH + "JobCondition"),
+            failed=I,
+            failedIndexes=S,
+            ready=I,
+            startTime=ref(META + "Time"),
+            succeeded=I,
+            terminating=I,
+            uncountedTerminatedPods=ref(_BATCH + "UncountedTerminatedPods"),
+        ),
+        _BATCH + "JobCondition": obj(
+            lastProbeTime=ref(META + "Time"),
+            lastTransitionTime=ref(META + "Time"),
+            message=S,
+            reason=S,
+            status=S,
+            type=S,
+        ),
+        _BATCH + "PodFailurePolicy": obj(
+            rules=arr_ref(_BATCH + "PodFailurePolicyRule")
+        ),
+        _BATCH + "PodFailurePolicyRule": obj(
+            action=S,
+            onExitCodes=ref(_BATCH + "PodFailurePolicyOnExitCodesRequirement"),
+            onPodConditions=arr_ref(
+                _BATCH + "PodFailurePolicyOnPodConditionsPattern"
+            ),
+        ),
+        _BATCH + "PodFailurePolicyOnExitCodesRequirement": obj(
+            containerName=S, operator=S, values=arr(I)
+        ),
+        _BATCH + "PodFailurePolicyOnPodConditionsPattern": obj(
+            status=S, type=S
+        ),
+        _BATCH + "SuccessPolicy": obj(
+            rules=arr_ref(_BATCH + "SuccessPolicyRule")
+        ),
+        _BATCH + "SuccessPolicyRule": obj(succeededCount=I, succeededIndexes=S),
+        _BATCH + "UncountedTerminatedPods": obj(
+            failed=arr(S), succeeded=arr(S)
+        ),
+        _BATCH + "CronJobSpec": obj(
+            concurrencyPolicy=S,
+            failedJobsHistoryLimit=I,
+            jobTemplate=ref(_BATCH + "JobTemplateSpec"),
+            schedule=S,
+            startingDeadlineSeconds={"type": "integer", "format": "int64"},
+            successfulJobsHistoryLimit=I,
+            suspend=B,
+            timeZone=S,
+        ),
+        _BATCH + "CronJobStatus": obj(
+            active=arr_ref(_CORE + "ObjectReference"),
+            lastScheduleTime=ref(META + "Time"),
+            lastSuccessfulTime=ref(META + "Time"),
+        ),
+        _BATCH + "JobTemplateSpec": obj(
+            metadata=ref(META + "ObjectMeta"),
+            spec=ref(_BATCH + "JobSpec"),
+        ),
+        # referenced core types: resolved in-document for shape conversion;
+        # the real core::v1 definitions (from the api.v1 document, processed
+        # first) win in the generated schema
+        _CORE + "PodTemplateSpec": obj(
+            metadata=ref(META + "ObjectMeta"),
+            spec={"type": "object"},
+        ),
+        _CORE + "ObjectReference": obj(
+            apiVersion=S,
+            fieldPath=S,
+            kind=S,
+            name=S,
+            namespace=S,
+            resourceVersion=S,
+            uid=S,
+        ),
+    },
+)
+
+# -------------------------------------------------------------- certificates/v1
+_CERT = "io.k8s.api.certificates.v1."
+fixture(
+    "apis.certificates.k8s.io.v1",
+    "certificates.k8s.io/v1",
+    [("certificatesigningrequests", "CertificateSigningRequest", False, ALL_VERBS)],
+    {
+        _CERT + "CertificateSigningRequest": top(
+            _CERT, "CertificateSigningRequest",
+            spec=ref(_CERT + "CertificateSigningRequestSpec"),
+            status=ref(_CERT + "CertificateSigningRequestStatus"),
+        ),
+        _CERT + "CertificateSigningRequestSpec": obj(
+            expirationSeconds=I,
+            extra=strslicemap(),
+            groups=arr(S),
+            request=S,
+            signerName=S,
+            uid=S,
+            usages=arr(S),
+            username=S,
+        ),
+        _CERT + "CertificateSigningRequestStatus": obj(
+            certificate=S,
+            conditions=arr_ref(_CERT + "CertificateSigningRequestCondition"),
+        ),
+        _CERT + "CertificateSigningRequestCondition": obj(
+            lastTransitionTime=ref(META + "Time"),
+            lastUpdateTime=ref(META + "Time"),
+            message=S,
+            reason=S,
+            status=S,
+            type=S,
+        ),
+    },
+)
+
+# -------------------------------------------------------------- coordination/v1
+_COORD = "io.k8s.api.coordination.v1."
+fixture(
+    "apis.coordination.k8s.io.v1",
+    "coordination.k8s.io/v1",
+    [("leases", "Lease", True, ALL_VERBS)],
+    {
+        _COORD + "Lease": top(
+            _COORD, "Lease", spec=ref(_COORD + "LeaseSpec")
+        ),
+        _COORD + "LeaseSpec": obj(
+            acquireTime=ref(META + "MicroTime"),
+            holderIdentity=S,
+            leaseDurationSeconds=I,
+            leaseTransitions=I,
+            preferredHolder=S,
+            renewTime=ref(META + "MicroTime"),
+            strategy=S,
+        ),
+    },
+)
+
+# ----------------------------------------------------------------- discovery/v1
+_DISC = "io.k8s.api.discovery.v1."
+fixture(
+    "apis.discovery.k8s.io.v1",
+    "discovery.k8s.io/v1",
+    [("endpointslices", "EndpointSlice", True, ALL_VERBS)],
+    {
+        _DISC + "EndpointSlice": top(
+            _DISC, "EndpointSlice",
+            addressType=S,
+            endpoints=arr_ref(_DISC + "Endpoint"),
+            ports=arr_ref(_DISC + "EndpointPort"),
+        ),
+        _DISC + "Endpoint": obj(
+            addresses=arr(S),
+            conditions=ref(_DISC + "EndpointConditions"),
+            deprecatedTopology=strmap(),
+            hints=ref(_DISC + "EndpointHints"),
+            hostname=S,
+            nodeName=S,
+            targetRef=ref(_CORE + "ObjectReference"),
+            zone=S,
+        ),
+        _DISC + "EndpointConditions": obj(ready=B, serving=B, terminating=B),
+        _DISC + "EndpointHints": obj(forZones=arr_ref(_DISC + "ForZone")),
+        _DISC + "ForZone": obj(name=S),
+        _DISC + "EndpointPort": obj(appProtocol=S, name=S, port=I, protocol=S),
+        _CORE + "ObjectReference": obj(
+            apiVersion=S,
+            fieldPath=S,
+            kind=S,
+            name=S,
+            namespace=S,
+            resourceVersion=S,
+            uid=S,
+        ),
+    },
+)
+
+# -------------------------------------------------------------------- events/v1
+_EV = "io.k8s.api.events.v1."
+fixture(
+    "apis.events.k8s.io.v1",
+    "events.k8s.io/v1",
+    [("events", "Event", True, ALL_VERBS)],
+    {
+        _EV + "Event": top(
+            _EV, "Event",
+            action=S,
+            deprecatedCount=I,
+            deprecatedFirstTimestamp=ref(META + "Time"),
+            deprecatedLastTimestamp=ref(META + "Time"),
+            deprecatedSource=ref(_CORE + "EventSource"),
+            eventTime=ref(META + "MicroTime"),
+            note=S,
+            reason=S,
+            regarding=ref(_CORE + "ObjectReference"),
+            related=ref(_CORE + "ObjectReference"),
+            reportingController=S,
+            reportingInstance=S,
+            series=ref(_EV + "EventSeries"),
+            type=S,
+        ),
+        _EV + "EventSeries": obj(
+            count=I, lastObservedTime=ref(META + "MicroTime")
+        ),
+        _CORE + "EventSource": obj(component=S, host=S),
+        _CORE + "ObjectReference": obj(
+            apiVersion=S,
+            fieldPath=S,
+            kind=S,
+            name=S,
+            namespace=S,
+            resourceVersion=S,
+            uid=S,
+        ),
+    },
+)
+
+
+# ----------------------------------------------------- flowcontrol/v1 + v1beta3
+def _flowcontrol(version: str) -> None:
+    _FC = f"io.k8s.api.flowcontrol.{version}."
+    fixture(
+        f"apis.flowcontrol.apiserver.k8s.io.{version}",
+        f"flowcontrol.apiserver.k8s.io/{version}",
+        [
+            ("flowschemas", "FlowSchema", False, ALL_VERBS),
+            ("prioritylevelconfigurations", "PriorityLevelConfiguration", False, ALL_VERBS),
+        ],
+        {
+            _FC + "FlowSchema": top(
+                _FC, "FlowSchema",
+                spec=ref(_FC + "FlowSchemaSpec"),
+                status=ref(_FC + "FlowSchemaStatus"),
+            ),
+            _FC + "PriorityLevelConfiguration": top(
+                _FC, "PriorityLevelConfiguration",
+                spec=ref(_FC + "PriorityLevelConfigurationSpec"),
+                status=ref(_FC + "PriorityLevelConfigurationStatus"),
+            ),
+            _FC + "FlowSchemaSpec": obj(
+                distinguisherMethod=ref(_FC + "FlowDistinguisherMethod"),
+                matchingPrecedence=I,
+                priorityLevelConfiguration=ref(
+                    _FC + "PriorityLevelConfigurationReference"
+                ),
+                rules=arr_ref(_FC + "PolicyRulesWithSubjects"),
+            ),
+            _FC + "FlowSchemaStatus": obj(
+                conditions=arr_ref(_FC + "FlowSchemaCondition")
+            ),
+            _FC + "FlowSchemaCondition": obj(
+                lastTransitionTime=ref(META + "Time"),
+                message=S,
+                reason=S,
+                status=S,
+                type=S,
+            ),
+            _FC + "FlowDistinguisherMethod": obj(type=S),
+            _FC + "PriorityLevelConfigurationReference": obj(name=S),
+            _FC + "PolicyRulesWithSubjects": obj(
+                nonResourceRules=arr_ref(_FC + "NonResourcePolicyRule"),
+                resourceRules=arr_ref(_FC + "ResourcePolicyRule"),
+                subjects=arr_ref(_FC + "Subject"),
+            ),
+            _FC + "NonResourcePolicyRule": obj(
+                nonResourceURLs=arr(S), verbs=arr(S)
+            ),
+            _FC + "ResourcePolicyRule": obj(
+                apiGroups=arr(S),
+                clusterScope=B,
+                namespaces=arr(S),
+                resources=arr(S),
+                verbs=arr(S),
+            ),
+            _FC + "Subject": obj(
+                group=ref(_FC + "GroupSubject"),
+                kind=S,
+                serviceAccount=ref(_FC + "ServiceAccountSubject"),
+                user=ref(_FC + "UserSubject"),
+            ),
+            _FC + "GroupSubject": obj(name=S),
+            _FC + "UserSubject": obj(name=S),
+            _FC + "ServiceAccountSubject": obj(name=S, namespace=S),
+            _FC + "PriorityLevelConfigurationSpec": obj(
+                exempt=ref(_FC + "ExemptPriorityLevelConfiguration"),
+                limited=ref(_FC + "LimitedPriorityLevelConfiguration"),
+                type=S,
+            ),
+            _FC + "PriorityLevelConfigurationStatus": obj(
+                conditions=arr_ref(_FC + "PriorityLevelConfigurationCondition")
+            ),
+            _FC + "PriorityLevelConfigurationCondition": obj(
+                lastTransitionTime=ref(META + "Time"),
+                message=S,
+                reason=S,
+                status=S,
+                type=S,
+            ),
+            _FC + "ExemptPriorityLevelConfiguration": obj(
+                lendablePercent=I, nominalConcurrencyShares=I
+            ),
+            _FC + "LimitedPriorityLevelConfiguration": obj(
+                borrowingLimitPercent=I,
+                lendablePercent=I,
+                limitResponse=ref(_FC + "LimitResponse"),
+                nominalConcurrencyShares=I,
+            ),
+            _FC + "LimitResponse": obj(
+                queuing=ref(_FC + "QueuingConfiguration"), type=S
+            ),
+            _FC + "QueuingConfiguration": obj(
+                handSize=I, queueLengthLimit=I, queues=I
+            ),
+        },
+    )
+
+
+_flowcontrol("v1")
+_flowcontrol("v1beta3")
+
+# ---------------------------------------------------------------- networking/v1
+_NET = "io.k8s.api.networking.v1."
+fixture(
+    "apis.networking.k8s.io.v1",
+    "networking.k8s.io/v1",
+    [
+        ("ingressclasses", "IngressClass", False, ALL_VERBS),
+        ("ingresses", "Ingress", True, ALL_VERBS),
+        ("networkpolicies", "NetworkPolicy", True, ALL_VERBS),
+    ],
+    {
+        _NET + "Ingress": top(
+            _NET, "Ingress",
+            spec=ref(_NET + "IngressSpec"),
+            status=ref(_NET + "IngressStatus"),
+        ),
+        _NET + "IngressClass": top(
+            _NET, "IngressClass", spec=ref(_NET + "IngressClassSpec")
+        ),
+        _NET + "NetworkPolicy": top(
+            _NET, "NetworkPolicy", spec=ref(_NET + "NetworkPolicySpec")
+        ),
+        _NET + "IngressSpec": obj(
+            defaultBackend=ref(_NET + "IngressBackend"),
+            ingressClassName=S,
+            rules=arr_ref(_NET + "IngressRule"),
+            tls=arr_ref(_NET + "IngressTLS"),
+        ),
+        _NET + "IngressStatus": obj(
+            loadBalancer=ref(_NET + "IngressLoadBalancerStatus")
+        ),
+        _NET + "IngressLoadBalancerStatus": obj(
+            ingress=arr_ref(_NET + "IngressLoadBalancerIngress")
+        ),
+        _NET + "IngressLoadBalancerIngress": obj(
+            hostname=S, ip=S, ports=arr_ref(_NET + "IngressPortStatus")
+        ),
+        _NET + "IngressPortStatus": obj(error=S, port=I, protocol=S),
+        _NET + "IngressBackend": obj(
+            resource=ref(_CORE + "TypedLocalObjectReference"),
+            service=ref(_NET + "IngressServiceBackend"),
+        ),
+        _NET + "IngressServiceBackend": obj(
+            name=S, port=ref(_NET + "ServiceBackendPort")
+        ),
+        _NET + "ServiceBackendPort": obj(name=S, number=I),
+        _NET + "IngressRule": obj(
+            host=S, http=ref(_NET + "HTTPIngressRuleValue")
+        ),
+        _NET + "HTTPIngressRuleValue": obj(
+            paths=arr_ref(_NET + "HTTPIngressPath")
+        ),
+        _NET + "HTTPIngressPath": obj(
+            backend=ref(_NET + "IngressBackend"), path=S, pathType=S
+        ),
+        _NET + "IngressTLS": obj(hosts=arr(S), secretName=S),
+        _NET + "IngressClassSpec": obj(
+            controller=S,
+            parameters=ref(_NET + "IngressClassParametersReference"),
+        ),
+        _NET + "IngressClassParametersReference": obj(
+            apiGroup=S, kind=S, name=S, namespace=S, scope=S
+        ),
+        _NET + "NetworkPolicySpec": obj(
+            egress=arr_ref(_NET + "NetworkPolicyEgressRule"),
+            ingress=arr_ref(_NET + "NetworkPolicyIngressRule"),
+            podSelector=ref(META + "LabelSelector"),
+            policyTypes=arr(S),
+        ),
+        _NET + "NetworkPolicyEgressRule": obj(
+            ports=arr_ref(_NET + "NetworkPolicyPort"),
+            to=arr_ref(_NET + "NetworkPolicyPeer"),
+        ),
+        _NET + "NetworkPolicyIngressRule": obj(
+            ports=arr_ref(_NET + "NetworkPolicyPort"),
+            **{"from": arr_ref(_NET + "NetworkPolicyPeer")},
+        ),
+        _NET + "NetworkPolicyPort": obj(endPort=I, port=S, protocol=S),
+        _NET + "NetworkPolicyPeer": obj(
+            ipBlock=ref(_NET + "IPBlock"),
+            namespaceSelector=ref(META + "LabelSelector"),
+            podSelector=ref(META + "LabelSelector"),
+        ),
+        _NET + "IPBlock": obj(cidr=S, **{"except": arr(S)}),
+        _CORE + "TypedLocalObjectReference": obj(apiGroup=S, kind=S, name=S),
+    },
+)
+
+# ---------------------------------------------------------------------- node/v1
+_NODE = "io.k8s.api.node.v1."
+fixture(
+    "apis.node.k8s.io.v1",
+    "node.k8s.io/v1",
+    [("runtimeclasses", "RuntimeClass", False, ALL_VERBS)],
+    {
+        _NODE + "RuntimeClass": top(
+            _NODE, "RuntimeClass",
+            handler=S,
+            overhead=ref(_NODE + "Overhead"),
+            scheduling=ref(_NODE + "Scheduling"),
+        ),
+        _NODE + "Overhead": obj(podFixed=strmap()),
+        _NODE + "Scheduling": obj(
+            nodeSelector=strmap(),
+            tolerations=arr_ref(_CORE + "Toleration"),
+        ),
+        _CORE + "Toleration": obj(
+            effect=S,
+            key=S,
+            operator=S,
+            tolerationSeconds={"type": "integer", "format": "int64"},
+            value=S,
+        ),
+    },
+)
+
+# ---------------------------------------------------------------- scheduling/v1
+_SCHED = "io.k8s.api.scheduling.v1."
+fixture(
+    "apis.scheduling.k8s.io.v1",
+    "scheduling.k8s.io/v1",
+    [("priorityclasses", "PriorityClass", False, ALL_VERBS)],
+    {
+        _SCHED + "PriorityClass": top(
+            _SCHED, "PriorityClass",
+            description=S,
+            globalDefault=B,
+            preemptionPolicy=S,
+            value=I,
+        ),
+    },
+)
+
+# ------------------------------------------------------------------- storage/v1
+_ST = "io.k8s.api.storage.v1."
+fixture(
+    "apis.storage.k8s.io.v1",
+    "storage.k8s.io/v1",
+    [
+        ("csidrivers", "CSIDriver", False, ALL_VERBS),
+        ("csinodes", "CSINode", False, ALL_VERBS),
+        ("csistoragecapacities", "CSIStorageCapacity", True, ALL_VERBS),
+        ("storageclasses", "StorageClass", False, ALL_VERBS),
+        ("volumeattachments", "VolumeAttachment", False, ALL_VERBS),
+    ],
+    {
+        _ST + "StorageClass": top(
+            _ST, "StorageClass",
+            allowVolumeExpansion=B,
+            allowedTopologies=arr_ref(_CORE + "TopologySelectorTerm"),
+            mountOptions=arr(S),
+            parameters=strmap(),
+            provisioner=S,
+            reclaimPolicy=S,
+            volumeBindingMode=S,
+        ),
+        _ST + "VolumeAttachment": top(
+            _ST, "VolumeAttachment",
+            spec=ref(_ST + "VolumeAttachmentSpec"),
+            status=ref(_ST + "VolumeAttachmentStatus"),
+        ),
+        _ST + "CSIDriver": top(
+            _ST, "CSIDriver", spec=ref(_ST + "CSIDriverSpec")
+        ),
+        _ST + "CSINode": top(_ST, "CSINode", spec=ref(_ST + "CSINodeSpec")),
+        _ST + "CSIStorageCapacity": top(
+            _ST, "CSIStorageCapacity",
+            capacity=S,
+            maximumVolumeSize=S,
+            nodeTopology=ref(META + "LabelSelector"),
+            storageClassName=S,
+        ),
+        _ST + "VolumeAttachmentSpec": obj(
+            attacher=S,
+            nodeName=S,
+            source=ref(_ST + "VolumeAttachmentSource"),
+        ),
+        _ST + "VolumeAttachmentSource": obj(persistentVolumeName=S),
+        _ST + "VolumeAttachmentStatus": obj(
+            attachError=ref(_ST + "VolumeError"),
+            attached=B,
+            attachmentMetadata=strmap(),
+            detachError=ref(_ST + "VolumeError"),
+        ),
+        _ST + "VolumeError": obj(message=S, time=ref(META + "Time")),
+        _ST + "CSIDriverSpec": obj(
+            attachRequired=B,
+            fsGroupPolicy=S,
+            podInfoOnMount=B,
+            requiresRepublish=B,
+            seLinuxMount=B,
+            storageCapacity=B,
+            tokenRequests=arr_ref(_ST + "TokenRequest"),
+            volumeLifecycleModes=arr(S),
+        ),
+        _ST + "TokenRequest": obj(
+            audience=S,
+            expirationSeconds={"type": "integer", "format": "int64"},
+        ),
+        _ST + "CSINodeSpec": obj(drivers=arr_ref(_ST + "CSINodeDriver")),
+        _ST + "CSINodeDriver": obj(
+            allocatable=ref(_ST + "VolumeNodeResources"),
+            name=S,
+            nodeID=S,
+            topologyKeys=arr(S),
+        ),
+        _ST + "VolumeNodeResources": obj(count=I),
+        _CORE + "TopologySelectorTerm": obj(
+            matchLabelExpressions=arr_ref(
+                _CORE + "TopologySelectorLabelRequirement"
+            )
+        ),
+        _CORE + "TopologySelectorLabelRequirement": obj(
+            key=S, values=arr(S)
+        ),
+    },
+)
+
+# -------------------------------------------------------------- autoscaling/v1
+_AS1 = "io.k8s.api.autoscaling.v1."
+fixture(
+    "apis.autoscaling.v1",
+    "autoscaling/v1",
+    [("horizontalpodautoscalers", "HorizontalPodAutoscaler", True, ALL_VERBS)],
+    {
+        _AS1 + "HorizontalPodAutoscaler": top(
+            _AS1, "HorizontalPodAutoscaler",
+            spec=ref(_AS1 + "HorizontalPodAutoscalerSpec"),
+            status=ref(_AS1 + "HorizontalPodAutoscalerStatus"),
+        ),
+        _AS1 + "HorizontalPodAutoscalerSpec": obj(
+            maxReplicas=I,
+            minReplicas=I,
+            scaleTargetRef=ref(_AS1 + "CrossVersionObjectReference"),
+            targetCPUUtilizationPercentage=I,
+        ),
+        _AS1 + "HorizontalPodAutoscalerStatus": obj(
+            currentCPUUtilizationPercentage=I,
+            currentReplicas=I,
+            desiredReplicas=I,
+            lastScaleTime=ref(META + "Time"),
+            observedGeneration={"type": "integer", "format": "int64"},
+        ),
+        _AS1 + "CrossVersionObjectReference": obj(
+            apiVersion=S, kind=S, name=S
+        ),
+    },
+)
+
+# -------------------------------------------------------------------- policy/v1
+_POL = "io.k8s.api.policy.v1."
+fixture(
+    "apis.policy.v1",
+    "policy/v1",
+    [("poddisruptionbudgets", "PodDisruptionBudget", True, ALL_VERBS)],
+    {
+        _POL + "PodDisruptionBudget": top(
+            _POL, "PodDisruptionBudget",
+            spec=ref(_POL + "PodDisruptionBudgetSpec"),
+            status=ref(_POL + "PodDisruptionBudgetStatus"),
+        ),
+        _POL + "PodDisruptionBudgetSpec": obj(
+            maxUnavailable=S,
+            minAvailable=S,
+            selector=ref(META + "LabelSelector"),
+            unhealthyPodEvictionPolicy=S,
+        ),
+        _POL + "PodDisruptionBudgetStatus": obj(
+            conditions=arr_ref(META + "Condition"),
+            currentHealthy=I,
+            desiredHealthy=I,
+            disruptionsAllowed=I,
+            expectedPods=I,
+            observedGeneration={"type": "integer", "format": "int64"},
+        ),
+    },
+)
+
+# --------------------------------------------------- the cedar Policy CRD itself
+# group cedar.k8s.aws -> reversed-domain schema prefix aws.k8s.cedar (how the
+# apiserver names CRD schemas in /openapi/v3); matches apis/v1alpha1.py
+_CRD = "aws.k8s.cedar.v1alpha1."
+fixture(
+    "apis.cedar.k8s.aws.v1alpha1",
+    "cedar.k8s.aws/v1alpha1",
+    [("policies", "Policy", False, ALL_VERBS)],
+    {
+        _CRD + "Policy": top(
+            _CRD, "Policy",
+            spec=obj(
+                content=S,
+                validation=obj(enforced=B, validationMode=S),
+            ),
+            status=obj(),
+        ),
+    },
+)
+
+
+def main() -> int:
+    outdir = pathlib.Path(
+        sys.argv[1] if len(sys.argv) > 1 else "tests/testdata/openapi"
+    )
+    outdir.mkdir(parents=True, exist_ok=True)
+    for api_path, (doc, resources) in sorted(FIXTURES.items()):
+        (outdir / f"{api_path}.schema.json").write_text(
+            json.dumps(doc, indent=1, sort_keys=True) + "\n"
+        )
+        (outdir / f"{api_path}.resourcelist.json").write_text(
+            json.dumps(resources, indent=1) + "\n"
+        )
+        print(f"wrote {api_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
