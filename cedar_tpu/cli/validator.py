@@ -16,6 +16,11 @@ Checks performed per policy:
     scope pins must name attributes that exist in the schema shape
     (best-effort static walk; accesses on untyped vars are skipped, like
     cedar's permissive mode)
+  * operand TYPES (schema/typecheck.py): comparisons/arithmetic need Longs,
+    ``like`` needs a String, logical operators need Booleans, ``contains``
+    needs a Set (with element-type compatibility), equality between
+    provably different types is flagged — so ``principal.name < 3`` is a
+    finding, like the Rust validator the reference runs in CI
 """
 
 from __future__ import annotations
@@ -246,6 +251,12 @@ def validate_policy(
             finding(
                 f"{var} ({t}) has no attribute path {'.'.join(path)!r}"
             )
+
+    # ---- operand typechecking (schema/typecheck.py)
+    from ..schema.typecheck import typecheck_policy
+
+    for msg in typecheck_policy(schema, policy, p_type, r_type):
+        finding(f"type error: {msg}")
     return findings
 
 

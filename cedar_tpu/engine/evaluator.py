@@ -200,6 +200,12 @@ class TPUPolicyEngine:
         self._lock = threading.Lock()
         self._mesh_steps: dict = {}  # (n_tiers, has_gate) -> pjit step
         self._mesh_bits_step = None
+        # set once the first serving shape (b=1) of the current/previous set
+        # has compiled: readiness gates on it so the first live request
+        # never eats an XLA compile (latches across hot swaps — same-bucket
+        # reloads reuse executables, so readiness must not flap)
+        self._warm_first = threading.Event()
+        self._warm_live: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -226,44 +232,79 @@ class TPUPolicyEngine:
             self._compiled = new
         if warm == "sync":
             self._warm_kernels(new)
+            self._warm_first.set()
         elif warm != "off":
             t = threading.Thread(
                 target=self._warm_thread_main, args=(new,), daemon=True
             )
             _live_warm_threads.add(t)
+            self._warm_live = t
             t.start()
+        else:
+            self._warm_first.set()  # warm-up intentionally skipped
         return {**compiled.stats(), "L": packed.L, "R": packed.R}
+
+    def warm_ready(self) -> bool:
+        """True once the first serving shape has compiled (or warm-up was
+        skipped/superseded): the readiness gate for a fresh server. An
+        engine that has never loaded is NOT ready — answering 200 before
+        the initial store load would admit traffic that later pays the
+        first compile mid-flight (and flap 200->503 when the load lands)."""
+        return self._warm_first.is_set()
+
+    def warm_wait(self, timeout: Optional[float] = None) -> bool:
+        """Join the current warm-up thread (tests); True when idle."""
+        t = self._warm_live
+        if t is None or not t.is_alive():
+            return True
+        t.join(timeout)
+        return not t.is_alive()
 
     def _warm_thread_main(self, cs: "_CompiledSet") -> None:
         try:
             self._warm_kernels(cs)
         finally:
+            # set even on bail: a superseding load owns warming from here,
+            # and readiness must not wedge on a dead thread
+            self._warm_first.set()
             _live_warm_threads.discard(threading.current_thread())
 
     def _warm_kernels(self, cs: "_CompiledSet") -> None:
-        """Trace+compile the first-hit serving shapes off the critical path:
-        single-request and small-batch buckets with the no-extras width
-        (what a webhook sees at startup), plus the one fixed shape of the
-        standalone bits kernel (compaction overflow / pallas diagnostics).
-        Larger buckets compile on first use exactly as before; every
-        compile here is one the first live requests would otherwise pay.
-        Bails out as soon as a hot swap supersedes `cs` — on the 1-core
-        serving host an orphan compile steals the request thread's CPU."""
+        """Trace+compile the serving shapes a fresh server actually hits,
+        off the critical path and in first-hit order: the b=1 shape first
+        (readiness gates on it via _warm_first), then the micro-batcher
+        buckets up to 512, each at the no-extras width AND the first
+        extras bucket (selector/set-heavy requests land on width 8), plus
+        the fixed shape of the standalone bits kernel. Larger buckets
+        compile on first use; every compile here is one the first live
+        requests would otherwise pay. Bails out as soon as a hot swap
+        supersedes `cs` — on a 1-core serving host an orphan compile
+        steals the request thread's CPU."""
         packed = cs.packed
-        shapes = [(b, self.match_arrays) for b in (1, 8, 32)]
-        shapes.append((1, self.match_bits_arrays))
-        for b, fn in shapes:
+        # NOTE: kind tags, not bound-method identity — `fn is
+        # self.match_arrays` is always False (a bound method is a fresh
+        # object per attribute access), which silently warmed the
+        # want_bits=False variant the serving path never calls
+        shapes: list = [("match", 1, 1)]
+        for b in (1, 8, 32, 128, 512):
+            for E in (1, 8):
+                if (b, E) != (1, 1):
+                    shapes.append(("match", b, E))
+        shapes.append(("bits", self._BITS_CHUNK, 1))
+        for i, (kind, b, E) in enumerate(shapes):
             if self._compiled is not cs or _shutdown.is_set():
                 return
             try:
                 warm_c = np.zeros((b, packed.table.n_slots), dtype=cs.code_dtype)
-                warm_e = np.full((b, 1), packed.L, dtype=cs.active_dtype)
-                if fn is self.match_arrays:
-                    fn(warm_c, warm_e, cs=cs, want_bits=True)
+                warm_e = np.full((b, E), packed.L, dtype=cs.active_dtype)
+                if kind == "match":
+                    self.match_arrays(warm_c, warm_e, cs=cs, want_bits=True)
                 else:
-                    fn(warm_c, warm_e, cs=cs)
+                    self.match_bits_arrays(warm_c, warm_e, cs=cs)
             except Exception:  # noqa: BLE001 — warm-up must never take down a swap
                 return
+            if i == 0:
+                self._warm_first.set()
 
     def _mesh_step(self, packed: PackedPolicySet):
         """The cached pjit evaluation step for this mesh + set shape."""

@@ -1,0 +1,304 @@
+"""Static operand typechecking of Cedar policies against a generated schema.
+
+Fills the CI role the reference delegates to the Rust ``cedar-policy-cli``
+validator (/root/reference Makefile:158-163,
+.github/workflows/cedar-validation.yaml): beyond existence checks, operand
+TYPES are verified, so ``principal.name < 3`` (comparing a String to a
+Long), ``like`` over a Long, or ``contains`` on a non-set are rejected at
+validation time instead of silently never matching (or erroring) at runtime.
+
+The checker is permissive exactly where the schema is silent — attributes
+on unpinned variables, ``context``, unknown common types — matching
+cedar's permissive validation mode: only provable mismatches are findings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..lang import ast
+from .model import Attribute, CedarSchema, EntityShape
+
+# type kinds
+STRING = "String"
+LONG = "Long"
+BOOL = "Boolean"
+SET = "Set"
+RECORD = "Record"
+ENTITY = "Entity"
+EXT = "Extension"
+UNKNOWN = "Unknown"
+
+_PRIMITIVES = {
+    "String": STRING,
+    "__cedar::String": STRING,
+    "Long": LONG,
+    "__cedar::Long": LONG,
+    "Boolean": BOOL,
+    "Bool": BOOL,
+    "__cedar::Boolean": BOOL,
+}
+
+
+@dataclass
+class TC:
+    """An inferred static type. UNKNOWN is top: it silences all checks."""
+
+    kind: str
+    element: Optional["TC"] = None  # Set element
+    attrs: Optional[Dict[str, Attribute]] = None  # Record / entity shape
+    entity: str = ""  # Entity type name
+    ns: str = ""  # namespace attribute refs resolve against
+
+    def __str__(self):
+        if self.kind == SET and self.element is not None:
+            return f"Set<{self.element}>"
+        if self.kind == ENTITY and self.entity:
+            return self.entity
+        return self.kind
+
+
+_UNKNOWN = TC(UNKNOWN)
+_STR = TC(STRING)
+_LONG = TC(LONG)
+_BOOL = TC(BOOL)
+
+
+class TypeChecker:
+    def __init__(
+        self,
+        schema: CedarSchema,
+        principal_type: Optional[str],
+        resource_type: Optional[str],
+    ):
+        self.schema = schema
+        self.vars = {
+            "principal": self._entity_tc(principal_type),
+            "resource": self._entity_tc(resource_type),
+            "action": _UNKNOWN,
+            "context": _UNKNOWN,
+        }
+        self.findings: List[str] = []
+
+    # ------------------------------------------------------------- resolve
+
+    def _entity_tc(self, type_name: Optional[str]) -> TC:
+        if not type_name:
+            return _UNKNOWN
+        shape = self.schema.get_entity_shape(type_name)
+        ns = "::".join(type_name.split("::")[:-1])
+        if shape is None:
+            return TC(ENTITY, entity=type_name, ns=ns)
+        return TC(ENTITY, attrs=shape.attributes, entity=type_name, ns=ns)
+
+    def _resolve_common(self, ns: str, ref: str) -> Optional[EntityShape]:
+        if ns:
+            shape = self.schema.get_entity_shape(f"{ns}::{ref}")
+            if shape is not None:
+                return shape
+        return self.schema.get_entity_shape(ref)
+
+    def _attr_tc(self, attr: Attribute, ns: str) -> TC:
+        prim = _PRIMITIVES.get(attr.type)
+        if prim is not None:
+            return TC(prim)
+        if attr.type == "Set":
+            elem = _UNKNOWN
+            if attr.element is not None:
+                elem = self._attr_tc(
+                    Attribute(type=attr.element.type, name=attr.element.name),
+                    ns,
+                )
+            return TC(SET, element=elem)
+        if attr.type == "Record":
+            return TC(RECORD, attrs=attr.attributes, ns=ns)
+        if attr.type == "Entity":
+            name = attr.name
+            if name and "::" not in name and ns:
+                name = f"{ns}::{name}"
+            return self._entity_tc(name)
+        if attr.type == "Extension":
+            return TC(EXT)
+        # common-type reference (namespace-relative)
+        inner = self._resolve_common(ns, attr.type)
+        if inner is None:
+            return _UNKNOWN
+        inner_ns = ns
+        if "::" in attr.type:
+            inner_ns = "::".join(attr.type.split("::")[:-1])
+        if inner.type == "Record":
+            return TC(RECORD, attrs=inner.attributes, ns=inner_ns)
+        prim = _PRIMITIVES.get(inner.type)
+        if prim is not None:
+            return TC(prim)
+        return _UNKNOWN
+
+    # --------------------------------------------------------------- infer
+
+    def err(self, msg: str) -> None:
+        if msg not in self.findings:
+            self.findings.append(msg)
+
+    def _expect(self, got: TC, want: str, what: str) -> None:
+        if got.kind != UNKNOWN and got.kind != want:
+            self.err(f"{what} must be {want}, got {got}")
+
+    def infer(self, e: ast.Expr) -> TC:
+        if isinstance(e, ast.Lit):
+            v = e.value
+            if type(v) is bool:
+                return _BOOL
+            if type(v) is int:
+                return _LONG
+            return _STR
+        if isinstance(e, ast.Var):
+            return self.vars.get(e.name, _UNKNOWN)
+        if isinstance(e, ast.EntityLit):
+            return self._entity_tc(e.uid.type)
+        if isinstance(e, (ast.GetAttr, ast.HasAttr)):
+            obj = self.infer(e.obj)
+            if isinstance(e, ast.HasAttr):
+                return _BOOL
+            if obj.kind in (ENTITY, RECORD) and obj.attrs is not None:
+                attr = obj.attrs.get(e.attr)
+                if attr is None:
+                    return _UNKNOWN  # existence is the validator's finding
+                return self._attr_tc(attr, obj.ns)
+            if obj.kind not in (ENTITY, RECORD, UNKNOWN):
+                self.err(f"attribute access .{e.attr} on {obj}")
+            return _UNKNOWN
+        if isinstance(e, (ast.And, ast.Or)):
+            op = "&&" if isinstance(e, ast.And) else "||"
+            self._expect(self.infer(e.left), BOOL, f"left operand of {op}")
+            self._expect(self.infer(e.right), BOOL, f"right operand of {op}")
+            return _BOOL
+        if isinstance(e, ast.Unary):
+            t = self.infer(e.arg)
+            if e.op == "!":
+                self._expect(t, BOOL, "operand of !")
+                return _BOOL
+            self._expect(t, LONG, "operand of unary -")
+            return _LONG
+        if isinstance(e, ast.If):
+            self._expect(self.infer(e.cond), BOOL, "if condition")
+            t1, t2 = self.infer(e.then), self.infer(e.els)
+            if t1.kind == t2.kind and t1.kind != UNKNOWN:
+                return t1
+            return _UNKNOWN
+        if isinstance(e, ast.Binary):
+            lt, rt = self.infer(e.left), self.infer(e.right)
+            if e.op in ("<", "<=", ">", ">="):
+                self._expect(lt, LONG, f"left operand of {e.op}")
+                self._expect(rt, LONG, f"right operand of {e.op}")
+                return _BOOL
+            if e.op in ("+", "-", "*"):
+                self._expect(lt, LONG, f"left operand of {e.op}")
+                self._expect(rt, LONG, f"right operand of {e.op}")
+                return _LONG
+            if e.op in ("==", "!="):
+                if (
+                    lt.kind != UNKNOWN
+                    and rt.kind != UNKNOWN
+                    and lt.kind != rt.kind
+                ):
+                    self.err(
+                        f"{e.op} between {lt} and {rt} is always "
+                        f"{'false' if e.op == '==' else 'true'}"
+                    )
+                elif (
+                    lt.kind == ENTITY
+                    and rt.kind == ENTITY
+                    and lt.entity
+                    and rt.entity
+                    and lt.entity != rt.entity
+                ):
+                    self.err(
+                        f"{e.op} between entity types {lt.entity} and "
+                        f"{rt.entity} is always "
+                        f"{'false' if e.op == '==' else 'true'}"
+                    )
+                return _BOOL
+            if e.op == "in":
+                if lt.kind not in (ENTITY, UNKNOWN):
+                    self.err(f"left operand of `in` must be an entity, got {lt}")
+                if rt.kind not in (ENTITY, SET, UNKNOWN):
+                    self.err(
+                        f"right operand of `in` must be an entity or set, got {rt}"
+                    )
+                return _BOOL
+            return _UNKNOWN
+        if isinstance(e, ast.Like):
+            self._expect(self.infer(e.obj), STRING, "operand of like")
+            return _BOOL
+        if isinstance(e, ast.Is):
+            t = self.infer(e.obj)
+            if t.kind not in (ENTITY, UNKNOWN):
+                self.err(f"operand of `is` must be an entity, got {t}")
+            if e.in_entity is not None:
+                self.infer(e.in_entity)
+            return _BOOL
+        if isinstance(e, ast.SetLit):
+            elems = [self.infer(x) for x in e.elems]
+            kinds = {t.kind for t in elems}
+            # pin the element type only when EVERY member is known and
+            # agrees — an UNKNOWN member could be anything at runtime, so
+            # judging membership against the known members would flag
+            # expressions that can in fact be true (permissive contract)
+            if elems and len(kinds) == 1 and UNKNOWN not in kinds:
+                return TC(SET, element=elems[0])
+            return TC(SET, element=_UNKNOWN)
+        if isinstance(e, ast.RecordLit):
+            return TC(RECORD, attrs=None)
+        if isinstance(e, ast.MethodCall):
+            obj = self.infer(e.obj)
+            args = [self.infer(a) for a in e.args]
+            if e.method == "contains":
+                self._expect(obj, SET, "receiver of .contains()")
+                if (
+                    obj.kind == SET
+                    and obj.element is not None
+                    and obj.element.kind != UNKNOWN
+                    and args
+                    and args[0].kind != UNKNOWN
+                    and args[0].kind != obj.element.kind
+                ):
+                    self.err(
+                        f".contains({args[0]}) on {obj} is always false"
+                    )
+                return _BOOL
+            if e.method in ("containsAll", "containsAny"):
+                self._expect(obj, SET, f"receiver of .{e.method}()")
+                if args:
+                    self._expect(args[0], SET, f"argument of .{e.method}()")
+                return _BOOL
+            if e.method in ("isIpv4", "isIpv6", "isLoopback", "isMulticast"):
+                self._expect(obj, EXT, f"receiver of .{e.method}()")
+                return _BOOL
+            if e.method in ("isInRange", "lessThan", "lessThanOrEqual",
+                            "greaterThan", "greaterThanOrEqual"):
+                self._expect(obj, EXT, f"receiver of .{e.method}()")
+                if args:
+                    self._expect(args[0], EXT, f"argument of .{e.method}()")
+                return _BOOL
+            return _UNKNOWN
+        if isinstance(e, ast.ExtCall):
+            for a in e.args:
+                self.infer(a)
+            return TC(EXT)
+        return _UNKNOWN
+
+
+def typecheck_policy(
+    schema: CedarSchema,
+    policy: ast.Policy,
+    principal_type: Optional[str],
+    resource_type: Optional[str],
+) -> List[str]:
+    """Type findings for every when/unless condition of one policy."""
+    tc = TypeChecker(schema, principal_type, resource_type)
+    for cond in policy.conditions:
+        t = tc.infer(cond.body)
+        if t.kind not in (BOOL, UNKNOWN):
+            tc.err(f"{cond.kind} condition must be Boolean, got {t}")
+    return tc.findings

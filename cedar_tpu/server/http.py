@@ -250,6 +250,15 @@ class WebhookServer:
 
     # ------------------------------------------------------------- handlers
 
+    def warm_ready(self) -> bool:
+        """Readiness beyond store load: every wired engine's first serving
+        shape must be compiled (TPUPolicyEngine.warm_ready)."""
+        for fp in (self.fastpath, self.admission_fastpath):
+            engine = getattr(fp, "engine", None)
+            if engine is not None and not engine.warm_ready():
+                return False
+        return True
+
     def handle_authorize(self, body: bytes) -> dict:
         start = time.monotonic()
         request_id = str(uuid.uuid4())
@@ -456,9 +465,18 @@ class WebhookServer:
                 log.debug("%s %s", self.address_string(), fmt % args)
 
             def do_GET(self):
-                if self.path in ("/healthz", "/readyz"):
-                    # always-200 stubs (reference health.go:22-26)
+                if self.path == "/healthz":
+                    # always-200 stub (reference health.go:22-26)
                     self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                elif self.path == "/readyz":
+                    # goes beyond the reference's always-200 stub: gate on
+                    # the engines' first serving shape being compiled so a
+                    # fresh server's first live request never eats an XLA
+                    # compile inside the apiserver's 3s webhook deadline
+                    ready = server.warm_ready()
+                    self.send_response(200 if ready else 503)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                 elif self.path == "/metrics":

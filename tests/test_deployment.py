@@ -37,9 +37,45 @@ ALL_YAML = [
     "config/rbac/kustomization.yaml",
     "config/prometheus/monitor.yaml",
     "config/default/kustomization.yaml",
+    "config/certmanager/certificate.yaml",
+    "config/certmanager/kustomization.yaml",
+    "config/certmanager/kustomizeconfig.yaml",
+    "config/webhook/manifests.yaml",
+    "config/webhook/service.yaml",
+    "config/webhook/kustomization.yaml",
+    "config/webhook/kustomizeconfig.yaml",
+    "config/manager/manager.yaml",
+    "config/manager/kustomization.yaml",
+    "config/samples/cedar_v1alpha1_policy.yaml",
+    "config/samples/kustomization.yaml",
     "demo/authorization-policy.yaml",
     "demo/admission-policy.yaml",
 ]
+
+
+def test_certmanager_overlay_wiring():
+    """The cert-manager overlay must tie together: the Certificate's issuer
+    ref resolves to the Issuer, the secret it issues is the one the manager
+    Deployment mounts, and the webhook Service fronts the admission port."""
+    certs = _docs("config/certmanager/certificate.yaml")
+    issuer = next(d for d in certs if d["kind"] == "Issuer")
+    cert = next(d for d in certs if d["kind"] == "Certificate")
+    assert cert["spec"]["issuerRef"]["name"] == issuer["metadata"]["name"]
+    secret = cert["spec"]["secretName"]
+    mgr = _docs("config/manager/manager.yaml")[0]
+    vols = mgr["spec"]["template"]["spec"]["volumes"]
+    assert any(v.get("secret", {}).get("secretName") == secret for v in vols)
+    svc = _docs("config/webhook/service.yaml")[0]
+    assert svc["spec"]["ports"][0]["targetPort"] == 10288
+    vwc = _docs("config/webhook/manifests.yaml")[0]
+    cc = vwc["webhooks"][0]["clientConfig"]["service"]
+    assert cc["name"] == svc["metadata"]["name"]
+    assert cc["path"] == "/v1/admit"
+    # the sample Policy parses as real Cedar
+    from cedar_tpu.lang import parse_policies
+
+    sample = _docs("config/samples/cedar_v1alpha1_policy.yaml")[0]
+    assert parse_policies(sample["spec"]["content"], filename="sample")
 
 
 @pytest.mark.parametrize("path", ALL_YAML)

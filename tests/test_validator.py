@@ -144,3 +144,87 @@ def test_admission_types_resolvable(schema):
         " when { resource.spec.bogusField == true };",
     )
     assert any("no attribute path" in f for f in fs)
+
+
+TYPE_BROKEN = [
+    # (policy source, fragment the finding must mention)
+    (
+        'permit (principal is k8s::User, action, resource)'
+        ' when { principal.name < 3 };',
+        "must be Long",
+    ),
+    (
+        'permit (principal is k8s::User, action, resource)'
+        ' when { principal.name == 3 };',
+        "always false",
+    ),
+    (
+        'permit (principal is k8s::User, action, resource)'
+        ' when { principal.name && true };',
+        "must be Boolean",
+    ),
+    (
+        'permit (principal, action, resource is k8s::Resource)'
+        ' when { resource.resource like "p*" && resource.name + 1 > 2 };',
+        "must be Long",
+    ),
+    (
+        'permit (principal is k8s::User, action, resource)'
+        ' when { principal.name.contains("x") };',
+        "must be Set",
+    ),
+    (
+        'permit (principal, action, resource is k8s::Resource)'
+        ' when { resource.namespace };',
+        "condition must be Boolean",
+    ),
+    (
+        'permit (principal is k8s::User, action, resource)'
+        ' when { !principal.name };',
+        "must be Boolean",
+    ),
+    (
+        'permit (principal, action, resource is core::v1::ConfigMap)'
+        ' when { resource.metadata.name like "x*" &&'
+        ' resource.metadata.generation like "y*" };',
+        "operand of like",
+    ),
+    (
+        'permit (principal, action, resource is k8s::Resource)'
+        ' when { if resource.resource then true else false };',
+        "if condition",
+    ),
+]
+
+
+@pytest.mark.parametrize("src,fragment", TYPE_BROKEN)
+def test_typecheck_rejects_operand_mismatches(schema, src, fragment):
+    """The validator's typechecker must reject operand-type mismatches the
+    way the reference's CI-side Rust validator does (Makefile:158-163)."""
+    found = _validate_src(schema, src)
+    assert found, f"expected a type finding for: {src}"
+    assert any(fragment in str(f) for f in found), (
+        f"expected {fragment!r} in {[str(f) for f in found]}"
+    )
+
+
+def test_typecheck_accepts_well_typed_conditions(schema):
+    """Well-typed uses of the same operators must stay clean."""
+    good = [
+        'permit (principal is k8s::User, action, resource)'
+        ' when { principal.name == "sam" };',
+        'permit (principal, action, resource is k8s::Resource)'
+        ' when { resource.resource like "pod*" };',
+        'permit (principal, action, resource is core::v1::ConfigMap)'
+        ' when { resource.metadata.generation > 3 };',
+        'permit (principal is k8s::User, action, resource)'
+        ' when { principal.extra.contains({key: "k", values: ["v"]}) };',
+        'permit (principal, action, resource is k8s::Resource)'
+        ' when { ["pods", "services"].contains(resource.resource) };',
+    ]
+    for src in good:
+        found = _validate_src(schema, src)
+        assert not [f for f in found if "type error" in str(f)], (
+            src,
+            [str(f) for f in found],
+        )

@@ -148,13 +148,24 @@ def group_doc(schemas: dict) -> dict:
     }
 
 
+def singular(name: str) -> str:
+    """policies -> policy, ingressclasses -> ingressclass, leases -> lease."""
+    if name.endswith("ies"):
+        return name[:-3] + "y"
+    if name.endswith("sses"):
+        return name[:-2]
+    if name.endswith("s"):
+        return name[:-1]
+    return name
+
+
 def rlist(group_version: str, resources: list) -> dict:
     out = []
     for name, kind, namespaced, verbs in resources:
         out.append(
             {
                 "name": name,
-                "singularName": name.rstrip("s"),
+                "singularName": singular(name),
                 "namespaced": namespaced,
                 "kind": kind,
                 "verbs": verbs,
