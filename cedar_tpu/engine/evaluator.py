@@ -209,7 +209,7 @@ class TPUPolicyEngine:
 
     # ------------------------------------------------------------ lifecycle
 
-    def load(self, tiers: Sequence[PolicySet], warm: str = "async") -> dict:
+    def load(self, tiers: Sequence[PolicySet], warm: str = "default") -> dict:
         """Compile + pack a tiered policy set and atomically swap it in.
         Returns compile stats.
 
@@ -220,7 +220,16 @@ class TPUPolicyEngine:
         before returning (tests); "off" skips it. Warm-up front-loads the
         serving shapes a fresh server sees first: the latency-regime match
         shapes (with their in-call diagnostics plane) AND the standalone
-        bitset kernel the throughput paths fetch flagged rows through."""
+        bitset kernel the throughput paths fetch flagged rows through.
+
+        The unspecified default resolves through CEDAR_TPU_WARM_DEFAULT
+        (else "async") — the test suite sets it to "off" so dozens of
+        incidental engine loads don't each spawn a ~20-compile background
+        ladder; explicit warm= arguments are never overridden."""
+        import os
+
+        if warm == "default":
+            warm = os.environ.get("CEDAR_TPU_WARM_DEFAULT", "async")
         if not tiers:
             raise ValueError("TPUPolicyEngine.load: at least one tier required")
         compiled: CompiledPolicies = lower_tiers(list(tiers), self.schema)
@@ -283,13 +292,20 @@ class TPUPolicyEngine:
         packed = cs.packed
         # NOTE: kind tags, not bound-method identity — `fn is
         # self.match_arrays` is always False (a bound method is a fresh
-        # object per attribute access), which silently warmed the
-        # want_bits=False variant the serving path never calls
+        # object per attribute access), which silently warmed the wrong
+        # want_bits variant for two rounds. Three planes get compiled:
+        # the latency-regime fast path (want_bits in-call), the
+        # throughput/python path (plain words — evaluate_batch behind the
+        # gated fast path), and the standalone bits kernel; fallback sets
+        # also warm the want_full variant their host tier walk uses.
         shapes: list = [("match", 1, 1)]
         for b in (1, 8, 32, 128, 512):
             for E in (1, 8):
                 if (b, E) != (1, 1):
                     shapes.append(("match", b, E))
+                shapes.append(("plain", b, E))
+                if packed.fallback:
+                    shapes.append(("full", b, E))
         shapes.append(("bits", self._BITS_CHUNK, 1))
         shapes.append(("bits", self._BITS_CHUNK, 8))
         for i, (kind, b, E) in enumerate(shapes):
@@ -300,6 +316,10 @@ class TPUPolicyEngine:
                 warm_e = np.full((b, E), packed.L, dtype=cs.active_dtype)
                 if kind == "match":
                     self.match_arrays(warm_c, warm_e, cs=cs, want_bits=True)
+                elif kind == "plain":
+                    self.match_arrays(warm_c, warm_e, cs=cs)
+                elif kind == "full":
+                    self.match_arrays(warm_c, warm_e, cs=cs, want_full=True)
                 else:
                     self.match_bits_arrays(warm_c, warm_e, cs=cs)
             except Exception:  # noqa: BLE001 — warm-up must never take down a swap

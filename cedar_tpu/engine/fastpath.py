@@ -1,22 +1,39 @@
-"""SAR fast path: raw request bytes -> decisions, native end to end.
+"""SAR + admission fast paths: raw request bytes -> decisions, native end
+to end.
 
 Fuses the C++ encoder (cedar_tpu/native) with the device matcher: the host
 never materializes Python entity objects for well-formed requests. Per
 request the host work is one C++ JSON parse + a handful of hash lookups;
 the device work rides the batched matmul kernel; the readback is 4 bytes.
 
-Semantics are identical to CedarWebhookAuthorizer.authorize over the TPU
-engine (the gates run inside the C++ encoder in the same order as
-/root/reference internal/server/authorizer/authorizer.go:38-66); rows the
-native path cannot prove equivalent (parse quirks, extras overflow, or a
-policy set with interpreter-fallback policies) are re-run through the exact
-Python path.
+Semantics are identical to the exact Python paths
+(CedarWebhookAuthorizer.authorize / CedarAdmissionHandler.handle over the
+TPU engine; the authorizer gates run inside the C++ encoder in the same
+order as /root/reference internal/server/authorizer/authorizer.go:38-66).
+Rows the native path cannot prove equivalent re-run through the exact
+Python path:
+
+  * parse quirks / extras overflow / unsupported admission shapes — routed
+    per row by the encoder's flag column;
+  * rows whose verdict word carries WORD_GATE — an interpreter-fallback
+    policy's scope matched (compiler.pack packs one gate rule per fallback
+    policy), so the device verdict is not authoritative; gated rows re-run
+    batched through the hybrid engine path.
+
+Both fast paths share one chunked pipeline (_RawFastPath): chunk k+1's C++
+encode overlaps chunk k's in-flight device work; clean rows decode via a
+per-distinct-verdict-word cache; flagged (multi/err) rows defer to one
+cross-chunk bits fetch with feature-row-keyed memoization; gated rows defer
+to one batched Python re-run. The subclasses contribute only the
+domain-specific pieces: encoding, flag routing, per-row fallbacks, and how
+a decoded payload renders into a response.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
@@ -41,7 +58,7 @@ from ..server.authorizer import (
 )
 from ..lang.authorize import ALLOW, DENY
 from ..ops.match import WORD_ERR, WORD_GATE, WORD_MULTI
-from .evaluator import TPUPolicyEngine
+from .evaluator import TPUPolicyEngine, _round_bucket
 
 log = logging.getLogger(__name__)
 
@@ -65,51 +82,6 @@ _GATE_RESULTS = {
 Result = Tuple[str, str, Optional[str]]
 
 
-def _gather_flag_bits(engine, snap, ctxs) -> dict:
-    """Materialize each chunk's async bits fetch and return {feature key:
-    bitset row} for EVERY flagged row that is not covered by an in-call
-    bitmap or a launch-time cache-value snapshot (ctx["flag_cached"]) —
-    duplicate keys within/across chunks share one entry, and rows whose
-    cache entry was evicted between launch and resolve are rescued with
-    ONE extra batched fetch (never a serial per-row round trip)."""
-    cache = snap.word_cache
-    key_bits: dict = {}
-    for ctx in ctxs:
-        if ctx["bits_fin"] is not None:
-            bits = ctx["bits_fin"]()  # launched back in _finish_words
-            fkeys = ctx["flag_keys"]
-            for j, k in enumerate(ctx["bits_rows"]):
-                key_bits[fkeys[k]] = bits[j]
-    sync_rows: list = []
-    for ctx in ctxs:
-        bm = ctx["bitmap"]
-        fc = ctx["flag_cached"]
-        for k in ctx["flag_rows"]:
-            if (bm and k in bm) or k in fc:
-                continue
-            key = ctx["flag_keys"][k]
-            if key in key_bits or key in cache:
-                continue
-            key_bits[key] = None  # claimed; filled below
-            sync_rows.append((ctx, k, key))
-    if not sync_rows:
-        return key_bits
-    packed = snap.cs.packed
-    E = max(ctx["ok_extras"].shape[1] for ctx, _k, _key in sync_rows)
-    codes_rows = np.stack([ctx["ok_codes"][k] for ctx, k, _ in sync_rows])
-    extras_rows = np.full(
-        (len(sync_rows), E), packed.L,
-        dtype=sync_rows[0][0]["ok_extras"].dtype,
-    )
-    for j, (ctx, k, _) in enumerate(sync_rows):
-        row = ctx["ok_extras"][k]
-        extras_rows[j, : row.shape[0]] = row
-    bits = engine.match_bits_arrays(codes_rows, extras_rows, cs=snap.cs)
-    for j, (_ctx, _k, key) in enumerate(sync_rows):
-        key_bits[key] = bits[j]
-    return key_bits
-
-
 class _Snapshot(NamedTuple):
     """Immutable (encoder, compiled set, caches) tuple.
 
@@ -121,26 +93,40 @@ class _Snapshot(NamedTuple):
     encoder: Optional[NativeEncoder]
     cs: object  # the _CompiledSet the encoder was built on
     reason_cache: dict  # policy index -> reason JSON (guarded by GIL appends)
-    # verdict word -> shared decoded payload; verdict diversity is tiny
-    # (distinct winning policies), so decode is one dict hit per row
+    # verdict word -> shared decoded payload (and feature-row bytes ->
+    # flagged-row payload); verdict diversity is tiny, so decode is one
+    # dict hit per row
     word_cache: dict
 
 
-class SARFastPath:
-    """Batch evaluator over raw SubjectAccessReview JSON bodies."""
+class _RawFastPath:
+    """The shared chunked raw-bytes pipeline (see module docstring).
 
-    def __init__(
-        self,
-        engine: TPUPolicyEngine,
-        authorizer: CedarWebhookAuthorizer,
-        fallback: Optional[Callable[[bytes], Result]] = None,
-    ):
+    Subclasses implement `_encode`, `_route_flags`, `_fallback_row`,
+    `_run_gated`, `_decode_word_payload`, `_decode_bits_payload`, and
+    `_emit`; everything else — snapshot management, chunk overlap, clean
+    decode, deferred gated/flagged resolution, memoization — lives here
+    once."""
+
+    # chunk size for the encode/device overlap pipeline: chunk k's device
+    # work proceeds while the host encodes chunk k+1. 16384 measured best
+    # on the 1-core serving host (4+ chunks in flight at NB=65536 hide the
+    # tunnel RTT; bigger chunks expose more of the tail bits fetch)
+    _CHUNK = 16384
+    # above this row count, skip the in-call diagnostics bitset plane
+    # (want_bits): computing + compacting [B, R/32] bitsets costs ~4x the
+    # plain match at large B, while flagged rows are rare (<1%) — fetching
+    # their bitsets in a second fixed-shape call (match_bits_arrays) is far
+    # cheaper in the throughput regime. Small batches keep the in-call
+    # payload: there a second device round trip costs more than the bits
+    # plane.
+    _BITS_INCALL_MAX = 4096
+
+    def __init__(self, engine: TPUPolicyEngine):
         self.engine = engine
-        self.authorizer = authorizer
-        self._fallback = fallback or self._python_fallback
         self._snap: Optional[_Snapshot] = None
         self._build_lock = threading.Lock()
-        # encode/device/decode seconds for the last authorize_raw call
+        # encode/device/decode seconds for the last process_raw call
         self.last_stage_s: dict = {}
 
     # ---------------------------------------------------------- availability
@@ -150,7 +136,7 @@ class SARFastPath:
         the native encoder when the set changes (policy hot swap); None when
         the set or environment rules the fast path out.
 
-        Interpreter-fallback policies no longer disable the native plane:
+        Interpreter-fallback policies do NOT disable the native plane:
         their scopes are packed as device gate rules (compiler.pack), and
         rows whose verdict word carries WORD_GATE re-run through the exact
         Python path — everything else stays native."""
@@ -178,28 +164,368 @@ class SARFastPath:
                 self._snap = snap
         return snap if snap.encoder is not None else None
 
-    @staticmethod
-    def _reason(snap: _Snapshot, pol: int) -> str:
-        """Reason JSON for a single-policy match; cached on the snapshot — it
-        depends only on the policy index within that compiled set."""
-        r = snap.reason_cache.get(pol)
-        if r is None:
-            from ..lang.authorize import Diagnostics, Reason
-
-            meta = snap.cs.packed.policy_meta[pol]
-            r = _diagnostic_to_reason(
-                Diagnostics(
-                    reasons=[Reason(meta.policy_id, meta.filename, meta.position)]
-                )
-            )
-            snap.reason_cache[pol] = r
-        return r
-
     @property
     def available(self) -> bool:
         return self._current_snapshot() is not None
 
-    # ------------------------------------------------------------ evaluation
+    # ----------------------------------------------------- subclass surface
+
+    def _encode(self, snap: _Snapshot, bodies: Sequence[bytes]):
+        """-> (codes, extras, counts, flags, aux) for one chunk."""
+        raise NotImplementedError
+
+    def _route_flags(self, flags, results, bodies, aux) -> np.ndarray:
+        """Fill encoder-gate rows into `results`; return the row indices
+        that need the per-row Python fallback."""
+        raise NotImplementedError
+
+    def _fallback_row(self, body: bytes):
+        """Exact Python path for one raw body."""
+        raise NotImplementedError
+
+    def _run_gated(self, bodies: List[bytes]) -> list:
+        """Exact Python path for gate-flagged rows, batched."""
+        raise NotImplementedError
+
+    def _decode_word_payload(self, snap: _Snapshot, word: int):
+        """Decode + cache the shared payload for one clean verdict word."""
+        raise NotImplementedError
+
+    def _decode_bits_payload(self, snap: _Snapshot, row_bits):
+        """Decode one rule-bitset row into the shared payload."""
+        raise NotImplementedError
+
+    def _emit(self, payload, i: int, aux):
+        """Render a shared payload into the response value for row i."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- pipeline
+
+    def process_raw(self, bodies: Sequence[bytes], snap: _Snapshot) -> list:
+        """Evaluate a batch of raw JSON bodies through the native plane.
+
+        Large batches run a two-phase pipeline: each chunk's C++ encode +
+        async device launch (_prepare_chunk) happens while the previous
+        chunk's device work is in flight; materialization + verdict decode
+        (_finish_words) drains in order; gated and flagged rows across ALL
+        chunks resolve in one deferred pass. `last_stage_s` records the
+        per-call encode/device/decode split for the bench's stage budget."""
+        self.last_stage_s = {"encode": 0.0, "device": 0.0, "decode": 0.0}
+        n = len(bodies)
+        pending = []
+        for lo in range(0, n, self._CHUNK):
+            chunk = bodies[lo : lo + self._CHUNK]
+            pending.append((chunk, self._prepare_chunk(snap, chunk)))
+        ctxs = [self._finish_words(snap, chunk, pre) for chunk, pre in pending]
+        self._resolve_deferred(snap, ctxs)
+        if len(ctxs) == 1:
+            return ctxs[0]["results"]
+        out: list = []
+        for ctx in ctxs:
+            out.extend(ctx["results"])
+        return out
+
+    def _prepare_chunk(self, snap: _Snapshot, bodies: Sequence[bytes]):
+        """Encode one chunk natively and LAUNCH its device match; the device
+        work proceeds asynchronously while the caller prepares the next
+        chunk."""
+        t0 = time.monotonic()
+        codes, extras, counts, flags, aux = self._encode(snap, bodies)
+        results: list = [None] * len(bodies)
+        py_rows = self._route_flags(flags, results, bodies, aux)
+
+        ok = flags == F_OK
+        n_ok = int(ok.sum())
+        idx = ok_codes = ok_extras = fin = None
+        if n_ok:
+            all_ok = n_ok == len(bodies)
+            idx = np.arange(len(bodies)) if all_ok else np.nonzero(ok)[0]
+            ok_codes = codes if all_ok else codes[idx]
+            # trim the extras buffer to the live width (bucketed to avoid
+            # retraces): most requests carry zero extras, and every padded
+            # column costs a [B, E, L] broadcast-compare on device
+            max_e = int(
+                counts.max(initial=0) if all_ok else counts[idx].max(initial=0)
+            )
+            if max_e == 0:
+                E = 1
+            else:
+                E = min(
+                    _round_bucket(max_e, (8, 16, 32, 64, 128, 256)),
+                    extras.shape[1],
+                )
+            ok_extras = extras[:, :E] if all_ok else extras[idx, :E]
+            # small batches: rule bitsets for multi/err rows arrive
+            # compacted IN the same device call (zero extra round trips
+            # over the high-RTT link). Large batches skip the bits plane;
+            # the deferred resolve fetches the rare flagged rows' bitsets
+            # in a second fixed-shape call instead.
+            fin = self.engine.match_arrays_launch(
+                ok_codes, ok_extras, cs=snap.cs,
+                want_bits=n_ok <= self._BITS_INCALL_MAX,
+            )
+        self.last_stage_s["encode"] += time.monotonic() - t0
+        return results, py_rows, idx, ok_codes, ok_extras, fin, aux
+
+    def _finish_words(self, snap: _Snapshot, bodies, pre) -> dict:
+        """Materialize one chunk's verdict words and decode every CLEAN row
+        (one shared payload per distinct word — the r03 per-row branch
+        chain was the serving-path bottleneck at ~10us/row). Gate-flagged
+        and multi/err rows are recorded for _resolve_deferred."""
+        results, py_rows, idx, ok_codes, ok_extras, fin, aux = pre
+        for i in py_rows:
+            results[i] = self._fallback_row(bodies[i])
+        ctx = {
+            "results": results,
+            "bodies": bodies,
+            "idx": idx,
+            "aux": aux,
+            "ok_codes": ok_codes,
+            "ok_extras": ok_extras,
+            "bitmap": None,
+            "gate_rows": [],
+            "flag_rows": [],
+            "flag_keys": {},
+            "flag_cached": {},
+            "bits_rows": [],
+            "bits_fin": None,
+        }
+        if fin is None:
+            return ctx
+        t0 = time.monotonic()
+        out = fin()
+        words, bitmap = out[0], (out[2] if len(out) == 3 else None)
+        t1 = time.monotonic()
+        self.last_stage_s["device"] += t1 - t0
+        w = words.astype(np.uint32)
+        ctx["bitmap"] = bitmap
+        handled = set()
+        if snap.cs.packed.has_gate:
+            ctx["gate_rows"] = np.nonzero((w & WORD_GATE) != 0)[0].tolist()
+            handled.update(ctx["gate_rows"])
+        flagged = np.nonzero((w & (WORD_ERR | WORD_MULTI)) != 0)[0].tolist()
+        ctx["flag_rows"] = [k for k in flagged if k not in handled]
+        handled.update(ctx["flag_rows"])
+        # a flagged row's complete reason set is a pure function of its
+        # feature row (codes + extras fully determine the rule bitset), so
+        # rows whose feature bytes were resolved before skip the fetch —
+        # in steady state repeating traffic pays no bits round trip at all.
+        # Launch the fetch for the truly-new rows NOW: it rides the link
+        # while this (and later) chunks decode, instead of paying a serial
+        # round trip at resolve time.
+        cache = snap.word_cache
+        if len(cache) > 200_000:  # adversarial-traffic growth bound;
+            cache.clear()  # evict BEFORE the membership checks below
+        miss = []
+        miss_keys = set()  # dedupe repeats WITHIN the chunk too
+        fkeys = ctx["flag_keys"]
+        fc = ctx["flag_cached"]
+        for k in ctx["flag_rows"]:
+            if bitmap and k in bitmap:
+                continue
+            key = ok_codes[k].tobytes() + ok_extras[k].tobytes()
+            fkeys[k] = key
+            cached = cache.get(key)
+            if cached is not None:
+                # snapshot the VALUE now: a concurrent eviction between
+                # launch and resolve must not strand the row
+                fc[k] = cached
+            elif key not in miss_keys:
+                miss.append(k)
+                miss_keys.add(key)
+        if miss:
+            ctx["bits_rows"] = miss
+            ctx["bits_fin"] = self.engine.match_bits_arrays_launch(
+                ok_codes[miss], ok_extras[miss], cs=snap.cs
+            )
+        decode = self._decode_word_payload
+        emit = self._emit
+        wl = w.tolist()
+        if handled:
+            for k, i in enumerate(idx.tolist()):
+                if k in handled:
+                    continue
+                word = wl[k]
+                payload = cache.get(word)
+                if payload is None:
+                    payload = decode(snap, word)
+                results[i] = emit(payload, i, aux)
+        else:
+            for k, i in enumerate(idx.tolist()):
+                word = wl[k]
+                payload = cache.get(word)
+                if payload is None:
+                    payload = decode(snap, word)
+                results[i] = emit(payload, i, aux)
+        self.last_stage_s["decode"] += time.monotonic() - t1
+        return ctx
+
+    def _resolve_deferred(self, snap: _Snapshot, ctxs: List[dict]) -> None:
+        """Resolve every chunk's gate-flagged and multi/err rows in ONE
+        pass: a single batched Python re-run for gated rows and a single
+        cross-chunk bits gather for flagged rows, instead of per-chunk
+        device round trips."""
+        gated = [(ctx, k) for ctx in ctxs for k in ctx["gate_rows"]]
+        if gated:
+            g_res = self._run_gated(
+                [ctx["bodies"][int(ctx["idx"][k])] for ctx, k in gated]
+            )
+            for (ctx, k), res in zip(gated, g_res):
+                ctx["results"][int(ctx["idx"][k])] = res
+
+        cache = snap.word_cache
+        decode_bits = self._decode_bits_payload
+        key_bits = _gather_flag_bits(self.engine, snap, ctxs)
+        for ctx in ctxs:
+            if not ctx["flag_rows"]:
+                continue
+            bm = ctx["bitmap"]
+            fc = ctx["flag_cached"]
+            fkeys = ctx["flag_keys"]
+            aux = ctx["aux"]
+            for k in ctx["flag_rows"]:
+                if bm and k in bm:
+                    payload = decode_bits(snap, bm[k])
+                elif k in fc:
+                    payload = fc[k]
+                else:
+                    key = fkeys[k]
+                    payload = cache.get(key)
+                    if payload is None:
+                        payload = cache[key] = decode_bits(snap, key_bits[key])
+                i = int(ctx["idx"][k])
+                ctx["results"][i] = self._emit(payload, i, aux)
+
+
+def _gather_flag_bits(engine, snap, ctxs) -> dict:
+    """Materialize each chunk's async bits fetch and return {feature key:
+    bitset row} for EVERY flagged row that is not covered by an in-call
+    bitmap or a launch-time cache-value snapshot (ctx["flag_cached"]) —
+    duplicate keys within/across chunks share one entry, and rows whose
+    cache entry was evicted between launch and resolve are rescued with
+    ONE extra batched fetch (never a serial per-row round trip)."""
+    cache = snap.word_cache
+    key_bits: dict = {}
+    for ctx in ctxs:
+        if ctx["bits_fin"] is not None:
+            bits = ctx["bits_fin"]()  # launched back in _finish_words
+            fkeys = ctx["flag_keys"]
+            for j, k in enumerate(ctx["bits_rows"]):
+                key_bits[fkeys[k]] = bits[j]
+    sync_rows: list = []
+    for ctx in ctxs:
+        bm = ctx["bitmap"]
+        fc = ctx["flag_cached"]
+        for k in ctx["flag_rows"]:
+            if (bm and k in bm) or k in fc:
+                continue
+            key = ctx["flag_keys"][k]
+            if key in key_bits:
+                continue
+            # NOT skipped when the key is (currently) in the shared cache:
+            # a concurrent caller's eviction could clear it between this
+            # check and the resolve loop, stranding the row — claiming the
+            # bits row here makes resolve self-sufficient, and the cost is
+            # one redundant row in a fetch that's already batched
+            key_bits[key] = None  # claimed; filled below
+            sync_rows.append((ctx, k, key))
+    if not sync_rows:
+        return key_bits
+    packed = snap.cs.packed
+    E = max(ctx["ok_extras"].shape[1] for ctx, _k, _key in sync_rows)
+    codes_rows = np.stack([ctx["ok_codes"][k] for ctx, k, _ in sync_rows])
+    extras_rows = np.full(
+        (len(sync_rows), E), packed.L,
+        dtype=sync_rows[0][0]["ok_extras"].dtype,
+    )
+    for j, (ctx, k, _) in enumerate(sync_rows):
+        row = ctx["ok_extras"][k]
+        extras_rows[j, : row.shape[0]] = row
+    bits = engine.match_bits_arrays(codes_rows, extras_rows, cs=snap.cs)
+    for j, (_ctx, _k, key) in enumerate(sync_rows):
+        key_bits[key] = bits[j]
+    return key_bits
+
+
+class SARFastPath(_RawFastPath):
+    """Batch evaluator over raw SubjectAccessReview JSON bodies."""
+
+    def __init__(
+        self,
+        engine: TPUPolicyEngine,
+        authorizer: CedarWebhookAuthorizer,
+        fallback: Optional[Callable[[bytes], Result]] = None,
+    ):
+        super().__init__(engine)
+        self.authorizer = authorizer
+        self._fallback = fallback or self._python_fallback
+
+    def authorize_raw(self, bodies: Sequence[bytes]) -> List[Result]:
+        """Evaluate a batch of raw SAR JSON bodies -> (decision, reason)."""
+        snap = self._current_snapshot()
+        if snap is None:
+            return [self._fallback(b) for b in bodies]
+        if not self.authorizer.ready():
+            # NoOpinion until every store's initial load completes
+            # (authorizer.go:58-66); gates still apply, so run the exact path
+            return [self._fallback(b) for b in bodies]
+        return self.process_raw(bodies, snap)
+
+    # --------------------------------------------------------------- hooks
+
+    def _encode(self, snap, bodies):
+        codes, extras, counts, flags = snap.encoder.encode_batch(bodies)
+        return codes, extras, counts, flags, None
+
+    def _route_flags(self, flags, results, bodies, aux):
+        for flag, res in _GATE_RESULTS.items():
+            for i in np.nonzero(flags == flag)[0]:
+                results[i] = res
+        return np.nonzero(
+            (flags == F_PARSE_ERROR) | (flags == F_EXTRAS_OVERFLOW)
+        )[0]
+
+    def _fallback_row(self, body: bytes) -> Result:
+        return self._fallback(body)
+
+    def _run_gated(self, bodies: List[bytes]) -> List[Result]:
+        if self._fallback == self._python_fallback:
+            return self._gated_batch(bodies)
+        # honor an injected custom fallback per row
+        return [self._fallback(b) for b in bodies]
+
+    def _decode_word_payload(self, snap: _Snapshot, word: int) -> Result:
+        """Decode + cache one clean verdict word (no multi/err/gate flags —
+        those rows are handled upstream). The deny-on-error log fires once
+        per distinct word per snapshot, not once per row."""
+        code = (word >> 30) & 0x3
+        pol = word & 0xFFFFFF
+        if code == 1:
+            r: Result = (DECISION_ALLOW, self._reason(snap, pol), None)
+        elif code == 2:
+            r = (DECISION_DENY, self._reason(snap, pol), None)
+        else:
+            if code == 3:
+                meta = snap.cs.packed.policy_meta[pol]
+                log.error(
+                    "Authorize errors: while evaluating policy `%s`:"
+                    " evaluation error",
+                    meta.policy_id,
+                )
+            r = (DECISION_NO_OPINION, "", None)
+        snap.word_cache[word] = r
+        return r
+
+    def _decode_bits_payload(self, snap: _Snapshot, row_bits) -> Result:
+        packed = snap.cs.packed
+        groups = self.engine._bits_groups(packed, row_bits)
+        decision, diag = self.engine._finalize_sets(packed, groups, None, None)
+        return self._map_decision(decision, diag)
+
+    def _emit(self, payload: Result, i: int, aux) -> Result:
+        return payload  # Result tuples are shared directly across rows
+
+    # ---------------------------------------------------------- python path
 
     def _python_fallback(self, body: bytes) -> Result:
         import json
@@ -268,263 +594,23 @@ class SARFastPath:
                     results[i] = self._map_decision(decision, diag)
         return results  # type: ignore[return-value]
 
-    # chunk size for the encode/device overlap pipeline: chunk k's device
-    # work proceeds while the host encodes chunk k+1. 16384 measured best
-    # on the 1-core serving host (4+ chunks in flight at NB=65536 hide the
-    # tunnel RTT; bigger chunks expose more of the tail bits fetch)
-    _CHUNK = 16384
-    # above this row count, skip the in-call diagnostics bitset plane
-    # (want_bits): computing + compacting [B, R/32] bitsets costs ~4x the
-    # plain match at large B, while flagged rows are rare (<1%) — fetching
-    # their bitsets in a second fixed-shape call (resolve_flagged ->
-    # match_bits_arrays) is far cheaper in the throughput regime. Small
-    # batches keep the in-call payload: there a second device round trip
-    # costs more than the bits plane.
-    _BITS_INCALL_MAX = 4096
+    # -------------------------------------------------------------- helpers
 
-    def authorize_raw(self, bodies: Sequence[bytes]) -> List[Result]:
-        """Evaluate a batch of raw SAR JSON bodies -> (decision, reason).
+    @staticmethod
+    def _reason(snap: _Snapshot, pol: int) -> str:
+        """Reason JSON for a single-policy match; cached on the snapshot — it
+        depends only on the policy index within that compiled set."""
+        r = snap.reason_cache.get(pol)
+        if r is None:
+            from ..lang.authorize import Diagnostics, Reason
 
-        Large batches run a two-phase pipeline: each chunk's C++ encode +
-        async device launch (_prepare_chunk) happens while the previous
-        chunk's device work is in flight; materialization + verdict decode
-        (_finish_chunk) drains in order. `last_stage_s` records the per-call
-        encode/device/decode split for the bench's stage budget."""
-        snap = self._current_snapshot()
-        if snap is None:
-            return [self._fallback(b) for b in bodies]
-        if not self.authorizer.ready():
-            # NoOpinion until every store's initial load completes
-            # (authorizer.go:58-66); gates still apply, so run the exact path
-            return [self._fallback(b) for b in bodies]
-
-        self.last_stage_s = {"encode": 0.0, "device": 0.0, "decode": 0.0}
-        n = len(bodies)
-        pending = []
-        for lo in range(0, n, self._CHUNK):
-            chunk = bodies[lo : lo + self._CHUNK]
-            pending.append((chunk, self._prepare_chunk(snap, chunk)))
-        # drain words + decode clean rows per chunk; flagged/gated rows are
-        # DEFERRED and resolved across all chunks in one pass (one bits
-        # fetch + one gated batch instead of per-chunk round trips)
-        ctxs = [self._finish_words(snap, chunk, pre) for chunk, pre in pending]
-        self._resolve_deferred(snap, ctxs)
-        if len(ctxs) == 1:
-            return ctxs[0]["results"]
-        out: List[Result] = []
-        for ctx in ctxs:
-            out.extend(ctx["results"])
-        return out
-
-    def _prepare_chunk(self, snap: _Snapshot, bodies: Sequence[bytes]):
-        """Encode one chunk natively and LAUNCH its device match; the device
-        work proceeds asynchronously while the caller prepares the next
-        chunk. Returns (results skeleton, py_rows, idx, ok_codes, ok_extras,
-        finish)."""
-        import time
-
-        t0 = time.monotonic()
-        encoder, cs = snap.encoder, snap.cs
-        codes, extras, _counts, flags = encoder.encode_batch(bodies)
-        results: List[Optional[Result]] = [None] * len(bodies)
-
-        ok = flags == F_OK
-        for flag, res in _GATE_RESULTS.items():
-            for i in np.nonzero(flags == flag)[0]:
-                results[i] = res
-        py_rows = np.nonzero(
-            (flags == F_PARSE_ERROR) | (flags == F_EXTRAS_OVERFLOW)
-        )[0]
-
-        n_ok = int(ok.sum())
-        idx = ok_codes = ok_extras = fin = None
-        if n_ok:
-            all_ok = n_ok == len(bodies)
-            idx = np.arange(len(bodies)) if all_ok else np.nonzero(ok)[0]
-            ok_codes = codes if all_ok else codes[idx]
-            # trim the extras buffer to the live width (bucketed to avoid
-            # retraces): most requests carry zero extras, and every padded
-            # column costs a [B, E, L] broadcast-compare on device
-            from .evaluator import _round_bucket
-
-            max_e = int(_counts.max(initial=0) if all_ok else _counts[idx].max(initial=0))
-            if max_e == 0:
-                E = 1
-            else:
-                E = min(
-                    _round_bucket(max_e, (8, 16, 32, 64, 128, 256)),
-                    extras.shape[1],
+            meta = snap.cs.packed.policy_meta[pol]
+            r = _diagnostic_to_reason(
+                Diagnostics(
+                    reasons=[Reason(meta.policy_id, meta.filename, meta.position)]
                 )
-            ok_extras = extras[:, :E] if all_ok else extras[idx, :E]
-            # small batches: rule bitsets for multi/err rows arrive
-            # compacted IN the same device call (zero extra round trips
-            # over the high-RTT link). Large batches skip the bits plane;
-            # resolve_flagged fetches the rare flagged rows' bitsets in one
-            # second fixed-shape call instead.
-            fin = self.engine.match_arrays_launch(
-                ok_codes, ok_extras, cs=cs,
-                want_bits=n_ok <= self._BITS_INCALL_MAX,
             )
-        self.last_stage_s["encode"] += time.monotonic() - t0
-        return results, py_rows, idx, ok_codes, ok_extras, fin
-
-    def _finish_words(self, snap: _Snapshot, bodies, pre) -> dict:
-        """Materialize one chunk's verdict words and decode every CLEAN row
-        (one shared Result per distinct word — the r03 per-row branch chain
-        was the serving-path bottleneck at ~10us/row). Gate-flagged and
-        multi/err rows are recorded for _resolve_deferred."""
-        import time
-
-        results, py_rows, idx, ok_codes, ok_extras, fin = pre
-        for i in py_rows:
-            results[i] = self._fallback(bodies[i])
-        ctx = {
-            "results": results,
-            "bodies": bodies,
-            "idx": idx,
-            "ok_codes": ok_codes,
-            "ok_extras": ok_extras,
-            "bitmap": None,
-            "w": None,
-            "gate_rows": [],
-            "flag_rows": [],
-            "flag_keys": {},
-            "flag_cached": {},
-            "bits_rows": [],
-            "bits_fin": None,
-        }
-        if fin is None:
-            return ctx
-        t0 = time.monotonic()
-        out = fin()
-        words, bitmap = out[0], (out[2] if len(out) == 3 else None)
-        t1 = time.monotonic()
-        self.last_stage_s["device"] += t1 - t0
-        w = words.astype(np.uint32)
-        ctx["w"] = w
-        ctx["bitmap"] = bitmap
-        handled = set()
-        if snap.cs.packed.has_gate:
-            ctx["gate_rows"] = np.nonzero((w & WORD_GATE) != 0)[0].tolist()
-            handled.update(ctx["gate_rows"])
-        flagged = np.nonzero((w & (WORD_ERR | WORD_MULTI)) != 0)[0].tolist()
-        ctx["flag_rows"] = [k for k in flagged if k not in handled]
-        handled.update(ctx["flag_rows"])
-        # a flagged row's complete reason set is a pure function of its
-        # feature row (codes + extras fully determine the rule bitset), so
-        # rows whose feature bytes were resolved before skip the fetch —
-        # in steady state repeating traffic pays no bits round trip at all.
-        # Launch the fetch for the truly-new rows NOW: it rides the link
-        # while this (and later) chunks decode, instead of paying a serial
-        # round trip at resolve time.
-        cache = snap.word_cache
-        if len(cache) > 200_000:  # adversarial-traffic growth bound;
-            cache.clear()  # evict BEFORE the membership checks below
-        miss = []
-        miss_keys = set()  # dedupe repeats WITHIN the chunk too
-        fkeys = ctx["flag_keys"] = {}
-        fc = ctx["flag_cached"]
-        for k in ctx["flag_rows"]:
-            if bitmap and k in bitmap:
-                continue
-            key = ok_codes[k].tobytes() + ok_extras[k].tobytes()
-            fkeys[k] = key
-            cached = cache.get(key)
-            if cached is not None:
-                # snapshot the VALUE now: a concurrent eviction between
-                # launch and resolve must not strand the row
-                fc[k] = cached
-            elif key not in miss_keys:
-                miss.append(k)
-                miss_keys.add(key)
-        if miss:
-            ctx["bits_rows"] = miss
-            ctx["bits_fin"] = self.engine.match_bits_arrays_launch(
-                ok_codes[miss], ok_extras[miss], cs=snap.cs
-            )
-        decode = self._decode_word
-        wl = w.tolist()
-        if handled:
-            for k, i in enumerate(idx.tolist()):
-                if k in handled:
-                    continue
-                word = wl[k]
-                r = cache.get(word)
-                results[i] = r if r is not None else decode(snap, word)
-        else:
-            for k, i in enumerate(idx.tolist()):
-                word = wl[k]
-                r = cache.get(word)
-                results[i] = r if r is not None else decode(snap, word)
-        self.last_stage_s["decode"] += time.monotonic() - t1
-        return ctx
-
-    def _resolve_deferred(self, snap: _Snapshot, ctxs: List[dict]) -> None:
-        """Resolve every chunk's gate-flagged and multi/err rows in ONE
-        pass: a single batched Python re-run for gated rows and a single
-        bits fetch for flagged rows, instead of per-chunk device round
-        trips."""
-        gated = [
-            (ctx, k) for ctx in ctxs for k in ctx["gate_rows"]
-        ]
-        if gated:
-            g_bodies = [ctx["bodies"][int(ctx["idx"][k])] for ctx, k in gated]
-            if self._fallback == self._python_fallback:
-                g_res = self._gated_batch(g_bodies)
-            else:  # honor an injected custom fallback per row
-                g_res = [self._fallback(b) for b in g_bodies]
-            for (ctx, k), res in zip(gated, g_res):
-                ctx["results"][int(ctx["idx"][k])] = res
-
-        packed = snap.cs.packed
-        cache = snap.word_cache
-
-        def decode_bits(row_bits) -> Result:
-            groups = self.engine._bits_groups(packed, row_bits)
-            decision, diag = self.engine._finalize_sets(
-                packed, groups, None, None
-            )
-            return self._map_decision(decision, diag)
-
-        key_bits = _gather_flag_bits(self.engine, snap, ctxs)
-        for ctx in ctxs:
-            if not ctx["flag_rows"]:
-                continue
-            bm = ctx["bitmap"]
-            fc = ctx["flag_cached"]
-            fkeys = ctx["flag_keys"]
-            for k in ctx["flag_rows"]:
-                if bm and k in bm:
-                    r = decode_bits(bm[k])
-                elif k in fc:
-                    r = fc[k]
-                else:
-                    key = fkeys[k]
-                    r = cache.get(key)
-                    if r is None:
-                        r = cache[key] = decode_bits(key_bits[key])
-                ctx["results"][int(ctx["idx"][k])] = r
-
-    def _decode_word(self, snap: _Snapshot, word: int) -> Result:
-        """Decode + cache one clean verdict word (no multi/err/gate flags —
-        those rows are handled upstream). The deny-on-error log fires once
-        per distinct word per snapshot, not once per row."""
-        code = (word >> 30) & 0x3
-        pol = word & 0xFFFFFF
-        if code == 1:
-            r: Result = (DECISION_ALLOW, self._reason(snap, pol), None)
-        elif code == 2:
-            r = (DECISION_DENY, self._reason(snap, pol), None)
-        else:
-            if code == 3:
-                meta = snap.cs.packed.policy_meta[pol]
-                log.error(
-                    "Authorize errors: while evaluating policy `%s`:"
-                    " evaluation error",
-                    meta.policy_id,
-                )
-            r = (DECISION_NO_OPINION, "", None)
-        snap.word_cache[word] = r
+            snap.reason_cache[pol] = r
         return r
 
     @staticmethod
@@ -539,51 +625,110 @@ class SARFastPath:
         return DECISION_NO_OPINION, "", None
 
 
-class AdmissionFastPath:
+class AdmissionFastPath(_RawFastPath):
     """Batch evaluator over raw AdmissionReview JSON bodies — the admission
-    analogue of SARFastPath. The C++ encoder parses the review, walks the
+    twin of SARFastPath. The C++ encoder parses the review, walks the
     (old)object into feature codes (native/encoder.cpp build_adm, mirroring
     entities/admission.py and reference
     internal/server/entities/admission.go:160-369), and the batched device
     kernel produces the verdicts; deny messages carry the complete
     matched-policy list like the reference's handler
-    (internal/server/admission/handler.go:157-164). Rows the native walk
-    can't prove identical (parse quirks, unsupported leaf shapes, extras
-    overflow) re-run through the exact Python handler."""
+    (internal/server/admission/handler.go:157-164)."""
 
     def __init__(self, engine: TPUPolicyEngine, handler):
-        self.engine = engine
+        super().__init__(engine)
         self.handler = handler  # CedarAdmissionHandler: fallback + readiness
-        self._snap: Optional[_Snapshot] = None
-        self._build_lock = threading.Lock()
+        # bound once: _emit runs per row on the clean-decode hot loop
+        from ..server.admission import AdmissionResponse
 
-    def _current_snapshot(self) -> Optional[_Snapshot]:
-        cs = self.engine._compiled
-        if cs is None:
-            return None
-        snap = self._snap
-        if snap is not None and snap.cs is cs:
-            return snap if snap.encoder is not None else None
-        with self._build_lock:
-            cs = self.engine._compiled
-            if cs is None:
-                return None
-            snap = self._snap
-            if snap is None or snap.cs is not cs:
-                try:
-                    encoder = NativeEncoder.create(cs.packed)
-                except Exception:  # noqa: BLE001 — cache the failure
-                    log.exception(
-                        "native admission encoder build failed; python path only"
-                    )
-                    encoder = None
-                snap = _Snapshot(encoder, cs, {}, {})
-                self._snap = snap
-        return snap if snap.encoder is not None else None
+        self._response_cls = AdmissionResponse
 
-    @property
-    def available(self) -> bool:
-        return self._current_snapshot() is not None
+    def handle_raw(self, bodies: Sequence[bytes]) -> list:
+        """Evaluate a batch of raw AdmissionReview JSON bodies."""
+        snap = self._current_snapshot()
+        if snap is None or not self.handler._ready():
+            # unready stores answer allow in handler.handle_batch; keep the
+            # exact path for both cases
+            return [self._py_one(b) for b in bodies]
+        return self.process_raw(bodies, snap)
+
+    # --------------------------------------------------------------- hooks
+
+    def _encode(self, snap, bodies):
+        codes, extras, counts, flags, uids = snap.encoder.encode_adm_batch(
+            bodies
+        )
+        return codes, extras, counts, flags, uids
+
+    def _route_flags(self, flags, results, bodies, uids):
+        from ..server.admission import AdmissionResponse
+
+        for i in np.nonzero(flags == F_ADM_NS_SKIP)[0]:
+            results[i] = AdmissionResponse(uid=uids[i], allowed=True)
+        return np.nonzero(
+            (flags == F_PARSE_ERROR)
+            | (flags == F_ADM_ERROR)
+            | (flags == F_EXTRAS_OVERFLOW)
+        )[0]
+
+    def _fallback_row(self, body: bytes):
+        return self._py_one(body)
+
+    def _run_gated(self, bodies: List[bytes]) -> list:
+        return self._gated_batch(bodies)
+
+    def _decode_word_payload(self, snap: _Snapshot, word: int):
+        """(allowed, message) payload for one clean verdict word, cached per
+        snapshot; error logs fire once per distinct word, not per row."""
+        code = (word >> 30) & 0x3
+        pol = word & 0xFFFFFF
+        if code == 1:
+            payload = (True, "")
+        elif code == 2:
+            payload = (False, self._deny_message(snap, (pol,)))
+        elif code == 3:
+            meta = snap.cs.packed.policy_meta[pol]
+            log.error(
+                "admission errors: while evaluating policy `%s`:"
+                " evaluation error",
+                meta.policy_id,
+            )
+            payload = (False, "")
+        else:  # no signal: the allow-all final tier should preclude
+            log.error(
+                "request denied without reasons; the default permit "
+                "policy was not evaluated"
+            )
+            payload = (False, "")
+        snap.word_cache[word] = payload
+        return payload
+
+    def _decode_bits_payload(self, snap: _Snapshot, row_bits):
+        import json as _json
+
+        packed = snap.cs.packed
+        groups = self.engine._bits_groups(packed, row_bits)
+        decision, diag = self.engine._finalize_sets(packed, groups, None, None)
+        if decision == DENY and diag.reasons:
+            return (
+                False,
+                _json.dumps(
+                    [r.to_dict() for r in diag.reasons],
+                    separators=(",", ":"),
+                ),
+            )
+        if decision == DENY:
+            if diag.errors:
+                log.error("admission errors: %s", diag.errors)
+            return (False, "")
+        return (True, "")
+
+    def _emit(self, payload, i: int, uids):
+        return self._response_cls(
+            uid=uids[i], allowed=payload[0], message=payload[1]
+        )
+
+    # ---------------------------------------------------------- python path
 
     def _parse_one(self, body: bytes):
         """Parse one raw body into an AdmissionRequest. Returns
@@ -624,20 +769,6 @@ class AdmissionFastPath:
             log.exception("admission fastpath fallback failed")
             return self._allow_on_error(review, e)
 
-    def _allow_on_error(self, review, e):
-        from ..server.admission import AdmissionResponse
-
-        uid = ""
-        if isinstance(review, dict):
-            uid = (review.get("request") or {}).get("uid", "") or ""
-        allowed = bool(getattr(self.handler, "allow_on_error", True))
-        return AdmissionResponse(
-            uid=uid,
-            allowed=allowed,
-            code=200,
-            error=f"evaluation error ({'allowed' if allowed else 'denied'} on error): {e}",
-        )
-
     def _gated_batch(self, bodies: Sequence[bytes]) -> list:
         """Exact Python path for gate-flagged rows with ONE batched
         handler.handle_batch call instead of per-row handle dispatches;
@@ -663,6 +794,20 @@ class AdmissionFastPath:
                     results[i] = resp
         return results
 
+    def _allow_on_error(self, review, e):
+        from ..server.admission import AdmissionResponse
+
+        uid = ""
+        if isinstance(review, dict):
+            uid = (review.get("request") or {}).get("uid", "") or ""
+        allowed = bool(getattr(self.handler, "allow_on_error", True))
+        return AdmissionResponse(
+            uid=uid,
+            allowed=allowed,
+            code=200,
+            error=f"evaluation error ({'allowed' if allowed else 'denied'} on error): {e}",
+        )
+
     def _deny_message(self, snap: _Snapshot, pols) -> str:
         """Compact JSON list of reason dicts — byte-identical to the
         handler's _decide rendering (Reason.to_dict per matched policy)."""
@@ -687,236 +832,3 @@ class AdmissionFastPath:
             )
             snap.reason_cache[key] = msg
         return msg
-
-    _CHUNK = 16384  # encode/device overlap chunk (see SARFastPath._CHUNK)
-
-    def handle_raw(self, bodies: Sequence[bytes]) -> list:
-        """Evaluate a batch of raw AdmissionReview JSON bodies. Large
-        batches pipeline: chunk k+1 encodes while chunk k's device work is
-        in flight (same structure as SARFastPath.authorize_raw)."""
-        snap = self._current_snapshot()
-        if snap is None or not self.handler._ready():
-            # unready stores answer allow in handler.handle_batch; keep the
-            # exact path for both cases
-            return [self._py_one(b) for b in bodies]
-        n = len(bodies)
-        pending = []
-        for lo in range(0, n, self._CHUNK):
-            chunk = bodies[lo : lo + self._CHUNK]
-            pending.append((chunk, self._prepare_chunk(snap, chunk)))
-        ctxs = [self._finish_words(snap, chunk, pre) for chunk, pre in pending]
-        self._resolve_deferred(snap, ctxs)
-        if len(ctxs) == 1:
-            return ctxs[0]["results"]
-        out: list = []
-        for ctx in ctxs:
-            out.extend(ctx["results"])
-        return out
-
-    def _prepare_chunk(self, snap: _Snapshot, bodies: Sequence[bytes]):
-        """Encode one chunk natively and LAUNCH its device match."""
-        from ..server.admission import AdmissionResponse
-
-        encoder, cs = snap.encoder, snap.cs
-        codes, extras, _counts, flags, uids = encoder.encode_adm_batch(bodies)
-        results: list = [None] * len(bodies)
-
-        for i in np.nonzero(flags == F_ADM_NS_SKIP)[0]:
-            results[i] = AdmissionResponse(uid=uids[i], allowed=True)
-        py_rows = np.nonzero(
-            (flags == F_PARSE_ERROR)
-            | (flags == F_ADM_ERROR)
-            | (flags == F_EXTRAS_OVERFLOW)
-        )[0]
-
-        ok = flags == F_OK
-        n_ok = int(ok.sum())
-        idx = ok_codes = ok_extras = fin = None
-        if n_ok:
-            all_ok = n_ok == len(bodies)
-            idx = np.arange(len(bodies)) if all_ok else np.nonzero(ok)[0]
-            ok_codes = codes if all_ok else codes[idx]
-            from .evaluator import _round_bucket
-
-            max_e = int(
-                _counts.max(initial=0) if all_ok else _counts[idx].max(initial=0)
-            )
-            if max_e == 0:
-                E = 1
-            else:
-                E = min(
-                    _round_bucket(max_e, (8, 16, 32, 64, 128, 256)),
-                    extras.shape[1],
-                )
-            ok_extras = extras[:, :E] if all_ok else extras[idx, :E]
-            fin = self.engine.match_arrays_launch(
-                ok_codes, ok_extras, cs=cs,
-                want_bits=n_ok <= SARFastPath._BITS_INCALL_MAX,
-            )
-        return results, py_rows, idx, ok_codes, ok_extras, fin, uids
-
-    def _finish_words(self, snap: _Snapshot, bodies, pre) -> dict:
-        """Materialize one chunk's verdict words and decode every clean row
-        (one shared (allowed, message) payload per distinct word; only the
-        uid-bearing response object is built per row). Gate-flagged and
-        multi/err rows are recorded for _resolve_deferred."""
-        from ..server.admission import AdmissionResponse
-
-        results, py_rows, idx, ok_codes, ok_extras, fin, uids = pre
-        for i in py_rows:
-            results[i] = self._py_one(bodies[i])
-        ctx = {
-            "results": results,
-            "bodies": bodies,
-            "idx": idx,
-            "ok_codes": ok_codes,
-            "ok_extras": ok_extras,
-            "uids": uids,
-            "bitmap": None,
-            "w": None,
-            "gate_rows": [],
-            "flag_rows": [],
-            "flag_keys": {},
-            "flag_cached": {},
-            "bits_rows": [],
-            "bits_fin": None,
-        }
-        if fin is None:
-            return ctx
-        out = fin()
-        words, bitmap = out[0], (out[2] if len(out) == 3 else None)
-        w = words.astype(np.uint32)
-        ctx["w"] = w
-        ctx["bitmap"] = bitmap
-        handled = set()
-        if snap.cs.packed.has_gate:
-            ctx["gate_rows"] = np.nonzero((w & WORD_GATE) != 0)[0].tolist()
-            handled.update(ctx["gate_rows"])
-        flagged = np.nonzero((w & (WORD_ERR | WORD_MULTI)) != 0)[0].tolist()
-        ctx["flag_rows"] = [k for k in flagged if k not in handled]
-        handled.update(ctx["flag_rows"])
-        # feature-row keyed memoization + async fetch for the truly-new
-        # rows (see SARFastPath._finish_words)
-        cache = snap.word_cache
-        if len(cache) > 200_000:  # adversarial-traffic growth bound;
-            cache.clear()  # evict BEFORE the membership checks below
-        miss = []
-        miss_keys = set()  # dedupe repeats WITHIN the chunk too
-        fkeys = ctx["flag_keys"]
-        fc = ctx["flag_cached"]
-        for k in ctx["flag_rows"]:
-            if bitmap and k in bitmap:
-                continue
-            key = ok_codes[k].tobytes() + ok_extras[k].tobytes()
-            fkeys[k] = key
-            cached = cache.get(key)
-            if cached is not None:
-                fc[k] = cached  # value snapshot: immune to eviction races
-            elif key not in miss_keys:
-                miss.append(k)
-                miss_keys.add(key)
-        if miss:
-            ctx["bits_rows"] = miss
-            ctx["bits_fin"] = self.engine.match_bits_arrays_launch(
-                ok_codes[miss], ok_extras[miss], cs=snap.cs
-            )
-        decode = self._decode_word
-        wl = w.tolist()
-        for k, i in enumerate(idx.tolist()):
-            if k in handled:
-                continue
-            word = wl[k]
-            payload = cache.get(word)
-            if payload is None:
-                payload = decode(snap, word)
-            results[i] = AdmissionResponse(
-                uid=uids[i], allowed=payload[0], message=payload[1]
-            )
-        return ctx
-
-    def _resolve_deferred(self, snap: _Snapshot, ctxs: list) -> None:
-        """One batched Python re-run for all chunks' gated rows + one bits
-        fetch for all flagged rows (see SARFastPath._resolve_deferred)."""
-        import json as _json
-
-        from ..server.admission import AdmissionResponse
-
-        gated = [(ctx, k) for ctx in ctxs for k in ctx["gate_rows"]]
-        if gated:
-            g_res = self._gated_batch(
-                [ctx["bodies"][int(ctx["idx"][k])] for ctx, k in gated]
-            )
-            for (ctx, k), res in zip(gated, g_res):
-                ctx["results"][int(ctx["idx"][k])] = res
-
-        packed = snap.cs.packed
-        cache = snap.word_cache
-
-        def decode_bits(row_bits):
-            groups = self.engine._bits_groups(packed, row_bits)
-            decision, diag = self.engine._finalize_sets(
-                packed, groups, None, None
-            )
-            if decision == DENY and diag.reasons:
-                return (
-                    False,
-                    _json.dumps(
-                        [r.to_dict() for r in diag.reasons],
-                        separators=(",", ":"),
-                    ),
-                )
-            if decision == DENY:
-                if diag.errors:
-                    log.error("admission errors: %s", diag.errors)
-                return (False, "")
-            return (True, "")
-
-        key_bits = _gather_flag_bits(self.engine, snap, ctxs)
-        for ctx in ctxs:
-            if not ctx["flag_rows"]:
-                continue
-            bm = ctx["bitmap"]
-            fc = ctx["flag_cached"]
-            fkeys = ctx["flag_keys"]
-            for k in ctx["flag_rows"]:
-                if bm and k in bm:
-                    payload = decode_bits(bm[k])
-                elif k in fc:
-                    payload = fc[k]
-                else:
-                    key = fkeys[k]
-                    payload = cache.get(key)
-                    if payload is None:
-                        payload = cache[key] = decode_bits(key_bits[key])
-                i = int(ctx["idx"][k])
-                ctx["results"][i] = AdmissionResponse(
-                    uid=ctx["uids"][i],
-                    allowed=payload[0],
-                    message=payload[1],
-                )
-
-    def _decode_word(self, snap: _Snapshot, word: int):
-        """(allowed, message) payload for one clean verdict word, cached per
-        snapshot; error logs fire once per distinct word, not per row."""
-        code = (word >> 30) & 0x3
-        pol = word & 0xFFFFFF
-        if code == 1:
-            payload = (True, "")
-        elif code == 2:
-            payload = (False, self._deny_message(snap, (pol,)))
-        elif code == 3:
-            meta = snap.cs.packed.policy_meta[pol]
-            log.error(
-                "admission errors: while evaluating policy `%s`:"
-                " evaluation error",
-                meta.policy_id,
-            )
-            payload = (False, "")
-        else:  # no signal: the allow-all final tier should preclude
-            log.error(
-                "request denied without reasons; the default permit "
-                "policy was not evaluated"
-            )
-            payload = (False, "")
-        snap.word_cache[word] = payload
-        return payload

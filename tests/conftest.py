@@ -23,6 +23,11 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# incidental engine loads must not each spawn the ~20-compile background
+# warm-up ladder (tests that exercise warm-up pass warm="async" explicitly,
+# which is never overridden)
+os.environ.setdefault("CEDAR_TPU_WARM_DEFAULT", "off")
+
 
 def pytest_addoption(parser):
     parser.addoption(
