@@ -326,3 +326,89 @@ def test_crd_store_relist_same_content_no_bump():
     g1 = store.content_generation()
     store._relist()  # watch-reconnect relist, identical content
     assert store.content_generation() == g1
+
+
+def test_boto3_avp_client_adapter_with_faithful_sdk_mock(monkeypatch):
+    """Drive the REAL Boto3AVPClient adapter (not the protocol fake)
+    against a mock boto3 module whose responses carry the Verified
+    Permissions API's actual wire shapes — multi-page ListPolicies
+    pagination, definition.static.statement extraction, and templateLinked
+    policies without a static statement (reference
+    internal/server/store/verified_permissions.go:58-99)."""
+    import sys
+    import types
+
+    pages = [
+        {"policies": [{"policyId": "p-aaa", "policyType": "STATIC"},
+                      {"policyId": "p-bbb", "policyType": "STATIC"}],
+         "nextToken": "t1"},
+        {"policies": [{"policyId": "p-ccc", "policyType": "TEMPLATE_LINKED"}]},
+    ]
+    statements = {
+        "p-aaa": 'permit (principal, action, resource) when '
+                 '{ principal.name == "avp-user" };',
+        "p-bbb": 'forbid (principal, action, resource) when '
+                 '{ resource.resource == "nodes" };',
+    }
+    calls = {"paginate": [], "get_policy": []}
+
+    class Paginator:
+        def paginate(self, **kw):
+            calls["paginate"].append(kw)
+            yield from pages
+
+    class Client:
+        def get_paginator(self, op):
+            assert op == "list_policies"
+            return Paginator()
+
+        def get_policy(self, policyStoreId, policyId):
+            calls["get_policy"].append((policyStoreId, policyId))
+            if policyId in statements:
+                return {
+                    "policyStoreId": policyStoreId,
+                    "policyId": policyId,
+                    "policyType": "STATIC",
+                    "definition": {
+                        "static": {"statement": statements[policyId]}
+                    },
+                }
+            # templateLinked policies carry no static statement
+            return {
+                "policyStoreId": policyStoreId,
+                "policyId": policyId,
+                "policyType": "TEMPLATE_LINKED",
+                "definition": {"templateLinked": {"policyTemplateId": "t-1"}},
+            }
+
+    class Session:
+        def __init__(self, **kw):
+            calls["session"] = kw
+
+        def client(self, service):
+            assert service == "verifiedpermissions"
+            return Client()
+
+    fake_boto3 = types.ModuleType("boto3")
+    fake_boto3.Session = Session
+    monkeypatch.setitem(sys.modules, "boto3", fake_boto3)
+
+    from cedar_tpu.stores.avp import (
+        Boto3AVPClient,
+        VerifiedPermissionsPolicyStore,
+    )
+
+    client = Boto3AVPClient(region="us-west-2")
+    assert calls["session"] == {"region_name": "us-west-2"}
+    assert client.list_policy_ids("store-1") == ["p-aaa", "p-bbb", "p-ccc"]
+    assert calls["paginate"] == [{"policyStoreId": "store-1"}]
+    assert client.get_policy_statement("store-1", "p-ccc") == ""
+
+    store = VerifiedPermissionsPolicyStore(
+        "store-1", client=client, start_ticker=False
+    )
+    assert store.initial_policy_load_complete()
+    ps = store.policy_set()
+    assert len(list(ps.policies())) == 2  # template-linked skipped
+    ids = {p.policy_id for p in ps.policies()}
+    assert ids == {"p-aaa.policy0", "p-bbb.policy0"}
