@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import random
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -856,5 +857,106 @@ def main():
     print(json.dumps(result))
 
 
+def _backend_transient(e: BaseException) -> bool:
+    """True iff the error reads as a device-link outage (the serving TPU sits
+    behind a shared tunnel that occasionally flaps mid-run), not a bug."""
+    s = f"{type(e).__name__}: {e}"
+    return any(
+        m in s
+        for m in (
+            "UNAVAILABLE",
+            "Unavailable",
+            "DEADLINE_EXCEEDED",
+            "Socket closed",
+            "Connection reset",
+            "failed to connect",
+        )
+    )
+
+
+def _wait_for_backend(max_wait_s: Optional[float] = None) -> bool:
+    """Probe the device until it answers, in a SUBPROCESS per attempt: a dead
+    tunnel usually hangs JAX calls rather than erroring, so each probe needs
+    a hard kill timeout the in-process API cannot provide."""
+    import os
+    import subprocess
+    import sys
+
+    if max_wait_s is None:
+        max_wait_s = float(os.environ.get("CEDAR_BENCH_WAIT_S", "600"))
+
+    probe = (
+        "import jax, numpy as np, jax.numpy as jnp;"
+        "x = jnp.ones((128, 128), jnp.bfloat16);"
+        "np.asarray(x @ x); print('backend-ok')"
+    )
+    deadline = time.time() + max_wait_s
+    while time.time() < deadline:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", probe],
+                timeout=120,
+                capture_output=True,
+            )
+            if r.returncode == 0 and b"backend-ok" in r.stdout:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        time.sleep(15.0)
+    return False
+
+
+def _run_main_guarded(deadline_s: float):
+    """main() on a worker thread with a hard deadline; returns ("ok", None),
+    ("error", exc), or ("hang", None). The COMMON tunnel-death mode is a
+    hang inside a JAX call — no except clause ever sees it — so the deadline
+    is the only signal; the caller's execv destroys the stuck thread along
+    with the process image."""
+    import threading
+
+    out = {"status": "hang", "exc": None}
+
+    def run():
+        try:
+            main()
+            out["status"] = "ok"
+        except BaseException as e:  # noqa: BLE001 — reported to the caller
+            out["status"] = "error"
+            out["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(deadline_s)
+    return out["status"], out["exc"]
+
+
 if __name__ == "__main__":
-    main()
+    import os
+    import sys
+
+    if os.environ.pop("CEDAR_BENCH_WAIT", ""):
+        # post-execv waiter stage: the failed run's device client died with
+        # the old process image, so this process (and its probe subprocesses)
+        # can attach cleanly once the link is back. Probing BEFORE the execv
+        # would race the still-attached dead client on single-attach backends.
+        if not _wait_for_backend():
+            raise SystemExit("backend did not return within the wait budget")
+    deadline_s = float(os.environ.get("CEDAR_BENCH_DEADLINE_S", "2700"))
+    status, exc = _run_main_guarded(deadline_s)
+    if status == "ok":
+        sys.exit(0)
+    retries = int(os.environ.get("CEDAR_BENCH_RETRY", "0"))
+    if retries >= 2 or not (status == "hang" or _backend_transient(exc)):
+        if exc is not None:
+            raise exc
+        raise SystemExit(f"# bench hung past {deadline_s:.0f}s deadline")
+    print(
+        "# transient backend failure "
+        f"({'hang' if status == 'hang' else f'{type(exc).__name__}: {exc}'}); "
+        "restarting with a fresh backend once the device returns",
+        file=sys.stderr,
+        flush=True,
+    )
+    os.environ["CEDAR_BENCH_RETRY"] = str(retries + 1)
+    os.environ["CEDAR_BENCH_WAIT"] = "1"
+    os.execv(sys.executable, [sys.executable] + sys.argv)

@@ -144,6 +144,49 @@ def _shape_has_path(schema: CedarSchema, type_name: str, path) -> bool:
     return True
 
 
+def _candidate_types(
+    schema: CedarSchema, action_uids, which: str, memo: dict
+) -> List[str]:
+    """Qualified entity types an UNSCOPED principal/resource can take: the
+    union of the policy's actions' appliesTo lists (every action's when the
+    action scope is bare). Empty = no finite union (unknown action, or an
+    action whose appliesTo is unrestricted) — the typechecker then stays
+    permissive. appliesTo names are namespace-relative to their action.
+    ``memo`` is scoped to one validation pass by the caller (never stored on
+    the schema, which could be mutated between passes)."""
+    key = (which, tuple((u.type, u.id) for u in action_uids))
+    if key in memo:
+        return memo[key]
+    pairs = []  # (action namespace, action shape)
+    if action_uids:
+        for uid in action_uids:
+            shape = _action_shape(schema, uid)
+            if shape is None:
+                return []  # unknown action already has its own finding
+            pairs.append(("::".join(uid.type.split("::")[:-1]), shape))
+    else:
+        for ns, namespace in schema.namespaces.items():
+            pairs.extend((ns, shape) for shape in namespace.actions.values())
+    out = set()
+    for ns, shape in pairs:
+        listed = (
+            shape.applies_to.principal_types
+            if which == "principal"
+            else shape.applies_to.resource_types
+        )
+        if not listed:
+            memo[key] = []
+            return []  # applies to anything: no finite union
+        for name in listed:
+            qualified = f"{ns}::{name}" if "::" not in name and ns else name
+            out.add(
+                qualified if _entity_type_exists(schema, qualified) else name
+            )
+    result = sorted(out)
+    memo[key] = result
+    return result
+
+
 def _scope_type(scope: ast.Scope) -> Optional[str]:
     if scope.op in ("is", "is_in"):
         return scope.entity_type
@@ -153,9 +196,13 @@ def _scope_type(scope: ast.Scope) -> Optional[str]:
 
 
 def validate_policy(
-    schema: CedarSchema, policy: ast.Policy, filename: str
+    schema: CedarSchema,
+    policy: ast.Policy,
+    filename: str,
+    _memo: Optional[dict] = None,
 ) -> List[Finding]:
     findings: List[Finding] = []
+    memo = _memo if _memo is not None else {}
 
     def finding(msg: str) -> None:
         findings.append(Finding(filename, policy.policy_id, msg))
@@ -252,16 +299,35 @@ def validate_policy(
                 f"{var} ({t}) has no attribute path {'.'.join(path)!r}"
             )
 
-    # ---- operand typechecking (schema/typecheck.py)
+    # ---- operand typechecking (schema/typecheck.py). Unscoped variables
+    # are typed by the agreement of their possible types (appliesTo union),
+    # so `permit (principal, action, resource) when { principal.name < 3 }`
+    # is a finding even without a scope constraint.
     from ..schema.typecheck import typecheck_policy
 
-    for msg in typecheck_policy(schema, policy, p_type, r_type):
+    for msg in typecheck_policy(
+        schema,
+        policy,
+        p_type,
+        r_type,
+        principal_candidates=(
+            None
+            if p_type
+            else _candidate_types(schema, action_uids, "principal", memo)
+        ),
+        resource_candidates=(
+            None
+            if r_type
+            else _candidate_types(schema, action_uids, "resource", memo)
+        ),
+        union_memo=memo,
+    ):
         finding(f"type error: {msg}")
     return findings
 
 
 def validate_file(
-    schema: CedarSchema, path: pathlib.Path
+    schema: CedarSchema, path: pathlib.Path, _memo: Optional[dict] = None
 ) -> Tuple[int, List[Finding]]:
     try:
         text = path.read_text()
@@ -272,8 +338,9 @@ def validate_file(
     except ParseError as e:
         return 0, [Finding(str(path), "", f"parse error: {e}")]
     findings: List[Finding] = []
+    memo = _memo if _memo is not None else {}
     for p in policies:
-        findings.extend(validate_policy(schema, p, str(path)))
+        findings.extend(validate_policy(schema, p, str(path), _memo=memo))
     return len(policies), findings
 
 
@@ -305,8 +372,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     total_policies = 0
     all_findings: List[Finding] = []
+    memo: dict = {}  # one validation pass, one cache lifetime
     for f in files:
-        n, findings = validate_file(schema, f)
+        n, findings = validate_file(schema, f, _memo=memo)
         total_policies += n
         all_findings.extend(findings)
 

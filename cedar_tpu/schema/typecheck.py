@@ -18,7 +18,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..lang import ast
-from .model import Attribute, CedarSchema, EntityShape
+from .model import Attribute, AttributeElement, CedarSchema, EntityShape
 
 # type kinds
 STRING = "String"
@@ -71,11 +71,31 @@ class TypeChecker:
         schema: CedarSchema,
         principal_type: Optional[str],
         resource_type: Optional[str],
+        principal_candidates: Optional[List[str]] = None,
+        resource_candidates: Optional[List[str]] = None,
+        union_memo: Optional[dict] = None,
     ):
+        """A pinned scope type takes precedence; otherwise a non-empty
+        candidate list (the possible types the request variable can take,
+        e.g. from the actions' appliesTo sets) types the variable as the
+        AGREEMENT of the candidates — like the Rust validator, which checks
+        every request environment, ``principal.name < 3`` is then a finding
+        even when the scope is bare ``principal``. ``union_memo`` (optional)
+        caches union TCs across policies within one validation pass; it must
+        not outlive schema mutations, which is why the caller owns it."""
         self.schema = schema
+        self._union_memo = union_memo if union_memo is not None else {}
         self.vars = {
-            "principal": self._entity_tc(principal_type),
-            "resource": self._entity_tc(resource_type),
+            "principal": (
+                self._entity_tc(principal_type)
+                if principal_type
+                else self._union_entity_tc(principal_candidates or [])
+            ),
+            "resource": (
+                self._entity_tc(resource_type)
+                if resource_type
+                else self._union_entity_tc(resource_candidates or [])
+            ),
             "action": _UNKNOWN,
             "context": _UNKNOWN,
         }
@@ -91,6 +111,83 @@ class TypeChecker:
         if shape is None:
             return TC(ENTITY, entity=type_name, ns=ns)
         return TC(ENTITY, attrs=shape.attributes, entity=type_name, ns=ns)
+
+    @staticmethod
+    def _prim_sig(t: TC) -> Optional[str]:
+        """Namespace-independent type signature, or None when the type can't
+        be compared across namespaces (entities, records, unknowns). Union
+        attributes are restricted to these so one TC (with a single ``ns``)
+        can represent attributes drawn from shapes in many namespaces."""
+        if t.kind in (STRING, LONG, BOOL, EXT):
+            return t.kind
+        if (
+            t.kind == SET
+            and t.element is not None
+            and t.element.kind in (STRING, LONG, BOOL, EXT)
+        ):
+            return f"Set<{t.element.kind}>"
+        return None
+
+    def _union_entity_tc(self, candidates: List[str]) -> TC:
+        """TC for a variable that may be ANY of `candidates` at request
+        time. An attribute is typed iff every candidate THAT DEFINES IT
+        agrees on a primitive signature: a mismatch finding is then sound in
+        every request environment — on defining candidates the operand types
+        are proven, and on candidates lacking the attribute the access
+        errors at runtime (the policy never matches), which is exactly the
+        dead code the finding reports. Attributes with DISAGREEING or
+        non-primitive signatures drop to UNKNOWN (permissive: no false
+        findings). ``entity`` stays empty so entity-identity checks don't
+        fire. Memoized per validation pass (``union_memo``): the bare-action
+        union scans every shape in the schema."""
+        if not candidates:
+            return _UNKNOWN
+        if len(candidates) == 1:
+            return self._entity_tc(candidates[0])
+        memo = self._union_memo
+        key = tuple(candidates)
+        cached = memo.get(key)
+        if cached is not None:
+            return cached
+        sigs: Dict[str, set] = {}
+        for name in candidates:
+            shape = self.schema.get_entity_shape(name)
+            if shape is None:
+                # an unresolvable candidate could carry ANY attribute types
+                # at request time; deriving findings from the resolvable
+                # subset would be unsound — go fully permissive, same as a
+                # pinned scope of an unknown type (_entity_tc attrs=None)
+                memo[key] = _UNKNOWN
+                return _UNKNOWN
+            ns = "::".join(name.split("::")[:-1])
+            for aname, attr in shape.attributes.items():
+                sigs.setdefault(aname, set()).add(
+                    self._prim_sig(self._attr_tc(attr, ns))
+                )
+        union_attrs: Dict[str, Attribute] = {}
+        for aname, s in sigs.items():
+            if len(s) != 1:
+                continue
+            sig = next(iter(s))
+            if sig is None:
+                continue
+            # synthesize an ns-INDEPENDENT attribute from the agreed
+            # signature: a candidate's raw Attribute could hold a namespace-
+            # relative common-type ref that resolves differently (or not at
+            # all) under this TC's empty ns
+            if sig.startswith("Set<"):
+                union_attrs[aname] = Attribute(
+                    type="Set", element=AttributeElement(type=sig[4:-1])
+                )
+            else:
+                union_attrs[aname] = Attribute(type=sig)
+        out = (
+            TC(ENTITY, attrs=union_attrs, entity="", ns="")
+            if union_attrs
+            else _UNKNOWN
+        )
+        memo[key] = out
+        return out
 
     def _resolve_common(self, ns: str, ref: str) -> Optional[EntityShape]:
         if ns:
@@ -294,9 +391,19 @@ def typecheck_policy(
     policy: ast.Policy,
     principal_type: Optional[str],
     resource_type: Optional[str],
+    principal_candidates: Optional[List[str]] = None,
+    resource_candidates: Optional[List[str]] = None,
+    union_memo: Optional[dict] = None,
 ) -> List[str]:
     """Type findings for every when/unless condition of one policy."""
-    tc = TypeChecker(schema, principal_type, resource_type)
+    tc = TypeChecker(
+        schema,
+        principal_type,
+        resource_type,
+        principal_candidates=principal_candidates,
+        resource_candidates=resource_candidates,
+        union_memo=union_memo,
+    )
     for cond in policy.conditions:
         t = tc.infer(cond.body)
         if t.kind not in (BOOL, UNKNOWN):

@@ -208,6 +208,64 @@ def test_typecheck_rejects_operand_mismatches(schema, src, fragment):
     )
 
 
+UNSCOPED_TYPE_BROKEN = [
+    # bare principal/resource: typed via the appliesTo-union agreement
+    # (every principal type's `name` is a String, etc.)
+    (
+        'permit (principal, action, resource) when { principal.name < 3 };',
+        "must be Long",
+    ),
+    (
+        'permit (principal, action == k8s::Action::"get", resource)'
+        ' when { principal.name + 1 > 0 };',
+        "must be Long",
+    ),
+    (
+        "permit (principal, action in"
+        ' [k8s::admission::Action::"create", k8s::admission::Action::"update"],'
+        " resource) when { principal.name && true };",
+        "must be Boolean",
+    ),
+]
+
+
+@pytest.mark.parametrize("src,fragment", UNSCOPED_TYPE_BROKEN)
+def test_typecheck_unscoped_union(schema, src, fragment):
+    """Operand mismatches must be findings even on BARE principal/resource:
+    the checker types the variable by the agreement of its possible types
+    (the actions' appliesTo union), like the Rust validator's per-request-
+    environment checking."""
+    found = _validate_src(schema, src)
+    assert found, f"expected a type finding for: {src}"
+    assert any(fragment in str(f) for f in found), (
+        f"expected {fragment!r} in {[str(f) for f in found]}"
+    )
+
+
+def test_typecheck_unscoped_union_stays_permissive(schema):
+    """Attributes whose primitive signature DIVERGES across the candidate
+    types that define them must not produce findings on bare vars. (An
+    attribute defined by only SOME candidates with one agreed signature IS
+    typed — on the others the access errors at runtime, so a mismatch is
+    still dead code; see TypeChecker._union_entity_tc.)"""
+    good = [
+        # `name` exists on every principal type but comparing it as a
+        # String is fine
+        'permit (principal, action, resource) when { principal.name == "x" };',
+        # resource union spans Resource + NonResourceURL + admission types;
+        # `path` signatures diverge across the defining candidates, so the
+        # attribute must drop to Unknown rather than be judged
+        'permit (principal, action, resource)'
+        ' when { resource has path && resource.path like "/api*" };',
+    ]
+    for src in good:
+        found = _validate_src(schema, src)
+        assert not [f for f in found if "type error" in str(f)], (
+            src,
+            [str(f) for f in found],
+        )
+
+
 def test_typecheck_accepts_well_typed_conditions(schema):
     """Well-typed uses of the same operators must stay clean."""
     good = [
