@@ -33,6 +33,7 @@ from typing import List, Optional, Set, Tuple
 
 from ..lang import ParseError, ast, parse_policies
 from ..schema.model import CedarSchema
+from ..schema.typecheck import in_feasible
 
 
 class Finding:
@@ -285,6 +286,31 @@ def validate_policy(
                 finding(
                     f"no action in the set applies to resource type {r_type}"
                 )
+
+    # ---- scope `in` feasibility: `principal in T::"x"` can only hold when
+    # some possible type of the variable equals T or lists T in its
+    # (transitive) memberOfTypes — otherwise the policy is dead, like the
+    # Rust validator's impossible-hierarchy findings
+    for var, scope in (
+        ("principal", policy.principal),
+        ("resource", policy.resource),
+    ):
+        if scope.op not in ("in", "is_in") or scope.entity is None:
+            continue
+        target = scope.entity.type
+        if not _entity_type_exists(schema, target):
+            continue  # unknown-type finding already emitted above
+        if scope.op == "is_in":
+            cands = [scope.entity_type]
+        else:
+            cands = _candidate_types(schema, action_uids, var, memo)
+        if cands and not any(
+            in_feasible(schema, c, target) for c in cands
+        ):
+            finding(
+                f"{var} scope `in` {target} can never hold: no possible "
+                f"{var} type is a member of {target}"
+            )
 
     # ---- attribute accesses on pinned types
     paths: Set[Tuple[str, Tuple[str, ...]]] = set()

@@ -65,6 +65,44 @@ _LONG = TC(LONG)
 _BOOL = TC(BOOL)
 
 
+def entity_def(schema: CedarSchema, name: str):
+    """The schema's Entity definition for a QUALIFIED type name, or None."""
+    parts = name.split("::")
+    namespace = schema.namespaces.get("::".join(parts[:-1]))
+    return namespace.entity_types.get(parts[-1]) if namespace else None
+
+
+def in_feasible(schema: CedarSchema, var_type: str, target_type: str) -> bool:
+    """Can an entity of `var_type` ever satisfy ``in target_type::"..."``?
+    Yes iff the types are equal or target is reachable through the
+    transitive memberOfTypes closure. PERMISSIVE when either side is
+    undeclared in the schema — silence is not evidence of infeasibility.
+    Shared by the validator's scope-level check and the typechecker's
+    condition-level check so the two surfaces can't drift."""
+    if var_type == target_type:
+        return True
+    if entity_def(schema, var_type) is None or entity_def(schema, target_type) is None:
+        return True
+    frontier = [var_type]
+    seen = {var_type}
+    while frontier:
+        cur = frontier.pop()
+        ent = entity_def(schema, cur)
+        if ent is None:
+            continue
+        ns = "::".join(cur.split("::")[:-1])
+        for m in ent.member_of_types:
+            q = f"{ns}::{m}" if "::" not in m and ns else m
+            # a membership edge may name the target in either spelling
+            if target_type in (q, m):
+                return True
+            nxt = q if entity_def(schema, q) is not None else m
+            if nxt not in seen:
+                seen.add(nxt)
+                frontier.append(nxt)
+    return False
+
+
 class TypeChecker:
     def __init__(
         self,
@@ -322,6 +360,17 @@ class TypeChecker:
                 if rt.kind not in (ENTITY, SET, UNKNOWN):
                     self.err(
                         f"right operand of `in` must be an entity or set, got {rt}"
+                    )
+                if (
+                    lt.kind == ENTITY
+                    and rt.kind == ENTITY
+                    and lt.entity
+                    and rt.entity
+                    and not in_feasible(self.schema, lt.entity, rt.entity)
+                ):
+                    self.err(
+                        f"`in` between {lt.entity} and {rt.entity} is "
+                        "always false: the hierarchy never relates them"
                     )
                 return _BOOL
             return _UNKNOWN

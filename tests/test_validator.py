@@ -266,6 +266,62 @@ def test_typecheck_unscoped_union_stays_permissive(schema):
         )
 
 
+def test_scope_in_feasibility(schema):
+    """`in` scopes that no possible var type can satisfy are dead policies
+    and must be findings; feasible hierarchies must stay clean."""
+    dead = [
+        # nothing is a member of k8s::Resource
+        'permit (principal in k8s::Resource::"r", action, resource);',
+        # a Node is never inside a User
+        'permit (principal is k8s::Node in k8s::User::"u", action, resource);',
+    ]
+    for src in dead:
+        found = _validate_src(schema, src)
+        assert any("can never hold" in str(f) for f in found), (
+            src,
+            [str(f) for f in found],
+        )
+    alive = [
+        # every principal type is (or is a member of) Group
+        'permit (principal in k8s::Group::"g", action, resource);',
+        'permit (principal is k8s::ServiceAccount in k8s::Group::"g",'
+        " action, resource);",
+        # same-type `in` degenerates to equality and is feasible
+        'permit (principal in k8s::User::"u", action, resource);',
+    ]
+    for src in alive:
+        found = _validate_src(schema, src)
+        assert not [f for f in found if "can never hold" in str(f)], (
+            src,
+            [str(f) for f in found],
+        )
+
+
+def test_condition_in_feasibility(schema):
+    """Condition-level `in` between hierarchy-unrelated entity types is a
+    finding; related (or unknown) pairs stay clean."""
+    found = _validate_src(
+        schema,
+        "permit (principal is k8s::User, action, resource)"
+        ' when { principal in k8s::Resource::"r" };',
+    )
+    assert any("always false" in str(f) for f in found), [str(f) for f in found]
+    for src in [
+        "permit (principal is k8s::User, action, resource)"
+        ' when { principal in k8s::Group::"g" };',
+        "permit (principal, action, resource)"  # bare principal: unknown
+        ' when { principal in k8s::Resource::"r" };',
+        # undeclared target type: schema silence is not infeasibility
+        "permit (principal is k8s::User, action, resource)"
+        ' when { principal in ext::Team::"t" };',
+    ]:
+        found = _validate_src(schema, src)
+        assert not [f for f in found if "always false" in str(f)], (
+            src,
+            [str(f) for f in found],
+        )
+
+
 def test_typecheck_accepts_well_typed_conditions(schema):
     """Well-typed uses of the same operators must stay clean."""
     good = [
