@@ -33,7 +33,7 @@ from typing import List, Optional, Set, Tuple
 
 from ..lang import ParseError, ast, parse_policies
 from ..schema.model import CedarSchema
-from ..schema.typecheck import in_feasible
+from ..schema.typecheck import entity_def, in_feasible
 
 
 class Finding:
@@ -48,10 +48,7 @@ class Finding:
 
 
 def _entity_type_exists(schema: CedarSchema, name: str) -> bool:
-    parts = name.split("::")
-    ns, short = "::".join(parts[:-1]), parts[-1]
-    namespace = schema.namespaces.get(ns)
-    return namespace is not None and short in namespace.entity_types
+    return entity_def(schema, name) is not None
 
 
 def _action_shape(schema: CedarSchema, uid) -> Optional[object]:

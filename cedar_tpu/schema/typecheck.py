@@ -89,14 +89,20 @@ def in_feasible(schema: CedarSchema, var_type: str, target_type: str) -> bool:
         cur = frontier.pop()
         ent = entity_def(schema, cur)
         if ent is None:
-            continue
+            # an UNDECLARED type one hop into the chain is the same schema
+            # silence as an undeclared var/target: its memberships are
+            # unknown, so the hierarchy cannot be proven infeasible
+            return True
         ns = "::".join(cur.split("::")[:-1])
         for m in ent.member_of_types:
+            # resolve the edge the way entity references resolve: the
+            # ns-qualified spelling wins when it is declared; compare the
+            # target against the RESOLVED spelling only (the raw name may
+            # coincide with a different namespace's type)
             q = f"{ns}::{m}" if "::" not in m and ns else m
-            # a membership edge may name the target in either spelling
-            if target_type in (q, m):
-                return True
             nxt = q if entity_def(schema, q) is not None else m
+            if nxt == target_type:
+                return True
             if nxt not in seen:
                 seen.add(nxt)
                 frontier.append(nxt)

@@ -322,6 +322,42 @@ def test_condition_in_feasibility(schema):
         )
 
 
+def test_in_feasible_edge_semantics():
+    """Unit semantics of the shared hierarchy helper on a synthetic schema:
+    undeclared intermediates stay permissive, and membership edges resolve
+    ns-qualified-first (the raw spelling must not match a same-named type
+    in another namespace)."""
+    from cedar_tpu.schema.model import CedarSchema
+    from cedar_tpu.schema.typecheck import in_feasible
+
+    s = CedarSchema.from_json(
+        {
+            "": {"entityTypes": {"Resource": {"shape": {"type": "Record"}}},
+                 "actions": {}},
+            "a": {
+                "entityTypes": {
+                    # X is a member of a::Resource (ns-qualified resolution),
+                    # NOT of the root-namespace Resource
+                    "X": {"shape": {"type": "Record"},
+                          "memberOfTypes": ["Resource"]},
+                    "Resource": {"shape": {"type": "Record"}},
+                    # Y's chain passes through an UNDECLARED type
+                    "Y": {"shape": {"type": "Record"},
+                          "memberOfTypes": ["ext::Team"]},
+                    "T": {"shape": {"type": "Record"}},
+                },
+                "actions": {},
+            },
+        }
+    )
+    assert in_feasible(s, "a::X", "a::Resource")
+    # raw spelling "Resource" must not leak feasibility to the ROOT type
+    assert not in_feasible(s, "a::X", "Resource")
+    # undeclared intermediate ext::Team: its memberships are unknown, so
+    # reaching a declared target cannot be ruled out
+    assert in_feasible(s, "a::Y", "a::T")
+
+
 def test_typecheck_accepts_well_typed_conditions(schema):
     """Well-typed uses of the same operators must stay clean."""
     good = [
