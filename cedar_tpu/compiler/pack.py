@@ -45,13 +45,20 @@ PERMIT_IDX = 0
 FORBID_IDX = 1
 ERROR_IDX = 2
 GROUPS_PER_TIER = 3
-# Fallback-scope gate rules live in ONE extra group past the tier groups
-# (index n_tiers * GROUPS_PER_TIER): a gate rule is the scope conjunction of
-# one interpreter-fallback policy. A request matching no gate rule provably
-# matches (and errors on) no fallback policy — the device verdict word is
-# authoritative for it, so the fast paths only re-route gate-flagged rows to
-# the exact Python path (the hybrid successor of disabling the native plane
-# whenever any fallback policy exists).
+# Gate rules live in ONE extra group past the tier groups (index
+# n_tiers * GROUPS_PER_TIER): a gate rule is the scope conjunction of a
+# policy the NATIVE plane cannot evaluate —
+#   (a) an interpreter-fallback policy (Unlowerable), or
+#   (b) a lowered policy carrying a hard literal outside the native
+#       dyn-contains class ("native-opaque": the Python encoder host-
+#       evaluates the literal per request, the C++ encoder cannot).
+# A request matching no gate rule provably matches (and errors on) no such
+# policy — every clause and error clause embeds the policy's scope prefix
+# (lower_policy), so the device verdict word is authoritative for it. The
+# fast paths re-route only gate-flagged rows to the exact Python path (the
+# hybrid successor of disabling the native plane whenever any such policy
+# exists). The Python engine path fills hard literals at encode time, so
+# for it only class (a) needs the host-side tier walk.
 GATE_RULE_POLICY = 0  # rule_policy for gate rules: any value != INT32_MAX
 
 
@@ -125,8 +132,12 @@ class PackedPolicySet:
     policy_meta: List[PolicyMeta]
     fallback: list  # List[FallbackPolicy]
     table: object = None  # compiler.table.FeatureTable
-    # True when fallback-scope gate rules were packed (group n_tiers * 3)
+    # True when gate rules were packed (group n_tiers * 3)
     has_gate: bool = False
+    # lowered policies whose hard literals the NATIVE encoder cannot
+    # evaluate (outside the dyn class); they gate like fallback policies on
+    # the native path but evaluate exactly on the Python path
+    native_opaque: int = 0
 
     @property
     def n_groups(self) -> int:
@@ -149,9 +160,25 @@ class _LitRegistry:
 
 
 def pack(compiled: CompiledPolicies) -> PackedPolicySet:
+    from .dyn import dyn_spec
+
     reg = _LitRegistry()
     rules: List[Tuple[List[Tuple[int, bool]], int, int]] = []  # (lits, group, pmeta)
     policy_meta: List[PolicyMeta] = []
+    opaque: List[Policy] = []  # lowered policies the NATIVE encoder can't eval
+    _dyn_ok: Dict[int, bool] = {}  # id(expr) -> expr is in the dyn class
+
+    def _native_opaque(lp) -> bool:
+        for clause in list(lp.clauses) + list(lp.error_clauses):
+            for cl in clause:
+                if cl.lit.kind in (HARD, HARD_OK, HARD_ERR):
+                    e = cl.lit.expr
+                    ok = _dyn_ok.get(id(e))
+                    if ok is None:
+                        ok = _dyn_ok[id(e)] = dyn_spec(e) is not None
+                    if not ok:
+                        return True
+        return False
 
     for lp in compiled.lowered:
         p: Policy = lp.policy
@@ -168,19 +195,22 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
         for clause in lp.error_clauses:
             lits = [(reg.intern(cl.lit), cl.negated) for cl in clause]
             rules.append((lits, err_group, pm_idx))
+        if _native_opaque(lp):
+            opaque.append(p)
 
-    # Fallback-scope gate rules: one rule per interpreter-fallback policy,
-    # testing just the policy's scope (principal/action/resource heads —
-    # always lowerable, total, error-free). Group = n_tiers * 3; a request
-    # with no gate hit cannot match or error on any fallback policy, so its
-    # device verdict needs no interpreter merge.
+    # Gate rules: one per interpreter-fallback policy AND one per
+    # native-opaque lowered policy (see GATE_RULE_POLICY comment), testing
+    # just the policy's scope (principal/action/resource heads — always
+    # lowerable, total, error-free). Group = n_tiers * 3; a request with no
+    # gate hit cannot match or error on any of these policies, so its
+    # device verdict needs no interpreter merge on the native path.
     has_gate = False
-    if compiled.fallback:
+    if compiled.fallback or opaque:
         from .lower import scope_literals
 
         gate_group = compiled.n_tiers * GROUPS_PER_TIER
-        for fp in compiled.fallback:
-            gate_lits, _ = scope_literals(fp.policy)
+        for gp in [fp.policy for fp in compiled.fallback] + opaque:
+            gate_lits, _ = scope_literals(gp)
             lits = [(reg.intern(cl.lit), cl.negated) for cl in gate_lits]
             rules.append((lits, gate_group, GATE_RULE_POLICY))
         has_gate = True
@@ -226,6 +256,7 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
         policy_meta=policy_meta,
         fallback=list(compiled.fallback),
         has_gate=has_gate,
+        native_opaque=len(opaque),
     )
 
 

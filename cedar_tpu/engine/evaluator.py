@@ -354,6 +354,7 @@ class TPUPolicyEngine:
             "L": c.packed.L,
             "R": c.packed.R,
             "fallback_policies": len(c.packed.fallback),
+            "native_opaque_policies": c.packed.native_opaque,
         }
 
     # ----------------------------------------------------------- evaluation
@@ -445,11 +446,12 @@ class TPUPolicyEngine:
         cs = cs or self._compiled
         packed = cs.packed
         w = words.astype(np.uint32)
-        # gated rows (fallback scope hit) re-run the exact Python path in
-        # their caller — their diagnostics never come from the word/bits
-        need = np.nonzero(
-            ((w & (WORD_ERR | WORD_MULTI)) != 0) & ((w & WORD_GATE) == 0)
-        )[0]
+        # WORD_GATE is ignored here on purpose: this path runs on the
+        # PYTHON-encoded side, where hard literals were host-evaluated, so
+        # the words/bits are authoritative even for gate-flagged rows
+        # (gates exist for the NATIVE encoder's benefit — its fast paths
+        # re-route gated rows before ever calling this)
+        need = np.nonzero((w & (WORD_ERR | WORD_MULTI)) != 0)[0]
         out: dict = {}
         if not need.size:
             return out

@@ -15,10 +15,12 @@ Python path:
 
   * parse quirks / extras overflow / unsupported admission shapes — routed
     per row by the encoder's flag column;
-  * rows whose verdict word carries WORD_GATE — an interpreter-fallback
-    policy's scope matched (compiler.pack packs one gate rule per fallback
-    policy), so the device verdict is not authoritative; gated rows re-run
-    batched through the hybrid engine path.
+  * rows whose verdict word carries WORD_GATE — the scope of a policy the
+    native plane cannot evaluate matched (compiler.pack packs one gate
+    rule per interpreter-fallback policy and per native-opaque policy —
+    one whose hard literals only the Python encoder can host-evaluate),
+    so the device verdict is not authoritative; gated rows re-run batched
+    through the hybrid engine path.
 
 Both fast paths share one chunked pipeline (_RawFastPath): chunk k+1's C++
 encode overlaps chunk k's in-flight device work; clean rows decode via a

@@ -1,0 +1,40 @@
+"""JAX environment hardening for cpu-only runs (tests, dryruns).
+
+This environment's sitecustomize registers a tunneled TPU PJRT plugin whose
+client setup BLOCKS indefinitely when the device link is down — and it
+initializes through ``backends()`` even under ``jax_platforms=cpu``. For
+runs that are cpu-only by design, replace every non-cpu backend factory
+with one that fails fast. The registrations themselves must stay: pallas /
+checkify register "tpu" MLIR lowerings at import time and error on unknown
+platforms.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def disable_non_cpu_backends() -> None:
+    """Make non-cpu PJRT backend factories raise instead of block.
+
+    Call AFTER ``import jax`` and before any backend initializes. Safe to
+    call multiple times; silently does nothing if jax's private factory
+    registry moves (the caller then simply keeps jax's stock behavior).
+    """
+    try:
+        from jax._src import xla_bridge as _xb
+
+        def _disabled(*_a, _n="", **_k):
+            raise RuntimeError(
+                f"{_n} backend disabled by cedar_tpu cpu-only hardening"
+            )
+
+        for name, reg in list(_xb._backend_factories.items()):
+            if name == "cpu":
+                continue
+            _xb._backend_factories[name] = reg._replace(
+                factory=functools.partial(_disabled, _n=name),
+                fail_quietly=True,
+            )
+    except Exception:  # noqa: BLE001 — private API; harmless if it moved
+        pass

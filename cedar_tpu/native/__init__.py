@@ -116,15 +116,15 @@ class _BlobWriter:
 
 
 def serialize_table(plan, table) -> Optional[bytes]:
-    """FeatureTable + EncodePlan -> native blob, or None when the set is not
-    natively encodable: a hard literal outside the dyn-contains class
-    (compiler/dyn.py) needs the Python interpreter per request, and value
-    kinds the canon format doesn't cover fall back to Python."""
-    if plan.hard_lits and (
-        len(plan.dyn_specs) != len(plan.hard_lits)
-        or any(s is None for s in plan.dyn_specs)
-    ):
-        return None
+    """FeatureTable + EncodePlan -> native blob, or None when value kinds
+    the canon format doesn't cover fall back to Python.
+
+    Hard literals OUTSIDE the dyn-contains class (compiler/dyn.py) do not
+    disable the native plane: their lit/ok/err features simply stay
+    inactive in native encodes, which can never fire the owning policy's
+    rules or error clauses — and every request those rules COULD affect
+    matches the policy's scope, which pack() turned into a gate rule, so
+    such rows re-run the exact Python path (WORD_GATE)."""
     try:
         return _serialize_table(plan, table)
     except ValueError:
@@ -328,8 +328,9 @@ class NativeEncoder:
     @classmethod
     def create(cls, packed) -> Optional["NativeEncoder"]:
         """Build a NativeEncoder for a PackedPolicySet, or None if the set
-        (hard literals outside the dyn-contains class) or the environment
-        (no g++) rules it out."""
+        (value kinds outside the canon format) or the environment (no g++)
+        rules it out. Hard literals outside the dyn class don't: their
+        policies gate to the Python path per row (see serialize_table)."""
         lib = _load_library()
         if lib is None:
             return None

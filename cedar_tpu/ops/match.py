@@ -66,12 +66,16 @@ CODE_ERROR = 3
 # verdict-word flag masks (see module docstring)
 WORD_ERR = 1 << 29
 WORD_MULTI = 1 << 28
-# bit 27: at least one fallback-scope GATE rule matched (compiler.pack packs
-# one scope-conjunction rule per interpreter-fallback policy into group
-# n_tiers * 3). A gated row may match/error on a fallback policy, so its
-# word is not authoritative — callers re-route it to the exact Python path.
-# Rows without the bit are fully decided by the word even when fallback
-# policies exist.
+# bit 27: at least one GATE rule matched (compiler.pack packs one scope-
+# conjunction rule into group n_tiers * 3 per policy the NATIVE plane can't
+# evaluate: interpreter-fallback policies AND native-opaque policies whose
+# hard literals only the Python encoder can host-evaluate). A gated row may
+# match/error on such a policy, so a NATIVELY-encoded word is not
+# authoritative — the fast paths re-route it to the exact Python path.
+# Python-encoded words stay authoritative for native-opaque policies (hard
+# literals were filled at encode time); only fallback policies need the
+# host-side tier walk there. Rows without the bit are fully decided by the
+# word in every case.
 WORD_GATE = 1 << 27
 
 # group-per-tier layout (mirrors compiler.pack)

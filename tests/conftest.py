@@ -23,31 +23,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# make non-cpu PJRT factories FAIL FAST (this environment's sitecustomize
-# registers a tunneled TPU plugin whose client setup BLOCKS indefinitely
-# when the tunnel is down — even under jax_platforms="cpu" the factory
-# still initializes through backends()); the tests are cpu-only by design.
-# The registrations themselves must stay: pallas/checkify register "tpu"
-# MLIR lowerings at import and error on unknown platforms.
-try:
-    from jax._src import xla_bridge as _xb  # noqa: E402
+# make non-cpu PJRT factories FAIL FAST: a device-link outage must not
+# hang the cpu-only suite (see cedar_tpu/jaxenv.py for the full story)
+import sys  # noqa: E402
 
-    def _disabled_factory(*_a, _n="", **_k):
-        raise RuntimeError(
-            f"{_n} backend disabled by cedar_tpu tests (cpu-only suite)"
-        )
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
+from cedar_tpu.jaxenv import disable_non_cpu_backends  # noqa: E402
 
-    import functools  # noqa: E402
-
-    for _name, _reg in list(_xb._backend_factories.items()):
-        if _name == "cpu":
-            continue
-        _xb._backend_factories[_name] = _reg._replace(
-            factory=functools.partial(_disabled_factory, _n=_name),
-            fail_quietly=True,
-        )
-except Exception:  # noqa: BLE001 — private API; harmless if it moved
-    pass
+disable_non_cpu_backends()
 
 # incidental engine loads must not each spawn the ~20-compile background
 # warm-up ladder (tests that exercise warm-up pass warm="async" explicitly,

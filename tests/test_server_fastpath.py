@@ -51,9 +51,10 @@ permit (principal in k8s::Group::"joiners", action == k8s::Action::"get",
   unless { principal.name != resource.name };
 """
 
-# a positive unlowerable-hard policy outside the dyn class: lowering keeps
-# it (hard literal), which rules the NATIVE ENCODER out entirely — the
-# server must degrade to the python path
+# a positive hard policy outside the dyn class (two-slot namespace join):
+# lowering keeps it as a hard literal the Python encoder host-evaluates;
+# the NATIVE plane packs its scope as a gate rule and re-routes only
+# scope-matching rows to the Python path (native-opaque hybrid)
 NON_NATIVE_POLICY = """
 permit (principal is k8s::ServiceAccount, action == k8s::Action::"get",
         resource is k8s::Resource)
@@ -116,10 +117,12 @@ def _post(port, path, doc, scheme="http", context=None):
         return json.loads(resp.read())
 
 
-def sar(user="sam", groups=(), resource="pods", name=""):
+def sar(user="sam", groups=(), resource="pods", name="", namespace=""):
     ra = {"verb": "get", "resource": resource, "version": "v1"}
     if name:
         ra["name"] = name
+    if namespace:
+        ra["namespace"] = namespace
     return {
         "apiVersion": "authorization.k8s.io/v1",
         "kind": "SubjectAccessReview",
@@ -217,24 +220,42 @@ class TestServerFastPaths:
         finally:
             srv.stop()
 
-    def test_hot_swap_to_non_native_set_degrades_to_python(self):
-        """A set whose hard literals the native encoder cannot evaluate
-        rules the fast path out; the server must degrade to the python
-        path and keep answering correctly."""
+    def test_hot_swap_to_native_opaque_set_stays_hybrid(self):
+        """A set with hard literals OUTSIDE the dyn class (a two-slot
+        namespace join) keeps the native plane available: the opaque
+        policy's scope is packed as a gate rule, so only rows it could
+        affect re-run the exact Python path; everything else stays
+        native — the plane no longer disables wholesale."""
         srv, engine, _ = _build_server(POLICIES)
         try:
             assert srv.fastpath.available
             engine.load(_tiers(POLICIES + NON_NATIVE_POLICY), warm="off")
-            assert not srv.fastpath.available  # encoder ruled out
-            # ... and requests still answer through the python path
+            assert engine.stats["native_opaque_policies"] == 1
+            assert engine.stats["fallback_policies"] == 0
+            assert srv.fastpath.available  # hybrid via the gate plane
+            # native rows keep their verdicts
             assert _post(srv.bound_port, "/v1/authorize", sar())["status"][
                 "allowed"
             ]
-            resp = _post(
+            deny = _post(srv.bound_port, "/v1/authorize", sar(resource="nodes"))
+            assert deny["status"]["denied"] is True
+            # gate-flagged rows (ServiceAccount get): exact python verdicts
+            sa = "system:serviceaccount:ns-1:app"
+            match = _post(
                 srv.bound_port, "/v1/authorize",
-                sar(user="system:serviceaccount:ns-1:app", resource="pods"),
+                sar(user=sa, resource="pods", namespace="ns-1"),
             )
-            assert resp["status"]["allowed"] is False  # namespace mismatch
+            assert match["status"]["allowed"] is True  # join holds
+            miss = _post(
+                srv.bound_port, "/v1/authorize",
+                sar(user=sa, resource="pods", namespace="other"),
+            )
+            assert miss["status"]["allowed"] is False  # join fails
+            err = _post(
+                srv.bound_port, "/v1/authorize",
+                sar(user=sa, resource="pods"),  # no namespace: access errors
+            )
+            assert err["status"]["allowed"] is False  # policy skipped
         finally:
             srv.stop()
 
