@@ -107,6 +107,20 @@ def build_selector_policy_set(n_policies: int = 1000):
     return PolicySet.from_source("\n".join(pols), "selbench")
 
 
+def _trial_rates(fn, n, trials=5):
+    """(median rate, [min, max]) of n/elapsed over `trials` runs of fn(),
+    after one warm call. Median, not best-of: round-over-round
+    comparability on a fluctuating device link."""
+    fn()  # warm
+    rates = []
+    for _ in range(trials):
+        t = time.time()
+        fn()
+        rates.append(n / (time.time() - t))
+    rates.sort()
+    return round(rates[len(rates) // 2]), [round(rates[0]), round(rates[-1])]
+
+
 def bench_config_matrix():
     """Quick measurements for BASELINE.json configs 1-4 (config 5 is the
     headline). Returns a dict merged into the result's extra."""
@@ -248,17 +262,50 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
         fast = SARFastPath(eng, auth)
         if native_available() and fast.available:
             bodies = sar_bodies(8192, with_sel)
-            fast.authorize_raw(bodies)  # warm (compile + encoder build)
-            trials = []
-            for _ in range(5):
-                t = time.time()
-                fast.authorize_raw(bodies)
-                trials.append(8192 / (time.time() - t))
-            trials.sort()
-            out[f"{key}_e2e_rate"] = round(trials[len(trials) // 2])
-            out[f"{key}_e2e_spread"] = [round(trials[0]), round(trials[-1])]
+            out[f"{key}_e2e_rate"], out[f"{key}_e2e_spread"] = _trial_rates(
+                lambda: fast.authorize_raw(bodies), 8192
+            )
         else:
             out[f"{key}_e2e_rate"] = out[f"{key}_python_rate"]
+
+    # -- config 2b: native-opaque hybrid — the rbac200 set plus a second
+    # tier of join policies only the Python encoder can host-evaluate. The
+    # native plane stays engaged (their scopes become gate rules); rows the
+    # joins could affect (~1/7: the forbid-delete scope) re-run the exact
+    # Python path, the rest keep native verdicts.
+    join_src = (
+        "permit (principal is k8s::ServiceAccount,"
+        ' action == k8s::Action::"get", resource is k8s::Resource)'
+        " when { principal.namespace == resource.namespace };\n"
+        'forbid (principal, action == k8s::Action::"delete",'
+        " resource is k8s::Resource)"
+        " when { resource has name && resource.name == principal.name };"
+    )
+    eng = TPUPolicyEngine()
+    ps_join = PolicySet.from_source(join_src, "joins")
+    eng.load([ps200, ps_join], warm="off")
+    auth = CedarWebhookAuthorizer(
+        TieredPolicyStores(
+            [MemoryStore("rbac200", ps200), MemoryStore("joins", ps_join)]
+        ),
+        evaluate=eng.evaluate,
+    )
+    fast = SARFastPath(eng, auth)
+    out["opaque_native_available"] = bool(
+        native_available() and fast.available
+    )
+    out["opaque_policies"] = eng.stats["native_opaque_policies"]
+    items = sar_items(2048)
+    out["opaque_python_rate"], _ = _trial_rates(
+        lambda: eng.evaluate_batch(items), 2048, trials=3
+    )
+    if out["opaque_native_available"]:
+        bodies = sar_bodies(8192)
+        out["opaque_e2e_rate"], out["opaque_e2e_spread"] = _trial_rates(
+            lambda: fast.authorize_raw(bodies), 8192
+        )
+    else:
+        out["opaque_e2e_rate"] = out["opaque_python_rate"]
 
     # -- config 4: admission path (demo admission policies + object walk)
     import pathlib
@@ -343,15 +390,9 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
     if out["admission_native_available"]:
         NB = 16384
         bodies = [json.dumps(review_body(i)).encode() for i in range(NB)]
-        fast.handle_raw(bodies)  # warm
-        trials = []
-        for _ in range(5):
-            t = time.time()
-            fast.handle_raw(bodies)
-            trials.append(NB / (time.time() - t))
-        trials.sort()
-        out["admission_e2e_rate"] = round(trials[len(trials) // 2])
-        out["admission_e2e_spread"] = [round(trials[0]), round(trials[-1])]
+        out["admission_e2e_rate"], out["admission_e2e_spread"] = _trial_rates(
+            lambda: fast.handle_raw(bodies), NB
+        )
     else:
         out["admission_e2e_rate"] = out["admission_python_rate"]
     return out
@@ -773,16 +814,11 @@ def main():
             stage_budget["encode_us_per_req_native"] = round(
                 (time.time() - t_enc) / NB * 1e6, 2
             )
-            trials = []
-            for _ in range(5):
-                t4 = time.time()
-                fast.authorize_raw(bodies)
-                trials.append(NB / (time.time() - t4))
-            trials.sort()
             # median, not best-of: round-over-round comparability on a
             # fluctuating link (VERDICT r3 #6); spread reported alongside
-            native_e2e_rate = trials[len(trials) // 2]
-            native_e2e_spread = (trials[0], trials[-1])
+            native_e2e_rate, native_e2e_spread = _trial_rates(
+                lambda: fast.authorize_raw(bodies), NB
+            )
             st = fast.last_stage_s
             stage_budget["decode_us_per_req"] = round(
                 st.get("decode", 0.0) / NB * 1e6, 3
@@ -850,6 +886,7 @@ def main():
             "L": stats["L"],
             "R": stats["R"],
             "fallback_policies": stats["fallback_policies"],
+            "native_opaque_policies": stats["native_opaque_policies"],
             "platform": jax.devices()[0].platform,
             "configs": config_matrix,
         },
