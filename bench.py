@@ -268,18 +268,20 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
         else:
             out[f"{key}_e2e_rate"] = out[f"{key}_python_rate"]
 
-    # -- config 2b: native-opaque hybrid — the rbac200 set plus a second
-    # tier of join policies only the Python encoder can host-evaluate. The
-    # native plane stays engaged (their scopes become gate rules); rows the
-    # joins could affect (~1/7: the forbid-delete scope) re-run the exact
-    # Python path, the rest keep native verdicts.
+    # -- config 2b: hard-literal hybrid — the rbac200 set plus a second
+    # tier of (a) principal/resource joins the C++ encoder evaluates itself
+    # (native dyn-eq class) and (b) one policy outside every native class
+    # whose scope becomes a gate rule: rows it could affect (~1/7, the
+    # forbid-delete scope) re-run the exact Python path, the rest keep
+    # native verdicts.
     join_src = (
         "permit (principal is k8s::ServiceAccount,"
         ' action == k8s::Action::"get", resource is k8s::Resource)'
         " when { principal.namespace == resource.namespace };\n"
         'forbid (principal, action == k8s::Action::"delete",'
         " resource is k8s::Resource)"
-        " when { resource has name && resource.name == principal.name };"
+        " when { resource has name && resource has namespace &&"
+        " resource.name == resource.namespace };"
     )
     eng = TPUPolicyEngine()
     ps_join = PolicySet.from_source(join_src, "joins")

@@ -1,18 +1,29 @@
-"""Dynamic set-contains templates: hard expressions the NATIVE encoder can
-evaluate per request without the Python interpreter.
+"""Dynamic templates: hard expressions the NATIVE encoder can evaluate per
+request without the Python interpreter.
 
-The restricted class is ``<slot>.contains(<template>)`` where the slot is a
-GetAttr chain over principal/resource/context and the template's leaves are
-compile-time constants or principal string attributes (``principal.name`` /
-``principal.namespace``) — the shape of the reference demo's
+Two restricted classes, both built from the same template grammar (leaves
+are compile-time constants or principal string attributes
+``principal.name`` / ``principal.namespace``):
 
-    resource.metadata.labels.contains({key: "owner", value: principal.name})
+  * ``<slot>.contains(<template>)`` (DynContains) — the shape of the
+    reference demo's
 
-(/root/reference demo/admission-policy.yaml). A policy whose only hard
-literals are in this class keeps the whole native fast path: the C++ encoder
-(native/encoder.cpp dyn tests) resolves the template against the request,
-builds the probe's canonical value key, and tests membership against the
-slot's element canons — byte-identical to interpreting the expression.
+        resource.metadata.labels.contains({key: "owner", value: principal.name})
+
+    (/root/reference demo/admission-policy.yaml): the C++ encoder resolves
+    the template against the request, builds the probe's canonical value
+    key, and tests membership against the slot's element canons.
+
+  * ``<slot> == <template>`` (DynEq) — principal/resource joins like
+    ``resource.name == principal.name`` or
+    ``principal.namespace == resource.namespace``: the C++ encoder
+    compares the slot value's canon against the resolved template canon
+    (equal Cedar values have equal canons; cross-type ``==`` is False).
+
+Both are byte-identical to interpreting the expression, so a policy whose
+hard literals are all in these classes keeps the whole native fast path;
+anything else makes the policy "native-opaque" — its scope becomes a gate
+rule (compiler/pack.py) and only scope-matching rows leave the native path.
 
 The Python encode path (compiler/table.py) always evaluates the full
 expression with the interpreter; this module only decides whether the native
@@ -44,15 +55,52 @@ class DynContains:
     tmpl: Tmpl  # template for the probe value
 
 
+@dataclass(frozen=True)
+class DynEq:
+    """``<slot> == <template>``: a two-operand equality the native encoder
+    evaluates per request — e.g. ``resource.name == principal.name`` or
+    ``principal.namespace == resource.namespace`` (slot on whichever side
+    chains off a request variable; the other side a template). Equal values
+    have equal canonical keys (the canon is injective — it keys the vocab),
+    so the native test is a byte compare of the two canons; a missing slot
+    attribute or template attribute errors exactly where the interpreter
+    raises."""
+
+    slot: Slot  # the (var, path) the left value is read from
+    tmpl: Tmpl  # template for the right value
+
+
+# value_key tags the native canon serializer (native/__init__._canon /
+# encoder.cpp canon_*) can represent; Decimal ("d") and IPAddr ("i") have
+# no native byte form, so templates holding them must NOT claim native
+# evaluability — serialize_table would ValueError and disable the plane
+# wholesale, the exact regression the gate plane exists to prevent
+_CANON_TAGS = frozenset({"b", "l", "s", "e", "S", "R"})
+
+
+def _canon_serializable(vk) -> bool:
+    tag = vk[0]
+    if tag not in _CANON_TAGS:
+        return False
+    if tag == "S":
+        return all(_canon_serializable(e) for e in vk[1])
+    if tag == "R":
+        return all(_canon_serializable(v) for _k, v in vk[1])
+    return True
+
+
 def _tmpl_of(e: ast.Expr) -> Optional[Tmpl]:
     from .lower import _NO_CONST, const_of, slot_of
 
     c = const_of(e)
     if c is not _NO_CONST:
         try:
-            return ("const", value_key(c))
+            vk = value_key(c)
         except EvalError:
             return None
+        if not _canon_serializable(vk):
+            return None
+        return ("const", vk)
     if isinstance(e, ast.GetAttr):
         s = slot_of(e)
         if (
@@ -84,20 +132,34 @@ def _tmpl_of(e: ast.Expr) -> Optional[Tmpl]:
     return None
 
 
-def dyn_spec(expr: ast.Expr) -> Optional[DynContains]:
-    """DynContains for a natively-evaluable hard expression, else None."""
+def dyn_spec(expr: ast.Expr):
+    """DynContains/DynEq for a natively-evaluable hard expression, else
+    None."""
     from .lower import slot_of
 
-    if not (
+    if (
         isinstance(expr, ast.MethodCall)
         and expr.method == "contains"
         and len(expr.args) == 1
     ):
-        return None
-    s = slot_of(expr.obj)
-    if s is None or not s[1]:
-        return None
-    t = _tmpl_of(expr.args[0])
-    if t is None:
-        return None
-    return DynContains(s, t)
+        s = slot_of(expr.obj)
+        if s is None or not s[1]:
+            return None
+        t = _tmpl_of(expr.args[0])
+        if t is None:
+            return None
+        return DynContains(s, t)
+    if isinstance(expr, ast.Binary) and expr.op == "==":
+        # slot on either side; the other side must be a template. NOTE:
+        # expressions where one side is a bare const are lowered to vocab
+        # EQ literals long before this (lower.leaf_literal), so reaching
+        # here means at least one side is dynamic.
+        for a, b in ((expr.left, expr.right), (expr.right, expr.left)):
+            s = slot_of(a)
+            if s is None or not s[1]:
+                continue
+            t = _tmpl_of(b)
+            if t is None:
+                continue
+            return DynEq(s, t)
+    return None

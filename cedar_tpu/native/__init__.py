@@ -12,7 +12,7 @@ returns None and callers keep the pure-Python encode path.
 
 Blob format (little-endian; must match BlobReader in encoder.cpp):
 
-  i32 magic "CTB2" (0x43544232)
+  i32 magic "CTB3" (0x43544233)
   i32 n_slots
   3x var sections (principal, action, resource):
       i32 type_slot, i32 uid_slot, i32 n_anc, i32 anc_slots[...]
@@ -26,7 +26,8 @@ Blob format (little-endian; must match BlobReader in encoder.cpp):
                                                 { u8 wild, [str chunk] } }
                           cmps:    i32 count, { i32 lit, u8 op, i64 c }
                           set_has: i32 count, { str canon, i32 n, i32 lits[] }
-                          dyns:    i32 count, { i32 lit, i32 ok, i32 err,
+                          dyns:    i32 count, { u8 kind (0 contains, 1 eq),
+                                                i32 lit, i32 ok, i32 err,
                                                 tmpl } }
   tmpl = u8 kind: 0 const  { str canon }
                 | 1 pattr  { str principal-attr }
@@ -44,6 +45,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..compiler.dyn import DynEq
 from ..lang.ast import WILDCARD
 
 # flags mirrored from encoder.cpp
@@ -156,7 +158,7 @@ def _write_tmpl(w: "_BlobWriter", t) -> None:
 
 def _serialize_table(plan, table) -> bytes:
     w = _BlobWriter()
-    w.i32(0x43544232)
+    w.i32(0x43544233)
     w.i32(table.n_slots)
 
     vars3 = ("principal", "action", "resource")
@@ -235,14 +237,15 @@ def _serialize_table(plan, table) -> bytes:
                 w.i32(lid)
 
         dyns = [
-            (lid, okid, elid, spec.tmpl)
+            (1 if isinstance(spec, DynEq) else 0, lid, okid, elid, spec.tmpl)
             for (lid, okid, _expr, elid), spec in zip(
                 plan.hard_lits, plan.dyn_specs
             )
             if spec is not None and spec.slot == slot
         ]
         w.i32(len(dyns))
-        for lid, okid, elid, tmpl in dyns:
+        for kind, lid, okid, elid, tmpl in dyns:
+            w.u8(kind)
             w.i32(lid)
             w.i32(okid)
             w.i32(elid)

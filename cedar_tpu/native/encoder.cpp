@@ -377,6 +377,7 @@ struct Tmpl {
 };
 
 struct DynTest {
+  uint8_t kind;                  // 0 contains, 1 eq (compiler/dyn.py)
   int32_t lit, ok_lit, err_lit;  // -1 when absent
   Tmpl tmpl;
 };
@@ -459,7 +460,7 @@ bool read_tmpl(BlobReader &r, Tmpl &t, int depth = 0) {
 
 Table *load_table(const uint8_t *blob, size_t len) {
   BlobReader r(blob, len);
-  if (r.i32() != 0x43544232) return nullptr;  // "CTB2"
+  if (r.i32() != 0x43544233) return nullptr;  // "CTB3"
   auto t = std::make_unique<Table>();
   t->n_slots = r.i32();
   for (int v = 0; v < 3; ++v) {
@@ -542,6 +543,8 @@ Table *load_table(const uint8_t *blob, size_t len) {
     int32_t nd = r.i32();
     for (int32_t j = 0; j < nd; ++j) {
       DynTest d;
+      d.kind = r.u8();
+      if (d.kind > 1) return nullptr;
       d.lit = r.i32();
       d.ok_lit = r.i32();
       d.err_lit = r.i32();
@@ -1021,13 +1024,33 @@ bool tmpl_canon(const Tmpl &t, F &&lookup, std::string &out) {
   return true;
 }
 
-// Evaluate a slot's dyn-contains tests given the slot's element canons
-// (nullptr => the slot path is missing / not a set: every test errors,
-// exactly where the interpreter raises evaluating the same expression).
+// Evaluate a slot's dyn tests.
+//   contains (kind 0): needs the slot's element canons (`elems`; nullptr =>
+//     the slot path is missing / not a set: the test errors, exactly where
+//     the interpreter raises evaluating the same expression).
+//   eq (kind 1): needs the slot value's full canonical key (`self_canon`;
+//     nullptr => missing attribute: access error). Equal Cedar values have
+//     equal canons (the canon keys the vocab), and cross-type == is False
+//     never an error, so a byte compare IS Cedar equality.
 template <class F>
 void eval_dyns(const ScalarSlot &s, const std::vector<std::string> *elems,
-               F &&lookup, ExtrasOut &extras, std::string &scratch) {
+               const std::string *self_canon, F &&lookup, ExtrasOut &extras,
+               std::string &scratch) {
   for (const auto &d : s.dyns) {
+    if (d.kind == 1) {
+      if (!self_canon) {
+        if (d.err_lit >= 0) extras.push(d.err_lit);
+        continue;
+      }
+      scratch.clear();
+      if (!tmpl_canon(d.tmpl, lookup, scratch)) {
+        if (d.err_lit >= 0) extras.push(d.err_lit);
+        continue;
+      }
+      if (d.ok_lit >= 0) extras.push(d.ok_lit);
+      if (d.lit >= 0 && *self_canon == scratch) extras.push(d.lit);
+      continue;
+    }
     if (!elems) {
       if (d.err_lit >= 0) extras.push(d.err_lit);
       continue;
@@ -1132,8 +1155,18 @@ void encode_one(const Table &t, Features &f, int32_t *codes, ExtrasOut &extras,
     }
   }
 
+  std::string vcanon;  // the slot value's canon: vocab key + dyn eq operand
   for (const auto &s : t.slots) {
     Value v = slot_value(f, s);
+    vcanon.clear();
+    const std::string *self = nullptr;
+    if (v.kind == Value::STRV) {
+      canon_str_into(vcanon, v.str);
+      self = &vcanon;
+    } else if (v.kind == Value::SETV) {
+      canon_set_into(vcanon, *v.elems);  // sorts elems in place (stable key)
+      self = &vcanon;
+    }
     if (!s.dyns.empty()) {
       auto lookup = [&f](sv attr, sv &out) {
         for (const auto &kv : f.p_attrs)
@@ -1143,18 +1176,12 @@ void encode_one(const Table &t, Features &f, int32_t *codes, ExtrasOut &extras,
           }
         return false;
       };
-      eval_dyns(s, v.kind == Value::SETV ? v.elems : nullptr, lookup, extras,
-                scratch);
+      eval_dyns(s, v.kind == Value::SETV ? v.elems : nullptr, self, lookup,
+                extras, scratch);
     }
     if (v.kind == Value::MISSING) continue;
 
-    scratch.clear();
-    if (v.kind == Value::STRV) {
-      canon_str_into(scratch, v.str);
-    } else {
-      canon_set_into(scratch, *v.elems);  // sorts elems in place (stable key)
-    }
-    const int32_t *row = sv_find(s.vocab, scratch);
+    const int32_t *row = sv_find(s.vocab, vcanon);
     if (row) {
       codes[s.sidx] = *row;
     } else {
@@ -1875,12 +1902,15 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
     if (entry && entry->first != 0) codes[t.anc_slots[1][0]] = entry->first;
   }
 
+  std::string vcanon;  // the slot value's canon: vocab key + dyn eq operand
   for (const auto &s : t.slots) {
     const CVal *root = s.var == 0   ? f.p_rec
                        : s.var == 2 ? f.res
                        : s.var == 3 ? f.ctx
                                     : nullptr;
     const CVal *v = root ? cval_nav(root, s.comps) : nullptr;
+    vcanon.clear();
+    if (v) canon_cval(v, vcanon);
     if (!s.dyns.empty()) {
       auto lookup = [&f](sv attr, sv &out) {
         if (!f.p_rec) return false;
@@ -1902,12 +1932,10 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
         }
         elems = &ecs;
       }
-      eval_dyns(s, elems, lookup, extras, scratch);
+      eval_dyns(s, elems, v ? &vcanon : nullptr, lookup, extras, scratch);
     }
     if (!v) continue;
-    scratch.clear();
-    canon_cval(v, scratch);
-    const int32_t *row = sv_find(s.vocab, scratch);
+    const int32_t *row = sv_find(s.vocab, vcanon);
     if (row) {
       codes[s.sidx] = *row;
     } else {

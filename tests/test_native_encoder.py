@@ -492,6 +492,65 @@ def test_fastpath_unsupported_value_kinds_fall_back():
         assert decision == exp_decision, f"mismatch for {sar}"
 
 
+def test_dyn_template_with_decimal_gates_not_disables():
+    """A dyn-shaped hard expression whose TEMPLATE holds a decimal constant
+    must be classified native-opaque (gate plane), NOT claimed natively
+    evaluable — the native canon has no decimal form, and claiming it would
+    make serialize_table fail and shut the whole plane off."""
+    src = (
+        POLICIES
+        + "\npermit (principal, action, resource is k8s::Resource)"
+        + ' when { resource.tag == {k: principal.name, v: decimal("1.0")} };'
+    )
+    tiers = [PolicySet.from_source(src, "dectmpl")]
+    engine = TPUPolicyEngine()
+    engine.load(tiers)
+    assert engine.stats["native_opaque_policies"] == 1
+    stores = TieredPolicyStores([MemoryStore.from_source("t0", src)])
+    authorizer = CedarWebhookAuthorizer(stores)
+    tpu_auth = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, tpu_auth)
+    assert fastpath.available  # hybrid via the gate, not disabled
+    rng = random.Random(12)
+    sars = [_random_sar(rng) for _ in range(40)]
+    results = fastpath.authorize_raw([json.dumps(s).encode() for s in sars])
+    for sar, (decision, _reason, _err) in zip(sars, results):
+        exp_decision, _ = authorizer.authorize(get_authorizer_attributes(sar))
+        assert decision == exp_decision, f"mismatch for {sar}"
+
+
+def test_native_dyn_eq_join_policies():
+    """Principal/resource joins (DynEq) evaluate NATIVELY: no opaque
+    policies, no fallback, and raw-bytes verdicts equal the interpreter."""
+    src = (
+        POLICIES
+        + "\npermit (principal, action, resource is k8s::Resource)"
+        + " when { resource has name && resource.name == principal.name };"
+        + "\nforbid (principal, action, resource is k8s::Resource)"
+        + " unless { resource has namespace &&"
+        + " resource.namespace == principal.name };"
+    )
+    tiers = [PolicySet.from_source(src, "dyneq")]
+    engine = TPUPolicyEngine()
+    engine.load(tiers)
+    assert engine.stats["native_opaque_policies"] == 0
+    assert engine.stats["fallback_policies"] == 0
+    stores = TieredPolicyStores([MemoryStore.from_source("t0", src)])
+    authorizer = CedarWebhookAuthorizer(stores)
+    tpu_auth = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, tpu_auth)
+    assert fastpath.available
+    rng = random.Random(13)
+    sars = [_random_sar(rng) for _ in range(80)]
+    results = fastpath.authorize_raw([json.dumps(s).encode() for s in sars])
+    for sar, (decision, reason, _err) in zip(sars, results):
+        exp_decision, exp_reason = authorizer.authorize(
+            get_authorizer_attributes(sar)
+        )
+        assert decision == exp_decision, f"mismatch for {sar}"
+        assert bool(reason) == bool(exp_reason), f"reason presence: {sar}"
+
+
 def test_microbatcher_batches_and_returns_in_order():
     import threading
 

@@ -51,14 +51,24 @@ permit (principal in k8s::Group::"joiners", action == k8s::Action::"get",
   unless { principal.name != resource.name };
 """
 
-# a positive hard policy outside the dyn class (two-slot namespace join):
-# lowering keeps it as a hard literal the Python encoder host-evaluates;
-# the NATIVE plane packs its scope as a gate rule and re-routes only
-# scope-matching rows to the Python path (native-opaque hybrid)
-NON_NATIVE_POLICY = """
+# a principal/resource join: a hard literal in the native dyn-eq class
+# (compiler/dyn.py DynEq) — the C++ encoder evaluates it per request, so
+# the policy stays FULLY native
+JOIN_POLICY = """
 permit (principal is k8s::ServiceAccount, action == k8s::Action::"get",
         resource is k8s::Resource)
   when { principal.namespace == resource.namespace };
+"""
+
+# a hard literal OUTSIDE every native class (two RESOURCE-slot join: the
+# dyn template side must be a constant or principal attribute): the Python
+# encoder host-evaluates it; the NATIVE plane packs its scope as a gate
+# rule and re-routes only scope-matching rows to the Python path
+NATIVE_OPAQUE_POLICY = """
+forbid (principal, action == k8s::Action::"deletecollection",
+        resource is k8s::Resource)
+  when { resource has name && resource has namespace &&
+         resource.name == resource.namespace };
 """
 
 
@@ -220,26 +230,17 @@ class TestServerFastPaths:
         finally:
             srv.stop()
 
-    def test_hot_swap_to_native_opaque_set_stays_hybrid(self):
-        """A set with hard literals OUTSIDE the dyn class (a two-slot
-        namespace join) keeps the native plane available: the opaque
-        policy's scope is packed as a gate rule, so only rows it could
-        affect re-run the exact Python path; everything else stays
-        native — the plane no longer disables wholesale."""
+    def test_hot_swap_join_set_stays_fully_native(self):
+        """A principal/resource join is in the native dyn-eq class: the
+        swapped set carries no opaque policies and the C++ encoder
+        evaluates the join itself — correct verdicts with no gating."""
         srv, engine, _ = _build_server(POLICIES)
         try:
             assert srv.fastpath.available
-            engine.load(_tiers(POLICIES + NON_NATIVE_POLICY), warm="off")
-            assert engine.stats["native_opaque_policies"] == 1
+            engine.load(_tiers(POLICIES + JOIN_POLICY), warm="off")
+            assert engine.stats["native_opaque_policies"] == 0
             assert engine.stats["fallback_policies"] == 0
-            assert srv.fastpath.available  # hybrid via the gate plane
-            # native rows keep their verdicts
-            assert _post(srv.bound_port, "/v1/authorize", sar())["status"][
-                "allowed"
-            ]
-            deny = _post(srv.bound_port, "/v1/authorize", sar(resource="nodes"))
-            assert deny["status"]["denied"] is True
-            # gate-flagged rows (ServiceAccount get): exact python verdicts
+            assert srv.fastpath.available
             sa = "system:serviceaccount:ns-1:app"
             match = _post(
                 srv.bound_port, "/v1/authorize",
@@ -256,6 +257,37 @@ class TestServerFastPaths:
                 sar(user=sa, resource="pods"),  # no namespace: access errors
             )
             assert err["status"]["allowed"] is False  # policy skipped
+        finally:
+            srv.stop()
+
+    def test_hot_swap_to_native_opaque_set_stays_hybrid(self):
+        """A set with a hard literal OUTSIDE every native class keeps the
+        native plane available: the opaque policy's scope is packed as a
+        gate rule, so only rows it could affect re-run the exact Python
+        path; everything else stays native — the plane no longer disables
+        wholesale."""
+        srv, engine, _ = _build_server(POLICIES)
+        try:
+            assert srv.fastpath.available
+            engine.load(_tiers(POLICIES + NATIVE_OPAQUE_POLICY), warm="off")
+            assert engine.stats["native_opaque_policies"] == 1
+            assert engine.stats["fallback_policies"] == 0
+            assert srv.fastpath.available  # hybrid via the gate plane
+            # native rows keep their verdicts
+            assert _post(srv.bound_port, "/v1/authorize", sar())["status"][
+                "allowed"
+            ]
+            deny = _post(srv.bound_port, "/v1/authorize", sar(resource="nodes"))
+            assert deny["status"]["denied"] is True
+            # gate-flagged rows (deletecollection): exact python verdicts
+            def dc(namespace, name):
+                doc = sar(resource="widgets", namespace=namespace, name=name)
+                doc["spec"]["resourceAttributes"]["verb"] = "deletecollection"
+                return doc
+            hit = _post(srv.bound_port, "/v1/authorize", dc("same", "same"))
+            assert hit["status"]["denied"] is True  # opaque forbid fires
+            miss = _post(srv.bound_port, "/v1/authorize", dc("ns-1", "other"))
+            assert miss["status"]["denied"] is False
         finally:
             srv.stop()
 
