@@ -280,8 +280,7 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
         " when { principal.namespace == resource.namespace };\n"
         'forbid (principal, action == k8s::Action::"delete",'
         " resource is k8s::Resource)"
-        " when { resource has name && resource has namespace &&"
-        " resource.name == resource.namespace };"
+        " when { resource has name && ip(resource.name).isLoopback() };"
     )
     eng = TPUPolicyEngine()
     ps_join = PolicySet.from_source(join_src, "joins")

@@ -2,8 +2,8 @@
 request without the Python interpreter.
 
 Two restricted classes, both built from the same template grammar (leaves
-are compile-time constants or principal string attributes
-``principal.name`` / ``principal.namespace``):
+are compile-time constants or request SLOT chains — any
+principal/resource/context attribute path, resolved per request):
 
   * ``<slot>.contains(<template>)`` (DynContains) — the shape of the
     reference demo's
@@ -39,14 +39,18 @@ from ..lang import ast
 from ..lang.values import EvalError, value_key
 from .ir import Slot
 
-# template node: ("const", value_key) | ("pattr", attr-name)
+# template node: ("const", value_key)
+#              | ("slot", var, path) — ANY request slot's value (the native
+#                encoder resolves the chain and uses its canonical key;
+#                missing/unnavigable -> error, like the interpreter)
 #              | ("record", tuple of (field-name, node) sorted by name)
 #              | ("set", tuple of nodes — canonicalized per request)
 Tmpl = Tuple
 
-# principal attributes every builder materializes as plain strings
-# (entities/user.py; native/encoder.cpp build_features / build_adm)
-_PRINCIPAL_STR_ATTRS = frozenset({"name", "namespace"})
+# the native template reader caps slot-leaf chains (read_tmpl); a longer
+# chain must classify as NOT natively evaluable (gate plane), never crash
+# or disable the serialized table
+_MAX_SLOT_COMPS = 32
 
 
 @dataclass(frozen=True)
@@ -103,14 +107,13 @@ def _tmpl_of(e: ast.Expr) -> Optional[Tmpl]:
         return ("const", vk)
     if isinstance(e, ast.GetAttr):
         s = slot_of(e)
-        if (
-            s is not None
-            and s[0] == "principal"
-            and len(s[1]) == 1
-            and s[1][0] in _PRINCIPAL_STR_ATTRS
-        ):
-            return ("pattr", s[1][0])
-        return None
+        if s is None or not s[1] or len(s[1]) > _MAX_SLOT_COMPS:
+            return None
+        # a request-variable chain: a slot leaf — the native encoder
+        # resolves it per request to the value's canonical key (e.g.
+        # principal.name, or context.oldObject.spec.x for admission
+        # immutability joins)
+        return ("slot", s[0], s[1])
     if isinstance(e, ast.RecordLit):
         fields = {}
         for k, v in e.pairs:

@@ -251,7 +251,12 @@ class TPUPolicyEngine:
             t.start()
         else:
             self._warm_first.set()  # warm-up intentionally skipped
-        return {**compiled.stats(), "L": packed.L, "R": packed.R}
+        return {
+            **compiled.stats(),
+            "L": packed.L,
+            "R": packed.R,
+            "native_opaque_policies": packed.native_opaque,
+        }
 
     def warm_ready(self) -> bool:
         """True once the first serving shape has compiled (or warm-up was

@@ -240,7 +240,9 @@ def value_key(v: Any):
     if isinstance(v, Decimal):
         return ("d", v.units)
     if isinstance(v, IPAddr):
-        return ("i", str(v.net))
+        # (addr, prefixlen) is the equality basis (__eq__/__hash__); addr
+        # str() is canonical per the ipaddress module
+        return ("i", str(v.addr), v.prefixlen)
     raise EvalError(f"unhashable value {v!r}")
 
 

@@ -30,9 +30,11 @@ Blob format (little-endian; must match BlobReader in encoder.cpp):
                                                 i32 lit, i32 ok, i32 err,
                                                 tmpl } }
   tmpl = u8 kind: 0 const  { str canon }
-                | 1 pattr  { str principal-attr }
                 | 2 record { i32 n, { str name, tmpl } }   (names sorted)
                 | 3 set    { i32 n, { tmpl } }             (sorted at runtime)
+                | 4 slot   { u8 var, i32 n, { str comp } } (another request
+                            slot's value, resolved per request; kind 1 was
+                            the principal-attr special case, subsumed by 4)
 
   (str = i32 length + bytes)
 """
@@ -133,14 +135,23 @@ def serialize_table(plan, table) -> Optional[bytes]:
         return None
 
 
+_TMPL_VAR_CODES = {"principal": 0, "action": 1, "resource": 2, "context": 3}
+
+
 def _write_tmpl(w: "_BlobWriter", t) -> None:
     kind = t[0]
     if kind == "const":
         w.u8(0)
         w.s(_canon(t[1]))
-    elif kind == "pattr":
-        w.u8(1)
-        w.s(t[1])
+    elif kind == "slot":
+        w.u8(4)
+        code = _TMPL_VAR_CODES.get(t[1])
+        if code is None:
+            raise ValueError(f"unknown template slot var {t[1]!r}")
+        w.u8(code)
+        w.i32(len(t[2]))
+        for comp in t[2]:
+            w.s(comp)
     elif kind == "record":
         w.u8(2)
         w.i32(len(t[1]))

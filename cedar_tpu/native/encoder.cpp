@@ -367,11 +367,15 @@ const V *sv_find(const SvMap<V> &m, sv key) {
   return it == m.end() ? nullptr : &it->second;
 }
 
-// dyn-contains template node (compiler/dyn.py): the probe value of a
-// <slot>.contains(<template>) hard expression, resolved per request.
+// dyn template node (compiler/dyn.py): the probe value of a
+// <slot>.contains(<template>) / <slot> == <template> hard expression,
+// resolved per request.
 struct Tmpl {
-  uint8_t kind;   // 0 const canon, 1 principal attr, 2 record, 3 set
-  std::string s;  // const: pre-canonicalized bytes; pattr: attribute name
+  uint8_t kind;     // 0 const canon, 2 record, 3 set,
+                    // 4 slot (another request slot's value)
+  uint8_t var = 0;  // slot: 0 principal, 1 action, 2 resource, 3 context
+  std::string s;    // const: pre-canonicalized bytes
+  std::vector<std::string> comps;  // slot: attribute path components
   std::vector<std::pair<std::string, Tmpl>> fields;  // record (names sorted)
                                                      // set: names unused
 };
@@ -444,8 +448,16 @@ class BlobReader {
 bool read_tmpl(BlobReader &r, Tmpl &t, int depth = 0) {
   if (depth > 8) return false;
   t.kind = r.u8();
-  if (t.kind == 0 || t.kind == 1) {
+  if (t.kind == 0) {
     t.s = r.str();
+    return r.ok();
+  }
+  if (t.kind == 4) {
+    t.var = r.u8();
+    if (t.var > 3) return false;
+    int32_t n = r.i32();
+    if (!r.ok() || n < 1 || n > 32) return false;
+    for (int32_t i = 0; i < n; ++i) t.comps.push_back(r.str());
     return r.ok();
   }
   if (t.kind != 2 && t.kind != 3) return false;
@@ -983,29 +995,26 @@ struct ExtrasOut {
   }
 };
 
-// Resolve a dyn template into the probe's canonical value key. `lookup`
-// is `bool(sv attr, sv &out)` returning the principal's string attribute
-// or false when absent (a Cedar attribute-access error). Returns false on
-// any error — the caller activates the test's err_lit, mirroring the
-// interpreter raising from the same expression.
-template <class F>
-bool tmpl_canon(const Tmpl &t, F &&lookup, std::string &out) {
+// Resolve a dyn template into the probe's canonical value key.
+// `slot_canon` is `bool(uint8_t var, const vector<string> &comps,
+// string &out)` appending ANY request slot's canonical value (false when
+// the chain doesn't resolve — a Cedar attribute-access error). Returns
+// false on any error — the caller activates the test's err_lit, mirroring
+// the interpreter raising from the same expression.
+template <class S>
+bool tmpl_canon(const Tmpl &t, S &&slot_canon, std::string &out) {
   if (t.kind == 0) {  // pre-canonicalized constant
     out += t.s;
     return true;
   }
-  if (t.kind == 1) {  // principal string attribute
-    sv val;
-    if (!lookup(sv(t.s), val)) return false;
-    canon_str_into(out, val);
-    return true;
-  }
+  if (t.kind == 4)  // another request slot's value
+    return slot_canon(t.var, t.comps, out);
   if (t.kind == 3) {  // set: canonicalize children, sort + dedupe
     std::vector<std::string> es;
     es.reserve(t.fields.size());
     for (const auto &f : t.fields) {
       std::string ec;
-      if (!tmpl_canon(f.second, lookup, ec)) return false;
+      if (!tmpl_canon(f.second, slot_canon, ec)) return false;
       es.push_back(std::move(ec));
     }
     canon_set_into(out, es);
@@ -1018,7 +1027,7 @@ bool tmpl_canon(const Tmpl &t, F &&lookup, std::string &out) {
     canon_len_prefix(out, t.fields[i].first.size());
     out += t.fields[i].first;
     out.push_back('\x1d');
-    if (!tmpl_canon(t.fields[i].second, lookup, out)) return false;
+    if (!tmpl_canon(t.fields[i].second, slot_canon, out)) return false;
   }
   out.push_back('}');
   return true;
@@ -1032,10 +1041,10 @@ bool tmpl_canon(const Tmpl &t, F &&lookup, std::string &out) {
 //     nullptr => missing attribute: access error). Equal Cedar values have
 //     equal canons (the canon keys the vocab), and cross-type == is False
 //     never an error, so a byte compare IS Cedar equality.
-template <class F>
+template <class S>
 void eval_dyns(const ScalarSlot &s, const std::vector<std::string> *elems,
-               const std::string *self_canon, F &&lookup, ExtrasOut &extras,
-               std::string &scratch) {
+               const std::string *self_canon, S &&slot_canon,
+               ExtrasOut &extras, std::string &scratch) {
   for (const auto &d : s.dyns) {
     if (d.kind == 1) {
       if (!self_canon) {
@@ -1043,7 +1052,7 @@ void eval_dyns(const ScalarSlot &s, const std::vector<std::string> *elems,
         continue;
       }
       scratch.clear();
-      if (!tmpl_canon(d.tmpl, lookup, scratch)) {
+      if (!tmpl_canon(d.tmpl, slot_canon, scratch)) {
         if (d.err_lit >= 0) extras.push(d.err_lit);
         continue;
       }
@@ -1056,7 +1065,7 @@ void eval_dyns(const ScalarSlot &s, const std::vector<std::string> *elems,
       continue;
     }
     scratch.clear();
-    if (!tmpl_canon(d.tmpl, lookup, scratch)) {
+    if (!tmpl_canon(d.tmpl, slot_canon, scratch)) {
       if (d.err_lit >= 0) extras.push(d.err_lit);
       continue;
     }
@@ -1071,39 +1080,66 @@ void eval_dyns(const ScalarSlot &s, const std::vector<std::string> *elems,
   }
 }
 
-Value slot_value(Features &f, const ScalarSlot &s) {
+// Resolve one FLAT attribute of an authz request variable — the single
+// resolution rule shared by the vocab path (slot_value) and the template
+// slot-leaf path (sar_slot_canon), so the two can never diverge on which
+// attributes exist.
+Value resolve_sar_attr(Features &f, uint8_t var, bool deep, sv attr) {
   Value v;
-  if (s.deep || s.var == 3) return v;  // context is empty for authz; deep
-                                       // paths never resolve in this domain
-  if (s.var == 0) {  // principal
+  if (deep || var == 3) return v;  // context is empty for authz; deep
+                                   // paths never resolve in this domain
+  if (var == 0) {  // principal
     for (const auto &kv : f.p_attrs)
-      if (kv.first == s.attr) {
+      if (kv.first == attr) {
         v.kind = Value::STRV;
         v.str = kv.second;
         return v;
       }
-    if (s.attr == "extra" && f.has_extra) {
+    if (attr == sv("extra") && f.has_extra) {
       v.kind = Value::SETV;
       v.elems = &f.extra_elem_canons;
     }
     return v;
   }
-  if (s.var == 1) return v;  // action entities carry no attributes
+  if (var == 1) return v;  // action entities carry no attributes
   // resource
   for (const auto &kv : f.r_attrs)
-    if (kv.first == s.attr) {
+    if (kv.first == attr) {
       v.kind = Value::STRV;
       v.str = kv.second;
       return v;
     }
-  if (s.attr == "labelSelector" && f.has_label) {
+  if (attr == sv("labelSelector") && f.has_label) {
     v.kind = Value::SETV;
     v.elems = &f.label_elem_canons;
-  } else if (s.attr == "fieldSelector" && f.has_field) {
+  } else if (attr == sv("fieldSelector") && f.has_field) {
     v.kind = Value::SETV;
     v.elems = &f.field_elem_canons;
   }
   return v;
+}
+
+Value slot_value(Features &f, const ScalarSlot &s) {
+  return resolve_sar_attr(f, s.var, s.deep, sv(s.attr));
+}
+
+// Resolve a template SLOT leaf for the authz domain: (var, single flat
+// attribute) -> append the value's canonical key. Shares resolve_sar_attr
+// with slot_value; deep chains, context, and action never resolve here —
+// the interpreter errors on the same accesses (authz attributes are flat).
+bool sar_slot_canon(Features &f, uint8_t var,
+                    const std::vector<std::string> &comps, std::string &out) {
+  if (comps.size() != 1) return false;
+  Value v = resolve_sar_attr(f, var, false, sv(comps[0]));
+  if (v.kind == Value::STRV) {
+    canon_str_into(out, v.str);
+    return true;
+  }
+  if (v.kind == Value::SETV) {
+    canon_set_into(out, *v.elems);
+    return true;
+  }
+  return false;
 }
 
 void encode_one(const Table &t, Features &f, int32_t *codes, ExtrasOut &extras,
@@ -1168,16 +1204,12 @@ void encode_one(const Table &t, Features &f, int32_t *codes, ExtrasOut &extras,
       self = &vcanon;
     }
     if (!s.dyns.empty()) {
-      auto lookup = [&f](sv attr, sv &out) {
-        for (const auto &kv : f.p_attrs)
-          if (kv.first == attr) {
-            out = kv.second;
-            return true;
-          }
-        return false;
+      auto slot_canon = [&f](uint8_t var, const std::vector<std::string> &c,
+                             std::string &out) {
+        return sar_slot_canon(f, var, c, out);
       };
-      eval_dyns(s, v.kind == Value::SETV ? v.elems : nullptr, self, lookup,
-                extras, scratch);
+      eval_dyns(s, v.kind == Value::SETV ? v.elems : nullptr, self,
+                slot_canon, extras, scratch);
     }
     if (v.kind == Value::MISSING) continue;
 
@@ -1353,7 +1385,14 @@ int classify_ip(sv s) {
   if (addr.find(':') != sv::npos) {
     char buf[16];
     std::string z(addr);
-    return inet_pton(AF_INET6, z.c_str(), buf) == 1 ? 1 : 0;
+    if (inet_pton(AF_INET6, z.c_str(), buf) != 1) return 0;
+    // only admit v6 spellings already in canonical (inet_ntop) form: the
+    // IPV canon (canon_cval) byte-compares address text as the equality
+    // basis, so "0:0:0:0:0:0:0:1" must not be provable — it would compare
+    // unequal to "::1" while python's parsed addresses compare equal
+    char txt[INET6_ADDRSTRLEN];
+    if (!inet_ntop(AF_INET6, buf, txt, sizeof txt)) return 2;
+    return z == txt ? 1 : 2;
   }
   // strict dotted-quad: 4 decimal octets, 0-255, no leading zeros
   int octets = 0;
@@ -1573,12 +1612,29 @@ void canon_cval(const CVal *v, std::string &out) {
     case CVal::BOOLV:
       out.push_back(v->b ? 't' : 'f');
       return;
-    case CVal::IPV:
-      // value_key tag "i": _canon() refuses it, so no vocab/set_has key can
-      // ever hold one — any distinct prefix is correct (never matches)
+    case CVal::IPV: {
+      // value_key tag "i": _canon() refuses it, so no vocab/set_has key
+      // can ever hold one. The canon still NORMALIZES (canonical address
+      // text — classify_ip only admits strict dotted-quad v4 and
+      // ntop-round-trip v6 — plus the PARSED prefix length, defaulted to
+      // the address family's max): the dyn eq tests byte-compare these
+      // canons, and python IPAddr equality is (addr, prefixlen)
+      sv s = v->str;
+      sv a = s;
+      long long plen = -1;
+      size_t slash = s.rfind('/');
+      if (slash != sv::npos) {
+        a = s.substr(0, slash);
+        py_int_parse(s.substr(slash + 1), &plen);  // valid per classify_ip
+      }
+      if (plen < 0) plen = a.find(':') != sv::npos ? 128 : 32;
       out.push_back('i');
-      out.append(v->str.data(), v->str.size());
+      out.append(a.data(), a.size());
+      char buf[8];
+      int n = snprintf(buf, sizeof buf, "/%lld", plen);
+      out.append(buf, size_t(n));
       return;
+    }
     case CVal::ENTV:
       out.push_back('e');
       canon_len_prefix(out, v->ent_type.size());
@@ -1912,14 +1968,16 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
     vcanon.clear();
     if (v) canon_cval(v, vcanon);
     if (!s.dyns.empty()) {
-      auto lookup = [&f](sv attr, sv &out) {
-        if (!f.p_rec) return false;
-        for (const auto &fl : f.p_rec->fields)
-          if (fl.first == attr && fl.second->kind == CVal::STRV) {
-            out = fl.second->str;
-            return true;
-          }
-        return false;
+      auto slot_canon = [&f](uint8_t var, const std::vector<std::string> &c,
+                             std::string &out) {
+        const CVal *sroot = var == 0   ? f.p_rec
+                            : var == 2 ? f.res
+                            : var == 3 ? f.ctx
+                                       : nullptr;
+        const CVal *sval = sroot ? cval_nav(sroot, c) : nullptr;
+        if (!sval) return false;
+        canon_cval(sval, out);
+        return true;
       };
       std::vector<std::string> ecs;
       const std::vector<std::string> *elems = nullptr;
@@ -1932,7 +1990,8 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
         }
         elems = &ecs;
       }
-      eval_dyns(s, elems, v ? &vcanon : nullptr, lookup, extras, scratch);
+      eval_dyns(s, elems, v ? &vcanon : nullptr, slot_canon, extras,
+                scratch);
     }
     if (!v) continue;
     const int32_t *row = sv_find(s.vocab, vcanon);

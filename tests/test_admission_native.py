@@ -300,6 +300,144 @@ def test_admission_fastpath_directed_cases():
     assert_parity(fast, handler, bodies)
 
 
+def test_admission_immutability_join_native():
+    """Field-immutability UPDATE policies — a deep slot-leaf join between
+    the new object and context.oldObject — evaluate NATIVELY (DynEq with a
+    slot template leaf): no opaque policies, no gating, and raw-bytes
+    verdicts equal the python handler, including the negated form and
+    missing-field error paths."""
+    src = (
+        ADM_POLICIES
+        + """
+forbid (
+    principal,
+    action == k8s::admission::Action::"update",
+    resource is apps::v1::Deployment
+) when {
+    context has oldObject && context.oldObject has spec &&
+    context.oldObject.spec has serviceAccountName &&
+    resource has spec && resource.spec has serviceAccountName &&
+    !(resource.spec.serviceAccountName ==
+      context.oldObject.spec.serviceAccountName)
+};
+"""
+    )
+    engine = TPUPolicyEngine()
+    stats = engine.load(
+        [
+            PolicySet.from_source(src, "imm"),
+            PolicySet.from_source(ALLOW_ALL_ADMISSION_POLICY_SOURCE, "aa"),
+        ],
+        warm="off",
+    )
+    assert stats["fallback_policies"] == 0
+    assert stats["native_opaque_policies"] == 0
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores(
+            [MemoryStore.from_source("imm", src),
+             allow_all_admission_policy_store()]
+        ),
+        evaluate=engine.evaluate,
+        evaluate_batch=engine.evaluate_batch,
+    )
+    fast = AdmissionFastPath(engine, handler)
+    assert fast.available
+
+    def dep(sa):
+        o = {"apiVersion": "apps/v1", "kind": "Deployment",
+             "metadata": {"name": "d", "namespace": "default"}}
+        if sa is not None:
+            o["spec"] = {"serviceAccountName": sa}
+        return o
+
+    bodies = [
+        json.dumps(
+            review(op="UPDATE", gvk=("apps", "v1", "Deployment"),
+                   obj=dep(new), old=dep(old))
+        ).encode()
+        for new, old in [
+            ("app-sa", "app-sa"),    # unchanged: allowed
+            ("app-sa", "other-sa"),  # changed: forbidden
+            ("app-sa", None),        # old missing the field: guard false
+            (None, "app-sa"),        # new missing the field: guard false
+        ]
+    ]
+    assert_parity(fast, handler, bodies)
+    # the changed-field review really is denied
+    res = fast.handle_raw([bodies[1]])[0]
+    assert res.allowed is False
+
+
+def test_admission_ip_field_join_parity():
+    """Joins over IP-typed fields: equal parsed addresses must compare
+    equal natively (the IPV canon normalizes address text + prefix), and
+    v6 spellings the native side can't prove canonical route the ROW to
+    the python fallback — either way, raw-bytes verdicts equal the
+    handler."""
+    src = (
+        ADM_POLICIES
+        + """
+forbid (
+    principal,
+    action == k8s::admission::Action::"update",
+    resource is core::v1::Service
+) when {
+    context has oldObject && context.oldObject has spec &&
+    context.oldObject.spec has clusterIP &&
+    resource has spec && resource.spec has clusterIP &&
+    !(resource.spec.clusterIP == context.oldObject.spec.clusterIP)
+};
+"""
+    )
+    engine = TPUPolicyEngine()
+    stats = engine.load(
+        [
+            PolicySet.from_source(src, "ipj"),
+            PolicySet.from_source(ALLOW_ALL_ADMISSION_POLICY_SOURCE, "aa"),
+        ],
+        warm="off",
+    )
+    assert stats["fallback_policies"] == 0
+    assert stats["native_opaque_policies"] == 0
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores(
+            [MemoryStore.from_source("ipj", src),
+             allow_all_admission_policy_store()]
+        ),
+        evaluate=engine.evaluate,
+        evaluate_batch=engine.evaluate_batch,
+    )
+    fast = AdmissionFastPath(engine, handler)
+    assert fast.available
+
+    def svc(ip):
+        return {"apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": "s", "namespace": "default"},
+                "spec": {"clusterIP": ip}}
+
+    bodies = [
+        json.dumps(
+            review(op="UPDATE", gvk=("", "v1", "Service"),
+                   obj=svc(new), old=svc(old))
+        ).encode()
+        for new, old in [
+            ("10.0.0.7", "10.0.0.7"),      # unchanged: allowed
+            ("10.0.0.7", "10.0.0.8"),      # changed: denied
+            ("::1", "::1"),                # v6 canonical unchanged: allowed
+            ("::1", "fe80::2"),            # v6 changed: denied
+            ("::1", "0:0:0:0:0:0:0:1"),    # same address, other spelling:
+                                           # python row fallback, allowed
+            ("10.0.0.7/32", "10.0.0.7"),   # explicit max prefix == default
+            ("None", "10.0.0.7"),          # "None" clusterIP: raw string
+        ]
+    ]
+    assert_parity(fast, handler, bodies)
+    res = fast.handle_raw(bodies)
+    assert [r.allowed for r in res] == [
+        True, False, True, False, True, True, False,
+    ]
+
+
 def test_admission_fastpath_randomized():
     engine, handler, fast = _build()
     rng = random.Random(42)

@@ -81,18 +81,20 @@ def _gen_condition(rng: random.Random) -> str:
         )
     if kind < 0.87:
         return "resource has subresource"
-    if kind < 0.89:
+    if kind < 0.88:
         # principal/resource join: native dyn-eq class (the C++ encoder
         # compares the two canons per request, compiler/dyn.py DynEq)
         return "resource has name && resource.name == principal.name"
-    if kind < 0.9:
-        # two-RESOURCE-slot join: outside every native class (the dyn
-        # template side must be const or a principal attr) — exercises the
-        # native-opaque scope-gate plane on the raw-bytes lane
+    if kind < 0.89:
+        # two-RESOURCE-slot join: native via a template SLOT leaf
         return (
             "resource has name && resource has namespace && "
             "resource.name == resource.namespace"
         )
+    if kind < 0.9:
+        # dynamic extension call: outside every native class — exercises
+        # the native-opaque scope-gate plane on the raw-bytes lane
+        return "resource has name && ip(resource.name).isLoopback()"
     if kind < 0.96:
         # UNGUARDED optional-attribute access: errors when the attribute is
         # absent — exercises Cedar's policy-error semantics (the policy is

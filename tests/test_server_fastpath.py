@@ -60,15 +60,24 @@ permit (principal is k8s::ServiceAccount, action == k8s::Action::"get",
   when { principal.namespace == resource.namespace };
 """
 
-# a hard literal OUTSIDE every native class (two RESOURCE-slot join: the
-# dyn template side must be a constant or principal attribute): the Python
-# encoder host-evaluates it; the NATIVE plane packs its scope as a gate
-# rule and re-routes only scope-matching rows to the Python path
-NATIVE_OPAQUE_POLICY = """
-forbid (principal, action == k8s::Action::"deletecollection",
+# a two-RESOURCE-slot join: template SLOT leaves put it in the native
+# dyn-eq class too — the C++ encoder resolves resource.namespace as the
+# probe value
+RESOURCE_JOIN_POLICY = """
+permit (principal, action == k8s::Action::"deletecollection",
         resource is k8s::Resource)
   when { resource has name && resource has namespace &&
          resource.name == resource.namespace };
+"""
+
+# a hard literal OUTSIDE every native class (extension-call on a dynamic
+# value): the Python encoder host-evaluates it; the NATIVE plane packs its
+# scope as a gate rule and re-routes only scope-matching rows to the
+# Python path
+NATIVE_OPAQUE_POLICY = """
+forbid (principal, action == k8s::Action::"deletecollection",
+        resource is k8s::Resource)
+  when { resource has name && ip(resource.name).isLoopback() };
 """
 
 
@@ -260,12 +269,33 @@ class TestServerFastPaths:
         finally:
             srv.stop()
 
+    def test_hot_swap_resource_join_stays_fully_native(self):
+        """Two-RESOURCE-slot joins ride the template slot leaves: still no
+        opaque policies, verdicts native."""
+        srv, engine, _ = _build_server(POLICIES)
+        try:
+            engine.load(_tiers(POLICIES + RESOURCE_JOIN_POLICY), warm="off")
+            assert engine.stats["native_opaque_policies"] == 0
+            assert srv.fastpath.available
+
+            def dc(namespace, name):
+                doc = sar(resource="widgets", namespace=namespace, name=name)
+                doc["spec"]["resourceAttributes"]["verb"] = "deletecollection"
+                return doc
+
+            hit = _post(srv.bound_port, "/v1/authorize", dc("same", "same"))
+            assert hit["status"]["allowed"] is True  # join holds
+            miss = _post(srv.bound_port, "/v1/authorize", dc("ns-1", "other"))
+            assert miss["status"]["allowed"] is False
+        finally:
+            srv.stop()
+
     def test_hot_swap_to_native_opaque_set_stays_hybrid(self):
-        """A set with a hard literal OUTSIDE every native class keeps the
-        native plane available: the opaque policy's scope is packed as a
-        gate rule, so only rows it could affect re-run the exact Python
-        path; everything else stays native — the plane no longer disables
-        wholesale."""
+        """A set with a hard literal OUTSIDE every native class (dynamic
+        extension call) keeps the native plane available: the opaque
+        policy's scope is packed as a gate rule, so only rows it could
+        affect re-run the exact Python path; everything else stays
+        native — the plane no longer disables wholesale."""
         srv, engine, _ = _build_server(POLICIES)
         try:
             assert srv.fastpath.available
@@ -280,14 +310,17 @@ class TestServerFastPaths:
             deny = _post(srv.bound_port, "/v1/authorize", sar(resource="nodes"))
             assert deny["status"]["denied"] is True
             # gate-flagged rows (deletecollection): exact python verdicts
-            def dc(namespace, name):
-                doc = sar(resource="widgets", namespace=namespace, name=name)
+            def dc(name):
+                doc = sar(resource="widgets", namespace="ns-1", name=name)
                 doc["spec"]["resourceAttributes"]["verb"] = "deletecollection"
                 return doc
-            hit = _post(srv.bound_port, "/v1/authorize", dc("same", "same"))
-            assert hit["status"]["denied"] is True  # opaque forbid fires
-            miss = _post(srv.bound_port, "/v1/authorize", dc("ns-1", "other"))
-            assert miss["status"]["denied"] is False
+
+            hit = _post(srv.bound_port, "/v1/authorize", dc("127.0.0.1"))
+            assert hit["status"]["denied"] is True  # loopback: forbid fires
+            nomatch = _post(srv.bound_port, "/v1/authorize", dc("10.0.0.8"))
+            assert nomatch["status"]["denied"] is False
+            err = _post(srv.bound_port, "/v1/authorize", dc("not-an-ip"))
+            assert err["status"]["denied"] is False  # ip() errors: skip
         finally:
             srv.stop()
 
