@@ -1,7 +1,7 @@
 """Dynamic templates: hard expressions the NATIVE encoder can evaluate per
 request without the Python interpreter.
 
-Two restricted classes, both built from the same template grammar (leaves
+Three restricted classes, all built from the same template grammar (leaves
 are compile-time constants or request SLOT chains — any
 principal/resource/context attribute path, resolved per request):
 
@@ -14,13 +14,18 @@ principal/resource/context attribute path, resolved per request):
     the template against the request, builds the probe's canonical value
     key, and tests membership against the slot's element canons.
 
-  * ``<slot> == <template>`` (DynEq) — principal/resource joins like
-    ``resource.name == principal.name`` or
+  * ``<slot> == <template>`` / ``!=`` (DynEq) — principal/resource joins
+    like ``resource.name == principal.name`` or
     ``principal.namespace == resource.namespace``: the C++ encoder
     compares the slot value's canon against the resolved template canon
     (equal Cedar values have equal canons; cross-type ``==`` is False).
 
-Both are byte-identical to interpreting the expression, so a policy whose
+  * ``<slot> < <template>`` etc. (DynCmp) — ordered Long comparisons like
+    ``resource.spec.replicas > context.oldObject.spec.replicas``
+    (no-scale admission policies): both canons must carry the Long tag,
+    anything else errors like the interpreter's type error.
+
+All three are byte-identical to interpreting the expression, so a policy whose
 hard literals are all in these classes keeps the whole native fast path;
 anything else makes the policy "native-opaque" — its scope becomes a gate
 rule (compiler/pack.py) and only scope-matching rows leave the native path.
@@ -61,17 +66,32 @@ class DynContains:
 
 @dataclass(frozen=True)
 class DynEq:
-    """``<slot> == <template>``: a two-operand equality the native encoder
-    evaluates per request — e.g. ``resource.name == principal.name`` or
-    ``principal.namespace == resource.namespace`` (slot on whichever side
-    chains off a request variable; the other side a template). Equal values
-    have equal canonical keys (the canon is injective — it keys the vocab),
-    so the native test is a byte compare of the two canons; a missing slot
-    attribute or template attribute errors exactly where the interpreter
-    raises."""
+    """``<slot> == <template>`` (or ``!=``): a two-operand equality the
+    native encoder evaluates per request — e.g. ``resource.name ==
+    principal.name`` or ``principal.namespace == resource.namespace``
+    (slot on whichever side chains off a request variable; the other side
+    a template). Equal values have equal canonical keys (the canon is
+    injective — it keys the vocab), so the native test is a byte compare
+    of the two canons; a missing slot attribute or template attribute
+    errors exactly where the interpreter raises."""
 
     slot: Slot  # the (var, path) the left value is read from
     tmpl: Tmpl  # template for the right value
+    negate: bool = False  # != (cross-type != is True, like the interpreter)
+
+
+@dataclass(frozen=True)
+class DynCmp:
+    """``<slot> <op> <template>`` for ``< <= > >=``: ordered comparison the
+    native encoder evaluates per request — e.g. ``resource.spec.replicas >
+    context.oldObject.spec.replicas`` (no-scale admission policies). Cedar
+    orders Longs only: both canons must carry the Long tag, anything else
+    errors exactly where the interpreter raises a type error. ``op`` is
+    normalized to slot-on-the-left."""
+
+    slot: Slot
+    tmpl: Tmpl
+    op: str  # "<" | "<=" | ">" | ">="
 
 
 # value_key tags the native canon serializer (native/__init__._canon /
@@ -136,8 +156,8 @@ def _tmpl_of(e: ast.Expr) -> Optional[Tmpl]:
 
 
 def dyn_spec(expr: ast.Expr):
-    """DynContains/DynEq for a natively-evaluable hard expression, else
-    None."""
+    """DynContains/DynEq/DynCmp for a natively-evaluable hard expression,
+    else None."""
     from .lower import slot_of
 
     if (
@@ -152,7 +172,7 @@ def dyn_spec(expr: ast.Expr):
         if t is None:
             return None
         return DynContains(s, t)
-    if isinstance(expr, ast.Binary) and expr.op == "==":
+    if isinstance(expr, ast.Binary) and expr.op in ("==", "!="):
         # slot on either side; the other side must be a template. NOTE:
         # expressions where one side is a bare const are lowered to vocab
         # EQ literals long before this (lower.leaf_literal), so reaching
@@ -164,5 +184,18 @@ def dyn_spec(expr: ast.Expr):
             t = _tmpl_of(b)
             if t is None:
                 continue
-            return DynEq(s, t)
+            return DynEq(s, t, negate=expr.op == "!=")
+    if isinstance(expr, ast.Binary) and expr.op in ("<", "<=", ">", ">="):
+        _FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+        for a, b, op in (
+            (expr.left, expr.right, expr.op),
+            (expr.right, expr.left, _FLIP[expr.op]),
+        ):
+            s = slot_of(a)
+            if s is None or not s[1]:
+                continue
+            t = _tmpl_of(b)
+            if t is None:
+                continue
+            return DynCmp(s, t, op)
     return None

@@ -26,7 +26,10 @@ Blob format (little-endian; must match BlobReader in encoder.cpp):
                                                 { u8 wild, [str chunk] } }
                           cmps:    i32 count, { i32 lit, u8 op, i64 c }
                           set_has: i32 count, { str canon, i32 n, i32 lits[] }
-                          dyns:    i32 count, { u8 kind (0 contains, 1 eq),
+                          dyns:    i32 count, { u8 kind (0 contains, 1 eq,
+                                                2 cmp), u8 op (eq: 0 ==
+                                                1 !=; cmp: 0 < 1 <= 2 >
+                                                3 >=; contains: 0),
                                                 i32 lit, i32 ok, i32 err,
                                                 tmpl } }
   tmpl = u8 kind: 0 const  { str canon }
@@ -47,7 +50,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..compiler.dyn import DynEq
+from ..compiler.dyn import DynCmp, DynEq
 from ..lang.ast import WILDCARD
 
 # flags mirrored from encoder.cpp
@@ -248,19 +251,27 @@ def _serialize_table(plan, table) -> bytes:
                 w.i32(lid)
 
         dyns = [
-            (1 if isinstance(spec, DynEq) else 0, lid, okid, elid, spec.tmpl)
+            (spec, lid, okid, elid)
             for (lid, okid, _expr, elid), spec in zip(
                 plan.hard_lits, plan.dyn_specs
             )
             if spec is not None and spec.slot == slot
         ]
         w.i32(len(dyns))
-        for kind, lid, okid, elid, tmpl in dyns:
-            w.u8(kind)
+        for spec, lid, okid, elid in dyns:
+            if isinstance(spec, DynEq):
+                w.u8(1)
+                w.u8(1 if spec.negate else 0)
+            elif isinstance(spec, DynCmp):
+                w.u8(2)
+                w.u8(_CMP_OPS[spec.op])
+            else:
+                w.u8(0)
+                w.u8(0)
             w.i32(lid)
             w.i32(okid)
             w.i32(elid)
-            _write_tmpl(w, tmpl)
+            _write_tmpl(w, spec.tmpl)
 
     return w.blob()
 

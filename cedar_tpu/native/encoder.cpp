@@ -381,7 +381,8 @@ struct Tmpl {
 };
 
 struct DynTest {
-  uint8_t kind;                  // 0 contains, 1 eq (compiler/dyn.py)
+  uint8_t kind;  // 0 contains, 1 eq, 2 cmp (compiler/dyn.py)
+  uint8_t op;    // eq: 0 ==, 1 !=; cmp: 0 <, 1 <=, 2 >, 3 >=
   int32_t lit, ok_lit, err_lit;  // -1 when absent
   Tmpl tmpl;
 };
@@ -556,7 +557,9 @@ Table *load_table(const uint8_t *blob, size_t len) {
     for (int32_t j = 0; j < nd; ++j) {
       DynTest d;
       d.kind = r.u8();
-      if (d.kind > 1) return nullptr;
+      if (d.kind > 2) return nullptr;
+      d.op = r.u8();
+      if (d.op > 3 || (d.kind != 2 && d.op > 1)) return nullptr;
       d.lit = r.i32();
       d.ok_lit = r.i32();
       d.err_lit = r.i32();
@@ -1033,20 +1036,32 @@ bool tmpl_canon(const Tmpl &t, S &&slot_canon, std::string &out) {
   return true;
 }
 
+// Parse a canonical Long ("l<decimal>") back to its value; false for any
+// other canon tag (the operand is not a Cedar Long).
+bool canon_long(const std::string &c, long long *out) {
+  if (c.size() < 2 || c[0] != 'l') return false;
+  const char *b = c.data() + 1, *e = c.data() + c.size();
+  auto res = std::from_chars(b, e, *out);
+  return res.ec == std::errc() && res.ptr == e;
+}
+
 // Evaluate a slot's dyn tests.
 //   contains (kind 0): needs the slot's element canons (`elems`; nullptr =>
 //     the slot path is missing / not a set: the test errors, exactly where
 //     the interpreter raises evaluating the same expression).
-//   eq (kind 1): needs the slot value's full canonical key (`self_canon`;
-//     nullptr => missing attribute: access error). Equal Cedar values have
-//     equal canons (the canon keys the vocab), and cross-type == is False
-//     never an error, so a byte compare IS Cedar equality.
+//   eq/neq (kind 1): needs the slot value's full canonical key
+//     (`self_canon`; nullptr => missing attribute: access error). Equal
+//     Cedar values have equal canons (the canon keys the vocab), and
+//     cross-type ==/!= is False/True never an error, so a byte compare IS
+//     Cedar equality.
+//   cmp (kind 2): both canons must be Longs ("l<decimal>"); anything else
+//     is the interpreter's type error.
 template <class S>
 void eval_dyns(const ScalarSlot &s, const std::vector<std::string> *elems,
                const std::string *self_canon, S &&slot_canon,
                ExtrasOut &extras, std::string &scratch) {
   for (const auto &d : s.dyns) {
-    if (d.kind == 1) {
+    if (d.kind == 1) {  // eq / neq: canon byte compare
       if (!self_canon) {
         if (d.err_lit >= 0) extras.push(d.err_lit);
         continue;
@@ -1057,7 +1072,31 @@ void eval_dyns(const ScalarSlot &s, const std::vector<std::string> *elems,
         continue;
       }
       if (d.ok_lit >= 0) extras.push(d.ok_lit);
-      if (d.lit >= 0 && *self_canon == scratch) extras.push(d.lit);
+      bool hit = *self_canon == scratch;
+      if (d.op) hit = !hit;  // != (cross-type != is True)
+      if (hit && d.lit >= 0) extras.push(d.lit);
+      continue;
+    }
+    if (d.kind == 2) {  // ordered cmp: both sides must be Longs
+      if (!self_canon) {
+        if (d.err_lit >= 0) extras.push(d.err_lit);
+        continue;
+      }
+      scratch.clear();
+      long long a, b;
+      if (!tmpl_canon(d.tmpl, slot_canon, scratch) ||
+          !canon_long(*self_canon, &a) || !canon_long(scratch, &b)) {
+        // missing attr OR a non-Long operand: Cedar's < <= > >= are
+        // defined on Longs only — the interpreter raises a type error
+        if (d.err_lit >= 0) extras.push(d.err_lit);
+        continue;
+      }
+      if (d.ok_lit >= 0) extras.push(d.ok_lit);
+      bool hit = d.op == 0   ? a < b
+                 : d.op == 1 ? a <= b
+                 : d.op == 2 ? a > b
+                             : a >= b;
+      if (hit && d.lit >= 0) extras.push(d.lit);
       continue;
     }
     if (!elems) {

@@ -368,6 +368,72 @@ forbid (
     assert res.allowed is False
 
 
+def test_admission_no_scale_up_cmp_native():
+    """Ordered-comparison joins (DynCmp): a no-scale-up policy comparing
+    resource.spec.replicas against context.oldObject.spec.replicas
+    evaluates natively — Long operands compare, anything else errors like
+    the interpreter's type error."""
+    src = (
+        ADM_POLICIES
+        + """
+forbid (
+    principal,
+    action == k8s::admission::Action::"update",
+    resource is apps::v1::Deployment
+) when {
+    context has oldObject && context.oldObject has spec &&
+    context.oldObject.spec has replicas &&
+    resource has spec && resource.spec has replicas &&
+    resource.spec.replicas > context.oldObject.spec.replicas
+};
+"""
+    )
+    engine = TPUPolicyEngine()
+    stats = engine.load(
+        [
+            PolicySet.from_source(src, "scale"),
+            PolicySet.from_source(ALLOW_ALL_ADMISSION_POLICY_SOURCE, "aa"),
+        ],
+        warm="off",
+    )
+    assert stats["fallback_policies"] == 0
+    assert stats["native_opaque_policies"] == 0
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores(
+            [MemoryStore.from_source("scale", src),
+             allow_all_admission_policy_store()]
+        ),
+        evaluate=engine.evaluate,
+        evaluate_batch=engine.evaluate_batch,
+    )
+    fast = AdmissionFastPath(engine, handler)
+    assert fast.available
+
+    def dep(replicas):
+        o = {"apiVersion": "apps/v1", "kind": "Deployment",
+             "metadata": {"name": "d", "namespace": "default"}}
+        if replicas is not None:
+            o["spec"] = {"replicas": replicas}
+        return o
+
+    bodies = [
+        json.dumps(
+            review(op="UPDATE", gvk=("apps", "v1", "Deployment"),
+                   obj=dep(new), old=dep(old))
+        ).encode()
+        for new, old in [
+            (3, 3),      # unchanged: allowed
+            (2, 3),      # scale down: allowed
+            (4, 3),      # scale up: denied (replicas under the 50 cap)
+            (None, 3),   # new has no replicas: guard false, allowed
+            (3, None),   # old has no replicas: guard false, allowed
+        ]
+    ]
+    assert_parity(fast, handler, bodies)
+    res = fast.handle_raw(bodies)
+    assert [r.allowed for r in res] == [True, True, False, True, True]
+
+
 def test_admission_ip_field_join_parity():
     """Joins over IP-typed fields: equal parsed addresses must compare
     equal natively (the IPV canon normalizes address text + prefix), and
@@ -533,14 +599,14 @@ def test_admission_fastpath_hybrid_with_fallback_policies():
     fallback scopes become device gate rules (compiler.pack), gate-flagged
     rows re-run the exact Python path, and every other row stays native —
     one unlowerable policy no longer disables the whole fast path."""
-    # the two-slot != join under `unless` is a negated unlowerable
-    # expression — a genuine interpreter-fallback policy (equivalent to
-    # forbidding when principal.namespace == resource namespace)
+    # a negated dynamic extension call is a negated unlowerable
+    # expression — a genuine interpreter-fallback policy (the ==/!= joins
+    # that used to serve this role are native dyn classes now)
     src = """
 forbid (principal is k8s::ServiceAccount,
         action == k8s::admission::Action::"create",
         resource is core::v1::ConfigMap)
-  unless { principal.namespace != resource.metadata.namespace };
+  unless { ip(resource.metadata.name).isLoopback() };
 forbid (principal, action == k8s::admission::Action::"create",
         resource is core::v1::ConfigMap)
   when {
@@ -696,7 +762,7 @@ def test_admission_fastpath_gate_respects_hot_swap():
 forbid (principal is k8s::ServiceAccount,
         action == k8s::admission::Action::"create",
         resource is core::v1::ConfigMap)
-  unless { principal.namespace != resource.metadata.namespace };
+  unless { ip(resource.metadata.name).isLoopback() };
 """
     src_pure = """
 forbid (principal, action == k8s::admission::Action::"create",
@@ -707,7 +773,11 @@ forbid (principal, action == k8s::admission::Action::"create",
     engine, handler, fast, stats = _build_fallback_set(src_fb)
     assert stats["fallback_policies"] == 1
     sa = "system:serviceaccount:default:builder"
-    body_sa = json.dumps(review(obj=obj_cm(), user=sa, groups=())).encode()
+    # name "10.0.0.5": valid non-loopback ip -> the unless is false -> the
+    # fallback forbid fires (via the gated python path)
+    body_sa = json.dumps(
+        review(obj=obj_cm(name="10.0.0.5"), user=sa, groups=())
+    ).encode()
     body_prod = json.dumps(review(obj=obj_cm(labels={"env": "prod"}))).encode()
     [r1, r2] = fast.handle_raw([body_sa, body_prod])
     assert not r1.allowed  # fallback policy, via the gated python path

@@ -81,15 +81,25 @@ def _gen_condition(rng: random.Random) -> str:
         )
     if kind < 0.87:
         return "resource has subresource"
-    if kind < 0.88:
+    if kind < 0.875:
         # principal/resource join: native dyn-eq class (the C++ encoder
         # compares the two canons per request, compiler/dyn.py DynEq)
         return "resource has name && resource.name == principal.name"
+    if kind < 0.885:
+        # negated-form join (DynEq neq; cross-type != is True)
+        return "resource has name && resource.name != principal.name"
     if kind < 0.89:
         # two-RESOURCE-slot join: native via a template SLOT leaf
         return (
             "resource has name && resource has namespace && "
             "resource.name == resource.namespace"
+        )
+    if kind < 0.895:
+        # ordered cmp join over STRINGS: DynCmp's type-error path — the
+        # interpreter raises, the native side must error identically
+        return (
+            "resource has name && resource has namespace && "
+            "resource.namespace < resource.name"
         )
     if kind < 0.9:
         # dynamic extension call: outside every native class — exercises

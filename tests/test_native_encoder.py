@@ -242,10 +242,12 @@ def test_fastpath_hybrid_with_fallback_policy():
     path: its scope becomes a device gate rule, gated rows re-run the exact
     Python path (hybrid merge), every other row stays native — decision
     parity must hold across both kinds of row."""
+    # a NEGATED dynamic extension call is a negated unlowerable expression
+    # (the ==/!= joins that used to serve this role are native dyn classes)
     src = POLICIES + """
 permit (principal in k8s::Group::"fbgroup", action == k8s::Action::"get",
         resource is k8s::Resource)
-  unless { principal.name != resource.name };
+  unless { ip(resource.name).isLoopback() };
 """
     engine = TPUPolicyEngine()
     engine.load([PolicySet.from_source(src, "hybrid")], warm="off")
@@ -258,14 +260,15 @@ permit (principal in k8s::Group::"fbgroup", action == k8s::Action::"get",
 
     rng = random.Random(31)
     sars = [_random_sar(rng) for _ in range(300)]
-    # force a mix of gated rows: some in fbgroup with matching/mismatching
-    # resource names (the join only the interpreter can evaluate)
+    # force a mix of gated rows: some in fbgroup with names that parse as
+    # non-loopback/loopback ips or error (only the interpreter evaluates
+    # the extension call)
     for i, s in enumerate(sars):
         if i % 3 == 0:
             s["spec"].setdefault("groups", []).append("fbgroup")
         if i % 6 == 0:
             ra = s["spec"].setdefault("resourceAttributes", {"verb": "get"})
-            ra["name"] = s["spec"]["user"]
+            ra["name"] = ["10.0.0.9", "127.0.0.1", "not-an-ip"][(i // 6) % 3]
     bodies = [json.dumps(s).encode() for s in sars]
     results = fastpath.authorize_raw(bodies)
     for sar, (decision, reason, error) in zip(sars, results):

@@ -114,11 +114,13 @@ def _mesh_policy_sources():
         'forbid (principal, action == k8s::Action::"get",'
         ' resource is k8s::Resource) when { resource.namespace == "locked" };'
     )
-    # interpreter fallback: two-slot join under unless -> gate plane
+    # interpreter fallback: negated dynamic extension call -> gate
+    # plane (the ==/!= joins that used to serve this role are
+    # native dyn classes now)
     pols.append(
         'permit (principal in k8s::Group::"joiners",'
         ' action == k8s::Action::"get", resource is k8s::Resource)'
-        " unless { principal.name != resource.name };"
+        " unless { ip(resource.name).isLoopback() };"
     )
     return "\n".join(pols)
 

@@ -44,11 +44,13 @@ forbid (principal is k8s::User,
          resource.metadata.labels.contains({key: "env", value: "prod"}) };
 """
 
-# a genuine interpreter-fallback policy (two-slot join under unless)
+# a genuine interpreter-fallback policy: a NEGATED dynamic extension call
+# is a negated unlowerable expression (the ==/!= joins that used to serve
+# this role are native dyn classes now)
 FALLBACK_POLICY = """
 permit (principal in k8s::Group::"joiners", action == k8s::Action::"get",
         resource is k8s::Resource)
-  unless { principal.name != resource.name };
+  unless { ip(resource.name).isLoopback() };
 """
 
 # a principal/resource join: a hard literal in the native dyn-eq class
@@ -223,11 +225,11 @@ class TestServerFastPaths:
             engine.load(_tiers(POLICIES + FALLBACK_POLICY), warm="off")
             assert engine.stats["fallback_policies"] == 1
             assert srv.fastpath.available  # hybrid: still native
-            # gated row (joiners group, name == principal name): python path
+            # gated row (joiners group, non-loopback ip name): python path
             resp = _post(
                 srv.bound_port, "/v1/authorize",
                 sar(user="jo", groups=("joiners",), resource="widgets",
-                    name="jo"),
+                    name="10.0.0.1"),
             )
             assert resp["status"]["allowed"] is True
             # non-gated rows keep their native verdicts
@@ -353,7 +355,11 @@ class TestServerMesh:
                 ("/v1/authorize", sar()),
                 ("/v1/authorize", sar(resource="nodes")),
                 ("/v1/authorize", sar(user="alice", resource="secrets")),
-                # gate-flagged row: fallback policy's scope matches
+                # gate-flagged rows: fallback policy's scope matches (one
+                # allows via the python path, one errors and skips)
+                ("/v1/authorize",
+                 sar(user="jo", groups=("joiners",), resource="widgets",
+                     name="10.0.0.1")),
                 ("/v1/authorize",
                  sar(user="jo", groups=("joiners",), resource="widgets",
                      name="jo")),
