@@ -358,6 +358,30 @@ def test_in_feasible_edge_semantics():
     assert in_feasible(s, "a::Y", "a::T")
 
 
+def test_reference_corpus_validates_clean(schema):
+    """Every .cedar the reference ships (demo mount policies + the RBAC
+    converter goldens, incl. cluster-admin and crazy-policy) must validate
+    with ZERO findings against our generated schema — with operand
+    typechecking and hierarchy feasibility on. Drive-input only: the files
+    are read from the reference tree, never copied."""
+    import pathlib
+
+    from cedar_tpu.cli.validator import validate_file
+
+    ref = pathlib.Path("/root/reference")
+    if not ref.exists():
+        pytest.skip("reference tree not present")
+    files = sorted(ref.rglob("*.cedar"))
+    assert len(files) >= 10
+    total = 0
+    memo: dict = {}
+    for f in files:
+        n, findings = validate_file(schema, f, _memo=memo)
+        assert not findings, (str(f), [str(x) for x in findings])
+        total += n
+    assert total >= 50
+
+
 def test_typecheck_accepts_well_typed_conditions(schema):
     """Well-typed uses of the same operators must stay clean."""
     good = [
