@@ -1,7 +1,7 @@
 """Dynamic templates: hard expressions the NATIVE encoder can evaluate per
 request without the Python interpreter.
 
-Three restricted classes, all built from the same template grammar (leaves
+The restricted classes, all built from the same template grammar (leaves
 are compile-time constants or request SLOT chains — any
 principal/resource/context attribute path, resolved per request):
 
@@ -13,6 +13,9 @@ principal/resource/context attribute path, resolved per request):
     (/root/reference demo/admission-policy.yaml): the C++ encoder resolves
     the template against the request, builds the probe's canonical value
     key, and tests membership against the slot's element canons.
+    ``containsAny``/``containsAll`` over error-prone elements ride
+    DynContainsMulti (error-free element sets are rewritten to
+    contains-chains earlier, in lower.expand).
 
   * ``<slot> == <template>`` / ``!=`` (DynEq) — principal/resource joins
     like ``resource.name == principal.name`` or
@@ -78,6 +81,21 @@ class DynEq:
     slot: Slot  # the (var, path) the left value is read from
     tmpl: Tmpl  # template for the right value
     negate: bool = False  # != (cross-type != is True, like the interpreter)
+
+
+@dataclass(frozen=True)
+class DynContainsMulti:
+    """``<slot>.containsAny([templates])`` / ``containsAll``: the chain
+    REWRITE (lower.expand) already handles these when every element is
+    provably error-free; this class catches the rest — elements embedding
+    error-prone chains (e.g. ``resource.x``). Cedar evaluates the argument
+    set eagerly, so the native test resolves EVERY template first (any
+    failure errors the whole test, like the interpreter) and only then
+    checks any/all membership."""
+
+    slot: Slot
+    tmpls: Tuple[Tmpl, ...]
+    require_all: bool  # containsAll
 
 
 @dataclass(frozen=True)
@@ -172,6 +190,26 @@ def dyn_spec(expr: ast.Expr):
         if t is None:
             return None
         return DynContains(s, t)
+    if (
+        isinstance(expr, ast.MethodCall)
+        and expr.method in ("containsAny", "containsAll")
+        and len(expr.args) == 1
+        and isinstance(expr.args[0], ast.SetLit)
+        and expr.args[0].elems
+        and len(expr.args[0].elems) <= 256  # native reader cap
+    ):
+        s = slot_of(expr.obj)
+        if s is None or not s[1]:
+            return None
+        tmpls = []
+        for el in expr.args[0].elems:
+            t = _tmpl_of(el)
+            if t is None:
+                return None
+            tmpls.append(t)
+        return DynContainsMulti(
+            s, tuple(tmpls), require_all=expr.method == "containsAll"
+        )
     if isinstance(expr, ast.Binary) and expr.op in ("==", "!="):
         # slot on either side; the other side must be a template. NOTE:
         # expressions where one side is a bare const are lowered to vocab

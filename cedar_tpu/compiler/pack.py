@@ -108,9 +108,10 @@ class EncodePlan:
     # encoder evaluates expr per request: a bool result activates ok (and
     # lit when True); an EvalError or non-bool result activates the error id
     hard_lits: List[Tuple[int, int, object, int]] = field(default_factory=list)
-    # parallel to hard_lits: compiler.dyn.DynContains/DynEq/DynCmp when the native
-    # encoder can evaluate the expr itself, else None (the owning policies
-    # become native-opaque and gate to the Python path per row)
+    # parallel to hard_lits: a compiler.dyn spec (DynContains /
+    # DynContainsMulti / DynEq / DynCmp) when the native encoder can
+    # evaluate the expr itself, else None (the owning policies become
+    # native-opaque and gate to the Python path per row)
     dyn_specs: List[object] = field(default_factory=list)
     # a safe upper bound on simultaneously-active literals per request
     max_active: int = 0

@@ -27,11 +27,13 @@ Blob format (little-endian; must match BlobReader in encoder.cpp):
                           cmps:    i32 count, { i32 lit, u8 op, i64 c }
                           set_has: i32 count, { str canon, i32 n, i32 lits[] }
                           dyns:    i32 count, { u8 kind (0 contains, 1 eq,
-                                                2 cmp), u8 op (eq: 0 ==
-                                                1 !=; cmp: 0 < 1 <= 2 >
-                                                3 >=; contains: 0),
+                                                2 cmp, 3 containsAny,
+                                                4 containsAll), u8 op
+                                                (eq: 0 == 1 !=; cmp: 0 <
+                                                1 <= 2 > 3 >=; else 0),
                                                 i32 lit, i32 ok, i32 err,
-                                                tmpl } }
+                                                kind<=2: tmpl
+                                                kind>=3: i32 n, { tmpl } }
   tmpl = u8 kind: 0 const  { str canon }
                 | 2 record { i32 n, { str name, tmpl } }   (names sorted)
                 | 3 set    { i32 n, { tmpl } }             (sorted at runtime)
@@ -50,7 +52,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..compiler.dyn import DynCmp, DynEq
+from ..compiler.dyn import DynCmp, DynContainsMulti, DynEq
 from ..lang.ast import WILDCARD
 
 # flags mirrored from encoder.cpp
@@ -265,13 +267,21 @@ def _serialize_table(plan, table) -> bytes:
             elif isinstance(spec, DynCmp):
                 w.u8(2)
                 w.u8(_CMP_OPS[spec.op])
+            elif isinstance(spec, DynContainsMulti):
+                w.u8(4 if spec.require_all else 3)
+                w.u8(0)
             else:
                 w.u8(0)
                 w.u8(0)
             w.i32(lid)
             w.i32(okid)
             w.i32(elid)
-            _write_tmpl(w, spec.tmpl)
+            if isinstance(spec, DynContainsMulti):
+                w.i32(len(spec.tmpls))
+                for t in spec.tmpls:
+                    _write_tmpl(w, t)
+            else:
+                _write_tmpl(w, spec.tmpl)
 
     return w.blob()
 

@@ -381,10 +381,11 @@ struct Tmpl {
 };
 
 struct DynTest {
-  uint8_t kind;  // 0 contains, 1 eq, 2 cmp (compiler/dyn.py)
+  uint8_t kind;  // 0 contains, 1 eq, 2 cmp, 3 containsAny, 4 containsAll
   uint8_t op;    // eq: 0 ==, 1 !=; cmp: 0 <, 1 <=, 2 >, 3 >=
   int32_t lit, ok_lit, err_lit;  // -1 when absent
-  Tmpl tmpl;
+  Tmpl tmpl;                // kinds 0-2
+  std::vector<Tmpl> tmpls;  // kinds 3-4 (eagerly-evaluated element set)
 };
 
 struct ScalarSlot {
@@ -557,13 +558,22 @@ Table *load_table(const uint8_t *blob, size_t len) {
     for (int32_t j = 0; j < nd; ++j) {
       DynTest d;
       d.kind = r.u8();
-      if (d.kind > 2) return nullptr;
+      if (d.kind > 4) return nullptr;
       d.op = r.u8();
       if (d.op > 3 || (d.kind != 2 && d.op > 1)) return nullptr;
       d.lit = r.i32();
       d.ok_lit = r.i32();
       d.err_lit = r.i32();
-      if (!read_tmpl(r, d.tmpl)) return nullptr;
+      if (d.kind >= 3) {
+        int32_t nt = r.i32();
+        if (!r.ok() || nt < 1 || nt > 256) return nullptr;
+        for (int32_t k = 0; k < nt; ++k) {
+          d.tmpls.emplace_back();
+          if (!read_tmpl(r, d.tmpls.back())) return nullptr;
+        }
+      } else if (!read_tmpl(r, d.tmpl)) {
+        return nullptr;
+      }
       s.dyns.push_back(std::move(d));
     }
     t->slots.push_back(std::move(s));
@@ -1056,6 +1066,10 @@ bool canon_long(const std::string &c, long long *out) {
 //     Cedar equality.
 //   cmp (kind 2): both canons must be Longs ("l<decimal>"); anything else
 //     is the interpreter's type error.
+//   containsAny/All (kinds 3/4): like contains, but over an EAGERLY
+//     resolved element-template set — any resolution failure errors the
+//     whole test before membership is judged, matching Cedar's eager
+//     argument evaluation.
 template <class S>
 void eval_dyns(const ScalarSlot &s, const std::vector<std::string> *elems,
                const std::string *self_canon, S &&slot_canon,
@@ -1101,6 +1115,36 @@ void eval_dyns(const ScalarSlot &s, const std::vector<std::string> *elems,
     }
     if (!elems) {
       if (d.err_lit >= 0) extras.push(d.err_lit);
+      continue;
+    }
+    if (d.kind >= 3) {  // containsAny (3) / containsAll (4): Cedar
+      // evaluates the argument set EAGERLY — every template must resolve
+      // (a later failure errors the whole test, so no early exit on a
+      // decided any/all), but membership is pure, so each probe is
+      // tested from the shared scratch as it resolves: no allocation
+      bool failed = false, any = false, all = true;
+      for (const auto &t : d.tmpls) {
+        scratch.clear();
+        if (!tmpl_canon(t, slot_canon, scratch)) {
+          failed = true;
+          break;
+        }
+        bool member = false;
+        for (const auto &ec : *elems)
+          if (ec == scratch) {
+            member = true;
+            break;
+          }
+        any = any || member;
+        all = all && member;
+      }
+      if (failed) {
+        if (d.err_lit >= 0) extras.push(d.err_lit);
+        continue;
+      }
+      if (d.ok_lit >= 0) extras.push(d.ok_lit);
+      bool hit = d.kind == 3 ? any : all;
+      if (hit && d.lit >= 0) extras.push(d.lit);
       continue;
     }
     scratch.clear();

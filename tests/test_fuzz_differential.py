@@ -70,7 +70,7 @@ def _gen_condition(rng: random.Random) -> str:
             f'{{key: "owner", operator: "{rng.choice(["=", "in"])}", '
             "values: [principal.name]})"
         )
-    if kind < 0.82:
+    if kind < 0.8:
         # containsAny chain over mixed const/dynamic elements (rewritten to
         # a contains-chain when elements are provably error-free)
         return (
@@ -78,6 +78,16 @@ def _gen_condition(rng: random.Random) -> str:
             f'{{key: "owner", operator: "{rng.choice(["=", "in"])}", '
             "values: [principal.name]}, "
             f'{{key: "owner", operator: "in", values: ["{rng.choice(USERS)}"]}}])'
+        )
+    if kind < 0.82:
+        # containsAny/All with an ERROR-PRONE element (resource.namespace
+        # is optional): the chain rewrite declines, DynContainsMulti rides
+        # the eager-evaluation path natively
+        m = rng.choice(["containsAny", "containsAll"])
+        return (
+            f"resource has labelSelector && resource.labelSelector.{m}(["
+            '{key: "owner", operator: "in", values: [principal.name]}, '
+            '{key: "owner", operator: "in", values: [resource.namespace]}])'
         )
     if kind < 0.87:
         return "resource has subresource"

@@ -554,6 +554,81 @@ def test_native_dyn_eq_join_policies():
         assert bool(reason) == bool(exp_reason), f"reason presence: {sar}"
 
 
+def test_native_contains_multi_error_prone_elements():
+    """containsAny/containsAll whose element templates can ERROR (they
+    embed optional resource attrs, so the chain rewrite declines) ride
+    DynContainsMulti natively: eager element resolution, any/all
+    membership, exact parity with the interpreter — incl. under unless."""
+    src = (
+        POLICIES
+        + """
+permit (principal is k8s::User, action == k8s::Action::"list",
+        resource is k8s::Resource)
+  when {
+    resource has labelSelector &&
+    resource.labelSelector.containsAny([
+        {key: "owner", operator: "in", values: [principal.name]},
+        {key: "owner", operator: "in", values: [resource.namespace]}])
+  };
+forbid (principal is k8s::User, action == k8s::Action::"watch",
+        resource is k8s::Resource)
+  unless {
+    resource has labelSelector &&
+    resource.labelSelector.containsAll([
+        {key: "owner", operator: "in", values: [principal.name]},
+        {key: "team", operator: "in", values: [resource.namespace]}])
+  };
+"""
+    )
+    engine = TPUPolicyEngine()
+    stats = engine.load([PolicySet.from_source(src, "cmulti")], warm="off")
+    assert stats["fallback_policies"] == 0
+    assert stats["native_opaque_policies"] == 0
+    stores = TieredPolicyStores([MemoryStore.from_source("cmulti", src)])
+    authorizer = CedarWebhookAuthorizer(stores)
+    tpu_auth = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, tpu_auth)
+    assert fastpath.available
+
+    def sel_sar(verb, reqs, ns="team-ns", user="ann"):
+        return {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": user, "uid": "u", "groups": [],
+                "resourceAttributes": {
+                    "verb": verb, "resource": "pods", "version": "v1",
+                    "namespace": ns,
+                    "labelSelector": {"requirements": reqs},
+                },
+            },
+        }
+
+    owner = {"key": "owner", "operator": "In", "values": ["ann"]}
+    owner_ns = {"key": "owner", "operator": "In", "values": ["team-ns"]}
+    team_ns = {"key": "team", "operator": "In", "values": ["team-ns"]}
+    sars = [
+        sel_sar("list", [owner]),            # any: first element matches
+        sel_sar("list", [owner_ns]),         # any: second (resource.namespace)
+        sel_sar("list", [team_ns]),          # any: neither -> no match
+        sel_sar("watch", [owner, team_ns]),  # all: both -> unless true
+        sel_sar("watch", [owner]),           # all: one missing -> forbid
+        sel_sar("watch", [team_ns]),
+        # no namespace: resource.namespace errors INSIDE the element set
+        {**sel_sar("list", [owner], ns="")},
+    ]
+    # drop the empty namespace key entirely for the last probe
+    del sars[-1]["spec"]["resourceAttributes"]["namespace"]
+    bodies = [json.dumps(s).encode() for s in sars]
+    results = fastpath.authorize_raw(bodies)
+    for sar, (decision, _r, _e) in zip(sars, results):
+        want, _ = authorizer.authorize(get_authorizer_attributes(sar))
+        assert decision == want, (sar, decision, want)
+    assert [r[0] for r in results[:6]] == [
+        "allow", "allow", "no_opinion", "no_opinion", "deny", "deny",
+    ]
+
+
 def test_microbatcher_batches_and_returns_in_order():
     import threading
 
