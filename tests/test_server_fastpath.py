@@ -378,6 +378,77 @@ class TestServerMesh:
                 single.stop()
 
 
+class TestHotSwapSoak:
+    def test_concurrent_serving_during_hot_swaps(self):
+        """Serving threads hammer the native fast path while the main
+        thread hot-swaps between two policy sets with OPPOSITE verdicts:
+        every row's answer must equal one set's oracle verdict — the
+        snapshot machinery may mix sets ACROSS rows during a swap (each
+        request evaluates under whatever set is current, like the
+        reference's RWMutex), but never produce a verdict neither set
+        would give."""
+        import threading
+
+        set_a = POLICIES
+        set_b = """
+forbid (principal is k8s::User, action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { principal.name == "sam" && resource.resource == "pods" };
+permit (principal, action, resource is k8s::Resource)
+  when { resource.resource == "nodes" };
+"""
+        engine = TPUPolicyEngine()
+        engine.load(_tiers(set_a), warm="off")
+        stores_a = TieredPolicyStores([MemoryStore.from_source("a", set_a)])
+        stores_b = TieredPolicyStores([MemoryStore.from_source("b", set_b)])
+        oracle_a = CedarWebhookAuthorizer(stores_a)
+        oracle_b = CedarWebhookAuthorizer(stores_b)
+        # the fast path's own authorizer reads stores_a; its gates
+        # (self-allow, system skip, readiness) behave identically for
+        # these probes under both sets
+        fast = SARFastPath(
+            engine, CedarWebhookAuthorizer(stores_a, evaluate=engine.evaluate)
+        )
+        assert fast.available
+
+        from cedar_tpu.server.http import get_authorizer_attributes
+
+        probes = [sar(), sar(resource="nodes"), sar(user="zoe")]
+        bodies = [json.dumps(p).encode() for p in probes]
+        allowed = []
+        for p in probes:
+            attrs = get_authorizer_attributes(p)
+            allowed.append(
+                {oracle_a.authorize(attrs)[0], oracle_b.authorize(attrs)[0]}
+            )
+        # the probe verdicts genuinely differ between the sets
+        assert any(len(s) == 2 for s in allowed)
+
+        errors: list = []
+        stop = threading.Event()
+
+        def serve():
+            try:
+                while not stop.is_set():
+                    res = fast.authorize_raw(bodies)
+                    for (dec, _r, _e), ok in zip(res, allowed):
+                        assert dec in ok, (dec, ok)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=serve) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(12):
+                engine.load(_tiers(set_b if i % 2 == 0 else set_a), warm="off")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors, errors[0]
+
+
 class TestServerTLS:
     def test_tls_handshake_and_round_trip(self, tmp_path):
         """Real TLS: generated self-signed certs, an HTTPS handshake, and a
