@@ -123,6 +123,10 @@ class _RawFastPath:
     # payload: there a second device round trip costs more than the bits
     # plane.
     _BITS_INCALL_MAX = 4096
+    # True when _emit returns the payload unchanged (SAR): clean rows then
+    # decode via a VECTORIZED per-distinct-word scatter (~8x the per-row
+    # python loop at 65k rows) instead of a dict-hit per row
+    _EMIT_IDENTITY = False
 
     def __init__(self, engine: TPUPolicyEngine):
         self.engine = engine
@@ -221,10 +225,10 @@ class _RawFastPath:
         ctxs = [self._finish_words(snap, chunk, pre) for chunk, pre in pending]
         self._resolve_deferred(snap, ctxs)
         if len(ctxs) == 1:
-            return ctxs[0]["results"]
+            return ctxs[0]["results"].tolist()
         out: list = []
         for ctx in ctxs:
-            out.extend(ctx["results"])
+            out.extend(ctx["results"].tolist())
         return out
 
     def _prepare_chunk(self, snap: _Snapshot, bodies: Sequence[bytes]):
@@ -233,7 +237,10 @@ class _RawFastPath:
         chunk."""
         t0 = time.monotonic()
         codes, extras, counts, flags, aux = self._encode(snap, bodies)
-        results: list = [None] * len(bodies)
+        # object ndarray, not a list: clean rows scatter in one vectorized
+        # fancy-index assignment (_finish_words); per-row assignments
+        # (fallback/gate/flag rows) work the same on either container
+        results = np.empty(len(bodies), dtype=object)
         py_rows = self._route_flags(flags, results, bodies, aux)
 
         ok = flags == F_OK
@@ -342,8 +349,20 @@ class _RawFastPath:
             )
         decode = self._decode_word_payload
         emit = self._emit
-        wl = w.tolist()
-        if handled:
+        if self._EMIT_IDENTITY and not handled:
+            # vectorized clean decode: one payload per DISTINCT word
+            # (verdict diversity is tiny), then one fancy-index scatter —
+            # no per-row python work at all
+            uniq, inv = np.unique(w, return_inverse=True)
+            payloads = np.empty(len(uniq), dtype=object)
+            for j, word in enumerate(uniq.tolist()):
+                payload = cache.get(word)
+                if payload is None:
+                    payload = decode(snap, word)
+                payloads[j] = payload
+            results[idx] = payloads[inv]
+        elif handled:
+            wl = w.tolist()
             for k, i in enumerate(idx.tolist()):
                 if k in handled:
                     continue
@@ -353,6 +372,7 @@ class _RawFastPath:
                     payload = decode(snap, word)
                 results[i] = emit(payload, i, aux)
         else:
+            wl = w.tolist()
             for k, i in enumerate(idx.tolist()):
                 word = wl[k]
                 payload = cache.get(word)
@@ -451,6 +471,8 @@ def _gather_flag_bits(engine, snap, ctxs) -> dict:
 
 class SARFastPath(_RawFastPath):
     """Batch evaluator over raw SubjectAccessReview JSON bodies."""
+
+    _EMIT_IDENTITY = True  # _emit returns the shared Result unchanged
 
     def __init__(
         self,

@@ -424,29 +424,41 @@ permit (principal, action, resource is k8s::Resource)
         # the probe verdicts genuinely differ between the sets
         assert any(len(s) == 2 for s in allowed)
 
+        import time
+
         errors: list = []
         stop = threading.Event()
+        counts = [0] * 4
 
-        def serve():
+        def serve(ti):
             try:
                 while not stop.is_set():
                     res = fast.authorize_raw(bodies)
                     for (dec, _r, _e), ok in zip(res, allowed):
                         assert dec in ok, (dec, ok)
+                    counts[ti] += 1
             except BaseException as e:  # noqa: BLE001 — surfaced below
                 errors.append(e)
 
-        threads = [threading.Thread(target=serve) for _ in range(4)]
+        threads = [threading.Thread(target=serve, args=(i,)) for i in range(4)]
         for t in threads:
             t.start()
+        swaps = 0
         try:
-            for i in range(12):
-                engine.load(_tiers(set_b if i % 2 == 0 else set_a), warm="off")
+            # keep swapping until every thread has served several batches
+            # AROUND swaps — guarantees the race window is actually hit
+            deadline = time.time() + 120
+            while (swaps < 12 or min(counts) < 3) and time.time() < deadline:
+                engine.load(
+                    _tiers(set_b if swaps % 2 == 0 else set_a), warm="off"
+                )
+                swaps += 1
         finally:
             stop.set()
             for t in threads:
                 t.join()
         assert not errors, errors[0]
+        assert swaps >= 12 and min(counts) >= 3, (swaps, counts)
 
 
 class TestServerTLS:
