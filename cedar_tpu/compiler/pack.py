@@ -17,7 +17,7 @@ the hot-swap analogue of the reference's RWMutex PolicySet update
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, FrozenSet, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 

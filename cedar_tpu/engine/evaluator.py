@@ -59,7 +59,6 @@ from ..lang.eval import Env, Request, policy_matches
 from ..lang.values import EvalError
 from ..compiler.table import encode_request_codes
 from ..ops.match import (
-    CODE_ALLOW,
     CODE_DENY,
     CODE_ERROR,
     CODE_NONE,

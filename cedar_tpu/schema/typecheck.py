@@ -14,8 +14,8 @@ cedar's permissive validation mode: only provable mismatches are findings.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional
 
 from ..lang import ast
 from .model import Attribute, AttributeElement, CedarSchema, EntityShape
