@@ -368,6 +368,73 @@ forbid (
     assert res.allowed is False
 
 
+def test_admission_connect_exec_options_parity():
+    """CONNECT pods/exec: the AdmissionReview object is a PodExecOptions
+    (reference schema connect_entities.go); policies over its command set
+    must evaluate natively with exact parity."""
+    src = (
+        ADM_POLICIES
+        + """
+forbid (
+    principal,
+    action == k8s::admission::Action::"connect",
+    resource is core::v1::PodExecOptions
+) when {
+    resource has command && resource.command.contains("/bin/sh")
+};
+"""
+    )
+    engine = TPUPolicyEngine()
+    stats = engine.load(
+        [
+            PolicySet.from_source(src, "exec"),
+            PolicySet.from_source(ALLOW_ALL_ADMISSION_POLICY_SOURCE, "aa"),
+        ],
+        warm="off",
+    )
+    assert stats["fallback_policies"] == 0
+    assert stats["native_opaque_policies"] == 0
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores(
+            [MemoryStore.from_source("exec", src),
+             allow_all_admission_policy_store()]
+        ),
+        evaluate=engine.evaluate,
+        evaluate_batch=engine.evaluate_batch,
+    )
+    fast = AdmissionFastPath(engine, handler)
+    assert fast.available
+
+    def exec_review(command, uid="e1"):
+        return {
+            "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {
+                "uid": uid, "operation": "CONNECT",
+                "userInfo": {"username": "bob", "groups": []},
+                "kind": {"group": "", "version": "v1",
+                         "kind": "PodExecOptions"},
+                "resource": {"group": "", "version": "v1",
+                             "resource": "pods"},
+                "subResource": "exec",
+                "namespace": "default", "name": "p1",
+                "object": {
+                    "apiVersion": "v1", "kind": "PodExecOptions",
+                    "stdin": True, "tty": True, "container": "app",
+                    "command": command,
+                },
+            },
+        }
+
+    bodies = [
+        json.dumps(exec_review(c)).encode()
+        for c in (["/bin/sh"], ["/bin/bash"], ["/bin/sh", "-c", "id"],
+                  ["ls"], [])
+    ]
+    assert_parity(fast, handler, bodies)
+    res = fast.handle_raw(bodies)
+    assert [r.allowed for r in res] == [False, True, False, True, True]
+
+
 def test_admission_no_scale_up_cmp_native():
     """Ordered-comparison joins (DynCmp): a no-scale-up policy comparing
     resource.spec.replicas against context.oldObject.spec.replicas
