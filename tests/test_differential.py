@@ -8,6 +8,8 @@ for-decision, including tier descent, error semantics, and default deny.
 
 import random
 
+import os
+
 import pytest
 
 from cedar_tpu.engine.evaluator import TPUPolicyEngine
@@ -485,6 +487,10 @@ def test_randomized_policies_differential():
     check([src], cases)
 
 
+@pytest.mark.skipif(
+    os.environ.get("CEDAR_TPU_PALLAS") == "1",
+    reason="the pallas kernel ships no in-call compaction payload by design\n    (resolve_flagged falls back to the standalone bits kernel)",
+)
 def test_want_bits_bitmap_matches_bits_kernel():
     """The compacted in-call bits payload (match_arrays want_bits) must be
     row-identical to the standalone bitset kernel, cover exactly the
@@ -524,6 +530,10 @@ forbid (principal, action, resource) when { resource.resource == "nodes" };
         assert (row == ref[i]).all()
 
 
+@pytest.mark.skipif(
+    os.environ.get("CEDAR_TPU_PALLAS") == "1",
+    reason="the pallas kernel ships no in-call compaction payload by design\n    (resolve_flagged falls back to the standalone bits kernel)",
+)
 def test_bits_compaction_overflow_falls_back():
     """More flagged rows than the device compaction carries (BITS_TOPK):
     the overflow rows must still render exact reason sets via the
