@@ -981,6 +981,12 @@ if __name__ == "__main__":
         # would race the still-attached dead client on single-attach backends.
         if not _wait_for_backend():
             raise SystemExit("backend did not return within the wait budget")
+    elif not _wait_for_backend(
+        max_wait_s=float(os.environ.get("CEDAR_BENCH_PREFLIGHT_S", "240"))
+    ):
+        # cheap pre-flight (no prior attach to race): a dead link at bench
+        # START exits in minutes instead of hanging main() to its deadline
+        raise SystemExit("device link unavailable at bench start")
     deadline_s = float(os.environ.get("CEDAR_BENCH_DEADLINE_S", "2700"))
     status, exc = _run_main_guarded(deadline_s)
     if status == "ok":
