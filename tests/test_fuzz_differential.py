@@ -302,6 +302,57 @@ def test_fuzz_native_fastpath_vs_interpreter(seed):
         )
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_native_fastpath_multitier(seed):
+    """The native raw-bytes lane over MULTI-TIER sets: the device tier walk
+    (first explicit decision wins) plus the gate plane must agree with the
+    tiered interpreter stores on every decision."""
+    import json
+
+    from cedar_tpu.engine.fastpath import SARFastPath
+    from cedar_tpu.native import native_available
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.server.http import get_authorizer_attributes
+
+    if not native_available():
+        pytest.skip("no C++ toolchain for the native encoder")
+    rng = random.Random(61000 + seed)
+    n_tiers = rng.randint(2, 3)
+    tiers_src = [
+        "\n".join(_gen_policy(rng) for _ in range(rng.randint(4, 15)))
+        for _ in range(n_tiers)
+    ]
+    engine = TPUPolicyEngine()
+    engine.load(
+        [
+            PolicySet.from_source(s, f"mt{seed}t{i}")
+            for i, s in enumerate(tiers_src)
+        ],
+        warm="off",
+    )
+    stores = TieredPolicyStores(
+        [
+            MemoryStore.from_source(f"mt{seed}t{i}", s)
+            for i, s in enumerate(tiers_src)
+        ]
+    )
+    oracle = CedarWebhookAuthorizer(stores)
+    fast = SARFastPath(
+        engine, CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    )
+    if not fast.available:
+        pytest.skip("generated policy set ruled the native encoder out")
+    attrs_list = [_gen_attributes(rng) for _ in range(60)]
+    sars = [_sar_json(a) for a in attrs_list]
+    bodies = [json.dumps(s).encode() for s in sars]
+    for sar, (decision, _r, _e) in zip(sars, fast.authorize_raw(bodies)):
+        want, _ = oracle.authorize(get_authorizer_attributes(sar))
+        assert decision == want, (
+            f"seed={seed} native={decision} interp={want}\nsar={sar}\n"
+            + "\n---tier---\n".join(tiers_src)
+        )
+
+
 @pytest.mark.parametrize("seed", range(12))
 def test_fuzz_interpreter_vs_tpu(seed):
     rng = random.Random(1000 + seed)
