@@ -327,6 +327,82 @@ class TestServerFastPaths:
             srv.stop()
 
 
+class TestAdmissionHotSwapSoak:
+    def test_admission_serving_during_hot_swaps(self):
+        """Admission twin of the SAR soak: handle_raw under concurrent
+        policy swaps between sets with opposite verdicts must only ever
+        produce verdicts one of the sets would give."""
+        import threading
+        import time
+
+        set_a = POLICIES  # forbids env=prod ConfigMap creates
+        set_b = """
+forbid (principal is k8s::User,
+        action == k8s::admission::Action::"create",
+        resource is core::v1::ConfigMap)
+  when { resource.metadata has labels &&
+         resource.metadata.labels.contains({key: "env", value: "dev"}) };
+"""
+        adm_engine = TPUPolicyEngine()
+
+        def tiers(src):
+            return [
+                PolicySet.from_source(src, "soak"),
+                PolicySet.from_source(ALLOW_ALL_ADMISSION_POLICY_SOURCE, "aa"),
+            ]
+
+        adm_engine.load(tiers(set_a), warm="off")
+        handler = CedarAdmissionHandler(
+            TieredPolicyStores(
+                [MemoryStore.from_source("soak", set_a),
+                 allow_all_admission_policy_store()]
+            ),
+            evaluate=adm_engine.evaluate,
+            evaluate_batch=adm_engine.evaluate_batch,
+        )
+        fast = AdmissionFastPath(adm_engine, handler)
+        assert fast.available
+        bodies = [
+            json.dumps(review(labels={"env": "prod"}, uid="p")).encode(),
+            json.dumps(review(labels={"env": "dev"}, uid="d")).encode(),
+            json.dumps(review(uid="n")).encode(),
+        ]
+        # (allowed under A, allowed under B) per body
+        allowed = [{False, True}, {True, False}, {True, True}]
+
+        errors: list = []
+        stop = threading.Event()
+        counts = [0] * 3
+
+        def serve(ti):
+            try:
+                while not stop.is_set():
+                    res = fast.handle_raw(bodies)
+                    for r, ok in zip(res, allowed):
+                        assert r.allowed in ok, (r, ok)
+                    counts[ti] += 1
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=serve, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        swaps = 0
+        try:
+            deadline = time.time() + 120
+            while (swaps < 10 or min(counts) < 3) and time.time() < deadline:
+                adm_engine.load(
+                    tiers(set_b if swaps % 2 == 0 else set_a), warm="off"
+                )
+                swaps += 1
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors, errors[0]
+        assert swaps >= 10 and min(counts) >= 3, (swaps, counts)
+
+
 class TestServerMesh:
     @pytest.mark.skipif(
         len(__import__("jax").devices()) < 8, reason="needs 8 devices"
