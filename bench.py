@@ -308,6 +308,42 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
     else:
         out["opaque_e2e_rate"] = out["opaque_python_rate"]
 
+    # -- config 2c: gate-plane degradation curve (VERDICT r4 #3). A HOT
+    # fallback scope — a group carried by 10% / 50% of traffic — re-routes
+    # its matching rows through the exact Python path; these rates bound
+    # the cliff an operator reads off the row_routing_total counters.
+    gate_src = (
+        'permit (principal in k8s::Group::"gated-g",'
+        ' action == k8s::Action::"get", resource is k8s::Resource)'
+        " unless { resource has name && ip(resource.name).isLoopback() };"
+    )
+    eng = TPUPolicyEngine()
+    ps_gate = PolicySet.from_source(gate_src, "gate")
+    eng.load([ps200, ps_gate], warm="off")
+    auth = CedarWebhookAuthorizer(
+        TieredPolicyStores(
+            [MemoryStore("rbac200", ps200), MemoryStore("gate", ps_gate)]
+        ),
+        evaluate=eng.evaluate,
+    )
+    fast = SARFastPath(eng, auth)
+    if native_available() and fast.available:
+        for frac in (0.1, 0.5):
+            bodies = []
+            for body in sar_bodies(8192):
+                if rng.random() < frac:
+                    doc = json.loads(body)
+                    doc["spec"]["groups"] = ["gated-g"]
+                    ra = doc["spec"]["resourceAttributes"]
+                    ra["verb"] = "get"
+                    ra["name"] = "10.0.0.8"
+                    body = json.dumps(doc).encode()
+                bodies.append(body)
+            key = f"gated_{int(frac * 100)}pct_rate"
+            out[key], out[f"{key}_spread"] = _trial_rates(
+                lambda b=bodies: fast.authorize_raw(b), 8192, trials=3
+            )
+
     # -- config 4: admission path (demo admission policies + object walk)
     import pathlib
 

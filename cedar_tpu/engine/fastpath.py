@@ -127,6 +127,8 @@ class _RawFastPath:
     # decode via a VECTORIZED per-distinct-word scatter (~8x the per-row
     # python loop at 65k rows) instead of a dict-hit per row
     _EMIT_IDENTITY = False
+    # label for the cedar_authorizer_row_routing_total{path=...} counter
+    _METRIC_PATH = "raw"
 
     def __init__(self, engine: TPUPolicyEngine):
         self.engine = engine
@@ -231,6 +233,22 @@ class _RawFastPath:
             out.extend(ctx["results"].tolist())
         return out
 
+    def _record_routing(
+        self, n: int, n_fallback: int, n_ok: int, n_gated: int, n_flagged: int
+    ) -> None:
+        """One chunk's row counts -> the routing-class Prometheus counter.
+        The gated share is the operator's early warning for the gate-plane
+        cliff: a hot fallback/opaque scope re-routes its matching rows
+        through the ~3k/s Python path (docs/Operations.md)."""
+        from ..server.metrics import record_row_routing
+
+        p = self._METRIC_PATH
+        record_row_routing(p, "clean_native", n_ok - n_gated - n_flagged)
+        record_row_routing(p, "gated", n_gated)
+        record_row_routing(p, "flagged", n_flagged)
+        record_row_routing(p, "encoder_fallback", n_fallback)
+        record_row_routing(p, "encoder_gate", n - n_fallback - n_ok)
+
     def _prepare_chunk(self, snap: _Snapshot, bodies: Sequence[bytes]):
         """Encode one chunk natively and LAUNCH its device match; the device
         work proceeds asynchronously while the caller prepares the next
@@ -300,6 +318,7 @@ class _RawFastPath:
             "bits_fin": None,
         }
         if fin is None:
+            self._record_routing(len(bodies), len(py_rows), 0, 0, 0)
             return ctx
         t0 = time.monotonic()
         out = fin()
@@ -315,6 +334,10 @@ class _RawFastPath:
         flagged = np.nonzero((w & (WORD_ERR | WORD_MULTI)) != 0)[0].tolist()
         ctx["flag_rows"] = [k for k in flagged if k not in handled]
         handled.update(ctx["flag_rows"])
+        self._record_routing(
+            len(bodies), len(py_rows), len(idx),
+            len(ctx["gate_rows"]), len(ctx["flag_rows"]),
+        )
         # a flagged row's complete reason set is a pure function of its
         # feature row (codes + extras fully determine the rule bitset), so
         # rows whose feature bytes were resolved before skip the fetch —
@@ -473,6 +496,7 @@ class SARFastPath(_RawFastPath):
     """Batch evaluator over raw SubjectAccessReview JSON bodies."""
 
     _EMIT_IDENTITY = True  # _emit returns the shared Result unchanged
+    _METRIC_PATH = "authorization"
 
     def __init__(
         self,
@@ -658,6 +682,8 @@ class AdmissionFastPath(_RawFastPath):
     kernel produces the verdicts; deny messages carry the complete
     matched-policy list like the reference's handler
     (internal/server/admission/handler.go:157-164)."""
+
+    _METRIC_PATH = "admission"
 
     def __init__(self, engine: TPUPolicyEngine, handler):
         super().__init__(engine)

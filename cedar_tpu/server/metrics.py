@@ -154,8 +154,30 @@ e2e_latency = REGISTRY.register(
 )
 
 
+row_routing_total = REGISTRY.register(
+    Counter(
+        f"{SUBSYSTEM}_row_routing_total",
+        "Fast-path rows partitioned by routing class: clean_native rows "
+        "decode on device verdicts alone; gated rows matched the scope of a "
+        "fallback/native-opaque policy and re-ran the exact Python path; "
+        "flagged rows needed a rule-bitset fetch (multi-policy/error "
+        "verdicts); encoder_fallback rows the C++ encoder could not prove "
+        "equivalent (parse quirks, extras overflow, unsupported shapes); "
+        "encoder_gate rows short-circuited in the encoder (self-allow, "
+        "system/namespace skip). A growing gated share is the early signal "
+        "of the gate-plane throughput cliff (docs/Operations.md).",
+        ["path", "row_class"],
+    )
+)
+
+
 def record_request_total(decision: str) -> None:
     request_total.inc(decision=decision)
+
+
+def record_row_routing(path: str, row_class: str, n: int) -> None:
+    if n:
+        row_routing_total.inc(n, path=path, row_class=row_class)
 
 
 def record_request_latency(decision: str, latency_s: float) -> None:

@@ -327,6 +327,51 @@ class TestServerFastPaths:
             srv.stop()
 
 
+class TestRowRoutingMetrics:
+    def test_row_routing_counters_on_metrics(self):
+        """The routing-class counters must be visible on /metrics after
+        fast-path traffic, with gated rows counted when a fallback scope
+        matches — the operator's early warning for the gate-plane cliff."""
+        srv, _, _ = _build_server(POLICIES + FALLBACK_POLICY)
+        try:
+
+            def snapshot():
+                port = srv._metrics_httpd.server_address[1]
+                exp = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10
+                ).read().decode()
+                out = {}
+                for line in exp.splitlines():
+                    if line.startswith("cedar_authorizer_row_routing_total{"):
+                        labels, v = line.rsplit(" ", 1)
+                        out[labels] = float(v)
+                return out
+
+            before = snapshot()
+            assert srv.fastpath.available
+            _post(srv.bound_port, "/v1/authorize", sar())  # clean native
+            _post(  # joiners scope matches the fallback policy: gated
+                srv.bound_port, "/v1/authorize",
+                sar(user="jo", groups=("joiners",), resource="widgets",
+                    name="10.0.0.1"),
+            )
+            _post(srv.bound_port, "/v1/admit", review())  # admission clean
+            after = snapshot()
+
+            def delta(path, klass):
+                key = (
+                    "cedar_authorizer_row_routing_total"
+                    f'{{path="{path}",row_class="{klass}"}}'
+                )
+                return after.get(key, 0.0) - before.get(key, 0.0)
+
+            assert delta("authorization", "clean_native") >= 1
+            assert delta("authorization", "gated") >= 1
+            assert delta("admission", "clean_native") >= 1
+        finally:
+            srv.stop()
+
+
 class TestAdmissionHotSwapSoak:
     def test_admission_serving_during_hot_swaps(self):
         """Admission twin of the SAR soak: handle_raw under concurrent
