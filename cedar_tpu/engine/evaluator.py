@@ -109,6 +109,8 @@ class _CompiledSet:
     def __init__(
         self, packed: PackedPolicySet, device=None, use_pallas=False, mesh=None
     ):
+        import os
+
         self.packed = packed
         self.mesh = mesh
         # literal/code ids fit int16 whenever the id space allows — halves
@@ -116,6 +118,14 @@ class _CompiledSet:
         self.active_dtype = np.int16 if packed.L < 32767 else np.int32
         self.code_dtype = packed.table.code_dtype
         self.pallas_args = None
+        # int8 scoring plane (default): W ships as int8 with int32
+        # accumulation — exact (entries are +/-1, sums << 2^24) and 2x bf16
+        # MXU peak on TPU; CEDAR_TPU_INT8=0 restores the bf16 plane
+        # (ops/match.py module docstring)
+        int8_plane = os.environ.get("CEDAR_TPU_INT8", "1") != "0"
+        thresh_host = (
+            packed.thresh.astype(np.int32) if int8_plane else packed.thresh
+        )
         if mesh is not None:
             # multi-chip: unchunked tensors placed with the (data, policy)
             # shardings; the engine routes evaluation through the pjit
@@ -132,18 +142,23 @@ class _CompiledSet:
             ) = shard_codes_tensors(
                 mesh,
                 packed.table.rows,
-                jax.numpy.asarray(packed.W, jax.numpy.bfloat16),
-                packed.thresh,
+                jax.numpy.asarray(packed.W, jax.numpy.int8)
+                if int8_plane
+                else jax.numpy.asarray(packed.W, jax.numpy.bfloat16),
+                thresh_host,
                 packed.rule_group,
                 packed.rule_policy,
             )
             return
         kwargs = {"device": device} if device is not None else {}
+        w_host = packed.W if int8_plane else packed.W.astype(np.float32)
         W3, thresh_c, group_c, policy_c = chunk_rules(
-            packed.W.astype(np.float32), packed.thresh,
+            w_host, thresh_host,
             packed.rule_group, packed.rule_policy,
         )
-        self.W_dev = jax.device_put(W3.astype(jax.numpy.bfloat16), **kwargs)
+        self.W_dev = jax.device_put(
+            W3 if int8_plane else W3.astype(jax.numpy.bfloat16), **kwargs
+        )
         self.thresh_dev = jax.device_put(thresh_c, **kwargs)
         self.rule_group_dev = jax.device_put(group_c, **kwargs)
         self.rule_policy_dev = jax.device_put(policy_c, **kwargs)

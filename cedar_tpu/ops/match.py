@@ -16,8 +16,14 @@ batch arrives as padded active-literal index lists [B, A]; the kernel:
      request — the host round trip is 4 bytes/decision, which is what makes
      the webhook's readback latency budget work.
 
-Scores are exact: lit entries are 0/1, W entries are +/-1, and row sums stay
-far below 2^24, so bf16 inputs with f32 accumulation lose nothing.
+Scores are exact in both kernel dtypes: lit entries are 0/1, W entries are
++/-1, and row sums stay far below 2^24. The DEFAULT scoring plane is int8
+inputs with int32 accumulation — on TPU the MXU runs int8 contractions at
+2x bf16 peak (v5e: ~394 TOPS int8 vs ~197 TFLOP/s bf16), and the matmul is
+the entire device cost of a decision. The bf16 plane (bf16 inputs, f32
+accumulation) remains for the pallas kernel and as a fallback
+(CEDAR_TPU_INT8=0); every match function follows the dtype of the W
+tensor it is handed, so the two planes share one code path.
 
 This replaces the reference's per-request tree-walking interpreter loop
 (cedar-go PolicySet.IsAuthorized called at /root/reference
@@ -83,12 +89,25 @@ _PERMIT, _FORBID, _ERROR = 0, 1, 2
 _GPT = 3
 
 
-def _lit_matrix(active, L: int):
-    """active [B, A] int -> {0,1} literal matrix [B, L] bf16. Out-of-range
+def _lit_dtype(w_dtype):
+    """The literal-matrix dtype that pairs with a W tensor: int8 W rides
+    the integer MXU plane, anything else the bf16 plane."""
+    return jnp.int8 if w_dtype == jnp.int8 else jnp.bfloat16
+
+
+def _scores(lit, Wc):
+    """lit [B, L] @ Wc [L, Rc] with the accumulator that keeps the plane
+    exact: int32 for the int8 plane, float32 for bf16."""
+    acc = jnp.int32 if Wc.dtype == jnp.int8 else jnp.float32
+    return jnp.dot(lit, Wc, preferred_element_type=acc)
+
+
+def _lit_matrix(active, L: int, dtype=jnp.bfloat16):
+    """active [B, A] int -> {0,1} literal matrix [B, L]. Out-of-range
     ids (the pad value) simply never match the iota."""
     a32 = active.astype(jnp.int32)
     iota = jnp.arange(L, dtype=jnp.int32)
-    return (a32[:, :, None] == iota[None, None, :]).any(axis=1).astype(jnp.bfloat16)
+    return (a32[:, :, None] == iota[None, None, :]).any(axis=1).astype(dtype)
 
 
 def _first_match(
@@ -109,7 +128,7 @@ def _first_match(
     def body(carry, xs):
         first_acc, last_acc = carry
         Wc, tc, gc, pc = xs
-        scores = jnp.dot(lit, Wc, preferred_element_type=jnp.float32)  # [B, Rc]
+        scores = _scores(lit, Wc)  # [B, Rc]
         sat = scores >= tc[None, :]
         masked_min = jnp.where(sat, pc[None, :], INT32_MAX)  # [B, Rc]
         masked_max = jnp.where(sat, pc[None, :], -1)
@@ -202,7 +221,7 @@ def match_rules_device(
     The full matrices are only materialized to the host when the caller
     needs them (interpreter-fallback merge or error attribution)."""
     L = W_chunks.shape[1]
-    lit = _lit_matrix(active, L)
+    lit = _lit_matrix(active, L, _lit_dtype(W_chunks.dtype))
     first, last, _ = _first_match(
         lit, W_chunks, thresh_c, group_c, policy_c, n_tiers * _GPT
     )
@@ -210,12 +229,13 @@ def match_rules_device(
     return (packed, (first, last)) if want_full else (packed, None)
 
 
-def _lit_matrix_codes(codes, extras, act_rows):
+def _lit_matrix_codes(codes, extras, act_rows, dtype=jnp.bfloat16):
     """codes [B, S] int (row indices into act_rows [V, L] uint8) + extras
     [B, E] int (raw literal ids, pad >= L) -> {0,1} literal matrix [B, L]
-    bf16. The activation table turns each dictionary-coded request feature
-    into its precomputed literal-activation row; rows are OR-combined (a
-    literal activated by two features must count once, not twice)."""
+    in the requested kernel dtype (_lit_dtype). The activation table turns
+    each dictionary-coded request feature into its precomputed
+    literal-activation row; rows are OR-combined (a literal activated by
+    two features must count once, not twice)."""
     L = act_rows.shape[1]
     S = codes.shape[1]
     acc = jnp.take(act_rows, codes[:, 0].astype(jnp.int32), axis=0)  # [B, L]
@@ -226,7 +246,7 @@ def _lit_matrix_codes(codes, extras, act_rows):
         iota = jnp.arange(L, dtype=jnp.int32)
         lit_e = (e32[:, :, None] == iota[None, None, :]).any(axis=1)
         acc = acc | lit_e.astype(acc.dtype)
-    return acc.astype(jnp.bfloat16)
+    return acc.astype(dtype)
 
 
 # flagged-row compaction width: the kernel returns rule bitsets for up to
@@ -297,7 +317,7 @@ def match_rules_codes(
     n_tiers * 3; rows with a gate hit get WORD_GATE set in their word (and
     an extra trailing column in the want_full matrices)."""
     n_groups = n_tiers * _GPT + (1 if has_gate else 0)
-    lit = _lit_matrix_codes(codes, extras, act_rows)
+    lit = _lit_matrix_codes(codes, extras, act_rows, _lit_dtype(W_chunks.dtype))
     first, last, bits = _first_match(
         lit, W_chunks, thresh_c, group_c, policy_c, n_groups,
         want_bits=want_bits,
@@ -359,7 +379,7 @@ def match_rules_compact(active, W_chunks, thresh_c, group_c, policy_c, n_groups:
     means "no rule matched". Kept for callers that always need per-group
     attribution (tests, fallback-heavy sets)."""
     L = W_chunks.shape[1]
-    lit = _lit_matrix(active, L)
+    lit = _lit_matrix(active, L, _lit_dtype(W_chunks.dtype))
     first, _, _ = _first_match(lit, W_chunks, thresh_c, group_c, policy_c, n_groups)
     return first
 
@@ -385,11 +405,11 @@ def match_rules_codes_bits(
     internal/server/store/store.go:31). Runs only for rows whose verdict
     word carries the multi or err flag, so the [B, R/32] readback never
     rides the hot path."""
-    lit = _lit_matrix_codes(codes, extras, act_rows)
+    lit = _lit_matrix_codes(codes, extras, act_rows, _lit_dtype(W_chunks.dtype))
 
     def body(_, xs):
         Wc, tc, _gc, _pc = xs
-        scores = jnp.dot(lit, Wc, preferred_element_type=jnp.float32)
+        scores = _scores(lit, Wc)
         sat = scores >= tc[None, :]
         return None, _pack_sat_bits(sat)
 
@@ -421,13 +441,14 @@ def chunk_rules(W, thresh, rule_group, rule_policy, chunk: int = 4096):
 
 
 @functools.partial(jax.jit, static_argnames=("n_groups",))
-def match_rules(active, W_bf16, thresh, rule_group, rule_policy, n_groups: int):
+def match_rules(active, W, thresh, rule_group, rule_policy, n_groups: int):
     """Unchunked single-matmul variant (small sets / compile checks).
+    Follows W's dtype like every other match function (int8 or bf16 plane).
     Returns (hits [B, G] bool, first_policy [B, G] int32)."""
-    L = W_bf16.shape[0]
-    lit = _lit_matrix(active, L)
+    L = W.shape[0]
+    lit = _lit_matrix(active, L, _lit_dtype(W.dtype))
 
-    scores = jnp.dot(lit, W_bf16, preferred_element_type=jnp.float32)  # [B, R]
+    scores = _scores(lit, W)  # [B, R]
     sat = scores >= thresh[None, :]
 
     group_onehot = jax.nn.one_hot(rule_group, n_groups, dtype=jnp.bfloat16)  # [R, G]

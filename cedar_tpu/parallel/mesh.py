@@ -29,7 +29,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.match import (
     INT32_MAX,
+    _lit_dtype,
     _lit_matrix_codes,
+    _scores,
     _tier_walk,
     match_rules,
 )
@@ -167,10 +169,10 @@ def sharded_codes_match_fn(mesh: Mesh, n_tiers: int, has_gate: bool = False):
         jax.jit, in_shardings=in_shardings, out_shardings=out_shardings
     )
     def step(codes, extras, act_rows, W, thresh, rule_group, rule_policy):
-        lit = _lit_matrix_codes(codes, extras, act_rows)  # [B, L]
-        scores = jnp.dot(
-            lit, W.astype(jnp.bfloat16), preferred_element_type=jnp.float32
-        )  # [B, R] — R sharded
+        lit = _lit_matrix_codes(
+            codes, extras, act_rows, _lit_dtype(W.dtype)
+        )  # [B, L]
+        scores = _scores(lit, W)  # [B, R] — R sharded
         sat = scores >= thresh[None, :]
         masked_min = jnp.where(sat, rule_policy[None, :], INT32_MAX)
         masked_max = jnp.where(sat, rule_policy[None, :], -1)
@@ -219,10 +221,8 @@ def sharded_codes_bits_fn(mesh: Mesh):
         jax.jit, in_shardings=in_shardings, out_shardings=out_shardings
     )
     def step(codes, extras, act_rows, W, thresh):
-        lit = _lit_matrix_codes(codes, extras, act_rows)
-        scores = jnp.dot(
-            lit, W.astype(jnp.bfloat16), preferred_element_type=jnp.float32
-        )
+        lit = _lit_matrix_codes(codes, extras, act_rows, _lit_dtype(W.dtype))
+        scores = _scores(lit, W)
         sat = scores >= thresh[None, :]
         return _pack_sat_bits(sat)
 

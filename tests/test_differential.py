@@ -577,3 +577,44 @@ permit (principal, action, resource) when { principal.name == "test-user" };
     for decision, diag in results:
         assert decision == "allow"
         assert len(diag.reasons) == 2
+
+
+def test_int8_and_bf16_planes_agree(monkeypatch):
+    """The int8 scoring plane (default since r5 — int8 W, int32
+    accumulation, 2x MXU peak) and the bf16 plane must produce identical
+    decisions and reason/error sets: both are exact (ops/match.py module
+    docstring), so any divergence is a dtype/packing bug."""
+    src = DEMO + """
+permit (principal, action, resource is k8s::Resource)
+  when { principal.name == "test-user" && resource.resource == "jobs" };
+permit (principal in k8s::Group::"devs", action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { resource.resource == "jobs" };
+"""
+    cases = [
+        sar(verb="get", resource="pods"),
+        sar(verb="list", resource="nodes"),
+        sar(verb="get", resource="secrets"),
+        sar(verb="get", resource="jobs"),  # multi-match: two permits
+        sar(user=SA, verb="get", resource="pods"),
+        sar(verb="create", resource="services", resource_request=False,
+            path="/healthz"),
+    ]
+    items = [record_to_cedar_resource(a) for a in cases]
+
+    def run(env_val):
+        monkeypatch.setenv("CEDAR_TPU_INT8", env_val)
+        engine = TPUPolicyEngine()
+        engine.load([PolicySet.from_source(src, "p")], warm="off")
+        assert engine._compiled.W_dev.dtype == (
+            __import__("jax").numpy.int8 if env_val == "1"
+            else __import__("jax").numpy.bfloat16
+        )
+        return engine.evaluate_batch(items)
+
+    int8_res = run("1")
+    bf16_res = run("0")
+    for (d1, g1), (d2, g2), attrs in zip(int8_res, bf16_res, cases):
+        assert d1 == d2, attrs
+        assert {r.policy for r in g1.reasons} == {r.policy for r in g2.reasons}
+        assert _err_policies(g1.errors) == _err_policies(g2.errors)
