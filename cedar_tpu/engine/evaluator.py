@@ -74,6 +74,11 @@ from ..ops.match import (
 )
 
 _BATCH_BUCKETS = (1, 8, 32, 128, 512, 1024, 2048, 4096, 8192, 16384, 32768)
+
+# chunk size of the raw fast paths' encode/device overlap pipeline
+# (engine/fastpath.py uses this as _RawFastPath._CHUNK); defined here so the
+# warm-up ladder can pre-compile the chunk shape without an import cycle
+SERVING_CHUNK = 16384
 # sub-batch size for the pipelined path: large enough to amortize the
 # per-call device round trip, small enough to keep several in flight
 _PIPELINE_SB = 32768
@@ -327,6 +332,13 @@ class TPUPolicyEngine:
                     shapes.append(("full", b, E))
         shapes.append(("bits", self._BITS_CHUNK, 1))
         shapes.append(("bits", self._BITS_CHUNK, 8))
+        # the raw fast paths' batch/replay chunk shape (no in-call bits at
+        # this scale): LAST in the ladder — it is the most expensive
+        # compile and nothing gates on it, but without it the first
+        # large-batch call after every hot swap eats a trace+compile
+        # (VERDICT r4 #8)
+        for E in (1, 8):
+            shapes.append(("plain", SERVING_CHUNK, E))
         for i, (kind, b, E) in enumerate(shapes):
             if self._compiled is not cs or _shutdown.is_set():
                 return

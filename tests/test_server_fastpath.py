@@ -645,6 +645,14 @@ class TestWarmup:
             assert match_rules_codes._cache_size() == size0, (
                 f"b={b} request triggered an XLA compile"
             )
+        # the full batch/replay CHUNK shape is warmed too (VERDICT r4 #8):
+        # the first large process_raw after warm must not retrace
+        from cedar_tpu.engine.evaluator import SERVING_CHUNK
+
+        fast.authorize_raw([json.dumps(sar()).encode()] * SERVING_CHUNK)
+        assert match_rules_codes._cache_size() == size0, (
+            "chunk-scale batch triggered an XLA compile after warm"
+        )
 
     def test_readyz_gates_on_first_warm_shape(self):
         """/readyz answers 503 until the engine's first serving shape has
