@@ -60,6 +60,73 @@ def load_rbac_documents(
     return bindings, roles
 
 
+RBAC_BASE = "/apis/rbac.authorization.k8s.io/v1"
+
+
+def fetch_rbac_documents(
+    client, kind: str, names: List[str], namespace: str
+) -> Tuple[List[Binding], Dict[Tuple[str, str, str], Role]]:
+    """Live-cluster twin of load_rbac_documents: list/get bindings from the
+    apiserver and Get each referenced role, mirroring the reference's
+    converter (/root/reference/cmd/converter/main.go:56-146 — list when no
+    names, per-name Get otherwise; a failed role Get skips that binding
+    with a message, which convert_bindings() emits when the role is absent
+    from the returned index)."""
+    bindings: List[Binding] = []
+    roles: Dict[Tuple[str, str, str], Role] = {}
+    if kind == "clusterrolebinding":
+        b_kind, list_path = (
+            "ClusterRoleBinding", f"{RBAC_BASE}/clusterrolebindings"
+        )
+        get_path = lambda n: f"{RBAC_BASE}/clusterrolebindings/{n}"  # noqa: E731
+    else:
+        b_kind, list_path = "RoleBinding", f"{RBAC_BASE}/rolebindings"
+        get_path = lambda n: (  # noqa: E731
+            f"{RBAC_BASE}/namespaces/{namespace}/rolebindings/{n}"
+        )
+    if names:
+        items = []
+        for n in names:
+            try:
+                items.append(client.get_json(get_path(n)))
+            except Exception as e:  # noqa: BLE001 — per-name skip, like the ref
+                print(
+                    f"Error getting {b_kind} {n}: {e}. Skipping this one",
+                    file=sys.stderr,
+                )
+    else:
+        items = client.get_json(list_path).get("items", [])
+    kept: List[Binding] = []
+    failed: set = set()
+    for item in items:
+        b = Binding.from_dict(item, kind=b_kind)
+        ref = b.role_ref
+        key = (ref.kind, b.namespace if ref.kind == "Role" else "", ref.name)
+        if key not in roles and key not in failed:
+            try:
+                if ref.kind == "Role":
+                    doc = client.get_json(
+                        f"{RBAC_BASE}/namespaces/{b.namespace}/roles/{ref.name}"
+                    )
+                else:
+                    doc = client.get_json(
+                        f"{RBAC_BASE}/clusterroles/{ref.name}"
+                    )
+                roles[key] = Role.from_dict(doc, kind=ref.kind)
+            except Exception as e:  # noqa: BLE001 — log the REAL error and
+                # skip the binding, like the reference (main.go:80-96); a
+                # 503/401 must not masquerade as "not found" downstream
+                failed.add(key)
+                print(
+                    f"Error getting {ref.kind} {ref.name}: {e}. "
+                    "Skipping this one",
+                    file=sys.stderr,
+                )
+        if key in roles:
+            kept.append(b)
+    return kept, roles
+
+
 def resolve_role(
     binding: Binding, roles: Dict[Tuple[str, str, str], Role]
 ) -> Optional[Role]:
@@ -148,6 +215,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         default=[],
         help="YAML file(s) with bindings and roles (default: stdin)",
     )
+    parser.add_argument(
+        "--kubeconfig",
+        default="",
+        help="Fetch bindings and roles from a live cluster via this "
+        "kubeconfig (the reference converter's primary mode) instead of "
+        "files/stdin",
+    )
     args = parser.parse_args(argv)
 
     aliases = {
@@ -167,12 +241,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         return 1
 
-    if args.file:
-        streams = [open(f).read() for f in args.file]
-    else:
-        streams = [sys.stdin.read()]
-    bindings, roles = load_rbac_documents(streams)
     names = [n for n in args.names.split(",") if n]
+    if args.kubeconfig:
+        from ..stores.kubeclient import KubeConfigClient
+
+        client = KubeConfigClient(args.kubeconfig)
+        bindings, roles = fetch_rbac_documents(
+            client, kind, names, args.namespace
+        )
+        names = []  # already filtered server-side (per-name Gets)
+    else:
+        if args.file:
+            streams = [open(f).read() for f in args.file]
+        else:
+            streams = [sys.stdin.read()]
+        bindings, roles = load_rbac_documents(streams)
 
     results = list(convert_bindings(kind, bindings, roles, names, args.namespace))
     for i, (binding, ps) in enumerate(results):

@@ -39,12 +39,58 @@ def api_path_to_group_version(name: str):
     raise ValueError(f"cannot parse API path from fixture name {name!r}")
 
 
+def fetch_openapi_documents(client):
+    """Live-cluster fetch mirroring the reference's K8sSchemaGetter
+    (/root/reference/internal/schema/convert/openapi.go:48-88 +
+    cmd/schema-generator/main.go:80-137): GET /openapi/v3, keep versioned
+    API paths (ending /vN[alphaN|betaN]), sort alphabetically, special-case
+    api/v1 -> core/v1, skip apiextensions.k8s.io, and fetch each path's
+    OpenAPI document + APIResourceList. Returns [(group, version, openapi,
+    resourcelist)]; per-API failures log and skip like the reference."""
+    import re
+
+    doc = client.get_json("/openapi/v3")
+    matcher = re.compile(r"/v\d+(?:alpha\d+|beta\d+)?$")
+    paths = sorted(k for k in doc.get("paths", {}) if matcher.search(k))
+    out = []
+    for p in paths:
+        if p == "api/v1":
+            group, version = "core", "v1"
+        else:
+            parts = p.split("/")
+            if len(parts) < 3:
+                continue
+            group, version = parts[1], parts[2]
+        if group == "apiextensions.k8s.io":
+            continue
+        rel = doc["paths"][p].get("serverRelativeURL") or f"/openapi/v3/{p}"
+        try:
+            openapi = client.get_json(rel)
+        except Exception as e:  # noqa: BLE001 — per-API skip, like the ref
+            print(
+                f"Failed to get schema for API {p}: {e}; skipping",
+                file=sys.stderr,
+            )
+            continue
+        try:
+            resources = client.get_json(f"/{p}")
+        except Exception as e:  # noqa: BLE001
+            print(
+                f"Failed to get APIResourceList for API {p}: {e}; skipping",
+                file=sys.stderr,
+            )
+            continue
+        out.append((group, version, openapi, resources))
+    return out
+
+
 def generate_schema(
     authorization_ns: str = "k8s",
     action_ns: str = "k8s::admission",
     admission: bool = True,
     openapi_dir: Optional[str] = None,
     source_schema: Optional[dict] = None,
+    api_docs=None,
 ) -> CedarSchema:
     schema = CedarSchema()
     if source_schema:
@@ -93,6 +139,19 @@ def generate_schema(
                 modify_schema_for_api_version(
                     resources, openapi, schema, group, version, action_ns
                 )
+        for group, version, openapi, resources in api_docs or ():
+            # live-cluster documents (fetch_openapi_documents); per-API
+            # conversion failures skip like the reference
+            try:
+                modify_schema_for_api_version(
+                    resources, openapi, schema, group, version, action_ns
+                )
+            except Exception as e:  # noqa: BLE001
+                print(
+                    f"Failed to convert schema for {group}/{version}: {e}; "
+                    "skipping",
+                    file=sys.stderr,
+                )
         k8s.add_connect_entities(schema, action_ns, authorization_ns)
 
     schema.sort_action_entities()
@@ -132,6 +191,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="Seed from a previously generated schema JSON before adding "
         "namespaces (merge-in workflow)",
     )
+    parser.add_argument(
+        "--kubeconfig",
+        default="",
+        help="Fetch /openapi/v3 + APIResourceLists from a live cluster via "
+        "this kubeconfig (the reference's primary mode) in addition to any "
+        "--openapi-dir fixtures",
+    )
     parser.add_argument("--output", default="", help="File to write schema to")
     parser.add_argument(
         "--format",
@@ -141,6 +207,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = parser.parse_args(argv)
 
+    api_docs = None
+    if args.kubeconfig and args.admission:
+        # --no-admission never consumes API documents (the admission branch
+        # owns the OpenAPI conversion) — skip the cluster crawl entirely
+        from ..stores.kubeclient import KubeConfigClient
+
+        api_docs = fetch_openapi_documents(KubeConfigClient(args.kubeconfig))
     try:
         schema = generate_schema(
             authorization_ns=args.authorization_namespace,
@@ -152,6 +225,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 if args.source_schema
                 else None
             ),
+            api_docs=api_docs,
         )
     except ValueError as e:
         print(str(e), file=sys.stderr)

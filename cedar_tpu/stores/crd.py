@@ -15,16 +15,11 @@ drive a fake source directly.
 
 from __future__ import annotations
 
-import base64
 import json
 import logging
 import os
-import ssl
-import tempfile
 import threading
-import time
 import urllib.error
-import urllib.request
 from typing import Callable, List, Optional, Protocol
 
 from ..apis.v1alpha1 import GROUP, PolicyObject, VERSION
@@ -245,55 +240,14 @@ class CRDPolicyStore:
 
 class KubeAPIWatchSource:
     """Minimal list+watch client for the Policy CRD over HTTPS using a
-    kubeconfig — stdlib only (urllib + ssl)."""
+    kubeconfig — stdlib only, via the shared KubeConfigClient transport
+    (stores/kubeclient.py)."""
 
     def __init__(self, kubeconfig_path: str, context: str = ""):
-        import yaml
+        from .kubeclient import KubeConfigClient
 
-        with open(kubeconfig_path) as f:
-            cfg = yaml.safe_load(f)
-        ctx_name = context or cfg.get("current-context", "")
-        ctx = next(
-            c["context"] for c in cfg.get("contexts", []) if c["name"] == ctx_name
-        )
-        cluster = next(
-            c["cluster"]
-            for c in cfg.get("clusters", [])
-            if c["name"] == ctx["cluster"]
-        )
-        user = next(
-            u["user"] for u in cfg.get("users", []) if u["name"] == ctx["user"]
-        )
-        self.server = cluster["server"].rstrip("/")
-        self._ssl = ssl.create_default_context()
-        if cluster.get("certificate-authority-data"):
-            self._ssl.load_verify_locations(
-                cadata=base64.b64decode(
-                    cluster["certificate-authority-data"]
-                ).decode()
-            )
-        elif cluster.get("certificate-authority"):
-            self._ssl.load_verify_locations(cafile=cluster["certificate-authority"])
-        if cluster.get("insecure-skip-tls-verify"):
-            self._ssl.check_hostname = False
-            self._ssl.verify_mode = ssl.CERT_NONE
-        self._token = user.get("token", "")
-        self._cert_files = []
-        cert = user.get("client-certificate-data")
-        key = user.get("client-key-data")
-        if cert and key:
-            cf = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
-            cf.write(base64.b64decode(cert))
-            cf.close()
-            kf = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
-            kf.write(base64.b64decode(key))
-            kf.close()
-            self._ssl.load_cert_chain(cf.name, kf.name)
-            self._cert_files = [cf.name, kf.name]
-        elif user.get("client-certificate") and user.get("client-key"):
-            self._ssl.load_cert_chain(
-                user["client-certificate"], user["client-key"]
-            )
+        self._client = KubeConfigClient(kubeconfig_path, context)
+        self.server = self._client.server
         self._resource_version = ""
 
     def _url(self, watch: bool = False) -> str:
@@ -304,10 +258,7 @@ class KubeAPIWatchSource:
         return base
 
     def _open(self, url: str, timeout: Optional[float]):
-        req = urllib.request.Request(url)
-        if self._token:
-            req.add_header("Authorization", f"Bearer {self._token}")
-        return urllib.request.urlopen(req, context=self._ssl, timeout=timeout)
+        return self._client.open(url, timeout)
 
     def list(self) -> List[PolicyObject]:
         with self._open(self._url(), timeout=30) as resp:

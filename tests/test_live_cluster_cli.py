@@ -1,0 +1,250 @@
+"""Live-cluster CLI modes against a local stub apiserver (VERDICT r4 #5).
+
+The converter fetches (Cluster)RoleBindings + their roles
+(/root/reference/cmd/converter/main.go:56-146) and the schema-generator
+fetches /openapi/v3 + APIResourceLists
+(/root/reference/cmd/schema-generator/main.go:64-137,
+internal/schema/convert/openapi.go:36-88) from a running apiserver. The
+stub serves the repo's recorded fixtures over plain HTTP with bearer-token
+auth, so both CLIs' --kubeconfig modes are exercised end to end, and the
+live results are asserted EQUAL to the offline fixture-mode results.
+"""
+
+import json
+import pathlib
+import shutil
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import yaml
+
+TESTDATA = pathlib.Path(__file__).parent / "testdata"
+RBAC_BASE = "/apis/rbac.authorization.k8s.io/v1"
+
+
+def _yaml_docs(path):
+    return [d for d in yaml.safe_load_all(path.read_text()) if d]
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    routes: dict = {}
+    seen_auth: list = []
+    seen_paths: list = []
+
+    def do_GET(self):
+        _StubHandler.seen_auth.append(self.headers.get("Authorization", ""))
+        path = self.path.split("?")[0]
+        _StubHandler.seen_paths.append(path)
+        doc = self.routes.get(path)
+        if doc is None:
+            self.send_response(404)
+            self.end_headers()
+            self.wfile.write(b"{}")
+            return
+        body = json.dumps(doc).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # keep test output clean
+        pass
+
+
+def _start_stub(routes):
+    _StubHandler.routes = routes
+    _StubHandler.seen_auth = []
+    _StubHandler.seen_paths = []
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _kubeconfig(tmp_path, port):
+    cfg = {
+        "apiVersion": "v1",
+        "kind": "Config",
+        "current-context": "stub",
+        "contexts": [
+            {"name": "stub", "context": {"cluster": "stub", "user": "stub"}}
+        ],
+        "clusters": [
+            {
+                "name": "stub",
+                "cluster": {"server": f"http://127.0.0.1:{port}"},
+            }
+        ],
+        "users": [{"name": "stub", "user": {"token": "stub-token"}}],
+    }
+    p = tmp_path / "kubeconfig"
+    p.write_text(yaml.safe_dump(cfg))
+    return str(p)
+
+
+class TestConverterLiveMode:
+    def test_list_clusterrolebindings_matches_offline_golden(
+        self, tmp_path, capsys
+    ):
+        docs = _yaml_docs(TESTDATA / "rbac" / "cluster-admin.yaml")
+        crb = next(d for d in docs if d["kind"] == "ClusterRoleBinding")
+        cr = next(d for d in docs if d["kind"] == "ClusterRole")
+        srv = _start_stub(
+            {
+                f"{RBAC_BASE}/clusterrolebindings": {"items": [crb]},
+                f"{RBAC_BASE}/clusterroles/cluster-admin": cr,
+            }
+        )
+        try:
+            from cedar_tpu.cli.converter import main
+
+            rc = main(
+                [
+                    "clusterrolebinding",
+                    "--kubeconfig",
+                    _kubeconfig(tmp_path, srv.server_address[1]),
+                ]
+            )
+            assert rc == 0
+            out = capsys.readouterr().out
+            header, policy_text = out.split("\n", 1)
+            assert header == "// cluster-admin"
+            golden = (TESTDATA / "rbac" / "cluster-admin.cedar").read_text()
+            assert policy_text.strip() == golden.strip()
+            # the kubeconfig's bearer token really authenticated the calls
+            assert "Bearer stub-token" in _StubHandler.seen_auth
+        finally:
+            srv.shutdown()
+
+    def test_named_rolebinding_get_path(self, tmp_path, capsys):
+        """Per-name fetch uses namespaced Gets (main.go:62-76) and Role
+        refs resolve in the binding's namespace."""
+        docs = _yaml_docs(TESTDATA / "rbac" / "namespaced-role.yaml")
+        rb = next(d for d in docs if d["kind"] == "RoleBinding")
+        role = next(d for d in docs if d["kind"] == "Role")
+        srv = _start_stub(
+            {
+                f"{RBAC_BASE}/namespaces/web/rolebindings/app-readers": rb,
+                f"{RBAC_BASE}/namespaces/web/roles/reader": role,
+            }
+        )
+        try:
+            from cedar_tpu.cli.converter import main
+
+            rc = main(
+                [
+                    "rolebinding",
+                    "app-readers",
+                    "--namespace",
+                    "web",
+                    "--kubeconfig",
+                    _kubeconfig(tmp_path, srv.server_address[1]),
+                ]
+            )
+            assert rc == 0
+            out = capsys.readouterr().out
+            golden = (
+                TESTDATA / "rbac" / "namespaced-role.cedar"
+            ).read_text()
+            assert out.split("\n", 1)[1].strip() == golden.strip()
+            assert (
+                f"{RBAC_BASE}/namespaces/web/rolebindings/app-readers"
+                in _StubHandler.seen_paths
+            )
+        finally:
+            srv.shutdown()
+
+    def test_missing_role_skips_binding(self, tmp_path, capsys):
+        docs = _yaml_docs(TESTDATA / "rbac" / "cluster-admin.yaml")
+        crb = next(d for d in docs if d["kind"] == "ClusterRoleBinding")
+        srv = _start_stub(
+            {f"{RBAC_BASE}/clusterrolebindings": {"items": [crb]}}
+        )  # no clusterroles route: the role Get 404s
+        try:
+            from cedar_tpu.cli.converter import main
+
+            rc = main(
+                [
+                    "clusterrolebinding",
+                    "--kubeconfig",
+                    _kubeconfig(tmp_path, srv.server_address[1]),
+                ]
+            )
+            assert rc == 0
+            captured = capsys.readouterr()
+            assert captured.out.strip() == ""
+            assert "Skipping this one" in captured.err
+        finally:
+            srv.shutdown()
+
+
+class TestSchemaGeneratorLiveMode:
+    def test_live_equals_offline_fixture_mode(self, tmp_path, capsys):
+        """The --kubeconfig fetch over one recorded API must produce the
+        exact schema the offline --openapi-dir mode builds from the same
+        fixture pair; apiextensions and unversioned paths are skipped
+        without being fetched."""
+        name = "apis.batch.v1"
+        openapi = json.loads(
+            (TESTDATA / "openapi" / f"{name}.schema.json").read_text()
+        )
+        rl = json.loads(
+            (TESTDATA / "openapi" / f"{name}.resourcelist.json").read_text()
+        )
+        srv = _start_stub(
+            {
+                "/openapi/v3": {
+                    "paths": {
+                        "apis/batch/v1": {
+                            "serverRelativeURL": "/openapi/v3/apis/batch/v1?hash=abc"
+                        },
+                        "apis/apiextensions.k8s.io/v1": {
+                            "serverRelativeURL": "/openapi/v3/apis/apiextensions.k8s.io/v1"
+                        },
+                        "apis/foo": {},  # unversioned: ignored
+                    }
+                },
+                "/openapi/v3/apis/batch/v1": openapi,
+                "/apis/batch/v1": rl,
+            }
+        )
+        try:
+            from cedar_tpu.cli.schema_generator import main
+
+            live_out = tmp_path / "live.json"
+            rc = main(
+                [
+                    "--kubeconfig",
+                    _kubeconfig(tmp_path, srv.server_address[1]),
+                    "--output",
+                    str(live_out),
+                ]
+            )
+            assert rc == 0
+            # apiextensions was never fetched (skip happens pre-request)
+            assert not any(
+                "apiextensions" in p for p in _StubHandler.seen_paths
+            )
+
+            fixture_dir = tmp_path / "fixtures"
+            fixture_dir.mkdir()
+            for suffix in ("schema.json", "resourcelist.json"):
+                shutil.copy(
+                    TESTDATA / "openapi" / f"{name}.{suffix}",
+                    fixture_dir / f"{name}.{suffix}",
+                )
+            offline_out = tmp_path / "offline.json"
+            rc = main(
+                [
+                    "--openapi-dir",
+                    str(fixture_dir),
+                    "--output",
+                    str(offline_out),
+                ]
+            )
+            assert rc == 0
+            live = json.loads(live_out.read_text())
+            assert json.loads(offline_out.read_text()) == live
+            assert "batch::v1" in live
+        finally:
+            srv.shutdown()
