@@ -174,12 +174,27 @@ class _CompiledSet:
             from ..ops.pallas_match import pallas_supported
 
             if pallas_supported(0, packed.L, packed.R):
+                # the kernel follows its W dtype like the XLA plane;
+                # int8-in-pallas stays opt-in (CEDAR_TPU_PALLAS_INT8=1)
+                # until the Mosaic int8-dot lowering is validated on the
+                # target chip — interpret-mode equality is tested either way
+                pallas_int8 = (
+                    int8_plane
+                    and os.environ.get("CEDAR_TPU_PALLAS_INT8", "0") == "1"
+                )
                 self.pallas_args = (
                     jax.device_put(
-                        jax.numpy.asarray(packed.W, jax.numpy.bfloat16),
+                        packed.W
+                        if pallas_int8
+                        else jax.numpy.asarray(packed.W, jax.numpy.bfloat16),
                         **kwargs,
                     ),
-                    jax.device_put(packed.thresh[None, :], **kwargs),
+                    jax.device_put(
+                        (thresh_host if pallas_int8 else packed.thresh)[
+                            None, :
+                        ],
+                        **kwargs,
+                    ),
                     jax.device_put(packed.rule_group[None, :], **kwargs),
                     jax.device_put(packed.rule_policy[None, :], **kwargs),
                 )

@@ -362,7 +362,7 @@ def match_rules_codes_pallas(
     from .pallas_match import pallas_first_match
 
     n_groups = n_tiers * _GPT + (1 if has_gate else 0)
-    lit = _lit_matrix_codes(codes, extras, act_rows)
+    lit = _lit_matrix_codes(codes, extras, act_rows, _lit_dtype(W2.dtype))
     first, last = pallas_first_match(
         lit, W2, thresh_r, group_r, policy_r, n_groups, interpret
     )

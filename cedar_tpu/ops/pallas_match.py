@@ -9,15 +9,20 @@ group-min reductions happen right after the MXU contraction, and the only
 HBM output is the tiny [B, G] first-match matrix.
 
 Grid: (B tiles, R tiles, L tiles) with the L (contraction) dimension
-innermost; an f32 VMEM scratch accumulates partial scores across L tiles,
-and an int32 VMEM scratch carries the running per-group minima across R
-tiles for each B tile. Rules are padded with thresh=1e9 (never satisfied),
-so padding never contributes a match — same invariant as the XLA path.
+innermost; a VMEM scratch accumulates partial scores across L tiles
+(f32 for the bf16 plane, int32 for the int8 plane — both exact for
+0/1 x +/-1 operands), and an int32 VMEM scratch carries the running
+per-group minima across R tiles for each B tile. Rules are padded with
+thresh=1e9 (never satisfied; exactly representable in both thresh
+dtypes), so padding never contributes a match — same invariant as the
+XLA path.
 
-Layouts (host side, prepared once per compiled policy set):
-  lit     [B, L]  bfloat16   {0, 1} literal activation matrix
-  W       [L, R]  bfloat16   +1 required-true / -1 required-false
-  thresh  [1, R]  float32    positive-literal count (1e9 padding)
+Layouts (host side, prepared once per compiled policy set); lit and W
+must share a plane — bf16 with f32 thresh, or int8 with int32 thresh
+(the default XLA plane's dtype, opt-in here via CEDAR_TPU_PALLAS_INT8):
+  lit     [B, L]  bf16|int8  {0, 1} literal activation matrix
+  W       [L, R]  bf16|int8  +1 required-true / -1 required-false
+  thresh  [1, R]  f32|int32  positive-literal count (1e9 padding)
   group   [1, R]  int32      tier * 3 + effect group id
   policy  [1, R]  int32      policy metadata index (INT32_MAX padding)
 Returns first [B, G] int32 (INT32_MAX = no match), identical to
@@ -61,9 +66,11 @@ def _kernel(
         acc_ref[:] = jnp.full_like(acc_ref, INT32_MAX)
         last_ref[:] = jnp.full_like(last_ref, -1)
 
-    # MXU contraction for this (B, R, L) tile, f32 accumulation in VMEM
+    # MXU contraction for this (B, R, L) tile; the accumulator scratch's
+    # dtype decides the plane: f32 for bf16 inputs, int32 for int8 inputs
+    # (v5e MXU runs int8 at 2x bf16 peak; both planes are exact here)
     score_ref[:] += jnp.dot(
-        lit_ref[:], w_ref[:], preferred_element_type=jnp.float32
+        lit_ref[:], w_ref[:], preferred_element_type=score_ref.dtype
     )
 
     @pl.when(k == nk - 1)
@@ -112,12 +119,16 @@ def _kernel(
 def pallas_first_match(
     lit, W, thresh_r, group_r, policy_r, n_groups: int, interpret: bool = False
 ):
-    """lit [B, L] bf16, W [L, R] bf16, thresh_r/group_r/policy_r [1, R].
-    Returns (first [B, n_groups] int32, last [B, n_groups] int32) — the
-    same (min, max) matched-policy contract as ops.match._first_match. Shapes must tile: B % TB == 0
+    """lit [B, L] + W [L, R] in matching dtypes (bf16 with f32 thresh, or
+    int8 with int32 thresh — the int8 plane of ops/match.py);
+    group_r/policy_r [1, R]. Returns (first [B, n_groups] int32, last
+    [B, n_groups] int32) — the same (min, max) matched-policy contract as
+    ops.match._first_match. Shapes must tile: B % TB == 0
     (or B <= TB), R % TR == 0, L % TK == 0 (or L <= TK)."""
     B, L = lit.shape
     R = W.shape[1]
+    acc_dtype = jnp.int32 if W.dtype == jnp.int8 else jnp.float32
+    in_bytes = 1 if W.dtype == jnp.int8 else 2
     tb = min(_TB, B)
     tk = min(_TK, L)
     tr = min(_TR, R)
@@ -159,7 +170,7 @@ def pallas_first_match(
             ),
         ],
         scratch_shapes=[
-            pltpu.VMEM((tb, tr), jnp.float32),
+            pltpu.VMEM((tb, tr), acc_dtype),
             pltpu.VMEM((tb, g_pad), jnp.int32),
             pltpu.VMEM((tb, g_pad), jnp.int32),
         ],
@@ -168,7 +179,8 @@ def pallas_first_match(
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * B * L * R,
-            bytes_accessed=B * L * 2 + L * R * 2 + 2 * B * g_pad * 4,
+            bytes_accessed=B * L * in_bytes + L * R * in_bytes
+            + 2 * B * g_pad * 4,
             transcendentals=0,
         ),
         interpret=interpret,

@@ -112,3 +112,79 @@ def test_engine_pallas_backend_matches_xla():
     for (d1, g1), (d2, g2) in zip(xla_res, pl_res):
         assert d1 == d2
         assert [r.policy for r in g1.reasons] == [r.policy for r in g2.reasons]
+
+
+@pytest.mark.parametrize("B,L,R,G", [(256, 128, 512, 3), (256, 256, 1024, 6)])
+def test_pallas_int8_plane_parity(B, L, R, G):
+    """The kernel's int8 plane (int8 lit/W, int32 thresh + accumulator —
+    CEDAR_TPU_PALLAS_INT8) must produce the exact first/last matrices of
+    the bf16 plane; both are exact, so any divergence is a dtype bug."""
+    rng = np.random.default_rng(B * 7 + R)
+    W, thresh, group, policy = _random_ruleset(rng, L, R, G)
+    active = rng.integers(0, L + 1, size=(B, 16)).astype(np.int32)
+    lit_bf16 = _lit_matrix(jnp.asarray(active), L)
+    lit_int8 = _lit_matrix(jnp.asarray(active), L, jnp.int8)
+
+    ref = pallas_first_match(
+        lit_bf16,
+        jnp.asarray(W, jnp.bfloat16),
+        jnp.asarray(thresh)[None, :],
+        jnp.asarray(group)[None, :],
+        jnp.asarray(policy)[None, :],
+        G,
+        interpret=True,
+    )
+    out = pallas_first_match(
+        lit_int8,
+        jnp.asarray(W, jnp.int8),
+        jnp.asarray(thresh.astype(np.int32))[None, :],
+        jnp.asarray(group)[None, :],
+        jnp.asarray(policy)[None, :],
+        G,
+        interpret=True,
+    )
+    assert (np.asarray(ref) == np.asarray(out)).all()
+
+
+def test_engine_pallas_int8_matches_xla(monkeypatch):
+    """Full-engine differential with the opt-in int8 pallas plane engaged
+    (interpret mode on CPU)."""
+    monkeypatch.setenv("CEDAR_TPU_PALLAS_INT8", "1")
+    src = "\n".join(
+        f'permit (principal, action == k8s::Action::"get",'
+        " resource is k8s::Resource) when {"
+        f' principal.name == "user-{i % 9}" &&'
+        f' resource.resource == "r-{i % 5}" }};'
+        for i in range(64)
+    )
+    tiers = [PolicySet.from_source(src, "pallas-int8")]
+
+    import random
+
+    from cedar_tpu.entities.attributes import Attributes, UserInfo
+    from cedar_tpu.server.authorizer import record_to_cedar_resource
+
+    rng = random.Random(11)
+    items = [
+        record_to_cedar_resource(
+            Attributes(
+                user=UserInfo(name=f"user-{rng.randint(0, 10)}", uid="u"),
+                verb="get",
+                resource=f"r-{rng.randint(0, 6)}",
+                api_version="v1",
+                resource_request=True,
+            )
+        )
+        for _ in range(64)
+    ]
+    xla_engine = TPUPolicyEngine(use_pallas=False)
+    xla_engine.load(tiers)
+    pl_engine = TPUPolicyEngine(use_pallas=True)
+    pl_engine.load(tiers)
+    assert pl_engine._compiled.pallas_args is not None
+    assert pl_engine._compiled.pallas_args[0].dtype == jnp.int8
+    for (d1, g1), (d2, g2) in zip(
+        xla_engine.evaluate_batch(items), pl_engine.evaluate_batch(items)
+    ):
+        assert d1 == d2
+        assert [r.policy for r in g1.reasons] == [r.policy for r in g2.reasons]
