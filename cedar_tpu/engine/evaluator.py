@@ -179,9 +179,16 @@ class _CompiledSet:
                 # until the Mosaic int8-dot lowering is validated on the
                 # target chip — interpret-mode equality is tested either way
                 pallas_int8 = (
-                    int8_plane
-                    and os.environ.get("CEDAR_TPU_PALLAS_INT8", "0") == "1"
+                    os.environ.get("CEDAR_TPU_PALLAS_INT8", "0") == "1"
                 )
+                if pallas_int8 and not int8_plane:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "CEDAR_TPU_PALLAS_INT8=1 ignored: CEDAR_TPU_INT8=0 "
+                        "selects the bf16 plane everywhere"
+                    )
+                    pallas_int8 = False
                 self.pallas_args = (
                     jax.device_put(
                         packed.W

@@ -357,8 +357,10 @@ def match_rules_codes_pallas(
 ):
     """Pallas-kernel variant of match_rules_codes: the scores matmul and the
     per-group first-match reduction run fused in VMEM (ops/pallas_match.py),
-    so the [B, R] score matrix never reaches HBM. Layouts: W2 [L, R] bf16
-    (unchunked), thresh_r/group_r/policy_r [1, R]."""
+    so the [B, R] score matrix never reaches HBM. Layouts: W2 [L, R]
+    unchunked in either kernel dtype (bf16 with f32 thresh_r, or int8 with
+    int32 thresh_r — the lit matrix follows W2's dtype),
+    group_r/policy_r [1, R]."""
     from .pallas_match import pallas_first_match
 
     n_groups = n_tiers * _GPT + (1 if has_gate else 0)

@@ -149,6 +149,7 @@ def test_pallas_int8_plane_parity(B, L, R, G):
 def test_engine_pallas_int8_matches_xla(monkeypatch):
     """Full-engine differential with the opt-in int8 pallas plane engaged
     (interpret mode on CPU)."""
+    monkeypatch.setenv("CEDAR_TPU_INT8", "1")  # pin against ambient bf16 env
     monkeypatch.setenv("CEDAR_TPU_PALLAS_INT8", "1")
     src = "\n".join(
         f'permit (principal, action == k8s::Action::"get",'

@@ -1,0 +1,132 @@
+"""One-command hardware validation for the round-5 kernel work.
+
+Run on a live device link (plain `python tools/hw_validate.py`, no
+JAX_PLATFORMS override). Prints one JSON line with:
+
+  * int8 vs bf16 device-resident match rates at the headline shape
+    (10k policies, 131072-row super-batches) — the measured answer to
+    whether the int8 plane's 2x MXU-peak claim holds end to end;
+  * pallas bf16 and pallas int8 status: whether the Mosaic lowering
+    compiles + matches the XLA plane on the real chip (the int8-in-pallas
+    default stays opt-in until this reports ok);
+  * per-plane first/last equality checks against the interpreter-free
+    XLA reference, so a silent lowering bug cannot masquerade as a win.
+
+Uses bench.py's policy-set builder and the same outage hardening pattern
+(subprocess probe with a hard timeout) — a dead tunnel exits in minutes.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    import os
+
+    from bench import _wait_for_backend, build_policy_set
+
+    # a forced-cpu run (the harness smoke) needs no device probe — and the
+    # probe subprocess would hang on a dead tunnel even under cpu (jaxenv)
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu" and not _wait_for_backend(
+        max_wait_s=240
+    ):
+        print(json.dumps({"ok": False, "error": "device link unavailable"}))
+        return 1
+
+    import numpy as np
+
+    import jax
+
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.lang import PolicySet  # noqa: F401  (bench import path)
+    from cedar_tpu.ops.match import match_rules_codes
+
+    import os
+
+    # CEDAR_HWVAL_SMALL=1 shrinks shapes for a CPU smoke of the harness
+    small = os.environ.get("CEDAR_HWVAL_SMALL", "0") == "1"
+    out: dict = {"ok": True, "platform": jax.devices()[0].platform}
+    ps, users, nss, resources, verbs, groups = build_policy_set(
+        300 if small else 10_000
+    )
+
+    def device_rate(env_val: str) -> float:
+        import os
+
+        os.environ["CEDAR_TPU_INT8"] = env_val
+        engine = TPUPolicyEngine()
+        engine.load([ps], warm="off")
+        cs = engine._compiled
+        packed = cs.packed
+        SB = 4096 if small else 131072
+        S = packed.table.n_slots
+        codes = np.zeros((SB, S), dtype=cs.code_dtype)
+        extras = np.full((SB, 8), packed.L, dtype=cs.active_dtype)
+        args = (
+            cs.act_rows_dev, cs.W_dev, cs.thresh_dev,
+            cs.rule_group_dev, cs.rule_policy_dev,
+        )
+        cb, eb = jax.device_put(codes), jax.device_put(extras)
+        w, _ = match_rules_codes(cb, eb, *args, packed.n_tiers, False)
+        np.asarray(w)  # compile + warm
+        n_pipe = 6
+        t = time.time()
+        ws = []
+        for _ in range(n_pipe):
+            w, _ = match_rules_codes(cb, eb, *args, packed.n_tiers, False)
+            w.copy_to_host_async()
+            ws.append(w)
+        for w in ws:
+            np.asarray(w)
+        return SB * n_pipe / (time.time() - t)
+
+    rates = {}
+    for env_val, key in (("1", "int8"), ("0", "bf16")):
+        trials = sorted(device_rate(env_val) for _ in range(3))
+        rates[key] = round(trials[1])
+    out["device_resident_rate_int8"] = rates["int8"]
+    out["device_resident_rate_bf16"] = rates["bf16"]
+    out["int8_speedup"] = round(rates["int8"] / max(rates["bf16"], 1), 3)
+
+    # pallas planes: compile + equality vs the XLA plane on the real chip
+    import os
+
+    os.environ["CEDAR_TPU_INT8"] = "1"
+    for key, env in (
+        ("pallas_bf16", {"CEDAR_TPU_PALLAS_INT8": "0"}),
+        ("pallas_int8", {"CEDAR_TPU_PALLAS_INT8": "1"}),
+    ):
+        os.environ.update(env)
+        try:
+            eng_pl = TPUPolicyEngine(use_pallas=True)
+            eng_pl.load([ps], warm="off")
+            eng_xla = TPUPolicyEngine(use_pallas=False)
+            eng_xla.load([ps], warm="off")
+            if eng_pl._compiled.pallas_args is None:
+                out[key] = "unsupported-shape"
+                continue
+            cs_pl, cs_x = eng_pl._compiled, eng_xla._compiled
+            B = 256
+            S = cs_pl.packed.table.n_slots
+            rng = np.random.default_rng(5)
+            codes = rng.integers(
+                0, cs_pl.packed.table.n_rows, size=(B, S)
+            ).astype(cs_pl.code_dtype)
+            extras = np.full((B, 8), cs_pl.packed.L, dtype=cs_pl.active_dtype)
+            w_pl = eng_pl.match_arrays(codes, extras, cs=cs_pl)[0]
+            w_x = eng_xla.match_arrays(codes, extras, cs=cs_x)[0]
+            same = bool((np.asarray(w_pl) == np.asarray(w_x)).all())
+            out[key] = "ok" if same else "MISMATCH"
+        except Exception as e:  # noqa: BLE001 — report, don't crash the probe
+            out[key] = f"error: {type(e).__name__}: {e}"
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
