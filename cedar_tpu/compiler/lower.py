@@ -754,8 +754,21 @@ def lower_policy(
         if simplified is None:
             continue
         hardened, errs = harden_clause(simplified, type_ctx, schema)
-        clauses.append(hardened)
+        # re-simplify AFTER hardening: an inserted presence guard can
+        # contradict an existing negated HAS on the same access (e.g.
+        # `unless { r has a } unless { r.a == "x" }`), making the match
+        # clause unsatisfiable — packing a clause with both signs of one
+        # literal would let the later W write win and the rule fire
+        # wrongly. The error clauses survive independently: Cedar still
+        # errors on the paths they encode (here: `a` absent) even when no
+        # match clause remains.
+        hardened = simplify_clause(hardened)
+        if hardened is not None:
+            clauses.append(hardened)
         for ec in errs:
+            ec = simplify_clause(ec)
+            if ec is None:
+                continue
             key = tuple((cl.lit.key(), cl.negated) for cl in ec)
             if key not in seen_err:
                 seen_err.add(key)

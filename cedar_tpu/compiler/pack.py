@@ -229,8 +229,25 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
 
     for r, (lits, group, pm_idx) in enumerate(rules):
         npos = 0
+        seen_sign: dict = {}
         for lit_id, negated in lits:
-            W[lit_id, r] = -1 if negated else 1
+            val = -1 if negated else 1
+            prev = seen_sign.get(lit_id)
+            if prev is not None:
+                if prev != val:
+                    # both signs of one literal in a single rule: the
+                    # clause is unsatisfiable and must have been dropped
+                    # by the lowerer (simplify after harden); a silent
+                    # last-write-wins here turns "never fires" into a
+                    # wrong match — fail the compile loudly instead
+                    raise ValueError(
+                        f"rule {r}: literal {lit_id} appears with both "
+                        "signs (unsatisfiable clause leaked past the "
+                        "lowerer)"
+                    )
+                continue  # duplicate same-sign literal: count once
+            seen_sign[lit_id] = val
+            W[lit_id, r] = val
             if not negated:
                 npos += 1
         thresh[r] = float(npos)

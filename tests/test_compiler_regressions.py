@@ -177,3 +177,75 @@ def test_same_bucket_reload_keeps_device_shapes():
     }
     assert shapes1 == shapes2
     assert cs1.code_dtype == cs2.code_dtype
+
+
+def test_unless_has_then_unless_eq_same_slot_is_unsatisfiable():
+    """Fuzz seed 1135 (r5): `unless { r has a } unless { r.a == "x" }` can
+    NEVER match — `a` present fails the first condition, `a` absent errors
+    in the second. The hardening pass inserts a positive HAS(a) guard
+    before the negated EQ, contradicting the clause's negated HAS(a);
+    before the post-harden re-simplify, pack's last-write-wins on the W
+    column turned that unsatisfiable clause into "fires when a present and
+    != x" — an ALLOW the interpreter never grants."""
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.entities.attributes import Attributes, UserInfo
+    from cedar_tpu.lang import PolicySet
+    from cedar_tpu.server.authorizer import record_to_cedar_resource
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    src = (
+        'permit (principal in k8s::Group::"editors", action, '
+        "resource is k8s::Resource) "
+        "unless { resource has subresource } "
+        'unless { resource.subresource == "default" };'
+    )
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "m")], warm="off")
+    stores = TieredPolicyStores([MemoryStore.from_source("m", src)])
+
+    def attrs(sub):
+        return Attributes(
+            user=UserInfo(name="u", uid="u1", groups=("editors",)),
+            verb="create", namespace="kube-system", api_version="v1",
+            resource="deployments", subresource=sub, resource_request=True,
+        )
+
+    for sub in ("status", "default", ""):
+        em, rq = record_to_cedar_resource(attrs(sub))
+        tpu_dec, tpu_diag = engine.evaluate(em, rq)
+        int_dec, int_diag = stores.is_authorized(em, rq)
+        assert tpu_dec == int_dec == "deny", (sub, tpu_dec, int_dec)
+        assert not tpu_diag.reasons and not int_diag.reasons
+        # absent attribute: BOTH paths report the evaluation error
+        assert bool(tpu_diag.errors) == bool(int_diag.errors), (
+            sub, tpu_diag.errors, int_diag.errors,
+        )
+
+
+def test_pack_rejects_two_signed_duplicate_literal():
+    """Defense in depth: if an unsatisfiable clause ever leaks past the
+    lowerer again, pack() must fail the compile loudly rather than let a
+    last-write-wins W column flip 'never fires' into a wrong match."""
+    import pytest
+
+    from cedar_tpu.compiler.lower import lower_tiers
+    from cedar_tpu.compiler.pack import pack
+    from cedar_tpu.engine.evaluator import AUTHZ_SCHEMA_INFO
+    from cedar_tpu.lang import PolicySet
+
+    src = (
+        'permit (principal, action, resource is k8s::Resource) '
+        'when { resource.resource == "pods" };'
+    )
+    compiled = lower_tiers(
+        [PolicySet.from_source(src, "m")], AUTHZ_SCHEMA_INFO
+    )
+    lp = compiled.lowered[0]
+    clause = lp.clauses[0]
+    # append the negation of an existing literal to forge the leak
+    from cedar_tpu.compiler.lower import ClauseLit
+
+    bad = clause + (ClauseLit(clause[-1].lit, not clause[-1].negated),)
+    lp.clauses[0] = bad
+    with pytest.raises(ValueError, match="both signs"):
+        pack(compiled)
