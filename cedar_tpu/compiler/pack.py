@@ -240,10 +240,18 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
                     # by the lowerer (simplify after harden); a silent
                     # last-write-wins here turns "never fires" into a
                     # wrong match — fail the compile loudly instead
+                    is_gate = group == compiled.n_tiers * GROUPS_PER_TIER
+                    owner = (
+                        "gate-rule"
+                        if is_gate
+                        else policy_meta[pm_idx].policy_id
+                        if 0 <= pm_idx < len(policy_meta)
+                        else f"pm_idx={pm_idx}"
+                    )
                     raise ValueError(
-                        f"rule {r}: literal {lit_id} appears with both "
-                        "signs (unsatisfiable clause leaked past the "
-                        "lowerer)"
+                        f"rule {r} (policy {owner}): literal {lit_id} "
+                        "appears with both signs (unsatisfiable clause "
+                        "leaked past the lowerer)"
                     )
                 continue  # duplicate same-sign literal: count once
             seen_sign[lit_id] = val

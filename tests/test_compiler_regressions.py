@@ -230,7 +230,7 @@ def test_pack_rejects_two_signed_duplicate_literal():
 
     from cedar_tpu.compiler.lower import lower_tiers
     from cedar_tpu.compiler.pack import pack
-    from cedar_tpu.engine.evaluator import AUTHZ_SCHEMA_INFO
+    from cedar_tpu.compiler.lower import AUTHZ_SCHEMA_INFO
     from cedar_tpu.lang import PolicySet
 
     src = (

@@ -1,0 +1,138 @@
+"""Long-running native-vs-interpreter fuzz soak (CPU backend).
+
+The in-suite fuzz (tests/test_fuzz_differential.py) pins a handful of
+seeds for CI speed; this tool runs the same generators over arbitrary
+seed ranges for soak sessions. Round 5's first 150-seed run caught a real
+compiler bug the fixed seeds missed (seed 1135: double-unless on one
+attribute packed an unsatisfiable clause as a firing rule — commit
+d7f75af), so keep soaking new ranges each round.
+
+Usage:
+  python tools/fuzz_soak.py [--mode single|multitier] [--start N]
+                            [--count N] [--requests N]
+
+Runs on the CPU backend regardless of a live device link (the compiler
+and the native encoder — the planes fuzz has caught bugs in — are
+device-independent; the device kernel is exercised identically on cpu).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(prog="fuzz-soak")
+    parser.add_argument("--mode", default="single",
+                        choices=["single", "multitier"])
+    parser.add_argument("--start", type=int, default=1000)
+    parser.add_argument("--count", type=int, default=100)
+    parser.add_argument("--requests", type=int, default=60)
+    args = parser.parse_args()
+
+    os.environ.setdefault("CEDAR_TPU_WARM_DEFAULT", "off")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from cedar_tpu.jaxenv import disable_non_cpu_backends
+
+    disable_non_cpu_backends()
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tests",
+        ),
+    )
+    from test_fuzz_differential import (  # noqa: E402
+        _gen_attributes,
+        _gen_policy,
+        _sar_json,
+    )
+
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.engine.fastpath import SARFastPath
+    from cedar_tpu.lang import PolicySet
+    from cedar_tpu.native import native_available
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.server.http import get_authorizer_attributes
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    if not native_available():
+        print("no C++ toolchain: the native lane cannot be soaked")
+        return 2
+
+    t0 = time.time()
+    ok = skip = 0
+    for seed in range(args.start, args.start + args.count):
+        rng = random.Random(seed)
+        if args.mode == "multitier":
+            n_tiers = rng.randint(2, 3)
+            srcs = [
+                "\n".join(
+                    _gen_policy(rng) for _ in range(rng.randint(4, 15))
+                )
+                for _ in range(n_tiers)
+            ]
+        else:
+            srcs = ["\n".join(_gen_policy(rng) for _ in range(rng.randint(5, 30)))]
+        engine = TPUPolicyEngine()
+        engine.load(
+            [
+                PolicySet.from_source(s, f"s{seed}t{i}")
+                for i, s in enumerate(srcs)
+            ],
+            warm="off",
+        )
+        stores = TieredPolicyStores(
+            [
+                MemoryStore.from_source(f"s{seed}t{i}", s)
+                for i, s in enumerate(srcs)
+            ]
+        )
+        oracle = CedarWebhookAuthorizer(stores)
+        fast = SARFastPath(
+            engine, CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+        )
+        if not fast.available:
+            skip += 1
+            continue
+        attrs_list = [_gen_attributes(rng) for _ in range(args.requests)]
+        sars = [_sar_json(a) for a in attrs_list]
+        bodies = [json.dumps(s).encode() for s in sars]
+        for sar, (decision, reason, _e) in zip(
+            sars, fast.authorize_raw(bodies)
+        ):
+            want_dec, want_reason = oracle.authorize(
+                get_authorizer_attributes(sar)
+            )
+            assert decision == want_dec, (
+                f"seed={seed} native={decision} interp={want_dec}\n"
+                f"sar={sar}\npolicies:\n" + "\n---tier---\n".join(srcs)
+            )
+            assert bool(reason) == bool(want_reason), (
+                f"seed={seed} reason presence mismatch\nsar={sar}\n"
+                "policies:\n" + "\n---tier---\n".join(srcs)
+            )
+        ok += 1
+        if ok % 50 == 0:
+            print(
+                f"{ok} seeds ok, {skip} skipped, {time.time() - t0:.0f}s",
+                flush=True,
+            )
+    print(
+        f"SOAK PASS ({args.mode}): {ok} seeds ok, {skip} skipped, "
+        f"{time.time() - t0:.0f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
