@@ -571,15 +571,16 @@ forbid (
     ]
 
 
-def test_admission_fastpath_randomized():
-    engine, handler, fast = _build()
-    rng = random.Random(42)
+def gen_admission_bodies(rng, n):
+    """Random AdmissionReview bodies over the kinds/ops/shapes the demo
+    policy set exercises — shared by the in-suite randomized test (fixed
+    seed) and tools/fuzz_soak.py --mode admission (arbitrary seeds)."""
     kinds = [("", "v1", "ConfigMap"), ("", "v1", "Secret"),
              ("apps", "v1", "Deployment"), ("", "v1", "Pod")]
     users = ["bob", "alice", "system:serviceaccount:ns1:sa1",
              "system:node:node-1"]
     bodies = []
-    for i in range(300):
+    for i in range(n):
         gvk = rng.choice(kinds)
         op = rng.choice(["CREATE", "UPDATE", "DELETE", "CONNECT"])
         labels = rng.choice(
@@ -639,6 +640,12 @@ def test_admission_fastpath_randomized():
                 )
             ).encode()
         )
+    return bodies
+
+
+def test_admission_fastpath_randomized():
+    engine, handler, fast = _build()
+    bodies = gen_admission_bodies(random.Random(42), 300)
     assert_parity(fast, handler, bodies)
 
 

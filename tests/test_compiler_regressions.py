@@ -228,9 +228,12 @@ def test_pack_rejects_two_signed_duplicate_literal():
     last-write-wins W column flip 'never fires' into a wrong match."""
     import pytest
 
-    from cedar_tpu.compiler.lower import lower_tiers
+    from cedar_tpu.compiler.lower import (
+        AUTHZ_SCHEMA_INFO,
+        ClauseLit,
+        lower_tiers,
+    )
     from cedar_tpu.compiler.pack import pack
-    from cedar_tpu.compiler.lower import AUTHZ_SCHEMA_INFO
     from cedar_tpu.lang import PolicySet
 
     src = (
@@ -243,8 +246,6 @@ def test_pack_rejects_two_signed_duplicate_literal():
     lp = compiled.lowered[0]
     clause = lp.clauses[0]
     # append the negation of an existing literal to forge the leak
-    from cedar_tpu.compiler.lower import ClauseLit
-
     bad = clause + (ClauseLit(clause[-1].lit, not clause[-1].negated),)
     lp.clauses[0] = bad
     with pytest.raises(ValueError, match="both signs"):

@@ -8,8 +8,14 @@ attribute packed an unsatisfiable clause as a firing rule — commit
 d7f75af), so keep soaking new ranges each round.
 
 Usage:
-  python tools/fuzz_soak.py [--mode single|multitier] [--start N]
-                            [--count N] [--requests N]
+  python tools/fuzz_soak.py [--mode single|multitier|admission]
+                            [--start N] [--count N] [--requests N]
+
+Modes single/multitier drive tests/test_fuzz_differential.py's policy +
+SAR generators (random policy sets per seed); mode admission drives
+tests/test_admission_native.py's AdmissionReview generator (random
+request streams over the demo admission set) through the C++ object walk
+vs the Python handler path.
 
 Runs on the CPU backend regardless of a live device link (the compiler
 and the native encoder — the planes fuzz has caught bugs in — are
@@ -29,7 +35,7 @@ import time
 def main() -> int:
     parser = argparse.ArgumentParser(prog="fuzz-soak")
     parser.add_argument("--mode", default="single",
-                        choices=["single", "multitier"])
+                        choices=["single", "multitier", "admission"])
     parser.add_argument("--start", type=int, default=1000)
     parser.add_argument("--count", type=int, default=100)
     parser.add_argument("--requests", type=int, default=60)
@@ -70,6 +76,35 @@ def main() -> int:
         return 2
 
     t0 = time.time()
+
+    if args.mode == "admission":
+        # random AdmissionReview streams (per-seed rng) over the demo
+        # admission set: the C++ object walk vs the Python handler path
+        from test_admission_native import (  # noqa: E402
+            _build,
+            assert_parity,
+            gen_admission_bodies,
+        )
+
+        _engine, handler, fast = _build()
+        # without this, a dead native lane degrades handle_raw to the
+        # Python path and the soak compares Python against itself
+        assert fast.available, "native admission lane unavailable"
+        for seed in range(args.start, args.start + args.count):
+            bodies = gen_admission_bodies(
+                random.Random(seed), args.requests
+            )
+            assert_parity(fast, handler, bodies)
+            done = seed - args.start + 1
+            if done % 25 == 0:
+                print(f"{done} admission seeds ok, {time.time() - t0:.0f}s",
+                      flush=True)
+        print(
+            f"SOAK PASS (admission): {args.count} seeds ok, "
+            f"{time.time() - t0:.0f}s"
+        )
+        return 0
+
     ok = skip = 0
     for seed in range(args.start, args.start + args.count):
         rng = random.Random(seed)
@@ -107,9 +142,10 @@ def main() -> int:
         attrs_list = [_gen_attributes(rng) for _ in range(args.requests)]
         sars = [_sar_json(a) for a in attrs_list]
         bodies = [json.dumps(s).encode() for s in sars]
-        for sar, (decision, reason, _e) in zip(
-            sars, fast.authorize_raw(bodies)
-        ):
+        results = fast.authorize_raw(bodies)
+        # a row-dropping bug must fail the soak, not shorten the zip
+        assert len(results) == len(bodies), (seed, len(results), len(bodies))
+        for sar, (decision, reason, _e) in zip(sars, results):
             want_dec, want_reason = oracle.authorize(
                 get_authorizer_attributes(sar)
             )
