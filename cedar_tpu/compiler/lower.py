@@ -752,19 +752,28 @@ def lower_policy(
         full = tuple(prefix) + c
         simplified = simplify_clause(full)
         if simplified is None:
-            continue
-        hardened, errs = harden_clause(simplified, type_ctx, schema)
-        # re-simplify AFTER hardening: an inserted presence guard can
-        # contradict an existing negated HAS on the same access (e.g.
-        # `unless { r has a } unless { r.a == "x" }`), making the match
-        # clause unsatisfiable — packing a clause with both signs of one
-        # literal would let the later W write win and the rule fire
-        # wrongly. The error clauses survive independently: Cedar still
-        # errors on the paths they encode (here: `a` absent) even when no
-        # match clause remains.
-        hardened = simplify_clause(hardened)
-        if hardened is not None:
-            clauses.append(hardened)
+            # the match clause can never fire (contradictory conditions,
+            # e.g. `when { C } unless { C }`) — but Cedar still evaluates
+            # the conditions in order and can ERROR (absent-attribute
+            # access) before reaching the contradiction, and errors are
+            # signals that stop tier descent. Harden the ORIGINAL clause
+            # purely for its error clauses; the match clause is dropped.
+            # (Unlowerable propagates exactly like the normal path: if the
+            # error behavior needs the interpreter, the policy falls back.)
+            _dropped, errs = harden_clause(full, type_ctx, schema)
+        else:
+            hardened, errs = harden_clause(simplified, type_ctx, schema)
+            # re-simplify AFTER hardening: an inserted presence guard can
+            # contradict an existing negated HAS on the same access (e.g.
+            # `unless { r has a } unless { r.a == "x" }`), making the
+            # match clause unsatisfiable — packing a clause with both
+            # signs of one literal would let the later W write win and
+            # the rule fire wrongly. The error clauses survive
+            # independently: Cedar still errors on the paths they encode
+            # (here: `a` absent) even when no match clause remains.
+            hardened = simplify_clause(hardened)
+            if hardened is not None:
+                clauses.append(hardened)
         for ec in errs:
             ec = simplify_clause(ec)
             if ec is None:

@@ -171,6 +171,8 @@ def _oracle(handler, body: bytes) -> dict:
 def assert_parity(fast, handler, bodies):
     got = [r.to_admission_review() for r in fast.handle_raw(bodies)]
     want = [_oracle(handler, b) for b in bodies]
+    # a row-dropping bug must fail here, not shorten the zip
+    assert len(got) == len(want) == len(bodies)
     for g, w, b in zip(got, want, bodies):
         assert g == w, f"mismatch for {b[:200]!r}:\n native={g}\n python={w}"
 

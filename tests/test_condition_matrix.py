@@ -1,0 +1,104 @@
+"""Directed differential over condition-pair combinations (r5).
+
+The round-5 fuzz caught a compiler bug in exactly this class — two
+conditions on one OPTIONAL attribute (`unless { has a } unless
+{ a == "x" }`) interacting with the hardening pass's presence guards.
+This test enumerates the whole neighborhood systematically: every
+ordered pair of when/unless conditions drawn from has / == / != / like
+on `resource.subresource`, each as its own single-policy set, evaluated
+against present-matching, present-other, and absent requests — decision,
+reason presence, and error presence must all match the interpreter.
+
+64 policies x 3 requests; single engine reused per policy via load()
+(the swap unit), so the suite stays fast on CPU.
+"""
+
+import itertools
+
+import pytest
+
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+from cedar_tpu.entities.attributes import Attributes, UserInfo
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.server.authorizer import record_to_cedar_resource
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+CONDS = {
+    "has": "resource has subresource",
+    "eq": 'resource.subresource == "status"',
+    "ne": 'resource.subresource != "status"',
+    "like": 'resource.subresource like "sta*"',
+}
+KINDS = ["when", "unless"]
+
+
+def _attrs(sub):
+    return Attributes(
+        user=UserInfo(name="u", uid="u1", groups=("g",)),
+        verb="get", namespace="default", api_version="v1",
+        resource="pods", subresource=sub, resource_request=True,
+    )
+
+
+REQUESTS = [_attrs("status"), _attrs("scale"), _attrs("")]
+ITEMS = [record_to_cedar_resource(a) for a in REQUESTS]
+
+PAIRS = list(
+    itertools.product(
+        itertools.product(KINDS, CONDS), itertools.product(KINDS, CONDS)
+    )
+)
+
+
+@pytest.mark.parametrize(
+    "first,second", PAIRS,
+    ids=[f"{k1}-{c1}--{k2}-{c2}" for (k1, c1), (k2, c2) in PAIRS],
+)
+def test_condition_pair_matches_interpreter(first, second):
+    (k1, c1), (k2, c2) = first, second
+    src = (
+        "permit (principal, action, resource is k8s::Resource) "
+        f"{k1} {{ {CONDS[c1]} }} {k2} {{ {CONDS[c2]} }};"
+    )
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "m")], warm="off")
+    stores = TieredPolicyStores([MemoryStore.from_source("m", src)])
+    tpu_res = engine.evaluate_batch(ITEMS)
+    for (em, rq), (tpu_dec, tpu_diag), attrs in zip(ITEMS, tpu_res, REQUESTS):
+        int_dec, int_diag = stores.is_authorized(em, rq)
+        ctx = (src, attrs.subresource)
+        assert tpu_dec == int_dec, (ctx, tpu_dec, int_dec)
+        assert bool(tpu_diag.reasons) == bool(int_diag.reasons), ctx
+        assert bool(tpu_diag.errors) == bool(int_diag.errors), (
+            ctx, tpu_diag.errors, int_diag.errors,
+        )
+
+
+def test_contradictory_policy_error_stops_tier_descent():
+    """The wrong-decision consequence the error-clause fix prevents: a
+    tier-1 policy with contradictory conditions can still ERROR (absent
+    attribute), and errors are signals that stop tier descent — the
+    device walk must not fall through to tier 2's allow."""
+    t1 = (
+        "permit (principal, action, resource is k8s::Resource) "
+        'when { resource.subresource == "status" } '
+        'unless { resource.subresource == "status" };'
+    )
+    t2 = "permit (principal, action, resource is k8s::Resource);"
+    engine = TPUPolicyEngine()
+    engine.load(
+        [PolicySet.from_source(t1, "t1"), PolicySet.from_source(t2, "t2")],
+        warm="off",
+    )
+    stores = TieredPolicyStores(
+        [MemoryStore.from_source("t1", t1), MemoryStore.from_source("t2", t2)]
+    )
+    # absent subresource: tier 1 errors -> descent stops in BOTH paths
+    em, rq = record_to_cedar_resource(_attrs(""))
+    tpu_dec, tpu_diag = engine.evaluate(em, rq)
+    int_dec, int_diag = stores.is_authorized(em, rq)
+    assert tpu_dec == int_dec == "deny"
+    assert bool(tpu_diag.errors) and bool(int_diag.errors)
+    # present subresource: tier 1 has no signal -> tier 2 allows in both
+    em, rq = record_to_cedar_resource(_attrs("status"))
+    assert engine.evaluate(em, rq)[0] == stores.is_authorized(em, rq)[0] == "allow"
