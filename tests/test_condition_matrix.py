@@ -9,8 +9,9 @@ on `resource.subresource`, each as its own single-policy set, evaluated
 against present-matching, present-other, and absent requests — decision,
 reason presence, and error presence must all match the interpreter.
 
-64 policies x 3 requests; single engine reused per policy via load()
-(the swap unit), so the suite stays fast on CPU.
+128 policies (64 same-attribute + 64 cross-attribute pairs over
+resource.name) x 5 requests, each checked at engine level and — in one
+combined sweep — through the native raw-bytes lane.
 """
 
 import itertools
@@ -29,25 +30,58 @@ CONDS = {
     "ne": 'resource.subresource != "status"',
     "like": 'resource.subresource like "sta*"',
 }
+# second-attribute conditions: cross-slot pairs exercise guard insertion
+# on one access while another access's literal is in the clause
+CONDS2 = {
+    "has-name": "resource has name",
+    "eq-name": 'resource.name == "web"',
+    "ne-name": 'resource.name != "web"',
+    "like-name": 'resource.name like "w*"',
+}
 KINDS = ["when", "unless"]
 
 
-def _attrs(sub):
+def _attrs(sub, name=""):
     return Attributes(
         user=UserInfo(name="u", uid="u1", groups=("g",)),
         verb="get", namespace="default", api_version="v1",
-        resource="pods", subresource=sub, resource_request=True,
+        resource="pods", subresource=sub, name=name, resource_request=True,
     )
 
 
-REQUESTS = [_attrs("status"), _attrs("scale"), _attrs("")]
+REQUESTS = [
+    _attrs("status"), _attrs("scale"), _attrs(""),
+    _attrs("status", name="web"), _attrs("", name="api"),
+]
 ITEMS = [record_to_cedar_resource(a) for a in REQUESTS]
 
+ALL_CONDS = {**CONDS, **CONDS2}
+# same-attribute pairs (the seed-1135 bug class) + cross-attribute pairs
+# (guard insertion for one access with another slot's literal in-clause)
 PAIRS = list(
     itertools.product(
         itertools.product(KINDS, CONDS), itertools.product(KINDS, CONDS)
     )
+) + list(
+    itertools.product(
+        itertools.product(KINDS, CONDS), itertools.product(KINDS, CONDS2)
+    )
 )
+
+
+def _check_engine_vs_interpreter(src):
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "m")], warm="off")
+    stores = TieredPolicyStores([MemoryStore.from_source("m", src)])
+    tpu_res = engine.evaluate_batch(ITEMS)
+    for (em, rq), (tpu_dec, tpu_diag), attrs in zip(ITEMS, tpu_res, REQUESTS):
+        int_dec, int_diag = stores.is_authorized(em, rq)
+        ctx = (src, attrs.subresource, attrs.name)
+        assert tpu_dec == int_dec, (ctx, tpu_dec, int_dec)
+        assert bool(tpu_diag.reasons) == bool(int_diag.reasons), ctx
+        assert bool(tpu_diag.errors) == bool(int_diag.errors), (
+            ctx, tpu_diag.errors, int_diag.errors,
+        )
 
 
 @pytest.mark.parametrize(
@@ -58,20 +92,60 @@ def test_condition_pair_matches_interpreter(first, second):
     (k1, c1), (k2, c2) = first, second
     src = (
         "permit (principal, action, resource is k8s::Resource) "
-        f"{k1} {{ {CONDS[c1]} }} {k2} {{ {CONDS[c2]} }};"
+        f"{k1} {{ {ALL_CONDS[c1]} }} {k2} {{ {ALL_CONDS[c2]} }};"
     )
-    engine = TPUPolicyEngine()
-    engine.load([PolicySet.from_source(src, "m")], warm="off")
-    stores = TieredPolicyStores([MemoryStore.from_source("m", src)])
-    tpu_res = engine.evaluate_batch(ITEMS)
-    for (em, rq), (tpu_dec, tpu_diag), attrs in zip(ITEMS, tpu_res, REQUESTS):
-        int_dec, int_diag = stores.is_authorized(em, rq)
-        ctx = (src, attrs.subresource)
-        assert tpu_dec == int_dec, (ctx, tpu_dec, int_dec)
-        assert bool(tpu_diag.reasons) == bool(int_diag.reasons), ctx
-        assert bool(tpu_diag.errors) == bool(int_diag.errors), (
-            ctx, tpu_diag.errors, int_diag.errors,
+    _check_engine_vs_interpreter(src)
+
+
+def test_condition_pairs_native_lane():
+    """The same matrix through the NATIVE raw-bytes lane: one combined
+    run per pair through SARFastPath (C++ encode + device + decode) must
+    produce the interpreter's decisions. Runs the pairs in one test (the
+    encoder build per policy set is the dominant cost)."""
+    import json
+
+    from cedar_tpu.engine.fastpath import SARFastPath
+    from cedar_tpu.native import native_available
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.server.http import get_authorizer_attributes
+
+    if not native_available():
+        pytest.skip("no C++ toolchain for the native encoder")
+
+    def sar_body(attrs):
+        ra = {"verb": "get", "resource": "pods", "version": "v1",
+              "namespace": "default"}
+        if attrs.subresource:
+            ra["subresource"] = attrs.subresource
+        if attrs.name:
+            ra["name"] = attrs.name
+        return json.dumps(
+            {"apiVersion": "authorization.k8s.io/v1",
+             "kind": "SubjectAccessReview",
+             "spec": {"user": "u", "uid": "u1", "groups": ["g"],
+                      "resourceAttributes": ra}}
+        ).encode()
+
+    bodies = [sar_body(a) for a in REQUESTS]
+    sars = [json.loads(b) for b in bodies]
+    for (k1, c1), (k2, c2) in PAIRS:
+        src = (
+            "permit (principal, action, resource is k8s::Resource) "
+            f"{k1} {{ {ALL_CONDS[c1]} }} {k2} {{ {ALL_CONDS[c2]} }};"
         )
+        engine = TPUPolicyEngine()
+        engine.load([PolicySet.from_source(src, "m")], warm="off")
+        stores = TieredPolicyStores([MemoryStore.from_source("m", src)])
+        oracle = CedarWebhookAuthorizer(stores)
+        fast = SARFastPath(
+            engine, CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+        )
+        assert fast.available, src
+        results = fast.authorize_raw(bodies)
+        assert len(results) == len(bodies)
+        for sar, (dec, _r, _e) in zip(sars, results):
+            want, _ = oracle.authorize(get_authorizer_attributes(sar))
+            assert dec == want, (src, sar, dec, want)
 
 
 def test_contradictory_policy_error_stops_tier_descent():
