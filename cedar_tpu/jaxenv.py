@@ -14,6 +14,21 @@ from __future__ import annotations
 import functools
 
 
+def force_cpu() -> None:
+    """The full cpu-only setup sequence for standalone scripts (soaks,
+    probes): pin JAX_PLATFORMS + jax_platforms to cpu, default warm-up
+    off, and fail-fast every non-cpu backend factory. One shared home so
+    the outage-critical hardening cannot drift between tools."""
+    import os
+
+    os.environ.setdefault("CEDAR_TPU_WARM_DEFAULT", "off")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    disable_non_cpu_backends()
+
+
 def disable_non_cpu_backends() -> None:
     """Make non-cpu PJRT backend factories raise instead of block.
 

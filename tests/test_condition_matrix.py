@@ -74,6 +74,7 @@ def _check_engine_vs_interpreter(src):
     engine.load([PolicySet.from_source(src, "m")], warm="off")
     stores = TieredPolicyStores([MemoryStore.from_source("m", src)])
     tpu_res = engine.evaluate_batch(ITEMS)
+    assert len(tpu_res) == len(ITEMS)  # row drops must fail, not shorten
     for (em, rq), (tpu_dec, tpu_diag), attrs in zip(ITEMS, tpu_res, REQUESTS):
         int_dec, int_diag = stores.is_authorized(em, rq)
         ctx = (src, attrs.subresource, attrs.name)

@@ -1,0 +1,276 @@
+"""Directed condition-structure probes (CPU backend).
+
+The permanent suite pins the 128-pair authz condition matrix
+(tests/test_condition_matrix.py). This tool keeps the HEAVIER directed
+sweeps runnable on demand — the shapes that found the round-5 compiler
+bugs live in this neighborhood (condition pairs on optional attributes
+interacting with the hardening pass's presence guards and the
+contradiction eliminator):
+
+  sel        64 pairs mixing set-typed labelSelector conditions
+  triples    N random when/unless triples over three optional attrs
+  ornot      N random ||/&&/! condition trees (Cedar short-circuit error
+             semantics vs the DNF expansion)
+  admission  144 pairs over optional DEEP admission attributes (labels /
+             annotations / metadata.name) through the native object walk
+
+Every probe differentials decision + reason presence + error presence
+against the interpreter oracle; admission differentials full response
+documents via tests' assert_parity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import random
+import sys
+
+
+def _env():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    from cedar_tpu.jaxenv import force_cpu
+
+    force_cpu()
+    sys.path.insert(0, os.path.join(root, "tests"))
+
+
+def _check(src, items, reqs_label=""):
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.lang import PolicySet
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "m")], warm="off")
+    stores = TieredPolicyStores([MemoryStore.from_source("m", src)])
+    bad = []
+    res = engine.evaluate_batch(items)
+    # a row-dropping bug must fail the probe, not shorten the zip
+    assert len(res) == len(items), (src, len(res), len(items))
+    for (em, rq), (td, tg) in zip(items, res):
+        idec, idg = stores.is_authorized(em, rq)
+        if (
+            td != idec
+            or bool(tg.reasons) != bool(idg.reasons)
+            or bool(tg.errors) != bool(idg.errors)
+        ):
+            bad.append((src, reqs_label, td, idec, tg.errors, idg.errors))
+    return bad, engine
+
+
+def _authz_items():
+    from cedar_tpu.entities.attributes import (
+        Attributes,
+        LabelSelectorRequirement,
+        UserInfo,
+    )
+    from cedar_tpu.server.authorizer import record_to_cedar_resource
+
+    def attrs(sub, name="", ns="default", sel=None):
+        a = Attributes(
+            user=UserInfo(name="u", uid="u1", groups=("g",)),
+            verb="get", namespace=ns, api_version="v1",
+            resource="pods", subresource=sub, name=name,
+            resource_request=True,
+        )
+        if sel is not None:
+            a.label_selector = (
+                LabelSelectorRequirement(
+                    key="owner", operator="=", values=(sel,)
+                ),
+            )
+        return a
+
+    reqs = [
+        attrs("status"), attrs("scale"), attrs(""),
+        attrs("status", name="web"), attrs("", name="api"),
+        attrs("status", sel="a"), attrs("", sel="b"), attrs("", ns=""),
+    ]
+    return [record_to_cedar_resource(a) for a in reqs]
+
+
+def probe_sel() -> int:
+    CONDS = {
+        "has": "resource has subresource",
+        "eq": 'resource.subresource == "status"',
+        "has-sel": "resource has labelSelector",
+        "sel": 'resource.labelSelector.contains({key: "owner",'
+               ' operator: "=", values: ["a"]})',
+    }
+    items = _authz_items()
+    bad = 0
+    for (k1, c1), (k2, c2) in itertools.product(
+        itertools.product(("when", "unless"), CONDS), repeat=2
+    ):
+        src = (
+            "permit (principal, action, resource is k8s::Resource) "
+            f"{k1} {{ {CONDS[c1]} }} {k2} {{ {CONDS[c2]} }};"
+        )
+        mism, _ = _check(src, items)
+        for m in mism:
+            bad += 1
+            print("MISMATCH", m)
+    print(f"sel pairs done, mismatches: {bad}")
+    return bad
+
+
+def probe_triples(n: int, seed: int) -> int:
+    CONDS = [
+        "resource has subresource",
+        'resource.subresource == "status"',
+        'resource.subresource != "status"',
+        'resource.subresource like "sta*"',
+        "resource has name",
+        'resource.name == "web"',
+        'resource.name != "web"',
+        'resource.name like "w*"',
+        "resource has namespace",
+        'resource.namespace == "default"',
+    ]
+    items = _authz_items()
+    rng = random.Random(seed)
+    bad = 0
+    for _ in range(n):
+        conds = [
+            (rng.choice(["when", "unless"]), rng.choice(CONDS))
+            for _ in range(3)
+        ]
+        body = " ".join(f"{k} {{ {c} }}" for k, c in conds)
+        src = (
+            "permit (principal, action, resource is k8s::Resource) "
+            f"{body};"
+        )
+        mism, _ = _check(src, items)
+        for m in mism:
+            bad += 1
+            print("MISMATCH", m)
+    print(f"triples done, mismatches: {bad}")
+    return bad
+
+
+def probe_ornot(n: int, seed: int) -> int:
+    ATOMS = [
+        "resource has subresource",
+        'resource.subresource == "status"',
+        'resource.subresource != "status"',
+        "resource has name",
+        'resource.name == "web"',
+        'resource.name like "w*"',
+    ]
+    items = _authz_items()
+    rng = random.Random(seed)
+
+    def gen(depth):
+        if depth == 0 or rng.random() < 0.4:
+            a = rng.choice(ATOMS)
+            return f"!({a})" if rng.random() < 0.3 else a
+        op = rng.choice(["&&", "||"])
+        return f"({gen(depth - 1)} {op} {gen(depth - 1)})"
+
+    bad = fallbacks = 0
+    for _ in range(n):
+        kind = rng.choice(["when", "unless"])
+        src = (
+            "permit (principal, action, resource is k8s::Resource) "
+            f"{kind} {{ {gen(2)} }};"
+        )
+        mism, engine = _check(src, items)
+        fallbacks += engine.stats["fallback_policies"]
+        for m in mism:
+            bad += 1
+            print("MISMATCH", m)
+    print(f"ornot done, mismatches: {bad}, fallbacks: {fallbacks}/{n}")
+    return bad
+
+
+def probe_admission() -> int:
+    from cedar_tpu.native import native_available
+
+    if not native_available():
+        print("admission pairs SKIPPED: no C++ toolchain")
+        return 0
+    from test_admission_native import (  # noqa: E402
+        _build_fallback_set,
+        assert_parity,
+        review,
+    )
+
+    CONDS = {
+        "has-lab": "resource.metadata has labels",
+        "lab": "resource.metadata has labels && "
+               'resource.metadata.labels.contains({key: "env",'
+               ' value: "prod"})',
+        "has-ann": "resource.metadata has annotations",
+        "name-eq": 'resource.metadata.name == "c"',
+        "name-like": 'resource.metadata.name like "c*"',
+        "ns-eq": "resource.metadata has namespace && "
+                 'resource.metadata.namespace == "default"',
+    }
+
+    def obj(labels=None, ann=None, name="c"):
+        o = {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": "default"},
+        }
+        if labels is not None:
+            o["metadata"]["labels"] = labels
+        if ann is not None:
+            o["metadata"]["annotations"] = ann
+        return o
+
+    bodies = [
+        json.dumps(review(obj=o)).encode()
+        for o in (
+            obj(), obj(labels={"env": "prod"}), obj(labels={"env": "dev"}),
+            obj(ann={"x": "y"}), obj(labels={}, name="d"),
+        )
+    ]
+    bad = 0
+    for (k1, c1), (k2, c2) in itertools.product(
+        itertools.product(("when", "unless"), CONDS), repeat=2
+    ):
+        src = (
+            "forbid (principal, "
+            'action == k8s::admission::Action::"create", '
+            "resource is core::v1::ConfigMap) "
+            f"{k1} {{ {CONDS[c1]} }} {k2} {{ {CONDS[c2]} }};"
+        )
+        _engine, handler, fast, _stats = _build_fallback_set(src)
+        assert fast.available, src
+        try:
+            assert_parity(fast, handler, bodies)
+        except AssertionError as e:
+            bad += 1
+            print("MISMATCH", (k1, c1, k2, c2))
+            print(str(e)[:400])
+    print(f"admission pairs done, mismatches: {bad}")
+    return bad
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(prog="condition-probe")
+    parser.add_argument(
+        "--probe", default="all",
+        choices=["all", "sel", "triples", "ornot", "admission"],
+    )
+    parser.add_argument("--count", type=int, default=250)
+    parser.add_argument("--seed", type=int, default=99)
+    args = parser.parse_args()
+    _env()
+    bad = 0
+    if args.probe in ("all", "sel"):
+        bad += probe_sel()
+    if args.probe in ("all", "triples"):
+        bad += probe_triples(args.count, args.seed)
+    if args.probe in ("all", "ornot"):
+        bad += probe_ornot(args.count, args.seed)
+    if args.probe in ("all", "admission"):
+        bad += probe_admission()
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

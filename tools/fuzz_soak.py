@@ -41,22 +41,12 @@ def main() -> int:
     parser.add_argument("--requests", type=int, default=60)
     args = parser.parse_args()
 
-    os.environ.setdefault("CEDAR_TPU_WARM_DEFAULT", "off")
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    from cedar_tpu.jaxenv import force_cpu
 
-    jax.config.update("jax_platforms", "cpu")
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from cedar_tpu.jaxenv import disable_non_cpu_backends
-
-    disable_non_cpu_backends()
-    sys.path.insert(
-        0,
-        os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "tests",
-        ),
-    )
+    force_cpu()
+    sys.path.insert(0, os.path.join(root, "tests"))
     from test_fuzz_differential import (  # noqa: E402
         _gen_attributes,
         _gen_policy,
