@@ -14,6 +14,21 @@ from __future__ import annotations
 import functools
 
 
+def harden_cpu_backends() -> None:
+    """The jax-may-already-be-imported hardening step: pin jax_platforms
+    to cpu (tolerating an initialized backend) and fail-fast every
+    non-cpu backend factory. Shared by force_cpu(), __graft_entry__'s
+    entry()/dryrun, and any caller that cannot control the env before
+    jax imports."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # a backend already initialized; the factory patch still helps
+    disable_non_cpu_backends()
+
+
 def force_cpu() -> None:
     """The full cpu-only setup sequence for standalone scripts (soaks,
     probes): pin JAX_PLATFORMS + jax_platforms to cpu, default warm-up
@@ -23,10 +38,7 @@ def force_cpu() -> None:
 
     os.environ.setdefault("CEDAR_TPU_WARM_DEFAULT", "off")
     os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    disable_non_cpu_backends()
+    harden_cpu_backends()
 
 
 def disable_non_cpu_backends() -> None:

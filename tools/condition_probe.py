@@ -38,7 +38,7 @@ def _env():
     sys.path.insert(0, os.path.join(root, "tests"))
 
 
-def _check(src, items, reqs_label=""):
+def _check(src, items):
     from cedar_tpu.engine.evaluator import TPUPolicyEngine
     from cedar_tpu.lang import PolicySet
     from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
@@ -57,7 +57,7 @@ def _check(src, items, reqs_label=""):
             or bool(tg.reasons) != bool(idg.reasons)
             or bool(tg.errors) != bool(idg.errors)
         ):
-            bad.append((src, reqs_label, td, idec, tg.errors, idg.errors))
+            bad.append((src, td, idec, tg.errors, idg.errors))
     return bad, engine
 
 
