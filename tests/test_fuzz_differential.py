@@ -157,9 +157,34 @@ def _gen_policy(rng: random.Random) -> str:
     else:
         resource = "resource"
     conds = ""
-    for _ in range(rng.randint(0, 2)):
-        kw = rng.choice(["when", "unless"])
-        conds += f" {kw} {{ {_gen_condition(rng)} }}"
+    if rng.random() < 0.15:
+        # correlated same-attribute condition pair: the round-5 bug class
+        # (hardening presence guards x contradiction elimination, commits
+        # d7f75af/66b885f) — generated as a PAIR so the interaction is hit
+        # by construction, not by coincidence
+        # per-attr values drawn from _gen_attributes' request domains so
+        # the conditions are LIVE (satisfiable and refutable at runtime);
+        # an off-domain value would leave the pair differentially inert
+        attr, val = rng.choice([
+            ("subresource", "status"),
+            ("name", "alice"),
+            ("name", "app-1"),
+            ("namespace", "default"),
+            ("namespace", "ns-1"),
+        ])
+        pool = [
+            f"resource has {attr}",
+            f'resource.{attr} == "{val}"',
+            f'resource.{attr} != "{val}"',
+            f'resource.{attr} like "{val[:2]}*"',
+        ]
+        for _ in range(2):
+            kw = rng.choice(["when", "unless"])
+            conds += f" {kw} {{ {rng.choice(pool)} }}"
+    else:
+        for _ in range(rng.randint(0, 2)):
+            kw = rng.choice(["when", "unless"])
+            conds += f" {kw} {{ {_gen_condition(rng)} }}"
     return f"{effect} ({principal}, {action}, {resource}){conds};"
 
 
