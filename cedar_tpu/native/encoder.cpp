@@ -206,11 +206,16 @@ class JsonParser {
   }
 
   // Fast path: no escapes -> a view into the input buffer, zero copies.
+  // Raw control characters (< 0x20) inside strings are a parse error,
+  // like the Python lane's strict json (a decision must never depend on
+  // which lane a row takes — see utf8_valid).
   bool string(sv &out) {
     ++p_;  // opening quote
     const char *start = p_;
-    while (p_ < end_ && *p_ != '"' && *p_ != '\\') ++p_;
-    if (p_ >= end_) return false;
+    while (p_ < end_ && *p_ != '"' && *p_ != '\\' &&
+           uint8_t(*p_) >= 0x20)
+      ++p_;
+    if (p_ >= end_ || uint8_t(*p_) < 0x20) return false;
     if (*p_ == '"') {
       out = sv(start, size_t(p_ - start));
       ++p_;
@@ -259,6 +264,7 @@ class JsonParser {
           default: return false;
         }
       } else {
+        if (uint8_t(c) < 0x20) return false;  // raw control char in string
         buf.push_back(c);
         ++p_;
       }
@@ -2108,6 +2114,54 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
   }
 }
 
+// Strict UTF-8 validation (RFC 3629, including overlong/surrogate/range
+// rejection). The Python lane refuses most invalid UTF-8 (CPython's json
+// decodes bytes with errors="surrogatepass": surrogate ENCODINGS are
+// accepted there, everything else invalid raises), while this parser is
+// byte-preserving — without this gate the same bytes could EVALUATE on
+// the native lane and decode-error on the Python lane, making the
+// decision depend on which lane a row takes. This gate is deliberately a
+// superset of Python's rejection: flagged rows (including the surrogate
+// class Python would accept) re-run through the Python fallback, which
+// returns the Python lane's own verdict — parity holds either way. One
+// pass over ~250-byte bodies: negligible next to the parse. (Found by
+// the round-5 byte-mutation fuzz.)
+bool utf8_valid(const uint8_t *p, size_t n) {
+  size_t i = 0;
+  while (i < n) {
+    uint8_t b = p[i];
+    if (b < 0x80) {
+      ++i;
+      continue;
+    }
+    size_t need;
+    uint32_t cp;
+    if ((b & 0xE0) == 0xC0) {
+      need = 1;
+      cp = b & 0x1Fu;
+    } else if ((b & 0xF0) == 0xE0) {
+      need = 2;
+      cp = b & 0x0Fu;
+    } else if ((b & 0xF8) == 0xF0) {
+      need = 3;
+      cp = b & 0x07u;
+    } else {
+      return false;  // continuation byte in lead position / 0xF8+
+    }
+    if (i + need >= n) return false;  // truncated sequence
+    for (size_t k = 1; k <= need; ++k) {
+      uint8_t c = p[i + k];
+      if ((c & 0xC0) != 0x80) return false;
+      cp = (cp << 6) | (c & 0x3Fu);
+    }
+    if (need == 1 && cp < 0x80) return false;                    // overlong
+    if (need == 2 && (cp < 0x800 || (cp - 0xD800u) < 0x800u)) return false;
+    if (need == 3 && (cp < 0x10000 || cp > 0x10FFFF)) return false;
+    i += need + 1;
+  }
+  return true;
+}
+
 }  // namespace
 
 // ------------------------------------------------------------------ C API
@@ -2138,6 +2192,14 @@ void ce_encode_sar_batch(void *handle, uint64_t n, const uint8_t *buf,
       int32_t *c = codes + i * uint64_t(t.n_slots);
       ExtrasOut eo{extras + i * uint64_t(extras_cap), extras_cap};
       arena.reset();
+      if (!utf8_valid(buf + offsets[i], size_t(lens[i]))) {
+        // python-lane parity: invalid UTF-8 is a decode error, never an
+        // evaluated request (see utf8_valid)
+        for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
+        extras_count[i] = 0;
+        flags[i] = F_PARSE_ERROR;
+        continue;
+      }
       JsonParser parser((const char *)buf + offsets[i], size_t(lens[i]), arena);
       JVal *root = parser.parse();
       if (!root || root->kind != JVal::OBJ) {
@@ -2202,6 +2264,12 @@ void ce_encode_adm_batch(void *handle, uint64_t n, const uint8_t *buf,
       uid_lens[i] = 0;
       arena.reset();
       cpool.reset();
+      if (!utf8_valid(buf + offsets[i], size_t(lens[i]))) {
+        // python-lane parity: invalid UTF-8 is a decode error (utf8_valid)
+        for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
+        flags[i] = F_PARSE_ERROR;
+        continue;
+      }
       JsonParser parser((const char *)buf + offsets[i], size_t(lens[i]), arena);
       JVal *root = parser.parse();
       if (!root || root->kind != JVal::OBJ) {

@@ -707,3 +707,51 @@ def test_webhook_server_uses_fastpath():
             assert a["status"].get("denied") == b["status"].get("denied")
     finally:
         fast_server._batcher.stop()
+
+
+def test_native_rejects_invalid_utf8_and_control_chars_like_python():
+    """The C++ parser must never EVALUATE bytes the Python lane would
+    refuse — bodies with invalid UTF-8 or raw control characters inside
+    strings route to the Python lane (decode error for most classes;
+    CPython's json decodes bytes with surrogatepass, so surrogate
+    encodings fall back and EVALUATE there — parity either way). Found by
+    the round-5 byte-mutation fuzz: a decision must never depend on which
+    lane a row takes."""
+    engine = TPUPolicyEngine()
+    engine.load(_policy_tiers())
+    stores = TieredPolicyStores([MemoryStore.from_source("t0", POLICIES)])
+    authorizer = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, authorizer)
+    assert fastpath.available
+    good = json.dumps(_random_sar(random.Random(8))).encode()
+    assert b"-user" in good
+    reject = [  # python lane refuses these: decode-error parity
+        good.replace(b'"user"', b'"us\x8fer"', 1),  # invalid start byte
+        good.replace(b"-user", b"-us\xd8er", 1),    # bad continuation
+        good.replace(b"-user", b"-us\x07er", 1),    # raw control char
+        good.replace(b"-user", b"-us\ner", 1),      # raw newline in string
+        good.replace(b"-user", b"-us\xc0\xafer", 1),        # overlong
+        good.replace(b"-user", b"-us\xf5\x80\x80\x80er", 1),  # > U+10FFFF
+    ]
+    # surrogatepass class: python json ACCEPTS; the native lane must not
+    # evaluate it itself — it falls back and returns the python verdict
+    surrogate = good.replace(b"-user", b"-us\xed\xa0\x80er", 1)
+    snap = fastpath._current_snapshot()
+    _c, _e, _n, flags = snap.encoder.encode_batch(reject + [surrogate, good])
+    assert list(flags[:-1]) == [1] * (len(reject) + 1)  # all F_PARSE_ERROR
+    assert flags[-1] == 0
+    results = fastpath.authorize_raw(reject + [surrogate, good])
+    for b, got in zip(reject + [surrogate], results):
+        want = fastpath._python_fallback(b)
+        assert got[0] == want[0] and bool(got[2]) == bool(want[2]), (b, got)
+    for b, (dec, _r, err) in zip(reject, results):
+        assert dec == "no_opinion", (b, dec)
+        assert err and "failed parsing request body" in err, (b, err)
+    # the untouched body still evaluates natively (no decode error)
+    assert results[-1][2] is None
+    # ESCAPED control chars and valid multi-byte UTF-8 remain accepted
+    ok = good.replace(b"-user", b"-us\\ner", 1)
+    ok2 = good.replace(b"-user", "-usér".encode(), 1)
+    for b in (ok, ok2):
+        [(dec, _r, err)] = fastpath.authorize_raw([b])
+        assert err is None, (b, err)

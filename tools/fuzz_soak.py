@@ -35,7 +35,8 @@ import time
 def main() -> int:
     parser = argparse.ArgumentParser(prog="fuzz-soak")
     parser.add_argument("--mode", default="single",
-                        choices=["single", "multitier", "admission"])
+                        choices=["single", "multitier", "admission",
+                                 "mutate"])
     parser.add_argument("--start", type=int, default=1000)
     parser.add_argument("--count", type=int, default=100)
     parser.add_argument("--requests", type=int, default=60)
@@ -66,6 +67,66 @@ def main() -> int:
         return 2
 
     t0 = time.time()
+
+    if args.mode == "mutate":
+        # byte-mutation fuzz of the C++ parser: random corruptions of
+        # valid SAR bodies through authorize_raw must (a) never crash and
+        # (b) match the Python lane row for row — the round-5 campaign
+        # caught two parser-parity classes this way (invalid UTF-8 and
+        # raw control chars evaluated natively, decode-erroring in python)
+        rng0 = random.Random(9)
+        src = "\n".join(_gen_policy(rng0) for _ in range(20))
+        engine = TPUPolicyEngine()
+        engine.load([PolicySet.from_source(src, "mut")], warm="off")
+        stores = TieredPolicyStores([MemoryStore.from_source("mut", src)])
+        fast = SARFastPath(
+            engine, CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+        )
+        assert fast.available, "native lane unavailable"
+
+        def mutate(rng, b):
+            b = bytearray(b)
+            for _ in range(rng.randint(1, 3)):
+                if not b:
+                    break
+                k = rng.random()
+                if k < 0.3:
+                    i = rng.randrange(len(b))
+                    b[i:i] = bytes(
+                        rng.randrange(256) for _ in range(rng.randint(1, 4))
+                    )
+                elif k < 0.55:
+                    i = rng.randrange(len(b))
+                    del b[i:min(len(b), i + rng.randint(1, 6))]
+                elif k < 0.8:
+                    b[rng.randrange(len(b))] = rng.randrange(256)
+                else:
+                    del b[rng.randrange(len(b)):]
+            return bytes(b)
+
+        for seed in range(args.start, args.start + args.count):
+            rng = random.Random(seed)
+            bodies = []
+            for i in range(args.requests):
+                b = json.dumps(_sar_json(_gen_attributes(rng))).encode()
+                bodies.append(mutate(rng, b) if i % 4 else b)
+            results = fast.authorize_raw(bodies)
+            assert len(results) == len(bodies)
+            for b, got in zip(bodies, results):
+                want = fast._python_fallback(b)
+                assert got[0] == want[0] and bool(got[2]) == bool(want[2]), (
+                    f"seed={seed} body={b[:200]!r}\n"
+                    f"native={got} python={want}"
+                )
+            done = seed - args.start + 1
+            if done % 25 == 0:
+                print(f"{done} mutate seeds ok, {time.time() - t0:.0f}s",
+                      flush=True)
+        print(
+            f"SOAK PASS (mutate): {args.count} seeds ok, "
+            f"{time.time() - t0:.0f}s"
+        )
+        return 0
 
     if args.mode == "admission":
         # random AdmissionReview streams (per-seed rng) over the demo
