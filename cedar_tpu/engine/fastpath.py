@@ -849,11 +849,10 @@ class AdmissionFastPath(_RawFastPath):
         return results
 
     def _allow_on_error(self, review, e):
+        from ..entities.admission import review_request_uid
         from ..server.admission import AdmissionResponse
 
-        uid = ""
-        if isinstance(review, dict):
-            uid = (review.get("request") or {}).get("uid", "") or ""
+        uid = review_request_uid(review)
         allowed = bool(getattr(self.handler, "allow_on_error", True))
         return AdmissionResponse(
             uid=uid,

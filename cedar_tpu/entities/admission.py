@@ -75,6 +75,18 @@ IP_ADDRESS_KEYS = frozenset(
 )
 
 
+def review_request_uid(review) -> str:
+    """uid of a decoded AdmissionReview, tolerating arbitrary wire shapes
+    (non-dict review/request, non-string uid). Like the reference's typed
+    unmarshal, malformed nodes read as zero values — the allow-on-error
+    paths extract the uid AFTER a conversion crash, so this must never
+    raise itself (found by the type-flip fuzz: ``"request": 3.5`` made
+    the error path the thing that crashed)."""
+    req = review.get("request") if isinstance(review, dict) else None
+    uid = req.get("uid") if isinstance(req, dict) else ""
+    return uid if isinstance(uid, str) else ""
+
+
 @dataclass
 class GroupVersionKind:
     group: str = ""
@@ -122,10 +134,24 @@ class AdmissionRequest:
                 return json.loads(raw)
             return raw
 
+        # known-field extraction, like the reference's typed json unmarshal
+        # (unknown keys in the wire document are IGNORED, never an error —
+        # a **kwargs construction would turn them into a TypeError and an
+        # allow-on-error response; found by the mutate-adm fuzz)
+        kind_d = req.get("kind") or {}
+        res_d = req.get("resource") or {}
         return cls(
             uid=req.get("uid", ""),
-            kind=GroupVersionKind(**(req.get("kind") or {})),
-            resource=GroupVersionResource(**(req.get("resource") or {})),
+            kind=GroupVersionKind(
+                group=kind_d.get("group", ""),
+                version=kind_d.get("version", ""),
+                kind=kind_d.get("kind", ""),
+            ),
+            resource=GroupVersionResource(
+                group=res_d.get("group", ""),
+                version=res_d.get("version", ""),
+                resource=res_d.get("resource", ""),
+            ),
             sub_resource=req.get("subResource", ""),
             name=req.get("name", ""),
             namespace=req.get("namespace", ""),

@@ -1829,8 +1829,14 @@ uint8_t build_adm(const JVal *root, AdmFeatures &f, AdmCtx &c, Arena &arena) {
     return F_ADM_ERROR;
   f.uid = str_field(req, "uid");
   if (f.uid.size() > 255) return F_ADM_ERROR;  // uid passback buffer bound
+  // DEFERRED namespace skip: the decision is recorded here but only
+  // returned after the FULL review validates — the reference decodes the
+  // whole AdmissionReview into typed structs before Handle()'s namespace
+  // check runs, so a malformed review in a skipped namespace must answer
+  // through the conversion-error path (python allow-on-error), not the
+  // skip. (Found by the type-flip fuzz: "userInfo": 7 in kube-system.)
   sv ns = str_field(req, "namespace");
-  if (ns == kSkipNs1 || ns == kSkipNs2) return F_ADM_NS_SKIP;
+  const bool ns_skip = (ns == kSkipNs1 || ns == kSkipNs2);
   f.op = str_field(req, "operation");
   if (f.op == "CREATE") f.action_id = "create";
   else if (f.op == "UPDATE") f.action_id = "update";
@@ -1970,6 +1976,16 @@ uint8_t build_adm(const JVal *root, AdmFeatures &f, AdmCtx &c, Arena &arena) {
   const JVal *obj = load_obj("object");
   const JVal *oldo = load_obj("oldObject");
   if (obj_bad) return F_ADM_ERROR;
+  if (ns_skip) {
+    // deferred namespace skip fires HERE: everything above mirrors the
+    // decode surface whose failures the Python lane answers with
+    // allow-on-error (typed fields, nested JSON-string payloads); the
+    // entity build below is handler-stage work the Python handler only
+    // runs AFTER its own namespace check, and its failure modes
+    // ("unstructured data is nil", unsupported walks) do not apply to
+    // skipped rows
+    return F_ADM_NS_SKIP;
+  }
   const JVal *main_obj = (f.op == "DELETE") ? oldo : obj;
   if (!main_obj || main_obj->kind != JVal::OBJ)
     return F_ADM_ERROR;  // "unstructured data is nil" / non-object payload

@@ -347,9 +347,9 @@ class WebhookServer:
             # allowOnError=true): a conversion/evaluation crash must not
             # block the cluster's write path
             log.exception("admit failed")
-            uid = ""
-            if isinstance(review, dict):
-                uid = (review.get("request") or {}).get("uid", "") or ""
+            from ..entities.admission import review_request_uid
+
+            uid = review_request_uid(review)
             allowed = bool(
                 getattr(self.admission_handler, "allow_on_error", True)
             )

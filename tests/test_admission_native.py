@@ -155,13 +155,17 @@ def _oracle(handler, body: bytes) -> dict:
         review_doc = json.loads(body)
         req = AdmissionRequest.from_admission_review(review_doc)
         return handler.handle(req).to_admission_review()
-    except (ValueError, TypeError, RecursionError) as e:
+    # broad like WebhookServer.handle_admit's allow-on-error catch: any
+    # conversion crash on arbitrary wire shapes answers, never raises
+    except Exception as e:  # noqa: BLE001
         if review_doc is None:
             return AdmissionResponse(
                 uid="", allowed=False, code=400,
                 error=f"failed parsing body: {e}",
             ).to_admission_review()
-        uid = (review_doc.get("request") or {}).get("uid", "") or ""
+        from cedar_tpu.entities.admission import review_request_uid
+
+        uid = review_request_uid(review_doc)
         return AdmissionResponse(
             uid=uid, allowed=True, code=200,
             error=f"evaluation error (allowed on error): {e}",
@@ -871,3 +875,48 @@ forbid (principal, action == k8s::admission::Action::"create",
     [r1, r2] = fast.handle_raw([body_sa, body_prod])
     assert r1.allowed  # join policy gone
     assert not r2.allowed  # prod label now forbidden, fully native
+
+
+def test_malformed_request_nodes_never_crash_the_error_path():
+    """Type-flipped wire shapes ("request": 3.5, non-dict userInfo,
+    non-string uid) must answer through the allow-on-error path, not
+    crash it — the type-flip fuzz found _allow_on_error itself raising
+    on a non-dict request node, killing the whole batch."""
+    engine, handler, fast = _build()
+    assert fast.available
+    base = review(obj=obj_cm())
+    flipped = []
+    for mutate in (
+        lambda d: d.__setitem__("request", 3.5),
+        lambda d: d.__setitem__("request", "x"),
+        lambda d: d["request"].__setitem__("userInfo", 7),
+        lambda d: d["request"].__setitem__("uid", ["u"]),
+        lambda d: d["request"].__setitem__("kind", "ConfigMap"),
+        lambda d: d["request"].__setitem__("resource", ["configmaps"]),
+    ):
+        d = json.loads(json.dumps(base))
+        mutate(d)
+        flipped.append(json.dumps(d).encode())
+    results = fast.handle_raw(flipped)
+    assert len(results) == len(flipped)
+    for b, got in zip(flipped, results):
+        assert _oracle(handler, b) == got.to_admission_review(), b[:200]
+
+
+def test_ns_skip_defers_to_conversion_errors():
+    """A malformed review in a skipped namespace answers through the
+    conversion-error path, not the namespace skip: the reference decodes
+    the full AdmissionReview into typed structs BEFORE Handle()'s
+    namespace check (type-flip fuzz, seed 700: "userInfo": 7 in
+    kube-system returned a clean skip on the native lane while the
+    Python lane answered allow-on-error)."""
+    engine, handler, fast = _build()
+    assert fast.available
+    good = review(ns="kube-system", obj=obj_cm(ns="kube-system"))
+    bad = json.loads(json.dumps(good))
+    bad["request"]["userInfo"] = 7
+    bodies = [json.dumps(good).encode(), json.dumps(bad).encode()]
+    assert_parity(fast, handler, bodies)
+    got = fast.handle_raw(bodies)
+    assert got[0].allowed and got[0].error is None  # clean skip
+    assert got[1].allowed and "evaluation error" in (got[1].error or "")

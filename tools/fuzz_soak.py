@@ -8,8 +8,9 @@ attribute packed an unsatisfiable clause as a firing rule — commit
 d7f75af), so keep soaking new ranges each round.
 
 Usage:
-  python tools/fuzz_soak.py [--mode single|multitier|admission]
-                            [--start N] [--count N] [--requests N]
+  python tools/fuzz_soak.py
+      [--mode single|multitier|admission|mutate|mutate-adm]
+      [--start N] [--count N] [--requests N]
 
 Modes single/multitier drive tests/test_fuzz_differential.py's policy +
 SAR generators (random policy sets per seed); mode admission drives
@@ -32,11 +33,62 @@ import sys
 import time
 
 
+_FLIPS = (7, "x", ["x"], {"k": "v"}, None, True, 3.5, [], {})
+
+
+def _flip_nodes(rng, doc):
+    """Structured mutation: randomly replace JSON nodes with other-typed
+    values — the class byte mutation rarely produces (e.g. "request": 3.5,
+    "groups": 7), which found the allow-on-error crash in round 5."""
+    import copy
+
+    doc = copy.deepcopy(doc)
+
+    def walk(node):
+        if isinstance(node, dict):
+            for k in list(node.keys()):
+                if rng.random() < 0.06:
+                    node[k] = rng.choice(_FLIPS)
+                else:
+                    walk(node[k])
+        elif isinstance(node, list):
+            for i in range(len(node)):
+                if rng.random() < 0.06:
+                    node[i] = rng.choice(_FLIPS)
+                else:
+                    walk(node[i])
+
+    walk(doc)
+    return doc
+
+
+def _mutate_bytes(rng, b):
+    """Random byte-level corruption: splice, delete, overwrite, truncate."""
+    b = bytearray(b)
+    for _ in range(rng.randint(1, 3)):
+        if not b:
+            break
+        k = rng.random()
+        if k < 0.3:
+            i = rng.randrange(len(b))
+            b[i:i] = bytes(
+                rng.randrange(256) for _ in range(rng.randint(1, 4))
+            )
+        elif k < 0.55:
+            i = rng.randrange(len(b))
+            del b[i:min(len(b), i + rng.randint(1, 6))]
+        elif k < 0.8:
+            b[rng.randrange(len(b))] = rng.randrange(256)
+        else:
+            del b[rng.randrange(len(b)):]
+    return bytes(b)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(prog="fuzz-soak")
     parser.add_argument("--mode", default="single",
                         choices=["single", "multitier", "admission",
-                                 "mutate"])
+                                 "mutate", "mutate-adm"])
     parser.add_argument("--start", type=int, default=1000)
     parser.add_argument("--count", type=int, default=100)
     parser.add_argument("--requests", type=int, default=60)
@@ -83,26 +135,7 @@ def main() -> int:
             engine, CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
         )
         assert fast.available, "native lane unavailable"
-
-        def mutate(rng, b):
-            b = bytearray(b)
-            for _ in range(rng.randint(1, 3)):
-                if not b:
-                    break
-                k = rng.random()
-                if k < 0.3:
-                    i = rng.randrange(len(b))
-                    b[i:i] = bytes(
-                        rng.randrange(256) for _ in range(rng.randint(1, 4))
-                    )
-                elif k < 0.55:
-                    i = rng.randrange(len(b))
-                    del b[i:min(len(b), i + rng.randint(1, 6))]
-                elif k < 0.8:
-                    b[rng.randrange(len(b))] = rng.randrange(256)
-                else:
-                    del b[rng.randrange(len(b)):]
-            return bytes(b)
+        mutate = _mutate_bytes
 
         for seed in range(args.start, args.start + args.count):
             rng = random.Random(seed)
@@ -124,6 +157,53 @@ def main() -> int:
                       flush=True)
         print(
             f"SOAK PASS (mutate): {args.count} seeds ok, "
+            f"{time.time() - t0:.0f}s"
+        )
+        return 0
+
+    if args.mode == "mutate-adm":
+        # admission twin of mutate: corrupted AdmissionReview bodies
+        # (byte mutations AND structured type-flips) through the C++
+        # object walk must match the Python handler path on the FULL
+        # response document
+        from test_admission_native import (  # noqa: E402
+            _build,
+            _oracle,
+            gen_admission_bodies,
+        )
+
+        _engine, handler, fast = _build()
+        assert fast.available, "native admission lane unavailable"
+        for seed in range(args.start, args.start + args.count):
+            rng = random.Random(seed)
+            bodies = []
+            for i, b in enumerate(
+                gen_admission_bodies(rng, args.requests)
+            ):
+                if i % 4 == 1:
+                    b = _mutate_bytes(rng, b)
+                elif i % 4 == 2:
+                    b = json.dumps(
+                        _flip_nodes(rng, json.loads(b))
+                    ).encode()
+                bodies.append(b)
+            results = fast.handle_raw(bodies)
+            assert len(results) == len(bodies)
+            for b, got in zip(bodies, results):
+                want = _oracle(handler, b)
+                g = got.to_admission_review()
+                assert g == want, (
+                    f"seed={seed} body={b[:200]!r}\n"
+                    f"native={g}\npython={want}"
+                )
+            done = seed - args.start + 1
+            if done % 25 == 0:
+                print(
+                    f"{done} mutate-adm seeds ok, {time.time() - t0:.0f}s",
+                    flush=True,
+                )
+        print(
+            f"SOAK PASS (mutate-adm): {args.count} seeds ok, "
             f"{time.time() - t0:.0f}s"
         )
         return 0
