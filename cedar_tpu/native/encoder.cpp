@@ -755,21 +755,101 @@ void dedupe_children(const JVal *obj, std::vector<const JVal *> &out);
 
 // Build all request features from the parsed SAR. Returns a gate flag or
 // F_OK. Mirrors get_authorizer_attributes + record_to_cedar_resource.
+// python-truthiness helpers for the SAR extraction. The Python lane skips
+// FALSY optional blocks ("if ra:"), crashes on truthy wrong-typed ones
+// (answering evaluation-error through its broad catch), and only ever
+// proceeds with objects/strings of the expected type. Rows this lane
+// flags re-run through the Python fallback, whose answer IS the oracle —
+// over-flagging is parity-safe, silent coercion is not (the round-5
+// type-flip fuzz found this lane evaluating wire shapes the Python lane
+// refuses).
+bool node_falsy(const JVal *v) {
+  switch (v->kind) {
+    case JVal::NUL: return true;
+    case JVal::BOOL: return !v->b;
+    case JVal::STR: return v->str.empty();
+    case JVal::ARR:
+    case JVal::OBJ: return v->child == nullptr;
+    case JVal::NUM: return false;  // "0" is falsy in python; flagging the
+      // rare numeric node routes to the fallback instead of raw-text
+      // zero detection
+  }
+  return false;
+}
+
+// "if block:" gate: OBJ with children passes; falsy skips (nullptr);
+// anything else marks bad (python would crash on attribute access)
+const JVal *truthy_obj(const JVal *v, bool &bad) {
+  if (!v) return nullptr;
+  if (v->kind == JVal::OBJ && v->child) return v;
+  if (node_falsy(v)) return nullptr;
+  bad = true;
+  return nullptr;
+}
+
+// field absent or a string; present-but-not-a-string routes the row to
+// the Python fallback (shared by the SAR and admission lanes)
+bool str_if_present(const JVal *o, sv k) {
+  const JVal *v = o ? o->get(k) : nullptr;
+  return !v || v->kind == JVal::STR;
+}
+
+// selector SHAPE validation, shared by every resourceAttributes row:
+// python parses label/field selectors inside "if ra:" BEFORE any verb
+// branching, so even rows whose entity build ignores selectors (e.g.
+// impersonation) crash python on flipped selector shapes — those rows
+// must flag to the fallback here too
+bool sar_selectors_ok(const JVal *ra) {
+  for (sv sel_key : {sv("labelSelector"), sv("fieldSelector")}) {
+    bool bad = false;
+    const JVal *sel = truthy_obj(ra->get(sel_key), bad);
+    if (bad) return false;
+    const JVal *reqs = sel ? sel->get("requirements") : nullptr;
+    if (!reqs) continue;
+    if (reqs->kind != JVal::ARR) {
+      if (!node_falsy(reqs)) return false;
+      continue;
+    }
+    for (const JVal *rq = reqs->child; rq; rq = rq->next) {
+      if (rq->kind != JVal::OBJ) return false;  // req.get crashes
+      if (!str_if_present(rq, "operator") || !str_if_present(rq, "key"))
+        return false;
+      const JVal *vv = rq->get("values");
+      if (!vv) continue;
+      if (vv->kind != JVal::ARR) {
+        if (!node_falsy(vv)) return false;
+        continue;
+      }
+      for (const JVal *v = vv->child; v; v = v->next)
+        if (v->kind != JVal::STR) return false;
+    }
+  }
+  return true;
+}
+
 uint8_t build_features(const JVal *root, Features &f) {
-  const JVal *spec = root->get("spec");
-  if (spec && spec->kind != JVal::OBJ) spec = nullptr;
+  bool bad = false;
+  const JVal *spec = truthy_obj(root->get("spec"), bad);
+  if (bad) return F_PARSE_ERROR;  // truthy non-object: python crashes
+  if (!str_if_present(spec, "user") || !str_if_present(spec, "uid"))
+    return F_PARSE_ERROR;
 
   sv user_name = str_field(spec, "user");
   sv user_uid = str_field(spec, "uid");
 
-  const JVal *ra = spec ? spec->get("resourceAttributes") : nullptr;
-  if (ra && ra->kind != JVal::OBJ) ra = nullptr;
-  const JVal *nra = spec ? spec->get("nonResourceAttributes") : nullptr;
-  if (nra && nra->kind != JVal::OBJ) nra = nullptr;
+  const JVal *ra =
+      truthy_obj(spec ? spec->get("resourceAttributes") : nullptr, bad);
+  const JVal *nra =
+      truthy_obj(spec ? spec->get("nonResourceAttributes") : nullptr, bad);
+  if (bad) return F_PARSE_ERROR;
 
   sv verb, ns, group, version, resource, subresource, name, path;
   bool resource_request = false;
   if (ra) {
+    for (const char *k : {"verb", "namespace", "group", "version",
+                          "resource", "subresource", "name"})
+      if (!str_if_present(ra, k)) return F_PARSE_ERROR;
+    if (!sar_selectors_ok(ra)) return F_PARSE_ERROR;
     verb = str_field(ra, "verb");
     ns = str_field(ra, "namespace");
     group = str_field(ra, "group");
@@ -780,6 +860,8 @@ uint8_t build_features(const JVal *root, Features &f) {
     resource_request = true;
   }
   if (nra) {  // nonResourceAttributes wins last, like the Python builder
+    if (!str_if_present(nra, "path") || !str_if_present(nra, "verb"))
+      return F_PARSE_ERROR;
     path = str_field(nra, "path");
     verb = str_field(nra, "verb");
     resource_request = false;
@@ -815,11 +897,24 @@ uint8_t build_features(const JVal *root, Features &f) {
   f.p_id = user_uid.empty() ? user_name : user_uid;
 
   const JVal *groups = spec ? spec->get("groups") : nullptr;
-  if (groups && groups->kind == JVal::ARR)
-    for (const JVal *g = groups->child; g; g = g->next)
-      if (g->kind == JVal::STR) f.groups.push_back(g->str);
+  if (groups) {
+    if (groups->kind == JVal::ARR) {
+      // python keeps every element; a non-string member crashes it
+      // downstream — flag instead of silently dropping
+      for (const JVal *g = groups->child; g; g = g->next) {
+        if (g->kind != JVal::STR) return F_PARSE_ERROR;
+        f.groups.push_back(g->str);
+      }
+    } else if (!node_falsy(groups)) {
+      // python: tuple() of a non-iterable crashes; of a string tolerates
+      // (character groups) — both classes answer via the fallback
+      return F_PARSE_ERROR;
+    }
+  }
 
   const JVal *extra = spec ? spec->get("extra") : nullptr;
+  if (extra && extra->kind != JVal::OBJ && !node_falsy(extra))
+    return F_PARSE_ERROR;  // python: (extra).items() crashes
   if (extra && extra->kind == JVal::OBJ && extra->child) {
     f.has_extra = true;
     // json.loads dedupes raw keys (dict: first position, last value), then
@@ -847,13 +942,19 @@ uint8_t build_features(const JVal *root, Features &f) {
     for (auto &e : lkids) {
       const JVal *kv = e.second;
       std::vector<std::string> vals;
-      if (kv->kind == JVal::ARR)
-        for (const JVal *v = kv->child; v; v = v->next)
-          if (v->kind == JVal::STR) {
-            std::string c;
-            canon_str_into(c, v->str);
-            vals.push_back(std::move(c));
-          }
+      if (kv->kind == JVal::ARR) {
+        for (const JVal *v = kv->child; v; v = v->next) {
+          // python: tuple(v) keeps every element; non-strings crash the
+          // canon downstream — flag instead of silently dropping
+          if (v->kind != JVal::STR) return F_PARSE_ERROR;
+          std::string c;
+          canon_str_into(c, v->str);
+          vals.push_back(std::move(c));
+        }
+      } else {
+        // python: tuple() of a non-list crashes or chars-splits a string
+        return F_PARSE_ERROR;
+      }
       std::string kc, vset;
       canon_str_into(kc, e.first);
       canon_set_into(vset, vals);
@@ -932,7 +1033,9 @@ uint8_t build_features(const JVal *root, Features &f) {
     if (!subresource.empty()) f.r_attrs.emplace_back("subresource", subresource);
     if (!ns.empty()) f.r_attrs.emplace_back("namespace", ns);
 
-    // selectors (server.go:221-309)
+    // selectors (server.go:221-309); shapes are already gated by
+    // sar_selectors_ok above — tolerant reads here cannot be reached
+    // with python-crashing values
     const JVal *ls = ra->get("labelSelector");
     const JVal *reqs =
         ls && ls->kind == JVal::OBJ ? ls->get("requirements") : nullptr;
@@ -1799,17 +1902,11 @@ struct AdmFeatures {
   }
 };
 
-// present-but-not-a-string: python's dataclass kwargs accept the value and
-// a later string operation raises (caught into the allow-on-error
-// response) — the native path can't reproduce those, so it flags the row
-bool str_if_present(const JVal *o, sv k) {
-  const JVal *v = o ? o->get(k) : nullptr;
-  return !v || v->kind == JVal::STR;
-}
-
-// request.kind / request.resource must be exactly the GroupVersion{Kind,
-// Resource} shape: python constructs the dataclass with **dict, so an
-// extra key or non-string value raises TypeError server-side
+// request.kind / request.resource: python's known-field extraction
+// ignores unknown keys and tolerates odd values (entities/admission.py
+// from_admission_review), so this strict shape check is DELIBERATELY a
+// superset — the rare flagged row answers through the Python fallback,
+// which is the oracle; strictness here costs fallback speed, never parity
 bool gv_shape_ok(const JVal *o, sv third_key) {
   if (!o || o->kind == JVal::NUL) return true;  // `or {}` -> defaults
   if (o->kind != JVal::OBJ) return false;

@@ -755,3 +755,52 @@ def test_native_rejects_invalid_utf8_and_control_chars_like_python():
     for b in (ok, ok2):
         [(dec, _r, err)] = fastpath.authorize_raw([b])
         assert err is None, (b, err)
+
+
+def test_sar_type_flipped_shapes_match_python_lane():
+    """Type-flipped SAR wire shapes must never EVALUATE on the native lane
+    when the Python lane refuses them (round-5 type-flip fuzz): truthy
+    non-object blocks, wrong-typed strings, non-list groups, flipped
+    selector shapes (which python parses BEFORE any verb branching — even
+    on impersonate rows), and the python-falsy empty resourceAttributes
+    block, which must leave resource_request False on both lanes."""
+    engine = TPUPolicyEngine()
+    engine.load(_policy_tiers())
+    stores = TieredPolicyStores([MemoryStore.from_source("t0", POLICIES)])
+    authorizer = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    fastpath = SARFastPath(engine, authorizer)
+    assert fastpath.available
+    base = _random_sar(random.Random(4))
+
+    def variant(mutate):
+        doc = json.loads(json.dumps(base))
+        mutate(doc)
+        return json.dumps(doc).encode()
+
+    cases = [
+        variant(lambda d: d.__setitem__("spec", 7)),
+        variant(lambda d: d["spec"].__setitem__("user", ["u"])),
+        variant(lambda d: d["spec"].__setitem__("groups", 3.5)),
+        variant(lambda d: d["spec"].__setitem__("groups", [7])),
+        variant(lambda d: d["spec"].__setitem__("resourceAttributes", "x")),
+        variant(lambda d: d["spec"].__setitem__("resourceAttributes", {})),
+        variant(lambda d: d["spec"]["resourceAttributes"].__setitem__(
+            "verb", {"k": "v"})),
+        variant(lambda d: d["spec"]["resourceAttributes"].__setitem__(
+            "labelSelector", True)),
+        variant(lambda d: d["spec"]["resourceAttributes"].__setitem__(
+            "labelSelector", {"requirements": [7]})),
+        variant(lambda d: (
+            d["spec"]["resourceAttributes"].__setitem__("verb", "impersonate"),
+            d["spec"]["resourceAttributes"].__setitem__(
+                "fieldSelector", {"requirements": True}),
+        )),
+        variant(lambda d: d["spec"].__setitem__("extra", {"k": "ab"})),
+    ]
+    results = fastpath.authorize_raw(cases)
+    assert len(results) == len(cases)
+    for b, got in zip(cases, results):
+        want = fastpath._python_fallback(b)
+        assert got[0] == want[0] and bool(got[2]) == bool(want[2]), (
+            b, got, want,
+        )

@@ -141,8 +141,13 @@ def main() -> int:
             rng = random.Random(seed)
             bodies = []
             for i in range(args.requests):
-                b = json.dumps(_sar_json(_gen_attributes(rng))).encode()
-                bodies.append(mutate(rng, b) if i % 4 else b)
+                doc = _sar_json(_gen_attributes(rng))
+                b = json.dumps(doc).encode()
+                if i % 4 == 1:
+                    b = mutate(rng, b)
+                elif i % 4 == 2:
+                    b = json.dumps(_flip_nodes(rng, doc)).encode()
+                bodies.append(b)
             results = fast.authorize_raw(bodies)
             assert len(results) == len(bodies)
             for b, got in zip(bodies, results):
