@@ -100,6 +100,18 @@ class Arena {
   size_t used_ = 0, n_owned_ = 0;
 };
 
+// bytes that continue the in-string fast scan (not quote, not backslash,
+// not a raw control char — see JsonParser::string); constexpr so the
+// per-byte hot loop carries no init guard
+struct PlainTable {
+  bool t[256] = {};
+  constexpr PlainTable() {
+    for (int c = 0; c < 256; ++c)
+      t[c] = c >= 0x20 && c != '"' && c != '\\';
+  }
+};
+constexpr PlainTable kPlain{};
+
 class JsonParser {
  public:
   JsonParser(const char *p, size_t n, Arena &arena)
@@ -209,17 +221,8 @@ class JsonParser {
   // Raw control characters (< 0x20) inside strings are a parse error,
   // like the Python lane's strict json (a decision must never depend on
   // which lane a row takes — see utf8_valid). The scan stops on quote,
-  // backslash, or control char via one table load per byte; the table is
-  // constexpr (zero init guards on the per-byte hot path).
-  struct PlainTable {
-    bool t[256] = {};
-    constexpr PlainTable() {
-      for (int c = 0; c < 256; ++c)
-        t[c] = c >= 0x20 && c != '"' && c != '\\';
-    }
-  };
-  static constexpr PlainTable kPlain{};
-
+  // backslash, or control char via one load per byte from the constexpr
+  // kPlain table (defined at namespace scope; zero init guards here).
   bool string(sv &out) {
     ++p_;  // opening quote
     const char *start = p_;
