@@ -209,6 +209,60 @@ def test_encoder_parity_randomized():
         assert native_extras == set(py_extras), f"extras mismatch for {sar}"
 
 
+def test_encode_thread_count_invariance():
+    """The in-library thread pool (ce_encode_sar_batch's n_threads) must be
+    a pure throughput knob: any thread count yields byte-identical outputs.
+    This is the mechanism behind bench.py's attached-host projection, which
+    divides the encode stage by (cores-1)."""
+    engine = TPUPolicyEngine()
+    engine.load(_policy_tiers())
+    encoder = NativeEncoder.create(engine._compiled.packed)
+    assert encoder is not None
+
+    rng = random.Random(11)
+    bodies = [json.dumps(_random_sar(rng)).encode() for _ in range(500)]
+    base = encoder.encode_batch(bodies, n_threads=1)
+    for nt in (2, 4, 8, 16):
+        got = encoder.encode_batch(bodies, n_threads=nt)
+        for a, b in zip(base, got):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_encode_concurrent_callers_share_table():
+    """Concurrent Python threads encoding through ONE loaded table (the
+    serving topology: ctypes drops the GIL for the C call) must each get
+    the serial answer — the table is read-only at encode time."""
+    import threading
+
+    engine = TPUPolicyEngine()
+    engine.load(_policy_tiers())
+    encoder = NativeEncoder.create(engine._compiled.packed)
+    assert encoder is not None
+
+    rng = random.Random(12)
+    batches = [
+        [json.dumps(_random_sar(rng)).encode() for _ in range(120)]
+        for _ in range(8)
+    ]
+    want = [encoder.encode_batch(b, n_threads=1) for b in batches]
+    got: list = [None] * len(batches)
+
+    def worker(i):
+        got[i] = encoder.encode_batch(batches[i], n_threads=2)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(len(batches))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for w, g in zip(want, got):
+        assert g is not None
+        for a, b in zip(w, g):
+            np.testing.assert_array_equal(a, b)
+
+
 def test_fastpath_decision_parity():
     engine = TPUPolicyEngine()
     engine.load(_policy_tiers())
