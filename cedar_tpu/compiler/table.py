@@ -75,6 +75,44 @@ class FeatureTable:
         # per-request code transfer a bucket early
         return np.int16 if self.n_rows_real <= 32767 else np.int32
 
+    def slot_row_ranges(self) -> List[Tuple[int, int]]:
+        """Per-slot (lo, hi) over the NONZERO row indices the encoder can
+        ever emit for that slot (code 0 = missing/no-policy-references is
+        always additionally possible). (0, 0) marks a slot that only ever
+        carries code 0. Vocab rows are assigned per slot in contiguous
+        construction phases (build_table), so hi - lo stays small for most
+        slots — the basis of the u8 wire format (engine._CompiledSet.wire):
+        a slot whose span fits 255 ships one byte per request instead of
+        two, with the device re-basing via `code + lo - 1`."""
+        ranges = [(0, 0)] * self.n_slots
+
+        def _feed(s: int, row: int) -> None:
+            if row == 0:
+                return
+            lo, hi = ranges[s]
+            ranges[s] = (row if lo == 0 else min(lo, row), max(hi, row))
+
+        for (var, _t), row in self.type_vocab.items():
+            s = self.var_type_slot.get(var)
+            if s is not None:
+                _feed(s, row)
+        for (var, _t, _i), row in self.uid_vocab.items():
+            s = self.var_uid_slot.get(var)
+            if s is not None:
+                _feed(s, row)
+        for (var, _t, _i), row in self.anc_vocab.items():
+            # every ancestor slot of `var` can carry any ancestor row
+            for s in self.anc_slots.get(var, ()):
+                _feed(s, row)
+        for slot, vocab in self.scalar_vocab.items():
+            s = self.scalar_slot_of.get(slot)
+            if s is None:
+                continue
+            for row in vocab.values():
+                _feed(s, row)
+            _feed(s, self.present_row.get(slot, 0))
+        return ranges
+
 
 class _RowBuilder:
     def __init__(self, n_lits: int):

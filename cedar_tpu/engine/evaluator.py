@@ -71,6 +71,7 @@ from ..ops.match import (
     match_rules_codes,
     match_rules_codes_bits,
     match_rules_codes_pallas,
+    match_rules_codes_wire,
 )
 
 _BATCH_BUCKETS = (1, 8, 32, 128, 512, 1024, 2048, 4096, 8192, 16384, 32768)
@@ -123,6 +124,16 @@ class _CompiledSet:
         self.active_dtype = np.int16 if packed.L < 32767 else np.int32
         self.code_dtype = packed.table.code_dtype
         self.pallas_args = None
+        # u8 wire plan (set below for the single-device XLA plane): slots
+        # whose nonzero row span fits 255 ship ONE byte per request, re-based
+        # on device (ops/match.py match_rules_codes_wire). The h2d link is
+        # the serving path's co-dominant cost on a degraded tunnel (r05
+        # outage log: 13-17 MB/s), so halving code bytes is a direct
+        # throughput win. CEDAR_TPU_WIRE_U8=0 restores the flat layout.
+        self.wire = None
+        self.lo8_dev = None
+        self._wire_pad8 = 0
+        self._wire_padw = 0
         # int8 scoring plane (default): W ships as int8 with int32
         # accumulation — exact (entries are +/-1, sums << 2^24) and 2x bf16
         # MXU peak on TPU; CEDAR_TPU_INT8=0 restores the bf16 plane
@@ -168,6 +179,62 @@ class _CompiledSet:
         self.rule_group_dev = jax.device_put(group_c, **kwargs)
         self.rule_policy_dev = jax.device_put(policy_c, **kwargs)
         self.act_rows_dev = jax.device_put(packed.table.rows, **kwargs)
+        if os.environ.get("CEDAR_TPU_WIRE_U8", "1") != "0":
+            ranges = packed.table.slot_row_ranges()
+            idx8 = [
+                s
+                for s, (lo, hi) in enumerate(ranges)
+                if hi - max(lo, 1) + 1 <= 255
+            ]
+            if idx8:
+                in8 = set(idx8)
+                idx16 = [
+                    s for s in range(packed.table.n_slots) if s not in in8
+                ]
+                lo8 = np.array(
+                    [max(ranges[s][0], 1) for s in idx8], np.int32
+                )
+                # lane widths bucket to multiples of 4 (zero-padded
+                # columns; code 0 gathers the all-zero row, so padding
+                # activates nothing): a reload that nudges one slot's
+                # span across 255 then usually keeps both jitted input
+                # shapes — preserving the retrace-free hot-swap property
+                # the table's own row bucketing exists for — and unrelated
+                # same-sized sets share more of the jit cache
+                self._wire_pad8 = -len(idx8) % 4
+                self._wire_padw = -len(idx16) % 4 if idx16 else 0
+                self.wire = (
+                    np.array(idx8, np.intp),
+                    np.array(idx16, np.intp),
+                    lo8,
+                )
+                self.lo8_dev = jax.device_put(
+                    np.concatenate(
+                        [lo8, np.ones(self._wire_pad8, np.int32)]
+                    ),
+                    **kwargs,
+                )
+
+    def pack_wire(self, codes):
+        """Split + re-base a [B, n_slots] code array into the u8 wire
+        layout (codes8 u8, codes_w code_dtype) exactly as the device
+        kernel expects it — the ONE definition of the wire transform,
+        shared by the serving path (match_arrays_launch) and the bench so
+        the two can never drift."""
+        idx8, idx16, lo8 = self.wire
+        B = codes.shape[0]
+        c8 = codes[:, idx8]
+        c8 = np.where(c8 == 0, 0, c8 - lo8 + 1).astype(np.uint8)
+        if self._wire_pad8:
+            c8 = np.concatenate(
+                [c8, np.zeros((B, self._wire_pad8), np.uint8)], axis=1
+            )
+        cw = np.ascontiguousarray(codes[:, idx16])
+        if self._wire_padw:
+            cw = np.concatenate(
+                [cw, np.zeros((B, self._wire_padw), cw.dtype)], axis=1
+            )
+        return c8, cw
         # optional pallas layout: unchunked [L, R] W + [1, R] rule tensors
         # for the fused match kernel (ops/pallas_match.py)
         if use_pallas:
@@ -642,11 +709,19 @@ class TPUPolicyEngine:
                         packed.has_gate,
                     )
                     return w, f, None
-            out = match_rules_codes(
-                chunk_c, chunk_e, *args, packed.n_tiers, want_full,
-                want_bits, np.int32(m) if want_bits else None,
-                packed.has_gate,
-            )
+            if cs.wire is not None:
+                c8, cw = cs.pack_wire(chunk_c)
+                out = match_rules_codes_wire(
+                    c8, cw, cs.lo8_dev, chunk_e, *args,
+                    packed.n_tiers, want_full, want_bits,
+                    np.int32(m) if want_bits else None, packed.has_gate,
+                )
+            else:
+                out = match_rules_codes(
+                    chunk_c, chunk_e, *args, packed.n_tiers, want_full,
+                    want_bits, np.int32(m) if want_bits else None,
+                    packed.has_gate,
+                )
             return out if want_bits else (*out, None)
 
         def trim_full(f, m):

@@ -316,8 +316,21 @@ def match_rules_codes(
     has_gate: the packed set carries fallback-scope gate rules in group
     n_tiers * 3; rows with a gate hit get WORD_GATE set in their word (and
     an extra trailing column in the want_full matrices)."""
-    n_groups = n_tiers * _GPT + (1 if has_gate else 0)
     lit = _lit_matrix_codes(codes, extras, act_rows, _lit_dtype(W_chunks.dtype))
+    return _match_from_lit(
+        lit, W_chunks, thresh_c, group_c, policy_c, n_tiers,
+        want_full, want_bits, n_valid, has_gate,
+    )
+
+
+def _match_from_lit(
+    lit, W_chunks, thresh_c, group_c, policy_c, n_tiers: int,
+    want_full: bool, want_bits: bool, n_valid, has_gate: bool,
+):
+    """Shared post-literal-expansion body of match_rules_codes and its wire
+    variant: scores + first-match scan + tier walk + gate bit + (optional)
+    flagged-row bits compaction."""
+    n_groups = n_tiers * _GPT + (1 if has_gate else 0)
     first, last, bits = _first_match(
         lit, W_chunks, thresh_c, group_c, policy_c, n_groups,
         want_bits=want_bits,
@@ -337,6 +350,67 @@ def match_rules_codes(
         flagged = (packed & jnp.uint32(WORD_ERR | WORD_MULTI)) != 0
     pack = _compact_flagged_bits(bits, flagged, n_valid)
     return (packed, (first, last) if want_full else None, pack)
+
+
+def _lit_matrix_codes_wire(
+    codes8, codes_w, lo8, extras, act_rows, dtype=jnp.bfloat16
+):
+    """u8-wire variant of _lit_matrix_codes: codes8 [B, S8] uint8 carries
+    re-based rows for the narrow slots (0 = missing; v>0 = global row
+    v + lo8[s] - 1), codes_w [B, Sw] int16/int32 carries the wide slots'
+    global rows unchanged. The re-basing is one fused add on device; the
+    wire saves half the per-request code bytes over the host->device link
+    (the usual bottleneck — see engine._CompiledSet.wire)."""
+    L = act_rows.shape[1]
+    acc = None
+    if codes8.shape[1]:
+        c8 = codes8.astype(jnp.int32)
+        c8 = jnp.where(c8 == 0, 0, c8 + (lo8[None, :] - 1))
+        for s in range(c8.shape[1]):
+            row = jnp.take(act_rows, c8[:, s], axis=0)
+            acc = row if acc is None else acc | row
+    for s in range(codes_w.shape[1]):
+        row = jnp.take(act_rows, codes_w[:, s].astype(jnp.int32), axis=0)
+        acc = row if acc is None else acc | row
+    if acc is None:  # degenerate: no slots at all (n_slots floor is 1)
+        acc = jnp.zeros((extras.shape[0], L), jnp.uint8)
+    if extras is not None and extras.shape[1] > 0:
+        e32 = extras.astype(jnp.int32)
+        iota = jnp.arange(L, dtype=jnp.int32)
+        lit_e = (e32[:, :, None] == iota[None, None, :]).any(axis=1)
+        acc = acc | lit_e.astype(acc.dtype)
+    return acc.astype(dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_tiers", "want_full", "want_bits", "has_gate")
+)
+def match_rules_codes_wire(
+    codes8,
+    codes_w,
+    lo8,
+    extras,
+    act_rows,
+    W_chunks,
+    thresh_c,
+    group_c,
+    policy_c,
+    n_tiers: int,
+    want_full: bool,
+    want_bits: bool = False,
+    n_valid=None,
+    has_gate: bool = False,
+):
+    """match_rules_codes over the split u8 wire layout (see
+    _lit_matrix_codes_wire and engine._CompiledSet.wire): identical
+    semantics and outputs, roughly half the h2d bytes per request."""
+    lit = _lit_matrix_codes_wire(
+        codes8, codes_w, lo8, extras, act_rows, _lit_dtype(W_chunks.dtype)
+    )
+    return _match_from_lit(
+        lit, W_chunks, thresh_c, group_c, policy_c, n_tiers,
+        want_full, want_bits, n_valid, has_gate,
+    )
 
 
 @functools.partial(
