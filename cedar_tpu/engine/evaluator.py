@@ -194,15 +194,17 @@ class _CompiledSet:
                 lo8 = np.array(
                     [max(ranges[s][0], 1) for s in idx8], np.int32
                 )
-                # lane widths bucket to multiples of 4 (zero-padded
+                # lane widths bucket to multiples of 2 (zero-padded
                 # columns; code 0 gathers the all-zero row, so padding
                 # activates nothing): a reload that nudges one slot's
                 # span across 255 then usually keeps both jitted input
                 # shapes — preserving the retrace-free hot-swap property
                 # the table's own row bucketing exists for — and unrelated
-                # same-sized sets share more of the jit cache
-                self._wire_pad8 = -len(idx8) % 4
-                self._wire_padw = -len(idx16) % 4 if idx16 else 0
+                # same-sized sets share more of the jit cache. Bucket 2,
+                # not 4: every pad column is a shipped byte (u8) or two
+                # (wide), and the wide lane is typically 0-2 slots
+                self._wire_pad8 = -len(idx8) % 2
+                self._wire_padw = -len(idx16) % 2 if idx16 else 0
                 self.wire = (
                     np.array(idx8, np.intp),
                     np.array(idx16, np.intp),
@@ -214,27 +216,6 @@ class _CompiledSet:
                     ),
                     **kwargs,
                 )
-
-    def pack_wire(self, codes):
-        """Split + re-base a [B, n_slots] code array into the u8 wire
-        layout (codes8 u8, codes_w code_dtype) exactly as the device
-        kernel expects it — the ONE definition of the wire transform,
-        shared by the serving path (match_arrays_launch) and the bench so
-        the two can never drift."""
-        idx8, idx16, lo8 = self.wire
-        B = codes.shape[0]
-        c8 = codes[:, idx8]
-        c8 = np.where(c8 == 0, 0, c8 - lo8 + 1).astype(np.uint8)
-        if self._wire_pad8:
-            c8 = np.concatenate(
-                [c8, np.zeros((B, self._wire_pad8), np.uint8)], axis=1
-            )
-        cw = np.ascontiguousarray(codes[:, idx16])
-        if self._wire_padw:
-            cw = np.concatenate(
-                [cw, np.zeros((B, self._wire_padw), cw.dtype)], axis=1
-            )
-        return c8, cw
         # optional pallas layout: unchunked [L, R] W + [1, R] rule tensors
         # for the fused match kernel (ops/pallas_match.py)
         if use_pallas:
@@ -272,6 +253,31 @@ class _CompiledSet:
                     jax.device_put(packed.rule_group[None, :], **kwargs),
                     jax.device_put(packed.rule_policy[None, :], **kwargs),
                 )
+
+    def pack_wire(self, codes):
+        """Split + re-base a [B, n_slots] code array into the u8 wire
+        layout (codes8 u8, codes_w code_dtype) exactly as the device
+        kernel expects it — the ONE definition of the wire transform,
+        shared by the serving path (match_arrays_launch) and the bench so
+        the two can never drift."""
+        idx8, idx16, lo8 = self.wire
+        B = codes.shape[0]
+        c8 = codes[:, idx8]
+        c8 = np.where(c8 == 0, 0, c8 - lo8 + 1).astype(np.uint8)
+        if self._wire_pad8:
+            c8 = np.concatenate(
+                [c8, np.zeros((B, self._wire_pad8), np.uint8)], axis=1
+            )
+        # normalize the wide lane to the set's code dtype no matter what
+        # the caller handed in (the C++ encoder emits int32)
+        cw = np.ascontiguousarray(codes[:, idx16]).astype(
+            self.code_dtype, copy=False
+        )
+        if self._wire_padw:
+            cw = np.concatenate(
+                [cw, np.zeros((B, self._wire_padw), cw.dtype)], axis=1
+            )
+        return c8, cw
 
 
 class TPUPolicyEngine:

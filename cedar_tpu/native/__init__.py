@@ -287,6 +287,7 @@ def _serialize_table(plan, table) -> bytes:
 
 
 _lib = None
+_pylib = None  # PyDLL view for the *_pylist entries (None: not compiled in)
 _lib_error: Optional[str] = None
 
 
@@ -334,6 +335,44 @@ def _load_library():
             ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int32,
         ]
+        # best-effort zero-packing entries (built iff Python.h was present;
+        # see build.py). A PyDLL view of the same library keeps the GIL on
+        # entry — the C side harvests the list under the GIL, then releases
+        # it for the threaded encode.
+        global _pylib
+        try:
+            pylib = ctypes.PyDLL(str(path))
+            pylib.ce_encode_sar_pylist.restype = None
+            pylib.ce_encode_sar_pylist.argtypes = [
+                ctypes.c_void_p,
+                ctypes.py_object,
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int32,
+            ]
+            pylib.ce_encode_adm_pylist.restype = None
+            pylib.ce_encode_adm_pylist.argtypes = [
+                ctypes.c_void_p,
+                ctypes.py_object,
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+            ]
+            _pylib = pylib
+        except (OSError, AttributeError):
+            _pylib = None  # glue not compiled in: packed-buffer path only
         _lib = lib
     except Exception as e:  # no toolchain / build failure => python path
         _lib_error = str(e)
@@ -398,21 +437,47 @@ class NativeEncoder:
         lib = _load_library()
         assert lib is not None
         n = len(bodies)
+        if n_threads <= 0:
+            import os
+
+            n_threads = min(max(os.cpu_count() or 1, 1), 16)
+        if n == 0:
+            return (
+                np.zeros((0, self.n_slots), np.int32),
+                np.full((0, extras_cap), self.pad_value, np.int32),
+                np.zeros((0,), np.int32),
+                np.zeros((0,), np.uint8),
+            )
+        if _pylib is not None and type(bodies) is list:
+            # zero-packing path: the C side reads the bytes objects in
+            # place — no join, no per-item length loop, and the output
+            # buffers arrive uninitialized (C writes every consumed cell)
+            codes = np.empty((n, self.n_slots), dtype=np.int32)
+            extras = np.empty((n, extras_cap), dtype=np.int32)
+            counts = np.empty((n,), dtype=np.int32)
+            flags = np.empty((n,), dtype=np.uint8)
+            _pylib.ce_encode_sar_pylist(
+                self._handle,
+                bodies,
+                n,
+                codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                extras.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                extras_cap,
+                self.pad_value,
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                n_threads,
+            )
+            return codes, extras, counts, flags
         codes = np.zeros((n, self.n_slots), dtype=np.int32)
         extras = np.full((n, extras_cap), self.pad_value, dtype=np.int32)
         counts = np.zeros((n,), dtype=np.int32)
         flags = np.zeros((n,), dtype=np.uint8)
-        if n == 0:
-            return codes, extras, counts, flags
 
         buf = b"".join(bodies)
         lens = np.fromiter((len(b) for b in bodies), dtype=np.uint64, count=n)
         offsets = np.zeros((n,), dtype=np.uint64)
         np.cumsum(lens[:-1], out=offsets[1:])
-        if n_threads <= 0:
-            import os
-
-            n_threads = min(max(os.cpu_count() or 1, 1), 16)
         lib.ce_encode_sar_batch(
             self._handle,
             n,
@@ -441,23 +506,56 @@ class NativeEncoder:
         lib = _load_library()
         assert lib is not None
         n = len(bodies)
+        if n_threads <= 0:
+            import os
+
+            n_threads = min(max(os.cpu_count() or 1, 1), 16)
+        if n == 0:
+            return (
+                np.zeros((0, self.n_slots), np.int32),
+                np.full((0, extras_cap), self.pad_value, np.int32),
+                np.zeros((0,), np.int32),
+                np.zeros((0,), np.uint8),
+                [],
+            )
+        uid_buf = ctypes.create_string_buffer(n * 256)
+        uid_lens = np.empty((n,), dtype=np.int32)
+        if _pylib is not None and type(bodies) is list:
+            codes = np.empty((n, self.n_slots), dtype=np.int32)
+            extras = np.empty((n, extras_cap), dtype=np.int32)
+            counts = np.empty((n,), dtype=np.int32)
+            flags = np.empty((n,), dtype=np.uint8)
+            _pylib.ce_encode_adm_pylist(
+                self._handle,
+                bodies,
+                n,
+                codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                extras.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                extras_cap,
+                self.pad_value,
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                uid_buf,
+                uid_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                n_threads,
+            )
+            raw = uid_buf.raw
+            uids = [
+                raw[i * 256 : i * 256 + uid_lens[i]].decode(
+                    "utf-8", "replace"
+                )
+                for i in range(n)
+            ]
+            return codes, extras, counts, flags, uids
         codes = np.zeros((n, self.n_slots), dtype=np.int32)
         extras = np.full((n, extras_cap), self.pad_value, dtype=np.int32)
         counts = np.zeros((n,), dtype=np.int32)
         flags = np.zeros((n,), dtype=np.uint8)
-        uid_buf = ctypes.create_string_buffer(max(n, 1) * 256)
-        uid_lens = np.zeros((n,), dtype=np.int32)
-        if n == 0:
-            return codes, extras, counts, flags, []
 
         buf = b"".join(bodies)
         lens = np.fromiter((len(b) for b in bodies), dtype=np.uint64, count=n)
         offsets = np.zeros((n,), dtype=np.uint64)
         np.cumsum(lens[:-1], out=offsets[1:])
-        if n_threads <= 0:
-            import os
-
-            n_threads = min(max(os.cpu_count() or 1, 1), 16)
         lib.ce_encode_adm_batch(
             self._handle,
             n,

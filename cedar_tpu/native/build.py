@@ -19,10 +19,29 @@ _BUILD_DIR = _HERE / "_build"
 _LOCK = threading.Lock()
 
 
+def _glue_include() -> str:
+    """Python include dir when this interpreter's headers are present
+    (enables the *_pylist zero-packing entries), else ''."""
+    import sysconfig
+
+    inc = sysconfig.get_paths().get("include")
+    if inc and os.path.exists(os.path.join(inc, "Python.h")):
+        return inc
+    return ""
+
+
 def _source_hash() -> str:
+    import sysconfig
+
     arch = os.environ.get("CEDAR_NATIVE_ARCH", "native")
     h = hashlib.sha256(_SRC.read_bytes())
     h.update(arch.encode())
+    if _glue_include():
+        # the glue compiles PyList/PyObject struct-offset macros for THIS
+        # interpreter's ABI: key the cache on it so a different
+        # interpreter (or a headers-appeared-later host) rebuilds
+        h.update(b"pyglue:")
+        h.update(str(sysconfig.get_config_var("SOABI")).encode())
     return h.hexdigest()[:16]
 
 
@@ -58,7 +77,21 @@ def ensure_built() -> pathlib.Path:
             "-o",
             str(tmp),
         ]
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        # CPython glue (the *_pylist zero-packing entries) is best-effort:
+        # compiled in when this interpreter's headers are present, dropped
+        # otherwise — the ctypes loader probes for the symbols and falls
+        # back to the packed-buffer entries (native/__init__.py)
+        inc = _glue_include()
+        glue = ["-DCEDAR_PY_GLUE", f"-I{inc}"] if inc else []
+        try:
+            subprocess.run(
+                cmd[:1] + glue + cmd[1:], check=True, capture_output=True,
+                text=True,
+            )
+        except subprocess.CalledProcessError:
+            if not glue:
+                raise
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, out)
         # drop stale builds of older source revisions
         for old in _BUILD_DIR.glob("libcedar_native_*.so"):

@@ -26,6 +26,21 @@
 // (format documented there); canonical value-key strings must stay in sync
 // with _canon() on the Python side.
 
+#ifdef CEDAR_PY_GLUE
+// Python.h first, per CPython convention. The *_pylist entries take the
+// bodies list directly (via ctypes py_object through a PyDLL view of this
+// library), eliminating the python-side join/fromiter/cumsum packing pass
+// (~1.1us/request on the 1-core bench host). No libpython link is needed
+// inside a CPython process; note the PyList_GET_* macros compile to
+// struct-offset reads for the BUILD interpreter's ABI, which is why
+// build.py keys the .so cache on the interpreter ABI tag (SOABI).
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+// Python.h drags in unistd.h, whose access(2) F_OK macro would shadow the
+// encoder's own F_OK flag enum below
+#undef F_OK
+#endif
+
 #include <arpa/inet.h>
 
 #include <algorithm>
@@ -2305,6 +2320,183 @@ bool utf8_valid(const uint8_t *p, size_t n) {
   return true;
 }
 
+// One request's raw bytes, independent of how the batch arrived (packed
+// buffer + offsets from ctypes, or per-item PyBytes pointers from the
+// GIL-side harvest in the *_pylist entries).
+struct ReqView {
+  const uint8_t *p;
+  uint64_t len;
+};
+
+// Shared batch threading driver: split [0, n) into n_threads contiguous
+// ranges (per-thread arenas/pools live inside `work`).
+template <class Work>
+void drive_batch(uint64_t n, int32_t n_threads, Work &&work) {
+  if (n_threads <= 1 || n < 64) {
+    work(uint64_t(0), n);
+    return;
+  }
+  uint64_t nt = uint64_t(n_threads);
+  if (nt > n) nt = n;
+  std::vector<std::thread> threads;
+  uint64_t chunk = (n + nt - 1) / nt;
+  for (uint64_t k = 0; k < nt; ++k) {
+    uint64_t lo = k * chunk, hi = lo + chunk > n ? n : lo + chunk;
+    if (lo >= hi) break;
+    threads.emplace_back(work, lo, hi);
+  }
+  for (auto &th : threads) th.join();
+}
+
+// SAR encode over a request range. extras_pad >= 0 means the extras
+// buffer arrived UNinitialized (np.empty): fill every row's unused cells
+// up to extras_cap so outputs stay deterministic — batch results must be
+// bit-identical regardless of entry point or thread count
+// (tests/test_native_encoder.py pins this).
+void encode_sar_rows(const Table &t, const ReqView *reqs, uint64_t lo,
+                     uint64_t hi, int32_t *codes, int32_t *extras,
+                     int32_t extras_cap, int32_t extras_pad,
+                     int32_t *extras_count, uint8_t *flags) {
+  Arena arena;
+  Features f;
+  std::string scratch;
+  for (uint64_t i = lo; i < hi; ++i) {
+    int32_t *c = codes + i * uint64_t(t.n_slots);
+    ExtrasOut eo{extras + i * uint64_t(extras_cap), extras_cap};
+    arena.reset();
+    uint8_t flag;
+    if (!reqs[i].p || !utf8_valid(reqs[i].p, size_t(reqs[i].len))) {
+      // python-lane parity: invalid UTF-8 is a decode error, never an
+      // evaluated request (see utf8_valid); a null view (non-bytes list
+      // item) is likewise a decode error for the python lane to report
+      flag = F_PARSE_ERROR;
+    } else {
+      JsonParser parser((const char *)reqs[i].p, size_t(reqs[i].len),
+                        arena);
+      JVal *root = parser.parse();
+      if (!root || root->kind != JVal::OBJ) {
+        flag = F_PARSE_ERROR;
+      } else {
+        f.reset();
+        flag = build_features(root, f);
+      }
+    }
+    if (flag != F_OK) {
+      for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
+      extras_count[i] = 0;
+      flags[i] = flag;
+    } else {
+      encode_one(t, f, c, eo, scratch);
+      extras_count[i] = eo.n;
+      flags[i] = eo.overflow ? F_EXTRAS_OVERFLOW : F_OK;
+    }
+    if (extras_pad >= 0)
+      for (int32_t k = eo.n; k < extras_cap; ++k) eo.buf[k] = extras_pad;
+  }
+}
+
+// Admission encode over a request range (see ce_encode_adm_batch for the
+// uids contract); extras_pad semantics as encode_sar_rows (fill EVERY
+// row's unused cells: outputs stay deterministic across entry points).
+void encode_adm_rows(const Table &t, const ReqView *reqs, uint64_t lo,
+                     uint64_t hi, int32_t *codes, int32_t *extras,
+                     int32_t extras_cap, int32_t extras_pad,
+                     int32_t *extras_count, uint8_t *flags, char *uids,
+                     int32_t *uid_lens) {
+  Arena arena;
+  CPool cpool;
+  AdmFeatures f;
+  std::string scratch;
+  for (uint64_t i = lo; i < hi; ++i) {
+    int32_t *c = codes + i * uint64_t(t.n_slots);
+    ExtrasOut eo{extras + i * uint64_t(extras_cap), extras_cap};
+    extras_count[i] = 0;
+    uid_lens[i] = 0;
+    arena.reset();
+    cpool.reset();
+    uint8_t flag = F_OK;
+    if (!reqs[i].p || !utf8_valid(reqs[i].p, size_t(reqs[i].len))) {
+      // python-lane parity: invalid UTF-8 is a decode error (utf8_valid);
+      // null view (non-bytes list item) likewise
+      flag = F_PARSE_ERROR;
+    } else {
+      JsonParser parser((const char *)reqs[i].p, size_t(reqs[i].len),
+                        arena);
+      JVal *root = parser.parse();
+      if (!root || root->kind != JVal::OBJ) {
+        flag = F_PARSE_ERROR;
+      } else {
+        f.reset();
+        AdmCtx ctx;
+        ctx.cp = &cpool;
+        flag = build_adm(root, f, ctx, arena);
+      }
+    }
+    if (flag != F_OK) {
+      for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
+      flags[i] = flag;
+      if (flag == F_ADM_NS_SKIP) {
+        memcpy(uids + i * 256, f.uid.data(), f.uid.size());
+        uid_lens[i] = int32_t(f.uid.size());
+      }
+    } else {
+      encode_adm_one(t, f, c, eo, scratch);
+      extras_count[i] = eo.n;
+      flags[i] = eo.overflow ? F_EXTRAS_OVERFLOW : F_OK;
+      memcpy(uids + i * 256, f.uid.data(), f.uid.size());
+      uid_lens[i] = int32_t(f.uid.size());
+    }
+    if (extras_pad >= 0)
+      for (int32_t k = eo.n; k < extras_cap; ++k) eo.buf[k] = extras_pad;
+  }
+}
+
+std::vector<ReqView> views_from_offsets(uint64_t n, const uint8_t *buf,
+                                        const uint64_t *offsets,
+                                        const uint64_t *lens) {
+  std::vector<ReqView> reqs(n);
+  for (uint64_t i = 0; i < n; ++i) reqs[i] = {buf + offsets[i], lens[i]};
+  return reqs;
+}
+
+#ifdef CEDAR_PY_GLUE
+// GIL-side harvest of a Python list of bytes-like objects into ReqViews.
+// The Py_buffer views are HELD for the duration of the encode (release()
+// under the GIL afterwards): an exported buffer pins bytearray /
+// memoryview storage — resizing raises BufferError instead of
+// invalidating the pointers the nogil worker threads are parsing. The
+// caller-supplied `n` (the Python-side allocation size) caps the row
+// count: a list mutated concurrently with the call can never overflow
+// the caller's output arrays. Non-buffer items yield a null view ->
+// F_PARSE_ERROR -> python fallback reports the exact decode error.
+struct PyListViews {
+  std::vector<ReqView> reqs;
+  std::vector<Py_buffer> held;
+
+  PyListViews(PyObject *list, uint64_t n_cap) {
+    Py_ssize_t n = PyList_GET_SIZE(list);
+    if (uint64_t(n) > n_cap) n = Py_ssize_t(n_cap);
+    reqs.resize(static_cast<size_t>(n), ReqView{nullptr, 0});
+    held.reserve(static_cast<size_t>(n));
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *o = PyList_GET_ITEM(list, i);  // borrowed
+      Py_buffer vb;
+      if (PyObject_GetBuffer(o, &vb, PyBUF_SIMPLE) != 0) {
+        PyErr_Clear();
+        continue;
+      }
+      reqs[size_t(i)] = {(const uint8_t *)vb.buf, uint64_t(vb.len)};
+      held.push_back(vb);
+    }
+  }
+  // GIL must be held
+  void release() {
+    for (auto &vb : held) PyBuffer_Release(&vb);
+    held.clear();
+  }
+};
+#endif  // CEDAR_PY_GLUE
+
 }  // namespace
 
 // ------------------------------------------------------------------ C API
@@ -2327,57 +2519,11 @@ void ce_encode_sar_batch(void *handle, uint64_t n, const uint8_t *buf,
                          int32_t *extras_count, uint8_t *flags,
                          int32_t n_threads) {
   const Table &t = *static_cast<Table *>(handle);
-  auto work = [&](uint64_t lo, uint64_t hi) {
-    Arena arena;
-    Features f;
-    std::string scratch;
-    for (uint64_t i = lo; i < hi; ++i) {
-      int32_t *c = codes + i * uint64_t(t.n_slots);
-      ExtrasOut eo{extras + i * uint64_t(extras_cap), extras_cap};
-      arena.reset();
-      if (!utf8_valid(buf + offsets[i], size_t(lens[i]))) {
-        // python-lane parity: invalid UTF-8 is a decode error, never an
-        // evaluated request (see utf8_valid)
-        for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
-        extras_count[i] = 0;
-        flags[i] = F_PARSE_ERROR;
-        continue;
-      }
-      JsonParser parser((const char *)buf + offsets[i], size_t(lens[i]), arena);
-      JVal *root = parser.parse();
-      if (!root || root->kind != JVal::OBJ) {
-        for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
-        extras_count[i] = 0;
-        flags[i] = F_PARSE_ERROR;
-        continue;
-      }
-      f.reset();
-      uint8_t gate = build_features(root, f);
-      if (gate != F_OK) {
-        for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
-        extras_count[i] = 0;
-        flags[i] = gate;
-        continue;
-      }
-      encode_one(t, f, c, eo, scratch);
-      extras_count[i] = eo.n;
-      flags[i] = eo.overflow ? F_EXTRAS_OVERFLOW : F_OK;
-    }
-  };
-  if (n_threads <= 1 || n < 64) {
-    work(0, n);
-    return;
-  }
-  uint64_t nt = uint64_t(n_threads);
-  if (nt > n) nt = n;
-  std::vector<std::thread> threads;
-  uint64_t chunk = (n + nt - 1) / nt;
-  for (uint64_t k = 0; k < nt; ++k) {
-    uint64_t lo = k * chunk, hi = lo + chunk > n ? n : lo + chunk;
-    if (lo >= hi) break;
-    threads.emplace_back(work, lo, hi);
-  }
-  for (auto &th : threads) th.join();
+  auto reqs = views_from_offsets(n, buf, offsets, lens);
+  drive_batch(n, n_threads, [&](uint64_t lo, uint64_t hi) {
+    encode_sar_rows(t, reqs.data(), lo, hi, codes, extras, extras_cap,
+                    /*extras_pad=*/-1, extras_count, flags);
+  });
 }
 
 int32_t ce_n_slots(void *handle) {
@@ -2395,65 +2541,74 @@ void ce_encode_adm_batch(void *handle, uint64_t n, const uint8_t *buf,
                          int32_t *extras_count, uint8_t *flags, char *uids,
                          int32_t *uid_lens, int32_t n_threads) {
   const Table &t = *static_cast<Table *>(handle);
-  auto work = [&](uint64_t lo, uint64_t hi) {
-    Arena arena;
-    CPool cpool;
-    AdmFeatures f;
-    std::string scratch;
-    for (uint64_t i = lo; i < hi; ++i) {
-      int32_t *c = codes + i * uint64_t(t.n_slots);
-      ExtrasOut eo{extras + i * uint64_t(extras_cap), extras_cap};
-      extras_count[i] = 0;
-      uid_lens[i] = 0;
-      arena.reset();
-      cpool.reset();
-      if (!utf8_valid(buf + offsets[i], size_t(lens[i]))) {
-        // python-lane parity: invalid UTF-8 is a decode error (utf8_valid)
-        for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
-        flags[i] = F_PARSE_ERROR;
-        continue;
-      }
-      JsonParser parser((const char *)buf + offsets[i], size_t(lens[i]), arena);
-      JVal *root = parser.parse();
-      if (!root || root->kind != JVal::OBJ) {
-        for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
-        flags[i] = F_PARSE_ERROR;
-        continue;
-      }
-      f.reset();
-      AdmCtx ctx;
-      ctx.cp = &cpool;
-      uint8_t gate = build_adm(root, f, ctx, arena);
-      if (gate != F_OK) {
-        for (int32_t s = 0; s < t.n_slots; ++s) c[s] = 0;
-        flags[i] = gate;
-        if (gate == F_ADM_NS_SKIP) {
-          memcpy(uids + i * 256, f.uid.data(), f.uid.size());
-          uid_lens[i] = int32_t(f.uid.size());
-        }
-        continue;
-      }
-      encode_adm_one(t, f, c, eo, scratch);
-      extras_count[i] = eo.n;
-      flags[i] = eo.overflow ? F_EXTRAS_OVERFLOW : F_OK;
-      memcpy(uids + i * 256, f.uid.data(), f.uid.size());
-      uid_lens[i] = int32_t(f.uid.size());
-    }
-  };
-  if (n_threads <= 1 || n < 64) {
-    work(0, n);
-    return;
-  }
-  uint64_t nt = uint64_t(n_threads);
-  if (nt > n) nt = n;
-  std::vector<std::thread> threads;
-  uint64_t chunk = (n + nt - 1) / nt;
-  for (uint64_t k = 0; k < nt; ++k) {
-    uint64_t lo = k * chunk, hi = lo + chunk > n ? n : lo + chunk;
-    if (lo >= hi) break;
-    threads.emplace_back(work, lo, hi);
-  }
-  for (auto &th : threads) th.join();
+  auto reqs = views_from_offsets(n, buf, offsets, lens);
+  drive_batch(n, n_threads, [&](uint64_t lo, uint64_t hi) {
+    encode_adm_rows(t, reqs.data(), lo, hi, codes, extras, extras_cap,
+                    /*extras_pad=*/-1, extras_count, flags, uids, uid_lens);
+  });
 }
+
+#ifdef CEDAR_PY_GLUE
+
+// Python-list variants: called through a PyDLL view (GIL HELD on entry).
+// The bodies list is harvested into pinned buffer views under the GIL,
+// the GIL is released for the threaded encode, then the views release
+// back under the GIL (see PyListViews for the lifetime argument).
+// `n_alloc` is the caller's output-array row count — the hard cap on how
+// many rows are encoded. `extras` arrives UNinitialized (np.empty);
+// every row is pad-filled in C (extras_pad).
+void ce_encode_sar_pylist(void *handle, PyObject *list, uint64_t n_alloc,
+                          int32_t *codes, int32_t *extras,
+                          int32_t extras_cap, int32_t extras_pad,
+                          int32_t *extras_count, uint8_t *flags,
+                          int32_t n_threads) {
+  const Table &t = *static_cast<Table *>(handle);
+  PyListViews views(list, n_alloc);
+  uint64_t n = views.reqs.size();
+  // if the list shrank concurrently, the trailing output rows would
+  // otherwise stay np.empty garbage: make them deterministic error rows
+  for (uint64_t i = n; i < n_alloc; ++i) {
+    for (int32_t s = 0; s < t.n_slots; ++s) codes[i * t.n_slots + s] = 0;
+    for (int32_t k = 0; k < extras_cap; ++k)
+      extras[i * uint64_t(extras_cap) + k] = extras_pad;
+    extras_count[i] = 0;
+    flags[i] = F_PARSE_ERROR;
+  }
+  PyThreadState *st = PyEval_SaveThread();
+  drive_batch(n, n_threads, [&](uint64_t lo, uint64_t hi) {
+    encode_sar_rows(t, views.reqs.data(), lo, hi, codes, extras,
+                    extras_cap, extras_pad, extras_count, flags);
+  });
+  PyEval_RestoreThread(st);
+  views.release();
+}
+
+void ce_encode_adm_pylist(void *handle, PyObject *list, uint64_t n_alloc,
+                          int32_t *codes, int32_t *extras,
+                          int32_t extras_cap, int32_t extras_pad,
+                          int32_t *extras_count, uint8_t *flags, char *uids,
+                          int32_t *uid_lens, int32_t n_threads) {
+  const Table &t = *static_cast<Table *>(handle);
+  PyListViews views(list, n_alloc);
+  uint64_t n = views.reqs.size();
+  for (uint64_t i = n; i < n_alloc; ++i) {  // see SAR twin
+    for (int32_t s = 0; s < t.n_slots; ++s) codes[i * t.n_slots + s] = 0;
+    for (int32_t k = 0; k < extras_cap; ++k)
+      extras[i * uint64_t(extras_cap) + k] = extras_pad;
+    extras_count[i] = 0;
+    uid_lens[i] = 0;
+    flags[i] = F_PARSE_ERROR;
+  }
+  PyThreadState *st = PyEval_SaveThread();
+  drive_batch(n, n_threads, [&](uint64_t lo, uint64_t hi) {
+    encode_adm_rows(t, views.reqs.data(), lo, hi, codes, extras,
+                    extras_cap, extras_pad, extras_count, flags, uids,
+                    uid_lens);
+  });
+  PyEval_RestoreThread(st);
+  views.release();
+}
+
+#endif  // CEDAR_PY_GLUE
 
 }  // extern "C"

@@ -858,3 +858,41 @@ def test_sar_type_flipped_shapes_match_python_lane():
         assert got[0] == want[0] and bool(got[2]) == bool(want[2]), (
             b, got, want,
         )
+
+
+def test_encode_batch_entry_points_agree():
+    """The pylist zero-packing entry (native._pylib, when compiled in) and
+    the packed-buffer entry must be bit-identical on every output,
+    including non-bytes list items (null view -> F_PARSE_ERROR -> python
+    fallback) and bytearray items (the Py_buffer views are HELD across the
+    nogil encode, so mutable exporters stay pinned)."""
+    import cedar_tpu.native as nat
+
+    engine = TPUPolicyEngine()
+    engine.load(_policy_tiers())
+    encoder = NativeEncoder.create(engine._compiled.packed)
+    assert encoder is not None
+
+    if nat._pylib is None:
+        pytest.skip("pylist glue not compiled in on this host")
+
+    rng = random.Random(12)
+    bodies = [json.dumps(_random_sar(rng)).encode() for _ in range(300)]
+    bodies[7] = bytearray(bodies[7])  # buffer-protocol, not bytes
+    bodies[11] = 12345  # not bytes-like at all
+
+    via_list = encoder.encode_batch(bodies)
+    # the packed-buffer path can't carry the non-bytes item: compare on a
+    # bytes-only copy, plus pin the non-bytes row's flag on the list path
+    assert via_list[3][11] != F_OK
+    clean = list(bodies)
+    clean[11] = b"not json"
+    via_list2 = encoder.encode_batch(clean)
+    saved = nat._pylib
+    nat._pylib = None
+    try:
+        via_buf = encoder.encode_batch([bytes(b) for b in clean])
+    finally:
+        nat._pylib = saved
+    for a, b in zip(via_list2, via_buf):
+        np.testing.assert_array_equal(a, b)
