@@ -101,6 +101,30 @@ class _Snapshot(NamedTuple):
     word_cache: dict
 
 
+def _chunk_sizes(n: int, chunk: int, tail: int) -> List[int]:
+    """Pipeline chunk plan for an n-row batch: full `chunk`s, then the
+    remainder — split into EQUAL halves when it exceeds `tail`, so the
+    final device wait (which no later encode hides) is at most a
+    half-chunk. Any remainder in (tail, chunk] halves into pieces in
+    (tail/2, tail], which stay above _BITS_INCALL_MAX — always the cheap
+    plain plane at the warmed tail-chunk batch bucket, never a small
+    piece on the unwarmed in-call bits plane."""
+    sizes = []
+    rem = n
+    while rem > chunk:
+        sizes.append(chunk)
+        rem -= chunk
+    # split only when BOTH halves exceed tail // 2 (== _BITS_INCALL_MAX
+    # for the serving constants): a half at exactly the threshold would
+    # ride the 4x-cost in-call bits plane at an unwarmed batch bucket
+    if rem > tail and rem - (rem + 1) // 2 > tail // 2:
+        half = (rem + 1) // 2
+        sizes.extend((half, rem - half))
+    elif rem:
+        sizes.append(rem)
+    return sizes
+
+
 class _RawFastPath:
     """The shared chunked raw-bytes pipeline (see module docstring).
 
@@ -117,6 +141,13 @@ class _RawFastPath:
     # warm-up ladder pre-compiles this shape (evaluator.SERVING_CHUNK) so
     # post-swap batch/replay traffic never eats the trace+compile.
     _CHUNK = SERVING_CHUNK
+    # the LAST chunk's device work has no later encode to hide behind: its
+    # h2d + compute is an exposed serial tail (~30-45ms per 16384 rows on
+    # the degraded r05 link). Splitting the tail into smaller pieces
+    # shortens that exposed wait on any link at negligible dispatch cost.
+    # Kept above _BITS_INCALL_MAX so tail pieces stay on the cheap plain
+    # plane; the warm ladder compiles this shape too.
+    _TAIL_CHUNK = SERVING_CHUNK // 2
     # above this row count, skip the in-call diagnostics bitset plane
     # (want_bits): computing + compacting [B, R/32] bitsets costs ~4x the
     # plain match at large B, while flagged rows are rare (<1%) — fetching
@@ -221,10 +252,11 @@ class _RawFastPath:
         chunks resolve in one deferred pass. `last_stage_s` records the
         per-call encode/device/decode split for the bench's stage budget."""
         self.last_stage_s = {"encode": 0.0, "device": 0.0, "decode": 0.0}
-        n = len(bodies)
         pending = []
-        for lo in range(0, n, self._CHUNK):
-            chunk = bodies[lo : lo + self._CHUNK]
+        lo = 0
+        for size in _chunk_sizes(len(bodies), self._CHUNK, self._TAIL_CHUNK):
+            chunk = bodies[lo : lo + size]
+            lo += size
             pending.append((chunk, self._prepare_chunk(snap, chunk)))
         ctxs = [self._finish_words(snap, chunk, pre) for chunk, pre in pending]
         self._resolve_deferred(snap, ctxs)

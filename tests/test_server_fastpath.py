@@ -679,3 +679,32 @@ class TestWarmup:
             assert readyz() == 200
         finally:
             srv.stop()
+
+
+def test_chunk_sizes_plan():
+    """Pipeline chunk plan invariants: sizes sum to n; all pieces but the
+    last two are full chunks; when the remainder splits, both halves land
+    strictly above the in-call-bits threshold and within the warmed tail
+    bucket (the r05 tail-split contract)."""
+    from cedar_tpu.engine.fastpath import _RawFastPath, _chunk_sizes
+
+    CH, TL = 16384, 8192
+    BITS_MAX = _RawFastPath._BITS_INCALL_MAX
+    assert TL // 2 == BITS_MAX  # the guard in _chunk_sizes relies on this
+    for n in range(0, 70000, 997):
+        sizes = _chunk_sizes(n, CH, TL)
+        assert sum(sizes) == n
+        assert all(s > 0 for s in sizes)
+        for s in sizes[:-2]:
+            assert s == CH
+        if len(sizes) >= 2 and sizes[-1] != CH and sizes[-2] != CH:
+            # a split happened: both halves above the bits threshold,
+            # inside the warmed tail bucket, and near-equal
+            a, b = sizes[-2], sizes[-1]
+            assert BITS_MAX < b <= a <= TL, (n, sizes)
+            assert a - b <= 1, (n, sizes)
+    # the exact boundary that would land a half AT the bits threshold
+    # must not split (8193 -> one piece, not 4097+4096)
+    assert _chunk_sizes(8193, CH, TL) == [8193]
+    assert _chunk_sizes(8194, CH, TL) == [4097, 4097]
+    assert _chunk_sizes(65536, CH, TL) == [CH, CH, CH, TL, TL]
