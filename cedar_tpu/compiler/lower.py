@@ -751,18 +751,26 @@ def lower_policy(
     for c in cond_clauses:
         full = tuple(prefix) + c
         simplified = simplify_clause(full)
-        if simplified is None:
-            # the match clause can never fire (contradictory conditions,
-            # e.g. `when { C } unless { C }`) — but Cedar still evaluates
-            # the conditions in order and can ERROR (absent-attribute
-            # access) before reaching the contradiction, and errors are
-            # signals that stop tier descent. Harden the ORIGINAL clause
-            # purely for its error clauses; the match clause is dropped.
-            # (Unlowerable propagates exactly like the normal path: if the
-            # error behavior needs the interpreter, the policy falls back.)
-            _dropped, errs = harden_clause(full, type_ctx, schema)
-        else:
-            hardened, errs = harden_clause(simplified, type_ctx, schema)
+        # Error clauses ALWAYS come from the ORIGINAL clause: Cedar
+        # evaluates conditions in written order, and the simplifier is
+        # value-semantics-only — it may drop a literal whose access errors
+        # (e.g. `unless { r.ns == "x" } when { r has ns && r.ns == "y" }`:
+        # the unless-literal is dominated by the eq and vanishes, yet Cedar
+        # still errors FIRST on the unguarded `r.ns` when ns is absent —
+        # fuzz seed 20007) or reorder guards across clause boundaries.
+        # Hardening the simplified clause for errors silently loses those
+        # paths; the match clause, by contrast, is a pure value predicate
+        # and is correct to harden post-simplification. (Unlowerable from
+        # either call propagates: if the error behavior needs the
+        # interpreter, the policy falls back.)
+        _dropped, errs = harden_clause(full, type_ctx, schema)
+        if simplified is not None:
+            if simplified == full:  # common case: nothing was simplified
+                hardened = _dropped
+            else:
+                hardened, _errs_simplified = harden_clause(
+                    simplified, type_ctx, schema
+                )
             # re-simplify AFTER hardening: an inserted presence guard can
             # contradict an existing negated HAS on the same access (e.g.
             # `unless { r has a } unless { r.a == "x" }`), making the

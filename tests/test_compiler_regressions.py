@@ -250,3 +250,49 @@ def test_pack_rejects_two_signed_duplicate_literal():
     lp.clauses[0] = bad
     with pytest.raises(ValueError, match="both signs"):
         pack(compiled)
+
+
+def test_unless_access_errors_despite_later_guarded_when():
+    """Fuzz seed 20007 (round 5): `unless { r.ns == "x" } when { r has ns
+    && r.ns == "y" }` — the simplifier drops the unless-literal (dominated
+    by the eq), but Cedar evaluates conditions in WRITTEN order, so the
+    unguarded `r.ns` access in the unless errors FIRST when ns is absent.
+    Error clauses must be hardened from the ORIGINAL clause, not the
+    simplified one; the lost error rule let a later tier's blanket permit
+    answer allow where the interpreter reports the tier-1 error
+    (no_opinion at the authorizer)."""
+    from cedar_tpu.entities.attributes import Attributes, UserInfo
+
+    tier1 = (
+        'permit (principal, action == k8s::Action::"delete", resource)'
+        ' unless { resource.namespace == "ns-1" }'
+        ' when { resource has namespace &&'
+        ' resource.namespace == "kube-system" };'
+    )
+    tier2 = "permit (principal, action, resource is k8s::Resource);"
+    # cluster-scoped request: resource has NO namespace -> the unless
+    # access errors in tier 1 -> error signal stops tier descent
+    attrs = Attributes(
+        user=UserInfo(name="alice", uid="u"),
+        verb="delete",
+        api_version="v1",
+        resource="nodes",
+        name="n1",
+        resource_request=True,
+    )
+    (tpu_d, tpu_g), (int_d, int_g), engine = both([tier1, tier2], attrs)
+    assert int_d == tpu_d, (tpu_d, int_d)
+    assert len(tpu_g.errors) == len(int_g.errors) == 1
+    assert not tpu_g.reasons and not int_g.reasons
+    # namespaced request: tier-1 when fails cleanly (ns != kube-system),
+    # no error, tier 2 permits
+    attrs2 = Attributes(
+        user=UserInfo(name="alice", uid="u"),
+        verb="delete",
+        namespace="default",
+        api_version="v1",
+        resource="pods",
+        resource_request=True,
+    )
+    (tpu_d2, _), (int_d2, _), _ = both([tier1, tier2], attrs2)
+    assert tpu_d2 == int_d2 == "allow"

@@ -688,9 +688,11 @@ def test_chunk_sizes_plan():
     bucket (the r05 tail-split contract)."""
     from cedar_tpu.engine.fastpath import _RawFastPath, _chunk_sizes
 
-    CH, TL = 16384, 8192
+    CH, TL = _RawFastPath._CHUNK, _RawFastPath._TAIL_CHUNK
     BITS_MAX = _RawFastPath._BITS_INCALL_MAX
-    assert TL // 2 == BITS_MAX  # the guard in _chunk_sizes relies on this
+    # the production relation the split guard relies on: halves of any
+    # remainder in (TL, CH] must exceed the in-call-bits threshold
+    assert TL // 2 >= BITS_MAX
     for n in range(0, 70000, 997):
         sizes = _chunk_sizes(n, CH, TL)
         assert sum(sizes) == n
@@ -703,8 +705,8 @@ def test_chunk_sizes_plan():
             a, b = sizes[-2], sizes[-1]
             assert BITS_MAX < b <= a <= TL, (n, sizes)
             assert a - b <= 1, (n, sizes)
-    # the exact boundary that would land a half AT the bits threshold
-    # must not split (8193 -> one piece, not 4097+4096)
-    assert _chunk_sizes(8193, CH, TL) == [8193]
-    assert _chunk_sizes(8194, CH, TL) == [4097, 4097]
-    assert _chunk_sizes(65536, CH, TL) == [CH, CH, CH, TL, TL]
+    # the exact boundary that would land a half AT the lower bound must
+    # not split (TL+1 -> one piece); one row more splits into equal halves
+    assert _chunk_sizes(TL + 1, CH, TL) == [TL + 1]
+    assert _chunk_sizes(TL + 2, CH, TL) == [TL // 2 + 1, TL // 2 + 1]
+    assert _chunk_sizes(4 * CH, CH, TL) == [CH, CH, CH, TL, TL]
