@@ -115,7 +115,7 @@ def _gen_condition(rng: random.Random) -> str:
         # dynamic extension call: outside every native class — exercises
         # the native-opaque scope-gate plane on the raw-bytes lane
         return "resource has name && ip(resource.name).isLoopback()"
-    if kind < 0.96:
+    if kind < 0.93:
         # UNGUARDED optional-attribute access: errors when the attribute is
         # absent — exercises Cedar's policy-error semantics (the policy is
         # skipped but surfaces in diagnostics) through the error clauses
@@ -123,6 +123,25 @@ def _gen_condition(rng: random.Random) -> str:
             f'resource.{rng.choice(["namespace", "name", "subresource"])} == '
             f'"{rng.choice(NAMESPACES[1:] + ["alice"])}"'
         )
+    if kind < 0.975:
+        # short-circuit forms: || / if-then-else over operands that
+        # INCLUDE unguarded accesses — expand() encodes each clause as one
+        # evaluation path (lower.py:390), and the error clauses must fire
+        # on exactly the Cedar path that reaches the erroring operand
+        # (left-true suppresses a right-side error for ||, etc.). Round
+        # 5's seed-20007 class showed order/path sensitivity is where the
+        # compiler breaks; generate it by construction.
+        ops = [
+            f'principal.name == "{rng.choice(USERS)}"',
+            f'resource.resource == "{rng.choice(RESOURCES)}"',
+            f'resource.namespace == "{rng.choice(NAMESPACES[1:])}"',  # may error
+            f'resource.name like "a*"',  # may error
+            "resource has subresource",
+        ]
+        a, b, c = (rng.choice(ops) for _ in range(3))
+        if rng.random() < 0.5:
+            return f"({a}) || ({b})"
+        return f"if {a} then {b} else {c}"
     return 'principal.name == "alice" && context has nothing'
 
 
