@@ -734,12 +734,17 @@ def main():
         c8, cw = cs.pack_wire(c)
         return (c8, cw, e)
 
+    segs = getattr(cs, "segs", None)  # CEDAR_TPU_SEGRED plane, if enabled
+
     def launch(inp):
         if wire is None:
-            return match_rules_codes(inp[0], inp[1], *args, packed.n_tiers,
-                                     False)
+            return match_rules_codes(
+                inp[0], inp[1], *args, packed.n_tiers, False,
+                False, None, packed.has_gate, segs,
+            )
         return match_rules_codes_wire(
-            inp[0], inp[1], cs.lo8_dev, inp[2], *args, packed.n_tiers, False
+            inp[0], inp[1], cs.lo8_dev, inp[2], *args, packed.n_tiers,
+            False, False, None, packed.has_gate, segs,
         )
 
     inputs = [mk_inp(c, e) for c, e in batches]

@@ -217,6 +217,15 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
             rules.append((lits, gate_group, GATE_RULE_POLICY))
         has_gate = True
 
+    # group-contiguous rule layout: sorting by (group, policy) lets the
+    # segmented-reduction kernel plane (ops/match.py, CEDAR_TPU_SEGRED)
+    # reduce each group over ONE contiguous column slice instead of
+    # n_groups masked passes over the full [B, Rc] score matrix. The
+    # first/last-match semantics are order-independent (min/max over
+    # POLICY indices, not rule indices), so the default scan plane is
+    # unaffected; stability keeps the layout deterministic.
+    rules.sort(key=lambda t: (t[1], t[2]))
+
     n_lits = len(reg.lits)
     n_rules = len(rules)
     L = _bucket(max(n_lits, 1))

@@ -109,6 +109,31 @@ def _round_bucket(n: int, buckets) -> int:
     return buckets[-1]
 
 
+def _segment_plan(group_c: np.ndarray, n_rules: int):
+    """Static per-chunk (group, start, end) column segments for the
+    segmented-reduction kernel plane (ops/match.py _first_match_seg).
+    group_c is the chunked [C, Rc] rule-group layout; rules are
+    group-contiguous after pack's (group, policy) sort, so each chunk
+    holds at most a handful of runs. Padding columns (>= n_rules, never
+    satisfied) are excluded outright."""
+    C, rc = group_c.shape
+    segs = []
+    for ci in range(C):
+        limit = min(rc, max(0, n_rules - ci * rc))
+        cols = group_c[ci]
+        runs = []
+        j = 0
+        while j < limit:
+            g = int(cols[j])
+            k = j
+            while k < limit and cols[k] == g:
+                k += 1
+            runs.append((g, j, k))
+            j = k
+        segs.append(tuple(runs))
+    return tuple(segs)
+
+
 class _CompiledSet:
     """Immutable device-resident compiled policy set (the swap unit)."""
 
@@ -134,6 +159,7 @@ class _CompiledSet:
         self.lo8_dev = None
         self._wire_pad8 = 0
         self._wire_padw = 0
+        self.segs = None  # segmented-reduction plan (set below; not mesh)
         # int8 scoring plane (default): W ships as int8 with int32
         # accumulation — exact (entries are +/-1, sums << 2^24) and 2x bf16
         # MXU peak on TPU; CEDAR_TPU_INT8=0 restores the bf16 plane
@@ -172,6 +198,21 @@ class _CompiledSet:
             w_host, thresh_host,
             packed.rule_group, packed.rule_policy,
         )
+        # segmented-reduction plane (opt-in, CEDAR_TPU_SEGRED=1): rules
+        # are group-contiguous (pack sorts by (group, policy)), so each
+        # chunk's per-group first/last-match reduces over one static
+        # column slice instead of n_groups masked passes — a candidate
+        # 2-4x cut of the XLA plane's non-matmul device cost; measured by
+        # tools/hw_validate.py before any default flip. COST: segs is a
+        # jit-static tuple derived from the rule layout, so a hot swap to
+        # a differently-laid-out set recompiles the match kernel (in the
+        # background warm ladder, like other shape changes) and each
+        # distinct layout retains its executables in the jit cache —
+        # acceptable for an experimental plane, documented in
+        # docs/Limitations.md alongside the flip criteria
+        self.segs = None
+        if os.environ.get("CEDAR_TPU_SEGRED", "0") == "1":
+            self.segs = _segment_plan(group_c, packed.n_rules)
         self.W_dev = jax.device_put(
             W3 if int8_plane else W3.astype(jax.numpy.bfloat16), **kwargs
         )
@@ -723,12 +764,13 @@ class TPUPolicyEngine:
                     c8, cw, cs.lo8_dev, chunk_e, *args,
                     packed.n_tiers, want_full, want_bits,
                     np.int32(m) if want_bits else None, packed.has_gate,
+                    cs.segs,
                 )
             else:
                 out = match_rules_codes(
                     chunk_c, chunk_e, *args, packed.n_tiers, want_full,
                     want_bits, np.int32(m) if want_bits else None,
-                    packed.has_gate,
+                    packed.has_gate, cs.segs,
                 )
             return out if want_bits else (*out, None)
 

@@ -161,6 +161,36 @@ def _first_match(
     return first, last, bits
 
 
+def _first_match_seg(
+    lit, W_chunks, thresh_c, policy_c, segs, n_groups: int,
+    want_bits: bool = False,
+):
+    """Segment variant of _first_match (CEDAR_TPU_SEGRED): rules are
+    group-contiguous (compiler.pack sorts by (group, policy)), so each
+    chunk reduces every group over ONE static column slice — 2 passes
+    over the [B, Rc] masked matrices total instead of 2 * n_groups masked
+    passes. `segs` is a static per-chunk tuple of (group, start, end)
+    local column ranges (padding columns excluded; they are never
+    satisfied anyway). Chunks unroll as a Python loop because the segment
+    lists differ per chunk — C is small (R/4096)."""
+    B = lit.shape[0]
+    first = jnp.full((B, n_groups), INT32_MAX, dtype=jnp.int32)
+    last = jnp.full((B, n_groups), -1, dtype=jnp.int32)
+    bits_parts = []
+    for ci in range(W_chunks.shape[0]):
+        scores = _scores(lit, W_chunks[ci])
+        sat = scores >= thresh_c[ci][None, :]
+        masked_min = jnp.where(sat, policy_c[ci][None, :], INT32_MAX)
+        masked_max = jnp.where(sat, policy_c[ci][None, :], -1)
+        for g, a, b in segs[ci]:
+            first = first.at[:, g].min(jnp.min(masked_min[:, a:b], axis=1))
+            last = last.at[:, g].max(jnp.max(masked_max[:, a:b], axis=1))
+        if want_bits:
+            bits_parts.append(_pack_sat_bits(sat))
+    bits = jnp.concatenate(bits_parts, axis=1) if want_bits else None
+    return first, last, bits
+
+
 def _tier_walk(first, last, n_tiers: int):
     """Walk tiers on device -> packed uint32 verdict word per request.
     Mirrors TieredPolicyStores semantics (/root/reference
@@ -279,7 +309,8 @@ def _compact_flagged_bits(bits, flagged, n_valid):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_tiers", "want_full", "want_bits", "has_gate")
+    jax.jit,
+    static_argnames=("n_tiers", "want_full", "want_bits", "has_gate", "segs"),
 )
 def match_rules_codes(
     codes,
@@ -294,6 +325,7 @@ def match_rules_codes(
     want_bits: bool = False,
     n_valid=None,
     has_gate: bool = False,
+    segs=None,
 ):
     """Feature-code variant of match_rules_device: the literal expansion
     happens ON DEVICE from the activation table, so the host ships one
@@ -319,22 +351,29 @@ def match_rules_codes(
     lit = _lit_matrix_codes(codes, extras, act_rows, _lit_dtype(W_chunks.dtype))
     return _match_from_lit(
         lit, W_chunks, thresh_c, group_c, policy_c, n_tiers,
-        want_full, want_bits, n_valid, has_gate,
+        want_full, want_bits, n_valid, has_gate, segs,
     )
 
 
 def _match_from_lit(
     lit, W_chunks, thresh_c, group_c, policy_c, n_tiers: int,
-    want_full: bool, want_bits: bool, n_valid, has_gate: bool,
+    want_full: bool, want_bits: bool, n_valid, has_gate: bool, segs=None,
 ):
     """Shared post-literal-expansion body of match_rules_codes and its wire
-    variant: scores + first-match scan + tier walk + gate bit + (optional)
+    variant: scores + first-match reduction (segmented when `segs` is
+    given, masked scan otherwise) + tier walk + gate bit + (optional)
     flagged-row bits compaction."""
     n_groups = n_tiers * _GPT + (1 if has_gate else 0)
-    first, last, bits = _first_match(
-        lit, W_chunks, thresh_c, group_c, policy_c, n_groups,
-        want_bits=want_bits,
-    )
+    if segs is not None:
+        first, last, bits = _first_match_seg(
+            lit, W_chunks, thresh_c, policy_c, segs, n_groups,
+            want_bits=want_bits,
+        )
+    else:
+        first, last, bits = _first_match(
+            lit, W_chunks, thresh_c, group_c, policy_c, n_groups,
+            want_bits=want_bits,
+        )
     packed = _tier_walk(first, last, n_tiers)
     if has_gate:
         gate = (first[:, n_tiers * _GPT] != INT32_MAX).astype(jnp.uint32)
@@ -383,7 +422,8 @@ def _lit_matrix_codes_wire(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_tiers", "want_full", "want_bits", "has_gate")
+    jax.jit,
+    static_argnames=("n_tiers", "want_full", "want_bits", "has_gate", "segs"),
 )
 def match_rules_codes_wire(
     codes8,
@@ -400,6 +440,7 @@ def match_rules_codes_wire(
     want_bits: bool = False,
     n_valid=None,
     has_gate: bool = False,
+    segs=None,
 ):
     """match_rules_codes over the split u8 wire layout (see
     _lit_matrix_codes_wire and engine._CompiledSet.wire): identical
@@ -409,7 +450,7 @@ def match_rules_codes_wire(
     )
     return _match_from_lit(
         lit, W_chunks, thresh_c, group_c, policy_c, n_tiers,
-        want_full, want_bits, n_valid, has_gate,
+        want_full, want_bits, n_valid, has_gate, segs,
     )
 
 

@@ -1,0 +1,127 @@
+"""Segmented-reduction kernel plane tests (CEDAR_TPU_SEGRED=1).
+
+pack() lays rules out group-contiguously, so the per-group first/last-
+match can reduce over static column segments (ops/match.py
+_first_match_seg) instead of n_groups masked passes. The plane is opt-in
+until tools/hw_validate.py shows a measured win on hardware; these tests
+pin exact equality against the default scan plane either way.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from cedar_tpu.compiler.table import encode_request_codes
+from cedar_tpu.engine.evaluator import TPUPolicyEngine, _segment_plan
+from cedar_tpu.lang import PolicySet
+
+from tests.test_wire import _random_set_and_items
+
+
+def _load(monkeypatch, src, segred):
+    monkeypatch.setenv("CEDAR_TPU_SEGRED", "1" if segred else "0")
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "t0")], warm="off")
+    return engine
+
+
+def test_segment_plan_covers_every_live_rule(monkeypatch):
+    """The static segments partition exactly the live (non-padding)
+    columns of every chunk, each run carrying one group."""
+    src, _items = _random_set_and_items(seed=21)
+    engine = _load(monkeypatch, src, True)
+    cs = engine._compiled
+    assert cs.segs is not None
+    packed = cs.packed
+    # reconstruct the chunked group layout the plan was built from
+    from cedar_tpu.ops.match import chunk_rules
+
+    _w, _t, group_c, _p = chunk_rules(
+        packed.W, packed.thresh, packed.rule_group, packed.rule_policy
+    )
+    C, rc = group_c.shape
+    assert len(cs.segs) == C
+    covered = 0
+    for ci, runs in enumerate(cs.segs):
+        limit = min(rc, max(0, packed.n_rules - ci * rc))
+        prev_end = 0
+        for g, a, b in runs:
+            assert a == prev_end and b <= limit
+            assert (group_c[ci, a:b] == g).all()
+            prev_end = b
+            covered += b - a
+        assert prev_end == limit
+    assert covered == packed.n_rules
+    # group-contiguity across the whole layout (pack's sort invariant)
+    live = packed.rule_group[: packed.n_rules]
+    assert (np.diff(live) >= 0).all(), "rules not sorted by group"
+
+
+def test_segred_and_scan_planes_agree(monkeypatch):
+    src, items = _random_set_and_items(seed=22)
+    res_on = _load(monkeypatch, src, True).evaluate_batch(items)
+    res_off = _load(monkeypatch, src, False).evaluate_batch(items)
+    for (d1, g1), (d2, g2) in zip(res_on, res_off):
+        assert d1 == d2
+        assert {r.policy for r in g1.reasons} == {r.policy for r in g2.reasons}
+        assert len(g1.errors) == len(g2.errors)
+
+
+def test_segred_kernel_words_full_and_bits_match_scan(monkeypatch):
+    """Kernel-level equality incl. want_full matrices and the want_bits
+    diagnostics plane, over the exact same encoded rows."""
+    src, items = _random_set_and_items(seed=23)
+    eng_on = _load(monkeypatch, src, True)
+    eng_off = _load(monkeypatch, src, False)
+    cs_on, cs_off = eng_on._compiled, eng_off._compiled
+    rows = [
+        encode_request_codes(cs_on.packed.plan, cs_on.packed.table, em, rq)
+        for em, rq in items
+    ]
+    S = cs_on.packed.table.n_slots
+    codes = np.zeros((len(rows), S), dtype=np.int32)
+    max_e = max((len(e) for _c, e in rows), default=0)
+    extras = np.full((len(rows), max(max_e, 1)), cs_on.packed.L, np.int32)
+    for i, (c, e) in enumerate(rows):
+        codes[i] = c
+        if e:
+            extras[i, : len(e)] = e
+    w_on, full_on, bm_on = eng_on.match_arrays(
+        codes, extras, cs=cs_on, want_full=True, want_bits=True
+    )
+    w_off, full_off, bm_off = eng_off.match_arrays(
+        codes, extras, cs=cs_off, want_full=True, want_bits=True
+    )
+    np.testing.assert_array_equal(np.asarray(w_on), np.asarray(w_off))
+    np.testing.assert_array_equal(np.asarray(full_on[0]), np.asarray(full_off[0]))
+    np.testing.assert_array_equal(np.asarray(full_on[1]), np.asarray(full_off[1]))
+    assert set(bm_on) == set(bm_off)
+    for k in bm_on:
+        np.testing.assert_array_equal(bm_on[k], bm_off[k])
+
+
+def test_segred_with_gate_plane(monkeypatch):
+    """A fallback policy's gate rules ride group n_tiers*3 — the LAST
+    segment after the sort; gated rows must still re-route identically."""
+    src, items = _random_set_and_items(seed=24, n_policies=20)
+    src += (
+        '\npermit (principal, action == k8s::Action::"get",'
+        " resource is k8s::Resource)"
+        " unless { resource has name && ip(resource.name).isLoopback() };"
+    )
+    res_on = _load(monkeypatch, src, True).evaluate_batch(items)
+    res_off = _load(monkeypatch, src, False).evaluate_batch(items)
+    for (d1, g1), (d2, g2) in zip(res_on, res_off):
+        assert d1 == d2
+        assert {r.policy for r in g1.reasons} == {r.policy for r in g2.reasons}
+
+
+def test_segment_plan_unit():
+    group_c = np.array([[0, 0, 1, 1], [1, 2, 2, 0]], dtype=np.int32)
+    # 6 live rules: chunk 1's trailing columns are padding
+    segs = _segment_plan(group_c, 6)
+    assert segs == (((0, 0, 2), (1, 2, 4)), ((1, 0, 1), (2, 1, 2)))
+    # exactly full: padding-free plan covers everything
+    segs = _segment_plan(group_c, 8)
+    assert segs[1] == ((1, 0, 1), (2, 1, 3), (0, 3, 4))

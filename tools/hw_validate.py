@@ -106,6 +106,41 @@ def main() -> int:
     rates = {}
     for env_val, key in (("1", "int8"), ("0", "bf16")):
         rates[key] = device_rate(env_val)
+
+    def segred_rate() -> int:
+        """int8 plane + the segmented-reduction kernel (CEDAR_TPU_SEGRED):
+        candidate cut of the XLA plane's non-matmul device cost."""
+        os.environ["CEDAR_TPU_INT8"] = "1"
+        os.environ["CEDAR_TPU_SEGRED"] = "1"
+        try:
+            engine = TPUPolicyEngine()
+            engine.load([ps], warm="off")
+            cs = engine._compiled
+            packed = cs.packed
+            S = packed.table.n_slots
+            codes = np.zeros((SB, S), dtype=cs.code_dtype)
+            extras = np.full((SB, 8), packed.L, dtype=cs.active_dtype)
+            args = (
+                cs.act_rows_dev, cs.W_dev, cs.thresh_dev,
+                cs.rule_group_dev, cs.rule_policy_dev,
+            )
+            cb, eb = jax.device_put(codes), jax.device_put(extras)
+            return median3(
+                lambda: match_rules_codes(
+                    cb, eb, *args, packed.n_tiers, False, False, None,
+                    packed.has_gate, cs.segs,
+                )[0]
+            )
+        finally:
+            os.environ["CEDAR_TPU_SEGRED"] = "0"
+
+    try:
+        out["segred_int8_resident_rate"] = segred_rate()
+        out["segred_vs_scan_speedup"] = round(
+            out["segred_int8_resident_rate"] / max(rates["int8"], 1), 3
+        )
+    except Exception as e:  # noqa: BLE001 — report, don't crash the probe
+        out["segred_int8_resident_rate"] = f"error: {type(e).__name__}: {e}"
     out["device_resident_rate_int8"] = rates["int8"]
     out["device_resident_rate_bf16"] = rates["bf16"]
     out["int8_speedup"] = round(rates["int8"] / max(rates["bf16"], 1), 3)
