@@ -66,6 +66,11 @@ validate-policies: ## Validate every .cedar file against the full schema
 	    --schema cedarschema/k8s-full.cedarschema.json \
 	    $$(find . -name '*.cedar' -not -path './.git/*')
 
+.PHONY: format-policies
+format-policies: ## Canonicalize .cedar policy files in place (goldens excluded; commented files skipped)
+	$(PYTHON) -m cedar_tpu.cli.policy_formatter \
+	    $$(find demo mount -name '*.cedar' 2>/dev/null)
+
 .PHONY: convert-rbac
 convert-rbac: ## Convert the cluster's RBAC to Cedar (needs kubeconfig)
 	$(PYTHON) -m cedar_tpu.cli.converter clusterrolebindings --output cedar
@@ -86,11 +91,12 @@ demo-server: ## Run the webhook locally against the demo policies
 	    --backend tpu --cert-dir /tmp/cedar-demo/certs
 
 .PHONY: demo-policies
-demo-policies: ## Render demo/*.yaml Policy content into mount/policies/
+demo-policies: ## Render demo/*.yaml Policy content into mount/policies/ (canonical layout)
 	$(PYTHON) -c "import yaml,pathlib; \
 	  docs=[d for d in yaml.safe_load_all(open('demo/authorization-policy.yaml')) if d]; \
 	  pathlib.Path('mount/policies/demo.cedar').write_text( \
 	      chr(10).join(d['spec']['content'] for d in docs))"
+	$(PYTHON) -m cedar_tpu.cli.policy_formatter mount/policies/demo.cedar
 
 .PHONY: kind
 kind: image demo-policies ## Create a kind cluster serving the webhook static pod

@@ -87,3 +87,54 @@ def test_policy_roundtrip(src):
     text = format_policy_set(ps)
     ps2 = PolicySet.from_source(text, "roundtrip")
     assert format_policy_set(ps2) == text  # fixpoint after one round
+
+
+def test_policy_formatter_cli(tmp_path):
+    """The format-policies CLI: canonicalizes a file in place, is
+    idempotent, preserves LEADING per-policy comments, skips files with
+    inline/trailing comments unless --strip-comments, never
+    false-positives on // inside string literals, and --check flags
+    non-canonical files."""
+    from cedar_tpu.cli.policy_formatter import format_source, main
+
+    raw = (
+        'permit(principal,action    ==\n k8s::Action::"get",'
+        "resource is k8s::Resource)   when{principal.name=="
+        '"alice"};'
+    )
+    f = tmp_path / "p.cedar"
+    f.write_text(raw)
+    assert main(["--check", str(f)]) == 1  # non-canonical detected
+    assert main([str(f)]) == 0
+    canon = f.read_text()
+    assert canon == format_source(raw)
+    assert main(["--check", str(f)]) == 0  # idempotent
+    # decisions preserved through the rewrite
+    from cedar_tpu.lang import PolicySet
+
+    before = PolicySet.from_source(raw, "a")
+    after = PolicySet.from_source(canon, "a")
+    assert len(before.policies()) == len(after.policies())
+    # leading per-policy comments are RE-ATTACHED, not dropped
+    g = tmp_path / "c.cedar"
+    g.write_text("// keep me\n// and me\n" + raw)
+    assert main([str(g)]) == 0
+    assert g.read_text().startswith("// keep me\n// and me\npermit (")
+    assert main(["--check", str(g)]) == 0  # idempotent with comments
+    # // inside a string literal is NOT a comment (no skip, no mangling)
+    h = tmp_path / "s.cedar"
+    h.write_text(
+        "permit(principal,action,resource)"
+        'when{principal.name=="https://x//y"};'
+    )
+    assert main([str(h)]) == 0
+    assert '"https://x//y"' in h.read_text()
+    # inline (unattachable) comment: skipped untouched; forced strip drops
+    k = tmp_path / "k.cedar"
+    k.write_text("permit(principal,action,resource); // trailing\n")
+    assert main([str(k)]) == 0
+    assert "// trailing" in k.read_text()
+    assert main(["--strip-comments", str(k)]) == 0
+    assert "//" not in k.read_text()
+    # empty file list is a no-op success (Makefile find may match nothing)
+    assert main([]) == 0
