@@ -77,7 +77,14 @@ def format_source(text: str, strip_comments: bool = False) -> str:
     for p in pols:
         lead: List[str] = []
         j = p.position[1] - 2  # 0-based index of the line above the policy
-        while j >= 0 and lines[j].lstrip().startswith("//"):
+        # stop at lines another policy already claimed: two policies on
+        # one source line share the same "line above" — the comment
+        # attaches to the FIRST of them only, never duplicated
+        while (
+            j >= 0
+            and j not in attached
+            and lines[j].lstrip().startswith("//")
+        ):
             lead.append(lines[j].strip())
             attached.add(j)
             j -= 1

@@ -2196,6 +2196,7 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
   }
 
   std::string vcanon;  // the slot value's canon: vocab key + dyn eq operand
+  std::vector<std::string> ecs;  // SET slots: per-element canons, built ONCE
   for (const auto &s : t.slots) {
     const CVal *root = s.var == 0   ? f.p_rec
                        : s.var == 2 ? f.res
@@ -2203,7 +2204,26 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
                                     : nullptr;
     const CVal *v = root ? cval_nav(root, s.comps) : nullptr;
     vcanon.clear();
-    if (v) canon_cval(v, vcanon);
+    ecs.clear();
+    const bool is_set = v && v->kind == CVal::SETV;
+    if (is_set) {
+      // one element-canon pass serves all three consumers: the set's own
+      // canon (canon_set_into — identical construction to canon_cval's
+      // SETV branch, sorting + deduping ecs in place, which membership
+      // probes below don't care about), the dyn tests, and the set_has
+      // probes. The previous shape canonicalized every element up to
+      // THREE times per slot — ~1.2us per labels/annotations entry on
+      // the admission walk.
+      ecs.reserve(v->elems.size());
+      for (const CVal *e : v->elems) {
+        std::string ec;
+        canon_cval(e, ec);
+        ecs.push_back(std::move(ec));
+      }
+      canon_set_into(vcanon, ecs);
+    } else if (v) {
+      canon_cval(v, vcanon);
+    }
     if (!s.dyns.empty()) {
       auto slot_canon = [&f](uint8_t var, const std::vector<std::string> &c,
                              std::string &out) {
@@ -2216,19 +2236,8 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
         canon_cval(sval, out);
         return true;
       };
-      std::vector<std::string> ecs;
-      const std::vector<std::string> *elems = nullptr;
-      if (v && v->kind == CVal::SETV) {
-        ecs.reserve(v->elems.size());
-        for (const CVal *e : v->elems) {
-          std::string ec;
-          canon_cval(e, ec);
-          ecs.push_back(std::move(ec));
-        }
-        elems = &ecs;
-      }
-      eval_dyns(s, elems, v ? &vcanon : nullptr, slot_canon, extras,
-                scratch);
+      eval_dyns(s, is_set ? &ecs : nullptr, v ? &vcanon : nullptr,
+                slot_canon, extras, scratch);
     }
     if (!v) continue;
     const int32_t *row = sv_find(s.vocab, vcanon);
@@ -2250,10 +2259,8 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
         }
       }
     }
-    if (v->kind == CVal::SETV && !s.set_has.empty()) {
-      for (const CVal *e : v->elems) {
-        std::string ec;
-        canon_cval(e, ec);
+    if (is_set && !s.set_has.empty()) {
+      for (const auto &ec : ecs) {  // canons already built above
         const auto *lits = sv_find(s.set_has, ec);
         if (lits)
           for (int32_t lid : *lits) extras.push(lid);

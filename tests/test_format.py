@@ -138,3 +138,16 @@ def test_policy_formatter_cli(tmp_path):
     assert "//" not in k.read_text()
     # empty file list is a no-op success (Makefile find may match nothing)
     assert main([]) == 0
+
+
+def test_policy_formatter_shared_line_comment_not_duplicated():
+    """Two policies on one source line share the same 'line above': the
+    leading comment attaches to the FIRST only (review finding, round 5)."""
+    from cedar_tpu.cli.policy_formatter import format_source
+
+    out = format_source(
+        "// note\npermit(principal,action,resource); "
+        "permit(principal is k8s::User,action,resource);"
+    )
+    assert out.count("// note") == 1
+    assert out.startswith("// note\npermit")
