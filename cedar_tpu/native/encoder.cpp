@@ -1476,6 +1476,13 @@ struct CVal {
   bool b = false;
   std::vector<std::pair<sv, CVal *>> fields;  // RECV
   std::vector<CVal *> elems;                  // SETV
+  // memoized canonical key: several table slots (and the dyn template
+  // resolver) canonicalize the SAME node per request — labels-bearing
+  // admission objects paid ~1us/entry re-canonicalizing across slots.
+  // Valid iff canon_done; make() clears the flag, the string keeps its
+  // capacity across pool reuse.
+  std::string canon;
+  bool canon_done = false;
 };
 
 class CPool {
@@ -1490,6 +1497,7 @@ class CPool {
     v->b = false;
     v->fields.clear();
     v->elems.clear();
+    v->canon_done = false;
     return v;
   }
   void reset() { used_ = 0; }
@@ -1825,7 +1833,12 @@ CVal *adm_top_record(AdmCtx &c, const JVal *obj) {
   return r;
 }
 
-void canon_cval(const CVal *v, std::string &out) {
+void canon_cval(const CVal *v, std::string &out);
+
+// one canon construction per node per request: recursive calls route
+// through the memoized canon_cval wrapper below, so nested sets/records
+// cache too (CVal.canon / canon_done, cleared by CPool::make)
+void canon_cval_build(const CVal *v, std::string &out) {
   switch (v->kind) {
     case CVal::STRV:
       canon_str_into(out, v->str);
@@ -1898,6 +1911,16 @@ void canon_cval(const CVal *v, std::string &out) {
       return;
     }
   }
+}
+
+void canon_cval(const CVal *v, std::string &out) {
+  if (!v->canon_done) {
+    CVal *m = const_cast<CVal *>(v);  // pooled storage is never truly const
+    m->canon.clear();
+    canon_cval_build(v, m->canon);
+    m->canon_done = true;
+  }
+  out += v->canon;
 }
 
 const CVal *cval_nav(const CVal *root, const std::vector<std::string> &comps) {
@@ -2206,14 +2229,16 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
     vcanon.clear();
     ecs.clear();
     const bool is_set = v && v->kind == CVal::SETV;
-    if (is_set) {
+    const bool want_elems = is_set && (!s.dyns.empty() || !s.set_has.empty());
+    if (want_elems) {
       // one element-canon pass serves all three consumers: the set's own
       // canon (canon_set_into — identical construction to canon_cval's
       // SETV branch, sorting + deduping ecs in place, which membership
       // probes below don't care about), the dyn tests, and the set_has
       // probes. The previous shape canonicalized every element up to
       // THREE times per slot — ~1.2us per labels/annotations entry on
-      // the admission walk.
+      // the admission walk. (Element canons themselves are memoized on
+      // the CVal nodes, so repeat visits copy cached strings.)
       ecs.reserve(v->elems.size());
       for (const CVal *e : v->elems) {
         std::string ec;
@@ -2222,6 +2247,7 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
       }
       canon_set_into(vcanon, ecs);
     } else if (v) {
+      // no per-element consumers: the memoized node canon covers sets too
       canon_cval(v, vcanon);
     }
     if (!s.dyns.empty()) {
@@ -2236,7 +2262,7 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
         canon_cval(sval, out);
         return true;
       };
-      eval_dyns(s, is_set ? &ecs : nullptr, v ? &vcanon : nullptr,
+      eval_dyns(s, want_elems ? &ecs : nullptr, v ? &vcanon : nullptr,
                 slot_canon, extras, scratch);
     }
     if (!v) continue;

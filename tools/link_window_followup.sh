@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-5 link-window follow-up automation. Parked alongside the bench
+# waiter: when bench_r05_hw_run3.out gains its JSON line (the waiter's
+# bench completed on a live link), this script
+#   1. runs tools/hw_validate.py -> HWVAL_r05b.json (plane decision data:
+#      pallas + segred throughput at the headline shape), then
+#   2. if the segred plane beats the scan plane by >15% on hardware,
+#      banks a CEDAR_TPU_SEGRED=1 bench record too (run4).
+# Everything is timeout-bounded; the script exits after one window.
+set -u
+cd /root/repo
+
+OUT=bench_r05_hw_run3.out
+DEADLINE=$(( $(date +%s) + 6*3600 ))
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    if [ -s "$OUT" ] && grep -q '"metric"' "$OUT" 2>/dev/null; then
+        break
+    fi
+    sleep 60
+done
+if ! grep -q '"metric"' "$OUT" 2>/dev/null; then
+    echo "followup: no bench record appeared within budget" >&2
+    exit 1
+fi
+
+echo "followup: bench record detected; running hw_validate" >&2
+timeout 2400 python tools/hw_validate.py > HWVAL_r05b.json 2>hwval_r05b.log
+if ! grep -q '"ok": true' HWVAL_r05b.json 2>/dev/null; then
+    echo "followup: hw_validate did not complete ok" >&2
+    exit 1
+fi
+
+SPEEDUP=$(python - <<'EOF'
+import json
+d = json.load(open("HWVAL_r05b.json"))
+v = d.get("segred_vs_scan_speedup")
+print(v if isinstance(v, (int, float)) else 0)
+EOF
+)
+echo "followup: segred_vs_scan_speedup=$SPEEDUP" >&2
+if python -c "import sys; sys.exit(0 if float('$SPEEDUP') > 1.15 else 1)"; then
+    echo "followup: segred wins on hardware; banking a segred bench" >&2
+    CEDAR_TPU_SEGRED=1 CEDAR_BENCH_DEADLINE_S=3000 \
+        timeout 3600 python bench.py > bench_r05_hw_run4.out 2> bench_r05_hw_run4.log
+fi
+echo "followup: done" >&2
