@@ -2242,10 +2242,20 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
       ecs.reserve(v->elems.size());
       for (const CVal *e : v->elems) {
         std::string ec;
-        canon_cval(e, ec);
+        canon_cval(e, ec);  // element canons memoized on the nodes
         ecs.push_back(std::move(ec));
       }
-      canon_set_into(vcanon, ecs);
+      if (v->canon_done) {
+        // set-level canon already memoized (another slot visited this
+        // node): skip the re-sort; membership probes below don't need
+        // ecs sorted or deduped
+        vcanon += v->canon;
+      } else {
+        canon_set_into(vcanon, ecs);
+        CVal *m = const_cast<CVal *>(v);
+        m->canon = vcanon;
+        m->canon_done = true;
+      }
     } else if (v) {
       // no per-element consumers: the memoized node canon covers sets too
       canon_cval(v, vcanon);

@@ -182,9 +182,27 @@ def _first_match_seg(
         sat = scores >= thresh_c[ci][None, :]
         masked_min = jnp.where(sat, policy_c[ci][None, :], INT32_MAX)
         masked_max = jnp.where(sat, policy_c[ci][None, :], -1)
-        for g, a, b in segs[ci]:
-            first = first.at[:, g].min(jnp.min(masked_min[:, a:b], axis=1))
-            last = last.at[:, g].max(jnp.max(masked_max[:, a:b], axis=1))
+        # assemble the chunk's per-group reductions as ONE stacked [B, G]
+        # update (a chunk holds at most one contiguous run per group), not
+        # a chain of .at[] scatters — dynamic-update-slice chains compile
+        # poorly (the XLA CPU emitter pathologically so at the headline
+        # shape; see docs/Limitations.md)
+        gmin = {g: jnp.min(masked_min[:, a:b], axis=1) for g, a, b in segs[ci]}
+        gmax = {g: jnp.max(masked_max[:, a:b], axis=1) for g, a, b in segs[ci]}
+        none_min = jnp.full((B,), INT32_MAX, dtype=jnp.int32)
+        none_max = jnp.full((B,), -1, dtype=jnp.int32)
+        first = jnp.minimum(
+            first,
+            jnp.stack(
+                [gmin.get(g, none_min) for g in range(n_groups)], axis=1
+            ),
+        )
+        last = jnp.maximum(
+            last,
+            jnp.stack(
+                [gmax.get(g, none_max) for g in range(n_groups)], axis=1
+            ),
+        )
         if want_bits:
             bits_parts.append(_pack_sat_bits(sat))
     bits = jnp.concatenate(bits_parts, axis=1) if want_bits else None

@@ -62,10 +62,11 @@ def main() -> int:
 
     SB = 4096 if small else 131072
 
-    def timed_rate(one) -> float:
+    def timed_rate(one, rows: int) -> float:
         """One pipelined timing pass: 6 async dispatches of `one()`
         (a device call returning the words array) drained together —
-        the SAME harness for every plane so rates stay comparable."""
+        the SAME harness for every plane and shape so rates stay
+        comparable."""
         n_pipe = 6
         t = time.time()
         ws = []
@@ -75,11 +76,12 @@ def main() -> int:
             ws.append(w)
         for w in ws:
             np.asarray(w)
-        return SB * n_pipe / (time.time() - t)
+        return rows * n_pipe / (time.time() - t)
 
-    def median3(one) -> int:
+    def median3(one, rows=None) -> int:
         np.asarray(one())  # compile + warm
-        return round(sorted(timed_rate(one) for _ in range(3))[1])
+        rows = SB if rows is None else rows
+        return round(sorted(timed_rate(one, rows) for _ in range(3))[1])
 
     def device_rate(env_val: str) -> int:
         import os
@@ -107,40 +109,60 @@ def main() -> int:
     for env_val, key in (("1", "int8"), ("0", "bf16")):
         rates[key] = device_rate(env_val)
 
-    def segred_rate() -> int:
-        """int8 plane + the segmented-reduction kernel (CEDAR_TPU_SEGRED):
-        candidate cut of the XLA plane's non-matmul device cost."""
+    def plane_rate(segred: bool, rows: int) -> int:
+        """int8 plane at a given batch shape, scan or segmented kernel.
+        BOTH shapes matter: the serving path dispatches <= 16384-row
+        chunks (fastpath._CHUNK) while the bench headline runs
+        131072-row super-batches — on the CPU backend the segmented
+        plane wins the former and loses the latter (memory pressure
+        from the unrolled per-chunk score intermediates), so the flip
+        decision needs the TPU number for each regime."""
         os.environ["CEDAR_TPU_INT8"] = "1"
-        os.environ["CEDAR_TPU_SEGRED"] = "1"
+        os.environ["CEDAR_TPU_SEGRED"] = "1" if segred else "0"
         try:
             engine = TPUPolicyEngine()
             engine.load([ps], warm="off")
             cs = engine._compiled
             packed = cs.packed
             S = packed.table.n_slots
-            codes = np.zeros((SB, S), dtype=cs.code_dtype)
-            extras = np.full((SB, 8), packed.L, dtype=cs.active_dtype)
+            codes = np.zeros((rows, S), dtype=cs.code_dtype)
+            extras = np.full((rows, 8), packed.L, dtype=cs.active_dtype)
             args = (
                 cs.act_rows_dev, cs.W_dev, cs.thresh_dev,
                 cs.rule_group_dev, cs.rule_policy_dev,
             )
             cb, eb = jax.device_put(codes), jax.device_put(extras)
+
             return median3(
                 lambda: match_rules_codes(
                     cb, eb, *args, packed.n_tiers, False, False, None,
                     packed.has_gate, cs.segs,
-                )[0]
+                )[0],
+                rows=rows,
             )
         finally:
             os.environ["CEDAR_TPU_SEGRED"] = "0"
 
-    try:
-        out["segred_int8_resident_rate"] = segred_rate()
+    serving_rows = 2048 if small else 16384
+    for key, segred, rows in (
+        ("segred_int8_resident_rate", True, SB),
+        ("segred_serving_rate", True, serving_rows),
+        ("scan_serving_rate", False, serving_rows),
+    ):
+        try:
+            out[key] = plane_rate(segred, rows)
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            out[key] = f"error: {type(e).__name__}: {e}"
+    if isinstance(out.get("segred_int8_resident_rate"), int):
         out["segred_vs_scan_speedup"] = round(
             out["segred_int8_resident_rate"] / max(rates["int8"], 1), 3
         )
-    except Exception as e:  # noqa: BLE001 — report, don't crash the probe
-        out["segred_int8_resident_rate"] = f"error: {type(e).__name__}: {e}"
+    if isinstance(out.get("segred_serving_rate"), int) and isinstance(
+        out.get("scan_serving_rate"), int
+    ):
+        out["segred_vs_scan_serving_speedup"] = round(
+            out["segred_serving_rate"] / max(out["scan_serving_rate"], 1), 3
+        )
     out["device_resident_rate_int8"] = rates["int8"]
     out["device_resident_rate_bf16"] = rates["bf16"]
     out["int8_speedup"] = round(rates["int8"] / max(rates["bf16"], 1), 3)
