@@ -130,6 +130,14 @@ def _segment_plan(group_c: np.ndarray, n_rules: int):
                 k += 1
             runs.append((g, j, k))
             j = k
+        # the kernel's per-chunk {group: reduction} assembly keeps ONE
+        # entry per group — valid only while pack's (group, policy) sort
+        # yields one contiguous run per group per chunk. A layout change
+        # that breaks that must fail the compile, not mis-reduce silently.
+        if len({g for g, _a, _b in runs}) != len(runs):
+            raise AssertionError(
+                f"rule layout not group-contiguous in chunk {ci}: {runs}"
+            )
         segs.append(tuple(runs))
     return tuple(segs)
 

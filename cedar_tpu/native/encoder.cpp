@@ -2247,8 +2247,13 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
       }
       if (v->canon_done) {
         // set-level canon already memoized (another slot visited this
-        // node): skip the re-sort; membership probes below don't need
-        // ecs sorted or deduped
+        // node): reuse it — but STILL sort+dedupe ecs so the set_has /
+        // dyn membership probes see exactly what the first-visit path
+        // (canon_set_into) sees: a duplicated JSON element must push
+        // each matching lit ONCE, in the same deterministic order, on
+        // every visit and on the Python lane alike
+        std::sort(ecs.begin(), ecs.end());
+        ecs.erase(std::unique(ecs.begin(), ecs.end()), ecs.end());
         vcanon += v->canon;
       } else {
         canon_set_into(vcanon, ecs);
