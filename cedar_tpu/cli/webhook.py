@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import hashlib
 import logging
+import os
 import signal
 import sys
 import threading
@@ -126,6 +127,31 @@ class TPUReloader:
 
 
 def build_server(args) -> WebhookServer:
+    # serving-plane default: the segmented-reduction kernel measurably
+    # wins at serving-chunk batch sizes on the CPU BACKEND (2-6x the
+    # device-side rate at 8-16k rows, BENCH_r05_cpu_backend2 era probes),
+    # where the matmul has no MXU and the scan plane's n_groups masked
+    # passes dominate. TPU keeps the scan default until hw_validate's
+    # two-regime numbers justify a flip (docs/Limitations.md). Explicit
+    # CEDAR_TPU_SEGRED always wins; the preference is passed to the
+    # engines directly (never via os.environ — a global flip would leak
+    # into unrelated engines in the same process).
+    segred = None
+    if (
+        args.backend == "tpu"
+        and not getattr(args, "mesh", "")  # the sharded pjit plane has no
+        # per-group scan to replace — segs would be silently ignored there
+        and "CEDAR_TPU_SEGRED" not in os.environ
+    ):
+        import jax
+
+        if jax.default_backend() == "cpu":
+            segred = True
+            log.info(
+                "cpu backend: segmented-reduction kernel plane enabled "
+                "(CEDAR_TPU_SEGRED=0 restores the scan plane)"
+            )
+
     config = None
     if args.config:
         with open(args.config) as f:
@@ -158,7 +184,7 @@ def build_server(args) -> WebhookServer:
         eval with an interpreter guard until the first successful load."""
         from ..engine.evaluator import TPUPolicyEngine
 
-        tier_engine = TPUPolicyEngine(mesh=mesh)
+        tier_engine = TPUPolicyEngine(mesh=mesh, segred=segred)
 
         def evaluate(entities, request):
             if not tier_engine.loaded:

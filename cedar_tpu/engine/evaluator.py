@@ -146,7 +146,8 @@ class _CompiledSet:
     """Immutable device-resident compiled policy set (the swap unit)."""
 
     def __init__(
-        self, packed: PackedPolicySet, device=None, use_pallas=False, mesh=None
+        self, packed: PackedPolicySet, device=None, use_pallas=False,
+        mesh=None, segred: "Optional[bool]" = None,
     ):
         import os
 
@@ -219,7 +220,12 @@ class _CompiledSet:
         # acceptable for an experimental plane, documented in
         # docs/Limitations.md alongside the flip criteria
         self.segs = None
-        if os.environ.get("CEDAR_TPU_SEGRED", "0") == "1":
+        use_segred = (
+            segred
+            if segred is not None
+            else os.environ.get("CEDAR_TPU_SEGRED", "0") == "1"
+        )
+        if use_segred:
             self.segs = _segment_plan(group_c, packed.n_rules)
         self.W_dev = jax.device_put(
             W3 if int8_plane else W3.astype(jax.numpy.bfloat16), **kwargs
@@ -336,12 +342,19 @@ class TPUPolicyEngine:
         device=None,
         use_pallas: Optional[bool] = None,
         mesh=None,
+        segred: Optional[bool] = None,
     ):
         """mesh: an optional jax.sharding.Mesh with ("data", "policy") axes
         (parallel.mesh.make_mesh). When set, compiled sets are placed with
         the (data, policy) shardings and every device call routes through
         the pjit steps — batch rows shard over `data`, the rule matmul over
-        `policy`, with XLA inserting the cross-shard min/max reductions."""
+        `policy`, with XLA inserting the cross-shard min/max reductions.
+
+        segred: force the segmented-reduction kernel plane on/off for this
+        engine's compiled sets; None defers to CEDAR_TPU_SEGRED (default
+        off). Passed per engine — never by mutating process env — so one
+        serving process can mix planes (the webhook CLI enables it on the
+        CPU backend, where it measures 2-6x at serving chunk sizes)."""
         import os
 
         self.schema = schema or AUTHZ_SCHEMA_INFO
@@ -359,6 +372,7 @@ class TPUPolicyEngine:
         if mesh is not None:
             use_pallas = False  # the sharded pjit plane replaces pallas
         self.use_pallas = use_pallas
+        self.segred = segred
         self._compiled: Optional[_CompiledSet] = None
         self._lock = threading.Lock()
         self._mesh_steps: dict = {}  # (n_tiers, has_gate) -> pjit step
@@ -398,7 +412,8 @@ class TPUPolicyEngine:
         compiled: CompiledPolicies = lower_tiers(list(tiers), self.schema)
         packed = pack(compiled)
         new = _CompiledSet(
-            packed, self.device, use_pallas=self.use_pallas, mesh=self.mesh
+            packed, self.device, use_pallas=self.use_pallas, mesh=self.mesh,
+            segred=self.segred,
         )
         with self._lock:
             self._compiled = new

@@ -125,3 +125,23 @@ def test_segment_plan_unit():
     # exactly full: padding-free plan covers everything
     segs = _segment_plan(group_c, 8)
     assert segs[1] == ((1, 0, 1), (2, 1, 3), (0, 3, 4))
+
+
+def test_engine_segred_kwarg_overrides_env(monkeypatch):
+    """The per-engine kwarg wins over CEDAR_TPU_SEGRED in both directions
+    (the webhook CLI enables the plane per engine on the CPU backend —
+    never by mutating process env)."""
+    src, items = _random_set_and_items(n_policies=10, n_items=8, seed=31)
+    monkeypatch.setenv("CEDAR_TPU_SEGRED", "0")
+    eng = TPUPolicyEngine(segred=True)
+    eng.load([PolicySet.from_source(src, "t0")], warm="off")
+    assert eng._compiled.segs is not None
+    monkeypatch.setenv("CEDAR_TPU_SEGRED", "1")
+    eng2 = TPUPolicyEngine(segred=False)
+    eng2.load([PolicySet.from_source(src, "t0")], warm="off")
+    assert eng2._compiled.segs is None
+    # and the two planes still agree end to end
+    r1 = eng.evaluate_batch(items)
+    r2 = eng2.evaluate_batch(items)
+    for (d1, _), (d2, _) in zip(r1, r2):
+        assert d1 == d2
