@@ -1146,9 +1146,12 @@ if __name__ == "__main__":
     import sys
 
     was_waiter = bool(os.environ.pop("CEDAR_BENCH_WAIT", ""))
-    if _SMOKE:
-        # cpu-only harness drive: no device probe (it would hang on a dead
-        # tunnel), fail-fast non-cpu backends, straight into main()
+    if _SMOKE or os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        # cpu-only run (smoke, or an explicit JAX_PLATFORMS=cpu fallback
+        # record): no device probe — the probe subprocess would hang on a
+        # dead tunnel even under cpu, because the site hook initializes
+        # the tunneled plugin through backends() (cedar_tpu/jaxenv.py).
+        # Fail-fast non-cpu backends and go straight into main().
         from cedar_tpu.jaxenv import force_cpu
 
         force_cpu()
