@@ -781,19 +781,25 @@ class TPUPolicyEngine:
                         packed.has_gate,
                     )
                     return w, f, None
+            # shape-aware plane selection: the segmented kernel's win is
+            # measured at serving-chunk batch sizes; at super-batch scale
+            # the unrolled per-chunk score intermediates cost more than
+            # the masked scan saves (docs/Limitations.md). Large batches
+            # therefore keep the scan plane even when segs are enabled.
+            segs = cs.segs if chunk_c.shape[0] <= SERVING_CHUNK else None
             if cs.wire is not None:
                 c8, cw = cs.pack_wire(chunk_c)
                 out = match_rules_codes_wire(
                     c8, cw, cs.lo8_dev, chunk_e, *args,
                     packed.n_tiers, want_full, want_bits,
                     np.int32(m) if want_bits else None, packed.has_gate,
-                    cs.segs,
+                    segs,
                 )
             else:
                 out = match_rules_codes(
                     chunk_c, chunk_e, *args, packed.n_tiers, want_full,
                     want_bits, np.int32(m) if want_bits else None,
-                    packed.has_gate, cs.segs,
+                    packed.has_gate, segs,
                 )
             return out if want_bits else (*out, None)
 

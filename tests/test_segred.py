@@ -145,3 +145,42 @@ def test_engine_segred_kwarg_overrides_env(monkeypatch):
     r2 = eng2.evaluate_batch(items)
     for (d1, _), (d2, _) in zip(r1, r2):
         assert d1 == d2
+
+
+def test_shape_gate_selects_plane(monkeypatch):
+    """Batches above SERVING_CHUNK must ride the scan plane even with
+    segments enabled (the ~1GB-intermediate blowup guard,
+    docs/Limitations.md); serving-sized batches keep the segments."""
+    import cedar_tpu.engine.evaluator as ev
+    from cedar_tpu.engine.evaluator import SERVING_CHUNK
+
+    src, _items = _random_set_and_items(n_policies=6, n_items=4, seed=33)
+    eng = TPUPolicyEngine(segred=True)
+    eng.load([PolicySet.from_source(src, "t0")], warm="off")
+    cs = eng._compiled
+    assert cs.segs is not None
+    S = cs.packed.table.n_slots
+    seen = []
+    real_wire = ev.match_rules_codes_wire
+    real_flat = ev.match_rules_codes
+
+    def spy_wire(*a, **k):
+        seen.append(a[-1] if not k else k.get("segs", a[-1]))
+        return real_wire(*a, **k)
+
+    def spy_flat(*a, **k):
+        seen.append(a[-1] if not k else k.get("segs", a[-1]))
+        return real_flat(*a, **k)
+
+    monkeypatch.setattr(ev, "match_rules_codes_wire", spy_wire)
+    monkeypatch.setattr(ev, "match_rules_codes", spy_flat)
+
+    def run(n):
+        codes = np.zeros((n, S), dtype=np.int32)
+        extras = np.full((n, 1), cs.packed.L, dtype=np.int32)
+        eng.match_arrays(codes, extras, cs=cs)
+
+    run(64)  # serving-sized: segments used
+    assert seen and seen[-1] is not None
+    run(SERVING_CHUNK + 1)  # pads above the gate: scan plane
+    assert seen[-1] is None
