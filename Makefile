@@ -37,6 +37,16 @@ test: ## Run the unit + differential test suite (virtual CPU devices)
 bench: ## Run the headline benchmark on the attached device
 	$(PYTHON) bench.py
 
+.PHONY: hw-validate
+hw-validate: ## Measure kernel planes (int8/bf16/pallas/segred) on the attached device
+	$(PYTHON) tools/hw_validate.py
+
+.PHONY: fuzz-soak
+fuzz-soak: ## Differential fuzz soak over fresh seed ranges (cpu backend)
+	for m in single multitier admission mutate mutate-adm; do \
+	    $(PYTHON) tools/fuzz_soak.py --mode $$m --start $${START:-200000} --count $${COUNT:-300}; \
+	done
+
 .PHONY: graft-check
 graft-check: ## Compile-check the jittable entry + multi-chip dry run
 	$(PYTHON) __graft_entry__.py
