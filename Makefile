@@ -30,8 +30,12 @@ image: ## Build the webhook container image
 ##@ Test
 
 .PHONY: test
-test: ## Run the unit + differential test suite (virtual CPU devices)
-	$(PYTHON) -m pytest tests/ -q
+test: ## Run the unit + differential test suite (virtual CPU devices; chaos/slow excluded — see `make chaos`)
+	$(PYTHON) -m pytest tests/ -q -m "not slow"
+
+.PHONY: chaos
+chaos: ## Run the fault-injection resilience suite (cpu backend)
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_resilience.py -q -m chaos
 
 .PHONY: bench
 bench: ## Run the headline benchmark on the attached device

@@ -22,7 +22,6 @@ import os
 import signal
 import sys
 import threading
-import time
 from typing import List, Optional
 
 from ..server.admission import (
@@ -179,32 +178,75 @@ def build_server(args) -> WebhookServer:
             mesh.shape["policy"],
         )
 
-    def _tpu_backend(tier_stores: TieredPolicyStores):
+    def _make_breaker(name: str):
+        """Circuit breaker per TPU engine (engine/breaker.py); None when
+        disabled by --breaker-failure-threshold 0."""
+        if args.breaker_failure_threshold <= 0:
+            return None
+        from ..engine.breaker import CircuitBreaker
+
+        latency_ms = args.breaker_latency_threshold_ms
+        if latency_ms <= 0:
+            # default the breach threshold to the request budget: a device
+            # that "succeeds" slower than any caller waits is breaching.
+            # Without this a uniformly slow device never trips — each
+            # deadline expiry's record_failure would be erased by the late
+            # batch completing as an unqualified success.
+            latency_ms = args.request_timeout_ms
+        return CircuitBreaker(
+            name=name,
+            failure_threshold=args.breaker_failure_threshold,
+            latency_threshold_s=latency_ms / 1e3 if latency_ms > 0 else None,
+            recovery_s=args.breaker_recovery_seconds,
+            half_open_probes=args.breaker_half_open_probes,
+        )
+
+    def _tpu_backend(
+        tier_stores: TieredPolicyStores, breaker=None, name: str = "hybrid"
+    ):
         """(engine, evaluate, evaluate_batch) for a tier stack: compiled
-        eval with an interpreter guard until the first successful load."""
+        eval with an interpreter guard until the first successful load, and
+        a circuit breaker that routes evaluation to the tiered interpreter
+        stores while the device plane is sick."""
+        from ..engine.breaker import guarded_call
         from ..engine.evaluator import TPUPolicyEngine
 
         tier_engine = TPUPolicyEngine(mesh=mesh, segred=segred)
 
-        def evaluate(entities, request):
+        def _guarded(device_call, fallback_call):
+            """engine/breaker.py guarded_call plus the pre-load interpreter
+            guard: unloaded engines answer from the tiered stores without
+            touching the breaker or the fallback metric (startup is not a
+            sick device plane)."""
             if not tier_engine.loaded:
-                return tier_stores.is_authorized(entities, request)
-            return tier_engine.evaluate(entities, request)
+                return fallback_call()
+            return guarded_call(breaker, device_call, fallback_call, name)
+
+        def evaluate(entities, request):
+            return _guarded(
+                lambda: tier_engine.evaluate(entities, request),
+                lambda: tier_stores.is_authorized(entities, request),
+            )
 
         def evaluate_batch(items):
-            if not tier_engine.loaded:
-                return [tier_stores.is_authorized(em, r) for em, r in items]
-            return tier_engine.evaluate_batch(items)
+            return _guarded(
+                lambda: tier_engine.evaluate_batch(items),
+                lambda: [tier_stores.is_authorized(em, r) for em, r in items],
+            )
 
         return tier_engine, evaluate, evaluate_batch
 
     evaluate = None
     engine = None
     reloader = None
+    authz_breaker = None
     if args.backend == "tpu" and not len(stores.stores):
         log.warning("TPU backend requested but no stores configured; using interpreter")
     elif args.backend == "tpu":
-        engine, evaluate, _ = _tpu_backend(stores)
+        authz_breaker = _make_breaker("authorization")
+        engine, evaluate, _ = _tpu_backend(
+            stores, breaker=authz_breaker, name="authorization"
+        )
         reloader = TPUReloader(
             stores,
             targets=[(engine, stores)],
@@ -219,7 +261,10 @@ def build_server(args) -> WebhookServer:
         from ..native import native_available, native_error
 
         if native_available():
-            fastpath = SARFastPath(engine, authorizer)
+            # the fast path shares the engine's breaker: a tripped device
+            # plane routes BOTH the native raw pipeline and the hybrid
+            # evaluate path to the interpreter
+            fastpath = SARFastPath(engine, authorizer, breaker=authz_breaker)
             log.info("native SAR fast path enabled")
         else:
             log.warning(
@@ -233,13 +278,17 @@ def build_server(args) -> WebhookServer:
     )
     admission_evaluate = None
     admission_evaluate_batch = None
+    admission_breaker = None
     if engine is not None:
         # the admission tier stack (same stores + the constant allow-all
         # final tier) compiles into its own engine; unlowerable admission
         # predicates fall back per policy with exact verdict merging. Both
         # engines ride the one reloader's fingerprint pass.
+        admission_breaker = _make_breaker("admission")
         admission_engine, admission_evaluate, admission_evaluate_batch = (
-            _tpu_backend(admission_stores)
+            _tpu_backend(
+                admission_stores, breaker=admission_breaker, name="admission"
+            )
         )
         reloader.targets.append((admission_engine, admission_stores))
 
@@ -247,9 +296,10 @@ def build_server(args) -> WebhookServer:
         reloader.reload_if_changed()
         reloader.start()
 
+    admission_fail_open = args.admission_fail_mode == "open"
     admission_handler = CedarAdmissionHandler(
         admission_stores,
-        allow_on_error=True,
+        allow_on_error=admission_fail_open,
         evaluate=admission_evaluate,
         evaluate_batch=admission_evaluate_batch,
     )
@@ -261,7 +311,7 @@ def build_server(args) -> WebhookServer:
 
         if native_available():
             admission_fastpath = AdmissionFastPath(
-                admission_engine, admission_handler
+                admission_engine, admission_handler, breaker=admission_breaker
             )
             log.info("native admission fast path enabled")
 
@@ -297,6 +347,11 @@ def build_server(args) -> WebhookServer:
         fastpath=fastpath,
         admission_fastpath=admission_fastpath,
         batch_window_s=args.batch_window_us / 1e6,
+        request_timeout_s=(
+            args.request_timeout_ms / 1e3 if args.request_timeout_ms > 0 else None
+        ),
+        admission_fail_open=admission_fail_open,
+        drain_grace_s=args.shutdown_grace_seconds,
     )
 
 
@@ -357,6 +412,59 @@ def make_parser() -> argparse.ArgumentParser:
         "--insecure",
         action="store_true",
         help="serve plain HTTP (testing only)",
+    )
+
+    resilience = parser.add_argument_group("resilience")
+    resilience.add_argument(
+        "--request-timeout-ms",
+        type=float,
+        default=2000.0,
+        help="per-request deadline budget; on expiry /v1/authorize answers "
+        "NoOpinion+evaluationError and /v1/admit answers the configured "
+        "fail-mode (0 disables)",
+    )
+    resilience.add_argument(
+        "--admission-fail-mode",
+        default="open",
+        choices=["open", "closed"],
+        help="admission answer when evaluation crashes or exceeds its "
+        "deadline: open allows (keeps the cluster write path alive), "
+        "closed denies (nothing unevaluated is admitted)",
+    )
+    resilience.add_argument(
+        "--breaker-failure-threshold",
+        type=int,
+        default=5,
+        help="consecutive evaluator errors that trip the TPU circuit "
+        "breaker to the interpreter fallback (0 disables the breaker)",
+    )
+    resilience.add_argument(
+        "--breaker-latency-threshold-ms",
+        type=float,
+        default=0.0,
+        help="device evaluation latency counted as a breach; consecutive "
+        "breaches also trip the breaker (0 = default to "
+        "--request-timeout-ms: slower than any caller waits is breaching)",
+    )
+    resilience.add_argument(
+        "--breaker-recovery-seconds",
+        type=float,
+        default=10.0,
+        help="how long a tripped breaker stays open before half-open "
+        "recovery probes",
+    )
+    resilience.add_argument(
+        "--breaker-half-open-probes",
+        type=int,
+        default=2,
+        help="consecutive successful probes that close a half-open breaker",
+    )
+    resilience.add_argument(
+        "--shutdown-grace-seconds",
+        type=float,
+        default=5.0,
+        help="drain window on SIGTERM: /readyz flips to 503, new requests "
+        "are shed, in-flight requests get this long to finish",
     )
 
     gameday = parser.add_argument_group("gameday")

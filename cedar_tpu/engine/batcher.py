@@ -17,10 +17,18 @@ rides the device's throughput curve.
 from __future__ import annotations
 
 import threading
-from typing import Callable, List, Sequence, TypeVar
+import time
+from typing import Callable, List, Optional, Sequence, TypeVar
 
 T = TypeVar("T")
 R = TypeVar("R")
+
+
+class DeadlineExceeded(Exception):
+    """A submitter's per-request budget elapsed before its batch result
+    arrived. The request may still be evaluated by the batch thread; the
+    caller has already answered (NoOpinion / configured admission
+    fail-mode), so the late result is discarded."""
 
 
 class _Slot:
@@ -33,6 +41,12 @@ class _Slot:
 
 
 class MicroBatcher:
+    # how often a blocked submitter re-checks the worker thread's liveness:
+    # if the worker dies without setting its slots (anything outside the
+    # per-batch try/except — an interpreter teardown, a C-extension crash
+    # that unwinds the thread), waiters must not hang forever
+    LIVENESS_POLL_S = 0.5
+
     def __init__(
         self,
         fn: Callable[[Sequence[T]], List[R]],
@@ -51,24 +65,63 @@ class MicroBatcher:
         )
         self._thread.start()
 
-    def submit(self, item: T) -> R:
-        """Enqueue one item and block until its result is available."""
+    def submit(self, item: T, timeout: Optional[float] = None) -> R:
+        """Enqueue one item and block until its result is available.
+
+        ``timeout`` bounds the wall-clock wait (queue slot + batch window +
+        evaluation): on expiry the item is withdrawn from the queue when
+        still pending and ``DeadlineExceeded`` is raised. With or without a
+        timeout the wait is never unbounded — a dead worker thread raises
+        ``RuntimeError`` instead of stranding the submitter forever."""
         slot = _Slot()
+        entry = (item, slot)
         with self._cv:
             if self._stopped:
                 raise RuntimeError("MicroBatcher is stopped")
-            self._queue.append((item, slot))
+            if not self._thread.is_alive():
+                raise RuntimeError("batcher dead: worker thread has exited")
+            self._queue.append(entry)
             self._cv.notify()
-        slot.event.wait()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not slot.event.is_set():
+            wait = self.LIVENESS_POLL_S
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    with self._cv:
+                        # withdraw if still queued so the device never pays
+                        # for an answer nobody is waiting on
+                        try:
+                            self._queue.remove(entry)
+                        except ValueError:
+                            pass  # already claimed by the batch thread
+                    if slot.event.is_set():
+                        break  # result landed while we were withdrawing
+                    raise DeadlineExceeded(
+                        f"deadline of {timeout:.3f}s exceeded waiting for "
+                        "batch result"
+                    )
+                wait = min(wait, remaining)
+            if slot.event.wait(wait):
+                break
+            if not self._thread.is_alive():
+                if slot.event.is_set():
+                    break  # final result delivered as the worker exited
+                raise RuntimeError(
+                    "batcher dead: worker thread exited without delivering "
+                    "results"
+                )
         if slot.error is not None:
             raise slot.error
         return slot.result
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout_s: float = 2.0) -> None:
+        """Stop accepting new work and drain: the worker processes every
+        queued item (late submitters get their answers) before exiting."""
         with self._cv:
             self._stopped = True
             self._cv.notify()
-        self._thread.join(timeout=2.0)
+        self._thread.join(timeout=drain_timeout_s)
 
     # ------------------------------------------------------------- internals
 
@@ -90,6 +143,11 @@ class MicroBatcher:
                     self._cv.wait(timeout=remaining)
                 batch = self._queue[: self.max_batch]
                 del self._queue[: self.max_batch]
+            if not batch:
+                # every queued item withdrew (deadline expiry) during the
+                # forming window: never call the batch fn with zero rows — a
+                # no-op "success" must not feed breaker recovery probes
+                continue
             items = [it for it, _ in batch]
             try:
                 results = self._fn(items)

@@ -163,8 +163,12 @@ class _RawFastPath:
     # label for the cedar_authorizer_row_routing_total{path=...} counter
     _METRIC_PATH = "raw"
 
-    def __init__(self, engine: TPUPolicyEngine):
+    def __init__(self, engine: TPUPolicyEngine, breaker=None):
         self.engine = engine
+        # optional CircuitBreaker (engine/breaker.py): when open, whole
+        # batches skip the device plane and run the per-row interpreter
+        # fallback; device outcomes (errors + latency) feed it back
+        self.breaker = breaker
         self._snap: Optional[_Snapshot] = None
         self._build_lock = threading.Lock()
         # encode/device/decode seconds for the last process_raw call
@@ -241,6 +245,23 @@ class _RawFastPath:
         raise NotImplementedError
 
     # ------------------------------------------------------------- pipeline
+
+    def _guarded_process(
+        self, bodies: Sequence[bytes], snap: _Snapshot, fallback_one
+    ) -> list:
+        """process_raw behind the circuit breaker (engine/breaker.py
+        guarded_call): an open breaker routes the whole batch to the per-row
+        interpreter fallback, a raising device plane feeds the breaker and
+        re-runs the batch on the fallback, and success/latency outcomes
+        drive breach accounting and recovery probes."""
+        from .breaker import guarded_call
+
+        return guarded_call(
+            self.breaker,
+            lambda: self.process_raw(bodies, snap),
+            lambda: [fallback_one(b) for b in bodies],
+            self._METRIC_PATH,
+        )
 
     def process_raw(self, bodies: Sequence[bytes], snap: _Snapshot) -> list:
         """Evaluate a batch of raw JSON bodies through the native plane.
@@ -539,8 +560,9 @@ class SARFastPath(_RawFastPath):
         engine: TPUPolicyEngine,
         authorizer: CedarWebhookAuthorizer,
         fallback: Optional[Callable[[bytes], Result]] = None,
+        breaker=None,
     ):
-        super().__init__(engine)
+        super().__init__(engine, breaker=breaker)
         self.authorizer = authorizer
         self._fallback = fallback or self._python_fallback
 
@@ -553,7 +575,7 @@ class SARFastPath(_RawFastPath):
             # NoOpinion until every store's initial load completes
             # (authorizer.go:58-66); gates still apply, so run the exact path
             return [self._fallback(b) for b in bodies]
-        return self.process_raw(bodies, snap)
+        return self._guarded_process(bodies, snap, self._fallback)
 
     # --------------------------------------------------------------- hooks
 
@@ -721,8 +743,8 @@ class AdmissionFastPath(_RawFastPath):
 
     _METRIC_PATH = "admission"
 
-    def __init__(self, engine: TPUPolicyEngine, handler):
-        super().__init__(engine)
+    def __init__(self, engine: TPUPolicyEngine, handler, breaker=None):
+        super().__init__(engine, breaker=breaker)
         self.handler = handler  # CedarAdmissionHandler: fallback + readiness
         # bound once: _emit runs per row on the clean-decode hot loop
         from ..server.admission import AdmissionResponse
@@ -736,7 +758,7 @@ class AdmissionFastPath(_RawFastPath):
             # unready stores answer allow in handler.handle_batch; keep the
             # exact path for both cases
             return [self._py_one(b) for b in bodies]
-        return self.process_raw(bodies, snap)
+        return self._guarded_process(bodies, snap, self._py_one)
 
     # --------------------------------------------------------------- hooks
 

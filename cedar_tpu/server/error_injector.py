@@ -46,6 +46,52 @@ class RateLimiter:
             return False
 
 
+class InjectedFault(RuntimeError):
+    """An artificial evaluator failure raised by BatchFaultInjector."""
+
+
+class BatchFaultInjector:
+    """Chaos hook for batch evaluation functions.
+
+    Wraps a ``fn(items) -> results`` callable (the MicroBatcher's batch fn
+    or a fast path's ``process_raw``) and injects faults at token-bucket
+    rates, reusing the gameday RateLimiter machinery: ``error_rate``
+    exceptions/second (raised before evaluation — exactly what a wedged
+    device plane looks like to callers) and ``latency_rate`` artificial
+    stalls of ``latency_s`` seconds. Very high rates (e.g. 1e9) fire on
+    every call, which is what deterministic chaos tests want; production
+    gamedays use small rates behind the same non-prod confirmation gate as
+    ErrorInjector."""
+
+    def __init__(
+        self,
+        fn,
+        latency_s: float = 0.0,
+        latency_rate: float = 0.0,
+        error_rate: float = 0.0,
+        now=time.monotonic,
+        sleep=time.sleep,
+    ):
+        self._fn = fn
+        self.latency_s = latency_s
+        self._latency_limiter = RateLimiter(latency_rate, now)
+        self._error_limiter = RateLimiter(error_rate, now)
+        self._sleep = sleep
+        self.injected_errors = 0
+        self.injected_stalls = 0
+
+    def __call__(self, items):
+        if self._error_limiter.allow():
+            self.injected_errors += 1
+            raise InjectedFault(
+                f"injected evaluator fault #{self.injected_errors}"
+            )
+        if self.latency_s > 0 and self._latency_limiter.allow():
+            self.injected_stalls += 1
+            self._sleep(self.latency_s)
+        return self._fn(items)
+
+
 class ErrorInjector:
     def __init__(self, cfg: Optional[ErrorInjectionConfig], now=time.monotonic):
         cfg = cfg or ErrorInjectionConfig()

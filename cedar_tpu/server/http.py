@@ -27,6 +27,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..engine.batcher import DeadlineExceeded
 from ..entities.admission import AdmissionRequest
 from ..entities.attributes import (
     Attributes,
@@ -197,6 +198,9 @@ class WebhookServer:
         admission_fastpath=None,
         batch_window_s: float = 0.0002,
         max_batch: int = 8192,
+        request_timeout_s: Optional[float] = None,
+        admission_fail_open: Optional[bool] = None,
+        drain_grace_s: float = 0.0,
     ):
         self.authorizer = authorizer
         self.admission_handler = admission_handler
@@ -245,6 +249,21 @@ class WebhookServer:
         self.metrics_port = metrics_port
         self.certfile = certfile
         self.keyfile = keyfile
+        # per-request deadline budget (None disables): a hung evaluation
+        # answers NoOpinion (/v1/authorize) or the admission fail-mode
+        # within the budget instead of holding the apiserver's thread
+        self.request_timeout_s = request_timeout_s
+        # deadline/crash posture for /v1/admit; defaults to the handler's
+        # allow_on_error (fail-open, the reference's posture)
+        if admission_fail_open is None:
+            admission_fail_open = bool(
+                getattr(admission_handler, "allow_on_error", True)
+            )
+        self.admission_fail_open = admission_fail_open
+        self.drain_grace_s = drain_grace_s
+        self._draining = False
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._metrics_httpd: Optional[ThreadingHTTPServer] = None
 
@@ -259,6 +278,20 @@ class WebhookServer:
                 return False
         return True
 
+    def ready(self) -> bool:
+        """The /readyz verdict (no longer the reference's always-200 stub):
+        not draining, every policy store's initial load complete, and every
+        wired engine's first serving shape compiled."""
+        if self._draining:
+            return False
+        try:
+            if self.authorizer is not None and not self.authorizer.ready():
+                return False
+        except Exception:  # noqa: BLE001 — a raising store reads as unready
+            log.exception("readiness check failed")
+            return False
+        return self.warm_ready()
+
     def handle_authorize(self, body: bytes) -> dict:
         start = time.monotonic()
         request_id = str(uuid.uuid4())
@@ -266,14 +299,23 @@ class WebhookServer:
         try:
             try:
                 use_fastpath = (
-                    self._batcher is not None and self.fastpath.available
+                    self._batcher is not None
+                    and self.fastpath.available
+                    and self._breaker_admits(self.fastpath)
                 )
             except Exception:  # noqa: BLE001 — degrade to the python path
                 log.exception("fastpath availability check failed")
                 use_fastpath = False
             if use_fastpath:
                 try:
-                    decision, reason, error = self._batcher.submit(body)
+                    decision, reason, error = self._batcher.submit(
+                        body, timeout=self.request_timeout_s
+                    )
+                except DeadlineExceeded as e:
+                    metrics.record_deadline_exceeded("authorization")
+                    self._record_breaker_timeout(self.fastpath)
+                    error = f"evaluation error: {e}"
+                    return sar_response(DECISION_NO_OPINION, "", error)
                 except Exception as e:  # noqa: BLE001 — always answer
                     log.exception(
                         "fastpath authorize requestId=%s failed", request_id
@@ -316,18 +358,85 @@ class WebhookServer:
                 latency,
             )
 
+    def _breaker_admits(self, fastpath) -> bool:
+        """False when the fastpath's circuit breaker is open. Requests then
+        skip the micro-batcher entirely — its worker thread may be wedged
+        inside a hung device call, and queueing behind it would burn every
+        request's deadline budget — and take the python interpreter path in
+        the request thread instead. No fallback metric here: the python
+        path's own guarded_call records breaker_open once per evaluation;
+        recording at the bypass too would double-count every request."""
+        breaker = getattr(fastpath, "breaker", None)
+        return breaker is None or breaker.allow()
+
+    @staticmethod
+    def _record_breaker_timeout(fastpath) -> None:
+        """A deadline expiry is a device-plane failure signal: a wedged
+        evaluator never returns, so _guarded_process's post-call accounting
+        can never feed the breaker. Consecutive expiries trip it here, which
+        routes traffic off the stuck batcher (see _breaker_admits) until
+        half-open probes find the device answering again."""
+        breaker = getattr(fastpath, "breaker", None)
+        if breaker is not None:
+            breaker.record_failure()
+
+    def _admission_fail_mode(self, review, e) -> dict:
+        """The configured fail-open/fail-closed admission answer for a
+        request whose evaluation crashed or ran out of deadline budget.
+        Fail-open (the reference's allowOnError=true posture) keeps the
+        cluster's write path alive; fail-closed trades availability for the
+        guarantee that nothing unevaluated is admitted."""
+        from ..entities.admission import review_request_uid
+
+        uid = review_request_uid(review) if review is not None else ""
+        allowed = self.admission_fail_open
+        return AdmissionResponse(
+            uid=uid, allowed=allowed, code=200,
+            error="evaluation error "
+            f"({'allowed' if allowed else 'denied'} on error): {e}",
+        ).to_admission_review()
+
+    def _admission_deadline(self, body: bytes, e) -> dict:
+        metrics.record_deadline_exceeded("admission")
+        try:
+            review = json.loads(body)
+        except Exception:  # noqa: BLE001 — uid is best-effort here
+            review = None
+        return self._admission_fail_mode(review, e)
+
     def handle_admit(self, body: bytes) -> dict:
+        # one deadline budget for the whole request: a fastpath failure that
+        # falls through to the python path spends the REMAINING budget, not
+        # a fresh one, so the apiserver never waits ~2x the configured limit
+        deadline = (
+            None
+            if self.request_timeout_s is None
+            else time.monotonic() + self.request_timeout_s
+        )
+
+        def remaining():
+            # non-positive remainders make submit() expire immediately
+            return None if deadline is None else deadline - time.monotonic()
+
         try:
             use_fast = (
                 self._adm_raw_batcher is not None
                 and self.admission_fastpath.available
+                and self._breaker_admits(self.admission_fastpath)
             )
         except Exception:  # noqa: BLE001 — degrade to the python path
             log.exception("admission fastpath availability check failed")
             use_fast = False
         if use_fast:
             try:
-                return self._adm_raw_batcher.submit(body).to_admission_review()
+                return self._adm_raw_batcher.submit(
+                    body, timeout=remaining()
+                ).to_admission_review()
+            except DeadlineExceeded as e:
+                # the budget is spent: answer the fail-mode now instead of
+                # burning more wall-clock on the python path
+                self._record_breaker_timeout(self.admission_fastpath)
+                return self._admission_deadline(body, e)
             except Exception:  # noqa: BLE001 — python path below still answers
                 log.exception("admission fastpath failed; python path")
         try:
@@ -339,25 +448,20 @@ class WebhookServer:
         try:
             req = AdmissionRequest.from_admission_review(review)
             if self._admission_batcher is not None:
-                return self._admission_batcher.submit(req).to_admission_review()
+                return self._admission_batcher.submit(
+                    req, timeout=remaining()
+                ).to_admission_review()
             return self.admission_handler.handle(req).to_admission_review()
+        except DeadlineExceeded as e:
+            metrics.record_deadline_exceeded("admission")
+            return self._admission_fail_mode(review, e)
         except Exception as e:  # noqa: BLE001 — fail-open like the reference
             # allow-on-error posture (/root/reference
             # internal/server/admission/handler.go:90-104 with
             # allowOnError=true): a conversion/evaluation crash must not
             # block the cluster's write path
             log.exception("admit failed")
-            from ..entities.admission import review_request_uid
-
-            uid = review_request_uid(review)
-            allowed = bool(
-                getattr(self.admission_handler, "allow_on_error", True)
-            )
-            return AdmissionResponse(
-                uid=uid, allowed=allowed, code=200,
-                error="evaluation error "
-                f"({'allowed' if allowed else 'denied'} on error): {e}",
-            ).to_admission_review()
+            return self._admission_fail_mode(review, e)
 
     # -------------------------------------------------------------- serving
 
@@ -377,26 +481,50 @@ class WebhookServer:
                 self.wfile.write(data)
 
             def do_POST(self):
+                # the drain check and the in-flight increment are one
+                # atomic step: once stop() sets _draining and sees
+                # _inflight == 0 under this lock, no request can slip past
+                # the check and reach a batcher that stop() already joined
+                with server._inflight_cv:
+                    draining = server._draining
+                    if not draining:
+                        server._inflight += 1
+                if draining:
+                    # drain: /readyz already reads 503, so the apiserver is
+                    # steering away; requests that still race in are shed
+                    # fast rather than answered by a server mid-teardown
+                    metrics.record_shed(
+                        "admission" if self.path == "/v1/admit"
+                        else "authorization"
+                    )
+                    self.send_error(503, "server is draining")
+                    return
                 try:
-                    length = int(self.headers.get("Content-Length") or 0)
-                except ValueError:
-                    self.send_error(400, "bad Content-Length")
-                    return
-                if length < 0 or length > MAX_BODY_BYTES:
-                    # 413 rather than reading an unbounded body into memory;
-                    # real SAR/AdmissionReview payloads are far below the cap
-                    # (apiserver itself limits request sizes to ~3MB).
-                    self.send_error(413, "request body too large")
-                    return
-                body = self.rfile.read(length) if length else b""
-                if server.recorder is not None:
-                    server.recorder.record(self.path, body)
-                if self.path == "/v1/authorize":
-                    self._write_json(server.handle_authorize(body))
-                elif self.path == "/v1/admit":
-                    self._write_json(server.handle_admit(body))
-                else:
-                    self.send_error(404)
+                    try:
+                        length = int(self.headers.get("Content-Length") or 0)
+                    except ValueError:
+                        self.send_error(400, "bad Content-Length")
+                        return
+                    if length < 0 or length > MAX_BODY_BYTES:
+                        # 413 rather than reading an unbounded body into
+                        # memory; real SAR/AdmissionReview payloads are far
+                        # below the cap (apiserver itself limits request
+                        # sizes to ~3MB).
+                        self.send_error(413, "request body too large")
+                        return
+                    body = self.rfile.read(length) if length else b""
+                    if server.recorder is not None:
+                        server.recorder.record(self.path, body)
+                    if self.path == "/v1/authorize":
+                        self._write_json(server.handle_authorize(body))
+                    elif self.path == "/v1/admit":
+                        self._write_json(server.handle_admit(body))
+                    else:
+                        self.send_error(404)
+                finally:
+                    with server._inflight_cv:
+                        server._inflight -= 1
+                        server._inflight_cv.notify_all()
 
             def do_GET(self):
                 if server.enable_profiling and self.path.startswith(
@@ -471,11 +599,13 @@ class WebhookServer:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                 elif self.path == "/readyz":
-                    # goes beyond the reference's always-200 stub: gate on
-                    # the engines' first serving shape being compiled so a
-                    # fresh server's first live request never eats an XLA
-                    # compile inside the apiserver's 3s webhook deadline
-                    ready = server.warm_ready()
+                    # goes beyond the reference's always-200 stub: unready
+                    # while draining for shutdown, until every store's
+                    # initial policy load completes, and until the engines'
+                    # first serving shape is compiled — so a fresh server's
+                    # first live request never eats an XLA compile inside
+                    # the apiserver's 3s webhook deadline
+                    ready = server.ready()
                     self.send_response(200 if ready else 503)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
@@ -528,14 +658,42 @@ class WebhookServer:
             self.metrics_port,
         )
 
-    def stop(self) -> None:
+    def begin_drain(self) -> None:
+        """Flip into draining: /readyz answers 503 (the apiserver stops
+        sending), new POSTs are shed with 503, in-flight requests finish.
+        Set under the in-flight lock so the flag and the request count form
+        one consistent picture for stop()'s drain wait."""
+        with self._inflight_cv:
+            self._draining = True
+
+    def stop(self, drain_grace_s: Optional[float] = None) -> None:
+        """Graceful shutdown: drain (readiness 503 + shed new requests),
+        wait up to the grace period for in-flight requests, stop the
+        listeners, then drain and join the micro-batchers."""
+        grace = self.drain_grace_s if drain_grace_s is None else drain_grace_s
+        self.begin_drain()
+        deadline = time.monotonic() + grace
+        with self._inflight_cv:
+            while self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    log.warning(
+                        "drain grace elapsed with %d request(s) in flight",
+                        self._inflight,
+                    )
+                    break
+                self._inflight_cv.wait(timeout=remaining)
         for httpd in (self._httpd, self._metrics_httpd):
             if httpd is not None:
                 httpd.shutdown()
                 httpd.server_close()
         self._httpd = None
         self._metrics_httpd = None
-        for batcher in (self._batcher, self._admission_batcher):
+        # batcher stop drains the queue: every already-accepted request
+        # still gets its answer before the worker joins
+        for batcher in (
+            self._batcher, self._admission_batcher, self._adm_raw_batcher
+        ):
             if batcher is not None:
                 batcher.stop()
 

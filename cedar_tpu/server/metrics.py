@@ -56,6 +56,29 @@ class Counter:
         return out
 
 
+class Gauge:
+    def __init__(self, name: str, help_text: str, label_names: Sequence[str]):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels) -> None:
+        key = tuple((k, labels.get(k, "")) for k in self.label_names)
+        with self._lock:
+            self._values[key] = value
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key in sorted(self._values):
+                out.append(
+                    f"{self.name}{_fmt_label(key)} {_fmt_value(self._values[key])}"
+                )
+        return out
+
+
 class Histogram:
     def __init__(
         self,
@@ -171,6 +194,60 @@ row_routing_total = REGISTRY.register(
 )
 
 
+breaker_state = REGISTRY.register(
+    Gauge(
+        f"{SUBSYSTEM}_breaker_state",
+        "Circuit breaker state per evaluation engine: 0 closed (device "
+        "plane healthy), 1 open (whole batches routed to the interpreter "
+        "fallback), 2 half-open (probing recovery).",
+        ["engine"],
+    )
+)
+
+breaker_transitions_total = REGISTRY.register(
+    Counter(
+        f"{SUBSYSTEM}_breaker_transitions_total",
+        "Circuit breaker state transitions partitioned by engine and "
+        "destination state.",
+        ["engine", "to"],
+    )
+)
+
+deadline_exceeded_total = REGISTRY.register(
+    Counter(
+        f"{SUBSYSTEM}_deadline_exceeded_total",
+        "Requests whose per-request deadline budget elapsed before a batch "
+        "result arrived; authorization answers NoOpinion+evaluationError, "
+        "admission answers the configured fail-mode.",
+        ["path"],
+    )
+)
+
+requests_shed_total = REGISTRY.register(
+    Counter(
+        f"{SUBSYSTEM}_requests_shed_total",
+        "Requests refused with 503 because the server is draining for "
+        "shutdown.",
+        ["path"],
+    )
+)
+
+fallback_batches_total = REGISTRY.register(
+    Counter(
+        f"{SUBSYSTEM}_fallback_batches_total",
+        "Evaluation work served by the Python interpreter fallback instead "
+        "of the device plane, partitioned by path and reason (breaker_open: "
+        "the circuit breaker rejected the work; evaluator_error: the device "
+        "evaluation raised and the work re-ran on the interpreter). Counted "
+        "per batch on the batched fastpaths and per request when an open "
+        "breaker bypasses the batcher or on the hybrid evaluate path, so "
+        "absolute counts are not comparable across reasons during an "
+        "outage — alert on nonzero rate, not magnitude.",
+        ["path", "reason"],
+    )
+)
+
+
 def record_request_total(decision: str) -> None:
     request_total.inc(decision=decision)
 
@@ -186,3 +263,23 @@ def record_request_latency(decision: str, latency_s: float) -> None:
 
 def record_e2e_latency(filename: str, latency_s: float) -> None:
     e2e_latency.observe(latency_s, filename=filename)
+
+
+def set_breaker_state(engine: str, state_code: int) -> None:
+    breaker_state.set(state_code, engine=engine)
+
+
+def record_breaker_transition(engine: str, to_state: str) -> None:
+    breaker_transitions_total.inc(engine=engine, to=to_state)
+
+
+def record_deadline_exceeded(path: str) -> None:
+    deadline_exceeded_total.inc(path=path)
+
+
+def record_shed(path: str) -> None:
+    requests_shed_total.inc(path=path)
+
+
+def record_fallback_batch(path: str, reason: str) -> None:
+    fallback_batches_total.inc(path=path, reason=reason)

@@ -26,6 +26,7 @@ from ..apis.v1alpha1 import GROUP, PolicyObject, VERSION
 from ..lang.authorize import PolicySet
 from ..lang.lexer import ParseError
 from ..lang.parser import parse_policies
+from ..server.backoff import Backoff
 
 log = logging.getLogger(__name__)
 
@@ -95,35 +96,49 @@ class CRDPolicyStore:
             except Exception as e:  # pragma: no cover - env specific
                 log.error("CRD store: failed to build kube client: %s", e)
                 return
-        try:
-            self._relist()
-        except Exception as e:
-            log.error("CRD store: initial list failed: %s", e)
+        # decorrelated-jitter backoff shared by the initial list and the
+        # watch reconnect loop: an apiserver blip must neither kill the
+        # store permanently (the old initial-list behavior) nor invite a
+        # synchronized fixed-cadence retry herd
+        backoff = Backoff(base_s=1.0, cap_s=30.0)
+        while not self._stop.is_set():
+            try:
+                self._relist()
+                break
+            except Exception as e:
+                log.error("CRD store: initial list failed, retrying: %s", e)
+                if self._stop.wait(backoff.next()):
+                    return
+        else:
             return
         self._load_complete = True
+        backoff.reset()
         while not self._stop.is_set():
             try:
                 self._source.watch(self._dispatch, self._stop)
+                backoff.reset()  # a clean watch cycle proves the link healthy
             except WatchExpired as e:
                 # stale resourceVersion (apiserver compaction / 410 Gone):
                 # drop the bookmark and rebuild from a fresh list
                 log.warning("CRD store: watch expired (%s), relisting", e)
-                self._try_relist()
+                self._try_relist(backoff)
             except Exception as e:
                 log.error("CRD store: watch error, retrying: %s", e)
-                if self._stop.wait(2.0):
+                if self._stop.wait(backoff.next()):
                     return
-                self._try_relist()
+                self._try_relist(backoff)
 
-    def _try_relist(self) -> None:
+    def _try_relist(self, backoff: Optional[Backoff] = None) -> None:
         try:
             reset = getattr(self._source, "reset_resource_version", None)
             if reset is not None:
                 reset()
             self._relist()
+            if backoff is not None:
+                backoff.reset()
         except Exception as e:
             log.error("CRD store: relist failed: %s", e)
-            self._stop.wait(2.0)
+            self._stop.wait(backoff.next() if backoff is not None else 2.0)
 
     def _relist(self) -> None:
         objs = self._source.list()

@@ -17,8 +17,11 @@ import base64
 import json
 import ssl
 import tempfile
+import urllib.error
 import urllib.request
 from typing import Optional
+
+from ..server.backoff import Backoff, retry_call
 
 
 class KubeConfigClient:
@@ -85,8 +88,36 @@ class KubeConfigClient:
             req.add_header("Authorization", f"Bearer {self._token}")
         return urllib.request.urlopen(req, context=self._ssl, timeout=timeout)
 
-    def get_json(self, path: str, timeout: float = 30.0):
+    def get_json(self, path: str, timeout: float = 30.0, attempts: int = 3):
         """GET an apiserver-relative path (e.g. ``/openapi/v3``) -> parsed
-        JSON."""
-        with self.open(f"{self.server}{path}", timeout=timeout) as resp:
-            return json.loads(resp.read())
+        JSON, retrying transient failures (connection errors, timeouts,
+        5xx) with decorrelated-jitter backoff. GETs are idempotent, so the
+        retry is always safe; 4xx responses are the caller's problem and
+        re-raise immediately."""
+
+        def _get():
+            try:
+                with self.open(f"{self.server}{path}", timeout=timeout) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                if e.code < 500:
+                    raise _NoRetry(e) from e
+                raise
+
+        try:
+            return retry_call(
+                _get,
+                attempts=max(1, attempts),
+                retry_on=(urllib.error.URLError, OSError, TimeoutError),
+                backoff=Backoff(base_s=0.25, cap_s=5.0),
+            )
+        except _NoRetry as e:
+            raise e.error from None
+
+
+class _NoRetry(Exception):
+    """Wraps a terminal (non-retryable) HTTP error through retry_call."""
+
+    def __init__(self, error):
+        super().__init__(str(error))
+        self.error = error

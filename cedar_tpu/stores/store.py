@@ -9,11 +9,14 @@ Behavior parity with reference internal/server/store/store.go:
 
 from __future__ import annotations
 
+import logging
 from typing import List, Protocol, Tuple, runtime_checkable
 
 from ..lang.authorize import DENY, Diagnostics, PolicySet
 from ..lang.entities import EntityMap
 from ..lang.eval import Request
+
+log = logging.getLogger(__name__)
 
 
 @runtime_checkable
@@ -44,7 +47,21 @@ class TieredPolicyStores:
     ) -> Tuple[str, Diagnostics]:
         decision, diagnostic = DENY, Diagnostics()
         for i, store in enumerate(self.stores):
-            decision, diagnostic = store.policy_set().is_authorized(entities, req)
+            try:
+                decision, diagnostic = store.policy_set().is_authorized(
+                    entities, req
+                )
+            except Exception as e:  # noqa: BLE001 — one sick tier must not 500
+                # a raising store reads as Deny-with-error for its tier: the
+                # error is an explicit signal (the walk stops here, matching
+                # the evaluator's per-policy error semantics), and the
+                # authorizer maps errors-without-reasons to NoOpinion — so a
+                # crashing tier degrades to "no opinion, error recorded"
+                # instead of crashing the handler
+                log.exception("policy store %s evaluation failed", store.name())
+                decision, diagnostic = DENY, Diagnostics(
+                    errors=[f"store {store.name()}: {e}"]
+                )
             if i == len(self.stores) - 1:
                 break
             if decision == DENY and not diagnostic.reasons and not diagnostic.errors:

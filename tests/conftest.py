@@ -38,6 +38,18 @@ disable_non_cpu_backends()
 os.environ.setdefault("CEDAR_TPU_WARM_DEFAULT", "off")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from the tier-1 -m 'not slow' run",
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection resilience tests (run via `make chaos`); "
+        "always also marked slow so they stay out of the tier-1 time budget",
+    )
+
+
 def pytest_addoption(parser):
     parser.addoption(
         "--update-goldens",

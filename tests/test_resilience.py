@@ -1,0 +1,880 @@
+"""Resilience layer tests: deadlines, circuit breaker, backoff, graceful
+degradation, and the chaos (fault-injection) suite.
+
+Fast unit tests (state machines under fake clocks, batcher deadlines) run
+unmarked in the tier-1 suite. The end-to-end chaos tests — injected
+evaluator latency/exceptions via the BatchFaultInjector machinery, live
+loopback servers, drain sequencing — are marked ``chaos`` + ``slow`` and
+run via ``make chaos``.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cedar_tpu.engine.batcher import DeadlineExceeded, MicroBatcher
+from cedar_tpu.engine.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from cedar_tpu.server import metrics
+from cedar_tpu.server.admission import (
+    AdmissionResponse,
+    CedarAdmissionHandler,
+    allow_all_admission_policy_store,
+)
+from cedar_tpu.server.authorizer import (
+    DECISION_ALLOW,
+    CedarWebhookAuthorizer,
+)
+from cedar_tpu.server.backoff import Backoff, retry_call
+from cedar_tpu.server.error_injector import (
+    BatchFaultInjector,
+    ErrorInjectionConfig,
+    ErrorInjector,
+    InjectedFault,
+    RateLimiter,
+)
+from cedar_tpu.server.http import WebhookServer
+from cedar_tpu.stores.store import (
+    Diagnostics,
+    MemoryStore,
+    TieredPolicyStores,
+)
+
+DEMO_POLICY = """
+permit (
+    principal,
+    action in [k8s::Action::"get", k8s::Action::"list"],
+    resource is k8s::Resource
+) when { principal.name == "test-user" && resource.resource == "pods" };
+"""
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_sar(user="test-user", verb="get", resource="pods"):
+    return {
+        "apiVersion": "authorization.k8s.io/v1",
+        "kind": "SubjectAccessReview",
+        "spec": {
+            "user": user,
+            "uid": "u1",
+            "groups": ["dev"],
+            "resourceAttributes": {
+                "verb": verb,
+                "resource": resource,
+                "version": "v1",
+            },
+        },
+    }
+
+
+def post(port, path, doc, timeout=10):
+    data = doc if isinstance(doc, bytes) else json.dumps(doc).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def get_status(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+# --------------------------------------------------------------------------
+# backoff
+
+
+class TestBackoff:
+    def test_decorrelated_jitter_window_and_cap(self):
+        draws = []
+
+        def uniform(lo, hi):
+            draws.append((lo, hi))
+            return hi  # worst case: always the top of the window
+
+        bo = Backoff(base_s=0.5, cap_s=10.0, uniform=uniform)
+        sleeps = [bo.next() for _ in range(6)]
+        # even the FIRST retry is jittered (window [base, 3*base]) — a
+        # deterministic first delay would re-synchronize the herd
+        assert sleeps[0] == 1.5
+        # each draw window is [base, 3*prev], prev starting at base
+        prev = 0.5
+        for (lo, hi), s in zip(draws, sleeps):
+            assert lo == 0.5
+            assert hi == prev * 3
+            prev = s
+        # growth is exponential until the cap, then pinned at the cap
+        assert sleeps[1] == 4.5 and sleeps[2] == 10.0
+        assert max(sleeps) <= 10.0
+        assert sleeps[-1] == 10.0
+
+    def test_reset_returns_to_base(self):
+        bo = Backoff(base_s=1.0, cap_s=60.0, uniform=lambda lo, hi: hi)
+        bo.next()
+        bo.next()
+        bo.reset()
+        assert bo.next() == 3.0  # window back to [base, 3*base]
+
+    def test_retry_call_retries_then_raises(self):
+        calls = []
+        slept = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("transient")
+
+        with pytest.raises(ValueError):
+            retry_call(
+                fn,
+                attempts=3,
+                retry_on=(ValueError,),
+                backoff=Backoff(uniform=lambda lo, hi: lo),
+                sleep=slept.append,
+            )
+        assert len(calls) == 3
+        assert len(slept) == 2  # no sleep after the final failure
+
+    def test_retry_call_returns_first_success(self):
+        state = {"n": 0}
+
+        def fn():
+            state["n"] += 1
+            if state["n"] < 2:
+                raise ValueError("once")
+            return "ok"
+
+        assert (
+            retry_call(fn, attempts=3, retry_on=(ValueError,), sleep=lambda s: None)
+            == "ok"
+        )
+        assert state["n"] == 2
+
+
+# --------------------------------------------------------------------------
+# circuit breaker
+
+
+class TestCircuitBreaker:
+    def make(self, clock, **kw):
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("recovery_s", 10.0)
+        kw.setdefault("half_open_probes", 2)
+        return CircuitBreaker(name="test", clock=clock, **kw)
+
+    def test_trips_on_consecutive_failures(self):
+        clock = FakeClock()
+        br = self.make(clock)
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == CLOSED and br.allow()
+        br.record_failure()
+        assert br.state == OPEN and not br.allow()
+
+    def test_success_resets_failure_streak(self):
+        br = self.make(FakeClock())
+        br.record_failure()
+        br.record_failure()
+        br.record_success(0.001)
+        br.record_failure()
+        br.record_failure()
+        assert br.state == CLOSED  # streak restarted; 2 < threshold 3
+
+    def test_half_open_after_recovery_then_closes(self):
+        clock = FakeClock()
+        br = self.make(clock)
+        for _ in range(3):
+            br.record_failure()
+        assert not br.allow()
+        clock.advance(10.0)
+        assert br.allow()  # half-open probe allowed
+        assert br.state == HALF_OPEN
+        br.record_success(0.001)
+        assert br.state == HALF_OPEN  # 1 of 2 probes
+        br.record_success(0.001)
+        assert br.state == CLOSED
+
+    def test_probe_failure_reopens_with_fresh_recovery_clock(self):
+        clock = FakeClock()
+        br = self.make(clock)
+        for _ in range(3):
+            br.record_failure()
+        clock.advance(10.0)
+        assert br.allow()
+        br.record_failure()  # one failed probe re-opens immediately
+        assert br.state == OPEN and not br.allow()
+        clock.advance(9.9)
+        assert not br.allow()  # recovery clock restarted at the probe failure
+        clock.advance(0.2)
+        assert br.allow()
+
+    def test_latency_breaches_trip(self):
+        br = self.make(
+            FakeClock(),
+            latency_threshold_s=0.5,
+            latency_breach_threshold=2,
+        )
+        br.record_success(0.9)
+        assert br.state == CLOSED
+        br.record_success(0.9)
+        assert br.state == OPEN
+
+    def test_fast_success_resets_breach_streak(self):
+        br = self.make(
+            FakeClock(), latency_threshold_s=0.5, latency_breach_threshold=2
+        )
+        br.record_success(0.9)
+        br.record_success(0.1)
+        br.record_success(0.9)
+        assert br.state == CLOSED
+
+    def test_half_open_latency_breach_reopens(self):
+        clock = FakeClock()
+        br = self.make(
+            clock, latency_threshold_s=0.5, latency_breach_threshold=3
+        )
+        for _ in range(3):
+            br.record_failure()
+        clock.advance(10.0)
+        assert br.allow()
+        br.record_success(0.9)  # a slow probe is not a recovery
+        assert br.state == OPEN
+
+    def test_state_gauge_published(self):
+        CircuitBreaker(name="gauge-test", clock=FakeClock())
+        assert 'cedar_authorizer_breaker_state{engine="gauge-test"} 0' in (
+            metrics.REGISTRY.expose()
+        )
+
+
+# --------------------------------------------------------------------------
+# micro-batcher deadlines + liveness
+
+
+class TestMicroBatcherDeadline:
+    def test_timeout_raises_deadline_exceeded(self):
+        release = threading.Event()
+
+        def slow_fn(items):
+            release.wait(2.0)
+            return [None] * len(items)
+
+        b = MicroBatcher(slow_fn, window_s=0.0)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            b.submit("x", timeout=0.05)
+        assert time.monotonic() - t0 < 1.0
+        release.set()
+        b.stop()
+
+    def test_timed_out_item_withdrawn_from_queue(self):
+        # stall the worker inside a batch, then time a second submit out
+        # while it is still QUEUED: it must be withdrawn, so the batch fn
+        # never sees it
+        seen = []
+        gate = threading.Event()
+
+        def fn(items):
+            seen.append(list(items))
+            gate.wait(2.0)
+            return [None] * len(items)
+
+        b = MicroBatcher(fn, max_batch=1, window_s=0.0)
+        first = threading.Thread(target=lambda: b.submit("a"), daemon=True)
+        first.start()
+        while not seen:  # worker is now inside batch #1
+            time.sleep(0.001)
+        with pytest.raises(DeadlineExceeded):
+            b.submit("b", timeout=0.05)
+        gate.set()
+        first.join(timeout=2.0)
+        b.stop()
+        assert ["b"] not in seen
+
+    def test_within_deadline_returns_result(self):
+        b = MicroBatcher(lambda items: [i * 2 for i in items], window_s=0.0)
+        assert b.submit(21, timeout=5.0) == 42
+        b.stop()
+
+    def test_dead_worker_raises_instead_of_hanging(self):
+        class AbandoningBatcher(MicroBatcher):
+            LIVENESS_POLL_S = 0.05
+
+            def _run(self):
+                # claim the queue, then die without delivering results —
+                # the shape of a worker crash outside the per-batch guard
+                while True:
+                    with self._cv:
+                        if self._queue:
+                            self._queue.clear()
+                            return
+                        self._cv.wait(0.01)
+
+        b = AbandoningBatcher(lambda items: items)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="batcher dead"):
+            b.submit("x")
+        assert time.monotonic() - t0 < 2.0
+
+        # and a submit AFTER the worker died fails fast at enqueue time
+        b._thread.join(timeout=1.0)
+        with pytest.raises(RuntimeError, match="batcher dead"):
+            b.submit("y")
+
+    def test_stop_drains_queued_items(self):
+        results = []
+
+        def submitter():
+            results.append(b.submit(1))
+
+        b = MicroBatcher(lambda items: [i + 1 for i in items], window_s=0.05)
+        threads = [threading.Thread(target=submitter) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.01)
+        b.stop()
+        for t in threads:
+            t.join(timeout=2.0)
+        assert results == [2, 2, 2, 2]
+
+
+# --------------------------------------------------------------------------
+# tiered store exception guard
+
+
+class _RaisingStore:
+    def __init__(self, name="sick"):
+        self._name = name
+
+    def initial_policy_load_complete(self):
+        return True
+
+    def policy_set(self):
+        raise RuntimeError("store backend exploded")
+
+    def name(self):
+        return self._name
+
+
+class TestTieredStoreGuard:
+    def test_raising_store_yields_deny_with_error(self):
+        stores = TieredPolicyStores([_RaisingStore()])
+        req = object()
+        decision, diag = stores.is_authorized({}, req)
+        assert decision == "deny"
+        assert diag.errors and "store backend exploded" in diag.errors[0]
+        assert not diag.reasons
+
+    def test_error_is_explicit_signal_stopping_the_walk(self):
+        healthy = MemoryStore.from_source("demo", DEMO_POLICY)
+        stores = TieredPolicyStores([_RaisingStore(), healthy])
+        from cedar_tpu.server.authorizer import record_to_cedar_resource
+        from cedar_tpu.server.http import get_authorizer_attributes
+
+        entities, req = record_to_cedar_resource(
+            get_authorizer_attributes(make_sar())
+        )
+        decision, diag = stores.is_authorized(entities, req)
+        assert diag.errors  # tier 0's error is the answer, like store.go
+        assert decision == "deny" and not diag.reasons
+
+    def test_authorizer_maps_raising_store_to_no_opinion(self):
+        stores = TieredPolicyStores([_RaisingStore()])
+        authorizer = CedarWebhookAuthorizer(stores)
+        from cedar_tpu.server.http import get_authorizer_attributes
+
+        decision, reason = authorizer.authorize(
+            get_authorizer_attributes(make_sar())
+        )
+        assert decision == "no_opinion" and reason == ""
+
+    def test_diagnostics_errors_constructor(self):
+        d = Diagnostics(errors=["boom"])
+        assert d.errors == ["boom"]
+
+
+# --------------------------------------------------------------------------
+# error injector / rate limiter edge cases
+
+
+class TestRateLimiterEdges:
+    def test_rate_zero_never_fires(self):
+        rl = RateLimiter(0.0)
+        assert not any(rl.allow() for _ in range(50))
+
+    def test_negative_rate_never_fires(self):
+        rl = RateLimiter(-1.0)
+        assert not rl.allow()
+
+    def test_burst_one_refill_under_fake_clock(self):
+        clock = FakeClock()
+        rl = RateLimiter(2.0, now=clock)  # 2 tokens/s, burst 1
+        assert rl.allow()  # initial token
+        assert not rl.allow()  # bucket empty, no time passed
+        clock.advance(0.25)  # +0.5 tokens: still below 1
+        assert not rl.allow()
+        clock.advance(0.25)  # reaches exactly 1 token
+        assert rl.allow()
+        assert not rl.allow()
+
+    def test_tokens_cap_at_burst_one(self):
+        clock = FakeClock()
+        rl = RateLimiter(1.0, now=clock)
+        clock.advance(100.0)  # a long idle stretch earns ONE token, not 100
+        assert rl.allow()
+        assert not rl.allow()
+
+    def test_concurrent_allow_admits_exactly_one(self):
+        clock = FakeClock()  # frozen: no refill during the race
+        rl = RateLimiter(1.0, now=clock)
+        results = []
+        barrier = threading.Barrier(16)
+
+        def worker():
+            barrier.wait()
+            results.append(rl.allow())
+
+        threads = [threading.Thread(target=worker) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(results) == 1
+
+    def test_injector_disabled_is_passthrough(self):
+        inj = ErrorInjector(ErrorInjectionConfig(enabled=False))
+        assert inj.inject_if_enabled("allow", "r") == ("allow", "r", None)
+
+    def test_injector_enabled_zero_rates_never_fires(self):
+        inj = ErrorInjector(
+            ErrorInjectionConfig(
+                enabled=True,
+                artificial_error_rate=0.0,
+                artificial_deny_rate=0.0,
+            )
+        )
+        for _ in range(50):
+            assert inj.inject_if_enabled("allow", "r") == ("allow", "r", None)
+
+    def test_injector_error_rate_fires_once_per_window(self):
+        clock = FakeClock()
+        inj = ErrorInjector(
+            ErrorInjectionConfig(enabled=True, artificial_error_rate=1.0),
+            now=clock,
+        )
+        assert inj.inject_if_enabled("allow", "r") == (
+            "no_opinion", "", "encountered error",
+        )
+        assert inj.inject_if_enabled("allow", "r") == ("allow", "r", None)
+        clock.advance(1.0)
+        assert inj.inject_if_enabled("allow", "r")[0] == "no_opinion"
+
+    def test_batch_fault_injector_counts_and_raises(self):
+        inj = BatchFaultInjector(lambda items: items, error_rate=1e9)
+        with pytest.raises(InjectedFault):
+            inj([1, 2])
+        assert inj.injected_errors == 1
+
+    def test_batch_fault_injector_latency(self):
+        stalls = []
+        inj = BatchFaultInjector(
+            lambda items: items,
+            latency_s=0.5,
+            latency_rate=1e9,
+            sleep=stalls.append,
+        )
+        assert inj([1]) == [1]
+        assert stalls == [0.5]
+
+
+# --------------------------------------------------------------------------
+# fast-path breaker guard (unit level, injected faults)
+
+
+class _StubSnapshot:
+    pass
+
+
+def make_guarded_fastpath(breaker, batch_fn, authorizer):
+    """A SARFastPath whose device plane is `batch_fn` and whose snapshot/
+    readiness plumbing is stubbed out — the breaker guard and the
+    interpreter fallback are the real code under test."""
+    from cedar_tpu.engine.fastpath import SARFastPath
+
+    class ChaosSARFastPath(SARFastPath):
+        available = True
+
+        def _current_snapshot(self):
+            return _StubSnapshot()
+
+        def process_raw(self, bodies, snap):
+            return batch_fn(bodies)
+
+    return ChaosSARFastPath(engine=None, authorizer=authorizer, breaker=breaker)
+
+
+class TestFastPathBreakerGuard:
+    def setup_method(self):
+        stores = TieredPolicyStores([MemoryStore.from_source("d", DEMO_POLICY)])
+        self.authorizer = CedarWebhookAuthorizer(stores)
+
+    def test_injected_errors_trip_breaker_and_fall_back(self):
+        clock = FakeClock()
+        breaker = CircuitBreaker(
+            name="unit-authz", failure_threshold=3, recovery_s=10.0,
+            half_open_probes=2, clock=clock,
+        )
+        chaos = BatchFaultInjector(
+            lambda bodies: [(DECISION_ALLOW, "device", None)] * len(bodies),
+            error_rate=1e9,
+        )
+        fp = make_guarded_fastpath(breaker, chaos, self.authorizer)
+        body = json.dumps(make_sar()).encode()
+
+        # every failing batch still answers via the interpreter fallback
+        for _ in range(3):
+            results = fp.authorize_raw([body])
+            assert results[0][0] == DECISION_ALLOW  # demo policy permits
+        assert breaker.state == OPEN
+        assert chaos.injected_errors == 3
+
+        # open breaker: the device plane is not even attempted
+        results = fp.authorize_raw([body])
+        assert results[0][0] == DECISION_ALLOW
+        assert chaos.injected_errors == 3
+
+        # recovery: heal the fault, wait out the window, probe, close
+        chaos._error_limiter = RateLimiter(0.0)
+        clock.advance(10.0)
+        for _ in range(2):
+            results = fp.authorize_raw([body])
+            assert results[0] == (DECISION_ALLOW, "device", None)
+        assert breaker.state == CLOSED
+
+    def test_fallback_metrics_recorded(self):
+        before_err = metrics.fallback_batches_total._values.get(
+            (("path", "authorization"), ("reason", "evaluator_error")), 0
+        )
+        before_open = metrics.fallback_batches_total._values.get(
+            (("path", "authorization"), ("reason", "breaker_open")), 0
+        )
+        clock = FakeClock()
+        breaker = CircuitBreaker(
+            name="unit-metrics", failure_threshold=1, recovery_s=10.0,
+            clock=clock,
+        )
+        chaos = BatchFaultInjector(lambda bodies: bodies, error_rate=1e9)
+        fp = make_guarded_fastpath(breaker, chaos, self.authorizer)
+        body = json.dumps(make_sar()).encode()
+        fp.authorize_raw([body])  # error -> trip
+        fp.authorize_raw([body])  # open -> shed to fallback
+        after = metrics.fallback_batches_total._values
+        assert after[(("path", "authorization"), ("reason", "evaluator_error"))] == before_err + 1
+        assert after[(("path", "authorization"), ("reason", "breaker_open"))] == before_open + 1
+
+
+# --------------------------------------------------------------------------
+# end-to-end chaos suite (live loopback servers, real sleeps)
+
+chaos = [pytest.mark.chaos, pytest.mark.slow]
+
+
+class _FakeFastPath:
+    """Duck-typed SAR fast path: `available` + `authorize_raw`."""
+
+    def __init__(self, fn):
+        self.available = True
+        self.authorize_raw = fn
+
+
+class _FakeAdmissionFastPath:
+    def __init__(self, fn):
+        self.available = True
+        self.handle_raw = fn
+
+
+def make_server(**kw):
+    stores = TieredPolicyStores([MemoryStore.from_source("demo", DEMO_POLICY)])
+    admission_stores = TieredPolicyStores(
+        [
+            MemoryStore.from_source("demo", DEMO_POLICY),
+            allow_all_admission_policy_store(),
+        ]
+    )
+    kw.setdefault("authorizer", CedarWebhookAuthorizer(stores))
+    kw.setdefault("admission_handler", CedarAdmissionHandler(admission_stores))
+    srv = WebhookServer(
+        address="127.0.0.1", port=0, metrics_port=0, **kw
+    )
+    srv.start()
+    return srv
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestDeadlineEndToEnd:
+    def test_authorize_slow_batch_yields_no_opinion_within_budget(self):
+        # latency injected into the batch fn via the gameday machinery: the
+        # device plane stalls 1s, the request budget is 150ms
+        slow = BatchFaultInjector(
+            lambda bodies: [(DECISION_ALLOW, "late", None)] * len(bodies),
+            latency_s=1.0,
+            latency_rate=1e9,
+        )
+        srv = make_server(
+            fastpath=_FakeFastPath(slow), request_timeout_s=0.15
+        )
+        try:
+            t0 = time.monotonic()
+            doc = post(srv.bound_port, "/v1/authorize", make_sar())
+            elapsed = time.monotonic() - t0
+            assert doc["status"]["allowed"] is False
+            assert doc["status"]["denied"] is False
+            assert "deadline" in doc["status"]["evaluationError"]
+            assert elapsed < 0.9  # answered within the budget, not the stall
+            assert "cedar_authorizer_deadline_exceeded_total" in (
+                metrics.REGISTRY.expose()
+            )
+        finally:
+            srv.stop()
+
+    def test_admit_deadline_fail_open_and_fail_closed(self):
+        review = {"request": {"uid": "uid-123", "operation": "CREATE"}}
+        for fail_open in (True, False):
+            slow = BatchFaultInjector(
+                lambda bodies: [
+                    AdmissionResponse(uid="uid-123", allowed=True)
+                    for _ in bodies
+                ],
+                latency_s=1.0,
+                latency_rate=1e9,
+            )
+            srv = make_server(
+                admission_fastpath=_FakeAdmissionFastPath(slow),
+                request_timeout_s=0.15,
+                admission_fail_open=fail_open,
+            )
+            try:
+                t0 = time.monotonic()
+                doc = post(srv.bound_port, "/v1/admit", review)
+                elapsed = time.monotonic() - t0
+                assert doc["response"]["allowed"] is fail_open
+                assert doc["response"]["uid"] == "uid-123"
+                assert "error" in doc["response"]["status"]["message"]
+                assert elapsed < 0.9
+            finally:
+                srv.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestBreakerEndToEnd:
+    def test_injected_exceptions_trip_then_recover(self):
+        stores = TieredPolicyStores(
+            [MemoryStore.from_source("demo", DEMO_POLICY)]
+        )
+        authorizer = CedarWebhookAuthorizer(stores)
+        clock = FakeClock()
+        breaker = CircuitBreaker(
+            name="e2e-authz", failure_threshold=3, recovery_s=5.0,
+            half_open_probes=1, clock=clock,
+        )
+        chaos = BatchFaultInjector(
+            lambda bodies: [(DECISION_ALLOW, "device-plane", None)]
+            * len(bodies),
+            error_rate=1e9,
+        )
+        fp = make_guarded_fastpath(breaker, chaos, authorizer)
+        srv = make_server(
+            authorizer=authorizer, fastpath=fp, request_timeout_s=5.0
+        )
+        try:
+            # injected evaluator exceptions: every request still answered
+            # (interpreter fallback), breaker trips at the threshold
+            for _ in range(4):
+                doc = post(srv.bound_port, "/v1/authorize", make_sar())
+                assert doc["status"]["allowed"] is True
+            assert breaker.state == OPEN
+            assert chaos.injected_errors == 3  # 4th batch never hit the device
+
+            # half-open probe after the recovery window heals the plane
+            chaos._error_limiter = RateLimiter(0.0)
+            clock.advance(5.0)
+            doc = post(srv.bound_port, "/v1/authorize", make_sar())
+            assert doc["status"]["allowed"] is True
+            assert breaker.state == CLOSED
+            assert doc["status"]["reason"] == "device-plane"
+
+            # breaker/fallback metrics are exposed on /metrics
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.bound_metrics_port}/metrics", timeout=5
+            ).read().decode()
+            assert 'cedar_authorizer_breaker_state{engine="e2e-authz"} 0' in body
+            assert "cedar_authorizer_fallback_batches_total" in body
+            assert "cedar_authorizer_deadline_exceeded_total" in body
+        finally:
+            srv.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestHungDevicePlane:
+    def test_deadline_expiries_trip_breaker_and_bypass_stuck_batcher(self):
+        # a wedged evaluator never returns, so only the caller-side deadline
+        # can see it: consecutive expiries must trip the breaker, and open
+        # routes requests AROUND the stuck batcher to the python path
+        breaker = CircuitBreaker(
+            name="hang-authz", failure_threshold=2, recovery_s=60.0
+        )
+        release = threading.Event()
+
+        def hung_batch(bodies):
+            release.wait(5.0)
+            return [(DECISION_ALLOW, "late", None)] * len(bodies)
+
+        fp = _FakeFastPath(hung_batch)
+        fp.breaker = breaker
+        srv = make_server(fastpath=fp, request_timeout_s=0.15)
+        try:
+            for _ in range(2):
+                doc = post(srv.bound_port, "/v1/authorize", make_sar())
+                assert doc["status"]["allowed"] is False
+                assert "deadline" in doc["status"]["evaluationError"]
+            assert breaker.state == OPEN
+            # the batcher worker is still wedged, but the open breaker
+            # bypasses it: the interpreter answers within the budget
+            t0 = time.monotonic()
+            doc = post(srv.bound_port, "/v1/authorize", make_sar())
+            assert doc["status"]["allowed"] is True
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            release.set()
+            srv.stop()
+
+
+class _StubAdmissionHandler:
+    supports_batch = True
+    allow_on_error = True
+
+    def __init__(self, handle_batch):
+        self.handle_batch = handle_batch
+
+
+class TestAdmitBudgetSharedAcrossPaths:
+    def test_fastpath_failure_leaves_only_remaining_budget(self):
+        # the raw fastpath burns most of the budget then crashes (generic
+        # error, not DeadlineExceeded); the python path must inherit the
+        # REMAINING budget, not a fresh one — total stays ~1x the limit
+        def crashing_raw(bodies):
+            time.sleep(0.25)
+            raise RuntimeError("device plane crashed late")
+
+        def slow_python_batch(reqs):
+            time.sleep(0.5)
+            return [AdmissionResponse(uid="u", allowed=True) for _ in reqs]
+
+        srv = WebhookServer(
+            None,
+            address="127.0.0.1",
+            port=0,
+            metrics_port=0,
+            admission_handler=_StubAdmissionHandler(slow_python_batch),
+            admission_fastpath=_FakeAdmissionFastPath(crashing_raw),
+            request_timeout_s=0.3,
+        )
+        try:
+            body = json.dumps(
+                {"request": {"uid": "uid-b", "operation": "CREATE"}}
+            ).encode()
+            t0 = time.monotonic()
+            doc = srv.handle_admit(body)
+            elapsed = time.monotonic() - t0
+            assert doc["response"]["allowed"] is True  # fail-open
+            assert "error" in doc["response"]["status"]["message"]
+            # a fresh budget on the python path would stretch this past
+            # 0.25 + 0.3 = 0.55s
+            assert elapsed < 0.45
+        finally:
+            srv.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestReadinessAndDrain:
+    def test_readyz_503_before_initial_policy_load(self):
+        from cedar_tpu.lang.authorize import PolicySet
+
+        lazy = MemoryStore("lazy", PolicySet(), load_complete=False)
+        stores = TieredPolicyStores([lazy])
+        srv = make_server(authorizer=CedarWebhookAuthorizer(stores))
+        try:
+            assert get_status(srv.bound_metrics_port, "/readyz") == 503
+            assert get_status(srv.bound_metrics_port, "/healthz") == 200
+            lazy._load_complete = True
+            assert get_status(srv.bound_metrics_port, "/readyz") == 200
+        finally:
+            srv.stop()
+
+    def test_drain_flips_readyz_and_sheds_requests(self):
+        srv = make_server()
+        try:
+            assert get_status(srv.bound_metrics_port, "/readyz") == 200
+            srv.begin_drain()
+            assert get_status(srv.bound_metrics_port, "/readyz") == 503
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                post(srv.bound_port, "/v1/authorize", make_sar())
+            assert exc_info.value.code == 503
+            assert "cedar_authorizer_requests_shed_total" in (
+                metrics.REGISTRY.expose()
+            )
+        finally:
+            srv.stop()
+
+    def test_stop_waits_for_inflight_requests(self):
+        release = threading.Event()
+        started = threading.Event()
+
+        def slow_batch(bodies):
+            started.set()
+            release.wait(5.0)
+            return [(DECISION_ALLOW, "drained", None)] * len(bodies)
+
+        srv = make_server(
+            fastpath=_FakeFastPath(slow_batch), request_timeout_s=10.0
+        )
+        results = []
+
+        def client():
+            results.append(post(srv.bound_port, "/v1/authorize", make_sar()))
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        started.wait(5.0)
+        stopper = threading.Thread(
+            target=lambda: srv.stop(drain_grace_s=5.0), daemon=True
+        )
+        stopper.start()
+        time.sleep(0.1)
+        release.set()  # let the in-flight request finish during the grace
+        stopper.join(timeout=10.0)
+        t.join(timeout=5.0)
+        assert results and results[0]["status"]["allowed"] is True
+        assert not stopper.is_alive()
