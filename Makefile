@@ -55,6 +55,31 @@ fuzz-soak: ## Differential fuzz soak over fresh seed ranges (cpu backend)
 graft-check: ## Compile-check the jittable entry + multi-chip dry run
 	$(PYTHON) __graft_entry__.py
 
+##@ Static analysis
+
+# scoped to the layers with the strongest invariants first; widen as
+# modules are annotated
+LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang
+
+.PHONY: lint
+lint: ## ruff + mypy over $(LINT_SCOPE) (missing tools are skipped with a note)
+	@if $(PYTHON) -m ruff --version >/dev/null 2>&1; then \
+	  $(PYTHON) -m ruff check --select E9,F $(LINT_SCOPE); \
+	else \
+	  echo "ruff not installed — falling back to compileall syntax check"; \
+	  $(PYTHON) -m compileall -q $(LINT_SCOPE); \
+	fi
+	@if $(PYTHON) -m mypy --version >/dev/null 2>&1; then \
+	  $(PYTHON) -m mypy --ignore-missing-imports --follow-imports=silent \
+	    $(LINT_SCOPE); \
+	else echo "mypy not installed — skipping (pip install mypy)"; fi
+
+.PHONY: analyze
+analyze: ## Whole-policy-set static analysis over the demo + test corpora (cedar-analyze --check)
+	$(PYTHON) -m cedar_tpu.cli.analyze --check demo/authorization-policy.yaml
+	$(PYTHON) -m cedar_tpu.cli.analyze --check demo/admission-policy.yaml
+	$(PYTHON) -m cedar_tpu.cli.analyze --check tests/testdata/rbac
+
 ##@ Schema & policies
 
 .PHONY: generate-schemas

@@ -1,0 +1,51 @@
+"""Whole-policy-set static analysis.
+
+The Cedar paper's core claim is that the language is *analyzable*; this
+package converts the compiler's private knowledge (compiler/lower.py's
+ordered-DNF clause form, compiler/pack.py's device layout) into
+operator-facing static guarantees over a whole tiered policy set:
+
+  * TPU-lowerability lint — which policies ride the device fast path and
+    which fall back to the per-row Python interpreter, with the exact
+    construct that forced the fallback and a fix hint;
+  * shadowing / unreachability — clause-level subsumption proving a policy
+    can never change any decision (differentially verifiable: deleting it
+    changes no decision on any request);
+  * permit/forbid conflict pairs — satisfiable-intersection checks over
+    clause literals (a SAT-lite over the finite slot/vocab domains the
+    encoder already builds);
+  * static capacity report — predicted slot-table/vocab growth and
+    packing-bucket occupancy before a set ever reaches a device.
+
+Entry points: analyze_tiers (the full report), loadgate.enforce (the
+serving-path gate honoring CedarConfig.validationMode), and the
+``cedar-analyze`` CLI (cedar_tpu/cli/analyze.py).
+"""
+
+from .analyze import analyze_tiers
+from .loadgate import (
+    AnalysisRejected,
+    check_object_policies,
+    enforce,
+)
+from .report import (
+    SEV_ERROR,
+    SEV_INFO,
+    SEV_WARNING,
+    AnalysisReport,
+    Finding,
+    REASONS,
+)
+
+__all__ = [
+    "AnalysisRejected",
+    "AnalysisReport",
+    "Finding",
+    "REASONS",
+    "SEV_ERROR",
+    "SEV_INFO",
+    "SEV_WARNING",
+    "analyze_tiers",
+    "check_object_policies",
+    "enforce",
+]

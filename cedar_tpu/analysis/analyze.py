@@ -1,0 +1,421 @@
+"""The whole-policy-set analyzer: lowerability, shadowing, conflicts,
+capacity — one pass over the compiler's lowered Clause representation.
+
+analyze_tiers is pure host-side work (lowering + numpy packing, no jax):
+safe to run at policy load time inside stores and in the CLI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from ..compiler.ir import (
+    HARD,
+    HARD_ERR,
+    HARD_OK,
+    FallbackPolicy,
+    LoweredPolicy,
+    Unlowerable,
+)
+from ..compiler.lower import AUTHZ_SCHEMA_INFO, SchemaInfo, lower_policy
+from ..lang.ast import FORBID, PERMIT, Policy
+from ..lang.format import format_expr
+from .report import AnalysisReport, Finding
+from .subsume import (
+    clause_key,
+    clause_pair_satisfiable,
+    clause_self_satisfiable,
+    covers,
+)
+
+# a policy whose DNF expansion reaches this many rules gets a capacity info
+# finding (each rule is a packed matmul column)
+CLAUSE_HEAVY = 32
+
+# default cap on clause-pair comparisons for the quadratic passes
+# (shadowing + conflicts); at MAX_CLAUSES=96 per policy this covers
+# thousand-policy sets while bounding worst-case load-time cost. Exhaustion
+# sets report.truncated — never a silent cap.
+PAIR_BUDGET = 2_000_000
+
+
+@dataclass
+class PolicyInfo:
+    """One policy's lowering outcome, either lowered or fallback."""
+
+    policy: Policy
+    tier: int
+    lowered: Optional[LoweredPolicy] = None
+    fallback: Optional[FallbackPolicy] = None
+
+    @property
+    def effect(self) -> str:
+        return self.policy.effect
+
+
+def lower_all(
+    tiers: Sequence, schema: Optional[SchemaInfo] = None
+) -> List[PolicyInfo]:
+    """Lower every policy of every tier individually, capturing the
+    Unlowerable reason instead of aggregating like lower_tiers does."""
+    schema = schema or AUTHZ_SCHEMA_INFO
+    infos: List[PolicyInfo] = []
+    for tier_idx, ps in enumerate(tiers):
+        for policy in ps.policies():
+            try:
+                lp = lower_policy(policy, tier_idx, schema)
+                infos.append(PolicyInfo(policy, tier_idx, lowered=lp))
+            except Unlowerable as e:
+                infos.append(
+                    PolicyInfo(
+                        policy,
+                        tier_idx,
+                        fallback=FallbackPolicy(
+                            policy=policy,
+                            tier=tier_idx,
+                            reason=str(e),
+                            code=e.code,
+                            construct=e.construct,
+                        ),
+                    )
+                )
+    return infos
+
+
+def _finding(code: str, info: PolicyInfo, message: str, related=()) -> Finding:
+    p = info.policy
+    return Finding(
+        code=code,
+        policy_id=p.policy_id,
+        filename=p.filename,
+        position=p.position,
+        tier=info.tier,
+        message=message,
+        related=tuple(related),
+    )
+
+
+def _hard_exprs(lp: LoweredPolicy) -> List[object]:
+    """Distinct interpreter-evaluated sub-expressions in a lowered policy."""
+    seen: Dict[int, object] = {}
+    for clause in list(lp.clauses) + list(lp.error_clauses):
+        for cl in clause:
+            if cl.lit.kind in (HARD, HARD_OK, HARD_ERR):
+                seen[id(cl.lit.expr)] = cl.lit.expr
+    # dedupe by formatted text: one expr may appear as several AST objects
+    out: Dict[str, object] = {}
+    for e in seen.values():
+        out[format_expr(e)] = e
+    return list(out.values())
+
+
+def lint_lowerability(infos: List[PolicyInfo]) -> List[Finding]:
+    from ..compiler.dyn import dyn_spec
+
+    findings: List[Finding] = []
+    for info in infos:
+        if info.fallback is not None:
+            fb = info.fallback
+            msg = fb.reason
+            if fb.construct is not None:
+                msg += f" — offending construct: `{format_expr(fb.construct)}`"
+            findings.append(_finding(fb.code, info, msg))
+            continue
+        lp = info.lowered
+        # a clause the simplifier kept may still be self-contradictory in
+        # ways only the implication engine sees (e.g. two different
+        # positive equalities on one slot)
+        sat_clauses = [c for c in lp.clauses if clause_self_satisfiable(c)]
+        if not sat_clauses and not lp.error_clauses:
+            findings.append(
+                _finding(
+                    "never_matches",
+                    info,
+                    "every evaluation path is statically contradictory; the "
+                    "policy can never match or error",
+                )
+            )
+            continue
+        hard = _hard_exprs(lp)
+        opaque = [e for e in hard if dyn_spec(e) is None]
+        if opaque:
+            shown = ", ".join(f"`{format_expr(e)}`" for e in opaque[:3])
+            findings.append(
+                _finding(
+                    "native_opaque",
+                    info,
+                    f"{len(opaque)} sub-expression(s) outside the native "
+                    f"template class: {shown}",
+                )
+            )
+        elif hard:
+            shown = ", ".join(f"`{format_expr(e)}`" for e in hard[:3])
+            findings.append(
+                _finding(
+                    "hard_literal",
+                    info,
+                    f"{len(hard)} host-evaluated sub-expression(s): {shown}",
+                )
+            )
+        if len(lp.clauses) >= CLAUSE_HEAVY:
+            findings.append(
+                _finding(
+                    "clause_heavy",
+                    info,
+                    f"expands to {len(lp.clauses)} DNF rules "
+                    f"(+{len(lp.error_clauses)} error rules)",
+                )
+            )
+    return findings
+
+
+class _Budget:
+    def __init__(self, n: int):
+        self.left = n
+        self.exhausted = False
+
+    def take(self, n: int) -> bool:
+        if self.left < n:
+            self.exhausted = True
+            return False
+        self.left -= n
+        return True
+
+
+def find_shadowing(
+    infos: List[PolicyInfo], budget: Optional[_Budget] = None
+) -> List[Finding]:
+    """Policies that provably never change any decision.
+
+    Soundness (what makes every finding differentially verifiable):
+      * only LOWERED policies are eligible, and a victim with error
+        clauses requires the shadower to ERROR on every request the
+        victim errors on too — an error is an explicit tier-stop signal,
+        so removing a policy may only happen when its every signal
+        (match AND error) is duplicated by the shadower;
+      * the shadower must match every request the victim matches
+        (clause-set cover over error-exact hardened clauses);
+      * cross-tier: ANY earlier-tier cover makes the victim unreachable
+        (the earlier tier emits an explicit signal and the walk stops
+        before the victim's tier is consulted);
+      * same-tier: a forbid cover silences both forbids (redundant) and
+        permits (forbid-overrides); a permit cover only silences permits.
+    """
+    budget = budget or _Budget(PAIR_BUDGET)
+    findings: List[Finding] = []
+    lowered = [i for i in infos if i.lowered is not None and i.lowered.clauses]
+    for victim in lowered:
+        vclauses = victim.lowered.clauses
+        verrors = victim.lowered.error_clauses
+        vkeys = frozenset(clause_key(c) for c in vclauses)
+        best: Optional[tuple] = None  # (code, shadower)
+        for shadower in lowered:
+            if shadower is victim:
+                continue
+            same_tier = shadower.tier == victim.tier
+            if shadower.tier > victim.tier:
+                continue
+            if same_tier:
+                if not (
+                    shadower.effect == FORBID
+                    or (shadower.effect == PERMIT and victim.effect == PERMIT)
+                ):
+                    continue
+            s_all = shadower.lowered.clauses + shadower.lowered.error_clauses
+            if not budget.take(
+                len(shadower.lowered.clauses) * len(vclauses)
+                + len(s_all) * len(verrors)
+            ):
+                break
+            if not covers(shadower.lowered.clauses, vclauses):
+                continue
+            # the victim's ERROR signal must be duplicated too: whenever
+            # the victim errors, the shadower must error or match on the
+            # same request — otherwise deleting the victim could silently
+            # resume a tier descent its error used to stop
+            if verrors and not covers(s_all, verrors):
+                continue
+            skeys = frozenset(clause_key(c) for c in shadower.lowered.clauses)
+            if skeys == vkeys and shadower.effect == victim.effect:
+                code = "duplicate"
+            elif not same_tier:
+                code = "shadowed"
+            elif victim.effect == PERMIT and shadower.effect == FORBID:
+                code = "unreachable_permit"
+            elif victim.effect == FORBID:
+                code = "redundant_forbid"
+            else:  # same tier, permit covered by a broader permit
+                code = "redundant_permit"
+            best = (code, shadower)
+            break
+        if best is not None:
+            code, shadower = best
+            where = (
+                "the same tier"
+                if shadower.tier == victim.tier
+                else f"tier {shadower.tier}"
+            )
+            findings.append(
+                _finding(
+                    code,
+                    victim,
+                    f"every request this {victim.effect} matches is already "
+                    f"matched by {shadower.effect} "
+                    f"`{shadower.policy.policy_id}` in {where}; deleting it "
+                    "changes no decision",
+                    related=(shadower.policy.policy_id,),
+                )
+            )
+    return findings
+
+
+def find_conflicts(
+    infos: List[PolicyInfo],
+    budget: Optional[_Budget] = None,
+    shadow_ids: Optional[frozenset] = None,
+) -> List[Finding]:
+    """permit/forbid pairs with a satisfiable clause intersection where the
+    forbid decides (same tier: forbid-overrides; earlier tier: the walk
+    stops there). Pairs whose permit is already reported unreachable are
+    skipped — the shadowing finding subsumes the conflict."""
+    budget = budget or _Budget(PAIR_BUDGET)
+    shadow_ids = shadow_ids or frozenset()
+    findings: List[Finding] = []
+    lowered = [i for i in infos if i.lowered is not None and i.lowered.clauses]
+    permits = [i for i in lowered if i.effect == PERMIT]
+    forbids = [i for i in lowered if i.effect == FORBID]
+    for p in permits:
+        if p.policy.policy_id in shadow_ids:
+            continue
+        for f in forbids:
+            if f.tier > p.tier:
+                continue  # later-tier forbid never beats this permit
+            if not budget.take(
+                len(p.lowered.clauses) * len(f.lowered.clauses)
+            ):
+                return findings
+            sat = any(
+                clause_pair_satisfiable(pc, fc)
+                for pc in p.lowered.clauses
+                for fc in f.lowered.clauses
+            )
+            if not sat:
+                continue
+            where = (
+                "the same tier (forbid overrides)"
+                if f.tier == p.tier
+                else f"earlier tier {f.tier} (the walk stops there)"
+            )
+            findings.append(
+                _finding(
+                    "permit_forbid_overlap",
+                    p,
+                    "requests can satisfy both this permit and forbid "
+                    f"`{f.policy.policy_id}` in {where}; those requests "
+                    "are denied",
+                    related=(f.policy.policy_id,),
+                )
+            )
+    return findings
+
+
+def capacity_report(infos: List[PolicyInfo], n_tiers: int) -> dict:
+    """Predicted device-table cost of the set, from the same pack() the
+    engine uses — operators see slot-table/vocab growth and packing-bucket
+    occupancy BEFORE a deploy, not from a production latency regression."""
+    from ..compiler.ir import CompiledPolicies
+    from ..compiler.pack import _bucket, pack
+
+    compiled = CompiledPolicies(n_tiers=max(n_tiers, 1))
+    for i in infos:
+        if i.lowered is not None:
+            compiled.lowered.append(i.lowered)
+        else:
+            compiled.fallback.append(i.fallback)
+    packed = pack(compiled)
+    vocab_entries = (
+        len(packed.table.type_vocab)
+        + len(packed.table.uid_vocab)
+        + len(packed.table.anc_vocab)
+        + sum(len(v) for v in packed.table.scalar_vocab.values())
+    )
+    per_policy = []
+    for i in infos:
+        if i.lowered is None:
+            continue
+        lp = i.lowered
+        lits = {
+            cl.lit.key() for c in lp.clauses + lp.error_clauses for cl in c
+        }
+        slots = {
+            cl.lit.slot
+            for c in lp.clauses + lp.error_clauses
+            for cl in c
+            if cl.lit.slot is not None
+        }
+        per_policy.append(
+            {
+                "policy": i.policy.policy_id,
+                "tier": i.tier,
+                "rules": len(lp.clauses),
+                "error_rules": len(lp.error_clauses),
+                "literals": len(lits),
+                "slots": len(slots),
+            }
+        )
+    return {
+        "n_rules": packed.n_rules,
+        "n_lits": packed.n_lits,
+        "L": packed.L,
+        "R": packed.R,
+        "rule_occupancy": packed.n_rules / packed.R,
+        "lit_occupancy": packed.n_lits / packed.L,
+        "rule_headroom": packed.R - packed.n_rules,
+        "lit_headroom": packed.L - packed.n_lits,
+        "next_rule_bucket": _bucket(packed.R + 1),
+        "table_rows": packed.table.n_rows_real,
+        "code_dtype": packed.table.code_dtype.__name__,
+        "n_slots": packed.table.n_slots,
+        "vocab_entries": vocab_entries,
+        "gate_rules": int(packed.has_gate),
+        "native_opaque_policies": packed.native_opaque,
+        "fallback_policies": len(compiled.fallback),
+        "per_policy": per_policy,
+    }
+
+
+def analyze_tiers(
+    tiers: Sequence,
+    schema: Optional[SchemaInfo] = None,
+    pair_budget: int = PAIR_BUDGET,
+    capacity: bool = True,
+) -> AnalysisReport:
+    """Analyze a whole tiered policy set (list of PolicySet, tier order).
+
+    Returns the full report: lowerability findings for every policy,
+    shadowing/unreachability, permit/forbid conflicts, per-tier
+    lowerability stats, and (unless capacity=False) the static capacity
+    report."""
+    infos = lower_all(tiers, schema)
+    report = AnalysisReport()
+    report.findings.extend(lint_lowerability(infos))
+    budget = _Budget(pair_budget)
+    shadow_findings = find_shadowing(infos, budget)
+    report.findings.extend(shadow_findings)
+    shadow_ids = frozenset(
+        f.policy_id for f in shadow_findings if f.code == "unreachable_permit"
+    )
+    report.findings.extend(find_conflicts(infos, budget, shadow_ids))
+    report.truncated = budget.exhausted
+    for tier_idx in range(len(tiers)):
+        tier_infos = [i for i in infos if i.tier == tier_idx]
+        n_fallback = sum(1 for i in tier_infos if i.fallback is not None)
+        report.tiers[tier_idx] = {
+            "policies": len(tier_infos),
+            "lowerable": len(tier_infos) - n_fallback,
+            "fallback": n_fallback,
+        }
+    if capacity:
+        report.capacity = capacity_report(infos, len(tiers))
+    return report

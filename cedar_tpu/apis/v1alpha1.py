@@ -23,6 +23,11 @@ STORE_TYPE_VERIFIED_PERMISSIONS = "verifiedPermissions"
 VALIDATION_MODE_STRICT = "strict"
 VALIDATION_MODE_PERMISSIVE = "permissive"
 VALIDATION_MODE_PARTIAL = "partial"
+VALIDATION_MODES = (
+    VALIDATION_MODE_STRICT,
+    VALIDATION_MODE_PERMISSIVE,
+    VALIDATION_MODE_PARTIAL,
+)
 
 
 class ValidationError(ValueError):
@@ -270,15 +275,28 @@ class StoreConfig:
 @dataclass
 class CedarConfig:
     stores: List[StoreConfig] = field(default_factory=list)
+    # spec.validationMode: load-time posture of the static policy-set
+    # analysis (cedar_tpu/analysis): strict rejects a load carrying
+    # blocking findings, permissive annotates only, partial drops just the
+    # offending policies from the compiled set (docs/analysis.md).
+    validation_mode: str = VALIDATION_MODE_PERMISSIVE
 
     @classmethod
     def from_dict(cls, d: dict) -> "CedarConfig":
         spec = d.get("spec", {}) or {}
         return cls(
-            stores=[StoreConfig.from_dict(s) for s in spec.get("stores", []) or []]
+            stores=[StoreConfig.from_dict(s) for s in spec.get("stores", []) or []],
+            validation_mode=spec.get(
+                "validationMode", VALIDATION_MODE_PERMISSIVE
+            ),
         )
 
     def validate(self) -> None:
+        if self.validation_mode not in VALIDATION_MODES:
+            raise ValidationError(
+                f".spec.validationMode: {self.validation_mode!r} is not one "
+                f"of {list(VALIDATION_MODES)}"
+            )
         for i, store in enumerate(self.stores):
             try:
                 store.validate()

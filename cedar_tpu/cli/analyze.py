@@ -1,0 +1,151 @@
+"""cedar-analyze: whole-policy-set static analysis.
+
+Reports, for a tiered policy set (each positional argument is one tier,
+in tier order):
+
+  * TPU-lowerability per policy, with the reason code and offending
+    construct for every interpreter-fallback policy;
+  * shadowing/unreachability (policies that provably never change any
+    decision) and duplicates within/across tiers;
+  * permit/forbid conflict pairs with a satisfiable clause intersection;
+  * the static capacity report (packing-bucket occupancy, activation-table
+    rows, vocab growth) — TPU table cost before a deploy.
+
+Tier arguments may be ``.cedar`` files, directories of ``.cedar`` files,
+or Kubernetes manifests (``.yaml``/``.yml``/``.json`` documents whose
+``spec.content`` holds Cedar text — the Policy CRD layout, e.g.
+``demo/authorization-policy.yaml``).
+
+``--check`` is the CI mode: exit 1 when any finding at or above
+``--fail-level`` (default: error) exists. See docs/analysis.md for the
+reason-code catalog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import List, Optional
+
+from ..analysis import analyze_tiers
+from ..analysis.analyze import PAIR_BUDGET
+from ..lang.authorize import PolicySet
+from ..lang.parser import parse_policies
+
+
+def _manifest_sources(path: pathlib.Path) -> List[tuple]:
+    """(name, cedar text) per document with spec.content in a manifest."""
+    import yaml
+
+    out = []
+    docs = list(yaml.safe_load_all(path.read_text()))
+    for i, doc in enumerate(docs):
+        if not isinstance(doc, dict):
+            continue
+        content = ((doc.get("spec") or {}).get("content") or "").strip()
+        if not content:
+            continue
+        name = (doc.get("metadata") or {}).get("name") or f"doc{i}"
+        out.append((f"{path}#{name}", content))
+    return out
+
+
+def load_tier(arg: str) -> PolicySet:
+    """One tier: a .cedar file, a directory of them (manifests included),
+    or a Policy-CRD manifest."""
+    path = pathlib.Path(arg)
+    if not path.exists():
+        raise FileNotFoundError(f"no such file or directory: {arg}")
+    ps = PolicySet()
+
+    def add_cedar(p: pathlib.Path) -> None:
+        # ids key on the path RELATIVE to the tier argument: two files
+        # with the same basename in different subdirectories must not
+        # collide (PolicySet.add overwrites on id, silently dropping one
+        # file from the analysis)
+        rel = p.relative_to(path) if p != path else p.name
+        for i, pol in enumerate(parse_policies(p.read_text(), str(p))):
+            ps.add(pol, policy_id=f"{rel}.policy{i}")
+
+    def add_manifest(p: pathlib.Path) -> None:
+        for name, content in _manifest_sources(p):
+            for i, pol in enumerate(parse_policies(content, name)):
+                ps.add(pol, policy_id=f"{name}.policy{i}")
+
+    if path.is_dir():
+        for p in sorted(path.rglob("*.cedar")):
+            add_cedar(p)
+        for ext in ("*.yaml", "*.yml"):
+            for p in sorted(path.rglob(ext)):
+                add_manifest(p)
+    elif path.suffix in (".yaml", ".yml", ".json"):
+        add_manifest(path)
+    else:
+        add_cedar(path)
+    return ps
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="cedar-analyze", description=__doc__)
+    parser.add_argument(
+        "tiers",
+        nargs="+",
+        metavar="TIER",
+        help=".cedar file, directory, or Policy-CRD manifest — one per "
+        "tier, in tier order",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the full report as JSON"
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="CI mode: exit 1 when findings at/above --fail-level exist",
+    )
+    parser.add_argument(
+        "--fail-level",
+        default="error",
+        choices=["error", "warning", "info"],
+        help="minimum severity that fails --check (default: error)",
+    )
+    parser.add_argument(
+        "--no-capacity",
+        action="store_true",
+        help="skip the capacity report (faster on huge sets)",
+    )
+    parser.add_argument(
+        "--pair-budget",
+        type=int,
+        default=PAIR_BUDGET,
+        help="clause-pair comparison budget for the quadratic "
+        "shadowing/conflict passes; exhaustion is reported, never silent",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        tiers = [load_tier(t) for t in args.tiers]
+    except Exception as e:  # noqa: BLE001 — file/parse problems are exit 2
+        print(f"cedar-analyze: {e}", file=sys.stderr)
+        return 2
+    if not any(len(ps) for ps in tiers):
+        print("cedar-analyze: no policies found", file=sys.stderr)
+        return 2
+
+    report = analyze_tiers(
+        tiers,
+        pair_budget=args.pair_budget,
+        capacity=not args.no_capacity,
+    )
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(report.render_text())
+    if args.check and report.at_or_above(args.fail_level):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

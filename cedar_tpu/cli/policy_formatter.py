@@ -8,19 +8,20 @@ same layout the RBAC converter emits (tests/test_format.py proves the
 round trip preserves decisions).
 
 Comment handling: the parser does not retain comments, so the formatter
-re-attaches LEADING ``//`` lines (the contiguous run directly above each
-policy) itself — the common documentation style, e.g.
+re-attaches LEADING ``//`` lines (the run above each policy, blank lines
+crossed — unless the comment hugs the code above it, which marks it as a
+trailing comment) itself — the common documentation style, e.g.
 mount/policies/demo.cedar. A file whose comments appear anywhere else
 (inline after code, inside a policy body, trailing the last policy) is
 SKIPPED with a warning rather than silently stripped; pass
 ``--strip-comments`` to format it anyway, losing exactly those comments.
 
 ``--check`` reports files that would change without writing and exits 1
-(the CI mode); skipped commented files are listed in its summary but do
-not fail the check — the check covers what the formatter can safely
-rewrite. Golden corpus files (tests/testdata) are deliberately NOT
-covered by ``make format-policies`` — they pin byte-parity with the
-reference's converter output, not this formatter's layout.
+(the CI mode); skipped commented files also FAIL the check — a skipped
+file is an unchecked file, and CI must not silently lose coverage.
+Golden corpus files (tests/testdata) are deliberately NOT covered by
+``make format-policies`` — they pin byte-parity with the reference's
+converter output, not this formatter's layout.
 """
 
 from __future__ import annotations
@@ -79,15 +80,42 @@ def format_source(text: str, strip_comments: bool = False) -> str:
         j = p.position[1] - 2  # 0-based index of the line above the policy
         # stop at lines another policy already claimed: two policies on
         # one source line share the same "line above" — the comment
-        # attaches to the FIRST of them only, never duplicated
-        while (
-            j >= 0
-            and j not in attached
-            and lines[j].lstrip().startswith("//")
-        ):
-            lead.append(lines[j].strip())
-            attached.add(j)
-            j -= 1
+        # attaches to the FIRST of them only, never duplicated. Blank
+        # lines between the comment block and the policy (or between
+        # comment blocks) are crossed, so documentation separated by
+        # spacing still attaches — EXCEPT a block that hugs the code
+        # above it while a blank separates it from this policy: that is
+        # the previous policy's TRAILING comment, and claiming it would
+        # silently re-home it; leave it unattached (file skipped).
+        crossed_blank = False
+        while j >= 0 and j not in attached:
+            stripped = lines[j].strip()
+            if stripped == "":
+                crossed_blank = True
+                j -= 1
+                continue
+            if not stripped.startswith("//"):
+                break
+            g = j
+            group: List[tuple] = []
+            while (
+                g >= 0
+                and g not in attached
+                and lines[g].strip().startswith("//")
+            ):
+                group.append((g, lines[g].strip()))
+                g -= 1
+            if (
+                crossed_blank
+                and g >= 0
+                and g not in attached
+                and lines[g].strip() != ""
+            ):
+                break  # trailing comment of the code above — not ours
+            for idx, s in group:
+                lead.append(s)
+                attached.add(idx)
+            j = g
         lead.reverse()
         blocks.append("\n".join(lead + [format_policy(p)]))
     if not strip_comments:
@@ -152,12 +180,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     if skipped:
         print(
             f"{skipped} file(s) skipped (unattachable comments) — not "
-            "checked",
+            + ("checked" if args.check else "formatted"),
             file=sys.stderr,
         )
     if failed:
         return 2
-    if args.check and changed:
+    # --check must not silently lose coverage: a skipped file is an
+    # unchecked file, and CI treating it as success would let an
+    # unformatted (or unformattable) file rot — fail the check instead
+    if args.check and (changed or skipped):
         return 1
     return 0
 

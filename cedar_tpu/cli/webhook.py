@@ -89,7 +89,20 @@ class TPUReloader:
         self._fps: dict = {}
         self._stop = threading.Event()
 
+    @staticmethod
+    def _tiers_for(tier_stores) -> list:
+        """Tiers for engine compilation, through the load-time analysis
+        gate when the tier stack carries a validation mode
+        (TieredPolicyStores.analyzed_policy_sets): strict raises
+        AnalysisRejected so the engine keeps its previous compiled set."""
+        analyzed = getattr(tier_stores, "analyzed_policy_sets", None)
+        if analyzed is not None:
+            return analyzed()
+        return [s.policy_set() for s in tier_stores]
+
     def reload_if_changed(self) -> bool:
+        from ..analysis import AnalysisRejected
+
         if not all(s.initial_policy_load_complete() for s in self.stores):
             return False
         fp = _fingerprint(self.stores)
@@ -98,7 +111,20 @@ class TPUReloader:
             if self._fps.get(idx) == fp:
                 continue
             try:
-                stats = engine.load([s.policy_set() for s in tier_stores])
+                stats = engine.load(self._tiers_for(tier_stores))
+            except AnalysisRejected as e:
+                # strict validation: the new corpus is rejected wholesale;
+                # keep serving the previous compiled set AND remember the
+                # fingerprint — re-analyzing an unchanged bad corpus every
+                # tick would only repeat the log/metric spam
+                log.error(
+                    "TPU engine [%d] load rejected by policy analysis; "
+                    "serving previous set: %s",
+                    idx,
+                    e,
+                )
+                self._fps[idx] = fp
+                continue
             except Exception:
                 log.exception(
                     "TPU engine [%d] reload failed; serving previous set", idx
@@ -155,6 +181,9 @@ def build_server(args) -> WebhookServer:
     if args.config:
         with open(args.config) as f:
             config = parse_config(f.read())
+    if config is not None and getattr(args, "validation_mode", ""):
+        # CLI flag overrides the config file's spec.validationMode
+        config.validation_mode = args.validation_mode
     stores = cedar_config_stores(config, kubeconfig_path=args.kubeconfig or None)
     if not len(stores.stores):
         log.warning("no policy stores configured; authorizer will no-opinion")
@@ -272,9 +301,12 @@ def build_server(args) -> WebhookServer:
                 native_error(),
             )
 
-    # admission gets the allow-all final tier (main.go:111-116)
+    # admission gets the allow-all final tier (main.go:111-116); it shares
+    # the authz stack's validation posture (the synthetic allow-all tail is
+    # trivially lowerable, so the gate treats both stacks identically)
     admission_stores = TieredPolicyStores(
-        list(stores.stores) + [allow_all_admission_policy_store()]
+        list(stores.stores) + [allow_all_admission_policy_store()],
+        validation_mode=stores.validation_mode,
     )
     admission_evaluate = None
     admission_evaluate_batch = None
@@ -333,6 +365,19 @@ def build_server(args) -> WebhookServer:
     if args.insecure:
         certfile = keyfile = None
 
+    def analysis_provider() -> dict:
+        """The last load-time analysis report per tier stack, for the
+        /debug/analysis endpoint; {} until the first analyzed load."""
+        out = {}
+        for name, ts in (
+            ("authorization", stores),
+            ("admission", admission_stores),
+        ):
+            rep = getattr(ts, "last_analysis", None)
+            if rep is not None:
+                out[name] = rep.to_dict()
+        return out
+
     return WebhookServer(
         authorizer=authorizer,
         admission_handler=admission_handler,
@@ -352,6 +397,7 @@ def build_server(args) -> WebhookServer:
         ),
         admission_fail_open=admission_fail_open,
         drain_grace_s=args.shutdown_grace_seconds,
+        analysis_provider=analysis_provider,
     )
 
 
@@ -389,6 +435,15 @@ def make_parser() -> argparse.ArgumentParser:
         "--no-native",
         action="store_true",
         help="disable the C++ SAR fast path (python encode only)",
+    )
+    cedar.add_argument(
+        "--validation-mode",
+        default="",
+        choices=["", "strict", "permissive", "partial"],
+        help="load-time policy-analysis posture, overriding the config "
+        "file's spec.validationMode: strict rejects loads with blocking "
+        "findings, permissive annotates, partial drops only the offending "
+        "policies (docs/analysis.md)",
     )
     cedar.add_argument(
         "--batch-window-us",

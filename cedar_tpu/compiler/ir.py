@@ -55,7 +55,23 @@ TRUE = "true"  # constant true (from literal folding)
 
 class Unlowerable(Exception):
     """Raised when a policy can't be lowered to the tensor IR; the policy is
-    then evaluated by the interpreter fallback (hybrid verdict merge)."""
+    then evaluated by the interpreter fallback (hybrid verdict merge).
+
+    Carries a stable machine-readable ``code`` (see
+    cedar_tpu/analysis/report.py for the operator-facing catalog) and,
+    when a specific sub-expression forced the fallback, that ``construct``
+    — so the static analyzer can point at the exact offending syntax
+    instead of re-deriving it from the message string."""
+
+    def __init__(
+        self,
+        message: str,
+        code: str = "unlowerable",
+        construct: Optional[Expr] = None,
+    ):
+        super().__init__(message)
+        self.code = code
+        self.construct = construct
 
 
 @dataclass(frozen=True)
@@ -105,6 +121,10 @@ class FallbackPolicy:
     policy: Policy
     tier: int
     reason: str
+    # stable reason code from the Unlowerable that routed the policy here
+    code: str = "unlowerable"
+    # the sub-expression that forced the fallback, when pinpointed
+    construct: Optional[Expr] = None
 
 
 @dataclass

@@ -361,10 +361,14 @@ def _conj(prefixes: List[Clause], suffixes: List[Clause]) -> List[Clause]:
         for s in suffixes:
             c = p + s
             if len(c) > MAX_LITERALS:
-                raise Unlowerable("clause literal limit exceeded")
+                raise Unlowerable(
+                    "clause literal limit exceeded", code="literal_limit"
+                )
             out.append(c)
             if len(out) > MAX_CLAUSES:
-                raise Unlowerable("clause count limit exceeded")
+                raise Unlowerable(
+                    "clause count limit exceeded", code="clause_limit"
+                )
     return out
 
 
@@ -644,7 +648,9 @@ def harden_clause(
 
                     if dyn_spec(lit.expr) is None:
                         raise Unlowerable(
-                            "negated unlowerable expression may error at runtime"
+                            "negated unlowerable expression may error at runtime",
+                            code="negated_opaque",
+                            construct=lit.expr,
                         )
                 # the error clause must NOT include the HARD_OK guard: the
                 # guard is active exactly when no error occurred
@@ -664,7 +670,8 @@ def harden_clause(
                 got = schema.attr_type(type_ctx.get(lit.var), lit.var, lit.slot[1])
                 if got != want:
                     raise Unlowerable(
-                        f"negated {lit.kind} on attribute of uncertain type"
+                        f"negated {lit.kind} on attribute of uncertain type",
+                        code="negated_untyped",
                     )
             # presence guards keep the device path aligned with Cedar's
             # error-skip on the negated literal
@@ -680,7 +687,10 @@ def harden_clause(
                 proven.update(lit.accesses)
         out.append(cl)
     if len(out) > MAX_LITERALS:
-        raise Unlowerable("clause literal limit exceeded after hardening")
+        raise Unlowerable(
+            "clause literal limit exceeded after hardening",
+            code="literal_limit",
+        )
     return tuple(out), errors
 
 
@@ -809,6 +819,12 @@ def lower_tiers(
                 out.lowered.append(lower_policy(policy, tier_idx, schema))
             except Unlowerable as e:
                 out.fallback.append(
-                    FallbackPolicy(policy=policy, tier=tier_idx, reason=str(e))
+                    FallbackPolicy(
+                        policy=policy,
+                        tier=tier_idx,
+                        reason=str(e),
+                        code=e.code,
+                        construct=e.construct,
+                    )
                 )
     return out

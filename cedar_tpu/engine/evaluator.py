@@ -102,6 +102,11 @@ def _join_warm_threads_at_exit() -> None:
 atexit.register(_join_warm_threads_at_exit)
 
 
+class WireSpanError(ValueError):
+    """A feature code fell outside its slot's u8 wire span (see
+    _CompiledSet.pack_wire); the flat code layout must be used instead."""
+
+
 def _round_bucket(n: int, buckets) -> int:
     for b in buckets:
         if n <= b:
@@ -314,10 +319,23 @@ class _CompiledSet:
         layout (codes8 u8, codes_w code_dtype) exactly as the device
         kernel expects it — the ONE definition of the wire transform,
         shared by the serving path (match_arrays_launch) and the bench so
-        the two can never drift."""
+        the two can never drift.
+
+        Raises WireSpanError when any code falls outside its slot's
+        promised [lo8, lo8+254] span: the uint8 cast would silently wrap
+        and gather a WRONG activation row on device. A span violation
+        means the codes were produced against a different table than this
+        set's wire plan (encoder/set mismatch) — the caller falls back to
+        the flat layout, which carries full-width codes."""
         idx8, idx16, lo8 = self.wire
         B = codes.shape[0]
         c8 = codes[:, idx8]
+        if not ((c8 == 0) | ((c8 >= lo8) & (c8 - lo8 + 1 <= 255))).all():
+            bad = np.nonzero(~((c8 == 0) | ((c8 >= lo8) & (c8 - lo8 + 1 <= 255))))
+            raise WireSpanError(
+                f"u8 wire span violation at (row, slot) {tuple(zip(*[b[:4].tolist() for b in bad]))}: "
+                "codes out of the slot's promised 255-row span"
+            )
         c8 = np.where(c8 == 0, 0, c8 - lo8 + 1).astype(np.uint8)
         if self._wire_pad8:
             c8 = np.concatenate(
@@ -787,8 +805,25 @@ class TPUPolicyEngine:
             # the masked scan saves (docs/Limitations.md). Large batches
             # therefore keep the scan plane even when segs are enabled.
             segs = cs.segs if chunk_c.shape[0] <= SERVING_CHUNK else None
+            wire_codes = None
             if cs.wire is not None:
-                c8, cw = cs.pack_wire(chunk_c)
+                try:
+                    wire_codes = cs.pack_wire(chunk_c)
+                except WireSpanError:
+                    # a span violation means these codes don't fit the u8
+                    # plan (advisor r5): serve THIS set via the flat
+                    # layout from here on instead of wrapping uint8 into a
+                    # wrong activation row. One log; the flat kernel is
+                    # correct, just a fatter transfer.
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "u8 wire span violation; disabling the wire layout "
+                        "for this compiled set (flat codes from now on)"
+                    )
+                    cs.wire = None
+            if wire_codes is not None:
+                c8, cw = wire_codes
                 out = match_rules_codes_wire(
                     c8, cw, cs.lo8_dev, chunk_e, *args,
                     packed.n_tiers, want_full, want_bits,

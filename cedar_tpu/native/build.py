@@ -30,13 +30,13 @@ def _glue_include() -> str:
     return ""
 
 
-def _source_hash() -> str:
+def _source_hash(with_glue: bool) -> str:
     import sysconfig
 
     arch = os.environ.get("CEDAR_NATIVE_ARCH", "native")
     h = hashlib.sha256(_SRC.read_bytes())
     h.update(arch.encode())
-    if _glue_include():
+    if with_glue:
         # the glue compiles PyList/PyObject struct-offset macros for THIS
         # interpreter's ABI: key the cache on it so a different
         # interpreter (or a headers-appeared-later host) rebuilds
@@ -45,12 +45,51 @@ def _source_hash() -> str:
     return h.hexdigest()[:16]
 
 
-def library_path() -> pathlib.Path:
-    return _BUILD_DIR / f"libcedar_native_{_source_hash()}.so"
+def library_path(with_glue: bool = None) -> pathlib.Path:
+    """The cache path for a (source, arch, glue?) build. The glue state is
+    part of the FILENAME, so a glueless fallback build can never occupy
+    the glue-tagged slot: a transient toolchain failure leaves the glue
+    path absent and the next import retries the full glue compile instead
+    of being pinned to the slower packed-buffer entries forever."""
+    if with_glue is None:
+        with_glue = bool(_glue_include())
+    tag = "glue_" if with_glue else ""
+    return _BUILD_DIR / f"libcedar_native_{tag}{_source_hash(with_glue)}.so"
+
+
+def _compile(out: pathlib.Path, glue_inc: str) -> None:
+    cxx = os.environ.get("CXX", "g++")
+    # CEDAR_NATIVE_ARCH=x86-64 (etc.) builds a portable binary — set it
+    # for container images so the .so survives a host-CPU change; the
+    # default tunes for the build machine
+    arch = os.environ.get("CEDAR_NATIVE_ARCH", "native")
+    tmp = out.with_suffix(".so.tmp")
+    cmd = [
+        cxx,
+        "-O3",
+        f"-march={arch}",
+        "-fno-plt",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+    ]
+    if glue_inc:
+        cmd += ["-DCEDAR_PY_GLUE", f"-I{glue_inc}"]
+    cmd += [str(_SRC), "-o", str(tmp)]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, out)
 
 
 def ensure_built() -> pathlib.Path:
-    """Compile (once) and return the shared-library path."""
+    """Compile (once) and return the shared-library path.
+
+    CPython glue (the *_pylist zero-packing entries) is best-effort:
+    compiled in when this interpreter's headers are present, dropped on
+    compile failure — the ctypes loader probes for the symbols and falls
+    back to the packed-buffer entries (native/__init__.py). The fallback
+    build is cached under the GLUELESS filename, so the glue compile is
+    retried on the next import rather than permanently pinned off."""
     out = library_path()
     if out.exists():
         return out
@@ -58,44 +97,23 @@ def ensure_built() -> pathlib.Path:
         if out.exists():
             return out
         _BUILD_DIR.mkdir(exist_ok=True)
-        cxx = os.environ.get("CXX", "g++")
-        # CEDAR_NATIVE_ARCH=x86-64 (etc.) builds a portable binary — set it
-        # for container images so the .so survives a host-CPU change; the
-        # default tunes for the build machine
-        arch = os.environ.get("CEDAR_NATIVE_ARCH", "native")
-        tmp = out.with_suffix(".so.tmp")
-        cmd = [
-            cxx,
-            "-O3",
-            f"-march={arch}",
-            "-fno-plt",
-            "-std=c++17",
-            "-shared",
-            "-fPIC",
-            "-pthread",
-            str(_SRC),
-            "-o",
-            str(tmp),
-        ]
-        # CPython glue (the *_pylist zero-packing entries) is best-effort:
-        # compiled in when this interpreter's headers are present, dropped
-        # otherwise — the ctypes loader probes for the symbols and falls
-        # back to the packed-buffer entries (native/__init__.py)
         inc = _glue_include()
-        glue = ["-DCEDAR_PY_GLUE", f"-I{inc}"] if inc else []
         try:
-            subprocess.run(
-                cmd[:1] + glue + cmd[1:], check=True, capture_output=True,
-                text=True,
-            )
+            _compile(out, inc)
         except subprocess.CalledProcessError:
-            if not glue:
+            if not inc:
                 raise
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(tmp, out)
-        # drop stale builds of older source revisions
+            # glue compile failed (e.g. transient toolchain breakage):
+            # build without it at the glueless cache slot
+            out = library_path(with_glue=False)
+            if not out.exists():
+                _compile(out, "")
+        # drop stale builds of older source revisions — but keep the
+        # glueless fallback alongside a glue request, and vice versa: the
+        # two names can legitimately coexist across retry cycles
+        keep = {library_path(with_glue=False), library_path(with_glue=True)}
         for old in _BUILD_DIR.glob("libcedar_native_*.so"):
-            if old != out:
+            if old not in keep:
                 try:
                     old.unlink()
                 except OSError:

@@ -201,6 +201,7 @@ class WebhookServer:
         request_timeout_s: Optional[float] = None,
         admission_fail_open: Optional[bool] = None,
         drain_grace_s: float = 0.0,
+        analysis_provider=None,
     ):
         self.authorizer = authorizer
         self.admission_handler = admission_handler
@@ -260,6 +261,10 @@ class WebhookServer:
                 getattr(admission_handler, "allow_on_error", True)
             )
         self.admission_fail_open = admission_fail_open
+        # () -> dict | None: the last policy-set analysis report
+        # (cedar_tpu/analysis), served on the metrics server's
+        # /debug/analysis endpoint for operators
+        self.analysis_provider = analysis_provider
         self.drain_grace_s = drain_grace_s
         self._draining = False
         self._inflight = 0
@@ -615,6 +620,24 @@ class WebhookServer:
                     self.send_header(
                         "Content-Type", "text/plain; version=0.0.4"
                     )
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                elif self.path == "/debug/analysis":
+                    # the last policy-set analysis report (load-time
+                    # lowerability/shadowing/conflict findings + capacity);
+                    # {} until the first analyzed load completes
+                    if server.analysis_provider is None:
+                        self.send_error(404)
+                        return
+                    try:
+                        doc = server.analysis_provider() or {}
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("analysis provider failed")
+                        doc = {"error": "analysis provider failed"}
+                    data = json.dumps(doc).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)

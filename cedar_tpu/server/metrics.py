@@ -248,6 +248,31 @@ fallback_batches_total = REGISTRY.register(
 )
 
 
+# Static-analysis metrics (cedar_tpu/analysis): deliberately outside the
+# cedar_authorizer_* request subsystem — they describe the POLICY SET, not
+# request traffic, and are re-published at every policy load.
+policy_fastpath_lowerable = REGISTRY.register(
+    Gauge(
+        "cedar_policy_fastpath_lowerable",
+        "Policies per tier the compiler lowers to the TPU fast path; the "
+        "remainder evaluate on the per-row Python interpreter fallback. A "
+        "drop after a policy deploy is the early signal of a latency "
+        "regression (docs/analysis.md).",
+        ["tier"],
+    )
+)
+
+policy_analysis_findings_total = REGISTRY.register(
+    Counter(
+        "cedar_policy_analysis_findings_total",
+        "Static-analysis findings observed at policy load, partitioned by "
+        "reason code (docs/analysis.md catalog). Counted per load pass: "
+        "alert on new codes appearing, not on magnitude.",
+        ["kind"],
+    )
+)
+
+
 def record_request_total(decision: str) -> None:
     request_total.inc(decision=decision)
 
@@ -283,3 +308,12 @@ def record_shed(path: str) -> None:
 
 def record_fallback_batch(path: str, reason: str) -> None:
     fallback_batches_total.inc(path=path, reason=reason)
+
+
+def set_fastpath_lowerable(tier: int, count: int) -> None:
+    policy_fastpath_lowerable.set(count, tier=str(tier))
+
+
+def record_analysis_findings(kind: str, n: int) -> None:
+    if n:
+        policy_analysis_findings_total.inc(n, kind=kind)

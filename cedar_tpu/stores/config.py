@@ -53,6 +53,7 @@ def cedar_config_stores(
                 CRDPolicyStore(
                     kubeconfig_path=kubeconfig_path,
                     kubeconfig_context=sd.crd_store.kubeconfig_context,
+                    validation_mode=config.validation_mode,
                 )
             )
         elif sd.type == STORE_TYPE_VERIFIED_PERMISSIONS:
@@ -67,4 +68,4 @@ def cedar_config_stores(
                     profile=sd.verified_permissions_store.aws_profile,
                 )
             )
-    return TieredPolicyStores(stores)
+    return TieredPolicyStores(stores, validation_mode=config.validation_mode)

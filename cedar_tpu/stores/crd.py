@@ -54,10 +54,18 @@ class CRDPolicyStore:
         kubeconfig_path: Optional[str] = None,
         kubeconfig_context: str = "",
         start: bool = True,
+        validation_mode: Optional[str] = None,
     ):
         self._source = source
         self._kubeconfig_path = kubeconfig_path or os.environ.get("KUBECONFIG", "")
         self._kubeconfig_context = kubeconfig_context
+        # load-time lowerability gate per Policy object
+        # (CedarConfig.validationMode; analysis/loadgate.py): strict
+        # rejects the whole object like a parse error, partial drops only
+        # the offending policies, permissive logs + counts. None skips the
+        # analysis entirely. Whole-set passes (shadowing/conflicts) need
+        # the full tier view and run at engine load instead.
+        self._validation_mode = validation_mode
         self._policies = PolicySet()
         self._ids_by_object: dict = {}  # object name -> [policy ids]
         # object name -> (uid, content): generation bumps ONLY when this
@@ -177,10 +185,60 @@ class CRDPolicyStore:
 
     def _parse(self, obj: PolicyObject):
         try:
-            return parse_policies(obj.spec.content, obj.name)
+            policies = parse_policies(obj.spec.content, obj.name)
         except ParseError as e:
             log.error("Error parsing policy %s: %s", obj.name, e)
             return None
+        return self._validated(obj, policies)
+
+    def _validated(self, obj: PolicyObject, policies):
+        """Apply the load-time lowerability gate to one object's policies
+        per the validation mode; None rejects the object entirely."""
+        if not self._validation_mode or not policies:
+            return policies
+        from ..analysis.loadgate import check_object_policies
+        from ..apis.v1alpha1 import (
+            VALIDATION_MODE_PARTIAL,
+            VALIDATION_MODE_STRICT,
+        )
+        from ..server.metrics import record_analysis_findings
+
+        checked = check_object_policies(policies)
+        bad = [(p, f) for p, f in checked if f is not None]
+        if not bad:
+            return policies
+        for _p, f in bad:
+            record_analysis_findings(f.code, 1)
+            log.log(
+                logging.ERROR
+                if self._validation_mode == VALIDATION_MODE_STRICT
+                else logging.WARNING,
+                "policy %s: analysis %s[%s]: %s",
+                obj.name,
+                f.severity,
+                f.code,
+                f.message,
+            )
+        if self._validation_mode == VALIDATION_MODE_STRICT:
+            log.error(
+                "rejecting Policy object %s (strict validation): %d "
+                "policy(ies) not fastpath-lowerable",
+                obj.name,
+                len(bad),
+            )
+            return None
+        if self._validation_mode == VALIDATION_MODE_PARTIAL:
+            dropped = {id(p) for p, _f in bad}
+            kept = [p for p in policies if id(p) not in dropped]
+            log.warning(
+                "Policy object %s: dropped %d of %d policy(ies) "
+                "(partial validation)",
+                obj.name,
+                len(bad),
+                len(policies),
+            )
+            return kept
+        return policies  # permissive: annotate only
 
     def _copy_on_write(self, mutate) -> None:
         """Build a mutated copy and swap the reference — O(policies) per

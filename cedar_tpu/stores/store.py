@@ -10,7 +10,7 @@ Behavior parity with reference internal/server/store/store.go:
 from __future__ import annotations
 
 import logging
-from typing import List, Protocol, Tuple, runtime_checkable
+from typing import List, Optional, Protocol, Tuple, runtime_checkable
 
 from ..lang.authorize import DENY, Diagnostics, PolicySet
 from ..lang.entities import EntityMap
@@ -33,8 +33,47 @@ class PolicyStore(Protocol):
 
 
 class TieredPolicyStores:
-    def __init__(self, stores: List[PolicyStore]):
+    def __init__(
+        self,
+        stores: List[PolicyStore],
+        validation_mode: Optional[str] = None,
+    ):
         self.stores = list(stores)
+        # load-time analysis posture (CedarConfig.validationMode); None
+        # disables the gate entirely (tests, bare construction)
+        self.validation_mode = validation_mode
+        # the last AnalysisReport the gate produced (served by the
+        # /debug/analysis endpoint); None until the first analyzed load
+        self.last_analysis = None
+
+    def analyzed_policy_sets(self) -> List[PolicySet]:
+        """Tiers for ENGINE COMPILATION after the load-time analysis gate
+        (analysis/loadgate.py): strict raises AnalysisRejected (callers
+        keep serving their previous compiled set), partial returns tiers
+        with the offending policies dropped, permissive returns the tiers
+        unchanged but publishes findings/metrics. With no validation mode
+        set, this is exactly the raw policy_set() list.
+
+        The gate shapes what the compiler sees; the interpreter walk
+        below (is_authorized) always evaluates the stores' raw sets. On
+        the TPU backend decisions come from the compiled set, so partial
+        REMOVES dropped policies from served decisions — a dropped
+        forbid weakens enforcement (docs/analysis.md)."""
+        tiers = [s.policy_set() for s in self.stores]
+        if not self.validation_mode:
+            return tiers
+        from ..analysis.loadgate import enforce
+
+        try:
+            tiers, report = enforce(tiers, self.validation_mode)
+        except Exception as e:
+            # strict rejection carries its report for the debug endpoint
+            report = getattr(e, "report", None)
+            if report is not None:
+                self.last_analysis = report
+            raise
+        self.last_analysis = report
+        return tiers
 
     def __iter__(self):
         return iter(self.stores)

@@ -1,0 +1,710 @@
+"""Static-analysis subsystem tests (cedar_tpu/analysis + cedar-analyze).
+
+Covers the lowerability matrix (every fallback reason code), the
+shadowing/conflict passes with a DIFFERENTIAL oracle (any policy flagged
+unreachable must never change any decision when deleted, across the whole
+request corpus), the load-time strict/permissive/partial gate through
+TieredPolicyStores and the CRD store, the analysis metrics, the debug
+endpoint, and the u8 wire span guard satellite.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cedar_tpu.analysis import (
+    AnalysisRejected,
+    analyze_tiers,
+    check_object_policies,
+)
+from cedar_tpu.analysis.analyze import lower_all
+from cedar_tpu.analysis.report import REASONS, SEV_ERROR
+from cedar_tpu.apis.v1alpha1 import PolicyObject
+from cedar_tpu.lang import (
+    ALLOW,
+    CedarRecord,
+    CedarSet,
+    Entity,
+    EntityMap,
+    EntityUID,
+    Request,
+)
+from cedar_tpu.lang.authorize import PolicySet
+from cedar_tpu.stores.crd import CRDPolicyStore
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+# ---------------------------------------------------------------- fixtures
+
+LOWERABLE = 'permit (principal, action, resource) when { resource.resource == "pods" };'
+
+# every fallback reason code -> a policy exercising exactly it
+FALLBACK_MATRIX = {
+    # negated opaque expression (containsAll over an error-prone element
+    # on a literal set: outside both the rewrite and the dyn class)
+    "negated_opaque": (
+        "permit (principal, action, resource) "
+        "unless { [1, 2].containsAll([resource.name]) };"
+    ),
+    # negated typed test on a context attribute (static type unknown)
+    "negated_untyped": (
+        "permit (principal, action, resource) "
+        'unless { context.path like "/api*" };'
+    ),
+    # 2^7 = 128 > MAX_CLAUSES evaluation paths
+    "clause_limit": (
+        "permit (principal, action, resource) when { "
+        + " && ".join(
+            f'(context.a{i} == "x" || context.b{i} == "x")' for i in range(7)
+        )
+        + " };"
+    ),
+    # one conjunction of 33 > MAX_LITERALS literals
+    "literal_limit": (
+        "permit (principal, action, resource) when { "
+        + " && ".join(f'context.a{i} == "x"' for i in range(33))
+        + " };"
+    ),
+}
+
+
+def analyze_src(*tier_sources, **kw):
+    return analyze_tiers(
+        [
+            PolicySet.from_source(src, f"tier{i}")
+            for i, src in enumerate(tier_sources)
+        ],
+        **kw,
+    )
+
+
+def codes_of(report, kind=None):
+    return [
+        f.code for f in report.findings if kind is None or f.kind == kind
+    ]
+
+
+# ------------------------------------------------------ lowerability matrix
+
+
+@pytest.mark.parametrize("code", sorted(FALLBACK_MATRIX))
+def test_fallback_reason_codes(code):
+    report = analyze_src(FALLBACK_MATRIX[code])
+    errors = [f for f in report.findings if f.severity == SEV_ERROR]
+    assert [f.code for f in errors] == [code]
+    assert errors[0].policy_id == "policy0"
+    assert errors[0].hint  # every code has a fix hint in the catalog
+    assert report.tiers[0] == {"policies": 1, "lowerable": 0, "fallback": 1}
+
+
+def test_fallback_matrix_is_exhaustive():
+    """Every raisable Unlowerable code in the compiler is exercised above
+    (the catalog's generic `unlowerable` is the default for raises that
+    predate coding — there are none left)."""
+    import re
+
+    import cedar_tpu.compiler.lower as lower_mod
+
+    src = open(lower_mod.__file__).read()
+    raised = set(re.findall(r'code="(\w+)"', src))
+    assert raised == set(FALLBACK_MATRIX)
+
+
+def test_offending_construct_is_reported():
+    report = analyze_src(FALLBACK_MATRIX["negated_opaque"])
+    (f,) = [f for f in report.findings if f.severity == SEV_ERROR]
+    assert "containsAll" in f.message
+
+
+def test_lowerable_set_is_clean():
+    report = analyze_src(LOWERABLE)
+    assert report.findings == []
+    assert report.tiers[0] == {"policies": 1, "lowerable": 1, "fallback": 0}
+
+
+def test_native_opaque_and_hard_literal_warnings():
+    # slot-templated contains: lowers, native dyn class -> hard_literal
+    dyn = (
+        "permit (principal, action, resource) when "
+        "{ resource.labelSelector.contains("
+        '{key: "owner", operator: "=", values: [principal.name]}) };'
+    )
+    report = analyze_src(dyn)
+    assert codes_of(report) == ["hard_literal"]
+    # an extension method over request data: lowers as a POSITIVE hard
+    # literal (no negation, so no fallback) but is outside the dyn
+    # template class -> native_opaque
+    opaque = (
+        "permit (principal, action, resource) when "
+        "{ context.sourceIP.isIpv4() };"
+    )
+    report = analyze_src(opaque)
+    assert "native_opaque" in codes_of(report)
+
+
+def test_never_matches():
+    # constant-folded false: no clauses, no error clauses
+    report = analyze_src("permit (principal, action, resource) when { false };")
+    assert "never_matches" in codes_of(report)
+    # two different positive equalities on one error-free slot
+    # (principal.name is schema-mandatory, so no error clauses either)
+    report = analyze_src(
+        "permit (principal, action, resource) "
+        'when { principal.name == "a" && principal.name == "b" };'
+    )
+    assert "never_matches" in codes_of(report)
+    # NOT flagged when the policy can still error (resource.apiGroup is
+    # not mandatory across all resource types): the error is a signal
+    report = analyze_src(
+        "permit (principal, action, resource) "
+        'when { resource.apiGroup == "a" && resource.apiGroup == "b" };'
+    )
+    assert "never_matches" not in codes_of(report)
+
+
+def test_clause_heavy_capacity_info():
+    src = (
+        "permit (principal, action, resource) when { "
+        + " && ".join(
+            f'(context.a{i} == "x" || context.b{i} == "x")' for i in range(5)
+        )
+        + " };"
+    )  # 2^5 = 32 rules: heavy but under MAX_CLAUSES
+    report = analyze_src(src)
+    assert "clause_heavy" in codes_of(report)
+
+
+def test_reason_catalog_complete():
+    report = analyze_src(*FALLBACK_MATRIX.values())
+    for f in report.findings:
+        assert f.code in REASONS
+        assert f.kind and f.severity and f.hint
+
+
+# ------------------------------------------------- shadowing + differential
+
+SHADOW_TIERS = [
+    # tier 0
+    """
+forbid (principal, action, resource) when { resource.resource == "secrets" };
+permit (principal, action, resource) when { resource.resource == "secrets" };
+permit (principal in k8s::Group::"admins", action, resource) when { resource.resource == "pods" };
+permit (principal in k8s::Group::"admins", action == k8s::Action::"get", resource) when { resource.resource == "pods" };
+forbid (principal, action, resource) when { resource.resource == "nodes" };
+forbid (principal, action, resource) when { resource.resource == "nodes" && resource.apiGroup == "" };
+""",
+    # tier 1
+    """
+permit (principal in k8s::Group::"admins", action, resource) when { resource.resource == "pods" };
+forbid (principal, action, resource) when { resource.resource == "secrets" && resource.namespace == "prod" };
+permit (principal, action, resource) when { resource.resource == "configmaps" };
+""",
+]
+
+_SHADOW_CODES = (
+    "shadowed",
+    "duplicate",
+    "unreachable_permit",
+    "redundant_forbid",
+    "redundant_permit",
+)
+
+
+def request_corpus():
+    """A corpus crossing principals x actions x resources, attribute
+    presence included — the differential oracle's domain."""
+    admins = EntityUID("k8s::Group", "admins")
+    corpus = []
+    for pname, groups in (("alice", (admins,)), ("bob", ())):
+        for verb in ("get", "list", "create"):
+            for rname, attrs in (
+                ("pods", {"resource": "pods", "apiGroup": ""}),
+                ("secrets", {"resource": "secrets", "apiGroup": ""}),
+                (
+                    "secrets-prod",
+                    {
+                        "resource": "secrets",
+                        "apiGroup": "",
+                        "namespace": "prod",
+                    },
+                ),
+                ("nodes", {"resource": "nodes", "apiGroup": ""}),
+                ("nodes-noapigroup", {"resource": "nodes"}),
+                ("configmaps", {"resource": "configmaps", "apiGroup": ""}),
+                ("none", {}),
+            ):
+                em = EntityMap()
+                u = EntityUID("k8s::User", pname)
+                em.add(
+                    Entity(u, CedarRecord({"name": pname}), parents=groups)
+                )
+                em.add(Entity(admins, CedarRecord({"name": "admins"})))
+                a = EntityUID("k8s::Action", verb)
+                r = EntityUID("k8s::Resource", rname)
+                em.add(
+                    Entity(
+                        r,
+                        CedarRecord(
+                            {k: v for k, v in attrs.items()}
+                        ),
+                    )
+                )
+                corpus.append((em, Request(u, a, r, CedarRecord())))
+    return corpus
+
+
+def decisions(tier_sources, corpus):
+    stores = TieredPolicyStores(
+        [
+            MemoryStore.from_source(f"tier{i}", src)
+            for i, src in enumerate(tier_sources)
+        ]
+    )
+    return [stores.is_authorized(em, req)[0] for em, req in corpus]
+
+
+def test_shadowing_findings_exist():
+    report = analyze_src(*SHADOW_TIERS)
+    codes = codes_of(report, kind="shadowing")
+    assert "unreachable_permit" in codes  # tier0 permit secrets
+    assert "redundant_permit" in codes  # tier0 narrow admins get pods
+    assert "redundant_forbid" in codes  # tier0 nodes+apiGroup forbid
+    assert "duplicate" in codes  # tier1 admins pods permit
+    assert "shadowed" in codes  # tier1 secrets-prod forbid
+
+
+def test_unreachable_policies_differentially_verified():
+    """THE acceptance property: deleting any policy the analyzer flags as
+    shadowed/unreachable/duplicate/redundant changes no decision on any
+    corpus request."""
+    report = analyze_src(*SHADOW_TIERS)
+    flagged = [
+        f for f in report.findings if f.code in _SHADOW_CODES
+    ]
+    assert flagged, "fixture must produce shadowing findings"
+    corpus = request_corpus()
+    baseline = decisions(SHADOW_TIERS, corpus)
+    assert ALLOW in baseline  # the corpus must exercise both decisions
+    tier_sets = [
+        PolicySet.from_source(src, f"tier{i}")
+        for i, src in enumerate(SHADOW_TIERS)
+    ]
+    for f in flagged:
+        mutated = []
+        for i, ps in enumerate(tier_sets):
+            if i != f.tier:
+                mutated.append(ps)
+                continue
+            trimmed = PolicySet()
+            for p in ps.policies():
+                if p.policy_id != f.policy_id:
+                    trimmed.add(p, policy_id=p.policy_id)
+            assert len(trimmed) == len(ps) - 1
+            mutated.append(trimmed)
+        stores = TieredPolicyStores(
+            [MemoryStore(f"tier{i}", ps) for i, ps in enumerate(mutated)]
+        )
+        got = [stores.is_authorized(em, req)[0] for em, req in corpus]
+        assert got == baseline, (
+            f"deleting {f.policy_id} (flagged {f.code}) changed decisions"
+        )
+
+
+def test_shadowing_respects_error_signals():
+    """A policy that can ERROR where its shadower neither errors nor
+    matches must NOT be flagged: its error is a tier-stop signal deletion
+    would erase (e.g. on a pods request with no namespace below, the
+    permit errors — stopping descent with a deny — while the forbid is
+    silent; deleting the permit would fall through to the allow-all)."""
+    tiers = [
+        # namespace is accessed FIRST, so the permit errors on ANY
+        # request missing it — including requests outside the forbid
+        """
+forbid (principal, action, resource) when { resource.resource == "secrets" };
+permit (principal, action, resource) when { resource.namespace == "x" && resource.resource == "secrets" };
+""",
+        "permit (principal, action, resource);",
+    ]
+    report = analyze_src(*tiers)
+    assert not [
+        f
+        for f in report.findings
+        if f.code in _SHADOW_CODES and f.policy_id == "policy1"
+    ]
+    # sanity: the differential indeed changes if policy1 were deleted
+    em = EntityMap()
+    u = EntityUID("k8s::User", "eve")
+    em.add(Entity(u, CedarRecord({"name": "eve"})))
+    a = EntityUID("k8s::Action", "get")
+    r = EntityUID("k8s::Resource", "pods")
+    em.add(Entity(r, CedarRecord({"resource": "pods", "apiGroup": ""})))
+    req = Request(u, a, r, CedarRecord())
+    with_p = decisions(tiers, [(em, req)])
+    without = decisions(
+        [
+            'forbid (principal, action, resource) when { resource.resource == "secrets" };',
+            tiers[1],
+        ],
+        [(em, req)],
+    )
+    assert with_p != without
+
+
+def test_conflict_pairs():
+    report = analyze_src(
+        """
+permit (principal, action, resource) when { resource.resource == "pods" };
+forbid (principal, action, resource) when { resource.resource == "pods" && resource.namespace == "kube-system" };
+forbid (principal, action, resource) when { resource.resource == "nodes" };
+"""
+    )
+    conflicts = [f for f in report.findings if f.kind == "conflict"]
+    assert len(conflicts) == 1  # pods overlap yes, nodes disjoint
+    assert conflicts[0].related == ("policy1",)
+
+
+def test_conflict_disjoint_literals_not_flagged():
+    report = analyze_src(
+        """
+permit (principal, action, resource) when { resource.resource == "pods" };
+forbid (principal, action, resource) when { resource.resource == "secrets" };
+"""
+    )
+    assert not [f for f in report.findings if f.kind == "conflict"]
+
+
+def test_pair_budget_truncation_is_reported():
+    report = analyze_src(SHADOW_TIERS[0], pair_budget=1)
+    assert report.truncated
+    assert "PARTIAL" in report.render_text()
+
+
+# ----------------------------------------------------------------- capacity
+
+
+def test_capacity_report():
+    report = analyze_src(*SHADOW_TIERS)
+    cap = report.capacity
+    assert cap["n_rules"] > 0
+    assert cap["R"] >= cap["n_rules"]
+    assert 0 < cap["rule_occupancy"] <= 1
+    assert cap["table_rows"] > 0
+    assert cap["vocab_entries"] > 0
+    assert cap["code_dtype"] in ("int16", "int32")
+    per = {p["policy"]: p for p in cap["per_policy"]}
+    assert all(p["rules"] >= 1 for p in per.values())
+    # fallback policies appear in the count, not per-policy rows
+    report2 = analyze_src(FALLBACK_MATRIX["negated_opaque"])
+    assert report2.capacity["fallback_policies"] == 1
+    assert report2.capacity["gate_rules"] == 1
+
+
+# ------------------------------------------------------------ load-time gate
+
+BAD = FALLBACK_MATRIX["negated_opaque"]
+
+
+def _tiered(mode):
+    return TieredPolicyStores(
+        [MemoryStore.from_source("t0", LOWERABLE + "\n" + BAD)],
+        validation_mode=mode,
+    )
+
+
+def test_loadgate_permissive_annotates():
+    ts = _tiered("permissive")
+    tiers = ts.analyzed_policy_sets()
+    assert [len(t) for t in tiers] == [2]
+    assert ts.last_analysis is not None
+    assert "negated_opaque" in ts.last_analysis.counts()
+
+
+def test_loadgate_partial_drops_offender():
+    ts = _tiered("partial")
+    tiers = ts.analyzed_policy_sets()
+    assert [len(t) for t in tiers] == [1]
+    assert [p.policy_id for p in tiers[0].policies()] == ["policy0"]
+    # the interpreter walk still sees the RAW set
+    assert len(ts.stores[0].policy_set()) == 2
+
+
+def test_loadgate_strict_rejects():
+    ts = _tiered("strict")
+    with pytest.raises(AnalysisRejected) as ei:
+        ts.analyzed_policy_sets()
+    assert "negated_opaque" in str(ei.value)
+    assert ts.last_analysis is not None  # report survives for debugging
+
+
+def test_loadgate_none_passthrough():
+    ts = _tiered(None)
+    assert [len(t) for t in ts.analyzed_policy_sets()] == [2]
+    assert ts.last_analysis is None
+
+
+def test_loadgate_clean_set_all_modes():
+    for mode in ("strict", "permissive", "partial"):
+        ts = TieredPolicyStores(
+            [MemoryStore.from_source("t0", LOWERABLE)], validation_mode=mode
+        )
+        assert [len(t) for t in ts.analyzed_policy_sets()] == [1]
+
+
+def test_fastpath_lowerable_metric_exported():
+    from cedar_tpu.server import metrics
+
+    ts = _tiered("permissive")
+    ts.analyzed_policy_sets()
+    exposition = metrics.REGISTRY.expose()
+    assert 'cedar_policy_fastpath_lowerable{tier="0"} 1' in exposition
+    assert "cedar_policy_analysis_findings_total" in exposition
+
+
+# ------------------------------------------------------------- CRD store e2e
+
+
+def _policy_obj(name, uid, content):
+    return PolicyObject.from_dict(
+        {
+            "metadata": {"name": name, "uid": uid},
+            "spec": {"content": content},
+        }
+    )
+
+
+def test_check_object_policies():
+    from cedar_tpu.lang.parser import parse_policies
+
+    pols = parse_policies(LOWERABLE + "\n" + BAD, "obj")
+    checked = check_object_policies(pols)
+    assert [f is None for _p, f in checked] == [True, False]
+    assert checked[1][1].code == "negated_opaque"
+
+
+def test_crd_store_strict_rejects_non_lowerable():
+    store = CRDPolicyStore(start=False, validation_mode="strict")
+    store.on_add(_policy_obj("good", "u1", LOWERABLE))
+    store.on_add(_policy_obj("bad", "u2", BAD))
+    ids = [p.policy_id for p in store.policy_set().policies()]
+    assert ids == ["good0-u1"]  # the whole bad object was rejected
+    # a MIXED object is rejected wholesale in strict mode too
+    store.on_add(_policy_obj("mixed", "u3", LOWERABLE + "\n" + BAD))
+    ids = sorted(p.policy_id for p in store.policy_set().policies())
+    assert ids == ["good0-u1"]
+
+
+def test_crd_store_partial_drops_only_offender():
+    store = CRDPolicyStore(start=False, validation_mode="partial")
+    store.on_add(_policy_obj("mixed", "u3", LOWERABLE + "\n" + BAD))
+    ids = [p.policy_id for p in store.policy_set().policies()]
+    assert ids == ["mixed0-u3"]
+
+
+def test_crd_store_permissive_keeps_everything():
+    store = CRDPolicyStore(start=False, validation_mode="permissive")
+    store.on_add(_policy_obj("mixed", "u3", LOWERABLE + "\n" + BAD))
+    assert len(store.policy_set()) == 2
+
+
+def test_crd_store_strict_end_to_end_with_source():
+    """Through the real lifecycle: initial list + watch events, strict
+    validation rejecting the non-lowerable object at load."""
+    import threading
+    import time
+
+    class Source:
+        def __init__(self):
+            self.watched = threading.Event()
+
+        def list(self):
+            return [
+                _policy_obj("good", "u1", LOWERABLE),
+                _policy_obj("bad", "u2", BAD),
+            ]
+
+        def watch(self, on_event, stop):
+            on_event("ADDED", _policy_obj("late-bad", "u9", BAD))
+            self.watched.set()
+            stop.wait(5)
+
+    src = Source()
+    store = CRDPolicyStore(source=src, start=True, validation_mode="strict")
+    deadline = time.time() + 5
+    while not src.watched.is_set() and time.time() < deadline:
+        time.sleep(0.01)
+    assert store.initial_policy_load_complete()
+    ids = [p.policy_id for p in store.policy_set().policies()]
+    assert ids == ["good0-u1"]
+    store.close()
+
+
+# ----------------------------------------------------- reloader + debug http
+
+
+def test_reloader_strict_keeps_previous_set():
+    from cedar_tpu.cli.webhook import TPUReloader
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+
+    good = MemoryStore.from_source("t0", LOWERABLE)
+    ts = TieredPolicyStores([good], validation_mode="strict")
+    engine = TPUPolicyEngine()
+    reloader = TPUReloader(ts, targets=[(engine, ts)], interval_s=999)
+    assert reloader.reload_if_changed()
+    assert engine.loaded
+    rules_before = engine.stats["rules"]
+    # corpus turns bad: the strict gate must reject, engine keeps serving
+    bad = MemoryStore.from_source("t0", LOWERABLE + "\n" + BAD)
+    ts.stores[0] = bad
+    reloader._fps.clear()
+    assert not reloader.reload_if_changed()
+    assert engine.stats["rules"] == rules_before
+
+
+def test_debug_analysis_endpoint():
+    from cedar_tpu.server.admission import (
+        CedarAdmissionHandler,
+        allow_all_admission_policy_store,
+    )
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.server.http import WebhookServer
+
+    ts = _tiered("permissive")
+    ts.analyzed_policy_sets()
+    server = WebhookServer(
+        authorizer=CedarWebhookAuthorizer(ts),
+        admission_handler=CedarAdmissionHandler(
+            TieredPolicyStores([allow_all_admission_policy_store()])
+        ),
+        address="127.0.0.1",
+        port=0,
+        metrics_port=0,
+        analysis_provider=lambda: {
+            "authorization": ts.last_analysis.to_dict()
+        },
+    )
+    server.start()
+    try:
+        port = server.bound_metrics_port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/analysis", timeout=5
+        ) as resp:
+            doc = json.loads(resp.read())
+        counts = doc["authorization"]["counts"]
+        assert counts.get("negated_opaque") == 1
+        assert doc["authorization"]["capacity"]["n_rules"] > 0
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_cli_check_modes(tmp_path, capsys):
+    from cedar_tpu.cli.analyze import main
+
+    clean = tmp_path / "clean.cedar"
+    clean.write_text(LOWERABLE)
+    dirty = tmp_path / "dirty.cedar"
+    dirty.write_text(LOWERABLE + "\n" + BAD)
+    assert main([str(clean), "--check"]) == 0
+    assert main([str(dirty), "--check"]) == 1
+    assert main([str(dirty)]) == 0  # report-only never fails
+    assert main([str(tmp_path / "missing.cedar")]) == 2
+    out = capsys.readouterr().out
+    assert "negated_opaque" in out
+
+
+def test_cli_json_and_manifest(tmp_path, capsys):
+    from cedar_tpu.cli.analyze import main
+
+    manifest = tmp_path / "p.yaml"
+    manifest.write_text(
+        "apiVersion: cedar.k8s.aws/v1alpha1\n"
+        "kind: Policy\n"
+        "metadata:\n  name: demo\n"
+        "spec:\n  content: |\n"
+        "    permit (principal, action, resource) "
+        'when { resource.resource == "pods" };\n'
+    )
+    assert main([str(manifest), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["tiers"]["0"]["lowerable"] == 1
+    assert doc["capacity"]["n_rules"] >= 1
+
+
+def test_cli_subdir_same_basename_no_collision(tmp_path, capsys):
+    """Same-named .cedar files in different subdirectories of one tier
+    must all be analyzed: ids key on the tier-relative path, not the
+    basename (review finding — basename collisions silently dropped
+    files from the analysis while --check exited 0)."""
+    from cedar_tpu.cli.analyze import main
+
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    (tmp_path / "a" / "p.cedar").write_text(LOWERABLE)
+    (tmp_path / "b" / "p.cedar").write_text(
+        "forbid (principal, action, resource) "
+        'when { resource.resource == "secrets" };'
+    )
+    assert main([str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["tiers"]["0"]["policies"] == 2
+
+
+def test_cli_multi_tier_shadowing(tmp_path, capsys):
+    from cedar_tpu.cli.analyze import main
+
+    t0 = tmp_path / "t0.cedar"
+    t0.write_text(LOWERABLE)
+    t1 = tmp_path / "t1.cedar"
+    t1.write_text(LOWERABLE)
+    assert main([str(t0), str(t1), "--check", "--fail-level", "warning"]) == 1
+    assert "duplicate" in capsys.readouterr().out
+
+
+# ----------------------------------------------- u8 wire span guard satellite
+
+
+def test_pack_wire_span_guard():
+    """Out-of-span codes raise instead of silently wrapping uint8, and the
+    serving path falls back to the flat layout (advisor r5 finding)."""
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine, WireSpanError
+
+    engine = TPUPolicyEngine()
+    engine.load(
+        [PolicySet.from_source(LOWERABLE, "t0")], warm="off"
+    )
+    cs = engine._compiled
+    if cs.wire is None:
+        pytest.skip("wire layout not active for this set")
+    n_slots = cs.packed.table.n_slots
+    good = np.zeros((2, n_slots), dtype=np.int32)
+    cs.pack_wire(good)  # in-span codes pass
+    bad = np.full((2, n_slots), 30000, dtype=np.int32)
+    with pytest.raises(WireSpanError):
+        cs.pack_wire(bad)
+    # serving path: the same bad codes fall back to the flat kernel and
+    # still answer (wire is disabled for the set afterwards)
+    extras = np.full((2, 1), cs.packed.L, dtype=cs.active_dtype)
+    words, _ = engine.match_arrays(bad, extras, cs=cs)
+    assert words.shape == (2,)
+    assert cs.wire is None
+
+
+def test_pack_wire_good_codes_roundtrip():
+    """In-span encoded requests still produce identical results through
+    the guarded wire path (guard must not reject valid traffic)."""
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(LOWERABLE, "t0")], warm="off")
+    em = EntityMap()
+    u = EntityUID("k8s::User", "alice")
+    em.add(Entity(u, CedarRecord({"name": "alice"})))
+    a = EntityUID("k8s::Action", "get")
+    r = EntityUID("k8s::Resource", "pods")
+    em.add(Entity(r, CedarRecord({"resource": "pods", "apiGroup": ""})))
+    decision, diag = engine.evaluate(em, Request(u, a, r, CedarRecord()))
+    assert decision == ALLOW
+    assert diag.reasons

@@ -140,6 +140,45 @@ def test_policy_formatter_cli(tmp_path):
     assert main([]) == 0
 
 
+def test_policy_formatter_blank_separated_comment_attaches():
+    """A doc block separated from its policy by blank line(s) attaches
+    instead of skipping the file (advisor r5: the scan crosses blanks)."""
+    from cedar_tpu.cli.policy_formatter import format_source
+
+    out = format_source("// doc\n\n\npermit(principal,action,resource);")
+    assert out.startswith("// doc\npermit")
+
+
+def test_policy_formatter_trailing_comment_not_rehomed():
+    """A comment hugging the previous policy, blank-separated from the
+    next, is the previous policy's TRAILING comment: the blank-crossing
+    scan must not silently re-home it onto the next policy — the file
+    stays skipped (unattachable), exactly as before the crossing."""
+    from cedar_tpu.cli.policy_formatter import (
+        _HasUnattachableComments,
+        format_source,
+    )
+
+    src = (
+        "permit(principal,action,resource);\n"
+        "// TODO: tighten the permit above\n\n"
+        "forbid(principal,action,resource);"
+    )
+    with pytest.raises(_HasUnattachableComments):
+        format_source(src)
+
+
+def test_policy_formatter_check_fails_on_skipped(tmp_path):
+    """--check exits nonzero when a file is skipped: a skipped file is an
+    unchecked file, and CI must not silently lose coverage."""
+    from cedar_tpu.cli.policy_formatter import main
+
+    k = tmp_path / "k.cedar"
+    k.write_text("permit(principal,action,resource); // trailing\n")
+    assert main(["--check", str(k)]) == 1
+    assert "// trailing" in k.read_text()  # never rewritten by --check
+
+
 def test_policy_formatter_shared_line_comment_not_duplicated():
     """Two policies on one source line share the same 'line above': the
     leading comment attaches to the FIRST only (review finding, round 5)."""
