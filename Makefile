@@ -41,6 +41,10 @@ chaos: ## Run the fault-injection resilience suite (cpu backend)
 bench: ## Run the headline benchmark on the attached device
 	$(PYTHON) bench.py
 
+.PHONY: bench-cache
+bench-cache: ## Decision-cache microbenchmark: Zipf SAR replay, hit ratio + cached-path p50/p99 vs the batched engine (cpu)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --cache
+
 .PHONY: hw-validate
 hw-validate: ## Measure kernel planes (int8/bf16/pallas/segred) on the attached device
 	$(PYTHON) tools/hw_validate.py

@@ -497,6 +497,143 @@ permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
     return out
 
 
+def run_cache_scenario() -> int:
+    """``bench.py --cache`` (``make bench-cache``): decision-cache
+    microbenchmark replaying a Zipf-distributed SAR stream — the shape of
+    real apiserver traffic, where a few hot (kubelet/controller) requests
+    dominate — through a real WebhookServer with the decision cache wired.
+
+    Reports the measured hit ratio and the cached-path p50/p99 against two
+    uncached baselines driven by the SAME stream: the hybrid engine path
+    (authorizer → TPUPolicyEngine.evaluate) and the batched-engine path
+    (MicroBatcher.submit → evaluate_batch, i.e. what a fastpath miss pays
+    including the batch-forming window). The acceptance claim is
+    ``cached_p50_below_batched_engine_p50``: a repeated SAR answered from
+    cache must be strictly cheaper than the batched engine. Runs on the cpu
+    backend by design — the cache's win must not depend on device speed."""
+    import jax  # noqa: F401 — backend must initialize before engine import
+
+    from cedar_tpu.cache import DecisionCache
+    from cedar_tpu.engine.batcher import MicroBatcher
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.server.admission import (
+        CedarAdmissionHandler,
+        allow_all_admission_policy_store,
+    )
+    from cedar_tpu.server.authorizer import (
+        CedarWebhookAuthorizer,
+        record_to_cedar_resource,
+    )
+    from cedar_tpu.server.http import WebhookServer, get_authorizer_attributes
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    t0 = time.time()
+    n_policies = _n(1000, 120)
+    ps, users, nss, resources, verbs, groups = build_policy_set(n_policies)
+    engine = TPUPolicyEngine()
+    engine.load([ps], warm="off")
+
+    # Zipf-distributed stream over a pool of unique SARs: rank r drawn with
+    # weight 1/r^1.1 (the classic web/apiserver skew exponent)
+    rng = random.Random(42)
+    n_unique = _n(512, 64)
+    n_requests = _n(8000, 1200)
+    pool = []
+    for _ in range(n_unique):
+        sar = {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": rng.choice(users),
+                "uid": "u",
+                "groups": [f"team-{rng.randint(0, 50)}"],
+                "resourceAttributes": {
+                    "verb": rng.choice(verbs),
+                    "version": "v1",
+                    "resource": rng.choice(resources),
+                    "namespace": rng.choice(nss),
+                },
+            },
+        }
+        pool.append(json.dumps(sar).encode())
+    weights = [1.0 / (r ** 1.1) for r in range(1, n_unique + 1)]
+    stream = rng.choices(pool, weights=weights, k=n_requests)
+
+    store = MemoryStore("bench", ps)
+    stores = TieredPolicyStores([store])
+    cache = DecisionCache(generation_fn=stores.cache_generation)
+    authorizer = CedarWebhookAuthorizer(stores, evaluate=engine.evaluate)
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores([store, allow_all_admission_policy_store()])
+    )
+    server = WebhookServer(authorizer, handler, decision_cache=cache)
+
+    # -- cached path: real handle_authorize with the cache wired; each
+    # request classified hit/miss by the cache's own counters
+    hit_lat, miss_lat = [], []
+    server.handle_authorize(stream[0])  # warm (first compile/eval paths)
+    for body in stream:
+        hits_before = cache.stats()["hits"]
+        t = time.monotonic()
+        server.handle_authorize(body)
+        dt = time.monotonic() - t
+        (hit_lat if cache.stats()["hits"] > hits_before else miss_lat).append(dt)
+
+    # -- uncached hybrid-engine baseline (same stream, cache off)
+    server_off = WebhookServer(authorizer, handler, decision_cache=None)
+    engine_lat = []
+    for body in stream[: _n(2000, 400)]:
+        t = time.monotonic()
+        server_off.handle_authorize(body)
+        engine_lat.append(time.monotonic() - t)
+
+    # -- batched-engine baseline: MicroBatcher.submit → evaluate_batch,
+    # the exact cost a cache hit avoids on the fast path (encode + window
+    # + device call)
+    batcher = MicroBatcher(engine.evaluate_batch, window_s=0.0002)
+    try:
+        items = [
+            record_to_cedar_resource(get_authorizer_attributes(json.loads(b)))
+            for b in stream[: _n(2000, 400)]
+        ]
+        batcher.submit(items[0], timeout=30)  # warm
+        batched_lat = []
+        for item in items:
+            t = time.monotonic()
+            batcher.submit(item, timeout=30)
+            batched_lat.append(time.monotonic() - t)
+    finally:
+        batcher.stop()
+
+    def pct(lat, q):
+        lat = sorted(lat)
+        return round(lat[min(len(lat) - 1, int(len(lat) * q))] * 1e6, 1)
+
+    st = cache.stats()
+    cached_p50 = pct(hit_lat, 0.5)
+    batched_p50 = pct(batched_lat, 0.5)
+    result = {
+        "metric": "decision_cache_zipf_replay",
+        "smoke": _SMOKE,
+        "policies": n_policies,
+        "unique_sars": n_unique,
+        "requests": n_requests,
+        "hit_ratio": round(st["hit_ratio"], 4),
+        "coalesced": 0,  # single driver thread: coalescing idle by design
+        "cached_p50_us": cached_p50,
+        "cached_p99_us": pct(hit_lat, 0.99),
+        "miss_p50_us": pct(miss_lat, 0.5) if miss_lat else None,
+        "engine_p50_us": pct(engine_lat, 0.5),
+        "engine_p99_us": pct(engine_lat, 0.99),
+        "batched_engine_p50_us": batched_p50,
+        "batched_engine_p99_us": pct(batched_lat, 0.99),
+        "cached_p50_below_batched_engine_p50": cached_p50 < batched_p50,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps(result))
+    return 0 if result["cached_p50_below_batched_engine_p50"] else 1
+
+
 def _timed(fn):
     t = time.time()
     fn()
@@ -1144,6 +1281,16 @@ def _run_main_guarded(deadline_s: float):
 
 if __name__ == "__main__":
     import sys
+
+    if "--cache" in sys.argv:
+        # decision-cache microbenchmark (make bench-cache): cpu-only BY
+        # DESIGN — the cache's win must not depend on device speed — and
+        # independent of the device preflight machinery below, so force
+        # the cpu backend unconditionally (force_cpu pins the env itself)
+        from cedar_tpu.jaxenv import force_cpu
+
+        force_cpu()
+        sys.exit(run_cache_scenario())
 
     was_waiter = bool(os.environ.pop("CEDAR_BENCH_WAIT", ""))
     if _SMOKE or os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":

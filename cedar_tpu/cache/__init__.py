@@ -1,0 +1,41 @@
+"""Decision cache & request-coalescing subsystem.
+
+The hot-path layer in front of the evaluation engines: a canonical request
+fingerprinter (fingerprint.py) keys a sharded LRU+TTL decision cache
+(decision_cache.py) with generation-based invalidation, and a singleflight
+coalescer (singleflight.py) collapses concurrent identical misses into one
+evaluation. See docs/caching.md for TTL semantics, invalidation, and the
+fail-mode interaction with the circuit breaker.
+"""
+
+from .decision_cache import (
+    CLASS_ALLOW,
+    CLASS_DENY,
+    CLASS_NO_OPINION,
+    DecisionCache,
+    classify_decision,
+)
+from .fingerprint import (
+    FINGERPRINT_VERSION,
+    FingerprintMemo,
+    fingerprint_admission_request,
+    fingerprint_attributes,
+    fingerprint_body,
+    recorded_name_parts,
+)
+from .singleflight import SingleFlight
+
+__all__ = [
+    "CLASS_ALLOW",
+    "CLASS_DENY",
+    "CLASS_NO_OPINION",
+    "DecisionCache",
+    "classify_decision",
+    "FINGERPRINT_VERSION",
+    "FingerprintMemo",
+    "fingerprint_admission_request",
+    "fingerprint_attributes",
+    "fingerprint_body",
+    "recorded_name_parts",
+    "SingleFlight",
+]

@@ -22,12 +22,16 @@ import time
 import urllib.request
 from typing import List, Optional, Tuple
 
+from ..cache.fingerprint import fingerprint_body
 from ..server import metrics
 
 
-def _load_recordings(paths) -> List[Tuple[str, str, bytes]]:
-    """[(filename, endpoint, body)] — endpoint inferred from the recorded
-    name (req-authorize-*.json / req-admit-*.json)."""
+def _load_recordings(paths) -> List[Tuple[str, str, bytes, str]]:
+    """[(filename, endpoint, body, fingerprint)] — endpoint inferred from
+    the recorded name (req-authorize-*.json / req-admit-*.json); the
+    fingerprint is recomputed through the SAME canonical helper the live
+    server's decision cache and recorder use (cedar_tpu/cache/fingerprint),
+    so replayed identity always matches recorded identity."""
     files: List[pathlib.Path] = []
     for p in paths:
         path = pathlib.Path(p)
@@ -38,7 +42,9 @@ def _load_recordings(paths) -> List[Tuple[str, str, bytes]]:
     out = []
     for f in files:
         endpoint = "authorize" if "authorize" in f.name else "admit"
-        out.append((f.name, endpoint, f.read_bytes()))
+        body = f.read_bytes()
+        fp = fingerprint_body(endpoint, body) or "unkeyed"
+        out.append((f.name, endpoint, body, fp))
     return out
 
 
@@ -72,7 +78,7 @@ def _replay_local(recordings, config_path: str):
     )
 
     results = []
-    for name, endpoint, body in recordings:
+    for name, endpoint, body, fp in recordings:
         start = time.monotonic()
         try:
             doc = json.loads(body)
@@ -91,7 +97,7 @@ def _replay_local(recordings, config_path: str):
             outcome, reason = "<error>", str(e)
         latency = time.monotonic() - start
         metrics.record_e2e_latency(name, latency)
-        results.append((name, endpoint, outcome, reason, latency))
+        results.append((name, endpoint, outcome, reason, latency, fp))
     return _report(results)
 
 
@@ -106,7 +112,7 @@ def _replay_remote(recordings, server: str, ca_cert: Optional[str] = None):
         ctx.check_hostname = False
         ctx.verify_mode = ssl.CERT_NONE
     results = []
-    for name, endpoint, body in recordings:
+    for name, endpoint, body, fp in recordings:
         url = f"{server.rstrip('/')}/v1/{endpoint}"
         start = time.monotonic()
         try:
@@ -116,7 +122,7 @@ def _replay_remote(recordings, server: str, ca_cert: Optional[str] = None):
             with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
                 doc = json.loads(resp.read())
         except Exception as e:  # noqa: BLE001 — report per file, keep going
-            results.append((name, endpoint, "<error>", str(e), 0.0))
+            results.append((name, endpoint, "<error>", str(e), 0.0, fp))
             continue
         latency = time.monotonic() - start
         metrics.record_e2e_latency(name, latency)
@@ -132,20 +138,29 @@ def _replay_remote(recordings, server: str, ca_cert: Optional[str] = None):
             response = doc.get("response", {})
             outcome = "allow" if response.get("allowed") else "deny"
             reason = (response.get("status") or {}).get("message", "")
-        results.append((name, endpoint, outcome, reason, latency))
+        results.append((name, endpoint, outcome, reason, latency, fp))
     return _report(results)
 
 
 def _report(results) -> int:
     lat = sorted(r[4] for r in results if r[2] != "<error>")
-    for name, endpoint, outcome, _reason, latency in results:
-        print(f"{name}\t{endpoint}\t{outcome}\t{latency * 1e3:.2f}ms")
+    for name, endpoint, outcome, _reason, latency, fp in results:
+        print(f"{name}\t{endpoint}\t{outcome}\t{latency * 1e3:.2f}ms\t{fp}")
     n_err = sum(1 for r in results if r[2] == "<error>")
     summary = f"# {len(results)} requests, {n_err} errors"
     if lat:
         p50 = lat[len(lat) // 2]
         p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
         summary += f", p50 {p50 * 1e3:.2f}ms, p99 {p99 * 1e3:.2f}ms"
+    # cache-key dedupe view: the share of replayed traffic a warm decision
+    # cache could answer (unique canonical fingerprints vs total)
+    keyed = [r[5] for r in results if r[5] != "unkeyed"]
+    if keyed:
+        uniq = len(set(keyed))
+        summary += (
+            f"; {uniq} unique fingerprints / {len(keyed)} keyed "
+            f"(max cacheable hit ratio {1 - uniq / len(keyed):.2f})"
+        )
     print(summary, file=sys.stderr)
     return 1 if n_err else 0
 

@@ -328,12 +328,58 @@ def build_server(args) -> WebhookServer:
         reloader.reload_if_changed()
         reloader.start()
 
+    # decision cache (cedar_tpu/cache, docs/caching.md): canonical-
+    # fingerprint LRU+TTL cache ahead of both engines, invalidated by the
+    # stores' composite content generation. Admission caching is opt-in and
+    # gated to read-only idempotent reviews (CONNECT / dry-run).
+    decision_cache = None
+    admission_cache = None
+    if args.decision_cache_size > 0:
+        from ..cache import DecisionCache
+
+        def _generation_fn(tier_stores, tier_engine):
+            """Composite cache generation: store CONTENT generations plus
+            the engine's load counter when a compiled backend serves the
+            decisions. Content alone bumps at the watch/refresh event,
+            which precedes the async recompile by up to a reloader tick —
+            folding in load_generation makes entries computed from the old
+            compiled set die again when the engine actually swaps, instead
+            of outliving the reload under the new content generation."""
+            if tier_engine is None:
+                return tier_stores.cache_generation
+            return lambda: (
+                tier_stores.cache_generation(),
+                tier_engine.load_generation,
+            )
+
+        decision_cache = DecisionCache(
+            max_entries=args.decision_cache_size,
+            allow_ttl_s=args.decision_cache_allow_ttl_seconds,
+            deny_ttl_s=args.decision_cache_deny_ttl_seconds,
+            no_opinion_ttl_s=args.decision_cache_no_opinion_ttl_seconds,
+            generation_fn=_generation_fn(stores, engine),
+            path="authorization",
+        )
+        if args.decision_cache_admission:
+            admission_cache = DecisionCache(
+                max_entries=args.decision_cache_size,
+                allow_ttl_s=args.decision_cache_allow_ttl_seconds,
+                deny_ttl_s=args.decision_cache_deny_ttl_seconds,
+                no_opinion_ttl_s=args.decision_cache_no_opinion_ttl_seconds,
+                generation_fn=_generation_fn(
+                    admission_stores,
+                    admission_engine if engine is not None else None,
+                ),
+                path="admission",
+            )
+
     admission_fail_open = args.admission_fail_mode == "open"
     admission_handler = CedarAdmissionHandler(
         admission_stores,
         allow_on_error=admission_fail_open,
         evaluate=admission_evaluate,
         evaluate_batch=admission_evaluate_batch,
+        cache=admission_cache,
     )
 
     admission_fastpath = None
@@ -398,6 +444,7 @@ def build_server(args) -> WebhookServer:
         admission_fail_open=admission_fail_open,
         drain_grace_s=args.shutdown_grace_seconds,
         analysis_provider=analysis_provider,
+        decision_cache=decision_cache,
     )
 
 
@@ -520,6 +567,45 @@ def make_parser() -> argparse.ArgumentParser:
         default=5.0,
         help="drain window on SIGTERM: /readyz flips to 503, new requests "
         "are shed, in-flight requests get this long to finish",
+    )
+
+    cache = parser.add_argument_group("decision cache")
+    cache.add_argument(
+        "--decision-cache-size",
+        type=int,
+        default=65536,
+        help="max cached decisions (sharded LRU; 0 disables the cache). "
+        "Keys are canonical request fingerprints; entries die on policy "
+        "reload (generation bump) or their decision-class TTL",
+    )
+    cache.add_argument(
+        "--decision-cache-allow-ttl-seconds",
+        type=float,
+        default=300.0,
+        help="TTL for cached Allow decisions (mirrors kube-apiserver's "
+        "--authorization-webhook-cache-authorized-ttl posture; 0 disables "
+        "caching allows)",
+    )
+    cache.add_argument(
+        "--decision-cache-deny-ttl-seconds",
+        type=float,
+        default=30.0,
+        help="TTL for cached Deny decisions (shorter than allows: a newly "
+        "granted permission should take effect quickly; 0 disables)",
+    )
+    cache.add_argument(
+        "--decision-cache-no-opinion-ttl-seconds",
+        type=float,
+        default=5.0,
+        help="TTL for cached NoOpinion decisions (shortest: these usually "
+        "fall through to RBAC and carry the least signal; 0 disables)",
+    )
+    cache.add_argument(
+        "--decision-cache-admission",
+        action="store_true",
+        help="opt-in admission decision caching, gated to read-only "
+        "idempotent reviews (CONNECT operations and dryRun requests); "
+        "mutating reviews always evaluate",
     )
 
     gameday = parser.add_argument_group("gameday")

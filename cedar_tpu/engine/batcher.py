@@ -32,12 +32,16 @@ class DeadlineExceeded(Exception):
 
 
 class _Slot:
-    __slots__ = ("event", "result", "error")
+    __slots__ = ("event", "result", "error", "waiters", "key")
 
     def __init__(self):
         self.event = threading.Event()
         self.result = None
         self.error: BaseException | None = None
+        # coalescing accounting: how many submitters share this slot, and
+        # the coalesce key it is registered under while still queued
+        self.waiters = 1
+        self.key = None
 
 
 class MicroBatcher:
@@ -59,29 +63,61 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: List[tuple] = []
+        # coalesce_key -> queued entry, for submitters that opt into
+        # sharing one queue slot per identical pending item; entries leave
+        # this map when the worker claims them (or the last waiter
+        # withdraws), so post-claim submitters enqueue fresh work
+        self._pending: dict = {}
         self._stopped = False
         self._thread = threading.Thread(
             target=self._run, name="micro-batcher", daemon=True
         )
         self._thread.start()
 
-    def submit(self, item: T, timeout: Optional[float] = None) -> R:
+    def submit(
+        self,
+        item: T,
+        timeout: Optional[float] = None,
+        coalesce_key: Optional[str] = None,
+    ) -> R:
         """Enqueue one item and block until its result is available.
 
         ``timeout`` bounds the wall-clock wait (queue slot + batch window +
         evaluation): on expiry the item is withdrawn from the queue when
         still pending and ``DeadlineExceeded`` is raised. With or without a
         timeout the wait is never unbounded — a dead worker thread raises
-        ``RuntimeError`` instead of stranding the submitter forever."""
-        slot = _Slot()
-        entry = (item, slot)
+        ``RuntimeError`` instead of stranding the submitter forever.
+
+        ``coalesce_key`` opts into request coalescing: while an entry for
+        the same key is still QUEUED (not yet claimed by the worker), a new
+        submit attaches to its slot as an extra waiter instead of enqueuing
+        a duplicate — the batch evaluates the item once and fans the result
+        out. Waiter accounting keeps per-waiter deadlines independent: a
+        timed-out follower only detaches itself; the shared queue slot is
+        withdrawn (and its pending registration dropped) only when the LAST
+        waiter leaves, so a follower expiry can never cancel the leader or
+        strand a result future nobody can reach."""
         with self._cv:
             if self._stopped:
                 raise RuntimeError("MicroBatcher is stopped")
             if not self._thread.is_alive():
                 raise RuntimeError("batcher dead: worker thread has exited")
-            self._queue.append(entry)
-            self._cv.notify()
+            entry = (
+                self._pending.get(coalesce_key)
+                if coalesce_key is not None
+                else None
+            )
+            if entry is not None:
+                slot = entry[1]
+                slot.waiters += 1
+            else:
+                slot = _Slot()
+                entry = (item, slot)
+                if coalesce_key is not None:
+                    slot.key = coalesce_key
+                    self._pending[coalesce_key] = entry
+                self._queue.append(entry)
+                self._cv.notify()
         deadline = None if timeout is None else time.monotonic() + timeout
         while not slot.event.is_set():
             wait = self.LIVENESS_POLL_S
@@ -89,12 +125,7 @@ class MicroBatcher:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     with self._cv:
-                        # withdraw if still queued so the device never pays
-                        # for an answer nobody is waiting on
-                        try:
-                            self._queue.remove(entry)
-                        except ValueError:
-                            pass  # already claimed by the batch thread
+                        self._withdraw(entry)
                     if slot.event.is_set():
                         break  # result landed while we were withdrawing
                     raise DeadlineExceeded(
@@ -112,6 +143,16 @@ class MicroBatcher:
                     "results"
                 )
         if slot.error is not None:
+            if slot.key is not None:
+                # coalesced slots can have MULTIPLE waiters reaching this
+                # raise: re-raising the shared object from several request
+                # threads mutates its __traceback__ concurrently — the
+                # exact interleaving the worker's per-slot fan-out
+                # prevents. Wrap a fresh object per waiter, chained to the
+                # shared one so the original traceback stays reachable.
+                err = RuntimeError(str(slot.error))
+                err.__cause__ = slot.error
+                raise err
             raise slot.error
         return slot.result
 
@@ -124,6 +165,25 @@ class MicroBatcher:
         self._thread.join(timeout=drain_timeout_s)
 
     # ------------------------------------------------------------- internals
+
+    def _withdraw(self, entry: tuple) -> None:
+        """One waiter's deadline expired (caller holds the lock). Decrement
+        the slot's waiter count; only the LAST departing waiter removes the
+        still-queued entry — by IDENTITY, never by equality. An equality
+        ``list.remove`` could withdraw a different submitter's
+        equal-looking entry (identical request bodies are the norm under
+        coalescing) and would crash outright on items like numpy arrays
+        whose ``==`` is elementwise."""
+        slot = entry[1]
+        slot.waiters -= 1
+        if slot.waiters > 0:
+            return  # other waiters still want the result: slot stays queued
+        for i, e in enumerate(self._queue):
+            if e is entry:
+                del self._queue[i]
+                break
+        if slot.key is not None and self._pending.get(slot.key) is entry:
+            del self._pending[slot.key]
 
     def _run(self) -> None:
         import time
@@ -143,6 +203,17 @@ class MicroBatcher:
                     self._cv.wait(timeout=remaining)
                 batch = self._queue[: self.max_batch]
                 del self._queue[: self.max_batch]
+                # claimed entries leave the coalesce map: submitters
+                # arriving after the claim must enqueue fresh work rather
+                # than attach to a result computed against an older policy
+                # snapshot
+                for _, slot in batch:
+                    if (
+                        slot.key is not None
+                        and self._pending.get(slot.key) is not None
+                        and self._pending[slot.key][1] is slot
+                    ):
+                        del self._pending[slot.key]
             if not batch:
                 # every queued item withdrew (deadline expiry) during the
                 # forming window: never call the batch fn with zero rows — a

@@ -392,6 +392,12 @@ class TPUPolicyEngine:
         self.use_pallas = use_pallas
         self.segred = segred
         self._compiled: Optional[_CompiledSet] = None
+        # monotonic count of successful load() swaps: decision-cache
+        # generations fold this in so entries computed from an older
+        # compiled set die when the engine actually starts serving the new
+        # one (store content generations alone bump at CONTENT change,
+        # which precedes the async recompile by up to a reloader tick)
+        self.load_generation = 0
         self._lock = threading.Lock()
         self._mesh_steps: dict = {}  # (n_tiers, has_gate) -> pjit step
         self._mesh_bits_step = None
@@ -435,6 +441,7 @@ class TPUPolicyEngine:
         )
         with self._lock:
             self._compiled = new
+            self.load_generation += 1
         if warm == "sync":
             self._warm_kernels(new)
             self._warm_first.set()

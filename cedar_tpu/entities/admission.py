@@ -115,6 +115,10 @@ class AdmissionRequest:
     user_info: UserInfo = field(default_factory=UserInfo)
     object: Optional[dict] = None
     old_object: Optional[dict] = None
+    # AdmissionReview.request.dryRun: true marks a side-effect-free review
+    # (evaluation-identical to the real write); the decision cache's
+    # read-only-idempotent gate keys on it (server/admission.py)
+    dry_run: bool = False
 
     @classmethod
     def from_admission_review(cls, review: dict) -> "AdmissionRequest":
@@ -156,6 +160,7 @@ class AdmissionRequest:
             name=req.get("name", ""),
             namespace=req.get("namespace", ""),
             operation=req.get("operation", ""),
+            dry_run=bool(req.get("dryRun", False)),
             user_info=UserInfo(
                 name=ui.get("username", ""),
                 uid=ui.get("uid", ""),

@@ -70,6 +70,17 @@ def allow_all_admission_policy_store() -> StaticStore:
     )
 
 
+def cacheable_admission_request(req: AdmissionRequest) -> bool:
+    """The read-only-idempotent gate for the opt-in admission decision
+    cache (docs/caching.md): only reviews with no write effect may be
+    answered from cache — CONNECT checks (exec/attach/port-forward style
+    connection gating, re-issued per session) and dry-run reviews
+    (evaluation-identical to the real write by definition). Mutating
+    CREATE/UPDATE/DELETE reviews always evaluate: their repeat rate is low
+    and a stale answer on a write is the wrong trade even bounded by TTL."""
+    return req.operation == "CONNECT" or req.dry_run
+
+
 @dataclass
 class AdmissionResponse:
     uid: str
@@ -102,6 +113,7 @@ class CedarAdmissionHandler:
         allow_on_error: bool = True,
         evaluate=None,
         evaluate_batch=None,
+        cache=None,
     ):
         self.stores = stores
         self.allow_on_error = allow_on_error
@@ -112,6 +124,11 @@ class CedarAdmissionHandler:
         # diagnostics)] — lets the server micro-batch admission reviews
         # into one device call
         self._evaluate_batch = evaluate_batch
+        # opt-in decision cache (cedar_tpu/cache DecisionCache), consulted
+        # only for requests passing cacheable_admission_request. OFF by
+        # default: admission traffic is write-shaped and rarely repeats;
+        # the authorization path is where the cache earns its keep.
+        self.cache = cache
 
     @property
     def supports_batch(self) -> bool:
@@ -143,10 +160,28 @@ class CedarAdmissionHandler:
         responses: list = [None] * len(reqs)
         ready = self._ready() if reqs else True
         build: list = []  # (index, entities, cedar_request)
+        cache_keys: dict = {}  # index -> (fingerprint, generation snapshot)
         for i, req in enumerate(reqs):
             if req.namespace in SKIPPED_NAMESPACES or not ready:
                 responses[i] = AdmissionResponse(uid=req.uid, allowed=True)
                 continue
+            if self.cache is not None and cacheable_admission_request(req):
+                from ..cache.fingerprint import fingerprint_admission_request
+
+                key = fingerprint_admission_request(req)
+                # generation snapshot BEFORE evaluation (see
+                # DecisionCache.current_generation)
+                gen = self.cache.current_generation()
+                hit = self.cache.get(key)
+                if hit is not None:
+                    # cached values carry no uid — the fingerprint excludes
+                    # the per-review nonce, so the response is rebuilt
+                    # around THIS review's uid
+                    responses[i] = AdmissionResponse(
+                        uid=req.uid, allowed=hit[0], message=hit[1]
+                    )
+                    continue
+                cache_keys[i] = (key, gen)
             try:
                 entities, cedar_req = self._build(req)
             except Exception as e:  # conversion error
@@ -182,6 +217,7 @@ class CedarAdmissionHandler:
             if verdicts is not None:
                 for (i, _, _), (decision, diagnostics) in zip(build, verdicts):
                     responses[i] = self._decide(reqs[i], decision, diagnostics)
+                    self._cache_put(cache_keys.get(i), responses[i], diagnostics)
             else:
                 for i, em, cr in build:
                     try:
@@ -194,7 +230,26 @@ class CedarAdmissionHandler:
                         )
                         continue
                     responses[i] = self._decide(reqs[i], decision, diagnostics)
+                    self._cache_put(cache_keys.get(i), responses[i], diagnostics)
         return responses
+
+    def _cache_put(self, keyed, response: AdmissionResponse, diagnostics) -> None:
+        """Insert a clean decision for a cacheable request. Errored
+        responses (allow-on-error posture) AND verdicts carrying
+        evaluation-error diagnostics (a raising tier reads as
+        Deny-with-error in TieredPolicyStores.is_authorized) are transient
+        — caching either would pin a transient failure for its TTL."""
+        if keyed is None or self.cache is None or response.error is not None:
+            return
+        if diagnostics is not None and diagnostics.errors:
+            return
+        key, generation = keyed
+        self.cache.put(
+            key,
+            (response.allowed, response.message),
+            "allow" if response.allowed else "deny",
+            generation=generation,
+        )
 
     def _decide(self, req, decision, diagnostics) -> AdmissionResponse:
         if decision == DENY:

@@ -70,11 +70,23 @@ class CedarWebhookAuthorizer:
         self,
         stores: TieredPolicyStores,
         evaluate: Optional[EvaluateFn] = None,
+        cache=None,
     ):
         self.stores = stores
         self._stores_loaded = False
         # pluggable evaluation backend; defaults to tiered interpreter eval
         self._evaluate: EvaluateFn = evaluate or stores.is_authorized
+        # optional decision cache (cedar_tpu/cache DecisionCache) consulted
+        # AFTER the short-circuits below and the readiness gate: with
+        # attributes already parsed, identity self-allows and system:*
+        # skips are cheaper than a fingerprint, so at THIS layer they skip
+        # the cache. (The webhook server's raw-body layer deliberately
+        # diverges: there a cache hit is cheaper than the JSON parse the
+        # short-circuit check would need, so it caches those decisions
+        # too.) The server calls authorize() with use_cache=False when its
+        # own cache handled the key — this seam serves direct embedders
+        # (bench, replay, library use).
+        self.cache = cache
 
     def ready(self) -> bool:
         """True once every store reports initial load complete; latches
@@ -92,8 +104,12 @@ class CedarWebhookAuthorizer:
         self._stores_loaded = True
         return True
 
-    def authorize(self, attributes: Attributes) -> Tuple[str, str]:
-        """Returns (decision, reason)."""
+    def authorize(
+        self, attributes: Attributes, use_cache: bool = True
+    ) -> Tuple[str, str]:
+        """Returns (decision, reason). ``use_cache=False`` bypasses the
+        authorizer-level decision cache for callers that already did their
+        own lookup on the same canonical key (the webhook server)."""
         user_name = attributes.user.name
         if (
             user_name == CEDAR_AUTHORIZER_IDENTITY_NAME
@@ -126,15 +142,34 @@ class CedarWebhookAuthorizer:
         if not self.ready():
             return DECISION_NO_OPINION, ""
 
+        cache_key = None
+        cache_gen = None
+        if use_cache and self.cache is not None:
+            from ..cache.fingerprint import fingerprint_attributes
+
+            cache_key = fingerprint_attributes(attributes)
+            # snapshot before evaluating: a mid-evaluation reload must not
+            # let this result survive under the post-reload generation
+            cache_gen = self.cache.current_generation()
+            hit = self.cache.get(cache_key)
+            if hit is not None:
+                return hit
+
         entities, request = record_to_cedar_resource(attributes)
         decision, diagnostic = self._evaluate(entities, request)
         if decision == ALLOW:
-            return DECISION_ALLOW, _diagnostic_to_reason(diagnostic)
-        if decision == DENY and diagnostic.reasons:
-            return DECISION_DENY, _diagnostic_to_reason(diagnostic)
-        if diagnostic.errors:
-            log.error("Authorize errors: %s", diagnostic.errors)
-        return DECISION_NO_OPINION, ""
+            result = DECISION_ALLOW, _diagnostic_to_reason(diagnostic)
+        elif decision == DENY and diagnostic.reasons:
+            result = DECISION_DENY, _diagnostic_to_reason(diagnostic)
+        else:
+            if diagnostic.errors:
+                log.error("Authorize errors: %s", diagnostic.errors)
+            result = DECISION_NO_OPINION, ""
+        # errored evaluations are transient — never cached; everything else
+        # is deterministic under the current policy-set generation
+        if cache_key is not None and not diagnostic.errors:
+            self.cache.put(cache_key, result, result[0], generation=cache_gen)
+        return result
 
 
 def _diagnostic_to_reason(diagnostic: Diagnostics) -> str:

@@ -202,6 +202,7 @@ class WebhookServer:
         admission_fail_open: Optional[bool] = None,
         drain_grace_s: float = 0.0,
         analysis_provider=None,
+        decision_cache=None,
     ):
         self.authorizer = authorizer
         self.admission_handler = admission_handler
@@ -265,6 +266,26 @@ class WebhookServer:
         # (cedar_tpu/analysis), served on the metrics server's
         # /debug/analysis endpoint for operators
         self.analysis_provider = analysis_provider
+        # decision cache (cedar_tpu/cache DecisionCache) consulted at the
+        # raw-body layer AHEAD of both engines: a hit answers without a
+        # MicroBatcher.submit or an interpreter walk, and a miss coalesces
+        # concurrent identical requests into ONE evaluation (singleflight).
+        # Because the lookup precedes the breaker check, a tripped device
+        # plane keeps serving fresh-enough cached decisions and only the
+        # misses pay the interpreter-fallback path (docs/caching.md).
+        self.decision_cache = decision_cache
+        self._sar_memo = None
+        self._sar_flights = None
+        if decision_cache is not None:
+            from ..cache import FingerprintMemo, SingleFlight
+
+            # memo sized with the cache: a working set that fits the
+            # decision cache must also fit the body→fingerprint memo, or
+            # mid-tail hits repay the parse the memo exists to avoid
+            self._sar_memo = FingerprintMemo(
+                capacity=decision_cache.max_entries
+            )
+            self._sar_flights = SingleFlight("authorization")
         self.drain_grace_s = drain_grace_s
         self._draining = False
         self._inflight = 0
@@ -302,51 +323,9 @@ class WebhookServer:
         request_id = str(uuid.uuid4())
         decision, reason, error = DECISION_NO_OPINION, "", None
         try:
-            try:
-                use_fastpath = (
-                    self._batcher is not None
-                    and self.fastpath.available
-                    and self._breaker_admits(self.fastpath)
-                )
-            except Exception:  # noqa: BLE001 — degrade to the python path
-                log.exception("fastpath availability check failed")
-                use_fastpath = False
-            if use_fastpath:
-                try:
-                    decision, reason, error = self._batcher.submit(
-                        body, timeout=self.request_timeout_s
-                    )
-                except DeadlineExceeded as e:
-                    metrics.record_deadline_exceeded("authorization")
-                    self._record_breaker_timeout(self.fastpath)
-                    error = f"evaluation error: {e}"
-                    return sar_response(DECISION_NO_OPINION, "", error)
-                except Exception as e:  # noqa: BLE001 — always answer
-                    log.exception(
-                        "fastpath authorize requestId=%s failed", request_id
-                    )
-                    error = f"evaluation error: {e}"
-                    return sar_response(DECISION_NO_OPINION, "", error)
-                if error is not None:
-                    return sar_response(decision, reason, error)
-                decision, reason, error = self.error_injector.inject_if_enabled(
-                    decision, reason
-                )
+            decision, reason, error = self._authorize_cached(body, request_id)
+            if error is not None:
                 return sar_response(decision, reason, error)
-            try:
-                sar = json.loads(body)
-            except (ValueError, TypeError, RecursionError) as e:
-                error = f"failed parsing request body: {e}"
-                return sar_response(
-                    DECISION_NO_OPINION, "Encountered decoding error", error
-                )
-            try:
-                attributes = get_authorizer_attributes(sar)
-                decision, reason = self.authorizer.authorize(attributes)
-            except Exception as e:  # noqa: BLE001 — always answer the apiserver
-                log.exception("authorize requestId=%s failed", request_id)
-                error = f"evaluation error: {e}"
-                return sar_response(DECISION_NO_OPINION, "", error)
             decision, reason, error = self.error_injector.inject_if_enabled(
                 decision, reason
             )
@@ -362,6 +341,116 @@ class WebhookServer:
                 label,
                 latency,
             )
+
+    def _authorize_cached(self, body: bytes, request_id: str):
+        """(decision, reason, error) through the decision cache: hit →
+        answered without touching any engine; miss → singleflight-coalesced
+        evaluation whose clean result is inserted for the next arrival.
+        Error results (decode failures, deadline expiries, evaluator
+        crashes) are transient and never cached."""
+        cache = self.decision_cache
+        if cache is None or not self._cache_usable():
+            return self._authorize_uncached(body, request_id)
+        key = self._sar_memo.fingerprint("authorize", body)
+        if key is None:
+            # unparseable body: the uncached path produces the exact
+            # decode-error answer (never cached — the fingerprint requires
+            # a parse, so decode errors cannot collide onto a key)
+            return self._authorize_uncached(body, request_id)
+        # generation snapshot BEFORE evaluation: a reload landing while the
+        # leader evaluates leaves the entry stamped pre-reload, so it dies
+        # at its first post-reload lookup instead of surviving the reload
+        gen = cache.current_generation()
+        hit = cache.get(key)
+        if hit is not None:
+            return hit[0], hit[1], None
+
+        def _leader():
+            res = self._authorize_uncached(body, request_id, coalesce_key=key)
+            if res[2] is None:
+                cache.put(key, (res[0], res[1]), res[0], generation=gen)
+            return res
+
+        try:
+            result, _ = self._sar_flights.do(
+                key, _leader, timeout=self.request_timeout_s
+            )
+        except DeadlineExceeded as e:
+            # a FOLLOWER's budget expired waiting on the leader; the leader
+            # keeps running and its result still warms the cache
+            metrics.record_deadline_exceeded("authorization")
+            return DECISION_NO_OPINION, "", f"evaluation error: {e}"
+        except Exception as e:  # noqa: BLE001 — always answer the apiserver
+            log.exception(
+                "coalesced authorize requestId=%s failed", request_id
+            )
+            return DECISION_NO_OPINION, "", f"evaluation error: {e}"
+        return result
+
+    def _cache_usable(self) -> bool:
+        """No caching until every store's initial load completes: pre-ready
+        NoOpinions are a startup artifact, not a decision worth keeping
+        (the ready() latch makes this a cheap check at steady state)."""
+        try:
+            return self.authorizer is None or self.authorizer.ready()
+        except Exception:  # noqa: BLE001 — unready reads as uncacheable
+            return False
+
+    def _authorize_uncached(
+        self,
+        body: bytes,
+        request_id: str,
+        coalesce_key: Optional[str] = None,
+    ):
+        """(decision, reason, error) through the engines — the pre-cache
+        serving path: native fast path behind the breaker, then the python
+        interpreter path."""
+        try:
+            use_fastpath = (
+                self._batcher is not None
+                and self.fastpath.available
+                and self._breaker_admits(self.fastpath)
+            )
+        except Exception:  # noqa: BLE001 — degrade to the python path
+            log.exception("fastpath availability check failed")
+            use_fastpath = False
+        if use_fastpath:
+            try:
+                return self._batcher.submit(
+                    body,
+                    timeout=self.request_timeout_s,
+                    coalesce_key=coalesce_key,
+                )
+            except DeadlineExceeded as e:
+                metrics.record_deadline_exceeded("authorization")
+                self._record_breaker_timeout(self.fastpath)
+                return DECISION_NO_OPINION, "", f"evaluation error: {e}"
+            except Exception as e:  # noqa: BLE001 — always answer
+                log.exception(
+                    "fastpath authorize requestId=%s failed", request_id
+                )
+                return DECISION_NO_OPINION, "", f"evaluation error: {e}"
+        try:
+            sar = json.loads(body)
+        except (ValueError, TypeError, RecursionError) as e:
+            return (
+                DECISION_NO_OPINION,
+                "Encountered decoding error",
+                f"failed parsing request body: {e}",
+            )
+        try:
+            attributes = get_authorizer_attributes(sar)
+            # bypass the authorizer-level cache ONLY when the server-level
+            # cache is wired: it already missed on this exact canonical
+            # key, and a second lookup would double-count the miss. With no
+            # server cache, an embedder-wired authorizer cache stays live.
+            decision, reason = self.authorizer.authorize(
+                attributes, use_cache=self.decision_cache is None
+            )
+        except Exception as e:  # noqa: BLE001 — always answer the apiserver
+            log.exception("authorize requestId=%s failed", request_id)
+            return DECISION_NO_OPINION, "", f"evaluation error: {e}"
+        return decision, reason, None
 
     def _breaker_admits(self, fastpath) -> bool:
         """False when the fastpath's circuit breaker is open. Requests then
@@ -620,6 +709,30 @@ class WebhookServer:
                     self.send_header(
                         "Content-Type", "text/plain; version=0.0.4"
                     )
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                elif self.path == "/debug/cache":
+                    # decision-cache stats per path (size, hit ratio,
+                    # evictions, TTLs, current generation); {} with the
+                    # cache disabled
+                    doc = {}
+                    try:
+                        if server.decision_cache is not None:
+                            doc["authorization"] = (
+                                server.decision_cache.stats()
+                            )
+                        adm_cache = getattr(
+                            server.admission_handler, "cache", None
+                        )
+                        if adm_cache is not None:
+                            doc["admission"] = adm_cache.stats()
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("cache stats failed")
+                        doc = {"error": "cache stats failed"}
+                    data = json.dumps(doc).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)

@@ -248,6 +248,71 @@ fallback_batches_total = REGISTRY.register(
 )
 
 
+# Decision-cache metrics (cedar_tpu/cache): the hot path in front of the
+# engines. Outside the cedar_authorizer_* subsystem — the cache serves both
+# authorization and admission, partitioned by the `path` label.
+decision_cache_hits_total = REGISTRY.register(
+    Counter(
+        "cedar_decision_cache_hits_total",
+        "Decision cache lookups answered from cache, partitioned by path "
+        "(authorization / admission). A hit returns without any engine or "
+        "interpreter evaluation.",
+        ["path"],
+    )
+)
+
+decision_cache_misses_total = REGISTRY.register(
+    Counter(
+        "cedar_decision_cache_misses_total",
+        "Decision cache lookups that fell through to evaluation, "
+        "partitioned by path. Expired-TTL and stale-generation entries "
+        "count as misses (and as evictions).",
+        ["path"],
+    )
+)
+
+decision_cache_evictions_total = REGISTRY.register(
+    Counter(
+        "cedar_decision_cache_evictions_total",
+        "Decision cache entries dropped, partitioned by path and reason "
+        "(lru: capacity pressure; ttl: decision-class TTL elapsed; "
+        "generation: policy-set reload invalidated the entry; flush: "
+        "operator/test invalidate_all). A persistent lru rate means the "
+        "working set exceeds --decision-cache-size.",
+        ["path", "reason"],
+    )
+)
+
+decision_cache_coalesced_total = REGISTRY.register(
+    Counter(
+        "cedar_decision_cache_coalesced_total",
+        "Requests that attached to an in-flight identical evaluation "
+        "(singleflight followers), partitioned by path. These requests "
+        "neither hit nor evaluated: they waited for a concurrent leader.",
+        ["path"],
+    )
+)
+
+decision_cache_size = REGISTRY.register(
+    Gauge(
+        "cedar_decision_cache_size",
+        "Current decision cache entry count, partitioned by path.",
+        ["path"],
+    )
+)
+
+decision_cache_hit_ratio = REGISTRY.register(
+    Gauge(
+        "cedar_decision_cache_hit_ratio",
+        "Lifetime hits / (hits + misses), partitioned by path. Alert on a "
+        "sustained drop: repetitive apiserver traffic should hold a high "
+        "ratio, and a collapse usually means TTLs are too short or policy "
+        "reloads are churning generations.",
+        ["path"],
+    )
+)
+
+
 # Static-analysis metrics (cedar_tpu/analysis): deliberately outside the
 # cedar_authorizer_* request subsystem — they describe the POLICY SET, not
 # request traffic, and are re-published at every policy load.
@@ -308,6 +373,31 @@ def record_shed(path: str) -> None:
 
 def record_fallback_batch(path: str, reason: str) -> None:
     fallback_batches_total.inc(path=path, reason=reason)
+
+
+def record_cache_hit(path: str) -> None:
+    decision_cache_hits_total.inc(path=path)
+
+
+def record_cache_miss(path: str) -> None:
+    decision_cache_misses_total.inc(path=path)
+
+
+def record_cache_evictions(path: str, reason: str, n: int = 1) -> None:
+    if n:
+        decision_cache_evictions_total.inc(n, path=path, reason=reason)
+
+
+def record_cache_coalesced(path: str) -> None:
+    decision_cache_coalesced_total.inc(path=path)
+
+
+def set_cache_size(path: str, size: int) -> None:
+    decision_cache_size.set(size, path=path)
+
+
+def set_cache_hit_ratio(path: str, ratio: float) -> None:
+    decision_cache_hit_ratio.set(round(ratio, 6), path=path)
 
 
 def set_fastpath_lowerable(tier: int, count: int) -> None:

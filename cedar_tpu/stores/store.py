@@ -10,6 +10,7 @@ Behavior parity with reference internal/server/store/store.go:
 from __future__ import annotations
 
 import logging
+import threading
 from typing import List, Optional, Protocol, Tuple, runtime_checkable
 
 from ..lang.authorize import DENY, Diagnostics, PolicySet
@@ -45,6 +46,13 @@ class TieredPolicyStores:
         # the last AnalysisReport the gate produced (served by the
         # /debug/analysis endpoint); None until the first analyzed load
         self.last_analysis = None
+        # cache_generation() proxy state for stores without a
+        # content_generation counter: store index -> [last PolicySet,
+        # monotonic counter]. The strong reference is the point — it keeps
+        # the last-seen set alive so an identity change can never be
+        # confused with id() reuse after garbage collection.
+        self._gen_lock = threading.Lock()
+        self._gen_proxies: dict = {}
 
     def analyzed_policy_sets(self) -> List[PolicySet]:
         """Tiers for ENGINE COMPILATION after the load-time analysis gate
@@ -74,6 +82,35 @@ class TieredPolicyStores:
             raise
         self.last_analysis = report
         return tiers
+
+    def cache_generation(self) -> tuple:
+        """Composite policy-set generation for decision-cache invalidation
+        (cedar_tpu/cache): the tuple of every tier's content generation.
+        ANY store reload changes the tuple, so cached decisions computed
+        under the old corpus die lazily at their next lookup — no scan.
+
+        Stores without a content_generation counter contribute a proxy
+        counter that bumps whenever their policy_set() IDENTITY changes:
+        reloaders swap the set object on content change, so identity moves
+        with content. The last-seen set is held by strong reference, so the
+        ``is`` comparison can never be fooled by id() reuse after garbage
+        collection — a swap always invalidates. A store that builds a
+        fresh PolicySet per call bumps every lookup, which safely disables
+        caching for that tier rather than serving stale entries."""
+        parts = []
+        for i, store in enumerate(self.stores):
+            gen = getattr(store, "content_generation", None)
+            if gen is not None:
+                parts.append(gen())
+                continue
+            ps = store.policy_set()
+            with self._gen_lock:
+                proxy = self._gen_proxies.get(i)
+                if proxy is None or proxy[0] is not ps:
+                    proxy = [ps, (proxy[1] + 1) if proxy else 0]
+                    self._gen_proxies[i] = proxy
+                parts.append(proxy[1])
+        return tuple(parts)
 
     def __iter__(self):
         return iter(self.stores)
