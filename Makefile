@@ -45,6 +45,10 @@ bench: ## Run the headline benchmark on the attached device
 bench-cache: ## Decision-cache microbenchmark: Zipf SAR replay, hit ratio + cached-path p50/p99 vs the batched engine (cpu)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --cache
 
+.PHONY: bench-pipeline
+bench-pipeline: ## Pipelined vs serial engine: decisions/sec + lone-request p50/p99 on one policy set (cpu; docs/performance.md)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --pipeline
+
 .PHONY: hw-validate
 hw-validate: ## Measure kernel planes (int8/bf16/pallas/segred) on the attached device
 	$(PYTHON) tools/hw_validate.py

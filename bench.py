@@ -634,6 +634,206 @@ def run_cache_scenario() -> int:
     return 0 if result["cached_p50_below_batched_engine_p50"] else 1
 
 
+def run_pipeline_scenario() -> int:
+    """``bench.py --pipeline`` (``make bench-pipeline``): the pipelined
+    execution model (engine/batcher.py PipelinedBatcher + the fastpath
+    stage split) against the serial batch loop, on the SAME policy set and
+    SAR stream. Two measurements:
+
+      * saturated throughput — serial = median per-batch wall of
+        ``authorize_raw`` (parse+encode, block on device, decode, next);
+        pipelined = median steady-state batch COMPLETION INTERVAL through
+        the real three-stage batcher (pipeline-fill edge dropped).
+        Medians, not run walls: the bench host's cores are shared, and
+        per-batch medians trim preemption spikes that would otherwise
+        dominate a whole-run timing.
+      * lone-request latency — p50/p99 of single submits through each
+        batcher (window + batch-of-1 evaluation); the pipeline must add
+        NO latency for an unsaturated server beyond the same 200µs window.
+
+    The __main__ handler pins the stage-isolation env (one thread per
+    stage, wire layout off, async cpu dispatch) BEFORE jax initializes —
+    see the comments there for why each knob exists. CPU-only by design:
+    rc 0 iff pipelined >= 1.3x serial at saturation with no lone-request
+    p99 regression."""
+    import statistics
+    import threading
+
+    from cedar_tpu.engine.batcher import MicroBatcher, PipelinedBatcher
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.engine.fastpath import SARFastPath
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    t0 = time.time()
+    n_policies = _n(100, 60)
+    B = _n(4096, 1024)
+    K = _n(30, 8)  # timed batches per round
+    ROUNDS = _n(3, 2)
+    DEPTH, WORKERS = 3, 2
+
+    ps, users, nss, resources, verbs, groups = build_policy_set(n_policies)
+    # segred mirrors the webhook CLI's cpu-backend serving default
+    engine = TPUPolicyEngine(segred=True)
+    engine.load([ps], warm="off")
+    authorizer = CedarWebhookAuthorizer(
+        TieredPolicyStores([MemoryStore("bench", ps)]),
+        evaluate=engine.evaluate,
+    )
+    fast = SARFastPath(engine, authorizer)
+    if not fast.available:
+        print(json.dumps({
+            "metric": "pipelined_vs_serial",
+            "error": "native fast path unavailable (no C++ toolchain)",
+        }))
+        return 1
+
+    rng = random.Random(2)
+
+    def body():
+        return json.dumps(
+            {
+                "apiVersion": "authorization.k8s.io/v1",
+                "kind": "SubjectAccessReview",
+                "spec": {
+                    "user": rng.choice(users),
+                    "uid": "u",
+                    "groups": rng.sample(groups, rng.randint(0, 3)),
+                    "resourceAttributes": {
+                        "verb": rng.choice(verbs),
+                        "version": "v1",
+                        "resource": rng.choice(resources),
+                        "namespace": rng.choice(nss),
+                    },
+                },
+            }
+        ).encode()
+
+    pool = [[body() for _ in range(B)] for _ in range(8)]
+    fast.authorize_raw(pool[0])  # warm the B-row shapes + encoder
+
+    class _BatchStages:
+        """Batcher adapter for the bench driver: each submitted ITEM is a
+        whole body batch, so the real three-stage pipeline machinery
+        (separate dispatch/decode threads, bounded queues) carries
+        B-row batches without per-request submit overhead; decode stamps
+        each batch's completion for the steady-state interval measure."""
+
+        def __init__(self, stamps):
+            self.stamps = stamps
+
+        def pipeline_encode(self, items):
+            return [fast.pipeline_encode(b) for b in items]
+
+        def pipeline_dispatch(self, ctxs):
+            return [fast.pipeline_dispatch(c) for c in ctxs]
+
+        def pipeline_decode(self, ctxs):
+            out = [fast.pipeline_decode(c) for c in ctxs]
+            self.stamps.append(time.monotonic())
+            return out
+
+    def serial_batch_times(n):
+        ts = []
+        for i in range(n):
+            t = time.monotonic()
+            fast.authorize_raw(pool[i % len(pool)])
+            ts.append(time.monotonic() - t)
+        return ts
+
+    def piped_deltas(n):
+        stamps: list = []
+        b = PipelinedBatcher(
+            _BatchStages(stamps), max_batch=1, window_s=0.0,
+            depth=DEPTH, encode_workers=WORKERS,
+        )
+        results = [None] * n
+
+        def one(i):
+            results[i] = b.submit(pool[i % len(pool)], timeout=600)
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        b.stop()
+        assert all(r is not None for r in results)
+        deltas = [y - x for x, y in zip(stamps, stamps[1:])]
+        return deltas[DEPTH:]  # drop the pipeline-fill edge
+
+    piped_deltas(_n(6, 4))  # warm the pipelined driver path
+    serial_ts: list = []
+    piped_ds: list = []
+    for _ in range(ROUNDS):  # alternate so ambient load hits both modes
+        serial_ts.extend(serial_batch_times(K))
+        piped_ds.extend(piped_deltas(K))
+    serial_med = statistics.median(serial_ts)
+    piped_med = statistics.median(piped_ds)
+    serial_rate = B / serial_med
+    piped_rate = B / piped_med
+    speedup = serial_rate and piped_rate / serial_rate
+
+    # ---- lone-request latency through the REAL batchers (window + b=1
+    # evaluation); the pipeline must not tax the unsaturated path.
+    # Requests ALTERNATE between the two batchers so an ambient
+    # preemption spike on the shared bench cores lands on both
+    # populations, and the p99 estimate drops the top sample per 100 —
+    # with ~100 sequential submits a raw max-as-p99 is pure spike lottery.
+    def _pcts(lat):
+        lat.sort()
+        n = len(lat)
+        return lat[n // 2], lat[max(min(int(n * 0.99) - 1, n - 1), 0)]
+
+    serial_b = MicroBatcher(fast.authorize_raw, window_s=0.0002)
+    piped_b = PipelinedBatcher(
+        fast, window_s=0.0002, depth=DEPTH, encode_workers=WORKERS
+    )
+    try:
+        s_lat: list = []
+        p_lat: list = []
+        serial_b.submit(pool[0][0], timeout=30)  # warm b=1 both paths
+        piped_b.submit(pool[0][0], timeout=30)
+        for i in range(_n(120, 40)):
+            for batcher, lat in ((serial_b, s_lat), (piped_b, p_lat)):
+                t = time.monotonic()
+                batcher.submit(pool[0][i % B], timeout=30)
+                lat.append(time.monotonic() - t)
+        s_p50, s_p99 = _pcts(s_lat)
+        p_p50, p_p99 = _pcts(p_lat)
+    finally:
+        serial_b.stop()
+        piped_b.stop()
+
+    # no-regression: within noise of the serial p99 plus one batch window
+    lone_ok = p_p99 <= s_p99 * 1.5 + 0.0002
+    result = {
+        "metric": "pipelined_vs_serial_sar",
+        "smoke": _SMOKE,
+        "policies": n_policies,
+        "batch": B,
+        "batches_timed": len(serial_ts),
+        "serial_rate": round(serial_rate),
+        "pipelined_rate": round(piped_rate),
+        "speedup": round(speedup, 2),
+        "serial_batch_ms_p50": round(serial_med * 1e3, 2),
+        "pipelined_batch_interval_ms_p50": round(piped_med * 1e3, 2),
+        "serial_single_p50_us": round(s_p50 * 1e6, 1),
+        "serial_single_p99_us": round(s_p99 * 1e6, 1),
+        "pipelined_single_p50_us": round(p_p50 * 1e6, 1),
+        "pipelined_single_p99_us": round(p_p99 * 1e6, 1),
+        "single_request_no_regression": bool(lone_ok),
+        "speedup_ok": bool(speedup >= 1.3),
+        "pipeline_depth": DEPTH,
+        "encode_workers": WORKERS,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps(result))
+    return 0 if (result["speedup_ok"] and lone_ok) else 1
+
+
 def _timed(fn):
     t = time.time()
     fn()
@@ -1169,9 +1369,15 @@ def main():
     except Exception as e:  # the headline must survive a matrix failure
         config_matrix = {"error": str(e)}
 
+    fallback_reason = os.environ.get("CEDAR_BENCH_CPU_FALLBACK", "")
     result = {
         "metric": "SAR decisions/sec @10k policies (TPU batch eval)"
         + (" [SMOKE: shrunk shapes, cpu]" if _SMOKE else ""),
+        **(
+            {"backend": "cpu-fallback", "backend_note": fallback_reason}
+            if fallback_reason
+            else {}
+        ),
         "value": round(device_rate),
         "unit": "decisions/sec",
         "vs_baseline": round(device_rate / 1_000_000, 4),
@@ -1205,6 +1411,26 @@ def main():
         },
     }
     print(json.dumps(result))
+
+
+def _cpu_fallback(reason: str) -> None:
+    """No device at bench start: degrade to the cpu backend instead of
+    exiting with a non-parseable tail (the BENCH_r05 rc=1 mode). The run
+    proceeds end-to-end; main() stamps the JSON record with
+    "backend": "cpu-fallback" so the number can never be read as a device
+    measurement."""
+    import sys
+
+    print(
+        f"# {reason}; falling back to JAX_PLATFORMS=cpu "
+        '(record will carry "backend": "cpu-fallback")',
+        file=sys.stderr,
+        flush=True,
+    )
+    os.environ["CEDAR_BENCH_CPU_FALLBACK"] = reason
+    from cedar_tpu.jaxenv import force_cpu
+
+    force_cpu()
 
 
 def _backend_transient(e: BaseException) -> bool:
@@ -1282,6 +1508,38 @@ def _run_main_guarded(deadline_s: float):
 if __name__ == "__main__":
     import sys
 
+    if "--pipeline" in sys.argv:
+        # pipelined-vs-serial scenario (make bench-pipeline): cpu-only BY
+        # DESIGN, with the stage-isolation env pinned BEFORE any jax
+        # backend initializes (setdefault: an explicit operator env always
+        # wins):
+        #   * CEDAR_NATIVE_THREADS=1 + single-thread XLA — the bench host
+        #     has ~2 shared cores; unpinned, every stage grabs both, both
+        #     modes become identically CPU-work-bound and the comparison
+        #     measures scheduler noise instead of the execution model.
+        #     Pinned, one core carries the host stages and the other the
+        #     XLA "device" — the resource shape of the attached-TPU
+        #     deployment this bench stands in for.
+        #   * CEDAR_TPU_WIRE_U8=0 — the u8 wire halves h2d LINK bytes; the
+        #     cpu backend has no link, so the split/span-check is pure
+        #     per-batch overhead for both modes.
+        #   * async cpu dispatch — pipeline_dispatch must launch without
+        #     blocking on device compute, as PJRT does on a real TPU.
+        os.environ.setdefault("CEDAR_NATIVE_THREADS", "1")
+        os.environ.setdefault("CEDAR_TPU_WIRE_U8", "0")
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_cpu_multi_thread_eigen" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_cpu_multi_thread_eigen=false"
+            ).strip()
+        from cedar_tpu.jaxenv import force_cpu
+
+        force_cpu()
+        import jax
+
+        jax.config.update("jax_cpu_enable_async_dispatch", True)
+        sys.exit(run_pipeline_scenario())
+
     if "--cache" in sys.argv:
         # decision-cache microbenchmark (make bench-cache): cpu-only BY
         # DESIGN — the cache's win must not depend on device speed — and
@@ -1308,13 +1566,16 @@ if __name__ == "__main__":
         # can attach cleanly once the link is back. Probing BEFORE the execv
         # would race the still-attached dead client on single-attach backends.
         if not _wait_for_backend():
-            raise SystemExit("backend did not return within the wait budget")
+            _cpu_fallback("backend did not return within the wait budget")
     elif not _wait_for_backend(
         max_wait_s=float(os.environ.get("CEDAR_BENCH_PREFLIGHT_S", "240"))
     ):
         # cheap pre-flight (no prior attach to race): a dead link at bench
-        # START exits in minutes instead of hanging main() to its deadline
-        raise SystemExit("device link unavailable at bench start")
+        # START no longer hard-fails with a non-parseable tail (rc=1,
+        # BENCH_r05): the run degrades to the cpu backend and the JSON
+        # record carries "backend": "cpu-fallback" so it can never be
+        # mistaken for a device number
+        _cpu_fallback("device link unavailable at bench start")
     deadline_s = float(os.environ.get("CEDAR_BENCH_DEADLINE_S", "2700"))
     status, exc = _run_main_guarded(deadline_s)
     if status == "ok":

@@ -240,7 +240,13 @@ def build_server(args) -> WebhookServer:
         from ..engine.breaker import guarded_call
         from ..engine.evaluator import TPUPolicyEngine
 
-        tier_engine = TPUPolicyEngine(mesh=mesh, segred=segred)
+        # warm_max_batch = the server's micro-batch ceiling: the warm-up
+        # ladder (and explicit warmup()) precompiles EVERY batch bucket a
+        # production batch can land on, so no request ever pays a trace
+        tier_engine = TPUPolicyEngine(
+            mesh=mesh, segred=segred, name=name,
+            warm_max_batch=args.max_batch,
+        )
 
         def _guarded(device_call, fallback_call):
             """engine/breaker.py guarded_call plus the pre-load interpreter
@@ -438,6 +444,9 @@ def build_server(args) -> WebhookServer:
         fastpath=fastpath,
         admission_fastpath=admission_fastpath,
         batch_window_s=args.batch_window_us / 1e6,
+        max_batch=args.max_batch,
+        pipeline_depth=args.pipeline_depth,
+        encode_workers=args.encode_workers,
         request_timeout_s=(
             args.request_timeout_ms / 1e3 if args.request_timeout_ms > 0 else None
         ),
@@ -497,6 +506,29 @@ def make_parser() -> argparse.ArgumentParser:
         type=float,
         default=200.0,
         help="micro-batch forming window for the TPU fast path",
+    )
+    cedar.add_argument(
+        "--max-batch",
+        type=int,
+        default=8192,
+        help="micro-batch row ceiling; also bounds the engine warm-up "
+        "ladder so every production batch bucket is precompiled at load "
+        "time (docs/performance.md)",
+    )
+    cedar.add_argument(
+        "--pipeline-depth",
+        type=int,
+        default=2,
+        help="batches in flight through the three-stage evaluation "
+        "pipeline (encode / dispatch / decode overlap, "
+        "docs/performance.md); 0 restores the serial batch loop",
+    )
+    cedar.add_argument(
+        "--encode-workers",
+        type=int,
+        default=2,
+        help="host encode threads feeding the pipelined batcher "
+        "(only used with --pipeline-depth > 0)",
     )
 
     serving = parser.add_argument_group("secure serving")

@@ -12,16 +12,57 @@ Latency shape: a lone request waits at most ``window_s`` (default 200µs)
 before the batch fires — well inside the p99 < 2ms budget — while a
 saturated server naturally forms large batches (up to ``max_batch``) and
 rides the device's throughput curve.
+
+``PipelinedBatcher`` replaces the strictly serial worker loop with a
+three-stage pipeline (docs/performance.md): batch N+1's host ENCODE runs on
+a small worker pool while batch N's device work is in flight, the DISPATCH
+thread launches each encoded batch asynchronously and immediately moves to
+the next, and a DECODE thread materializes results and completes each
+submitter's slot. Bounded depth-``depth`` queues between the stages provide
+backpressure — a slow device stalls the collector instead of growing an
+unbounded encoded-batch backlog. Submission semantics (deadline withdrawal,
+coalescing, drain-on-stop) are IDENTICAL to the serial batcher: both share
+one queue/slot front end, and the stages are required to produce the same
+results the serial batch fn would.
 """
 
 from __future__ import annotations
 
+import queue as _queue
 import threading
 import time
 from typing import Callable, List, Optional, Sequence, TypeVar
 
 T = TypeVar("T")
 R = TypeVar("R")
+
+# end-of-stream marker flowing through the pipeline hand-off queues on
+# drain: the collector sends it after its last batch, each stage forwards
+# it after finishing all prior work, so every accepted item's slot is set
+# before any worker thread exits
+_SENTINEL = object()
+
+
+def _record_stall(path: Optional[str], stage: str, seconds: float) -> None:
+    if path is None or seconds <= 0:
+        return
+    try:
+        from ..server.metrics import record_pipeline_stall
+
+        record_pipeline_stall(path, stage, seconds)
+    except Exception:  # noqa: BLE001 — metrics must never break serving
+        pass
+
+
+def _record_occupancy(path: Optional[str], n: int) -> None:
+    if path is None:
+        return
+    try:
+        from ..server.metrics import record_batch_occupancy
+
+        record_batch_occupancy(path, n)
+    except Exception:  # noqa: BLE001 — metrics must never break serving
+        pass
 
 
 class DeadlineExceeded(Exception):
@@ -53,13 +94,17 @@ class MicroBatcher:
 
     def __init__(
         self,
-        fn: Callable[[Sequence[T]], List[R]],
+        fn: Optional[Callable[[Sequence[T]], List[R]]],
         max_batch: int = 8192,
         window_s: float = 0.0002,
+        metrics_path: Optional[str] = None,
     ):
         self._fn = fn
         self.max_batch = max_batch
         self.window_s = window_s
+        # label for cedar_batch_occupancy / cedar_pipeline_stall metrics;
+        # None (embedders, tests) records nothing
+        self.metrics_path = metrics_path
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: List[tuple] = []
@@ -69,10 +114,31 @@ class MicroBatcher:
         # withdraws), so post-claim submitters enqueue fresh work
         self._pending: dict = {}
         self._stopped = False
+        self._threads: List[threading.Thread] = []
+        self._start_workers()
+
+    def _start_workers(self) -> None:
         self._thread = threading.Thread(
             target=self._run, name="micro-batcher", daemon=True
         )
+        self._threads = [self._thread]
         self._thread.start()
+
+    def _alive(self) -> bool:
+        """True while every worker thread is running: any dead stage means
+        accepted items may never complete, so submitters must bail."""
+        return all(t.is_alive() for t in self._threads)
+
+    def debug_stats(self) -> dict:
+        """Live queue/config snapshot for /debug/engine."""
+        with self._cv:
+            q = len(self._queue)
+        return {
+            "mode": "serial",
+            "queue": q,
+            "max_batch": self.max_batch,
+            "window_us": round(self.window_s * 1e6, 1),
+        }
 
     def submit(
         self,
@@ -100,7 +166,7 @@ class MicroBatcher:
         with self._cv:
             if self._stopped:
                 raise RuntimeError("MicroBatcher is stopped")
-            if not self._thread.is_alive():
+            if not self._alive():
                 raise RuntimeError("batcher dead: worker thread has exited")
             entry = (
                 self._pending.get(coalesce_key)
@@ -135,12 +201,12 @@ class MicroBatcher:
                 wait = min(wait, remaining)
             if slot.event.wait(wait):
                 break
-            if not self._thread.is_alive():
+            if not self._alive():
                 if slot.event.is_set():
                     break  # final result delivered as the worker exited
                 raise RuntimeError(
-                    "batcher dead: worker thread exited without delivering "
-                    "results"
+                    "batcher dead: worker thread exited without "
+                    "delivering results"
                 )
         if slot.error is not None:
             if slot.key is not None:
@@ -157,12 +223,14 @@ class MicroBatcher:
         return slot.result
 
     def stop(self, drain_timeout_s: float = 2.0) -> None:
-        """Stop accepting new work and drain: the worker processes every
+        """Stop accepting new work and drain: the worker(s) process every
         queued item (late submitters get their answers) before exiting."""
         with self._cv:
             self._stopped = True
-            self._cv.notify()
-        self._thread.join(timeout=drain_timeout_s)
+            self._cv.notify_all()
+        deadline = time.monotonic() + drain_timeout_s
+        for t in self._threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.05))
 
     # ------------------------------------------------------------- internals
 
@@ -185,57 +253,287 @@ class MicroBatcher:
         if slot.key is not None and self._pending.get(slot.key) is entry:
             del self._pending[slot.key]
 
-    def _run(self) -> None:
-        import time
+    def _form_batch(self) -> Optional[list]:
+        """Wait for work and claim one batch under the lock — the shared
+        front end of the serial worker and the pipeline collector. Returns
+        None when stopped with an empty queue (the worker should exit), or
+        a possibly-empty batch (empty: every queued item withdrew during
+        the forming window — never call the batch fn with zero rows, a
+        no-op "success" must not feed breaker recovery probes)."""
+        with self._cv:
+            while not self._queue and not self._stopped:
+                self._cv.wait()
+            if self._stopped and not self._queue:
+                return None
+            # batch-forming window: let concurrent submitters pile in
+            deadline = time.monotonic() + self.window_s
+            while len(self._queue) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=remaining)
+            batch = self._queue[: self.max_batch]
+            del self._queue[: self.max_batch]
+            # claimed entries leave the coalesce map: submitters
+            # arriving after the claim must enqueue fresh work rather
+            # than attach to a result computed against an older policy
+            # snapshot
+            for _, slot in batch:
+                if (
+                    slot.key is not None
+                    and self._pending.get(slot.key) is not None
+                    and self._pending[slot.key][1] is slot
+                ):
+                    del self._pending[slot.key]
+        if batch:
+            _record_occupancy(self.metrics_path, len(batch))
+        return batch
 
+    def _complete_batch(self, batch: list, results: Sequence[R]) -> None:
+        if len(results) != len(batch):
+            raise RuntimeError(
+                f"batch fn returned {len(results)} results for "
+                f"{len(batch)} items"
+            )
+        for (_, slot), res in zip(batch, results):
+            slot.result = res
+            slot.event.set()
+
+    def _fail_batch(self, batch: list, e: BaseException) -> None:
+        # one fresh exception per slot: sharing a single exception
+        # object (and its traceback) across request threads interleaves
+        # tracebacks and leaks one request's error text into others
+        for _, slot in batch:
+            err = RuntimeError(f"batch evaluation failed: {e!r}")
+            err.__cause__ = e  # keep the original traceback reachable
+            slot.error = err
+            slot.event.set()
+
+    def _run(self) -> None:
         while True:
-            with self._cv:
-                while not self._queue and not self._stopped:
-                    self._cv.wait()
-                if self._stopped and not self._queue:
-                    return
-                # batch-forming window: let concurrent submitters pile in
-                deadline = time.monotonic() + self.window_s
-                while len(self._queue) < self.max_batch:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    self._cv.wait(timeout=remaining)
-                batch = self._queue[: self.max_batch]
-                del self._queue[: self.max_batch]
-                # claimed entries leave the coalesce map: submitters
-                # arriving after the claim must enqueue fresh work rather
-                # than attach to a result computed against an older policy
-                # snapshot
-                for _, slot in batch:
-                    if (
-                        slot.key is not None
-                        and self._pending.get(slot.key) is not None
-                        and self._pending[slot.key][1] is slot
-                    ):
-                        del self._pending[slot.key]
+            batch = self._form_batch()
+            if batch is None:
+                return
             if not batch:
-                # every queued item withdrew (deadline expiry) during the
-                # forming window: never call the batch fn with zero rows — a
-                # no-op "success" must not feed breaker recovery probes
                 continue
+            try:
+                self._complete_batch(batch, self._fn([it for it, _ in batch]))
+            except BaseException as e:  # noqa: BLE001 — propagate per-item
+                self._fail_batch(batch, e)
+
+
+class PipelinedBatcher(MicroBatcher):
+    """Three-stage pipelined variant of the MicroBatcher (module docstring).
+
+    ``stages`` must provide the split evaluation surface the raw fast paths
+    expose (engine/fastpath.py):
+
+      * ``pipeline_encode(items) -> ctx`` — host-only parse/encode; runs on
+        a pool of ``encode_workers`` threads, one batch per worker
+      * ``pipeline_dispatch(ctx) -> ctx`` — launch the device work
+        asynchronously (no blocking readback); runs on the dispatch thread,
+        which immediately moves to the next encoded batch
+      * ``pipeline_decode(ctx) -> results`` — materialize (the only stage
+        that blocks on the device), decode, resolve deferred rows; runs on
+        the decode thread, which completes each submitter's slot
+
+    so host decode of batch N overlaps device execution of batch N+1, and
+    encode of batch N+2 overlaps both. The inter-stage queues are bounded
+    at ``depth``: when the device falls behind, the collector blocks
+    putting into the dispatch queue (backpressure) instead of encoding an
+    unbounded backlog; the blocked time is published as
+    cedar_pipeline_stall_seconds_total{stage}.
+
+    Error/drain contracts match the serial batcher exactly: a stage
+    exception fails that batch's slots with per-waiter wrapped errors (the
+    stages themselves degrade to interpreter-fallback RESULTS on device
+    errors, so slot errors only surface stage bugs); stop() drains the
+    submit queue through all three stages before the workers exit, so no
+    accepted item's slot is ever left unset."""
+
+    def __init__(
+        self,
+        stages,
+        max_batch: int = 8192,
+        window_s: float = 0.0002,
+        depth: int = 2,
+        encode_workers: int = 2,
+        metrics_path: Optional[str] = None,
+    ):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.stages = stages
+        self.depth = max(1, int(depth))
+        self.encode_workers = max(1, int(encode_workers))
+        self._pool = ThreadPoolExecutor(
+            self.encode_workers, thread_name_prefix="pipe-encode"
+        )
+        self._dispatch_q: _queue.Queue = _queue.Queue(maxsize=self.depth)
+        self._decode_q: _queue.Queue = _queue.Queue(maxsize=self.depth)
+        self._batches_total = 0
+        # batches accepted into the pipeline but not yet decoded; lets the
+        # decode stage distinguish starvation (work exists upstream, the
+        # decoder is idle) from a genuinely idle server. Three threads
+        # mutate it — always through _inflight_add (a bare += is
+        # LOAD/ADD/STORE and loses updates under contention, which would
+        # pin the decode-stall accounting on forever-idle servers)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._stall_s = {"collect": 0.0, "dispatch": 0.0, "decode": 0.0}
+        super().__init__(
+            fn=None, max_batch=max_batch, window_s=window_s,
+            metrics_path=metrics_path,
+        )
+
+    def _alive(self) -> bool:
+        """During a drain the collector (and then the dispatcher) exit as
+        soon as they forward the sentinel — their remaining work is already
+        in the downstream queues — so a waiter's liveness poll must not
+        read those exits as 'batcher dead' while the decoder is still
+        delivering results. Before stop(), all three stages must live."""
+        if self._stopped:
+            return self._decoder.is_alive()
+        return all(t.is_alive() for t in self._threads)
+
+    def _start_workers(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run_collect, name="pipe-collect", daemon=True
+        )
+        self._dispatcher = threading.Thread(
+            target=self._run_dispatch, name="pipe-dispatch", daemon=True
+        )
+        self._decoder = threading.Thread(
+            target=self._run_decode, name="pipe-decode", daemon=True
+        )
+        self._threads = [self._thread, self._dispatcher, self._decoder]
+        for t in self._threads:
+            t.start()
+
+    def debug_stats(self) -> dict:
+        with self._cv:
+            q = len(self._queue)
+        return {
+            "mode": "pipelined",
+            "queue": q,
+            "max_batch": self.max_batch,
+            "window_us": round(self.window_s * 1e6, 1),
+            "depth": self.depth,
+            "encode_workers": self.encode_workers,
+            "dispatch_queue": self._dispatch_q.qsize(),
+            "decode_queue": self._decode_q.qsize(),
+            "batches_total": self._batches_total,
+            "stall_seconds": {
+                k: round(v, 6) for k, v in self._stall_s.items()
+            },
+        }
+
+    # ------------------------------------------------------------- plumbing
+
+    def _inflight_add(self, n: int) -> None:
+        with self._inflight_lock:
+            self._inflight += n
+
+    def _stall(self, stage: str, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        self._stall_s[stage] += seconds
+        _record_stall(self.metrics_path, stage, seconds)
+
+    def _put(self, q: _queue.Queue, item, consumer: threading.Thread) -> bool:
+        """Bounded put that can never wedge on a dead consumer thread: a
+        stage that crashed outside its per-batch try (should not happen,
+        but a wedged pipeline strands every submitter) turns the put into
+        a False return and the batch fails fast instead."""
+        while True:
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except _queue.Full:
+                if not consumer.is_alive():
+                    return False
+
+    # --------------------------------------------------------------- stages
+
+    def _run_collect(self) -> None:
+        while True:
+            batch = self._form_batch()
+            if batch is None:
+                break
+            if not batch:
+                continue
+            self._batches_total += 1
             items = [it for it, _ in batch]
             try:
-                results = self._fn(items)
-                if len(results) != len(items):
-                    raise RuntimeError(
-                        f"batch fn returned {len(results)} results for "
-                        f"{len(items)} items"
-                    )
-                for (_, slot), res in zip(batch, results):
-                    slot.result = res
-                    slot.event.set()
-            except BaseException as e:  # noqa: BLE001 — propagate per-item
-                # one fresh exception per slot: sharing a single exception
-                # object (and its traceback) across request threads interleaves
-                # tracebacks and leaks one request's error text into others
-                for _, slot in batch:
-                    err = RuntimeError(f"batch evaluation failed: {e!r}")
-                    err.__cause__ = e  # keep the original traceback reachable
-                    slot.error = err
-                    slot.event.set()
+                fut = self._pool.submit(self.stages.pipeline_encode, items)
+            except RuntimeError as e:  # pool shut down under us
+                self._fail_batch(batch, e)
+                continue
+            t0 = time.monotonic()
+            self._inflight_add(1)
+            ok = self._put(self._dispatch_q, (batch, fut), self._dispatcher)
+            # time blocked on a full dispatch queue = downstream (device or
+            # decode) backpressure reaching the collector
+            self._stall("collect", time.monotonic() - t0)
+            if not ok:
+                self._inflight_add(-1)
+                self._fail_batch(
+                    batch, RuntimeError("pipeline dispatch stage died")
+                )
+        self._put(self._dispatch_q, _SENTINEL, self._dispatcher)
+
+    def _run_dispatch(self) -> None:
+        while True:
+            item = self._dispatch_q.get()
+            if item is _SENTINEL:
+                self._put(self._decode_q, _SENTINEL, self._decoder)
+                return
+            batch, fut = item
+            t0 = time.monotonic()
+            try:
+                ctx = fut.result()  # wait for the encode worker
+            except BaseException as e:  # noqa: BLE001 — per-batch isolation
+                self._inflight_add(-1)
+                self._fail_batch(batch, e)
+                continue
+            # time waiting on the encode future = encode stage too slow to
+            # keep the device fed
+            self._stall("dispatch", time.monotonic() - t0)
+            try:
+                ctx = self.stages.pipeline_dispatch(ctx)
+            except BaseException as e:  # noqa: BLE001 — per-batch isolation
+                self._inflight_add(-1)
+                self._fail_batch(batch, e)
+                continue
+            if not self._put(self._decode_q, (batch, ctx), self._decoder):
+                self._inflight_add(-1)
+                self._fail_batch(
+                    batch, RuntimeError("pipeline decode stage died")
+                )
+
+    def _run_decode(self) -> None:
+        while True:
+            busy = self._inflight > 0
+            t0 = time.monotonic()
+            item = self._decode_q.get()
+            if busy:
+                # time waiting for launched work WHILE batches were in
+                # flight = pipeline starvation (encode/dispatch cannot keep
+                # the decoder busy); an idle server records nothing
+                self._stall("decode", time.monotonic() - t0)
+            if item is _SENTINEL:
+                return
+            batch, ctx = item
+            try:
+                self._complete_batch(batch, self.stages.pipeline_decode(ctx))
+            except BaseException as e:  # noqa: BLE001 — per-batch isolation
+                self._fail_batch(batch, e)
+            finally:
+                self._inflight_add(-1)
+
+    def stop(self, drain_timeout_s: float = 5.0) -> None:
+        """Drain the whole pipeline: the collector pushes every remaining
+        queued item through encode/dispatch/decode (trailed by a sentinel
+        each stage forwards), so every accepted submitter gets an answer
+        before the workers exit."""
+        super().stop(drain_timeout_s=drain_timeout_s)
+        self._pool.shutdown(wait=False)

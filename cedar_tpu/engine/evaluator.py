@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import atexit
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -84,6 +85,11 @@ SERVING_CHUNK = 16384
 # per-call device round trip, small enough to keep several in flight
 _PIPELINE_SB = 32768
 _PIPELINE_MIN = 8192  # don't split batches smaller than this
+# above this row count the fast paths skip the in-call diagnostics bitset
+# plane (see engine/fastpath.py _BITS_INCALL_MAX, which aliases this);
+# defined here so the warm-up plan knows which buckets need the want_bits
+# variant without an import cycle
+BITS_INCALL_MAX = 4096
 
 # Daemon warm-up threads must not be inside an XLA call when the
 # interpreter finalizes: pthread teardown mid-C++-exception aborts the
@@ -112,6 +118,45 @@ def _round_bucket(n: int, buckets) -> int:
         if n <= b:
             return b
     return buckets[-1]
+
+
+class _StagingPool:
+    """Reusable host staging buffers for bucket-padded (codes, extras)
+    batches. The serial path allocated a fresh np.zeros per batch; with the
+    pipelined batcher keeping `depth` batches in flight the allocator was
+    both a per-batch cost and a fragmentation source, while the working set
+    is a handful of (bucket, width) shapes that repeat forever. Buffers are
+    handed back AFTER the batch's finish() materializes its outputs — the
+    device has fully consumed the inputs by then, so reuse is safe even on
+    backends that zero-copy numpy inputs (the CPU runtime may alias them;
+    releasing at dispatch time would let a later batch overwrite rows an
+    in-flight computation is still reading).
+
+    A buffer whose release is skipped (an exception unwound past finish) is
+    simply garbage-collected — the pool holds no record of outstanding
+    buffers, so it can neither leak nor double-hand one out."""
+
+    def __init__(self, max_per_key: int = 8):
+        self._free: dict = {}  # (shape, dtype str) -> [ndarray]
+        self._lock = threading.Lock()
+        self._max_per_key = max_per_key
+
+    def acquire(self, shape, dtype) -> np.ndarray:
+        key = (tuple(shape), np.dtype(dtype).str)
+        with self._lock:
+            bufs = self._free.get(key)
+            if bufs:
+                return bufs.pop()
+        # caller fills EVERY row (payload + pad): no zeroing here
+        return np.empty(shape, dtype=dtype)
+
+    def release(self, *arrays) -> None:
+        with self._lock:
+            for a in arrays:
+                key = (a.shape, a.dtype.str)
+                bufs = self._free.setdefault(key, [])
+                if len(bufs) < self._max_per_key:
+                    bufs.append(a)
 
 
 def _segment_plan(group_c: np.ndarray, n_rules: int):
@@ -361,6 +406,8 @@ class TPUPolicyEngine:
         use_pallas: Optional[bool] = None,
         mesh=None,
         segred: Optional[bool] = None,
+        name: str = "engine",
+        warm_max_batch: int = 512,
     ):
         """mesh: an optional jax.sharding.Mesh with ("data", "policy") axes
         (parallel.mesh.make_mesh). When set, compiled sets are placed with
@@ -372,12 +419,20 @@ class TPUPolicyEngine:
         engine's compiled sets; None defers to CEDAR_TPU_SEGRED (default
         off). Passed per engine — never by mutating process env — so one
         serving process can mix planes (the webhook CLI enables it on the
-        CPU backend, where it measures 2-6x at serving chunk sizes)."""
+        CPU backend, where it measures 2-6x at serving chunk sizes).
+
+        name labels the engine's metrics (cedar_engine_warmup_seconds);
+        warm_max_batch bounds the batch-bucket ladder warm-up compiles
+        (load-time warm threads and warmup() without an explicit
+        max_batch) — the webhook CLI sets it to the server's max_batch so
+        no production bucket ever pays a first-request trace."""
         import os
 
         self.schema = schema or AUTHZ_SCHEMA_INFO
         self.device = device
         self.mesh = mesh
+        self.name = name
+        self.warm_max_batch = warm_max_batch
         if use_pallas is None:
             use_pallas = os.environ.get("CEDAR_TPU_PALLAS", "0") == "1"
         # interpret mode lets the pallas path run (and be tested) on CPU;
@@ -391,6 +446,21 @@ class TPUPolicyEngine:
             use_pallas = False  # the sharded pjit plane replaces pallas
         self.use_pallas = use_pallas
         self.segred = segred
+        # bucket-padded staging buffers, reused across batches (returned
+        # by each launch's finish()); shared by every caller of this engine
+        self._staging = _StagingPool()
+        # donate the per-batch codes/extras device buffers on TPU-class
+        # backends (ops/match.py *_donated): inputs are dead after the
+        # literal expansion, and with pipeline-depth batches in flight they
+        # are the footprint term that scales. Never on CPU — the runtime
+        # may alias numpy inputs, and the staging pool reuses those arrays.
+        donate_env = os.environ.get("CEDAR_TPU_DONATE", "1") != "0"
+        self._donate = backend in ("tpu", "axon") and mesh is None and donate_env
+        # mesh twin: the pjit steps take the same donation (their own jit,
+        # so the flag threads through _mesh_step instead)
+        self._mesh_donate = (
+            backend in ("tpu", "axon") and mesh is not None and donate_env
+        )
         self._compiled: Optional[_CompiledSet] = None
         # monotonic count of successful load() swaps: decision-cache
         # generations fold this in so entries computed from an older
@@ -478,6 +548,7 @@ class TPUPolicyEngine:
         return not t.is_alive()
 
     def _warm_thread_main(self, cs: "_CompiledSet") -> None:
+        t0 = time.monotonic()
         try:
             self._warm_kernels(cs)
         finally:
@@ -485,64 +556,139 @@ class TPUPolicyEngine:
             # and readiness must not wedge on a dead thread
             self._warm_first.set()
             _live_warm_threads.discard(threading.current_thread())
+            try:
+                from ..server.metrics import set_engine_warmup_seconds
 
-    def _warm_kernels(self, cs: "_CompiledSet") -> None:
-        """Trace+compile the serving shapes a fresh server actually hits,
-        off the critical path and in first-hit order: the b=1 shape first
-        (readiness gates on it via _warm_first), then the micro-batcher
-        buckets up to 512, each at the no-extras width AND the first
-        extras bucket (selector/set-heavy requests land on width 8), plus
-        the fixed shape of the standalone bits kernel. Larger buckets
-        compile on first use; every compile here is one the first live
-        requests would otherwise pay. Bails out as soon as a hot swap
-        supersedes `cs` — on a 1-core serving host an orphan compile
-        steals the request thread's CPU."""
-        packed = cs.packed
-        # NOTE: kind tags, not bound-method identity — `fn is
-        # self.match_arrays` is always False (a bound method is a fresh
-        # object per attribute access), which silently warmed the wrong
-        # want_bits variant for two rounds. Three planes get compiled:
-        # the latency-regime fast path (want_bits in-call), the
-        # throughput/python path (plain words — evaluate_batch behind the
-        # gated fast path), and the standalone bits kernel; fallback sets
-        # also warm the want_full variant their host tier walk uses.
+                set_engine_warmup_seconds(
+                    self.name, time.monotonic() - t0
+                )
+            except Exception:  # noqa: BLE001 — metrics never break warm-up
+                pass
+
+    # every extras width the native fast path can produce: _encode_chunk
+    # buckets the live width via _round_bucket(max_e, (8, 16, 32, ...))
+    # capped at the encoder's DEFAULT_EXTRAS_CAP (32), so production
+    # batches land on exactly these four shapes. The warm ladder must
+    # cover them ALL — width 16/32 (selector/group-heavy traffic) paying
+    # a first-hit trace is the same deadline blowout as a cold bucket.
+    _WARM_EXTRAS_WIDTHS = (1, 8, 16, 32)
+
+    def _warm_shape_plan(
+        self,
+        packed: PackedPolicySet,
+        max_batch: Optional[int] = None,
+        extras_widths: Optional[Sequence[int]] = None,
+    ) -> list:
+        """The ordered (kind, batch, extras) ladder of serving shapes to
+        precompile, first-hit order: the b=1 shape first (readiness gates
+        on it via _warm_first), then every batch bucket up to max_batch
+        (default self.warm_max_batch) at each extras width — no-extras
+        requests ride width 1, selector/set-heavy requests land on the
+        8/16/32 buckets (_WARM_EXTRAS_WIDTHS).
+        Three planes per bucket: the latency-regime fast path (want_bits
+        in-call, only at buckets <= BITS_INCALL_MAX where the fast paths
+        request it), the throughput/python path (plain words), and — for
+        fallback sets — the want_full variant their host tier walk uses;
+        plus the fixed shape of the standalone bits kernel. The raw fast
+        paths' batch/replay chunk shapes come LAST — they are the most
+        expensive compiles and nothing gates on them, but without them the
+        first large-batch call after every hot swap eats a trace+compile
+        (VERDICT r4 #8). The half-chunk is the pipeline's tail-split piece
+        (fastpath._TAIL_CHUNK).
+
+        NOTE: kind tags, not bound-method identity — `fn is
+        self.match_arrays` is always False (a bound method is a fresh
+        object per attribute access), which silently warmed the wrong
+        want_bits variant for two rounds."""
+        if extras_widths is None:
+            extras_widths = self._WARM_EXTRAS_WIDTHS
+        cap = max_batch if max_batch is not None else self.warm_max_batch
+        buckets = [b for b in _BATCH_BUCKETS if b <= max(cap, 1)]
         shapes: list = [("match", 1, 1)]
-        for b in (1, 8, 32, 128, 512):
-            for E in (1, 8):
-                if (b, E) != (1, 1):
+        for b in buckets:
+            for E in extras_widths:
+                if (b, E) != (1, 1) and b <= BITS_INCALL_MAX:
                     shapes.append(("match", b, E))
                 shapes.append(("plain", b, E))
                 if packed.fallback:
                     shapes.append(("full", b, E))
-        shapes.append(("bits", self._BITS_CHUNK, 1))
-        shapes.append(("bits", self._BITS_CHUNK, 8))
-        # the raw fast paths' batch/replay chunk shapes (no in-call bits at
-        # this scale): LAST in the ladder — they are the most expensive
-        # compiles and nothing gates on them, but without them the first
-        # large-batch call after every hot swap eats a trace+compile
-        # (VERDICT r4 #8). The half-chunk is the pipeline's tail-split
-        # piece (fastpath._TAIL_CHUNK).
-        for E in (1, 8):
+        for E in extras_widths:
+            shapes.append(("bits", self._BITS_CHUNK, E))
+        for E in extras_widths:
             shapes.append(("plain", SERVING_CHUNK // 2, E))
             shapes.append(("plain", SERVING_CHUNK, E))
-        for i, (kind, b, E) in enumerate(shapes):
+        return shapes
+
+    def _warm_one(self, cs: "_CompiledSet", kind: str, b: int, E: int) -> None:
+        """Compile one ladder shape by running it on all-padding rows."""
+        packed = cs.packed
+        warm_c = np.zeros((b, packed.table.n_slots), dtype=cs.code_dtype)
+        warm_e = np.full((b, E), packed.L, dtype=cs.active_dtype)
+        if kind == "match":
+            self.match_arrays(warm_c, warm_e, cs=cs, want_bits=True)
+        elif kind == "plain":
+            self.match_arrays(warm_c, warm_e, cs=cs)
+        elif kind == "full":
+            self.match_arrays(warm_c, warm_e, cs=cs, want_full=True)
+        else:
+            self.match_bits_arrays(warm_c, warm_e, cs=cs)
+
+    def _warm_kernels(self, cs: "_CompiledSet") -> None:
+        """Run the warm-up ladder for `cs`, off the critical path. Larger
+        buckets than warm_max_batch compile on first use; every compile
+        here is one the first live requests would otherwise pay. Bails out
+        as soon as a hot swap supersedes `cs` — on a 1-core serving host an
+        orphan compile steals the request thread's CPU."""
+        for i, (kind, b, E) in enumerate(self._warm_shape_plan(cs.packed)):
             if self._compiled is not cs or _shutdown.is_set():
                 return
             try:
-                warm_c = np.zeros((b, packed.table.n_slots), dtype=cs.code_dtype)
-                warm_e = np.full((b, E), packed.L, dtype=cs.active_dtype)
-                if kind == "match":
-                    self.match_arrays(warm_c, warm_e, cs=cs, want_bits=True)
-                elif kind == "plain":
-                    self.match_arrays(warm_c, warm_e, cs=cs)
-                elif kind == "full":
-                    self.match_arrays(warm_c, warm_e, cs=cs, want_full=True)
-                else:
-                    self.match_bits_arrays(warm_c, warm_e, cs=cs)
+                self._warm_one(cs, kind, b, E)
             except Exception:  # noqa: BLE001 — warm-up must never take down a swap
                 return
             if i == 0:
                 self._warm_first.set()
+
+    def warmup(
+        self,
+        max_batch: Optional[int] = None,
+        extras_widths: Optional[Sequence[int]] = None,
+    ) -> dict:
+        """Synchronously precompile EVERY (batch-bucket x extras-bucket)
+        kernel plane up to max_batch (default warm_max_batch) for the
+        current compiled set, so no production request at any bucket size
+        ever pays a jit trace. Unlike the background ladder this runs
+        inline, never bails on a concurrent swap (the caller wants THIS
+        set warm), and reports what it cost: {"shapes", "seconds",
+        "traces"} — traces is the number of fresh kernel compiles
+        (ops.match.kernel_trace_count delta; 0 means everything was
+        already warm, e.g. a same-bucket hot swap). Publishes the elapsed
+        time as cedar_engine_warmup_seconds{engine=self.name}."""
+        from ..ops.match import kernel_trace_count
+
+        cs = self._compiled
+        if cs is None:
+            raise RuntimeError("TPUPolicyEngine.warmup: no policy set loaded")
+        t0 = time.monotonic()
+        tc0 = kernel_trace_count()
+        shapes = self._warm_shape_plan(cs.packed, max_batch, extras_widths)
+        for kind, b, E in shapes:
+            if _shutdown.is_set():
+                break
+            self._warm_one(cs, kind, b, E)
+        self._warm_first.set()
+        elapsed = time.monotonic() - t0
+        try:
+            from ..server.metrics import set_engine_warmup_seconds
+
+            set_engine_warmup_seconds(self.name, elapsed)
+        except Exception:  # noqa: BLE001 — metrics must never break warm-up
+            pass
+        return {
+            "shapes": len(shapes),
+            "seconds": round(elapsed, 3),
+            "traces": kernel_trace_count() - tc0,
+        }
 
     def _mesh_step(self, packed: PackedPolicySet):
         """The cached pjit evaluation step for this mesh + set shape."""
@@ -552,7 +698,8 @@ class TPUPolicyEngine:
             from ..parallel.mesh import sharded_codes_match_fn
 
             fn = self._mesh_steps[key] = sharded_codes_match_fn(
-                self.mesh, packed.n_tiers, packed.has_gate
+                self.mesh, packed.n_tiers, packed.has_gate,
+                donate=self._mesh_donate,
             )
         return fn
 
@@ -685,30 +832,44 @@ class TPUPolicyEngine:
             out[i] = self._finalize_sets(packed, groups, None, None)
         return out
 
-    @staticmethod
     def _pad_to_bucket(
+        self,
         chunk_c,
         chunk_e,
         pad_L: int,
         target: Optional[int] = None,
         data_mult: int = 1,
+        held: Optional[list] = None,
     ):
         """Pad a (codes, extras) chunk up to the next batch bucket — or to
         an explicit `target` row count (the fixed-shape bits kernel).
         Bucketed shapes keep the jitted executables retrace-free. Extras
         pad with >= L so padding rows activate nothing. data_mult rounds
         the row count up to a multiple of the mesh's data axis so the
-        batch shards evenly."""
+        batch shards evenly.
+
+        With `held`, the padded buffers come from the engine's staging
+        pool instead of fresh np allocations and are appended to the list;
+        the caller hands them back (pool.release) once the batch's
+        finish() has materialized — not before: the device may still be
+        reading a zero-copied input until then."""
         m = chunk_c.shape[0]
         B = target if target is not None else _round_bucket(m, _BATCH_BUCKETS)
         if data_mult > 1:
             B = -(-B // data_mult) * data_mult
         if B == m:
             return chunk_c, chunk_e
-        pc = np.zeros((B, chunk_c.shape[1]), dtype=chunk_c.dtype)
+        if held is not None:
+            pc = self._staging.acquire((B, chunk_c.shape[1]), chunk_c.dtype)
+            pe = self._staging.acquire((B, chunk_e.shape[1]), chunk_e.dtype)
+            held.extend((pc, pe))
+            pc[m:] = 0  # reused buffers: the pad region must be re-filled
+        else:
+            pc = np.zeros((B, chunk_c.shape[1]), dtype=chunk_c.dtype)
+            pe = np.empty((B, chunk_e.shape[1]), dtype=chunk_e.dtype)
         pc[:m] = chunk_c
-        pe = np.full((B, chunk_e.shape[1]), pad_L, dtype=chunk_e.dtype)
         pe[:m] = chunk_e
+        pe[m:] = pad_L
         return pc, pe
 
     def match_arrays(
@@ -767,6 +928,8 @@ class TPUPolicyEngine:
         codes_arr = codes_arr.astype(cs.code_dtype, copy=False)
         extras_arr = extras_arr.astype(cs.active_dtype, copy=False)
 
+        held: list = []  # pooled staging buffers, released by finish()
+
         def one(chunk_c, chunk_e):
             """-> (words_dev, full_dev_or_None, pack_dev_or_None)"""
             m = chunk_c.shape[0]
@@ -777,7 +940,7 @@ class TPUPolicyEngine:
                 # resolve_flagged instead of an in-call payload
                 chunk_c, chunk_e = self._pad_to_bucket(
                     chunk_c, chunk_e, packed.L,
-                    data_mult=cs.mesh.shape["data"],
+                    data_mult=cs.mesh.shape["data"], held=held,
                 )
                 w, f, last = self._mesh_step(packed)(
                     chunk_c,
@@ -789,7 +952,9 @@ class TPUPolicyEngine:
                     cs.rule_policy_dev,
                 )
                 return w, ((f, last) if want_full else None), None
-            chunk_c, chunk_e = self._pad_to_bucket(chunk_c, chunk_e, packed.L)
+            chunk_c, chunk_e = self._pad_to_bucket(
+                chunk_c, chunk_e, packed.L, held=held
+            )
             B = chunk_c.shape[0]
             if cs.pallas_args is not None:
                 from ..ops.pallas_match import pallas_supported
@@ -830,15 +995,29 @@ class TPUPolicyEngine:
                     )
                     cs.wire = None
             if wire_codes is not None:
+                from ..ops.match import match_rules_codes_wire_donated
+
                 c8, cw = wire_codes
-                out = match_rules_codes_wire(
+                wire_fn = (
+                    match_rules_codes_wire_donated
+                    if self._donate
+                    else match_rules_codes_wire
+                )
+                out = wire_fn(
                     c8, cw, cs.lo8_dev, chunk_e, *args,
                     packed.n_tiers, want_full, want_bits,
                     np.int32(m) if want_bits else None, packed.has_gate,
                     segs,
                 )
             else:
-                out = match_rules_codes(
+                from ..ops.match import match_rules_codes_donated
+
+                flat_fn = (
+                    match_rules_codes_donated
+                    if self._donate
+                    else match_rules_codes
+                )
+                out = flat_fn(
                     chunk_c, chunk_e, *args, packed.n_tiers, want_full,
                     want_bits, np.int32(m) if want_bits else None,
                     packed.has_gate, segs,
@@ -892,6 +1071,11 @@ class TPUPolicyEngine:
                 (lo, np.asarray(w)[:m], trim_full(f, m) if want_full else None, p)
                 for lo, m, w, f, p in outs
             ]
+            # outputs are materialized: the device has fully consumed the
+            # staged inputs, so their buffers can serve the next batch
+            if held:
+                self._staging.release(*held)
+                del held[:]
             if len(host) == 1:
                 _, words, full, _ = host[0]
             else:
@@ -955,11 +1139,13 @@ class TPUPolicyEngine:
 
             self._mesh_bits_step = sharded_codes_bits_fn(self.mesh)
 
+        held: list = []  # pooled staging buffers, released by finish()
+
         def one(chunk_c, chunk_e):
             if cs.mesh is not None:
                 chunk_c, chunk_e = self._pad_to_bucket(
                     chunk_c, chunk_e, packed.L, target=CH,
-                    data_mult=cs.mesh.shape["data"],
+                    data_mult=cs.mesh.shape["data"], held=held,
                 )
                 return self._mesh_bits_step(
                     chunk_c,
@@ -969,7 +1155,7 @@ class TPUPolicyEngine:
                     cs.thresh_dev,
                 )
             chunk_c, chunk_e = self._pad_to_bucket(
-                chunk_c, chunk_e, packed.L, target=CH
+                chunk_c, chunk_e, packed.L, target=CH, held=held
             )
             return match_rules_codes_bits(
                 chunk_c,
@@ -989,7 +1175,11 @@ class TPUPolicyEngine:
             outs.append((hi - lo, b))
 
         def finish():
-            return np.concatenate([np.asarray(b)[:m] for m, b in outs])
+            out = np.concatenate([np.asarray(b)[:m] for m, b in outs])
+            if held:
+                self._staging.release(*held)
+                del held[:]
+            return out
 
         return finish
 

@@ -60,7 +60,12 @@ from ..server.authorizer import (
 )
 from ..lang.authorize import ALLOW, DENY
 from ..ops.match import WORD_ERR, WORD_GATE, WORD_MULTI
-from .evaluator import SERVING_CHUNK, TPUPolicyEngine, _round_bucket
+from .evaluator import (
+    BITS_INCALL_MAX,
+    SERVING_CHUNK,
+    TPUPolicyEngine,
+    _round_bucket,
+)
 
 log = logging.getLogger(__name__)
 
@@ -154,8 +159,9 @@ class _RawFastPath:
     # their bitsets in a second fixed-shape call (match_bits_arrays) is far
     # cheaper in the throughput regime. Small batches keep the in-call
     # payload: there a second device round trip costs more than the bits
-    # plane.
-    _BITS_INCALL_MAX = 4096
+    # plane. Aliased from the evaluator so the warm-up bucket plan and
+    # this routing threshold can never drift apart.
+    _BITS_INCALL_MAX = BITS_INCALL_MAX
     # True when _emit returns the payload unchanged (SAR): clean rows then
     # decode via a VECTORIZED per-distinct-word scatter (~8x the per-row
     # python loop at 65k rows) instead of a dict-hit per row
@@ -171,8 +177,10 @@ class _RawFastPath:
         self.breaker = breaker
         self._snap: Optional[_Snapshot] = None
         self._build_lock = threading.Lock()
-        # encode/device/decode seconds for the last process_raw call
-        self.last_stage_s: dict = {}
+        # accumulated encode/device/decode seconds (reset per process_raw
+        # call on the serial path; the pipelined stages accumulate into it
+        # from their worker threads, so treat it as approximate there)
+        self.last_stage_s: dict = {"encode": 0.0, "device": 0.0, "decode": 0.0}
 
     # ---------------------------------------------------------- availability
 
@@ -288,6 +296,112 @@ class _RawFastPath:
             out.extend(ctx["results"].tolist())
         return out
 
+    # ------------------------------------------------- pipelined stage API
+    #
+    # The engine/batcher.py PipelinedBatcher drives these three entry
+    # points from its worker threads so batch N+1's host encode overlaps
+    # batch N's device execution, and batch N's host decode overlaps batch
+    # N+2's encode. Semantics are IDENTICAL to the serial
+    # authorize_raw/handle_raw path (tests/test_pipeline.py pins the
+    # differential): the same snapshot/readiness gates run at encode time,
+    # an open breaker (or any device-plane exception) degrades to the same
+    # per-row interpreter-fallback RESULTS the serial guarded path
+    # produces, and breaker success latency is measured over the
+    # dispatch→decode window (the serial guard's window minus the encode
+    # it no longer serializes).
+
+    def _pipeline_ready(self) -> bool:
+        """Path-specific readiness gate (store initial loads), mirroring
+        the serial entry point's check."""
+        raise NotImplementedError
+
+    def pipeline_encode(self, bodies: Sequence[bytes]):
+        """Stage 1 (encode worker pool): availability gates + host encode.
+        Returns an opaque ctx for pipeline_dispatch; when the native plane
+        is unavailable, unready, or breaker-rejected, the ctx already
+        carries the final per-row fallback results and the later stages
+        pass it through untouched."""
+        from ..server.metrics import record_fallback_batch
+
+        try:
+            snap = self._current_snapshot()
+            usable = snap is not None and self._pipeline_ready()
+        except Exception:  # noqa: BLE001 — degrade to the python path
+            log.exception("fastpath availability check failed")
+            usable = False
+        if usable and self.breaker is not None and not self.breaker.allow():
+            record_fallback_batch(self._METRIC_PATH, "breaker_open")
+            usable = False
+        if not usable:
+            return ("direct", [self._fallback_row(b) for b in bodies])
+        try:
+            encs = []
+            lo = 0
+            for size in _chunk_sizes(
+                len(bodies), self._CHUNK, self._TAIL_CHUNK
+            ):
+                chunk = bodies[lo : lo + size]
+                lo += size
+                encs.append((chunk, self._encode_chunk(snap, chunk)))
+        except Exception:  # noqa: BLE001 — encode failure degrades
+            return ("direct", self._pipeline_degrade(bodies, "encode"))
+        return ("enc", snap, bodies, encs)
+
+    def pipeline_dispatch(self, ctx):
+        """Stage 2 (dispatch thread): launch every chunk's device match
+        asynchronously and return immediately — the caller dispatches the
+        NEXT batch while this one executes."""
+        if ctx[0] == "direct":
+            return ctx
+        _, snap, bodies, encs = ctx
+        t0 = time.monotonic()
+        try:
+            launched = [
+                (chunk, self._launch_chunk(snap, enc)) for chunk, enc in encs
+            ]
+        except Exception:  # noqa: BLE001 — device failure degrades
+            return ("direct", self._pipeline_degrade(bodies, "dispatch"))
+        return ("run", snap, bodies, launched, t0)
+
+    def pipeline_decode(self, ctx) -> list:
+        """Stage 3 (decode thread): materialize the device results (the
+        only stage that blocks on the device), decode clean rows, resolve
+        gated/flagged rows, and return the per-body results."""
+        if ctx[0] == "direct":
+            return ctx[1]
+        _, snap, bodies, launched, t0 = ctx
+        try:
+            ctxs = [
+                self._finish_words(snap, chunk, pre) for chunk, pre in launched
+            ]
+            self._resolve_deferred(snap, ctxs)
+        except Exception:  # noqa: BLE001 — device failure degrades
+            return self._pipeline_degrade(bodies, "decode")
+        if self.breaker is not None:
+            self.breaker.record_success(time.monotonic() - t0)
+        if len(ctxs) == 1:
+            return ctxs[0]["results"].tolist()
+        out: list = []
+        for c in ctxs:
+            out.extend(c["results"].tolist())
+        return out
+
+    def _pipeline_degrade(self, bodies: Sequence[bytes], stage: str) -> list:
+        """A pipelined stage raised: feed the breaker and answer the whole
+        batch from the per-row interpreter fallback — the exact degradation
+        guarded_call gives the serial path."""
+        from ..server.metrics import record_fallback_batch
+
+        log.exception(
+            "%s pipelined %s stage failed; interpreter fallback",
+            self._METRIC_PATH,
+            stage,
+        )
+        if self.breaker is not None:
+            self.breaker.record_failure()
+        record_fallback_batch(self._METRIC_PATH, "evaluator_error")
+        return [self._fallback_row(b) for b in bodies]
+
     def _record_routing(
         self, n: int, n_fallback: int, n_ok: int, n_gated: int, n_flagged: int
     ) -> None:
@@ -304,11 +418,10 @@ class _RawFastPath:
         record_row_routing(p, "encoder_fallback", n_fallback)
         record_row_routing(p, "encoder_gate", n - n_fallback - n_ok)
 
-    def _prepare_chunk(self, snap: _Snapshot, bodies: Sequence[bytes]):
-        """Encode one chunk natively and LAUNCH its device match; the device
-        work proceeds asynchronously while the caller prepares the next
-        chunk."""
-        t0 = time.monotonic()
+    def _encode_chunk(self, snap: _Snapshot, bodies: Sequence[bytes]):
+        """Host-only half of chunk preparation: C++ encode, encoder-gate
+        flag routing, extras-width trim. No device interaction — this is
+        the piece the pipelined batcher runs on its encode worker pool."""
         codes, extras, counts, flags, aux = self._encode(snap, bodies)
         # object ndarray, not a list: clean rows scatter in one vectorized
         # fancy-index assignment (_finish_words); per-row assignments
@@ -318,7 +431,7 @@ class _RawFastPath:
 
         ok = flags == F_OK
         n_ok = int(ok.sum())
-        idx = ok_codes = ok_extras = fin = None
+        idx = ok_codes = ok_extras = None
         if n_ok:
             all_ok = n_ok == len(bodies)
             idx = np.arange(len(bodies)) if all_ok else np.nonzero(ok)[0]
@@ -337,6 +450,15 @@ class _RawFastPath:
                     extras.shape[1],
                 )
             ok_extras = extras[:, :E] if all_ok else extras[idx, :E]
+        return results, py_rows, idx, ok_codes, ok_extras, aux
+
+    def _launch_chunk(self, snap: _Snapshot, enc):
+        """Device half of chunk preparation: launch the encoded rows' match
+        asynchronously (dispatch only — the readback happens in
+        _finish_words)."""
+        results, py_rows, idx, ok_codes, ok_extras, aux = enc
+        fin = None
+        if idx is not None:
             # small batches: rule bitsets for multi/err rows arrive
             # compacted IN the same device call (zero extra round trips
             # over the high-RTT link). Large batches skip the bits plane;
@@ -344,10 +466,18 @@ class _RawFastPath:
             # in a second fixed-shape call instead.
             fin = self.engine.match_arrays_launch(
                 ok_codes, ok_extras, cs=snap.cs,
-                want_bits=n_ok <= self._BITS_INCALL_MAX,
+                want_bits=len(idx) <= self._BITS_INCALL_MAX,
             )
-        self.last_stage_s["encode"] += time.monotonic() - t0
         return results, py_rows, idx, ok_codes, ok_extras, fin, aux
+
+    def _prepare_chunk(self, snap: _Snapshot, bodies: Sequence[bytes]):
+        """Encode one chunk natively and LAUNCH its device match; the device
+        work proceeds asynchronously while the caller prepares the next
+        chunk."""
+        t0 = time.monotonic()
+        pre = self._launch_chunk(snap, self._encode_chunk(snap, bodies))
+        self.last_stage_s["encode"] += time.monotonic() - t0
+        return pre
 
     def _finish_words(self, snap: _Snapshot, bodies, pre) -> dict:
         """Materialize one chunk's verdict words and decode every CLEAN row
@@ -577,6 +707,9 @@ class SARFastPath(_RawFastPath):
             return [self._fallback(b) for b in bodies]
         return self._guarded_process(bodies, snap, self._fallback)
 
+    def _pipeline_ready(self) -> bool:
+        return self.authorizer.ready()
+
     # --------------------------------------------------------------- hooks
 
     def _encode(self, snap, bodies):
@@ -759,6 +892,9 @@ class AdmissionFastPath(_RawFastPath):
             # exact path for both cases
             return [self._py_one(b) for b in bodies]
         return self._guarded_process(bodies, snap, self._py_one)
+
+    def _pipeline_ready(self) -> bool:
+        return self.handler._ready()
 
     # --------------------------------------------------------------- hooks
 

@@ -389,6 +389,41 @@ def native_error() -> Optional[str]:
     return _lib_error
 
 
+
+_encode_threads_cache: "Optional[int]" = None
+
+
+def _default_encode_threads() -> int:
+    """Per-batch encode thread count. CEDAR_NATIVE_THREADS pins it
+    (operators sharing cores with other tenants; the pipeline bench uses 1
+    to isolate stage overlap — docs/performance.md); a malformed value is
+    logged ONCE and ignored rather than crashing every native encode into
+    the interpreter-fallback path. Resolved on first use and cached — this
+    runs per micro-batch on the hot path."""
+    global _encode_threads_cache
+    if _encode_threads_cache is not None:
+        return _encode_threads_cache
+    import logging
+    import os
+
+    val = 0
+    raw = os.environ.get("CEDAR_NATIVE_THREADS", "")
+    if raw:
+        try:
+            env = int(raw)
+            if env > 0:
+                val = env
+        except ValueError:
+            logging.getLogger(__name__).warning(
+                "ignoring malformed CEDAR_NATIVE_THREADS=%r (want a "
+                "positive integer)",
+                raw,
+            )
+    if val <= 0:
+        val = min(max(os.cpu_count() or 1, 1), 16)
+    _encode_threads_cache = val
+    return val
+
 class NativeEncoder:
     """Owns one loaded native activation table; encodes raw SAR JSON batches."""
 
@@ -438,9 +473,7 @@ class NativeEncoder:
         assert lib is not None
         n = len(bodies)
         if n_threads <= 0:
-            import os
-
-            n_threads = min(max(os.cpu_count() or 1, 1), 16)
+            n_threads = _default_encode_threads()
         if n == 0:
             return (
                 np.zeros((0, self.n_slots), np.int32),
@@ -507,9 +540,7 @@ class NativeEncoder:
         assert lib is not None
         n = len(bodies)
         if n_threads <= 0:
-            import os
-
-            n_threads = min(max(os.cpu_count() or 1, 1), 16)
+            n_threads = _default_encode_threads()
         if n == 0:
             return (
                 np.zeros((0, self.n_slots), np.int32),

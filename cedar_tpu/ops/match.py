@@ -88,6 +88,25 @@ WORD_GATE = 1 << 27
 _PERMIT, _FORBID, _ERROR = 0, 1, 2
 _GPT = 3
 
+# Monotonic count of kernel TRACES (not executions): every jitted match
+# function bumps it from inside its traced body, which Python runs exactly
+# once per (shape, dtype, static-arg) cache miss. TPUPolicyEngine.warmup()
+# and tests/test_pipeline.py read it to prove a claim no wall-clock
+# measurement can: that a post-warmup request at any batch bucket triggers
+# ZERO new compiles (a fresh trace inside a request deadline is the r02
+# selector1k collapse).
+_TRACE_COUNT = 0
+
+
+def kernel_trace_count() -> int:
+    """Total jitted-kernel traces since import (see _note_trace)."""
+    return _TRACE_COUNT
+
+
+def _note_trace() -> None:
+    global _TRACE_COUNT
+    _TRACE_COUNT += 1
+
 
 def _lit_dtype(w_dtype):
     """The literal-matrix dtype that pairs with a W tensor: int8 W rides
@@ -268,6 +287,7 @@ def match_rules_device(
     Returns (packed uint32 [B], (first, last) [B, G] int32 pair or None).
     The full matrices are only materialized to the host when the caller
     needs them (interpreter-fallback merge or error attribution)."""
+    _note_trace()
     L = W_chunks.shape[1]
     lit = _lit_matrix(active, L, _lit_dtype(W_chunks.dtype))
     first, last, _ = _first_match(
@@ -326,11 +346,7 @@ def _compact_flagged_bits(bits, flagged, n_valid):
     return vals, idx, jnp.take(bits, idx, axis=0)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_tiers", "want_full", "want_bits", "has_gate", "segs"),
-)
-def match_rules_codes(
+def _match_rules_codes_py(
     codes,
     extras,
     act_rows,
@@ -366,11 +382,31 @@ def match_rules_codes(
     has_gate: the packed set carries fallback-scope gate rules in group
     n_tiers * 3; rows with a gate hit get WORD_GATE set in their word (and
     an extra trailing column in the want_full matrices)."""
+    _note_trace()
     lit = _lit_matrix_codes(codes, extras, act_rows, _lit_dtype(W_chunks.dtype))
     return _match_from_lit(
         lit, W_chunks, thresh_c, group_c, policy_c, n_tiers,
         want_full, want_bits, n_valid, has_gate, segs,
     )
+
+
+_CODES_STATICS = ("n_tiers", "want_full", "want_bits", "has_gate", "segs")
+
+match_rules_codes = functools.partial(
+    jax.jit, static_argnames=_CODES_STATICS
+)(_match_rules_codes_py)
+
+# donated twin: the per-batch codes/extras staging transfers are dead the
+# moment the literal expansion reads them, so donating lets XLA reuse
+# their device buffers for scratch — with several batches in flight
+# (engine/batcher.py pipeline) the input buffers are the part of the
+# footprint that scales with depth. Selected by the engine on TPU-class
+# backends only: the CPU runtime may alias a numpy input buffer, where
+# donation would hand the caller's (pooled, reused) staging array to XLA
+# as writable scratch.
+match_rules_codes_donated = functools.partial(
+    jax.jit, static_argnames=_CODES_STATICS, donate_argnums=(0, 1)
+)(_match_rules_codes_py)
 
 
 def _match_from_lit(
@@ -439,11 +475,7 @@ def _lit_matrix_codes_wire(
     return acc.astype(dtype)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_tiers", "want_full", "want_bits", "has_gate", "segs"),
-)
-def match_rules_codes_wire(
+def _match_rules_codes_wire_py(
     codes8,
     codes_w,
     lo8,
@@ -463,6 +495,7 @@ def match_rules_codes_wire(
     """match_rules_codes over the split u8 wire layout (see
     _lit_matrix_codes_wire and engine._CompiledSet.wire): identical
     semantics and outputs, roughly half the h2d bytes per request."""
+    _note_trace()
     lit = _lit_matrix_codes_wire(
         codes8, codes_w, lo8, extras, act_rows, _lit_dtype(W_chunks.dtype)
     )
@@ -470,6 +503,18 @@ def match_rules_codes_wire(
         lit, W_chunks, thresh_c, group_c, policy_c, n_tiers,
         want_full, want_bits, n_valid, has_gate, segs,
     )
+
+
+match_rules_codes_wire = functools.partial(
+    jax.jit, static_argnames=_CODES_STATICS
+)(_match_rules_codes_wire_py)
+
+# donated twin (see match_rules_codes_donated): codes8/codes_w/extras are
+# the per-batch staging inputs; lo8 is the compiled set's resident tensor
+# and must NOT be donated
+match_rules_codes_wire_donated = functools.partial(
+    jax.jit, static_argnames=_CODES_STATICS, donate_argnums=(0, 1, 3)
+)(_match_rules_codes_wire_py)
 
 
 @functools.partial(
@@ -496,6 +541,7 @@ def match_rules_codes_pallas(
     group_r/policy_r [1, R]."""
     from .pallas_match import pallas_first_match
 
+    _note_trace()
     n_groups = n_tiers * _GPT + (1 if has_gate else 0)
     lit = _lit_matrix_codes(codes, extras, act_rows, _lit_dtype(W2.dtype))
     first, last = pallas_first_match(
@@ -513,6 +559,7 @@ def match_rules_compact(active, W_chunks, thresh_c, group_c, policy_c, n_groups:
     """Full per-(tier, effect) first-match matrix [B, G] int32; INT32_MAX
     means "no rule matched". Kept for callers that always need per-group
     attribution (tests, fallback-heavy sets)."""
+    _note_trace()
     L = W_chunks.shape[1]
     lit = _lit_matrix(active, L, _lit_dtype(W_chunks.dtype))
     first, _, _ = _first_match(lit, W_chunks, thresh_c, group_c, policy_c, n_groups)
@@ -540,6 +587,7 @@ def match_rules_codes_bits(
     internal/server/store/store.go:31). Runs only for rows whose verdict
     word carries the multi or err flag, so the [B, R/32] readback never
     rides the hot path."""
+    _note_trace()
     lit = _lit_matrix_codes(codes, extras, act_rows, _lit_dtype(W_chunks.dtype))
 
     def body(_, xs):
@@ -580,6 +628,7 @@ def match_rules(active, W, thresh, rule_group, rule_policy, n_groups: int):
     """Unchunked single-matmul variant (small sets / compile checks).
     Follows W's dtype like every other match function (int8 or bf16 plane).
     Returns (hits [B, G] bool, first_policy [B, G] int32)."""
+    _note_trace()
     L = W.shape[0]
     lit = _lit_matrix(active, L, _lit_dtype(W.dtype))
 

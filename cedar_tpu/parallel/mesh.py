@@ -131,7 +131,9 @@ def shard_codes_tensors(mesh: Mesh, act_rows, W, thresh, rule_group, rule_policy
     )
 
 
-def sharded_codes_match_fn(mesh: Mesh, n_tiers: int, has_gate: bool = False):
+def sharded_codes_match_fn(
+    mesh: Mesh, n_tiers: int, has_gate: bool = False, donate: bool = False
+):
     """The production evaluation step, sharded: feature codes in, packed
     uint32 verdict words out. This is the step TPUPolicyEngine.match_arrays
     routes through when the engine owns a mesh.
@@ -148,7 +150,12 @@ def sharded_codes_match_fn(mesh: Mesh, n_tiers: int, has_gate: bool = False):
     Returns (packed words [B], (first [B, G], last [B, G])) — the same
     surface as ops.match.match_rules_codes(want_full=True); has_gate adds
     the fallback-scope gate column and the WORD_GATE bit exactly like the
-    single-device kernel."""
+    single-device kernel.
+
+    donate hands the per-batch codes/extras shards back to XLA as scratch
+    (ops/match.py match_rules_codes_donated has the rationale); the
+    engine enables it on TPU-class backends only — the CPU runtime may
+    alias numpy inputs, which the engine's staging pool reuses."""
     G = n_tiers * 3 + (1 if has_gate else 0)
     in_shardings = (
         NamedSharding(mesh, P("data", None)),  # codes [B, S]
@@ -166,7 +173,10 @@ def sharded_codes_match_fn(mesh: Mesh, n_tiers: int, has_gate: bool = False):
     )
 
     @functools.partial(
-        jax.jit, in_shardings=in_shardings, out_shardings=out_shardings
+        jax.jit,
+        in_shardings=in_shardings,
+        out_shardings=out_shardings,
+        donate_argnums=(0, 1) if donate else (),
     )
     def step(codes, extras, act_rows, W, thresh, rule_group, rule_policy):
         lit = _lit_matrix_codes(

@@ -203,21 +203,48 @@ class WebhookServer:
         drain_grace_s: float = 0.0,
         analysis_provider=None,
         decision_cache=None,
+        pipeline_depth: int = 0,
+        encode_workers: int = 2,
     ):
         self.authorizer = authorizer
         self.admission_handler = admission_handler
+        # pipeline_depth > 0 runs each raw fast path through the
+        # three-stage PipelinedBatcher (engine/batcher.py): host encode of
+        # batch N+1 overlaps device execution of batch N, with
+        # `pipeline_depth` batches in flight and `encode_workers` encode
+        # threads. 0 keeps the serial MicroBatcher (identical results —
+        # tests/test_pipeline.py pins the differential; the CLI defaults
+        # to depth 2, embedders opt in).
+        self.pipeline_depth = max(0, int(pipeline_depth))
+        self.encode_workers = max(1, int(encode_workers))
+
+        def _eval_batcher(fastpath_obj, serial_fn, path):
+            from ..engine.batcher import MicroBatcher, PipelinedBatcher
+
+            if self.pipeline_depth > 0:
+                return PipelinedBatcher(
+                    fastpath_obj,
+                    max_batch=max_batch,
+                    window_s=batch_window_s,
+                    depth=self.pipeline_depth,
+                    encode_workers=self.encode_workers,
+                    metrics_path=path,
+                )
+            return MicroBatcher(
+                serial_fn,
+                max_batch=max_batch,
+                window_s=batch_window_s,
+                metrics_path=path,
+            )
+
         # native SAR fast path (engine/fastpath.py): request threads funnel
         # raw bodies through a micro-batcher into the C++ encoder + device
         # matcher; unavailable configurations fall back per request
         self.fastpath = fastpath
         self._batcher = None
         if fastpath is not None:
-            from ..engine.batcher import MicroBatcher
-
-            self._batcher = MicroBatcher(
-                fastpath.authorize_raw,
-                max_batch=max_batch,
-                window_s=batch_window_s,
+            self._batcher = _eval_batcher(
+                fastpath, fastpath.authorize_raw, "authorization"
             )
         # admission reviews micro-batch into one device call when the
         # handler has a batched evaluation backend
@@ -236,12 +263,8 @@ class WebhookServer:
         self.admission_fastpath = admission_fastpath
         self._adm_raw_batcher = None
         if admission_fastpath is not None:
-            from ..engine.batcher import MicroBatcher
-
-            self._adm_raw_batcher = MicroBatcher(
-                admission_fastpath.handle_raw,
-                max_batch=max_batch,
-                window_s=batch_window_s,
+            self._adm_raw_batcher = _eval_batcher(
+                admission_fastpath, admission_fastpath.handle_raw, "admission"
             )
         self.error_injector = error_injector or ErrorInjector(None)
         self.recorder = recorder
@@ -686,6 +709,14 @@ class WebhookServer:
             def log_message(self, fmt, *args):
                 log.debug("%s %s", self.address_string(), fmt % args)
 
+            def _send_json(self, doc: dict):
+                data = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_GET(self):
                 if self.path == "/healthz":
                     # always-200 stub (reference health.go:22-26)
@@ -730,12 +761,43 @@ class WebhookServer:
                     except Exception:  # noqa: BLE001 — debug must not 500
                         log.exception("cache stats failed")
                         doc = {"error": "cache stats failed"}
-                    data = json.dumps(doc).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
+                    self._send_json(doc)
+                elif self.path == "/debug/engine":
+                    # per-path engine + batcher pipeline snapshot: mode
+                    # (serial/pipelined), pipeline depth, encode workers,
+                    # live queue fills, per-stage stall totals, and the
+                    # engine's warm/compile state (docs/performance.md);
+                    # {} with no fast path wired
+                    doc = {}
+                    try:
+                        for name, fp, batcher in (
+                            (
+                                "authorization",
+                                server.fastpath,
+                                server._batcher,
+                            ),
+                            (
+                                "admission",
+                                server.admission_fastpath,
+                                server._adm_raw_batcher,
+                            ),
+                        ):
+                            if batcher is None:
+                                continue
+                            entry = {"pipeline": batcher.debug_stats()}
+                            engine = getattr(fp, "engine", None)
+                            if engine is not None:
+                                entry["engine"] = {
+                                    "name": engine.name,
+                                    "warm_ready": engine.warm_ready(),
+                                    "load_generation": engine.load_generation,
+                                    **engine.stats,
+                                }
+                            doc[name] = entry
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("engine stats failed")
+                        doc = {"error": "engine stats failed"}
+                    self._send_json(doc)
                 elif self.path == "/debug/analysis":
                     # the last policy-set analysis report (load-time
                     # lowerability/shadowing/conflict findings + capacity);
@@ -748,12 +810,7 @@ class WebhookServer:
                     except Exception:  # noqa: BLE001 — debug must not 500
                         log.exception("analysis provider failed")
                         doc = {"error": "analysis provider failed"}
-                    data = json.dumps(doc).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
+                    self._send_json(doc)
                 else:
                     self.send_error(404)
 

@@ -313,6 +313,49 @@ decision_cache_hit_ratio = REGISTRY.register(
 )
 
 
+# Pipelined-evaluation metrics (engine/batcher.py PipelinedBatcher +
+# TPUPolicyEngine.warmup, docs/performance.md). Outside the
+# cedar_authorizer_* subsystem like the cache metrics: they describe the
+# engine pipeline shared by both paths, partitioned by the `path` label.
+batch_occupancy = REGISTRY.register(
+    Histogram(
+        "cedar_batch_occupancy",
+        "Rows per formed micro-batch, partitioned by path. A distribution "
+        "stuck at 1 under load means the batch window is too short (or "
+        "traffic too serialized) to amortize device dispatch; a "
+        "distribution pinned at max_batch with rising pipeline stalls "
+        "means the device is the bottleneck.",
+        ["path"],
+        [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384],
+    )
+)
+
+pipeline_stall_seconds_total = REGISTRY.register(
+    Counter(
+        "cedar_pipeline_stall_seconds_total",
+        "Seconds a pipeline stage spent stalled, partitioned by path and "
+        "stage: collect = the collector blocked on a full dispatch queue "
+        "(device/decode backpressure); dispatch = the dispatch thread "
+        "waited on an encode worker (encode-bound); decode = the decode "
+        "thread sat idle while batches were in flight (pipeline "
+        "starvation). Rate > ~0.5 s/s on one stage names the bottleneck "
+        "(docs/performance.md has the tuning table).",
+        ["path", "stage"],
+    )
+)
+
+engine_warmup_seconds = REGISTRY.register(
+    Gauge(
+        "cedar_engine_warmup_seconds",
+        "Seconds the last TPUPolicyEngine.warmup() spent precompiling the "
+        "(batch-bucket x extras-bucket) kernel planes, partitioned by "
+        "engine. Near-zero after a reload means the bucketed shapes "
+        "reused the previous executables (the common hot-swap case).",
+        ["engine"],
+    )
+)
+
+
 # Static-analysis metrics (cedar_tpu/analysis): deliberately outside the
 # cedar_authorizer_* request subsystem — they describe the POLICY SET, not
 # request traffic, and are re-published at every policy load.
@@ -398,6 +441,19 @@ def set_cache_size(path: str, size: int) -> None:
 
 def set_cache_hit_ratio(path: str, ratio: float) -> None:
     decision_cache_hit_ratio.set(round(ratio, 6), path=path)
+
+
+def record_batch_occupancy(path: str, n: int) -> None:
+    batch_occupancy.observe(n, path=path)
+
+
+def record_pipeline_stall(path: str, stage: str, seconds: float) -> None:
+    if seconds > 0:
+        pipeline_stall_seconds_total.inc(seconds, path=path, stage=stage)
+
+
+def set_engine_warmup_seconds(engine: str, seconds: float) -> None:
+    engine_warmup_seconds.set(round(seconds, 6), engine=engine)
 
 
 def set_fastpath_lowerable(tier: int, count: int) -> None:

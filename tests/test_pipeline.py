@@ -1,0 +1,568 @@
+"""Pipelined-evaluation tests (ISSUE 4, docs/performance.md).
+
+The PipelinedBatcher splits the serial batch loop into encode / dispatch /
+decode stages running on separate threads. Everything riding on it is
+pinned here:
+
+  * differential identity — >= 1k mixed SAR + AdmissionReview bodies
+    produce BYTE-identical responses through the pipelined batcher and the
+    serial fast-path entry points, including across a decision-inverting
+    policy reload;
+  * warmup() — after TPUPolicyEngine.warmup, a request at ANY batch bucket
+    triggers zero new jit traces (ops.match.kernel_trace_count);
+  * resilience semantics survive the move to three stages: per-waiter
+    deadline withdrawal, breaker trips degrading to interpreter-fallback
+    RESULTS (never errors), and drain-on-stop leaving no slot unset;
+  * /debug/engine + the occupancy/stall metrics.
+"""
+
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from cedar_tpu.engine.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    PipelinedBatcher,
+)
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.native import native_available
+from cedar_tpu.ops.match import kernel_trace_count
+from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+from cedar_tpu.server.http import sar_response
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain for the native encoder"
+)
+
+SAR_POLICIES = """
+permit (principal is k8s::User, action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { principal.name == "sam" && resource.resource == "pods" };
+permit (principal in k8s::Group::"viewers", action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { resource.resource == "pods" };
+forbid (principal, action, resource is k8s::Resource)
+  when { resource.resource == "nodes" };
+permit (principal, action in [k8s::Action::"list", k8s::Action::"watch"],
+        resource is k8s::Resource)
+  when { resource has labelSelector &&
+         resource.labelSelector.contains({key: "owner", operator: "=",
+                                          values: ["team-a"]}) };
+"""
+
+# a hard literal outside every native class: its scope packs as a gate
+# rule, and matching rows re-route through the exact Python path — the
+# differential must cover the gated lane too
+GATED_POLICY = """
+forbid (principal, action == k8s::Action::"deletecollection",
+        resource is k8s::Resource)
+  when { resource has name && ip(resource.name).isLoopback() };
+"""
+
+# the reload flips pods-get for sam from permit to forbid: a decision
+# inversion the post-reload differential must observe on both paths
+SAR_POLICIES_RELOADED = """
+forbid (principal is k8s::User, action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { principal.name == "sam" && resource.resource == "pods" };
+permit (principal, action, resource is k8s::Resource)
+  when { resource.resource == "services" };
+"""
+
+ADM_POLICIES = """
+forbid (principal is k8s::User,
+        action == k8s::admission::Action::"create",
+        resource is core::v1::ConfigMap)
+  when { resource.metadata has labels &&
+         resource.metadata.labels.contains({key: "env", value: "prod"}) };
+"""
+
+
+def _sar_body(i: int) -> bytes:
+    """Mixed SAR stream: clean allow/deny/no-opinion rows, multi-match rows
+    (sam in viewers getting pods), selector extras, encoder gates
+    (system users), gated rows (loopback deletecollection), and parse
+    errors."""
+    k = i % 11
+    if k == 9:
+        return b'{"not json' + str(i).encode()
+    user, groups = f"user-{i % 7}", []
+    verb, resource, name = "get", "pods", ""
+    sel = None
+    if k == 0:
+        user = "sam"
+    elif k == 1:
+        user, groups = "sam", ["viewers"]  # two permits match: multi row
+    elif k == 2:
+        groups = ["viewers"]
+    elif k == 3:
+        resource = "nodes"  # forbid
+    elif k == 4:
+        verb, resource = "list", "secrets"
+        sel = {
+            "requirements": [
+                {"key": "owner", "operator": "In", "values": ["team-a"]}
+            ]
+        }
+    elif k == 5:
+        user = "system:kube-scheduler"  # encoder gate: system skip
+    elif k == 6:
+        verb, resource, name = "deletecollection", "pods", "127.0.0.1"  # gated
+    elif k == 7:
+        verb, resource, name = "deletecollection", "pods", "box-7"  # gate scope
+    ra = {
+        "verb": verb,
+        "version": "v1",
+        "resource": resource,
+        "namespace": f"ns-{i % 5}",
+    }
+    if name:
+        ra["name"] = name
+    if sel:
+        ra["labelSelector"] = sel
+    return json.dumps(
+        {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": user,
+                "uid": "u",
+                "groups": groups,
+                "resourceAttributes": ra,
+            },
+        }
+    ).encode()
+
+
+def _adm_body(i: int) -> bytes:
+    k = i % 7
+    if k == 6:
+        return b'{"broken' + str(i).encode()
+    ns = "kube-system" if k == 5 else "default"  # ns-skip lane
+    labels = {"env": "prod"} if k % 2 else {"env": "dev"}
+    return json.dumps(
+        {
+            "request": {
+                "uid": f"adm-{i}",
+                "operation": "CREATE",
+                "userInfo": {"username": "bob", "groups": ["tenants"]},
+                "kind": {"group": "", "version": "v1", "kind": "ConfigMap"},
+                "resource": {
+                    "group": "",
+                    "version": "v1",
+                    "resource": "configmaps",
+                },
+                "namespace": ns,
+                "object": {
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {
+                        "name": f"cm-{i}",
+                        "namespace": ns,
+                        "labels": labels,
+                    },
+                    "data": {"k": "v"},
+                },
+            }
+        }
+    ).encode()
+
+
+def _sar_stack(src, breaker=None, evaluate_engine=True):
+    from cedar_tpu.engine.fastpath import SARFastPath
+
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "pipe")], warm="off")
+    stores = TieredPolicyStores([MemoryStore.from_source("pipe", src)])
+    authorizer = CedarWebhookAuthorizer(
+        stores, evaluate=engine.evaluate if evaluate_engine else None
+    )
+    fast = SARFastPath(engine, authorizer, breaker=breaker)
+    return engine, stores, authorizer, fast
+
+
+def _adm_stack(src):
+    from cedar_tpu.engine.fastpath import AdmissionFastPath
+    from cedar_tpu.server.admission import (
+        ALLOW_ALL_ADMISSION_POLICY_SOURCE,
+        CedarAdmissionHandler,
+        allow_all_admission_policy_store,
+    )
+
+    engine = TPUPolicyEngine()
+    engine.load(
+        [
+            PolicySet.from_source(src, "pipe"),
+            PolicySet.from_source(ALLOW_ALL_ADMISSION_POLICY_SOURCE, "aa"),
+        ],
+        warm="off",
+    )
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores(
+            [
+                MemoryStore.from_source("pipe", src),
+                allow_all_admission_policy_store(),
+            ]
+        ),
+        evaluate=engine.evaluate,
+        evaluate_batch=engine.evaluate_batch,
+    )
+    fast = AdmissionFastPath(engine, handler)
+    return engine, handler, fast
+
+
+def _submit_all(batcher, bodies, timeout=60.0, workers=32):
+    with ThreadPoolExecutor(workers) as pool:
+        return list(
+            pool.map(lambda b: batcher.submit(b, timeout=timeout), bodies)
+        )
+
+
+def _sar_bytes(results):
+    return [
+        json.dumps(sar_response(*r), sort_keys=True).encode() for r in results
+    ]
+
+
+def _adm_bytes(results):
+    return [
+        json.dumps(r.to_admission_review(), sort_keys=True).encode()
+        for r in results
+    ]
+
+
+@needs_native
+class TestPipelinedDifferential:
+    def test_sar_differential_1k_with_reload(self):
+        """>= 1k mixed SAR bodies: pipelined == serial byte-for-byte, on
+        the initial policy set AND after a decision-inverting reload."""
+        engine, _stores, _auth, fast = _sar_stack(
+            SAR_POLICIES + GATED_POLICY
+        )
+        bodies = [_sar_body(i) for i in range(700)]
+        serial = _sar_bytes(fast.authorize_raw(bodies))
+        # small max_batch forces many batches through the pipeline so the
+        # differential crosses batch boundaries, not one giant batch
+        batcher = PipelinedBatcher(
+            fast, max_batch=128, window_s=0.0002, depth=2, encode_workers=2
+        )
+        try:
+            piped = _sar_bytes(_submit_all(batcher, bodies))
+            assert piped == serial
+            # decision-inverting hot swap: both paths must flip together
+            engine.load(
+                [PolicySet.from_source(SAR_POLICIES_RELOADED, "pipe2")],
+                warm="off",
+            )
+            serial2 = _sar_bytes(fast.authorize_raw(bodies))
+            piped2 = _sar_bytes(_submit_all(batcher, bodies))
+            assert piped2 == serial2
+            assert serial2 != serial  # the reload really inverted decisions
+        finally:
+            batcher.stop()
+
+    def test_admission_differential_with_pipeline(self):
+        _engine, _handler, fast = _adm_stack(ADM_POLICIES)
+        bodies = [_adm_body(i) for i in range(400)]
+        serial = _adm_bytes(fast.handle_raw(bodies))
+        batcher = PipelinedBatcher(
+            fast, max_batch=64, window_s=0.0002, depth=2, encode_workers=2
+        )
+        try:
+            piped = _adm_bytes(_submit_all(batcher, bodies))
+            assert piped == serial
+        finally:
+            batcher.stop()
+
+
+class TestWarmup:
+    def test_warmup_compiles_every_bucket_plane(self):
+        """After warmup(), a first request at ANY batch bucket (either
+        common extras width) triggers zero new jit traces — the compile
+        counter in ops/match.py is the proof, not wall-clock."""
+        src = """
+permit (principal, action == k8s::Action::"get", resource is k8s::Resource)
+  when { resource.resource == "pods" };
+"""
+        engine = TPUPolicyEngine()
+        engine.load([PolicySet.from_source(src, "warm")], warm="off")
+        report = engine.warmup(max_batch=128)
+        assert report["shapes"] > 0
+        assert report["seconds"] >= 0
+        cs = engine._compiled
+        n_slots = cs.packed.table.n_slots
+        L = cs.packed.L
+        tc0 = kernel_trace_count()
+        for b in (1, 3, 8, 17, 32, 100, 128):
+            # every native-fastpath extras width (1/8/16/32): width 16/32
+            # selector-heavy traffic must be as trace-free as no-extras
+            for E in (1, 8, 16, 32):
+                codes = np.zeros((b, n_slots), dtype=cs.code_dtype)
+                extras = np.full((b, E), L, dtype=cs.active_dtype)
+                engine.match_arrays(codes, extras, cs=cs)
+                engine.match_arrays(codes, extras, cs=cs, want_bits=True)
+        assert kernel_trace_count() == tc0, (
+            "a post-warmup request at a warmed bucket traced a new kernel"
+        )
+        # a second warmup finds everything compiled: zero fresh traces
+        assert engine.warmup(max_batch=128)["traces"] == 0
+
+    def test_warmup_requires_loaded_set(self):
+        with pytest.raises(RuntimeError):
+            TPUPolicyEngine().warmup()
+
+
+class _StubStages:
+    """Controllable stages for batcher-semantics tests: encode tags, the
+    dispatch stage sleeps (simulating in-flight device work), decode
+    doubles each item."""
+
+    def __init__(self, dispatch_sleep_s=0.0, decode_sleep_s=0.0):
+        self.dispatch_sleep_s = dispatch_sleep_s
+        self.decode_sleep_s = decode_sleep_s
+        self.encoded_batches = []
+
+    def pipeline_encode(self, items):
+        self.encoded_batches.append(list(items))
+        return list(items)
+
+    def pipeline_dispatch(self, ctx):
+        if self.dispatch_sleep_s:
+            time.sleep(self.dispatch_sleep_s)
+        return ctx
+
+    def pipeline_decode(self, ctx):
+        if self.decode_sleep_s:
+            time.sleep(self.decode_sleep_s)
+        return [x * 2 for x in ctx]
+
+
+class TestPipelinedBatcherSemantics:
+    def test_results_roundtrip_and_debug_stats(self):
+        stages = _StubStages()
+        b = PipelinedBatcher(stages, max_batch=16, window_s=0.0002, depth=2)
+        try:
+            assert _submit_all(b, list(range(50)), workers=8) == [
+                2 * i for i in range(50)
+            ]
+            stats = b.debug_stats()
+            assert stats["mode"] == "pipelined"
+            assert stats["depth"] == 2
+            assert stats["batches_total"] >= 1
+            assert set(stats["stall_seconds"]) == {
+                "collect",
+                "dispatch",
+                "decode",
+            }
+        finally:
+            b.stop()
+
+    def test_deadline_withdrawal_under_pipelining(self):
+        """A submitter's budget expiring while its batch is stuck behind
+        slow device work raises DeadlineExceeded without wedging the
+        pipeline; per-waiter coalesce accounting survives too — a
+        timed-out follower never cancels the leader's shared slot."""
+        stages = _StubStages(dispatch_sleep_s=0.25)
+        b = PipelinedBatcher(stages, max_batch=8, window_s=0.0002, depth=1)
+        try:
+            with pytest.raises(DeadlineExceeded):
+                b.submit("late", timeout=0.03)
+            # the withdrawn-or-evaluated item must not corrupt later work
+            assert b.submit("ok", timeout=5.0) == "okok"
+
+            leader_out = {}
+
+            def leader():
+                leader_out["r"] = b.submit("co", timeout=5.0, coalesce_key="k")
+
+            t = threading.Thread(target=leader)
+            t.start()
+            time.sleep(0.01)  # leader enqueued (or already claimed)
+            try:
+                # follower with an instantly-expiring budget: must raise,
+                # must NOT withdraw the leader's slot
+                b.submit("co", timeout=0.0, coalesce_key="k")
+            except DeadlineExceeded:
+                pass
+            t.join(timeout=10)
+            assert leader_out["r"] == "coco"
+        finally:
+            b.stop()
+
+    def test_drain_no_slot_left_unset(self):
+        """stop() mid-pipeline drains every accepted item through all
+        three stages: no submitter hangs, every slot is set."""
+        stages = _StubStages(dispatch_sleep_s=0.02)
+        b = PipelinedBatcher(stages, max_batch=4, window_s=0.0002, depth=2)
+        results = []
+        errors = []
+
+        def one(i):
+            try:
+                results.append((i, b.submit(i, timeout=30)))
+            except Exception as e:  # noqa: BLE001 — recorded for the assert
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(40)]
+        for t in threads:
+            t.start()
+        time.sleep(0.03)  # several batches in flight, several queued
+        b.stop(drain_timeout_s=30)
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "a submitter hung"
+        # every item either completed with the right answer or was
+        # EXPLICITLY rejected at submit time (post-stop arrival) — no slot
+        # silently dropped, and no ACCEPTED waiter may read the
+        # collector's drain-time exit as a dead batcher while the decode
+        # stage is still delivering (PipelinedBatcher._alive)
+        assert not errors or all(
+            isinstance(e, RuntimeError) for _, e in errors
+        )
+        assert not any(
+            "without delivering" in str(e) for _, e in errors
+        ), f"accepted waiter errored during drain: {errors}"
+        assert all(r == 2 * i for i, r in results)
+        assert len(results) + len(errors) == 40
+
+    def test_drain_with_slow_decode_outlives_liveness_poll(self):
+        """The collector exits at the drain sentinel while decode is still
+        working; a waiter whose liveness poll (0.5s) fires in that window
+        must keep waiting for its result, not raise 'batcher dead'."""
+        stages = _StubStages(decode_sleep_s=0.7)
+        b = PipelinedBatcher(stages, max_batch=2, window_s=0.0002, depth=2)
+        results = {}
+
+        def one(i):
+            results[i] = b.submit(i, timeout=30)
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)  # batches claimed, decode sleeping
+        b.stop(drain_timeout_s=30)
+        for t in threads:
+            t.join(timeout=30)
+        assert results == {i: 2 * i for i in range(4)}
+
+    def test_stage_exception_fails_batch_without_killing_workers(self):
+        class Boom(_StubStages):
+            def pipeline_dispatch(self, ctx):
+                if "boom" in ctx:
+                    raise ValueError("stage bug")
+                return ctx
+
+        stages = Boom()
+        b = PipelinedBatcher(stages, max_batch=4, window_s=0.0002)
+        try:
+            with pytest.raises(RuntimeError, match="batch evaluation failed"):
+                b.submit("boom", timeout=5.0)
+            # the pipeline survives and keeps serving
+            assert b.submit("fine", timeout=5.0) == "finefine"
+        finally:
+            b.stop()
+
+
+@needs_native
+class TestBreakerUnderPipelining:
+    def test_device_failure_degrades_then_trips_breaker(self):
+        """A raising device plane feeds the breaker from the pipelined
+        stages and answers from the interpreter fallback (RESULTS, not
+        errors); once tripped, the encode stage routes batches directly to
+        the fallback without touching the device."""
+        from cedar_tpu.engine.breaker import OPEN, CircuitBreaker
+
+        breaker = CircuitBreaker(
+            name="pipe-test", failure_threshold=2, recovery_s=60.0
+        )
+        # authorizer WITHOUT the engine evaluate hook: the interpreter
+        # fallback must keep answering while the device plane is sick
+        engine, _stores, _auth, fast = _sar_stack(
+            SAR_POLICIES, breaker=breaker, evaluate_engine=False
+        )
+        calls = {"n": 0}
+
+        def boom(*a, **k):
+            calls["n"] += 1
+            raise RuntimeError("device wedged")
+
+        engine.match_arrays_launch = boom  # type: ignore[method-assign]
+        b = PipelinedBatcher(fast, max_batch=8, window_s=0.0002)
+        try:
+            body = _sar_body(0)  # sam gets pods: interpreter says Allow
+            expected = json.dumps(
+                sar_response(*fast._python_fallback(body)), sort_keys=True
+            )
+            for _ in range(3):
+                got = json.dumps(
+                    sar_response(*b.submit(body, timeout=30)), sort_keys=True
+                )
+                assert got == expected
+            assert breaker.state == OPEN
+            launches_when_open = calls["n"]
+            for _ in range(3):
+                b.submit(body, timeout=30)
+            # open breaker: encode stage short-circuits, no device launches
+            assert calls["n"] == launches_when_open
+        finally:
+            b.stop()
+
+
+@needs_native
+class TestDebugEngineEndpoint:
+    def test_debug_engine_reports_pipeline_and_queue_fill(self):
+        import urllib.request
+
+        from cedar_tpu.server.http import WebhookServer
+        from cedar_tpu.server.metrics import REGISTRY
+
+        engine, _stores, _auth, fast = _sar_stack(SAR_POLICIES)
+        _adm_engine, handler, adm_fast = _adm_stack(ADM_POLICIES)
+        server = WebhookServer(
+            authorizer=_auth,
+            admission_handler=handler,
+            address="127.0.0.1",
+            port=0,
+            metrics_port=0,
+            fastpath=fast,
+            admission_fastpath=adm_fast,
+            pipeline_depth=2,
+            encode_workers=2,
+        )
+        server.start()
+        try:
+            port = server.bound_port
+            mport = server.bound_metrics_port
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/authorize",
+                data=_sar_body(0),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/debug/engine", timeout=30
+            ) as resp:
+                doc = json.loads(resp.read())
+            for path in ("authorization", "admission"):
+                pipe = doc[path]["pipeline"]
+                assert pipe["mode"] == "pipelined"
+                assert pipe["depth"] == 2
+                assert pipe["encode_workers"] == 2
+                assert "dispatch_queue" in pipe and "decode_queue" in pipe
+                assert "stall_seconds" in pipe
+                eng = doc[path]["engine"]
+                assert "load_generation" in eng and "warm_ready" in eng
+            # the batch drove the occupancy histogram + stall counters
+            exposition = REGISTRY.expose()
+            assert "cedar_batch_occupancy_bucket" in exposition
+            assert "cedar_pipeline_stall_seconds_total" in exposition
+        finally:
+            server.stop()
