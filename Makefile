@@ -49,6 +49,10 @@ bench-cache: ## Decision-cache microbenchmark: Zipf SAR replay, hit ratio + cach
 bench-pipeline: ## Pipelined vs serial engine: decisions/sec + lone-request p50/p99 on one policy set (cpu; docs/performance.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --pipeline
 
+.PHONY: bench-shadow
+bench-shadow: ## Shadow-rollout overhead: live p50/p99 + saturated throughput at 0/10/100% shadow sampling (cpu; docs/rollout.md)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --shadow
+
 .PHONY: hw-validate
 hw-validate: ## Measure kernel planes (int8/bf16/pallas/segred) on the attached device
 	$(PYTHON) tools/hw_validate.py
@@ -67,7 +71,7 @@ graft-check: ## Compile-check the jittable entry + multi-chip dry run
 
 # scoped to the layers with the strongest invariants first; widen as
 # modules are annotated
-LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang
+LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout
 
 .PHONY: lint
 lint: ## ruff + mypy over $(LINT_SCOPE) (missing tools are skipped with a note)

@@ -834,6 +834,216 @@ def run_pipeline_scenario() -> int:
     return 0 if (result["speedup_ok"] and lone_ok) else 1
 
 
+def run_shadow_scenario() -> int:
+    """``bench.py --shadow`` (``make bench-shadow``): proves shadow
+    evaluation is off the hot path. One WebhookServer (engine-backed
+    authorizer, no decision cache so the measured path is the real
+    evaluation) serves the SAME SAR stream at shadow sampling 0%, 10% and
+    100% against a staged candidate that inverts a known decision. Three
+    measurements per rate:
+
+      * lone-request p50/p99 — sequential handle_authorize calls; the
+        acceptance claim is p99 parity at 100% sampling (the offer() hook
+        is a sampling check + put_nowait, never a wait);
+      * saturated throughput — 4 driver threads pushing the stream
+        concurrently; the claim is a <= 5% delta at 100% sampling (shadow
+        work sheds under pressure rather than slowing the live path);
+      * the diff report — the candidate's inverted decision must actually
+        surface, proving the shadow plane was live during the runs.
+
+    cpu-only by design (the overhead claim must not hide behind device
+    speed). rc 0 iff p99 parity holds (<= 1.5x + window noise, the
+    pipeline bench's tolerance) and the throughput delta is <= 5%."""
+    import statistics
+    import threading
+
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.lang import PolicySet
+    from cedar_tpu.rollout import RolloutController
+    from cedar_tpu.server.admission import (
+        CedarAdmissionHandler,
+        allow_all_admission_policy_store,
+    )
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.server.http import WebhookServer
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    t0 = time.time()
+    n_policies = _n(1000, 120)
+    n_requests = _n(4000, 600)
+    # drivers = host cores: enough concurrency to saturate the serving
+    # path without adding oversubscription noise of its own
+    DRIVERS = max(2, min(4, os.cpu_count() or 2))
+
+    ps, users, nss, resources, verbs, groups = build_policy_set(n_policies)
+    # candidate = live corpus + one decision-inverting forbid: user-0's
+    # allowed requests flip allow->deny, everything else is unchanged
+    cand = PolicySet()
+    for p in ps.policies():
+        cand.add(p, policy_id=p.policy_id)
+    for i, p in enumerate(
+        PolicySet.from_source(
+            f'forbid(principal, action, resource) when '
+            f'{{ principal.name == "{users[0]}" }};',
+            "bench-candidate",
+        ).policies()
+    ):
+        cand.add(p, policy_id=f"bench-candidate.policy{i}")
+
+    engine = TPUPolicyEngine(name="authorization")
+    engine.load([ps], warm="off")
+    store = MemoryStore("bench", ps)
+    stores = TieredPolicyStores([store])
+    authorizer = CedarWebhookAuthorizer(
+        stores,
+        evaluate=engine.evaluate,
+        evaluate_batch=engine.evaluate_batch,
+    )
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores([store, allow_all_admission_policy_store()])
+    )
+    # queue sized so true saturation actually engages the shed-first
+    # contract (the production default 1024 would absorb a whole smoke
+    # round without ever filling)
+    rollout = RolloutController(
+        authz_engine=engine, sample_rate=0.0, queue_depth=256
+    )
+    server = WebhookServer(authorizer, handler, rollout=rollout)
+    rollout.stage(tiers=[cand], description="bench-candidate", warm="off")
+
+    rng = random.Random(5)
+    stream = []
+    for _ in range(n_requests):
+        sar = {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": rng.choice(users[:32]),  # user-0 well represented
+                "uid": "u",
+                "groups": [rng.choice(groups)],
+                "resourceAttributes": {
+                    "verb": rng.choice(verbs),
+                    "version": "v1",
+                    "resource": rng.choice(resources),
+                    "namespace": rng.choice(nss),
+                },
+            },
+        }
+        stream.append(json.dumps(sar).encode())
+
+    def pct(lat, q):
+        lat = sorted(lat)
+        return lat[min(len(lat) - 1, int(len(lat) * q))]
+
+    # Interleaved protocol: every round measures ALL rates back-to-back
+    # (latency loop + saturated wall per rate), so ambient load drift on
+    # the shared bench cores lands on every rate roughly equally; the
+    # overhead claims compare WITHIN-round pairs, not populations measured
+    # minutes apart (the pipeline bench alternates modes for the same
+    # reason). Warm everything — live shapes AND shadow batch shapes — at
+    # full sampling once before any timing.
+    RATES = (0.0, 0.1, 1.0)
+    rollout.set_sample_rate(1.0)
+    for body in stream[: _n(400, 120)]:
+        server.handle_authorize(body)
+    rollout.drain(60)
+
+    lat_rounds = {r: {"p50": [], "p99": []} for r in RATES}
+    wall_rounds = {r: [] for r in RATES}
+    slices = [stream[i::DRIVERS] for i in range(DRIVERS)]
+    # smoke walls are short (~1s) so their relative noise is larger;
+    # more rounds buy the median robustness the full run gets from
+    # longer walls
+    ROUNDS = _n(3, 5)
+    for _round in range(ROUNDS):
+        # rotate the within-round order so no rate systematically enjoys
+        # the warmest (or coldest) slot of every round
+        order = RATES[_round % len(RATES):] + RATES[: _round % len(RATES)]
+        for rate in order:
+            rollout.set_sample_rate(rate)
+            # lone-request latency: each sample is followed by a shadow
+            # drain, so the timing isolates the live answer's critical
+            # path (is the offer hook really non-blocking?) instead of
+            # re-measuring co-tenancy with an artificial backlog — a
+            # back-to-back loop is saturation, and saturation is the
+            # throughput gate's job below
+            rl = []
+            for body in stream[: _n(400, 120)]:
+                t = time.monotonic()
+                server.handle_authorize(body)
+                rl.append(time.monotonic() - t)
+                rollout.drain(5)
+            lat_rounds[rate]["p50"].append(pct(rl, 0.5))
+            lat_rounds[rate]["p99"].append(pct(rl, 0.99))
+
+            def drive(chunk):
+                for body in chunk:
+                    server.handle_authorize(body)
+
+            threads = [
+                threading.Thread(target=drive, args=(s,)) for s in slices
+            ]
+            t = time.monotonic()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall_rounds[rate].append(time.monotonic() - t)
+            rollout.drain(60)
+
+    per_rate = {
+        rate: {
+            "p50_us": round(
+                statistics.median(lat_rounds[rate]["p50"]) * 1e6, 1
+            ),
+            "p99_us": round(
+                statistics.median(lat_rounds[rate]["p99"]) * 1e6, 1
+            ),
+            "saturated_rps": round(
+                n_requests / statistics.median(wall_rounds[rate])
+            ),
+        }
+        for rate in RATES
+    }
+
+    report = rollout.report.to_dict()
+    base, full = per_rate[0.0], per_rate[1.0]
+    # per-round PAIRED comparisons: drift between rounds cancels, and the
+    # median across rounds discards one preempted round outright
+    tput_delta = statistics.median(
+        w1 / w0 - 1.0
+        for w0, w1 in zip(wall_rounds[0.0], wall_rounds[1.0])
+    )
+    p99_pairs = list(zip(lat_rounds[0.0]["p99"], lat_rounds[1.0]["p99"]))
+    p99_excess = statistics.median(p1 - p0 for p0, p1 in p99_pairs)
+    # the 1.5x + 200µs tolerance of the pipeline bench, on paired medians
+    p99_ok = p99_excess <= (
+        0.5 * statistics.median(p0 for p0, _ in p99_pairs) + 200e-6
+    )
+    tput_ok = tput_delta <= 0.05
+    result = {
+        "metric": "shadow_overhead_sar",
+        "smoke": _SMOKE,
+        "policies": n_policies,
+        "requests": n_requests,
+        "drivers": DRIVERS,
+        "sampling": {str(r): v for r, v in per_rate.items()},
+        "overhead_p50_us": round(full["p50_us"] - base["p50_us"], 1),
+        "overhead_p99_us": round(full["p99_us"] - base["p99_us"], 1),
+        "saturated_tput_delta_pct": round(tput_delta * 100, 2),
+        "shadow_diffs": report["diffs"],
+        "shadow_evaluations": report["evaluations"],
+        "shadow_shed": report["shed"],
+        "diffs_detected": report["total_diffs"] > 0,
+        "p99_parity_ok": bool(p99_ok),
+        "tput_delta_ok": bool(tput_ok),
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps(result))
+    server.stop()
+    return 0 if (p99_ok and tput_ok and result["diffs_detected"]) else 1
+
+
 def _timed(fn):
     t = time.time()
     fn()
@@ -1539,6 +1749,29 @@ if __name__ == "__main__":
 
         jax.config.update("jax_cpu_enable_async_dispatch", True)
         sys.exit(run_pipeline_scenario())
+
+    if "--shadow" in sys.argv:
+        # shadow-rollout overhead proof (make bench-shadow): cpu-only BY
+        # DESIGN — the off-hot-path claim must hold without device speed
+        # hiding the offer()/queue cost in noise. Same stage-isolation
+        # env as the pipeline bench (see its comment block): on the
+        # ~2-shared-core bench host, multithreaded XLA turns every
+        # (live driver x shadow worker) overlap into scheduler thrash
+        # and the 5%-delta gate into a noise lottery; single-threaded
+        # XLA calls make the comparison measure the execution model.
+        os.environ.setdefault("CEDAR_NATIVE_THREADS", "1")
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_cpu_multi_thread_eigen" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_cpu_multi_thread_eigen=false"
+            ).strip()
+        from cedar_tpu.jaxenv import force_cpu
+
+        force_cpu()
+        import jax
+
+        jax.config.update("jax_cpu_enable_async_dispatch", True)
+        sys.exit(run_shadow_scenario())
 
     if "--cache" in sys.argv:
         # decision-cache microbenchmark (make bench-cache): cpu-only BY

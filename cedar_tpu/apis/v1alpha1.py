@@ -129,6 +129,7 @@ class PolicyObject:
     name: str = ""
     uid: str = ""
     annotations: Dict[str, str] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
     spec: PolicySpec = field(default_factory=PolicySpec)
 
     @classmethod
@@ -139,6 +140,7 @@ class PolicyObject:
             name=meta.get("name", ""),
             uid=meta.get("uid", ""),
             annotations=dict(meta.get("annotations", {}) or {}),
+            labels=dict(meta.get("labels", {}) or {}),
             spec=PolicySpec(
                 content=spec.get("content", ""),
                 validation=PolicyValidation.from_dict(spec.get("validation")),
@@ -153,6 +155,7 @@ class PolicyObject:
                 "name": self.name,
                 **({"uid": self.uid} if self.uid else {}),
                 **({"annotations": self.annotations} if self.annotations else {}),
+                **({"labels": self.labels} if self.labels else {}),
             },
             "spec": {
                 "validation": {"enforced": self.spec.validation.enforced},

@@ -1,0 +1,216 @@
+"""cedar-shadow: offline decision diffing of recorded traffic against a
+candidate policy set.
+
+The live webhook's recorder middleware (server/recorder.py) persists every
+POST body as ``req-<endpoint>-<fingerprint>-<unixnano>.json``. This CLI
+replays those recordings through BOTH a live store stack (the StoreConfig
+the server runs with) and a candidate set (a directory of *.cedar files or
+an inline file), and prints the same decision-diff report the live
+server's shadow evaluator accumulates at /debug/rollout — so an operator
+can answer "what would this candidate have decided about yesterday's
+traffic" without staging anything on the serving path.
+
+Both sides evaluate on the interpreter oracle: offline throughput is not
+the point, bit-exact decision parity with the stores is. The candidate is
+gated by the same static analysis as a live stage (strict by default) so
+a candidate the server would refuse to stage also fails here, with the
+same findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from ..rollout.report import (
+    DiffReport,
+    compare_admission,
+    compare_authorization,
+)
+
+
+def _build_live(config_path: str):
+    """(authorizer, admission handler) over the live StoreConfig —
+    interpreter oracle, waiting for initial store loads like cedar-replay."""
+    import time
+
+    from ..server.admission import (
+        CedarAdmissionHandler,
+        allow_all_admission_policy_store,
+    )
+    from ..server.authorizer import CedarWebhookAuthorizer
+    from ..stores.config import cedar_config_stores, parse_config
+    from ..stores.store import TieredPolicyStores
+
+    with open(config_path) as f:
+        config = parse_config(f.read())
+    stores = cedar_config_stores(config)
+    deadline = time.time() + 30
+    while not all(s.initial_policy_load_complete() for s in stores):
+        if time.time() > deadline:
+            raise RuntimeError("live stores not ready after 30s")
+        time.sleep(0.2)
+    authorizer = CedarWebhookAuthorizer(stores)
+    admission = CedarAdmissionHandler(
+        TieredPolicyStores(
+            list(stores.stores) + [allow_all_admission_policy_store()]
+        )
+    )
+    return authorizer, admission
+
+
+def _build_candidate(directory: str, validation_mode: str):
+    """(authorizer, admission handler) over the candidate directory,
+    through the same stage gate and stack-store assembly a live rollout
+    applies (rollout/controller.candidate_stores)."""
+    from ..analysis.loadgate import AnalysisRejected, enforce
+    from ..rollout.controller import candidate_stores
+    from ..rollout.source import candidate_tiers_from_directory
+    from ..server.admission import CedarAdmissionHandler
+    from ..server.authorizer import CedarWebhookAuthorizer
+
+    tiers = candidate_tiers_from_directory(directory)
+    if validation_mode:
+        try:
+            tiers, _report = enforce(tiers, validation_mode, publish=False)
+        except AnalysisRejected as e:
+            raise RuntimeError(f"candidate rejected by analysis: {e}")
+    authz_stores, admission_stores = candidate_stores(tiers)
+    return (
+        CedarWebhookAuthorizer(authz_stores),
+        CedarAdmissionHandler(admission_stores),
+    )
+
+
+def _load_recordings(paths) -> List[tuple]:
+    """[(filename, endpoint, body)] — endpoint inferred from the recorded
+    name like cli/replay.py."""
+    import pathlib
+
+    files: List[pathlib.Path] = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.glob("req-*.json")))
+        else:
+            files.append(path)
+    out = []
+    for f in files:
+        endpoint = "authorize" if "authorize" in f.name else "admit"
+        out.append((f.name, endpoint, f.read_bytes()))
+    return out
+
+
+def diff_recordings(recordings, live, candidate, exemplar_cap: int = 64):
+    """Replay every recording through both stacks and accumulate the diff
+    report — the offline twin of rollout/shadow.py's comparison, sharing
+    its classify/record/fingerprint implementation
+    (rollout/report.compare_*) so the two reports cannot drift."""
+    from ..entities.admission import AdmissionRequest
+    from ..server.http import get_authorizer_attributes
+
+    live_authorizer, live_admission = live
+    cand_authorizer, cand_admission = candidate
+    report = DiffReport(exemplar_cap=exemplar_cap)
+    for _name, endpoint, body in recordings:
+        if endpoint == "authorize":
+            try:
+                attributes = get_authorizer_attributes(json.loads(body))
+            except Exception:  # noqa: BLE001 — unkeyable rows are skipped
+                report.record_skipped("authorization")
+                continue
+            compare_authorization(
+                report,
+                attributes,
+                live_authorizer.authorize(attributes),
+                cand_authorizer.authorize(attributes),
+            )
+        else:
+            try:
+                req = AdmissionRequest.from_admission_review(json.loads(body))
+            except Exception:  # noqa: BLE001 — unkeyable rows are skipped
+                report.record_skipped("admission")
+                continue
+            live_resp = live_admission.handle(req)
+            cand_resp = cand_admission.handle(req)
+            compare_admission(
+                report,
+                req,
+                (live_resp.allowed, live_resp.message or ""),
+                (cand_resp.allowed, cand_resp.message or ""),
+            )
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cedar-shadow",
+        description="Replay recorded webhook requests against a candidate "
+        "policy set and report decision diffs (docs/rollout.md)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="+",
+        help="recording files or directories (req-*.json)",
+    )
+    parser.add_argument(
+        "--config",
+        required=True,
+        help="StoreConfig of the LIVE policy stores (the baseline)",
+    )
+    parser.add_argument(
+        "--candidate-dir",
+        required=True,
+        help="directory of *.cedar files forming the candidate set",
+    )
+    parser.add_argument(
+        "--validation-mode",
+        default="strict",
+        choices=["", "strict", "permissive", "partial"],
+        help="analysis gate applied to the candidate before replay "
+        "(default strict, matching a live stage; '' disables)",
+    )
+    parser.add_argument(
+        "--exemplar-cap",
+        type=int,
+        default=64,
+        help="max diff exemplars retained in the report",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the full diff report as JSON instead of text",
+    )
+    parser.add_argument(
+        "--fail-on-diff",
+        action="store_true",
+        help="exit nonzero when any decision diff is found (CI gating)",
+    )
+    args = parser.parse_args(argv)
+
+    recordings = _load_recordings(args.paths)
+    if not recordings:
+        print("no recordings found", file=sys.stderr)
+        return 1
+    try:
+        live = _build_live(args.config)
+        candidate = _build_candidate(args.candidate_dir, args.validation_mode)
+    except Exception as e:  # noqa: BLE001 — setup failures are user errors
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    report = diff_recordings(
+        recordings, live, candidate, exemplar_cap=args.exemplar_cap
+    )
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(report.render_text())
+    if args.fail_on_diff and report.total_diffs:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

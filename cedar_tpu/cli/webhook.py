@@ -272,14 +272,16 @@ def build_server(args) -> WebhookServer:
         return tier_engine, evaluate, evaluate_batch
 
     evaluate = None
+    evaluate_batch = None
     engine = None
+    admission_engine = None
     reloader = None
     authz_breaker = None
     if args.backend == "tpu" and not len(stores.stores):
         log.warning("TPU backend requested but no stores configured; using interpreter")
     elif args.backend == "tpu":
         authz_breaker = _make_breaker("authorization")
-        engine, evaluate, _ = _tpu_backend(
+        engine, evaluate, evaluate_batch = _tpu_backend(
             stores, breaker=authz_breaker, name="authorization"
         )
         reloader = TPUReloader(
@@ -288,7 +290,9 @@ def build_server(args) -> WebhookServer:
             interval_s=args.tpu_reload_seconds,
         )
 
-    authorizer = CedarWebhookAuthorizer(stores, evaluate=evaluate)
+    authorizer = CedarWebhookAuthorizer(
+        stores, evaluate=evaluate, evaluate_batch=evaluate_batch
+    )
 
     fastpath = None
     if engine is not None and not args.no_native:
@@ -379,6 +383,69 @@ def build_server(args) -> WebhookServer:
                 path="admission",
             )
 
+    # shadow rollout (cedar_tpu/rollout, docs/rollout.md): staged candidate
+    # policy sets shadow-evaluated against live traffic, with atomic
+    # promote/rollback over the engines' compiled sets. Wired only with the
+    # TPU backend — promotion swaps compiled sets, which the interpreter
+    # path doesn't have.
+    rollout = None
+    rollout_control_enabled = True
+    rollout_control_token = None
+    if args.rollout_control_token_file:
+        with open(args.rollout_control_token_file) as f:
+            rollout_control_token = f.read().strip()
+        if not rollout_control_token:
+            raise ValueError(
+                "--rollout-control-token-file is empty: refusing to serve "
+                "unauthenticated rollout control by accident"
+            )
+    elif not args.rollout_insecure_control:
+        # secure default: without a token (or the explicit insecure
+        # opt-in) the mutating lifecycle endpoints answer 403; startup
+        # staging via --rollout-candidate-dir still works, and
+        # /debug/rollout stays readable
+        rollout_control_enabled = False
+    if engine is not None:
+        from ..rollout import RolloutController
+
+        def _crd_candidates():
+            """Candidate-labeled Policy objects across every CRD-backed
+            store tier (the stores withhold them from live serving);
+            POST /rollout/stage {"crd": true} builds the candidate
+            corpus from them."""
+            out = []
+            for s in stores.stores:
+                candidates = getattr(s, "candidate_objects", None)
+                if candidates is not None:
+                    out.extend(candidates())
+            return out
+
+        rollout = RolloutController(
+            authz_engine=engine,
+            admission_engine=admission_engine,
+            sample_rate=args.shadow_sample_rate,
+            queue_depth=args.shadow_queue_depth,
+            duty_cycle=args.shadow_duty_cycle,
+            crd_candidate_provider=_crd_candidates,
+        )
+        if args.rollout_candidate_dir:
+            try:
+                rollout.stage(directory=args.rollout_candidate_dir)
+                log.info(
+                    "staged rollout candidate from %s",
+                    args.rollout_candidate_dir,
+                )
+            except Exception:  # noqa: BLE001 — a bad candidate must not
+                # block serving; the operator re-stages via /rollout/stage
+                log.exception(
+                    "failed to stage rollout candidate from %s",
+                    args.rollout_candidate_dir,
+                )
+    elif args.rollout_candidate_dir:
+        log.warning(
+            "--rollout-candidate-dir requires --backend tpu; ignoring"
+        )
+
     admission_fail_open = args.admission_fail_mode == "open"
     admission_handler = CedarAdmissionHandler(
         admission_stores,
@@ -454,6 +521,9 @@ def build_server(args) -> WebhookServer:
         drain_grace_s=args.shutdown_grace_seconds,
         analysis_provider=analysis_provider,
         decision_cache=decision_cache,
+        rollout=rollout,
+        rollout_control_enabled=rollout_control_enabled,
+        rollout_control_token=rollout_control_token,
     )
 
 
@@ -638,6 +708,54 @@ def make_parser() -> argparse.ArgumentParser:
         help="opt-in admission decision caching, gated to read-only "
         "idempotent reviews (CONNECT operations and dryRun requests); "
         "mutating reviews always evaluate",
+    )
+
+    rollout = parser.add_argument_group("shadow rollout")
+    rollout.add_argument(
+        "--rollout-candidate-dir",
+        default="",
+        help="stage a candidate policy set from this directory of *.cedar "
+        "files at startup (shadow evaluation starts immediately; promotion "
+        "stays manual via POST /rollout/promote on the metrics port). "
+        "Requires --backend tpu (docs/rollout.md)",
+    )
+    rollout.add_argument(
+        "--shadow-sample-rate",
+        type=float,
+        default=1.0,
+        help="fraction of live traffic shadow-evaluated against the staged "
+        "candidate (0.0-1.0); sampling happens before the queue, so lower "
+        "rates also shrink shadow CPU cost proportionally",
+    )
+    rollout.add_argument(
+        "--shadow-queue-depth",
+        type=int,
+        default=1024,
+        help="bounded shadow-evaluation queue; full-queue offers are shed "
+        "(cedar_shadow_shed_total) rather than ever delaying live answers",
+    )
+    rollout.add_argument(
+        "--shadow-duty-cycle",
+        type=float,
+        default=0.1,
+        help="max fraction of one core the shadow worker may consume; "
+        "under pressure the queue backs up and sheds so live serving "
+        "never loses cpu to shadow evaluation (docs/rollout.md)",
+    )
+    rollout.add_argument(
+        "--rollout-control-token-file",
+        default="",
+        help="file holding a bearer token required by the mutating "
+        "rollout endpoints (POST /rollout/stage|promote|rollback). With "
+        "neither this nor --rollout-insecure-control, those endpoints "
+        "answer 403 — a staged allow-all + promote is a cluster "
+        "authorization takeover, and the metrics listener is plain HTTP",
+    )
+    rollout.add_argument(
+        "--rollout-insecure-control",
+        action="store_true",
+        help="allow UNAUTHENTICATED rollout lifecycle POSTs on the "
+        "metrics listener (trusted-loopback deployments only)",
     )
 
     gameday = parser.add_argument_group("gameday")

@@ -108,6 +108,23 @@ def _join_warm_threads_at_exit() -> None:
 atexit.register(_join_warm_threads_at_exit)
 
 
+def track_warm_thread(t: threading.Thread) -> None:
+    """Register an external warm-up thread (e.g. the shadow rollout's
+    candidate warmer) with the atexit join above: any daemon thread that
+    may sit inside an XLA call at interpreter teardown aborts the whole
+    process otherwise. The thread's target must poll warm_shutdown_set()
+    (warmup() does) so the join cannot hang."""
+    _live_warm_threads.add(t)
+
+
+def untrack_warm_thread(t: threading.Thread) -> None:
+    _live_warm_threads.discard(t)
+
+
+def warm_shutdown_set() -> bool:
+    return _shutdown.is_set()
+
+
 class WireSpanError(ValueError):
     """A feature code fell outside its slot's u8 wire span (see
     _CompiledSet.pack_wire); the flat code layout must be used instead."""
@@ -653,6 +670,7 @@ class TPUPolicyEngine:
         self,
         max_batch: Optional[int] = None,
         extras_widths: Optional[Sequence[int]] = None,
+        should_continue=None,
     ) -> dict:
         """Synchronously precompile EVERY (batch-bucket x extras-bucket)
         kernel plane up to max_batch (default warm_max_batch) for the
@@ -663,7 +681,13 @@ class TPUPolicyEngine:
         "traces"} — traces is the number of fresh kernel compiles
         (ops.match.kernel_trace_count delta; 0 means everything was
         already warm, e.g. a same-bucket hot swap). Publishes the elapsed
-        time as cedar_engine_warmup_seconds{engine=self.name}."""
+        time as cedar_engine_warmup_seconds{engine=self.name}.
+
+        should_continue: optional () -> bool polled between shapes; False
+        stops the ladder early. Callers warming a set that can be
+        superseded mid-ladder (the shadow rollout's candidate warmer)
+        pass their liveness check here — on a small host an orphaned
+        ladder of compiles steals the cpu live requests need."""
         from ..ops.match import kernel_trace_count
 
         cs = self._compiled
@@ -673,7 +697,9 @@ class TPUPolicyEngine:
         tc0 = kernel_trace_count()
         shapes = self._warm_shape_plan(cs.packed, max_batch, extras_widths)
         for kind, b, E in shapes:
-            if _shutdown.is_set():
+            if _shutdown.is_set() or (
+                should_continue is not None and not should_continue()
+            ):
                 break
             self._warm_one(cs, kind, b, E)
         self._warm_first.set()
@@ -689,6 +715,53 @@ class TPUPolicyEngine:
             "seconds": round(elapsed, 3),
             "traces": kernel_trace_count() - tc0,
         }
+
+    @property
+    def compiled_set(self):
+        """The live _CompiledSet (None before the first load). Exposed for
+        the shadow-rollout subsystem, which moves compiled sets between a
+        candidate engine and the serving engine at promotion; treat the
+        object as opaque and immutable."""
+        return self._compiled
+
+    def adopt_compiled(self, compiled, donor=None) -> tuple:
+        """Atomically swap in an externally compiled set — the shadow
+        rollout's promotion/rollback primitive (cedar_tpu/rollout). Unlike
+        load() this performs NO compilation: the set was compiled (and its
+        kernel planes warmed) by a candidate engine sharing this engine's
+        backend/device settings, so the jitted executables are already in
+        the shared kernel cache and the first post-swap request pays no
+        trace. Bumps load_generation (decision-cache composite generations
+        fold it in, so every pre-swap entry dies) and latches warm
+        readiness. Returns (prior compiled set, new load_generation); the
+        prior set stays device-resident, so handing it back to
+        adopt_compiled later (rollback) is also compile-free.
+
+        donor: the engine that compiled/warmed `compiled`. On MESH
+        deployments the pjit evaluation steps are cached per engine
+        instance keyed (n_tiers, has_gate); without transplanting the
+        donor's entries, a candidate whose tier count differs from the
+        live set's would miss this engine's cache and the first post-swap
+        request would pay a fresh pjit trace — exactly the cold-swap cost
+        adoption exists to avoid. Single-device engines share the
+        module-level jit caches and need no transplant."""
+        if compiled is None:
+            raise ValueError("adopt_compiled: compiled set required")
+        if (
+            donor is not None
+            and self.mesh is not None
+            and donor.mesh is self.mesh
+        ):
+            self._mesh_steps.update(donor._mesh_steps)
+            if self._mesh_bits_step is None:
+                self._mesh_bits_step = donor._mesh_bits_step
+        with self._lock:
+            prior = self._compiled
+            self._compiled = compiled
+            self.load_generation += 1
+            generation = self.load_generation
+        self._warm_first.set()
+        return prior, generation
 
     def _mesh_step(self, packed: PackedPolicySet):
         """The cached pjit evaluation step for this mesh + set shape."""

@@ -48,6 +48,31 @@ _TR = 512
 _TK = 2048
 
 
+def _tpu_compiler_params(**kwargs):
+    """Construct the pallas TPU compiler-params object under either API
+    spelling: newer jax exposes ``pltpu.CompilerParams``, older releases
+    ``pltpu.TPUCompilerParams``. Feature-detected (never version-sniffed)
+    so the same wheel works across the drift; unknown fields are dropped
+    rather than raising, since every field we pass is a tuning hint, not a
+    correctness requirement. Returns None when neither class exists —
+    callers then omit compiler_params entirely."""
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if cls is None:
+        return None
+    try:
+        return cls(**kwargs)
+    except TypeError:
+        import dataclasses
+
+        try:
+            names = {f.name for f in dataclasses.fields(cls)}
+        except TypeError:
+            return None
+        return cls(**{k: v for k, v in kwargs.items() if k in names})
+
+
 def _kernel(
     lit_ref, w_ref, thresh_ref, group_ref, policy_ref, out_ref, last_out_ref,
     score_ref, acc_ref, last_ref, *, n_groups: int, g_pad: int
@@ -137,6 +162,12 @@ def pallas_first_match(
     grid = (B // tb, R // tr, L // tk)
     kernel = functools.partial(_kernel, n_groups=n_groups, g_pad=g_pad)
 
+    call_kwargs = {}
+    cp = _tpu_compiler_params(
+        dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+    )
+    if cp is not None:
+        call_kwargs["compiler_params"] = cp
     out, last = pl.pallas_call(
         kernel,
         out_shape=[
@@ -174,9 +205,6 @@ def pallas_first_match(
             pltpu.VMEM((tb, g_pad), jnp.int32),
             pltpu.VMEM((tb, g_pad), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
-        ),
         cost_estimate=pl.CostEstimate(
             flops=2 * B * L * R,
             bytes_accessed=B * L * in_bytes + L * R * in_bytes
@@ -184,6 +212,7 @@ def pallas_first_match(
             transcendentals=0,
         ),
         interpret=interpret,
+        **call_kwargs,
     )(lit, W, thresh_r, group_r, policy_r)
     return out[:, :n_groups], last[:, :n_groups]
 

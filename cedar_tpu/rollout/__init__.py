@@ -1,0 +1,58 @@
+"""Shadow rollout: staged candidate policy sets, live-traffic decision
+diffing, and atomic promotion (docs/rollout.md).
+
+Operators cannot safely change Cedar policies on a cluster-critical
+authorizer by editing the live store: a bad edit flips real
+kubelet/controller decisions the instant the store reloads. This
+subsystem closes that gap with a three-phase rollout:
+
+  1. **stage** — compile a *candidate* policy set alongside the live one
+     (its own TPUPolicyEngine, warmed through the existing warmup()
+     ladder, entirely off the hot path), gated by the static analyzer so
+     unlowerable/conflicting candidates are rejected before they can
+     shadow anything (analysis/loadgate.py).
+  2. **shadow** — asynchronously re-evaluate a configurable sample of
+     live authorize/admit traffic against the candidate and accumulate a
+     decision-diff report (allow→deny / deny→allow / decision-changed /
+     reason-changed counts plus a capped exemplar ring keyed by the same
+     canonical fingerprints the decision cache uses). Shadow work rides a
+     bounded best-effort queue, bypasses the decision cache, and is shed
+     first under pressure — it can never add latency to the live answer.
+  3. **promote / rollback** — promotion atomically swaps the candidate's
+     pre-warmed compiled set into the live engines (zero new jit traces)
+     and bumps their load generation, which kills every pre-promotion
+     decision-cache entry through the existing cache_generation()
+     composite; rollback restores the prior compiled set the same way,
+     without recompiling anything.
+"""
+
+from .controller import RolloutController, RolloutError
+from .report import (
+    DIFF_ALLOW_TO_DENY,
+    DIFF_DECISION_CHANGED,
+    DIFF_DENY_TO_ALLOW,
+    DIFF_REASON_CHANGED,
+    DiffReport,
+    classify_decision_diff,
+)
+from .shadow import ShadowEvaluator
+from .source import (
+    candidate_tiers_from_directory,
+    candidate_tiers_from_objects,
+    candidate_tiers_from_source,
+)
+
+__all__ = [
+    "RolloutController",
+    "RolloutError",
+    "DiffReport",
+    "ShadowEvaluator",
+    "classify_decision_diff",
+    "candidate_tiers_from_directory",
+    "candidate_tiers_from_objects",
+    "candidate_tiers_from_source",
+    "DIFF_ALLOW_TO_DENY",
+    "DIFF_DENY_TO_ALLOW",
+    "DIFF_DECISION_CHANGED",
+    "DIFF_REASON_CHANGED",
+]

@@ -1,0 +1,268 @@
+"""Decision-diff accounting for shadow evaluation.
+
+One DiffReport accumulates everything an operator needs to judge a
+candidate policy set before promotion: per-path evaluation/shed totals,
+per-kind diff counters, and a capped exemplar ring of the actual diffing
+requests keyed by their canonical fingerprint (cache/fingerprint.py — the
+same key the live decision cache and the request recorder stamp, so an
+exemplar can be joined against recordings and cache entries directly).
+
+Diff kinds:
+
+  * ``allow_to_deny``    — live allowed, candidate denies (the dangerous
+                           direction: promoting breaks working callers)
+  * ``deny_to_allow``    — live denies, candidate allows (a permission
+                           widening; verify it is intentional)
+  * ``decision_changed`` — any other decision flip (NoOpinion transitions,
+                           which change which authorizer in the apiserver
+                           chain decides)
+  * ``reason_changed``   — same decision, different reason payload (policy
+                           ids / matched sets moved; harmless for callers
+                           but signals the deciding policy changed)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+DIFF_ALLOW_TO_DENY = "allow_to_deny"
+DIFF_DENY_TO_ALLOW = "deny_to_allow"
+DIFF_DECISION_CHANGED = "decision_changed"
+DIFF_REASON_CHANGED = "reason_changed"
+
+DIFF_KINDS = (
+    DIFF_ALLOW_TO_DENY,
+    DIFF_DENY_TO_ALLOW,
+    DIFF_DECISION_CHANGED,
+    DIFF_REASON_CHANGED,
+)
+
+# exemplar ring default: enough to characterize every diff class a bad
+# candidate produces without letting a 100%-diffing candidate grow memory
+DEFAULT_EXEMPLAR_CAP = 64
+
+
+def classify_decision_diff(
+    live_decision: str,
+    live_reason: str,
+    cand_decision: str,
+    cand_reason: str,
+) -> Optional[str]:
+    """The diff kind for one (live, candidate) result pair, or None when
+    the candidate reproduces the live answer exactly. Decisions are the
+    webhook decision strings ("allow"/"deny"/"no_opinion") for
+    authorization and "allow"/"deny" for admission."""
+    if live_decision != cand_decision:
+        if live_decision == "allow" and cand_decision == "deny":
+            return DIFF_ALLOW_TO_DENY
+        if live_decision == "deny" and cand_decision == "allow":
+            return DIFF_DENY_TO_ALLOW
+        return DIFF_DECISION_CHANGED
+    if live_reason != cand_reason:
+        return DIFF_REASON_CHANGED
+    return None
+
+
+def compare_authorization(
+    report: "DiffReport",
+    attributes,
+    live,
+    cand,
+    publish_metrics: bool = False,
+) -> Optional[str]:
+    """Classify one (live, candidate) authorization result pair —
+    (decision, reason) tuples — and record it into the report. The ONE
+    compare/record definition shared by the live shadow worker
+    (rollout/shadow.py) and the offline cedar-shadow CLI, so their
+    reports can never drift. publish_metrics additionally feeds the
+    cedar_shadow_* counters (live serving only — offline replay must not
+    touch process metrics)."""
+    mod = None
+    if publish_metrics:
+        from ..server import metrics as mod
+    if mod is not None:
+        mod.record_shadow_evaluation("authorization")
+    kind = classify_decision_diff(live[0], live[1], cand[0], cand[1])
+    if kind is None:
+        report.record_match("authorization")
+        return None
+    from ..cache.fingerprint import fingerprint_attributes
+
+    report.record_diff(
+        "authorization",
+        kind,
+        fingerprint_attributes(attributes),
+        {"decision": live[0], "reason": live[1]},
+        {"decision": cand[0], "reason": cand[1]},
+    )
+    if mod is not None:
+        mod.record_shadow_diff(kind)
+    return kind
+
+
+def compare_admission(
+    report: "DiffReport",
+    req,
+    live,
+    cand,
+    publish_metrics: bool = False,
+) -> Optional[str]:
+    """Admission twin of compare_authorization; live/cand are
+    (allowed: bool, message: str) pairs and req is the parsed
+    AdmissionRequest (its canonical fingerprint keys the exemplar)."""
+    mod = None
+    if publish_metrics:
+        from ..server import metrics as mod
+    if mod is not None:
+        mod.record_shadow_evaluation("admission")
+    kind = classify_decision_diff(
+        "allow" if live[0] else "deny",
+        live[1],
+        "allow" if cand[0] else "deny",
+        cand[1],
+    )
+    if kind is None:
+        report.record_match("admission")
+        return None
+    from ..cache.fingerprint import fingerprint_admission_request
+
+    report.record_diff(
+        "admission",
+        kind,
+        fingerprint_admission_request(req),
+        {"allowed": live[0], "message": live[1]},
+        {"allowed": cand[0], "message": cand[1]},
+    )
+    if mod is not None:
+        mod.record_shadow_diff(kind)
+    return kind
+
+
+class DiffReport:
+    """Thread-safe shadow-evaluation tallies + exemplar ring.
+
+    Counters also feed the Prometheus metrics (cedar_shadow_*), but the
+    report is the authoritative per-rollout view: metrics are cumulative
+    across rollouts while a report resets at stage time."""
+
+    def __init__(self, exemplar_cap: int = DEFAULT_EXEMPLAR_CAP):
+        self._lock = threading.Lock()
+        self.started_at = time.time()
+        self.evaluations: dict = {}  # path -> count
+        self.matches: dict = {}  # path -> count
+        self.diffs: dict = {}  # kind -> count
+        self.shed: dict = {}  # path -> count
+        self.skipped: dict = {}  # path -> count (unparseable / live errors)
+        self.errors = 0  # candidate-side evaluation crashes
+        self._exemplars: deque = deque(maxlen=max(1, int(exemplar_cap)))
+
+    # ------------------------------------------------------------ recording
+
+    def record_match(self, path: str) -> None:
+        with self._lock:
+            self.evaluations[path] = self.evaluations.get(path, 0) + 1
+            self.matches[path] = self.matches.get(path, 0) + 1
+
+    def record_diff(
+        self,
+        path: str,
+        kind: str,
+        fingerprint: str,
+        live,
+        candidate,
+    ) -> None:
+        with self._lock:
+            self.evaluations[path] = self.evaluations.get(path, 0) + 1
+            self.diffs[kind] = self.diffs.get(kind, 0) + 1
+            self._exemplars.append(
+                {
+                    "fingerprint": fingerprint,
+                    "path": path,
+                    "kind": kind,
+                    "live": live,
+                    "candidate": candidate,
+                }
+            )
+
+    def record_shed(self, path: str) -> None:
+        with self._lock:
+            self.shed[path] = self.shed.get(path, 0) + 1
+
+    def record_skipped(self, path: str) -> None:
+        with self._lock:
+            self.skipped[path] = self.skipped.get(path, 0) + 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    # ------------------------------------------------------------- reading
+
+    @property
+    def total_evaluations(self) -> int:
+        with self._lock:
+            return sum(self.evaluations.values())
+
+    @property
+    def total_diffs(self) -> int:
+        with self._lock:
+            return sum(self.diffs.values())
+
+    def exemplars(self) -> list:
+        with self._lock:
+            return list(self._exemplars)
+
+    def diff_fingerprints(self) -> set:
+        """Distinct fingerprints across the exemplar ring — the offline
+        join key against recordings (req-<ep>-<fp>-*.json)."""
+        with self._lock:
+            return {e["fingerprint"] for e in self._exemplars}
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "started_at": self.started_at,
+                "evaluations": dict(self.evaluations),
+                "matches": dict(self.matches),
+                "diffs": {k: self.diffs.get(k, 0) for k in DIFF_KINDS},
+                "total_diffs": sum(self.diffs.values()),
+                "shed": dict(self.shed),
+                "skipped": dict(self.skipped),
+                "candidate_errors": self.errors,
+                "exemplars": list(self._exemplars),
+            }
+
+    def render_text(self) -> str:
+        """Human-readable summary (the cedar-shadow CLI's default output)."""
+        d = self.to_dict()
+        lines = []
+        total = sum(d["evaluations"].values())
+        lines.append(
+            f"# shadow evaluations: {total} "
+            + " ".join(f"{p}={n}" for p, n in sorted(d["evaluations"].items()))
+        )
+        lines.append(
+            "# diffs: "
+            + (
+                " ".join(
+                    f"{k}={n}" for k, n in d["diffs"].items() if n
+                )
+                or "none"
+            )
+        )
+        if d["skipped"]:
+            lines.append(
+                "# skipped: "
+                + " ".join(f"{p}={n}" for p, n in sorted(d["skipped"].items()))
+            )
+        if d["candidate_errors"]:
+            lines.append(f"# candidate errors: {d['candidate_errors']}")
+        for e in d["exemplars"]:
+            lines.append(
+                f"{e['fingerprint']}\t{e['path']}\t{e['kind']}\t"
+                f"live={e['live']}\tcandidate={e['candidate']}"
+            )
+        return "\n".join(lines)

@@ -71,11 +71,16 @@ class CedarWebhookAuthorizer:
         stores: TieredPolicyStores,
         evaluate: Optional[EvaluateFn] = None,
         cache=None,
+        evaluate_batch=None,
     ):
         self.stores = stores
         self._stores_loaded = False
         # pluggable evaluation backend; defaults to tiered interpreter eval
         self._evaluate: EvaluateFn = evaluate or stores.is_authorized
+        # optional batched backend ([(entities, request)] -> [(decision,
+        # diagnostics)]): authorize_batch funnels every non-short-circuited
+        # item through ONE call (one device dispatch on the TPU engine)
+        self._evaluate_batch = evaluate_batch
         # optional decision cache (cedar_tpu/cache DecisionCache) consulted
         # AFTER the short-circuits below and the readiness gate: with
         # attributes already parsed, identity self-allows and system:*
@@ -104,12 +109,10 @@ class CedarWebhookAuthorizer:
         self._stores_loaded = True
         return True
 
-    def authorize(
-        self, attributes: Attributes, use_cache: bool = True
-    ) -> Tuple[str, str]:
-        """Returns (decision, reason). ``use_cache=False`` bypasses the
-        authorizer-level decision cache for callers that already did their
-        own lookup on the same canonical key (the webhook server)."""
+    def _short_circuit(self, attributes: Attributes) -> Optional[Tuple[str, str]]:
+        """The pre-evaluation gates shared by authorize() and
+        authorize_batch(): identity self-allows, system:* skips, and the
+        store-readiness NoOpinion. None means the request must evaluate."""
         user_name = attributes.user.name
         if (
             user_name == CEDAR_AUTHORIZER_IDENTITY_NAME
@@ -141,6 +144,87 @@ class CedarWebhookAuthorizer:
 
         if not self.ready():
             return DECISION_NO_OPINION, ""
+        return None
+
+    @staticmethod
+    def _map_verdict(decision: str, diagnostic: Diagnostics) -> Tuple[str, str]:
+        """Cedar verdict -> (webhook decision, reason) — the mapping at
+        reference authorizer.go:73-84."""
+        if decision == ALLOW:
+            return DECISION_ALLOW, _diagnostic_to_reason(diagnostic)
+        if decision == DENY and diagnostic.reasons:
+            return DECISION_DENY, _diagnostic_to_reason(diagnostic)
+        if diagnostic.errors:
+            log.error("Authorize errors: %s", diagnostic.errors)
+        return DECISION_NO_OPINION, ""
+
+    def authorize_batch(self, attributes_list) -> list:
+        """Batched authorize with per-item semantics identical to
+        authorize(): same gates, readiness check, and verdict mapping. The
+        non-short-circuited items evaluate through ONE evaluate_batch call
+        when a batched backend is wired (one TPU dispatch), per item
+        otherwise. Deliberately bypasses the decision cache — the batch
+        callers (shadow rollout, offline replay) must observe the engine,
+        not the cache. A crashing item answers NoOpinion instead of
+        failing its whole batch."""
+        results: list = [None] * len(attributes_list)
+        build = []  # (index, entities, cedar request)
+        for i, attributes in enumerate(attributes_list):
+            short = self._short_circuit(attributes)
+            if short is not None:
+                results[i] = short
+                continue
+            try:
+                entities, request = record_to_cedar_resource(attributes)
+            except Exception:  # noqa: BLE001 — one bad item must not kill the batch
+                log.exception("authorize_batch entity build failed")
+                results[i] = (DECISION_NO_OPINION, "")
+                continue
+            build.append((i, entities, request))
+        if build:
+            verdicts = None
+            if self._evaluate_batch is not None:
+                try:
+                    verdicts = self._evaluate_batch(
+                        [(em, req) for _, em, req in build]
+                    )
+                    if verdicts is not None and len(verdicts) != len(build):
+                        # zip would silently truncate and leave None rows
+                        # in the result; treat the mismatch like a batch
+                        # failure and re-answer per item
+                        log.error(
+                            "evaluate_batch returned %d results for %d "
+                            "items; per-item fallback",
+                            len(verdicts),
+                            len(build),
+                        )
+                        verdicts = None
+                except Exception:  # noqa: BLE001 — per-item path below answers
+                    log.exception(
+                        "batched evaluation failed; per-item fallback"
+                    )
+            if verdicts is not None:
+                for (i, _, _), (decision, diag) in zip(build, verdicts):
+                    results[i] = self._map_verdict(decision, diag)
+            else:
+                for i, entities, request in build:
+                    try:
+                        decision, diag = self._evaluate(entities, request)
+                        results[i] = self._map_verdict(decision, diag)
+                    except Exception:  # noqa: BLE001 — answer every item
+                        log.exception("authorize_batch evaluation failed")
+                        results[i] = (DECISION_NO_OPINION, "")
+        return results
+
+    def authorize(
+        self, attributes: Attributes, use_cache: bool = True
+    ) -> Tuple[str, str]:
+        """Returns (decision, reason). ``use_cache=False`` bypasses the
+        authorizer-level decision cache for callers that already did their
+        own lookup on the same canonical key (the webhook server)."""
+        short = self._short_circuit(attributes)
+        if short is not None:
+            return short
 
         cache_key = None
         cache_gen = None
@@ -157,14 +241,7 @@ class CedarWebhookAuthorizer:
 
         entities, request = record_to_cedar_resource(attributes)
         decision, diagnostic = self._evaluate(entities, request)
-        if decision == ALLOW:
-            result = DECISION_ALLOW, _diagnostic_to_reason(diagnostic)
-        elif decision == DENY and diagnostic.reasons:
-            result = DECISION_DENY, _diagnostic_to_reason(diagnostic)
-        else:
-            if diagnostic.errors:
-                log.error("Authorize errors: %s", diagnostic.errors)
-            result = DECISION_NO_OPINION, ""
+        result = self._map_verdict(decision, diagnostic)
         # errored evaluations are transient — never cached; everything else
         # is deterministic under the current policy-set generation
         if cache_key is not None and not diagnostic.errors:

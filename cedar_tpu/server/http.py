@@ -205,6 +205,9 @@ class WebhookServer:
         decision_cache=None,
         pipeline_depth: int = 0,
         encode_workers: int = 2,
+        rollout=None,
+        rollout_control_enabled: bool = True,
+        rollout_control_token: Optional[str] = None,
     ):
         self.authorizer = authorizer
         self.admission_handler = admission_handler
@@ -309,6 +312,22 @@ class WebhookServer:
                 capacity=decision_cache.max_entries
             )
             self._sar_flights = SingleFlight("authorization")
+        # shadow-rollout controller (cedar_tpu/rollout RolloutController):
+        # the serving paths hand (body, live answer) pairs to offer() —
+        # a sampling check + put_nowait, shed under pressure — and the
+        # metrics server exposes /debug/rollout plus the
+        # stage/promote/rollback lifecycle endpoints (docs/rollout.md)
+        self.rollout = rollout
+        # the lifecycle POSTs MUTATE live cluster authorization (a staged
+        # allow-all + promote is a policy takeover), while the metrics
+        # listener is plain HTTP: control is therefore gateable. Embedders
+        # constructing the server directly default to enabled (they own
+        # their listener exposure); the webhook CLI default-DISABLES
+        # control unless the operator supplies a bearer token file or
+        # explicitly opts into unauthenticated control (docs/rollout.md).
+        # GET /debug/rollout stays open — it is read-only.
+        self.rollout_control_enabled = rollout_control_enabled
+        self.rollout_control_token = rollout_control_token
         self.drain_grace_s = drain_grace_s
         self._draining = False
         self._inflight = 0
@@ -349,6 +368,17 @@ class WebhookServer:
             decision, reason, error = self._authorize_cached(body, request_id)
             if error is not None:
                 return sar_response(decision, reason, error)
+            if self.rollout is not None and self._cache_usable():
+                # shadow the REAL decision (pre-injection): offer() is a
+                # sampling check plus a non-blocking enqueue — the live
+                # answer below is already computed and never waits on it.
+                # Gated on store readiness (the same latched check the
+                # cache uses): a pre-ready NoOpinion is a startup
+                # artifact, and diffing it against the always-ready
+                # candidate would pollute the report with
+                # decision_changed noise that says nothing about the
+                # policy delta
+                self.rollout.offer("authorize", body, (decision, reason))
             decision, reason, error = self.error_injector.inject_if_enabled(
                 decision, reason
             )
@@ -522,6 +552,28 @@ class WebhookServer:
         return self._admission_fail_mode(review, e)
 
     def handle_admit(self, body: bytes) -> dict:
+        review = self._handle_admit(body)
+        if self.rollout is not None and self._admission_shadowable():
+            # non-blocking shadow offer; error/fail-mode responses are
+            # filtered by the shadow worker (code != 200), but the
+            # pre-ready allow is a CLEAN 200 — it must be gated here or
+            # startup traffic diffs against the always-ready candidate
+            self.rollout.offer("admit", body, review)
+        return review
+
+    def _admission_shadowable(self) -> bool:
+        """Stores ready for admission (latched, like _cache_usable): the
+        unready-allow answer is a startup artifact, not a decision the
+        candidate should be diffed against."""
+        try:
+            return (
+                self.admission_handler is None
+                or self.admission_handler._ready()
+            )
+        except Exception:  # noqa: BLE001 — unready reads as unshadowable
+            return False
+
+    def _handle_admit(self, body: bytes) -> dict:
         # one deadline budget for the whole request: a fastpath failure that
         # falls through to the python path spends the REMAINING budget, not
         # a fresh one, so the apiserver never waits ~2x the configured limit
@@ -709,9 +761,9 @@ class WebhookServer:
             def log_message(self, fmt, *args):
                 log.debug("%s %s", self.address_string(), fmt % args)
 
-            def _send_json(self, doc: dict):
+            def _send_json(self, doc: dict, code: int = 200):
                 data = json.dumps(doc).encode()
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
@@ -798,6 +850,19 @@ class WebhookServer:
                         log.exception("engine stats failed")
                         doc = {"error": "engine stats failed"}
                     self._send_json(doc)
+                elif self.path == "/debug/rollout":
+                    # shadow-rollout state + decision-diff report
+                    # (docs/rollout.md): lifecycle state, candidate warm
+                    # progress, per-kind diff counts, and the exemplar ring
+                    if server.rollout is None:
+                        self.send_error(404)
+                        return
+                    try:
+                        doc = server.rollout.status()
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("rollout status failed")
+                        doc = {"error": "rollout status failed"}
+                    self._send_json(doc)
                 elif self.path == "/debug/analysis":
                     # the last policy-set analysis report (load-time
                     # lowerability/shadowing/conflict findings + capacity);
@@ -814,7 +879,104 @@ class WebhookServer:
                 else:
                     self.send_error(404)
 
+            def do_POST(self):
+                """Rollout lifecycle control (docs/rollout.md): POST
+                /rollout/stage with {"directory": ...} or {"source": ...}
+                (+ optional "warm", "sampleRate"), /rollout/promote with
+                optional {"force": true}, /rollout/rollback. Served on the
+                plain metrics listener like the debug endpoints — operator
+                plane, not the apiserver-facing TLS port."""
+                if server.rollout is None:
+                    self.send_error(404)
+                    return
+                if not server.rollout_control_enabled:
+                    self._send_json(
+                        {
+                            "error": "rollout control is disabled on this "
+                            "listener; start the webhook with "
+                            "--rollout-control-token-file (bearer auth) or "
+                            "--rollout-insecure-control (docs/rollout.md)"
+                        },
+                        403,
+                    )
+                    return
+                if server.rollout_control_token:
+                    import hmac
+
+                    auth = self.headers.get("Authorization") or ""
+                    expected = f"Bearer {server.rollout_control_token}"
+                    # bytes compare: compare_digest raises TypeError on
+                    # non-ASCII str input, and header bytes arrive
+                    # latin-1-decoded — a stray byte must answer 403, not
+                    # abort the connection with a traceback
+                    if not hmac.compare_digest(
+                        auth.encode("utf-8", "surrogateescape"),
+                        expected.encode("utf-8", "surrogateescape"),
+                    ):
+                        self._send_json(
+                            {"error": "missing or invalid bearer token"},
+                            403,
+                        )
+                        return
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    self.send_error(400, "bad Content-Length")
+                    return
+                if length < 0 or length > MAX_BODY_BYTES:
+                    self.send_error(413, "request body too large")
+                    return
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    doc = json.loads(raw) if raw else {}
+                except (ValueError, TypeError) as e:
+                    self._send_json({"error": f"bad JSON body: {e}"}, 400)
+                    return
+                from ..rollout import RolloutError
+                from ..rollout.source import CandidateSourceError
+
+                try:
+                    if self.path == "/rollout/stage":
+                        out = server.rollout.stage(
+                            directory=doc.get("directory"),
+                            source=doc.get("source"),
+                            crd=bool(doc.get("crd")),
+                            description=doc.get("description", ""),
+                            warm=doc.get("warm", "async"),
+                            sample_rate=doc.get("sampleRate"),
+                        )
+                    elif self.path == "/rollout/promote":
+                        out = server.rollout.promote(
+                            force=bool(doc.get("force"))
+                        )
+                        server._prebuild_snapshots()
+                    elif self.path == "/rollout/rollback":
+                        out = server.rollout.rollback()
+                        server._prebuild_snapshots()
+                    else:
+                        self.send_error(404)
+                        return
+                except (RolloutError, CandidateSourceError) as e:
+                    self._send_json({"error": str(e)}, 409)
+                    return
+                except Exception as e:  # noqa: BLE001 — report, never crash
+                    log.exception("rollout control %s failed", self.path)
+                    self._send_json({"error": str(e)}, 500)
+                    return
+                self._send_json(out)
+
         return MetricsHandler
+
+    def _prebuild_snapshots(self) -> None:
+        """Touch the fast paths after a promote/rollback swap so their
+        native-encoder snapshots rebuild NOW (a host-side C++ table build)
+        instead of on the first live request."""
+        for fp in (self.fastpath, self.admission_fastpath):
+            try:
+                if fp is not None:
+                    fp.available  # noqa: B018 — property triggers the rebuild
+            except Exception:  # noqa: BLE001 — the lazy path still works
+                log.exception("snapshot prebuild failed")
 
     def start(self) -> None:
         """Start both servers on background threads."""
@@ -889,6 +1051,11 @@ class WebhookServer:
         ):
             if batcher is not None:
                 batcher.stop()
+        if self.rollout is not None:
+            try:
+                self.rollout.stop()  # shadow worker; best-effort by design
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception("rollout stop failed")
 
     @property
     def bound_port(self) -> Optional[int]:

@@ -356,6 +356,54 @@ engine_warmup_seconds = REGISTRY.register(
 )
 
 
+# Shadow-rollout metrics (cedar_tpu/rollout, docs/rollout.md): shadow
+# evaluation is best-effort work BEHIND the live paths, so its counters
+# are outside the cedar_authorizer_* request subsystem.
+shadow_evaluations_total = REGISTRY.register(
+    Counter(
+        "cedar_shadow_evaluations_total",
+        "Live requests re-evaluated against the staged candidate policy "
+        "set, partitioned by path (authorization / admission). Compare "
+        "with cedar_authorizer_request_total to see effective shadow "
+        "coverage after sampling and shedding.",
+        ["path"],
+    )
+)
+
+shadow_diffs_total = REGISTRY.register(
+    Counter(
+        "cedar_shadow_diffs_total",
+        "Shadow evaluations whose candidate answer differed from the live "
+        "answer, partitioned by kind (allow_to_deny / deny_to_allow / "
+        "decision_changed / reason_changed). Any nonzero allow_to_deny "
+        "rate means promotion would break currently-working callers "
+        "(docs/rollout.md).",
+        ["kind"],
+    )
+)
+
+shadow_shed_total = REGISTRY.register(
+    Counter(
+        "cedar_shadow_shed_total",
+        "Sampled requests dropped because the shadow queue was full, "
+        "partitioned by path. Shadow work is shed first under pressure by "
+        "design; a sustained rate only means the diff report covers a "
+        "smaller sample, never that live traffic slowed.",
+        ["path"],
+    )
+)
+
+rollout_generation = REGISTRY.register(
+    Gauge(
+        "cedar_rollout_generation",
+        "Monotonic rollout lifecycle counter: bumps on every stage, "
+        "promote, and rollback. Join against decision-latency dashboards "
+        "to correlate policy rollouts with behavior changes.",
+        [],
+    )
+)
+
+
 # Static-analysis metrics (cedar_tpu/analysis): deliberately outside the
 # cedar_authorizer_* request subsystem — they describe the POLICY SET, not
 # request traffic, and are re-published at every policy load.
@@ -454,6 +502,22 @@ def record_pipeline_stall(path: str, stage: str, seconds: float) -> None:
 
 def set_engine_warmup_seconds(engine: str, seconds: float) -> None:
     engine_warmup_seconds.set(round(seconds, 6), engine=engine)
+
+
+def record_shadow_evaluation(path: str) -> None:
+    shadow_evaluations_total.inc(path=path)
+
+
+def record_shadow_diff(kind: str) -> None:
+    shadow_diffs_total.inc(kind=kind)
+
+
+def record_shadow_shed(path: str) -> None:
+    shadow_shed_total.inc(path=path)
+
+
+def set_rollout_generation(generation: int) -> None:
+    rollout_generation.set(generation)
 
 
 def set_fastpath_lowerable(tier: int, count: int) -> None:

@@ -68,10 +68,17 @@ class CRDPolicyStore:
         self._validation_mode = validation_mode
         self._policies = PolicySet()
         self._ids_by_object: dict = {}  # object name -> [policy ids]
-        # object name -> (uid, content): generation bumps ONLY when this
-        # map changes, so watch reconnect relists and metadata-only
-        # MODIFIED events never trigger a TPU recompile
+        # object name -> (uid, content, is_candidate): generation bumps
+        # ONLY when this map changes, so watch reconnect relists and
+        # metadata-only MODIFIED events never trigger a TPU recompile.
+        # is_candidate is part of the key because flipping the rollout
+        # label IS a serving-set change (the object enters/leaves the live
+        # corpus) even though uid+content are untouched.
         self._content_by_object: dict = {}
+        # Policy objects labeled cedar.k8s.aws/rollout=candidate: EXCLUDED
+        # from the live serving set and held here for the shadow-rollout
+        # controller to stage (rollout/source.candidate_tiers_from_objects)
+        self._candidate_objects: dict = {}
         self._generation = 0
         self._lock = threading.Lock()
         self._load_complete = False
@@ -154,7 +161,14 @@ class CRDPolicyStore:
             ps = PolicySet()
             ids_by_object: dict = {}
             content_by_object: dict = {}
+            candidate_objects: dict = {}
             for obj in objs:
+                if self._is_candidate(obj):
+                    candidate_objects[obj.name] = obj
+                    content_by_object[obj.name] = (
+                        obj.uid, obj.spec.content, True,
+                    )
+                    continue
                 policies = self._parse(obj)
                 if policies is None:
                     continue
@@ -164,11 +178,25 @@ class CRDPolicyStore:
                     ps.add(p, policy_id=pid)
                     ids.append(pid)
                 ids_by_object[obj.name] = ids
-                content_by_object[obj.name] = (obj.uid, obj.spec.content)
+                content_by_object[obj.name] = (obj.uid, obj.spec.content, False)
             self._policies = ps
             self._ids_by_object = ids_by_object
-            if content_by_object != self._content_by_object:
-                self._content_by_object = content_by_object
+            self._candidate_objects = candidate_objects
+            # generation compares the LIVE view only: a candidate-labeled
+            # object's content is not served, so a candidate edit arriving
+            # via a reconnect relist must not recompile the engines (or —
+            # after a promotion — revert the promoted compiled set through
+            # the reloader). Label flips still bump: the object enters or
+            # leaves the live view. The watch _upsert path has the same
+            # semantics.
+            live_view = {
+                k: v for k, v in content_by_object.items() if not v[2]
+            }
+            prev_live_view = {
+                k: v for k, v in self._content_by_object.items() if not v[2]
+            }
+            self._content_by_object = content_by_object
+            if live_view != prev_live_view:
                 self._generation += 1
 
     def _dispatch(self, event_type: str, obj: PolicyObject) -> None:
@@ -182,6 +210,22 @@ class CRDPolicyStore:
             raise WatchExpired("ERROR event from watch stream")
 
     # -------------------------------------------------------- event handlers
+
+    @staticmethod
+    def _is_candidate(obj: PolicyObject) -> bool:
+        """True when the object carries the shadow-rollout candidate label
+        (rollout/source.py CANDIDATE_LABEL): such objects are withheld
+        from the live serving set and surfaced via candidate_objects()."""
+        from ..rollout.source import CANDIDATE_LABEL, CANDIDATE_LABEL_VALUE
+
+        labels = getattr(obj, "labels", None) or {}
+        return labels.get(CANDIDATE_LABEL) == CANDIDATE_LABEL_VALUE
+
+    def candidate_objects(self) -> list:
+        """The current candidate-labeled Policy objects (for
+        RolloutController.stage via candidate_tiers_from_objects)."""
+        with self._lock:
+            return list(self._candidate_objects.values())
 
     def _parse(self, obj: PolicyObject):
         try:
@@ -259,15 +303,37 @@ class CRDPolicyStore:
 
     def _upsert(self, obj: PolicyObject) -> None:
         """ADDED/MODIFIED share the semantics: replace the object's policies.
-        Metadata-only MODIFIED events (same uid + content) are no-ops — no
-        set rebuild, no generation bump, no recompile downstream."""
-        if self._content_by_object.get(obj.name) == (obj.uid, obj.spec.content):
+        Metadata-only MODIFIED events (same uid + content + candidate
+        label state) are no-ops — no set rebuild, no generation bump, no
+        recompile downstream. Candidate-labeled objects never enter the
+        live set; gaining the label removes an object from it (the
+        operator is pulling it into the staged corpus), losing the label
+        admits it."""
+        is_candidate = self._is_candidate(obj)
+        key = (obj.uid, obj.spec.content, is_candidate)
+        if self._content_by_object.get(obj.name) == key:
+            return
+        if is_candidate:
+            with self._lock:
+                self._candidate_objects[obj.name] = obj
+            if obj.name in self._ids_by_object:
+                # previously live: withdraw its policies from the set
+                def mutate(ps: PolicySet) -> None:
+                    for pid in self._ids_by_object.pop(obj.name, []):
+                        ps.remove(pid)
+                    self._content_by_object[obj.name] = key
+
+                self._copy_on_write(mutate)
+            else:
+                with self._lock:
+                    self._content_by_object[obj.name] = key
             return
         policies = self._parse(obj)
         if policies is None:
             return
 
         def mutate(ps: PolicySet) -> None:
+            self._candidate_objects.pop(obj.name, None)
             for pid in self._ids_by_object.pop(obj.name, []):
                 ps.remove(pid)
             ids = []
@@ -276,11 +342,19 @@ class CRDPolicyStore:
                 ps.add(p, policy_id=pid)
                 ids.append(pid)
             self._ids_by_object[obj.name] = ids
-            self._content_by_object[obj.name] = (obj.uid, obj.spec.content)
+            self._content_by_object[obj.name] = key
 
         self._copy_on_write(mutate)
 
     def on_delete(self, obj: PolicyObject) -> None:
+        with self._lock:
+            was_candidate = (
+                self._candidate_objects.pop(obj.name, None) is not None
+            )
+        if was_candidate and obj.name not in self._ids_by_object:
+            with self._lock:
+                self._content_by_object.pop(obj.name, None)
+            return
         if obj.name not in self._ids_by_object:
             return  # unknown object: nothing to remove, nothing changed
 

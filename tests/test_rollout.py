@@ -1,0 +1,923 @@
+"""Shadow-rollout subsystem tests (cedar_tpu/rollout, docs/rollout.md).
+
+The load-bearing suite pieces:
+
+  * a ≥1.1k-body recorded-traffic differential proving (a) live responses
+    are BYTE-identical with shadowing on vs off and (b) the diff report
+    catches exactly the fingerprints of the requests whose decision the
+    candidate inverts — nothing more, nothing less;
+  * promotion atomicity: the candidate's pre-warmed compiled planes serve
+    the first post-promote request with ZERO new jit traces
+    (kernel_trace_count-asserted), pre-promotion decision-cache entries
+    die through the generation composite, and rollback restores the prior
+    compiled set without recompiling;
+  * the shed-first queue contract, the strict stage-time analysis gate,
+    the /debug/rollout + lifecycle HTTP endpoints, the CRD candidate
+    label, and the cedar-shadow offline CLI.
+"""
+
+import json
+import time
+
+import pytest
+
+from cedar_tpu.cache import DecisionCache
+from cedar_tpu.cache.fingerprint import fingerprint_body
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.rollout import (
+    RolloutController,
+    RolloutError,
+    classify_decision_diff,
+)
+from cedar_tpu.rollout.report import DiffReport
+from cedar_tpu.rollout.shadow import ShadowEvaluator
+from cedar_tpu.server.admission import (
+    CedarAdmissionHandler,
+    allow_all_admission_policy_store,
+)
+from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+from cedar_tpu.server.http import WebhookServer
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+# live and candidate differ ONLY in effect keywords ("permit"/"forbid" are
+# the same length) and one admission label value, so unchanged policies
+# keep identical ids, filenames, and positions — any diff the report finds
+# is a real decision/reason change, never formatting noise.
+LIVE_POLICIES = """
+permit (principal is k8s::User, action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { principal.name == "alice" && resource.resource == "pods" };
+forbid (principal is k8s::User, action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { principal.name == "carol" && resource.resource == "secrets" };
+permit (principal is k8s::User, action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { principal.name == "bob" };
+forbid (principal is k8s::User,
+        action == k8s::admission::Action::"create",
+        resource is core::v1::ConfigMap)
+  when { resource.metadata has labels &&
+         resource.metadata.labels.contains({key: "env", value: "prod"}) };
+"""
+
+# inversions: alice/pods permit->forbid (allow_to_deny), carol/secrets
+# forbid->permit (deny_to_allow), admission forbid retargeted prod->heha
+# (env=prod reviews deny->allow, env=heha reviews allow->deny)
+CANDIDATE_POLICIES = (
+    LIVE_POLICIES.replace(
+        'permit (principal is k8s::User, action == k8s::Action::"get",\n'
+        "        resource is k8s::Resource)\n"
+        '  when { principal.name == "alice"',
+        'forbid (principal is k8s::User, action == k8s::Action::"get",\n'
+        "        resource is k8s::Resource)\n"
+        '  when { principal.name == "alice"',
+    )
+    .replace(
+        'forbid (principal is k8s::User, action == k8s::Action::"get",\n'
+        "        resource is k8s::Resource)\n"
+        '  when { principal.name == "carol"',
+        'permit (principal is k8s::User, action == k8s::Action::"get",\n'
+        "        resource is k8s::Resource)\n"
+        '  when { principal.name == "carol"',
+    )
+    .replace('value: "prod"', 'value: "heha"')
+)
+
+FILENAME = "rollout-test"
+
+
+def _tiers(src):
+    return [PolicySet.from_source(src, FILENAME)]
+
+
+def sar_body(user="alice", resource="pods", namespace="default", verb="get"):
+    return json.dumps(
+        {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": user,
+                "uid": "u",
+                "groups": [],
+                "resourceAttributes": {
+                    "verb": verb,
+                    "version": "v1",
+                    "resource": resource,
+                    "namespace": namespace,
+                },
+            },
+        }
+    ).encode()
+
+
+def review_body(env=None, uid="r1", name="c"):
+    obj = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": "default"},
+    }
+    if env is not None:
+        obj["metadata"]["labels"] = {"env": env}
+    return json.dumps(
+        {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": uid,
+                "operation": "CREATE",
+                "userInfo": {"username": "sam", "groups": []},
+                "kind": {"group": "", "version": "v1", "kind": "ConfigMap"},
+                "resource": {
+                    "group": "",
+                    "version": "v1",
+                    "resource": "configmaps",
+                },
+                "namespace": "default",
+                "name": name,
+                "object": obj,
+            },
+        }
+    ).encode()
+
+
+def _interpreter_server(src, rollout=None):
+    stores = TieredPolicyStores([MemoryStore(FILENAME, _tiers(src)[0])])
+    authorizer = CedarWebhookAuthorizer(stores)
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores(
+            list(stores.stores) + [allow_all_admission_policy_store()]
+        )
+    )
+    return (
+        WebhookServer(authorizer, handler, rollout=rollout),
+        stores,
+    )
+
+
+def _engine_stack(src, warm_max_batch=8):
+    """(engine, admission_engine, server, stores, cache) with TPU engines
+    and the decision cache wired the way the webhook CLI wires them."""
+    engine = TPUPolicyEngine(
+        name="authorization", warm_max_batch=warm_max_batch
+    )
+    engine.load(_tiers(src), warm="off")
+    adm_engine = TPUPolicyEngine(
+        name="admission", warm_max_batch=warm_max_batch
+    )
+    adm_engine.load(
+        _tiers(src) + [allow_all_admission_policy_store().policy_set()],
+        warm="off",
+    )
+    stores = TieredPolicyStores([MemoryStore(FILENAME, _tiers(src)[0])])
+    cache = DecisionCache(
+        generation_fn=lambda: (
+            stores.cache_generation(),
+            engine.load_generation,
+        ),
+        path="authorization",
+    )
+    authorizer = CedarWebhookAuthorizer(
+        stores,
+        evaluate=engine.evaluate,
+        evaluate_batch=engine.evaluate_batch,
+    )
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores(
+            list(stores.stores) + [allow_all_admission_policy_store()]
+        ),
+        evaluate=adm_engine.evaluate,
+        evaluate_batch=adm_engine.evaluate_batch,
+    )
+    server = WebhookServer(
+        authorizer, handler, decision_cache=cache
+    )
+    return engine, adm_engine, server, stores, cache
+
+
+def _traffic():
+    """≥1.1k bodies with a deterministic mix: SARs over 4 users x 3
+    resources x namespaces, plus admission reviews over 3 label states."""
+    bodies = []
+    users = ["alice", "bob", "carol", "dave"]
+    resources = ["pods", "secrets", "services"]
+    for i in range(800):
+        bodies.append(
+            (
+                "authorize",
+                sar_body(
+                    user=users[i % 4],
+                    resource=resources[(i // 4) % 3],
+                    namespace=f"ns-{i % 7}",
+                ),
+            )
+        )
+    envs = ["prod", "heha", None]
+    for i in range(300):
+        bodies.append(
+            ("admit", review_body(env=envs[i % 3], uid=f"r{i}", name=f"c{i}"))
+        )
+    return bodies
+
+
+class TestDiffClassification:
+    def test_kinds(self):
+        assert classify_decision_diff("allow", "x", "deny", "y") == "allow_to_deny"
+        assert classify_decision_diff("deny", "x", "allow", "y") == "deny_to_allow"
+        assert (
+            classify_decision_diff("no_opinion", "", "allow", "r")
+            == "decision_changed"
+        )
+        assert (
+            classify_decision_diff("allow", "r1", "allow", "r2")
+            == "reason_changed"
+        )
+        assert classify_decision_diff("allow", "r", "allow", "r") is None
+
+    def test_report_exemplars_and_fingerprints(self):
+        rep = DiffReport(exemplar_cap=2)
+        rep.record_diff("authorization", "allow_to_deny", "fp1", {}, {})
+        rep.record_diff("authorization", "allow_to_deny", "fp2", {}, {})
+        rep.record_diff("authorization", "deny_to_allow", "fp3", {}, {})
+        # capped ring keeps the newest exemplars; counters keep everything
+        assert rep.diff_fingerprints() == {"fp2", "fp3"}
+        assert rep.to_dict()["diffs"]["allow_to_deny"] == 2
+        assert rep.total_diffs == 3
+
+
+class TestRecordedTrafficDifferential:
+    def test_live_bytes_identical_and_diffs_exact(self):
+        """The tentpole differential: ≥1.1k bodies through a shadowing and
+        a non-shadowing server must produce byte-identical live responses,
+        and the diff report must catch exactly the fingerprints of the
+        requests whose decision the candidate inverts."""
+        bodies = _traffic()
+        assert len(bodies) >= 1100
+
+        plain_srv, _ = _interpreter_server(LIVE_POLICIES)
+        # full coverage on purpose: no sampling, a queue that cannot fill,
+        # and no duty-cycle throttle — the assertion is EXACT fingerprint
+        # capture, so nothing may shed
+        rollout = RolloutController(
+            exemplar_cap=4096, queue_depth=4096, duty_cycle=1.0
+        )
+        shadow_srv, _ = _interpreter_server(LIVE_POLICIES, rollout=rollout)
+        rollout.stage(
+            tiers=[PolicySet.from_source(CANDIDATE_POLICIES, FILENAME)],
+            description="inverting candidate",
+            warm="off",
+        )
+        try:
+            for endpoint, body in bodies:
+                if endpoint == "authorize":
+                    base = plain_srv.handle_authorize(body)
+                    shadowed = shadow_srv.handle_authorize(body)
+                else:
+                    base = plain_srv.handle_admit(body)
+                    shadowed = shadow_srv.handle_admit(body)
+                assert json.dumps(base).encode() == json.dumps(
+                    shadowed
+                ).encode(), (endpoint, body)
+
+            assert rollout.drain(60), "shadow queue did not drain"
+            report = rollout.report.to_dict()
+
+            expected = {}
+            for endpoint, body in bodies:
+                doc = json.loads(body)
+                if endpoint == "authorize":
+                    spec = doc["spec"]
+                    user = spec["user"]
+                    resource = spec["resourceAttributes"]["resource"]
+                    if user == "alice" and resource == "pods":
+                        kind = "allow_to_deny"
+                    elif user == "carol" and resource == "secrets":
+                        kind = "deny_to_allow"
+                    else:
+                        continue
+                else:
+                    labels = (
+                        doc["request"]["object"]["metadata"].get("labels")
+                        or {}
+                    )
+                    if labels.get("env") == "prod":
+                        kind = "deny_to_allow"
+                    elif labels.get("env") == "heha":
+                        kind = "allow_to_deny"
+                    else:
+                        continue
+                fp = fingerprint_body(
+                    "authorize" if endpoint == "authorize" else "admit", body
+                )
+                expected[fp] = kind
+
+            assert expected, "traffic generator produced no inversions"
+            got = {
+                e["fingerprint"]: e["kind"]
+                for e in report["exemplars"]
+            }
+            assert got == expected
+            # every evaluation either matched or diffed; nothing skipped
+            assert report["skipped"] == {}
+            assert report["candidate_errors"] == 0
+            assert report["diffs"]["reason_changed"] == 0
+            assert report["diffs"]["decision_changed"] == 0
+            total = sum(report["evaluations"].values())
+            assert total == len(bodies)
+        finally:
+            shadow_srv.stop()
+            plain_srv.stop()
+
+
+class TestPromotionAtomicity:
+    def test_promote_zero_traces_cache_dead_rollback_no_recompile(self):
+        from cedar_tpu.ops.match import kernel_trace_count
+
+        engine, adm_engine, server, stores, cache = _engine_stack(
+            LIVE_POLICIES
+        )
+        rollout = RolloutController(
+            authz_engine=engine, admission_engine=adm_engine
+        )
+        server.rollout = rollout
+        # warm the LIVE planes too: the test isolates the PROMOTION cost,
+        # and a production server is always warmed at load
+        engine.warmup()
+        adm_engine.warmup()
+        rollout.stage(
+            tiers=[PolicySet.from_source(CANDIDATE_POLICIES, FILENAME)],
+            warm="sync",
+        )
+        assert rollout.warm_ready()
+        try:
+            allow = server.handle_authorize(sar_body("alice", "pods"))
+            assert allow["status"]["allowed"] is True
+            # the allow is now cached; promotion must kill it
+            assert cache.stats()["size"] >= 1
+
+            traces0 = kernel_trace_count()
+            status = rollout.promote()
+            assert status["state"] == "promoted"
+            denied = server.handle_authorize(sar_body("alice", "pods"))
+            assert denied["status"]["denied"] is True, (
+                "stale cache entry or compiled set survived promotion"
+            )
+            adm = server.handle_admit(review_body(env="heha"))
+            assert adm["response"]["allowed"] is False
+            assert kernel_trace_count() == traces0, (
+                "promotion caused fresh jit traces despite the candidate "
+                "warm-up"
+            )
+
+            rollout.rollback()
+            allowed_again = server.handle_authorize(sar_body("alice", "pods"))
+            assert allowed_again["status"]["allowed"] is True
+            assert kernel_trace_count() == traces0, (
+                "rollback recompiled instead of restoring the prior set"
+            )
+        finally:
+            server.stop()
+
+    def test_lifecycle_guards(self):
+        engine = TPUPolicyEngine(name="authorization", warm_max_batch=1)
+        engine.load(_tiers(LIVE_POLICIES), warm="off")
+        rollout = RolloutController(authz_engine=engine)
+        with pytest.raises(RolloutError):
+            rollout.promote()
+        with pytest.raises(RolloutError):
+            rollout.rollback()
+        rollout.stage(
+            tiers=[PolicySet.from_source(CANDIDATE_POLICIES, FILENAME)],
+            warm="off",
+        )
+        # staged (not promoted) rollback = discard; nothing live changed
+        gen_before = engine.load_generation
+        status = rollout.rollback()
+        assert status["state"] == "idle"
+        assert engine.load_generation == gen_before
+
+    def test_stage_refuses_over_active_promotion(self):
+        """Staging over a live promotion would strand its rollback point;
+        the stage must refuse until rollback — or until store reloads
+        supersede the promotion, which finalizes it."""
+        engine = TPUPolicyEngine(name="authorization", warm_max_batch=1)
+        engine.load(_tiers(LIVE_POLICIES), warm="off")
+        rollout = RolloutController(authz_engine=engine)
+        cand_tiers = [PolicySet.from_source(CANDIDATE_POLICIES, FILENAME)]
+        rollout.stage(tiers=cand_tiers, warm="off")
+        rollout.promote(force=True)
+        with pytest.raises(RolloutError, match="promotion is still active"):
+            rollout.stage(tiers=cand_tiers, warm="off")
+        # the rollback point survived the refused stage
+        assert rollout.status()["state"] == "promoted"
+        rollout.rollback()
+        assert rollout.status()["state"] == "idle"
+        # promote again, then supersede via a store-driven reload: the
+        # next stage finalizes the promotion instead of refusing
+        rollout.stage(tiers=cand_tiers, warm="off")
+        rollout.promote(force=True)
+        engine.load(_tiers(CANDIDATE_POLICIES), warm="off")  # commit+reload
+        status = rollout.stage(tiers=cand_tiers, warm="off")
+        assert status["state"] == "staged"
+        rollout.stop()
+
+    def test_mesh_promotion_transplants_pjit_steps(self):
+        """On mesh engines the pjit evaluation steps are cached per
+        engine instance; adoption must transplant the donor's entries or
+        the first post-promotion request pays a fresh pjit trace."""
+        from cedar_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(8)
+        live = TPUPolicyEngine(name="authorization", mesh=mesh, warm_max_batch=1)
+        live.load(_tiers(LIVE_POLICIES), warm="off")
+        rollout = RolloutController(authz_engine=live)
+        rollout.stage(
+            tiers=[PolicySet.from_source(CANDIDATE_POLICIES, FILENAME)],
+            warm="off",
+        )
+        staged = rollout._candidate.authz_engine
+        # drive one evaluation through the candidate so its pjit step for
+        # the candidate's (n_tiers, has_gate) key exists
+        from cedar_tpu.server.authorizer import record_to_cedar_resource
+        from cedar_tpu.server.http import get_authorizer_attributes
+
+        attrs = get_authorizer_attributes(json.loads(sar_body("alice")))
+        staged.evaluate(*record_to_cedar_resource(attrs))
+        staged_keys = set(staged._mesh_steps)
+        assert staged_keys, "candidate engine produced no pjit step"
+        rollout.promote(force=True)
+        assert staged_keys <= set(live._mesh_steps), (
+            "promotion did not transplant the candidate's pjit steps"
+        )
+
+    def test_rollback_refuses_after_external_reload(self):
+        """A store-driven engine reload between promote and rollback makes
+        the saved compiled set stale: rollback must refuse, not silently
+        revive pre-promotion policy."""
+        engine = TPUPolicyEngine(name="authorization", warm_max_batch=1)
+        engine.load(_tiers(LIVE_POLICIES), warm="off")
+        rollout = RolloutController(authz_engine=engine)
+        rollout.stage(
+            tiers=[PolicySet.from_source(CANDIDATE_POLICIES, FILENAME)],
+            warm="off",
+        )
+        rollout.promote(force=True)
+        engine.load(_tiers(LIVE_POLICIES), warm="off")  # reloader fired
+        with pytest.raises(RolloutError, match="reloaded since"):
+            rollout.rollback()
+
+
+class TestStageGate:
+    def test_unlowerable_candidate_rejected(self):
+        """The stage-time analysis gate (strict by default) rejects a
+        candidate the fast path cannot lower, before it shadows anything."""
+        engine = TPUPolicyEngine(name="authorization", warm_max_batch=1)
+        engine.load(_tiers(LIVE_POLICIES), warm="off")
+        rollout = RolloutController(authz_engine=engine)
+        bad = LIVE_POLICIES + (
+            'permit (principal in k8s::Group::"joiners", '
+            'action == k8s::Action::"get", resource is k8s::Resource)\n'
+            "  unless { ip(resource.name).isLoopback() };\n"
+        )
+        with pytest.raises(RolloutError, match="analysis"):
+            rollout.stage(
+                tiers=[PolicySet.from_source(bad, FILENAME)], warm="off"
+            )
+        assert rollout.status()["state"] == "idle"
+        # a permissive controller stages the same candidate
+        lax = RolloutController(
+            authz_engine=engine, stage_validation_mode="permissive"
+        )
+        lax.stage(tiers=[PolicySet.from_source(bad, FILENAME)], warm="off")
+        assert lax.status()["state"] == "staged"
+        lax.stop()
+
+    def test_stage_requires_a_source(self):
+        rollout = RolloutController()
+        with pytest.raises(RolloutError):
+            rollout.stage()
+
+
+class TestShadowQueue:
+    def test_full_queue_sheds_not_blocks(self):
+        """Shadow work is shed first: with the worker wedged and the
+        bounded queue full, offers return immediately as shed — the live
+        caller never waits."""
+        import threading
+
+        release = threading.Event()
+
+        class _SlowCandidate:
+            class authorizer:  # noqa: N801 — duck-typed stack
+                @staticmethod
+                def authorize_batch(attrs):
+                    release.wait(10)
+                    return [("allow", "")] * len(attrs)
+
+            class admission_handler:  # noqa: N801
+                @staticmethod
+                def handle_batch(reqs):
+                    return []
+
+        report = DiffReport()
+        shadow = ShadowEvaluator(
+            _SlowCandidate(), report, sample_rate=1.0, queue_depth=4
+        )
+        try:
+            live = ("allow", "")
+            # worker picks up a first batch and wedges on it; fill the
+            # queue behind it, then overflow
+            shadow.offer("authorize", sar_body(), live)
+            deadline = time.time() + 5
+            shed = 0
+            while shed == 0 and time.time() < deadline:
+                t0 = time.monotonic()
+                ok = shadow.offer("authorize", sar_body(), live)
+                assert time.monotonic() - t0 < 0.5, "offer blocked"
+                if not ok:
+                    shed += 1
+            assert shed, "queue never shed"
+            assert sum(report.shed.values()) >= 1
+        finally:
+            release.set()
+            shadow.stop()
+
+    def test_unready_live_answers_not_offered(self):
+        """Pre-ready NoOpinions/allows are startup artifacts: the server
+        must not offer them, or the always-ready candidate would fill the
+        report with decision_changed noise."""
+        unready = MemoryStore(
+            FILENAME, _tiers(LIVE_POLICIES)[0], load_complete=False
+        )
+        stores = TieredPolicyStores([unready])
+        authorizer = CedarWebhookAuthorizer(stores)
+        handler = CedarAdmissionHandler(
+            TieredPolicyStores(
+                [unready, allow_all_admission_policy_store()]
+            )
+        )
+        rollout = RolloutController()
+        rollout.stage(
+            tiers=[PolicySet.from_source(CANDIDATE_POLICIES, FILENAME)],
+            warm="off",
+        )
+        server = WebhookServer(authorizer, handler, rollout=rollout)
+        try:
+            resp = server.handle_authorize(sar_body("alice", "pods"))
+            assert resp["status"]["allowed"] is False  # pre-ready NoOpinion
+            adm = server.handle_admit(review_body(env="heha"))
+            assert adm["response"]["allowed"] is True  # pre-ready allow
+            assert rollout.drain(10)
+            assert rollout.report.to_dict()["evaluations"] == {}
+        finally:
+            server.stop()
+
+    def test_sample_rate_zero_offers_nothing(self):
+        report = DiffReport()
+
+        class _Boom:
+            class authorizer:  # noqa: N801
+                @staticmethod
+                def authorize_batch(attrs):
+                    raise AssertionError("must not evaluate at rate 0")
+
+        shadow = ShadowEvaluator(_Boom(), report, sample_rate=0.0)
+        try:
+            assert shadow.offer("authorize", sar_body(), ("allow", "")) is False
+            assert shadow.drain(5)
+            assert report.to_dict()["evaluations"] == {}
+        finally:
+            shadow.stop()
+
+
+class TestHTTPEndpoints:
+    def test_debug_and_lifecycle_endpoints(self):
+        import urllib.request
+
+        engine, adm_engine, server, stores, cache = _engine_stack(
+            LIVE_POLICIES, warm_max_batch=1
+        )
+        rollout = RolloutController(
+            authz_engine=engine, admission_engine=adm_engine
+        )
+        server.rollout = rollout
+        server.start()
+        port = server.bound_metrics_port
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as resp:
+                return json.loads(resp.read())
+
+        def post(path, doc=None, expect=200):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(doc or {}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    assert resp.status == expect
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                assert e.code == expect, (e.code, e.read())
+                return json.loads(e.read())
+
+        try:
+            assert get("/debug/rollout")["state"] == "idle"
+            out = post(
+                "/rollout/stage",
+                {"source": CANDIDATE_POLICIES, "warm": "sync"},
+            )
+            assert out["state"] == "staged"
+            assert out["candidate"]["warm_state"] == "ready"
+            # diffing traffic shows up in the debug doc
+            server.handle_authorize(sar_body("alice", "pods"))
+            assert rollout.drain(30)
+            doc = get("/debug/rollout")
+            assert doc["diff"]["diffs"]["allow_to_deny"] == 1
+            # metrics exposition carries the rollout counters
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as resp:
+                text = resp.read().decode()
+            assert "cedar_shadow_evaluations_total" in text
+            assert "cedar_rollout_generation" in text
+            out = post("/rollout/promote")
+            assert out["state"] == "promoted"
+            denied = server.handle_authorize(sar_body("alice", "pods"))
+            assert denied["status"]["denied"] is True
+            out = post("/rollout/rollback")
+            assert out["state"] == "idle"
+            # conflict answers 409 with an explanatory error
+            err = post("/rollout/promote", expect=409)
+            assert "error" in err
+            err = post("/rollout/stage", {"source": "permit (nope"}, 409)
+            assert "error" in err
+        finally:
+            server.stop()
+
+    def test_control_gating_disabled_and_token(self):
+        """The mutating lifecycle POSTs are gateable: disabled → 403;
+        token-gated → 403 without the bearer, 200 with it. GET
+        /debug/rollout stays open either way."""
+        import urllib.error
+        import urllib.request
+
+        engine = TPUPolicyEngine(name="authorization", warm_max_batch=1)
+        engine.load(_tiers(LIVE_POLICIES), warm="off")
+        rollout = RolloutController(authz_engine=engine)
+        server, _ = _interpreter_server(LIVE_POLICIES, rollout=rollout)
+        server.rollout_control_enabled = False
+        server.start()
+        port = server.bound_metrics_port
+
+        def post(path, headers=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=b"{}",
+                method="POST",
+                headers=headers or {},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        try:
+            code, doc = post("/rollout/stage")
+            assert code == 403 and "disabled" in doc["error"]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/rollout", timeout=10
+            ) as resp:
+                assert resp.status == 200  # read-only stays open
+            server.rollout_control_enabled = True
+            server.rollout_control_token = "sekrit"
+            code, doc = post("/rollout/stage")
+            assert code == 403 and "bearer" in doc["error"].lower()
+            code, doc = post(
+                "/rollout/stage",
+                headers={"Authorization": "Bearer sekrit"},
+            )
+            assert code == 409  # authenticated; fails only on the body
+        finally:
+            server.stop()
+
+    def test_endpoints_404_without_rollout(self):
+        import urllib.error
+        import urllib.request
+
+        server, _ = _interpreter_server(LIVE_POLICIES)
+        server.start()
+        try:
+            port = server.bound_metrics_port
+            for method, path in (
+                ("GET", "/debug/rollout"),
+                ("POST", "/rollout/promote"),
+            ):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}{path}",
+                    data=b"{}" if method == "POST" else None,
+                    method=method,
+                )
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(req, timeout=10)
+                assert exc.value.code == 404
+        finally:
+            server.stop()
+
+
+class TestCRDCandidateLabel:
+    def _obj(self, name, uid, content, labels=None):
+        from cedar_tpu.apis.v1alpha1 import PolicyObject
+
+        return PolicyObject.from_dict(
+            {
+                "metadata": {
+                    "name": name,
+                    "uid": uid,
+                    **({"labels": labels} if labels else {}),
+                },
+                "spec": {"content": content},
+            }
+        )
+
+    def test_candidate_labeled_objects_excluded_from_live_set(self):
+        from cedar_tpu.rollout.source import candidate_tiers_from_objects
+        from cedar_tpu.stores.crd import CRDPolicyStore
+
+        live = self._obj(
+            "live", "u1", 'permit (principal, action, resource);'
+        )
+        cand = self._obj(
+            "cand",
+            "u2",
+            'forbid (principal, action, resource);',
+            labels={"cedar.k8s.aws/rollout": "candidate"},
+        )
+
+        class _Src:
+            def list(self):
+                return [live, cand]
+
+            def watch(self, on_event, stop):
+                stop.wait(5)
+
+        store = CRDPolicyStore(source=_Src(), start=False)
+        store._relist()
+        assert len(store.policy_set().policies()) == 1  # candidate excluded
+        assert [o.name for o in store.candidate_objects()] == ["cand"]
+        tiers = candidate_tiers_from_objects(store.candidate_objects())
+        assert len(tiers) == 1 and len(tiers[0].policies()) == 1
+        # the end-to-end staging path: stage(crd=True) pulls the labeled
+        # objects through the wired provider
+        rollout = RolloutController(
+            crd_candidate_provider=store.candidate_objects
+        )
+        status = rollout.stage(crd=True, warm="off")
+        assert status["state"] == "staged"
+        assert status["candidate"]["description"] == "crd-label"
+        assert status["candidate"]["policies"] == 1
+        rollout.stop()
+
+    def test_crd_relist_candidate_edit_no_generation_bump(self):
+        """A candidate-labeled object's content change arriving via a
+        reconnect relist must NOT bump the store generation: the live
+        serving set is untouched, and a bump would recompile the engines
+        (or, post-promotion, revert the promoted set via the reloader)."""
+        from cedar_tpu.stores.crd import CRDPolicyStore
+
+        live = self._obj("live", "u1", "permit (principal, action, resource);")
+        cand_v1 = self._obj(
+            "cand", "u2", "forbid (principal, action, resource);",
+            labels={"cedar.k8s.aws/rollout": "candidate"},
+        )
+        cand_v2 = self._obj(
+            "cand", "u2", "permit (principal, action, resource);",
+            labels={"cedar.k8s.aws/rollout": "candidate"},
+        )
+        objs = [live, cand_v1]
+
+        class _Src:
+            def list(self):
+                return list(objs)
+
+            def watch(self, on_event, stop):
+                stop.wait(5)
+
+        store = CRDPolicyStore(source=_Src(), start=False)
+        store._relist()
+        gen = store.content_generation()
+        objs[1] = cand_v2  # candidate-only edit
+        store._relist()
+        assert store.content_generation() == gen
+        objs[1] = self._obj(  # label removed: enters the live view
+            "cand", "u2", "permit (principal, action, resource);"
+        )
+        store._relist()
+        assert store.content_generation() > gen
+
+    def test_label_flip_moves_object_between_live_and_candidate(self):
+        from cedar_tpu.stores.crd import CRDPolicyStore
+
+        store = CRDPolicyStore(start=False)
+        obj = self._obj("p", "u1", "permit (principal, action, resource);")
+        store.on_add(obj)
+        assert len(store.policy_set().policies()) == 1
+        gen0 = store.content_generation()
+        labeled = self._obj(
+            "p",
+            "u1",
+            "permit (principal, action, resource);",
+            labels={"cedar.k8s.aws/rollout": "candidate"},
+        )
+        store.on_update(labeled)  # gaining the label withdraws from live
+        assert len(store.policy_set().policies()) == 0
+        assert [o.name for o in store.candidate_objects()] == ["p"]
+        assert store.content_generation() > gen0
+        store.on_update(obj)  # losing it readmits
+        assert len(store.policy_set().policies()) == 1
+        assert store.candidate_objects() == []
+
+
+class TestCedarShadowCLI:
+    def test_offline_replay_diff_report(self, tmp_path, capsys):
+        from cedar_tpu.cli.shadow import main as shadow_main
+
+        live_dir = tmp_path / "live"
+        live_dir.mkdir()
+        (live_dir / "rollout-test.cedar").write_text(LIVE_POLICIES)
+        cand_dir = tmp_path / "candidate"
+        cand_dir.mkdir()
+        (cand_dir / "rollout-test.cedar").write_text(CANDIDATE_POLICIES)
+        config = tmp_path / "config.yaml"
+        config.write_text(
+            "apiVersion: cedar.k8s.aws/v1alpha1\n"
+            "kind: StoreConfig\n"
+            "spec:\n"
+            "  stores:\n"
+            '    - type: "directory"\n'
+            "      directoryStore:\n"
+            f'        path: "{live_dir}"\n'
+        )
+        rec = tmp_path / "recordings"
+        rec.mkdir()
+        cases = [
+            ("authorize", sar_body("alice", "pods")),  # inverted
+            ("authorize", sar_body("bob", "services")),  # unchanged
+            ("admit", review_body(env="prod", uid="x1")),  # inverted
+            ("admit", review_body(env=None, uid="x2")),  # unchanged
+        ]
+        for i, (endpoint, body) in enumerate(cases):
+            fp = fingerprint_body(endpoint, body)
+            (rec / f"req-{endpoint}-{fp}-{1000 + i}.json").write_bytes(body)
+
+        rc = shadow_main(
+            [
+                str(rec),
+                "--config",
+                str(config),
+                "--candidate-dir",
+                str(cand_dir),
+                "--json",
+                "--fail-on-diff",
+            ]
+        )
+        out = capsys.readouterr().out
+        report = json.loads(out)
+        assert rc == 2  # diffs found + --fail-on-diff
+        assert report["diffs"]["allow_to_deny"] == 1
+        assert report["diffs"]["deny_to_allow"] == 1
+        assert report["diffs"]["reason_changed"] == 0
+        assert report["matches"] == {"authorization": 1, "admission": 1}
+        got_fps = {e["fingerprint"] for e in report["exemplars"]}
+        assert got_fps == {
+            fingerprint_body("authorize", sar_body("alice", "pods")),
+            fingerprint_body("admit", review_body(env="prod", uid="x1")),
+        }
+
+
+class TestAuthorizeBatchParity:
+    def test_batch_matches_single(self):
+        from cedar_tpu.server.http import get_authorizer_attributes
+
+        stores = TieredPolicyStores(
+            [MemoryStore(FILENAME, _tiers(LIVE_POLICIES)[0])]
+        )
+        authorizer = CedarWebhookAuthorizer(stores)
+        bodies = [
+            sar_body("alice", "pods"),
+            sar_body("carol", "secrets"),
+            sar_body("dave", "services"),
+            sar_body("system:kube-scheduler", "pods"),  # system skip gate
+        ]
+        attrs = [
+            get_authorizer_attributes(json.loads(b)) for b in bodies
+        ]
+        singles = [authorizer.authorize(a) for a in attrs]
+        batched = authorizer.authorize_batch(attrs)
+        assert batched == singles
+        assert batched[3] == ("no_opinion", "")
