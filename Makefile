@@ -34,8 +34,13 @@ test: ## Run the unit + differential test suite (virtual CPU devices; chaos/slow
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
 
 .PHONY: chaos
-chaos: ## Run the fault-injection resilience suite (cpu backend)
+chaos: ## Run the fault-injection resilience suite deterministically (seeded scenarios, cpu backend)
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_resilience.py -q -m chaos
+
+.PHONY: gameday
+gameday: ## Run a scripted chaos game day (cedar-chaos) against a locally spawned server; SCENARIO=kill-decode|device-loss|poison-crd|store-stall
+	JAX_PLATFORMS=cpu $(PYTHON) -m cedar_tpu.cli.chaos --spawn \
+	    --scenario $${SCENARIO:-kill-decode}
 
 .PHONY: bench
 bench: ## Run the headline benchmark on the attached device
@@ -52,6 +57,10 @@ bench-pipeline: ## Pipelined vs serial engine: decisions/sec + lone-request p50/
 .PHONY: bench-shadow
 bench-shadow: ## Shadow-rollout overhead: live p50/p99 + saturated throughput at 0/10/100% shadow sampling (cpu; docs/rollout.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --shadow
+
+.PHONY: bench-chaos
+bench-chaos: ## Game-day suite: availability/correctness/recovery SLOs under scripted faults + chaos-disabled differential (cpu; docs/resilience.md)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --chaos
 
 .PHONY: hw-validate
 hw-validate: ## Measure kernel planes (int8/bf16/pallas/segred) on the attached device
@@ -71,7 +80,7 @@ graft-check: ## Compile-check the jittable entry + multi-chip dry run
 
 # scoped to the layers with the strongest invariants first; widen as
 # modules are annotated
-LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout
+LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos
 
 .PHONY: lint
 lint: ## ruff + mypy over $(LINT_SCOPE) (missing tools are skipped with a note)

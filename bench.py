@@ -1044,6 +1044,347 @@ def run_shadow_scenario() -> int:
     return 0 if (p99_ok and tput_ok and result["diffs_detected"]) else 1
 
 
+def run_chaos_scenario() -> int:
+    """``bench.py --chaos`` (``make bench-chaos``): the four scripted game
+    days (docs/resilience.md) against one in-process WebhookServer with
+    the REAL serving stack — native SAR fast path, pipelined batcher,
+    breaker, supervisor, device recovery, directory + CRD stores — plus
+    the chaos-disabled differential:
+
+      * kill-decode   — the pipeline decode thread dies mid-traffic; the
+                        supervisor revives it
+      * device-loss   — device dispatch raises fatally; breaker trips,
+                        interpreter carries traffic, recovery rebuilds
+      * poison-crd    — a CRD Policy object's text turns to garbage; it is
+                        quarantined and last-known-good content serves on
+      * store-stall   — the directory store stalls on its reload tick
+
+    Per scenario: drive the SAME deterministic SAR stream fault-free
+    (control), under fault, and after disarm (recovery), asserting
+    availability >= SLO, ZERO decision flips among clean answers, and
+    recovered p99 within budget. The differential then proves responses
+    with the chaos plane configured-but-DISARMED are byte-identical to a
+    pristine registry, with p50 overhead inside the noise gate. cpu-only
+    by design; rc 0 iff every gate holds."""
+    import shutil
+    import statistics
+    import tempfile
+
+    from cedar_tpu.apis.v1alpha1 import PolicyObject
+    from cedar_tpu.chaos import builtin_scenario, default_registry
+    from cedar_tpu.engine.breaker import CircuitBreaker, guarded_call
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.engine.fastpath import SARFastPath
+    from cedar_tpu.cli.chaos import make_sar_stream
+    from cedar_tpu.server.admission import (
+        CedarAdmissionHandler,
+        allow_all_admission_policy_store,
+    )
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.server.http import WebhookServer
+    from cedar_tpu.server.supervisor import (
+        DeviceRecovery,
+        HeartbeatGroup,
+        Supervisor,
+    )
+    from cedar_tpu.stores.crd import CRDPolicyStore
+    from cedar_tpu.stores.directory import DirectoryPolicyStore
+    from cedar_tpu.stores.quarantine import quarantine_registry
+    from cedar_tpu.stores.store import TieredPolicyStores
+
+    t0 = time.time()
+    n_requests = _n(600, 200)
+    registry = default_registry()
+    registry.reset()
+    quarantine_registry().reset()
+
+    # --- serving stack: directory store (policy corpus on disk so the
+    # store.load seam is real) + a CRD store with two live objects
+    tmpdir = tempfile.mkdtemp(prefix="cedar-bench-chaos-")
+    rng = random.Random(3)
+    pols = []
+    for i in range(_n(400, 60)):
+        user = f"user-{rng.randint(0, 15)}"
+        res = rng.choice(["pods", "secrets", "configmaps", "services"])
+        verb = rng.choice(["get", "list", "watch", "create"])
+        pols.append(
+            f'permit (principal, action == k8s::Action::"{verb}", '
+            "resource is k8s::Resource) when { "
+            f'principal.name == "{user}" && resource.resource == "{res}" }};'
+        )
+    with open(os.path.join(tmpdir, "bench.cedar"), "w") as f:
+        f.write("\n".join(pols))
+    dir_store = DirectoryPolicyStore(
+        tmpdir, refresh_interval_s=0.1, start_ticker=True
+    )
+
+    crd_objects = {
+        "crd-allow": (
+            'permit (principal, action == k8s::Action::"list", '
+            "resource is k8s::Resource) when { "
+            'principal.name == "user-1" && resource.resource == "pods" };'
+        ),
+        "crd-forbid": (
+            'forbid (principal, action == k8s::Action::"delete", '
+            "resource is k8s::Resource) when { "
+            'resource.resource == "secrets" };'
+        ),
+    }
+
+    class _Source:
+        def list(self):
+            return [
+                PolicyObject.from_dict(
+                    {
+                        "metadata": {"name": name, "uid": f"{name}-uid"},
+                        "spec": {"content": content},
+                    }
+                )
+                for name, content in crd_objects.items()
+            ]
+
+        def watch(self, on_event, stop):
+            stop.wait()
+
+    crd_store = CRDPolicyStore(source=_Source(), start=False)
+    crd_store._relist()
+    crd_store._load_complete = True
+
+    stores = TieredPolicyStores([dir_store, crd_store])
+    engine = TPUPolicyEngine(name="authorization")
+    engine.load([s.policy_set() for s in stores], warm="off")
+    breaker = CircuitBreaker(
+        name="authorization", failure_threshold=3, recovery_s=0.5
+    )
+    recovery = DeviceRecovery(
+        engine, breaker=breaker, name="authorization", warm=False
+    )
+
+    def _guarded(device_call, fallback_call):
+        return guarded_call(
+            breaker, device_call, fallback_call, "authorization",
+            on_error=recovery.observe,
+        )
+
+    authorizer = CedarWebhookAuthorizer(
+        stores,
+        evaluate=lambda em, r: _guarded(
+            lambda: engine.evaluate(em, r),
+            lambda: stores.is_authorized(em, r),
+        ),
+        evaluate_batch=lambda items: _guarded(
+            lambda: engine.evaluate_batch(items),
+            lambda: [stores.is_authorized(em, r) for em, r in items],
+        ),
+    )
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores(
+            list(stores.stores) + [allow_all_admission_policy_store()]
+        )
+    )
+    fastpath = SARFastPath(engine, authorizer, breaker=breaker)
+    fastpath.on_device_error = recovery.observe
+    supervisor = Supervisor(interval_s=0.1, wedge_budget_s=5.0)
+    supervisor.register_recovery(recovery)
+    server = WebhookServer(
+        authorizer,
+        handler,
+        fastpath=fastpath,
+        pipeline_depth=2,
+        request_timeout_s=0.5,
+        supervisor=supervisor,
+    )
+    supervisor.register(
+        "batcher.authorization",
+        threads=lambda: list(server._batcher._threads),
+        restart=lambda reason: server._batcher.revive(
+            force=reason.startswith("wedged")
+        ),
+        heartbeat=HeartbeatGroup(lambda: server._batcher.heartbeats),
+    )
+    supervisor.start()
+
+    def drive(stream):
+        """[(clean, decision)], latencies — in-process twin of the
+        cedar-chaos HTTP driver."""
+        results, lat = [], []
+        for body in stream:
+            t = time.monotonic()
+            try:
+                doc = server.handle_authorize(body)
+            except Exception:  # noqa: BLE001 — an escaping error = unavailable
+                results.append((False, None))
+                lat.append(time.monotonic() - t)
+                continue
+            lat.append(time.monotonic() - t)
+            status = doc.get("status") or {}
+            results.append(
+                (
+                    not status.get("evaluationError"),
+                    (bool(status.get("allowed")), bool(status.get("denied"))),
+                )
+            )
+        return results, lat
+
+    def p99(lat):
+        s = sorted(lat)
+        return s[min(len(s) - 1, int(len(s) * 0.99))] if s else 0.0
+
+    stream = make_sar_stream(n_requests, seed=5)
+    drive(stream[: _n(200, 60)])  # warm every serving shape pre-timing
+
+    def gameday(name, mid_fault=None):
+        """control -> fault -> recovery protocol for one builtin scenario;
+        ``mid_fault`` runs once while armed (event triggers)."""
+        scenario = builtin_scenario(name)
+        slo = scenario["slo"]
+        registry.reset()
+        control, _control_lat = drive(stream)
+        control_lat = drive(stream)[1]  # second pass: steady-state p99
+        registry.configure(scenario)
+        registry.arm()
+        if mid_fault is not None:
+            mid_fault()
+        fault, fault_lat = drive(stream)
+        registry.disarm()
+        time.sleep(1.5)  # supervisor revive + breaker recovery settle
+        recovery_res, recovery_lat = drive(stream)
+        clean = sum(1 for ok, _ in fault if ok)
+        availability = clean / len(fault)
+        wrong = sum(
+            1
+            for (f_ok, f_dec), (c_ok, c_dec) in zip(fault, control)
+            if f_ok and c_ok and f_dec != c_dec
+        )
+        wrong += sum(
+            1
+            for (r_ok, r_dec), (c_ok, c_dec) in zip(recovery_res, control)
+            if r_ok and c_ok and r_dec != c_dec
+        )
+        budget = p99(control_lat) * slo["recovery_p99_ratio"] + (
+            slo["recovery_p99_floor_ms"] / 1e3
+        )
+        out = {
+            "availability": round(availability, 4),
+            "wrong_decisions": wrong,
+            "control_p99_ms": round(p99(control_lat) * 1e3, 2),
+            "fault_p99_ms": round(p99(fault_lat) * 1e3, 2),
+            "recovered_p99_ms": round(p99(recovery_lat) * 1e3, 2),
+            "injected": sum(
+                sum(r.get("fired", 0) for r in s["rules"])
+                for s in registry.stats()["seams"].values()
+            ),
+            "ok": bool(
+                availability >= slo["availability"]
+                and wrong == 0
+                and p99(recovery_lat) <= budget
+            ),
+        }
+        registry.reset()
+        return out
+
+    results = {}
+    results["kill-decode"] = gameday("kill-decode")
+
+    results["device-loss"] = gameday("device-loss")
+    results["device-loss"]["rebuilds"] = recovery.rebuilds
+
+    def poison_crd():
+        # a MODIFIED event arrives for crd-allow; the armed corrupt rule
+        # turns its text to garbage at parse time -> quarantine +
+        # last-known-good retention (readiness must hold throughout)
+        crd_store.on_update(
+            PolicyObject.from_dict(
+                {
+                    "metadata": {
+                        "name": "crd-allow", "uid": "crd-allow-uid-2",
+                    },
+                    "spec": {"content": crd_objects["crd-allow"] + "\n"},
+                }
+            )
+        )
+
+    ready_before = server.ready()
+    results["poison-crd"] = gameday("poison-crd", mid_fault=poison_crd)
+    results["poison-crd"]["quarantined"] = quarantine_registry().count()
+    results["poison-crd"]["readyz_held"] = bool(ready_before and server.ready())
+    results["poison-crd"]["ok"] = bool(
+        results["poison-crd"]["ok"]
+        and results["poison-crd"]["quarantined"] >= 1
+        and results["poison-crd"]["readyz_held"]
+    )
+
+    # store-stall: the latency rule fires on the directory ticker's next
+    # load_policies tick (0.1s interval), stalling reloads while the
+    # serving path keeps answering from the compiled set
+    results["store-stall"] = gameday("store-stall")
+
+    # --- chaos-disabled differential + overhead (the "compiled in but
+    # off" claim): responses with a scenario CONFIGURED but disarmed must
+    # be byte-identical to a pristine registry, at a cost below the bench
+    # noise floor. A disarmed chaos_fire is one attribute read (~100ns)
+    # against a multi-ms request, so any measurable wall delta IS noise —
+    # the gate therefore measures the floor explicitly (pristine run vs
+    # pristine run) and requires the configured-but-off delta to sit
+    # inside it, per round, on the median.
+    diff_stream = make_sar_stream(_n(1000, 300), seed=9)
+    registry.reset()
+    r0 = [json.dumps(server.handle_authorize(b)) for b in diff_stream]
+    registry.configure(builtin_scenario("device-loss"))  # configured...
+    registry.disarm()  # ...but OFF
+    r1 = [json.dumps(server.handle_authorize(b)) for b in diff_stream]
+    identical = r0 == r1
+    deltas, noises = [], []
+    for _ in range(3):
+        registry.reset()  # pristine: no scenario configured
+        t_a = time.monotonic()
+        drive(diff_stream)
+        wall_p1 = time.monotonic() - t_a
+        t_a = time.monotonic()
+        drive(diff_stream)
+        wall_p2 = time.monotonic() - t_a  # pristine again: the noise floor
+        registry.configure(builtin_scenario("device-loss"))
+        registry.disarm()
+        t_b = time.monotonic()
+        drive(diff_stream)
+        off_wall = time.monotonic() - t_b
+        base = min(wall_p1, wall_p2)
+        noises.append(abs(wall_p2 / wall_p1 - 1.0))
+        deltas.append(off_wall / base - 1.0)
+    overhead = statistics.median(deltas)
+    noise_floor = statistics.median(noises)
+    overhead_ok = overhead <= max(2.0 * noise_floor, 0.05)
+    registry.reset()
+
+    result = {
+        "metric": "chaos_gameday_suite",
+        "smoke": _SMOKE,
+        "requests": n_requests,
+        "scenarios": results,
+        "disabled_byte_identical": bool(identical),
+        "disabled_overhead_pct": round(overhead * 100, 2),
+        "noise_floor_pct": round(noise_floor * 100, 2),
+        "disabled_overhead_ok": bool(overhead_ok),
+        "supervisor_restarts": {
+            name: c["restarts"]
+            for name, c in supervisor.status()["components"].items()
+        },
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    ok = (
+        all(r["ok"] for r in results.values())
+        and identical
+        and overhead_ok
+    )
+    result["pass"] = bool(ok)
+    print(json.dumps(result))
+    server.stop()
+    dir_store.close()
+    crd_store.close()
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def _timed(fn):
     t = time.time()
     fn()
@@ -1772,6 +2113,18 @@ if __name__ == "__main__":
 
         jax.config.update("jax_cpu_enable_async_dispatch", True)
         sys.exit(run_shadow_scenario())
+
+    if "--chaos" in sys.argv:
+        # game-day suite (make bench-chaos): cpu-only BY DESIGN — the
+        # availability/correctness claims are about the failure machinery,
+        # not device speed, and the scripted faults must hit a
+        # deterministic backend. Seeded scenarios, no wall-clock
+        # randomness in the injection schedule.
+        os.environ.setdefault("CEDAR_NATIVE_THREADS", "1")
+        from cedar_tpu.jaxenv import force_cpu
+
+        force_cpu()
+        sys.exit(run_chaos_scenario())
 
     if "--cache" in sys.argv:
         # decision-cache microbenchmark (make bench-cache): cpu-only BY

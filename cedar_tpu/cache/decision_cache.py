@@ -36,6 +36,8 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional, Tuple
 
+from ..chaos.registry import chaos_fire
+
 log = logging.getLogger(__name__)
 
 # decision classes — string values match server.authorizer DECISION_*
@@ -166,7 +168,13 @@ class DecisionCache:
 
     def get(self, key: str):
         """Cached value for ``key``, or None. Expired / stale-generation
-        entries are deleted on sight and count as misses."""
+        entries are deleted on sight and count as misses.
+
+        The chaos seam below can raise/stall here by scenario
+        (docs/resilience.md); the serving paths contain a raising cache by
+        treating the lookup as a miss — a sick cache must only ever cost
+        an evaluation, never an answer."""
+        chaos_fire("cache.get")
         gen = self._generation()
         now = self._clock()
         shard = self._shard_for(key)
@@ -208,6 +216,7 @@ class DecisionCache:
         BEFORE the decision was evaluated (see current_generation); when
         omitted it is resolved at insert time, which is only safe for
         values not derived from the policy set (tests, fixed fixtures)."""
+        chaos_fire("cache.put")
         ttl = self.ttl_for(decision_class)
         if ttl <= 0:
             return False

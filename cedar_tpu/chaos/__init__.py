@@ -1,0 +1,45 @@
+"""cedar_tpu.chaos — fault injection + game-day scenarios.
+
+The registry (registry.py) holds the named seams threaded through every
+serving layer; scenario.py holds the scenario file format and the built-in
+game days; the cedar-chaos CLI (cli/chaos.py) drives scenarios against a
+live server. docs/resilience.md "Game days" is the runbook.
+"""
+
+from .registry import (
+    SEAMS,
+    ChaosError,
+    ChaosRegistry,
+    InjectionRule,
+    Seam,
+    ThreadKilled,
+    TokenBucket,
+    chaos_fire,
+    default_registry,
+)
+from .scenario import (
+    BUILTIN_SCENARIOS,
+    DEFAULT_SLO,
+    ScenarioError,
+    builtin_scenario,
+    load_scenario,
+    load_scenario_file,
+)
+
+__all__ = [
+    "SEAMS",
+    "ChaosError",
+    "ChaosRegistry",
+    "InjectionRule",
+    "Seam",
+    "ThreadKilled",
+    "TokenBucket",
+    "chaos_fire",
+    "default_registry",
+    "BUILTIN_SCENARIOS",
+    "DEFAULT_SLO",
+    "ScenarioError",
+    "builtin_scenario",
+    "load_scenario",
+    "load_scenario_file",
+]

@@ -233,10 +233,13 @@ def build_server(args) -> WebhookServer:
     def _tpu_backend(
         tier_stores: TieredPolicyStores, breaker=None, name: str = "hybrid"
     ):
-        """(engine, evaluate, evaluate_batch) for a tier stack: compiled
-        eval with an interpreter guard until the first successful load, and
-        a circuit breaker that routes evaluation to the tiered interpreter
-        stores while the device plane is sick."""
+        """(engine, evaluate, evaluate_batch, recovery) for a tier stack:
+        compiled eval with an interpreter guard until the first successful
+        load, a circuit breaker that routes evaluation to the tiered
+        interpreter stores while the device plane is sick, and — with
+        supervision enabled — a DeviceRecovery observing the guard's
+        exceptions so a fatal device loss trips the breaker and rebuilds
+        the engine off the serving path (docs/resilience.md)."""
         from ..engine.breaker import guarded_call
         from ..engine.evaluator import TPUPolicyEngine
 
@@ -247,6 +250,15 @@ def build_server(args) -> WebhookServer:
             mesh=mesh, segred=segred, name=name,
             warm_max_batch=args.max_batch,
         )
+        recovery = None
+        if args.supervisor_interval_seconds > 0:
+            from ..server.supervisor import DeviceRecovery
+
+            recovery = DeviceRecovery(
+                tier_engine, breaker=breaker, name=name,
+                warm_max_batch=args.max_batch,
+            )
+        on_error = recovery.observe if recovery is not None else None
 
         def _guarded(device_call, fallback_call):
             """engine/breaker.py guarded_call plus the pre-load interpreter
@@ -255,7 +267,9 @@ def build_server(args) -> WebhookServer:
             sick device plane)."""
             if not tier_engine.loaded:
                 return fallback_call()
-            return guarded_call(breaker, device_call, fallback_call, name)
+            return guarded_call(
+                breaker, device_call, fallback_call, name, on_error=on_error
+            )
 
         def evaluate(entities, request):
             return _guarded(
@@ -269,7 +283,7 @@ def build_server(args) -> WebhookServer:
                 lambda: [tier_stores.is_authorized(em, r) for em, r in items],
             )
 
-        return tier_engine, evaluate, evaluate_batch
+        return tier_engine, evaluate, evaluate_batch, recovery
 
     evaluate = None
     evaluate_batch = None
@@ -277,11 +291,13 @@ def build_server(args) -> WebhookServer:
     admission_engine = None
     reloader = None
     authz_breaker = None
+    authz_recovery = None
+    admission_recovery = None
     if args.backend == "tpu" and not len(stores.stores):
         log.warning("TPU backend requested but no stores configured; using interpreter")
     elif args.backend == "tpu":
         authz_breaker = _make_breaker("authorization")
-        engine, evaluate, evaluate_batch = _tpu_backend(
+        engine, evaluate, evaluate_batch, authz_recovery = _tpu_backend(
             stores, breaker=authz_breaker, name="authorization"
         )
         reloader = TPUReloader(
@@ -302,8 +318,12 @@ def build_server(args) -> WebhookServer:
         if native_available():
             # the fast path shares the engine's breaker: a tripped device
             # plane routes BOTH the native raw pipeline and the hybrid
-            # evaluate path to the interpreter
+            # evaluate path to the interpreter. It also shares the
+            # device-loss recovery observer: a fatal XLA error in either
+            # plane triggers the one rebuild.
             fastpath = SARFastPath(engine, authorizer, breaker=authz_breaker)
+            if authz_recovery is not None:
+                fastpath.on_device_error = authz_recovery.observe
             log.info("native SAR fast path enabled")
         else:
             log.warning(
@@ -327,10 +347,13 @@ def build_server(args) -> WebhookServer:
         # predicates fall back per policy with exact verdict merging. Both
         # engines ride the one reloader's fingerprint pass.
         admission_breaker = _make_breaker("admission")
-        admission_engine, admission_evaluate, admission_evaluate_batch = (
-            _tpu_backend(
-                admission_stores, breaker=admission_breaker, name="admission"
-            )
+        (
+            admission_engine,
+            admission_evaluate,
+            admission_evaluate_batch,
+            admission_recovery,
+        ) = _tpu_backend(
+            admission_stores, breaker=admission_breaker, name="admission"
         )
         reloader.targets.append((admission_engine, admission_stores))
 
@@ -464,6 +487,8 @@ def build_server(args) -> WebhookServer:
             admission_fastpath = AdmissionFastPath(
                 admission_engine, admission_handler, breaker=admission_breaker
             )
+            if admission_recovery is not None:
+                admission_fastpath.on_device_error = admission_recovery.observe
             log.info("native admission fast path enabled")
 
     injector = ErrorInjector(
@@ -497,7 +522,48 @@ def build_server(args) -> WebhookServer:
                 out[name] = rep.to_dict()
         return out
 
-    return WebhookServer(
+    # self-healing supervision (server/supervisor.py, docs/resilience.md):
+    # a watchdog over every long-lived worker thread — batcher stages,
+    # the shadow worker, CRD watch, directory reload tickers — restarting
+    # dead/wedged components with their queues drained-or-shed; 0 disables
+    supervisor = None
+    if args.supervisor_interval_seconds > 0:
+        from ..server.supervisor import Supervisor
+
+        supervisor = Supervisor(
+            interval_s=args.supervisor_interval_seconds,
+            wedge_budget_s=args.supervisor_wedge_seconds,
+        )
+        for rec in (authz_recovery, admission_recovery):
+            if rec is not None:
+                supervisor.register_recovery(rec)
+
+    # startup chaos scenario (cedar_tpu/chaos): gated by the same non-prod
+    # confirmation flag as the reference error injector — an armed
+    # scenario exists to BREAK serving
+    if args.chaos_scenario:
+        if not args.confirm_non_prod_inject_errors:
+            raise ValueError(
+                "--chaos-scenario requires --confirm-non-prod-inject-errors "
+                "(fault injection is never a production default)"
+            )
+        from ..chaos import (
+            builtin_scenario,
+            default_registry,
+            load_scenario_file,
+        )
+
+        scenario = builtin_scenario(args.chaos_scenario)
+        if scenario is None:
+            scenario = load_scenario_file(args.chaos_scenario)
+        default_registry().configure(scenario)
+        default_registry().arm()
+        log.warning(
+            "chaos scenario %r ARMED at startup (non-prod gate confirmed)",
+            scenario.get("name", args.chaos_scenario),
+        )
+
+    server = WebhookServer(
         authorizer=authorizer,
         admission_handler=admission_handler,
         error_injector=injector,
@@ -524,7 +590,60 @@ def build_server(args) -> WebhookServer:
         rollout=rollout,
         rollout_control_enabled=rollout_control_enabled,
         rollout_control_token=rollout_control_token,
+        supervisor=supervisor,
+        chaos_control_enabled=args.confirm_non_prod_inject_errors,
     )
+    if supervisor is not None:
+        _register_supervised(supervisor, server, rollout, stores)
+    return server
+
+
+def _register_supervised(supervisor, server, rollout, stores) -> None:
+    """Put every long-lived worker under the watchdog. ``threads``
+    providers re-read the live objects so post-revive generations stay
+    covered; restarts force-abandon wedged (still-alive) workers only when
+    the probe said wedged."""
+    from ..server.supervisor import HeartbeatGroup
+
+    def _force(reason: str) -> bool:
+        return reason.startswith("wedged")
+
+    for name, batcher in (
+        ("batcher.authorization", server._batcher),
+        ("batcher.admission", server._adm_raw_batcher),
+        ("batcher.admission_python", server._admission_batcher),
+    ):
+        if batcher is None:
+            continue
+        supervisor.register(
+            name,
+            threads=lambda b=batcher: list(b._threads),
+            restart=lambda reason, b=batcher: b.revive(force=_force(reason)),
+            heartbeat=HeartbeatGroup(lambda b=batcher: b.heartbeats),
+        )
+    if rollout is not None:
+        supervisor.register(
+            "shadow.worker",
+            threads=rollout.shadow_worker_threads,
+            restart=lambda reason: rollout.revive_shadow(force=_force(reason)),
+            heartbeat=HeartbeatGroup(rollout.shadow_heartbeats),
+            # shadow drains can legitimately sit in a candidate jit trace
+            # for a while: give the wedge probe extra slack
+            wedge_budget_s=max(60.0, 4 * supervisor.wedge_budget_s),
+        )
+    for store in getattr(stores, "stores", []):
+        if hasattr(store, "watch_threads"):
+            supervisor.register(
+                f"store.crd.{store.name()}",
+                threads=store.watch_threads,
+                restart=lambda reason, s=store: s.revive(force=_force(reason)),
+            )
+        elif hasattr(store, "ticker_threads"):
+            supervisor.register(
+                f"store.directory.{store.name()}",
+                threads=store.ticker_threads,
+                restart=lambda reason, s=store: s.revive(force=_force(reason)),
+            )
 
 
 def make_parser() -> argparse.ArgumentParser:
@@ -664,6 +783,23 @@ def make_parser() -> argparse.ArgumentParser:
         help="consecutive successful probes that close a half-open breaker",
     )
     resilience.add_argument(
+        "--supervisor-interval-seconds",
+        type=float,
+        default=1.0,
+        help="watchdog poll interval for the self-healing supervisor: "
+        "dead or wedged worker threads (batcher stages, shadow worker, "
+        "CRD watch, store tickers) are restarted with their queues "
+        "drained-or-shed, and fatal device errors trigger an engine "
+        "rebuild (0 disables supervision; docs/resilience.md)",
+    )
+    resilience.add_argument(
+        "--supervisor-wedge-seconds",
+        type=float,
+        default=10.0,
+        help="busy-heartbeat age after which a live worker thread counts "
+        "as wedged and is force-restarted (idle workers never trip this)",
+    )
+    resilience.add_argument(
         "--shutdown-grace-seconds",
         type=float,
         default=5.0,
@@ -764,7 +900,17 @@ def make_parser() -> argparse.ArgumentParser:
     gameday.add_argument(
         "--confirm-non-prod-inject-errors",
         action="store_true",
-        help="required gate for error injection (never set in production)",
+        help="required gate for error injection — the reference response "
+        "injector, the /chaos/* control endpoints, and --chaos-scenario "
+        "(never set in production)",
+    )
+    gameday.add_argument(
+        "--chaos-scenario",
+        default="",
+        help="arm a chaos scenario at startup: a built-in name "
+        "(kill-decode, device-loss, poison-crd, store-stall) or a "
+        "scenario JSON file; requires --confirm-non-prod-inject-errors "
+        "(docs/resilience.md, cedar-chaos)",
     )
 
     debug = parser.add_argument_group("debug")

@@ -28,13 +28,33 @@ results the serial batch fn would.
 
 from __future__ import annotations
 
+import logging
 import queue as _queue
 import threading
 import time
 from typing import Callable, List, Optional, Sequence, TypeVar
 
+from ..chaos.registry import chaos_fire
+from ..server.supervisor import Heartbeat
+
+log = logging.getLogger(__name__)
+
 T = TypeVar("T")
 R = TypeVar("R")
+
+
+def _record_worker_death(component: str) -> None:
+    """A worker thread is unwinding on an uncaught exception: make the
+    death VISIBLE (log + cedar_worker_deaths_total) at the point it
+    happens — before supervision, a dead stage just left its bounded
+    queue filling forever with nothing in any dashboard."""
+    log.critical("worker thread %s died on an uncaught exception", component)
+    try:
+        from ..server.metrics import record_worker_death
+
+        record_worker_death(component)
+    except Exception:  # noqa: BLE001 — metrics must never mask the death
+        pass
 
 # end-of-stream marker flowing through the pipeline hand-off queues on
 # drain: the collector sends it after its last batch, each stage forwards
@@ -115,14 +135,48 @@ class MicroBatcher:
         self._pending: dict = {}
         self._stopped = False
         self._threads: List[threading.Thread] = []
+        # worker generation: revive() bumps it, and every worker loop
+        # checks its captured epoch so a superseded (dead-and-replaced, or
+        # wedged-and-abandoned) generation can never race the fresh one
+        # for queued work
+        self._epoch = 0
+        # per-stage liveness beacons for the supervisor's wedge detection
+        # (server/supervisor.py): busy+stale = wedged, idle = healthy
+        self.heartbeats: dict = {}
         self._start_workers()
 
     def _start_workers(self) -> None:
+        self.heartbeats.setdefault("worker", Heartbeat())
         self._thread = threading.Thread(
             target=self._run, name="micro-batcher", daemon=True
         )
         self._threads = [self._thread]
         self._thread.start()
+
+    def revive(self, force: bool = False) -> bool:
+        """Restart dead worker threads (supervisor hook). ``force`` also
+        abandons live-but-wedged workers: the epoch bump makes any old
+        generation exit at its next loop iteration, and fresh workers take
+        over the submit queue. Queued items survive (the new workers
+        evaluate them); work held INSIDE a wedged stage call completes
+        whenever that call returns, or its waiters' deadlines free them.
+        Returns False when nothing needed doing (or the batcher is
+        stopped)."""
+        with self._cv:
+            if self._stopped:
+                return False
+            dead = [t for t in self._threads if not t.is_alive()]
+            if not dead and not force:
+                return False
+            self._epoch += 1
+            self._cv.notify_all()
+            self._start_workers()
+        log.warning(
+            "micro-batcher revived (%d dead worker(s)%s)",
+            len(dead),
+            ", forced" if force else "",
+        )
+        return True
 
     def _alive(self) -> bool:
         """True while every worker thread is running: any dead stage means
@@ -253,16 +307,22 @@ class MicroBatcher:
         if slot.key is not None and self._pending.get(slot.key) is entry:
             del self._pending[slot.key]
 
-    def _form_batch(self) -> Optional[list]:
+    def _form_batch(self, epoch: Optional[int] = None) -> Optional[list]:
         """Wait for work and claim one batch under the lock — the shared
         front end of the serial worker and the pipeline collector. Returns
         None when stopped with an empty queue (the worker should exit), or
-        a possibly-empty batch (empty: every queued item withdrew during
+        when ``epoch`` no longer matches (this worker generation was
+        superseded by revive(); a fresh generation owns the queue), or a
+        possibly-empty batch (empty: every queued item withdrew during
         the forming window — never call the batch fn with zero rows, a
         no-op "success" must not feed breaker recovery probes)."""
         with self._cv:
             while not self._queue and not self._stopped:
+                if epoch is not None and self._epoch != epoch:
+                    return None
                 self._cv.wait()
+            if epoch is not None and self._epoch != epoch:
+                return None
             if self._stopped and not self._queue:
                 return None
             # batch-forming window: let concurrent submitters pile in
@@ -310,12 +370,26 @@ class MicroBatcher:
             slot.event.set()
 
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        except BaseException:  # noqa: BLE001 — visibility, then unwind
+            _record_worker_death("batcher.worker")
+            raise
+
+    def _run_loop(self) -> None:
+        epoch = self._epoch
+        hb = self.heartbeats["worker"]
         while True:
-            batch = self._form_batch()
+            hb.idle()
+            batch = self._form_batch(epoch)
             if batch is None:
                 return
             if not batch:
                 continue
+            # chaos seam OUTSIDE the per-batch containment below: a kill
+            # rule unwinds this worker exactly like a C-extension crash
+            chaos_fire("pipeline.collect")
+            hb.busy()
             try:
                 self._complete_batch(batch, self._fn([it for it, _ in batch]))
             except BaseException as e:  # noqa: BLE001 — propagate per-item
@@ -368,8 +442,6 @@ class PipelinedBatcher(MicroBatcher):
         self._pool = ThreadPoolExecutor(
             self.encode_workers, thread_name_prefix="pipe-encode"
         )
-        self._dispatch_q: _queue.Queue = _queue.Queue(maxsize=self.depth)
-        self._decode_q: _queue.Queue = _queue.Queue(maxsize=self.depth)
         self._batches_total = 0
         # batches accepted into the pipeline but not yet decoded; lets the
         # decode stage distinguish starvation (work exists upstream, the
@@ -396,18 +468,106 @@ class PipelinedBatcher(MicroBatcher):
         return all(t.is_alive() for t in self._threads)
 
     def _start_workers(self) -> None:
-        self._thread = threading.Thread(
-            target=self._run_collect, name="pipe-collect", daemon=True
+        # fresh hand-off queues per worker generation: after a revive() a
+        # superseded (possibly wedged) stage thread still holds references
+        # to ITS generation's queues, so it can never consume — or block
+        # on — the new stages' work. Stage threads receive their epoch,
+        # queues, and downstream consumer as bound arguments for the same
+        # reason.
+        for stage in ("collect", "dispatch", "decode"):
+            self.heartbeats.setdefault(stage, Heartbeat())
+        self._dispatch_q = _queue.Queue(maxsize=self.depth)
+        self._decode_q = _queue.Queue(maxsize=self.depth)
+        epoch = self._epoch
+        self._decoder = threading.Thread(
+            target=self._run_decode, name="pipe-decode", daemon=True,
+            args=(epoch, self._decode_q),
         )
         self._dispatcher = threading.Thread(
-            target=self._run_dispatch, name="pipe-dispatch", daemon=True
+            target=self._run_dispatch, name="pipe-dispatch", daemon=True,
+            args=(epoch, self._dispatch_q, self._decode_q, self._decoder),
         )
-        self._decoder = threading.Thread(
-            target=self._run_decode, name="pipe-decode", daemon=True
+        self._thread = threading.Thread(
+            target=self._run_collect, name="pipe-collect", daemon=True,
+            args=(epoch, self._dispatch_q, self._dispatcher),
         )
         self._threads = [self._thread, self._dispatcher, self._decoder]
         for t in self._threads:
             t.start()
+
+    def revive(self, force: bool = False) -> bool:
+        """Restart the pipeline after a stage death (or, forced, a wedge):
+        supersede the old worker generation, SHED every batch sitting in
+        the old hand-off queues (their slots fail fast with a restart
+        error — the callers' serving paths answer the bounded degraded
+        response), and bring up fresh stages with fresh queues. Batches
+        held inside a wedged stage call are not reachable; their waiters'
+        deadlines bound the damage."""
+        with self._cv:
+            if self._stopped:
+                return False
+            dead = [t for t in self._threads if not t.is_alive()]
+            if not dead and not force:
+                return False
+            self._epoch += 1
+            old_threads = list(self._threads)
+            old_qs = [self._dispatch_q, self._decode_q]
+            self._cv.notify_all()
+        # wake + retire the surviving old stages: a sentinel unblocks a
+        # blocked get, and the epoch check exits the loop
+        shed = self._shed_queues(old_qs)
+        for q in old_qs:
+            try:
+                q.put_nowait(_SENTINEL)
+            except _queue.Full:
+                pass
+        for t in old_threads:
+            if t.is_alive():
+                t.join(timeout=0.5)
+        # second pass: anything a still-live old stage pushed between the
+        # first drain and its exit
+        shed += self._shed_queues(old_qs)
+        with self._inflight_lock:
+            self._inflight = 0
+        with self._cv:
+            if self._stopped:
+                return False
+            self._start_workers()
+        log.warning(
+            "pipeline revived: %d dead stage(s)%s, %d queued batch(es) shed",
+            len(dead),
+            ", forced" if force else "",
+            shed,
+        )
+        return True
+
+    def _shed_superseded(self, item) -> None:
+        """A superseded stage pulled ``item`` off its old queue in the
+        window between revive()'s drain passes: shed it like the drain
+        would have."""
+        if item is not None and item is not _SENTINEL:
+            self._fail_batch(
+                item[0],
+                RuntimeError("pipeline stage restarted; batch shed"),
+            )
+
+    def _shed_queues(self, qs) -> int:
+        """Fail every batch queued in ``qs`` (revive shed path)."""
+        shed = 0
+        for q in qs:
+            while True:
+                try:
+                    item = q.get_nowait()
+                except _queue.Empty:
+                    break
+                if item is _SENTINEL:
+                    continue
+                self._fail_batch(
+                    item[0],
+                    RuntimeError("pipeline stage restarted; batch shed"),
+                )
+                shed += 1
+        return shed
 
     def debug_stats(self) -> dict:
         with self._cv:
@@ -454,13 +614,26 @@ class PipelinedBatcher(MicroBatcher):
 
     # --------------------------------------------------------------- stages
 
-    def _run_collect(self) -> None:
+    def _run_collect(self, epoch, dispatch_q, dispatcher) -> None:
+        try:
+            self._collect_loop(epoch, dispatch_q, dispatcher)
+        except BaseException:  # noqa: BLE001 — visibility, then unwind
+            _record_worker_death("pipeline.collect")
+            raise
+
+    def _collect_loop(self, epoch, dispatch_q, dispatcher) -> None:
+        hb = self.heartbeats["collect"]
         while True:
-            batch = self._form_batch()
+            hb.idle()
+            batch = self._form_batch(epoch)
             if batch is None:
                 break
             if not batch:
                 continue
+            # chaos kill seam OUTSIDE the per-batch containment: unwinds
+            # this stage like a real crash would
+            chaos_fire("pipeline.collect")
+            hb.busy()
             self._batches_total += 1
             items = [it for it, _ in batch]
             try:
@@ -470,7 +643,7 @@ class PipelinedBatcher(MicroBatcher):
                 continue
             t0 = time.monotonic()
             self._inflight_add(1)
-            ok = self._put(self._dispatch_q, (batch, fut), self._dispatcher)
+            ok = self._put(dispatch_q, (batch, fut), dispatcher)
             # time blocked on a full dispatch queue = downstream (device or
             # decode) backpressure reaching the collector
             self._stall("collect", time.monotonic() - t0)
@@ -479,13 +652,33 @@ class PipelinedBatcher(MicroBatcher):
                 self._fail_batch(
                     batch, RuntimeError("pipeline dispatch stage died")
                 )
-        self._put(self._dispatch_q, _SENTINEL, self._dispatcher)
+        if self._epoch == epoch:
+            self._put(dispatch_q, _SENTINEL, dispatcher)
 
-    def _run_dispatch(self) -> None:
+    def _run_dispatch(self, epoch, dispatch_q, decode_q, decoder) -> None:
+        try:
+            self._dispatch_loop(epoch, dispatch_q, decode_q, decoder)
+        except BaseException:  # noqa: BLE001 — visibility, then unwind
+            _record_worker_death("pipeline.dispatch")
+            raise
+
+    def _dispatch_loop(self, epoch, dispatch_q, decode_q, decoder) -> None:
+        hb = self.heartbeats["dispatch"]
         while True:
-            item = self._dispatch_q.get()
+            hb.idle()
+            item = dispatch_q.get()
+            if self._epoch != epoch:
+                # superseded by revive(): a fresh stage owns the work — but
+                # a real batch this get RACED away from revive's queue
+                # drain must still fail fast, not strand its waiters until
+                # their deadlines
+                self._shed_superseded(item)
+                return
+            # chaos seam after the queue get, outside any per-batch try
+            chaos_fire("pipeline.dispatch_q")
+            hb.busy()
             if item is _SENTINEL:
-                self._put(self._decode_q, _SENTINEL, self._decoder)
+                self._put(decode_q, _SENTINEL, decoder)
                 return
             batch, fut = item
             t0 = time.monotonic()
@@ -504,17 +697,32 @@ class PipelinedBatcher(MicroBatcher):
                 self._inflight_add(-1)
                 self._fail_batch(batch, e)
                 continue
-            if not self._put(self._decode_q, (batch, ctx), self._decoder):
+            if not self._put(decode_q, (batch, ctx), decoder):
                 self._inflight_add(-1)
                 self._fail_batch(
                     batch, RuntimeError("pipeline decode stage died")
                 )
 
-    def _run_decode(self) -> None:
+    def _run_decode(self, epoch, decode_q) -> None:
+        try:
+            self._decode_loop(epoch, decode_q)
+        except BaseException:  # noqa: BLE001 — visibility, then unwind
+            _record_worker_death("pipeline.decode")
+            raise
+
+    def _decode_loop(self, epoch, decode_q) -> None:
+        hb = self.heartbeats["decode"]
         while True:
             busy = self._inflight > 0
             t0 = time.monotonic()
-            item = self._decode_q.get()
+            hb.idle()
+            item = decode_q.get()
+            if self._epoch != epoch:
+                self._shed_superseded(item)  # see _dispatch_loop
+                return
+            # chaos seam after the queue get, outside any per-batch try
+            chaos_fire("pipeline.decode_q")
+            hb.busy()
             if busy:
                 # time waiting for launched work WHILE batches were in
                 # flight = pipeline starvation (encode/dispatch cannot keep

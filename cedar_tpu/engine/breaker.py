@@ -151,15 +151,34 @@ class CircuitBreaker:
             if self._failures >= self.failure_threshold:
                 self._transition(OPEN)
 
+    def force_open(self) -> None:
+        """Trip immediately, bypassing the failure-streak accounting — the
+        device-loss recovery (server/supervisor.py) calls this when an
+        error is classified FATAL: waiting for threshold-1 more broken
+        batches would only burn more callers' deadline budgets."""
+        with self._lock:
+            self._transition(OPEN)
 
-def guarded_call(breaker, device_call, fallback_call, path: str):
+    def half_open_now(self) -> None:
+        """Skip the remaining recovery wait and start probing — the
+        re-arm step after a successful engine rebuild. Half-open (not
+        closed): live probes, not the rebuild's own warm calls, decide
+        whether the new plane actually serves."""
+        with self._lock:
+            if self._state == OPEN:
+                self._transition(HALF_OPEN)
+
+
+def guarded_call(breaker, device_call, fallback_call, path: str, on_error=None):
     """Run ``device_call()`` behind an optional breaker — the one guard
     shared by the native fastpath batches (_RawFastPath._guarded_process)
     and the CLI's hybrid evaluate closures. An open breaker routes the whole
     call to ``fallback_call()``, a raising device plane feeds the breaker
     and falls back (bounded degradation instead of an error), and
     success latency drives breach accounting and recovery probes. ``path``
-    labels the fallback metric."""
+    labels the fallback metric. ``on_error`` (optional, (exc) -> bool)
+    observes the raising exception — the device-loss recovery's fatal
+    classifier hangs here; its failures never reach the caller."""
     from ..server.metrics import record_fallback_batch
 
     if breaker is not None and not breaker.allow():
@@ -168,10 +187,15 @@ def guarded_call(breaker, device_call, fallback_call, path: str):
     t0 = time.monotonic()
     try:
         result = device_call()
-    except Exception:  # noqa: BLE001 — degrade, never drop the call
+    except Exception as e:  # noqa: BLE001 — degrade, never drop the call
         log.exception("%s device call failed; interpreter fallback", path)
         if breaker is not None:
             breaker.record_failure()
+        if on_error is not None:
+            try:
+                on_error(e)
+            except Exception:  # noqa: BLE001 — recovery must not break serving
+                log.exception("%s device-error observer failed", path)
         record_fallback_batch(path, "evaluator_error")
         return fallback_call()
     if breaker is not None:

@@ -57,6 +57,7 @@ from ..compiler.pack import (
 from ..lang.authorize import ALLOW, DENY, Diagnostics, PolicySet, Reason
 from ..lang.entities import EntityMap
 from ..lang.eval import Env, Request, policy_matches
+from ..chaos.registry import chaos_fire
 from ..lang.values import EvalError
 from ..compiler.table import encode_request_codes
 from ..ops.match import (
@@ -763,6 +764,34 @@ class TPUPolicyEngine:
         self._warm_first.set()
         return prior, generation
 
+    def rebuild_compiled(self) -> bool:
+        """Re-place the CURRENT compiled set on the backend from its
+        retained host-side pack — the device-loss recovery primitive
+        (server/supervisor.py DeviceRecovery). The PackedPolicySet is pure
+        host memory and survives any device death, so this performs no
+        policy recompilation: a fresh _CompiledSet re-uploads the packed
+        tensors, and the jitted kernels come from the shape-keyed cache —
+        compile-free when the runtime survived (chaos drills, same-process
+        resets), a re-trace off the serving path when it did not. Bumps
+        load_generation so cached decisions from the dead plane die.
+        Returns False with nothing loaded."""
+        with self._lock:
+            cs = self._compiled
+        if cs is None:
+            return False
+        new = _CompiledSet(
+            cs.packed, self.device, use_pallas=self.use_pallas,
+            mesh=self.mesh, segred=self.segred,
+        )
+        with self._lock:
+            # a concurrent load()/adopt_compiled() swap wins: its set is
+            # newer than the one we re-placed
+            if self._compiled is not cs:
+                return False
+            self._compiled = new
+            self.load_generation += 1
+        return True
+
     def _mesh_step(self, packed: PackedPolicySet):
         """The cached pjit evaluation step for this mesh + set shape."""
         key = (packed.n_tiers, packed.has_gate)
@@ -804,6 +833,11 @@ class TPUPolicyEngine:
     def evaluate_batch(
         self, items: Sequence[Tuple[EntityMap, Request]]
     ) -> List[Tuple[str, Diagnostics]]:
+        # chaos seam (docs/resilience.md): the hybrid evaluate path's
+        # device launch — an injected fatal error here exercises the same
+        # breaker + device-recovery machinery a real lost backend would,
+        # without needing the native fast path
+        chaos_fire("engine.dispatch")
         cs = self._compiled
         if cs is None:
             raise RuntimeError("TPUPolicyEngine: no policy set loaded")

@@ -40,6 +40,7 @@ from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..chaos.registry import chaos_fire
 from ..native import (
     F_ADM_ERROR,
     F_ADM_NS_SKIP,
@@ -175,6 +176,11 @@ class _RawFastPath:
         # batches skip the device plane and run the per-row interpreter
         # fallback; device outcomes (errors + latency) feed it back
         self.breaker = breaker
+        # optional (exc) -> bool observer for device-plane exceptions
+        # (server/supervisor.py DeviceRecovery.observe): a fatal XLA/runtime
+        # error triggers a breaker trip + engine rebuild off the serving
+        # path; evaluation bugs are ignored by its classifier
+        self.on_device_error = None
         self._snap: Optional[_Snapshot] = None
         self._build_lock = threading.Lock()
         # accumulated encode/device/decode seconds (reset per process_raw
@@ -269,6 +275,7 @@ class _RawFastPath:
             lambda: self.process_raw(bodies, snap),
             lambda: [fallback_one(b) for b in bodies],
             self._METRIC_PATH,
+            on_error=self.on_device_error,
         )
 
     def process_raw(self, bodies: Sequence[bytes], snap: _Snapshot) -> list:
@@ -390,6 +397,8 @@ class _RawFastPath:
         """A pipelined stage raised: feed the breaker and answer the whole
         batch from the per-row interpreter fallback — the exact degradation
         guarded_call gives the serial path."""
+        import sys
+
         from ..server.metrics import record_fallback_batch
 
         log.exception(
@@ -399,6 +408,12 @@ class _RawFastPath:
         )
         if self.breaker is not None:
             self.breaker.record_failure()
+        exc = sys.exc_info()[1]
+        if self.on_device_error is not None and exc is not None:
+            try:
+                self.on_device_error(exc)
+            except Exception:  # noqa: BLE001 — recovery must not break serving
+                log.exception("device-error observer failed")
         record_fallback_batch(self._METRIC_PATH, "evaluator_error")
         return [self._fallback_row(b) for b in bodies]
 
@@ -422,6 +437,7 @@ class _RawFastPath:
         """Host-only half of chunk preparation: C++ encode, encoder-gate
         flag routing, extras-width trim. No device interaction — this is
         the piece the pipelined batcher runs on its encode worker pool."""
+        chaos_fire("engine.encode")
         codes, extras, counts, flags, aux = self._encode(snap, bodies)
         # object ndarray, not a list: clean rows scatter in one vectorized
         # fancy-index assignment (_finish_words); per-row assignments
@@ -456,6 +472,7 @@ class _RawFastPath:
         """Device half of chunk preparation: launch the encoded rows' match
         asynchronously (dispatch only — the readback happens in
         _finish_words)."""
+        chaos_fire("engine.dispatch")
         results, py_rows, idx, ok_codes, ok_extras, aux = enc
         fin = None
         if idx is not None:
@@ -505,6 +522,7 @@ class _RawFastPath:
         if fin is None:
             self._record_routing(len(bodies), len(py_rows), 0, 0, 0)
             return ctx
+        chaos_fire("engine.decode")
         t0 = time.monotonic()
         out = fin()
         words, bitmap = out[0], (out[2] if len(out) == 3 else None)

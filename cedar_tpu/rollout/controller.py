@@ -172,6 +172,9 @@ class RolloutController:
         staged candidate (its diff report is discarded). Raises
         RolloutError when the candidate fails to load or is rejected by
         analysis."""
+        from ..chaos.registry import chaos_fire
+
+        chaos_fire("rollout.stage")
         if tiers is None:
             if directory:
                 tiers = candidate_tiers_from_directory(directory)
@@ -392,6 +395,9 @@ class RolloutController:
         whose warm-up finished (``force=True`` overrides — the first
         post-promotion requests may then pay compiles). The previous
         compiled sets are retained for rollback()."""
+        from ..chaos.registry import chaos_fire
+
+        chaos_fire("rollout.promote")
         with self._lock:
             cand = self._candidate
             if self._state != STATE_STAGED or cand is None:
@@ -489,6 +495,23 @@ class RolloutController:
 
     def stop(self) -> None:
         self._stop_shadow(self._detach_shadow())
+
+    def shadow_worker_threads(self) -> list:
+        """The CURRENT shadow worker thread(s) — supervisor liveness probe
+        (empty with nothing staged, so the probe reads healthy)."""
+        shadow = self._shadow
+        return shadow.worker_threads() if shadow is not None else []
+
+    def revive_shadow(self, force: bool = False) -> bool:
+        """Supervisor restart hook for the current shadow worker."""
+        shadow = self._shadow
+        return shadow.revive(force) if shadow is not None else False
+
+    def shadow_heartbeats(self) -> dict:
+        """The current shadow worker's heartbeat (supervisor wedge probe;
+        re-read per check so re-staging swaps stay covered)."""
+        shadow = self._shadow
+        return {"shadow": shadow.heartbeat} if shadow is not None else {}
 
     def _detach_shadow(self):
         """Unhook the shadow evaluator under the lock and hand it back for

@@ -34,6 +34,8 @@ import random
 import threading
 from typing import Optional
 
+from ..chaos.registry import chaos_fire
+from ..server.supervisor import Heartbeat
 from .report import DiffReport, compare_admission, compare_authorization
 
 log = logging.getLogger(__name__)
@@ -97,7 +99,14 @@ class ShadowEvaluator:
         # the cedar-shadow CLI) can assert a complete report
         self._pending = 0
         self._pending_cv = threading.Condition()
-        self._worker = threading.Thread(
+        # supervisor liveness beacon (server/supervisor.py): idle while
+        # blocked on the queue, busy only inside a drain batch
+        self.heartbeat = Heartbeat()
+        self._worker: Optional[threading.Thread] = None
+        self._start_worker()
+
+    def _start_worker(self) -> None:
+        worker = threading.Thread(
             target=self._run, name="shadow-eval", daemon=True
         )
         # the worker spends its life inside XLA calls (candidate
@@ -107,8 +116,29 @@ class ShadowEvaluator:
         # (engine/evaluator.py) and _run polls the shared shutdown flag
         from ..engine.evaluator import track_warm_thread
 
-        track_warm_thread(self._worker)
-        self._worker.start()
+        track_warm_thread(worker)
+        self._worker = worker
+        worker.start()
+
+    def worker_threads(self) -> list:
+        """The drain worker thread(s) (supervisor liveness probe)."""
+        return [self._worker] if self._worker is not None else []
+
+    def revive(self, force: bool = False) -> bool:
+        """Restart a dead (or, forced, wedged) drain worker (supervisor
+        hook). The queue and its pending offers survive — the fresh worker
+        picks them up; a superseded old worker exits at its next loop
+        check. Items lost inside a killed worker were already
+        pending-decremented by its drain finally, so drain() cannot
+        wedge on them."""
+        if self._stop.is_set():
+            return False
+        w = self._worker
+        if w is not None and w.is_alive() and not force:
+            return False
+        log.warning("shadow evaluator: restarting drain worker")
+        self._start_worker()
+        return True
 
     # --------------------------------------------------------------- intake
 
@@ -125,6 +155,16 @@ class ShadowEvaluator:
         if rate < 1.0 and self._rng.random() >= rate:
             return False
         path = _PATHS.get(endpoint, endpoint)
+        try:
+            # chaos seam, CONTAINED: offer() is called on the live serving
+            # path, so an injected error OR kill here must shed the shadow
+            # observation, never surface to (or unwind) the request
+            # thread. A latency rule genuinely stalls the caller — that is
+            # what a slow offer path IS, and what such a scenario tests.
+            chaos_fire("shadow.offer")
+        except BaseException:  # noqa: BLE001 — includes ThreadKilled
+            self.report.record_shed(path)
+            return False
         try:
             with self._pending_cv:
                 self._q.put_nowait((endpoint, body, live))
@@ -165,17 +205,31 @@ class ShadowEvaluator:
 
         try:
             self._run_loop(warm_shutdown_set)
+        except BaseException:  # noqa: BLE001 — visibility, then unwind
+            try:
+                from ..server.metrics import record_worker_death
+
+                record_worker_death("shadow.worker")
+            except Exception:  # noqa: BLE001 — must not mask the death
+                pass
+            log.critical("shadow worker died on an uncaught exception")
+            raise
         finally:
             untrack_warm_thread(threading.current_thread())
 
     def _run_loop(self, warm_shutdown_set) -> None:
         import time
 
+        me = threading.current_thread()
         while not self._stop.is_set() and not warm_shutdown_set():
+            if self._worker is not me:
+                return  # superseded by revive(): a fresh worker owns the queue
+            self.heartbeat.idle()
             try:
                 first = self._q.get(timeout=0.05)
             except queue.Empty:
                 continue
+            self.heartbeat.busy()
             self._stop.wait(BATCH_LINGER_S)  # see BATCH_LINGER_S
             items = [first]
             while len(items) < self.batch_max:
@@ -203,6 +257,10 @@ class ShadowEvaluator:
                 self._stop.wait(min(1.0, elapsed * (1.0 / duty - 1.0)))
 
     def _process(self, items) -> None:
+        # chaos seam: an error rule exercises the keep-the-worker-alive
+        # containment below the caller; a kill rule unwinds the worker
+        # (the supervisor's shadow-restart drill)
+        chaos_fire("shadow.process")
         auth = [(body, live) for ep, body, live in items if ep == "authorize"]
         adm = [(body, live) for ep, body, live in items if ep == "admit"]
         if auth:

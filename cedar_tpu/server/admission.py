@@ -171,8 +171,13 @@ class CedarAdmissionHandler:
                 key = fingerprint_admission_request(req)
                 # generation snapshot BEFORE evaluation (see
                 # DecisionCache.current_generation)
-                gen = self.cache.current_generation()
-                hit = self.cache.get(key)
+                try:
+                    gen = self.cache.current_generation()
+                    hit = self.cache.get(key)
+                except Exception:  # noqa: BLE001 — a sick cache is a miss
+                    log.exception("admission cache lookup failed; evaluating")
+                    gen = hit = None
+                    key = None
                 if hit is not None:
                     # cached values carry no uid — the fingerprint excludes
                     # the per-review nonce, so the response is rebuilt
@@ -181,7 +186,8 @@ class CedarAdmissionHandler:
                         uid=req.uid, allowed=hit[0], message=hit[1]
                     )
                     continue
-                cache_keys[i] = (key, gen)
+                if key is not None:
+                    cache_keys[i] = (key, gen)
             try:
                 entities, cedar_req = self._build(req)
             except Exception as e:  # conversion error
@@ -244,12 +250,15 @@ class CedarAdmissionHandler:
         if diagnostics is not None and diagnostics.errors:
             return
         key, generation = keyed
-        self.cache.put(
-            key,
-            (response.allowed, response.message),
-            "allow" if response.allowed else "deny",
-            generation=generation,
-        )
+        try:
+            self.cache.put(
+                key,
+                (response.allowed, response.message),
+                "allow" if response.allowed else "deny",
+                generation=generation,
+            )
+        except Exception:  # noqa: BLE001 — a sick cache only costs re-evaluation
+            log.exception("admission cache insert failed; decision served")
 
     def _decide(self, req, decision, diagnostics) -> AdmissionResponse:
         if decision == DENY:

@@ -232,10 +232,16 @@ class CedarWebhookAuthorizer:
             from ..cache.fingerprint import fingerprint_attributes
 
             cache_key = fingerprint_attributes(attributes)
-            # snapshot before evaluating: a mid-evaluation reload must not
-            # let this result survive under the post-reload generation
-            cache_gen = self.cache.current_generation()
-            hit = self.cache.get(cache_key)
+            try:
+                # snapshot before evaluating: a mid-evaluation reload must
+                # not let this result survive under the post-reload
+                # generation
+                cache_gen = self.cache.current_generation()
+                hit = self.cache.get(cache_key)
+            except Exception:  # noqa: BLE001 — a sick cache is a miss
+                log.exception("authorizer cache lookup failed; evaluating")
+                cache_key = None
+                hit = None
             if hit is not None:
                 return hit
 
@@ -245,7 +251,12 @@ class CedarWebhookAuthorizer:
         # errored evaluations are transient — never cached; everything else
         # is deterministic under the current policy-set generation
         if cache_key is not None and not diagnostic.errors:
-            self.cache.put(cache_key, result, result[0], generation=cache_gen)
+            try:
+                self.cache.put(
+                    cache_key, result, result[0], generation=cache_gen
+                )
+            except Exception:  # noqa: BLE001 — the answer still serves
+                log.exception("authorizer cache insert failed")
         return result
 
 

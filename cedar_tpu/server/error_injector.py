@@ -5,14 +5,33 @@ enabled, a token-bucket limiter (burst 1) per failure kind swaps the real
 decision for a fake error (NoOpinion + error) or a fake deny, at most
 ``rate`` times per second each. Gated by --confirm-non-prod-inject-errors
 (options.go:184-187).
+
+This is now a thin shim over the chaos seam registry (cedar_tpu/chaos):
+the ErrorInjector is the ``response`` seam with two rate-scheduled rules,
+the token bucket lives in chaos.registry.TokenBucket (re-exported here as
+RateLimiter for compatibility), and every artificial swap counts into
+``cedar_chaos_injections_total{seam="response"}``. Scenario files can
+script the same seam (docs/resilience.md "Game days"); this class keeps
+the reference's flag surface and limiter semantics exactly.
 """
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
+
+from ..chaos.registry import (
+    RESPONSE_SEAM,
+    InjectionRule,
+    Seam,
+    TokenBucket,
+    _record_injection,
+)
+
+# compatibility alias: the reference-parity token bucket moved into the
+# chaos registry so seam rules and this injector share one implementation
+RateLimiter = TokenBucket
 
 
 @dataclass
@@ -20,30 +39,6 @@ class ErrorInjectionConfig:
     enabled: bool = False
     artificial_error_rate: float = 0.0
     artificial_deny_rate: float = 0.0
-
-
-class RateLimiter:
-    """Token bucket: ``rate`` tokens/second, burst 1 (golang.org/x/time/rate
-    semantics as used by the reference with burst=1)."""
-
-    def __init__(self, rate: float, now=time.monotonic):
-        self.rate = rate
-        self._now = now
-        self._tokens = 1.0 if rate > 0 else 0.0
-        self._last = now()
-        self._lock = threading.Lock()
-
-    def allow(self) -> bool:
-        if self.rate <= 0:
-            return False
-        with self._lock:
-            now = self._now()
-            self._tokens = min(1.0, self._tokens + (now - self._last) * self.rate)
-            self._last = now
-            if self._tokens >= 1.0:
-                self._tokens -= 1.0
-                return True
-            return False
 
 
 class InjectedFault(RuntimeError):
@@ -61,7 +56,8 @@ class BatchFaultInjector:
     stalls of ``latency_s`` seconds. Very high rates (e.g. 1e9) fire on
     every call, which is what deterministic chaos tests want; production
     gamedays use small rates behind the same non-prod confirmation gate as
-    ErrorInjector."""
+    ErrorInjector. Scenario-scripted equivalents live on the
+    engine.encode/dispatch/decode seams (cedar_tpu/chaos)."""
 
     def __init__(
         self,
@@ -93,11 +89,30 @@ class BatchFaultInjector:
 
 
 class ErrorInjector:
+    """The reference-parity response injector: a privately held chaos
+    ``response`` seam with ``response_error`` / ``response_deny`` rules at
+    the configured token-bucket rates. Rule order matches the reference:
+    the error limiter is consulted first, the deny limiter second, and a
+    deny firing overrides the error swap."""
+
     def __init__(self, cfg: Optional[ErrorInjectionConfig], now=time.monotonic):
         cfg = cfg or ErrorInjectionConfig()
         self.enabled = cfg.enabled
-        self._error_limiter = RateLimiter(cfg.artificial_error_rate, now)
-        self._deny_limiter = RateLimiter(cfg.artificial_deny_rate, now)
+        self._seam = Seam(RESPONSE_SEAM)
+        self._seam.add_rule(
+            InjectionRule(
+                kind="response_error",
+                rate=cfg.artificial_error_rate,
+                now=now,
+            )
+        )
+        self._seam.add_rule(
+            InjectionRule(
+                kind="response_deny",
+                rate=cfg.artificial_deny_rate,
+                now=now,
+            )
+        )
 
     def inject_if_enabled(
         self, decision: str, reason: str, error: Optional[str] = None
@@ -105,8 +120,6 @@ class ErrorInjector:
         """(decision, reason, error) pass-through unless a limiter fires."""
         if not self.enabled:
             return decision, reason, error
-        if self._error_limiter.allow():
-            decision, reason, error = "no_opinion", "", "encountered error"
-        if self._deny_limiter.allow():
-            decision, reason, error = "deny", "Authorization denied", None
-        return decision, reason, error
+        return self._seam.fire(
+            (decision, reason, error), on_fire=_record_injection
+        )

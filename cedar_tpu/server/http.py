@@ -27,6 +27,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..chaos.registry import chaos_fire
 from ..engine.batcher import DeadlineExceeded
 from ..entities.admission import AdmissionRequest
 from ..entities.attributes import (
@@ -208,6 +209,8 @@ class WebhookServer:
         rollout=None,
         rollout_control_enabled: bool = True,
         rollout_control_token: Optional[str] = None,
+        supervisor=None,
+        chaos_control_enabled: bool = False,
     ):
         self.authorizer = authorizer
         self.admission_handler = admission_handler
@@ -328,6 +331,17 @@ class WebhookServer:
         # GET /debug/rollout stays open — it is read-only.
         self.rollout_control_enabled = rollout_control_enabled
         self.rollout_control_token = rollout_control_token
+        # self-healing supervisor (server/supervisor.py): started/stopped
+        # with the server when wired; /debug/supervisor serves its status
+        # (plus the poison-object quarantine) either way
+        self.supervisor = supervisor
+        # chaos game-day control (cedar_tpu/chaos, docs/resilience.md):
+        # POST /chaos/{configure,arm,disarm,reset} on the metrics listener.
+        # Injection wrecks live answers BY DESIGN, so control is off
+        # unless the operator started the webhook with the same
+        # --confirm-non-prod-inject-errors gate the reference injector
+        # uses; GET /debug/chaos stays readable.
+        self.chaos_control_enabled = chaos_control_enabled
         self.drain_grace_s = drain_grace_s
         self._draining = False
         self._inflight = 0
@@ -382,6 +396,12 @@ class WebhookServer:
             decision, reason, error = self.error_injector.inject_if_enabled(
                 decision, reason
             )
+            # scenario-driven twin of the injector above: the shared
+            # registry's `response` seam (cedar_tpu/chaos), a no-op
+            # attribute read unless a game day armed it
+            decision, reason, error = chaos_fire(
+                "response", (decision, reason, error)
+            )
             return sar_response(decision, reason, error)
         finally:
             label = "<error>" if error else _DECISION_LABEL[decision]
@@ -412,16 +432,26 @@ class WebhookServer:
             return self._authorize_uncached(body, request_id)
         # generation snapshot BEFORE evaluation: a reload landing while the
         # leader evaluates leaves the entry stamped pre-reload, so it dies
-        # at its first post-reload lookup instead of surviving the reload
-        gen = cache.current_generation()
-        hit = cache.get(key)
+        # at its first post-reload lookup instead of surviving the reload.
+        # A RAISING cache (chaos cache.get seam, or a real bug) degrades to
+        # the uncached path: a sick cache may cost an evaluation, never an
+        # answer.
+        try:
+            gen = cache.current_generation()
+            hit = cache.get(key)
+        except Exception:  # noqa: BLE001 — a sick cache is a miss
+            log.exception("decision cache lookup failed; evaluating")
+            return self._authorize_uncached(body, request_id)
         if hit is not None:
             return hit[0], hit[1], None
 
         def _leader():
             res = self._authorize_uncached(body, request_id, coalesce_key=key)
             if res[2] is None:
-                cache.put(key, (res[0], res[1]), res[0], generation=gen)
+                try:
+                    cache.put(key, (res[0], res[1]), res[0], generation=gen)
+                except Exception:  # noqa: BLE001 — the answer still serves
+                    log.exception("decision cache insert failed")
             return res
 
         try:
@@ -863,6 +893,43 @@ class WebhookServer:
                         log.exception("rollout status failed")
                         doc = {"error": "rollout status failed"}
                     self._send_json(doc)
+                elif self.path == "/debug/supervisor":
+                    # self-healing state (docs/resilience.md): per-component
+                    # thread/heartbeat health + restart counts, device
+                    # recovery status, and the quarantine summary
+                    doc = {}
+                    try:
+                        if server.supervisor is not None:
+                            doc = server.supervisor.status()
+                        from ..stores.quarantine import quarantine_registry
+
+                        doc["quarantine"] = quarantine_registry().snapshot()
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("supervisor status failed")
+                        doc = {"error": "supervisor status failed"}
+                    self._send_json(doc)
+                elif self.path == "/debug/quarantine":
+                    # poison-object quarantine: WHICH objects are being
+                    # served from last-known-good content, and why
+                    try:
+                        from ..stores.quarantine import quarantine_registry
+
+                        doc = quarantine_registry().snapshot()
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("quarantine snapshot failed")
+                        doc = {"error": "quarantine snapshot failed"}
+                    self._send_json(doc)
+                elif self.path == "/debug/chaos":
+                    # chaos-plane state: armed flag, scenario name, per-seam
+                    # call/fire counts ({} armed=False when never configured)
+                    try:
+                        from ..chaos.registry import default_registry
+
+                        doc = default_registry().stats()
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("chaos stats failed")
+                        doc = {"error": "chaos stats failed"}
+                    self._send_json(doc)
                 elif self.path == "/debug/analysis":
                     # the last policy-set analysis report (load-time
                     # lowerability/shadowing/conflict findings + capacity);
@@ -886,6 +953,9 @@ class WebhookServer:
                 optional {"force": true}, /rollout/rollback. Served on the
                 plain metrics listener like the debug endpoints — operator
                 plane, not the apiserver-facing TLS port."""
+                if self.path.startswith("/chaos/"):
+                    self._chaos_control()
+                    return
                 if server.rollout is None:
                     self.send_error(404)
                     return
@@ -965,6 +1035,58 @@ class WebhookServer:
                     return
                 self._send_json(out)
 
+            def _chaos_control(self):
+                """Game-day control (docs/resilience.md): POST
+                /chaos/configure with a scenario JSON body, then
+                /chaos/arm; /chaos/disarm stops injection instantly;
+                /chaos/reset also drops the scenario. Gated by the
+                non-prod confirmation flag — injection exists to BREAK the
+                serving path."""
+                if not server.chaos_control_enabled:
+                    self._send_json(
+                        {
+                            "error": "chaos control is disabled; start the "
+                            "webhook with --confirm-non-prod-inject-errors "
+                            "(docs/resilience.md)"
+                        },
+                        403,
+                    )
+                    return
+                from ..chaos.registry import default_registry
+                from ..chaos.scenario import ScenarioError, load_scenario
+
+                registry = default_registry()
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    self.send_error(400, "bad Content-Length")
+                    return
+                if length < 0 or length > MAX_BODY_BYTES:
+                    self.send_error(413, "request body too large")
+                    return
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    if self.path == "/chaos/configure":
+                        scenario = load_scenario(raw or b"{}")
+                        registry.configure(scenario)
+                    elif self.path == "/chaos/arm":
+                        registry.arm()
+                    elif self.path == "/chaos/disarm":
+                        registry.disarm()
+                    elif self.path == "/chaos/reset":
+                        registry.reset()
+                    else:
+                        self.send_error(404)
+                        return
+                except (ScenarioError, ValueError) as e:
+                    self._send_json({"error": str(e)}, 400)
+                    return
+                except Exception as e:  # noqa: BLE001 — report, never crash
+                    log.exception("chaos control %s failed", self.path)
+                    self._send_json({"error": str(e)}, 500)
+                    return
+                self._send_json(registry.stats())
+
         return MetricsHandler
 
     def _prebuild_snapshots(self) -> None:
@@ -1003,6 +1125,8 @@ class WebhookServer:
             name="metrics-server",
             daemon=True,
         ).start()
+        if self.supervisor is not None:
+            self.supervisor.start()
         scheme = "https" if self.certfile else "http"
         log.info(
             "serving on %s://%s:%d (metrics http://%s:%d)",
@@ -1026,6 +1150,13 @@ class WebhookServer:
         wait up to the grace period for in-flight requests, stop the
         listeners, then drain and join the micro-batchers."""
         grace = self.drain_grace_s if drain_grace_s is None else drain_grace_s
+        if self.supervisor is not None:
+            # stop supervision FIRST: reviving a stage mid-teardown would
+            # race the batcher joins below
+            try:
+                self.supervisor.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception("supervisor stop failed")
         self.begin_drain()
         deadline = time.monotonic() + grace
         with self._inflight_cv:

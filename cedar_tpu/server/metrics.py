@@ -429,6 +429,70 @@ policy_analysis_findings_total = REGISTRY.register(
 )
 
 
+# Supervision / chaos metrics (server/supervisor.py, cedar_tpu/chaos,
+# docs/resilience.md "Game days"): the self-healing plane. Outside the
+# cedar_authorizer_* request subsystem — these describe worker threads and
+# injected faults, not request traffic.
+worker_deaths_total = REGISTRY.register(
+    Counter(
+        "cedar_worker_deaths_total",
+        "Long-lived worker threads that exited on an uncaught exception, "
+        "partitioned by component (batcher stages, shadow worker, CRD "
+        "watch, store reload ticker). Any nonzero rate is a bug or an "
+        "injected fault; without supervision a dead worker leaves its "
+        "bounded queue filling forever, so alert on this even before the "
+        "supervisor restarts it.",
+        ["component"],
+    )
+)
+
+supervisor_restarts_total = REGISTRY.register(
+    Counter(
+        "cedar_supervisor_restarts_total",
+        "Component restarts performed by the supervisor watchdog, "
+        "partitioned by component. Dead threads and wedged (stale busy "
+        "heartbeat) stages both count; queued work held by the restarted "
+        "stage is shed with per-request error answers rather than "
+        "stranded.",
+        ["component"],
+    )
+)
+
+device_rebuilds_total = REGISTRY.register(
+    Counter(
+        "cedar_device_rebuilds_total",
+        "TPU engine rebuilds performed by the device-loss recovery: a "
+        "fatal XLA/runtime error tripped the breaker, the compiled set "
+        "was re-placed from the retained host-side pack, the warm ladder "
+        "re-ran, and the breaker re-armed half-open.",
+        [],
+    )
+)
+
+quarantined_objects = REGISTRY.register(
+    Gauge(
+        "cedar_quarantined_objects",
+        "Policy objects currently quarantined (parse or load-gate "
+        "failures); serving continues on each object's last-known-good "
+        "content. /debug/quarantine names them — a nonzero steady state "
+        "means someone shipped a poison policy object.",
+        [],
+    )
+)
+
+chaos_injections_total = REGISTRY.register(
+    Counter(
+        "cedar_chaos_injections_total",
+        "Faults injected by the chaos plane, partitioned by seam and kind "
+        "(error / latency / corrupt / kill / response_error / "
+        "response_deny). Nonzero only while a game-day scenario is armed "
+        "(or the reference-parity response injector is enabled); alert on "
+        "this in production — it should never move outside game days.",
+        ["seam", "kind"],
+    )
+)
+
+
 def record_request_total(decision: str) -> None:
     request_total.inc(decision=decision)
 
@@ -527,3 +591,23 @@ def set_fastpath_lowerable(tier: int, count: int) -> None:
 def record_analysis_findings(kind: str, n: int) -> None:
     if n:
         policy_analysis_findings_total.inc(n, kind=kind)
+
+
+def record_worker_death(component: str) -> None:
+    worker_deaths_total.inc(component=component)
+
+
+def record_supervisor_restart(component: str) -> None:
+    supervisor_restarts_total.inc(component=component)
+
+
+def record_device_rebuild() -> None:
+    device_rebuilds_total.inc()
+
+
+def set_quarantined_objects(n: int) -> None:
+    quarantined_objects.set(n)
+
+
+def record_chaos_injection(seam: str, kind: str) -> None:
+    chaos_injections_total.inc(seam=seam, kind=kind)

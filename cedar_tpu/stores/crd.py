@@ -23,10 +23,12 @@ import urllib.error
 from typing import Callable, List, Optional, Protocol
 
 from ..apis.v1alpha1 import GROUP, PolicyObject, VERSION
+from ..chaos.registry import chaos_fire
 from ..lang.authorize import PolicySet
 from ..lang.lexer import ParseError
 from ..lang.parser import parse_policies
 from ..server.backoff import Backoff
+from .quarantine import quarantine_registry
 
 log = logging.getLogger(__name__)
 
@@ -79,14 +81,61 @@ class CRDPolicyStore:
         # from the live serving set and held here for the shadow-rollout
         # controller to stage (rollout/source.candidate_tiers_from_objects)
         self._candidate_objects: dict = {}
+        # object names THIS store quarantined: a relist after a watch
+        # outage must clear entries for objects deleted while disconnected
+        # (their DELETED events never arrived)
+        self._quarantined: set = set()
         self._generation = 0
         self._lock = threading.Lock()
         self._load_complete = False
         self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
         if start:
-            threading.Thread(
-                target=self._populate_policies, name="crd-store", daemon=True
-            ).start()
+            self._start_thread()
+
+    def _start_thread(self) -> None:
+        self._thread = threading.Thread(
+            target=self._watch_main, name="crd-store", daemon=True
+        )
+        self._thread.start()
+
+    def watch_threads(self) -> list:
+        """The list+watch worker thread(s) (supervisor liveness probe)."""
+        return [self._thread] if self._thread is not None else []
+
+    def revive(self, force: bool = False) -> bool:
+        """Restart a dead (or, forced, wedged) list+watch thread
+        (supervisor hook). The fresh thread relists from scratch — the
+        content-keyed generation means an unchanged corpus relist never
+        recompiles downstream. A superseded old thread exits at its next
+        loop check."""
+        t = self._thread
+        if self._stop.is_set():
+            return False
+        if t is not None and t.is_alive() and not force:
+            return False
+        log.warning("CRD store: restarting list+watch thread")
+        self._start_thread()
+        return True
+
+    def _watch_main(self) -> None:
+        try:
+            self._populate_policies()
+        except BaseException:  # noqa: BLE001 — visibility, then unwind
+            try:
+                from ..server.metrics import record_worker_death
+
+                record_worker_death("crd.watch")
+            except Exception:  # noqa: BLE001 — must not mask the death
+                pass
+            log.critical("CRD watch thread died on an uncaught exception")
+            raise
+
+    def _superseded(self) -> bool:
+        """True when this thread's generation was replaced by revive()
+        (direct test calls from the owning thread are never superseded)."""
+        t = self._thread
+        return t is not None and t is not threading.current_thread()
 
     def close(self) -> None:
         self._stop.set()
@@ -116,7 +165,7 @@ class CRDPolicyStore:
         # store permanently (the old initial-list behavior) nor invite a
         # synchronized fixed-cadence retry herd
         backoff = Backoff(base_s=1.0, cap_s=30.0)
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not self._superseded():
             try:
                 self._relist()
                 break
@@ -128,7 +177,7 @@ class CRDPolicyStore:
             return
         self._load_complete = True
         backoff.reset()
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not self._superseded():
             try:
                 self._source.watch(self._dispatch, self._stop)
                 backoff.reset()  # a clean watch cycle proves the link healthy
@@ -156,7 +205,14 @@ class CRDPolicyStore:
             self._stop.wait(backoff.next() if backoff is not None else 2.0)
 
     def _relist(self) -> None:
+        chaos_fire("store.crd.relist")
         objs = self._source.list()
+        # objects deleted while the watch was down never sent a DELETED
+        # event: their quarantine entries leave with them at the relist
+        listed = {obj.name for obj in objs}
+        for name in self._quarantined - listed:
+            quarantine_registry().clear("crd", name)
+            self._quarantined.discard(name)
         with self._lock:
             ps = PolicySet()
             ids_by_object: dict = {}
@@ -169,16 +225,31 @@ class CRDPolicyStore:
                         obj.uid, obj.spec.content, True,
                     )
                     continue
+                uid, content = obj.uid, obj.spec.content
                 policies = self._parse(obj)
                 if policies is None:
-                    continue
+                    # poison-object quarantine with last-known-good
+                    # retention: the object is broken (parse failure or
+                    # strict-gate rejection), but its PREVIOUS content
+                    # served fine — keep serving that instead of silently
+                    # dropping the object's policies from the corpus. The
+                    # retained (uid, content) keeps the live-view
+                    # generation stable, so no recompile churns either.
+                    prev = self._content_by_object.get(obj.name)
+                    if prev is None or prev[2]:
+                        continue  # nothing good to retain
+                    uid, content = prev[0], prev[1]
+                    try:
+                        policies = parse_policies(content, obj.name)
+                    except ParseError:
+                        continue  # previous content gone bad too: drop
                 ids = []
                 for i, p in enumerate(policies):
-                    pid = f"{obj.name}{i}-{obj.uid}"
+                    pid = f"{obj.name}{i}-{uid}"
                     ps.add(p, policy_id=pid)
                     ids.append(pid)
                 ids_by_object[obj.name] = ids
-                content_by_object[obj.name] = (obj.uid, obj.spec.content, False)
+                content_by_object[obj.name] = (uid, content, False)
             self._policies = ps
             self._ids_by_object = ids_by_object
             self._candidate_objects = candidate_objects
@@ -228,12 +299,26 @@ class CRDPolicyStore:
             return list(self._candidate_objects.values())
 
     def _parse(self, obj: PolicyObject):
+        # chaos seam: a corrupt rule turns this object's policy text into
+        # garbage — the scripted poison-CRD game day (docs/resilience.md)
+        content = chaos_fire("store.crd.object", obj.spec.content)
         try:
-            policies = parse_policies(obj.spec.content, obj.name)
+            policies = parse_policies(content, obj.name)
         except ParseError as e:
             log.error("Error parsing policy %s: %s", obj.name, e)
+            quarantine_registry().quarantine("crd", obj.name, str(e))
+            self._quarantined.add(obj.name)
             return None
-        return self._validated(obj, policies)
+        policies = self._validated(obj, policies)
+        if policies is None:
+            quarantine_registry().quarantine(
+                "crd", obj.name, "rejected by strict load-time validation"
+            )
+            self._quarantined.add(obj.name)
+            return None
+        quarantine_registry().clear("crd", obj.name)
+        self._quarantined.discard(obj.name)
+        return policies
 
     def _validated(self, obj: PolicyObject, policies):
         """Apply the load-time lowerability gate to one object's policies
@@ -347,6 +432,8 @@ class CRDPolicyStore:
         self._copy_on_write(mutate)
 
     def on_delete(self, obj: PolicyObject) -> None:
+        quarantine_registry().clear("crd", obj.name)
+        self._quarantined.discard(obj.name)
         with self._lock:
             was_candidate = (
                 self._candidate_objects.pop(obj.name, None) is not None

@@ -18,9 +18,11 @@ import os
 import threading
 from typing import Dict, Optional, Tuple
 
+from ..chaos.registry import ChaosError, chaos_fire
 from ..lang.authorize import PolicySet
 from ..lang.lexer import ParseError
 from ..lang.parser import parse_policies
+from .quarantine import quarantine_registry
 
 log = logging.getLogger(__name__)
 
@@ -35,6 +37,10 @@ class DirectoryPolicyStore:
     ):
         self.directory = directory
         self.refresh_interval_s = refresh_interval_s
+        # file names THIS store quarantined, so reload cleanup can clear
+        # entries for files that vanish — including born-poison files that
+        # never produced a parse-cache entry to diff against
+        self._quarantined: set = set()
         self._policies = PolicySet()
         # (filename -> (content sha256, parsed policies)); entries for
         # removed files are dropped each reload
@@ -55,21 +61,67 @@ class DirectoryPolicyStore:
         self._stop.set()
 
     def _reload_loop(self) -> None:
-        while not self._stop.wait(self.refresh_interval_s):
-            self.load_policies()
+        try:
+            while not self._stop.wait(self.refresh_interval_s):
+                if (
+                    self._ticker is not None
+                    and self._ticker is not threading.current_thread()
+                ):
+                    return  # superseded by revive(): a fresh ticker owns reloads
+                self.load_policies()
+        except BaseException:  # noqa: BLE001 — visibility, then unwind
+            try:
+                from ..server.metrics import record_worker_death
+
+                record_worker_death("directory.reload")
+            except Exception:  # noqa: BLE001 — must not mask the death
+                pass
+            log.critical(
+                "directory store reload ticker died on an uncaught exception"
+            )
+            raise
+
+    def ticker_threads(self) -> list:
+        """The reload ticker thread(s) (supervisor liveness probe)."""
+        return [self._ticker] if self._ticker is not None else []
+
+    def revive(self, force: bool = False) -> bool:
+        """Restart a dead (or, forced, wedged) reload ticker (supervisor
+        hook); serving is unaffected either way — the previous policy set
+        keeps answering."""
+        t = self._ticker
+        if self._stop.is_set() or t is None:
+            return False
+        if t.is_alive() and not force:
+            return False
+        log.warning("directory store: restarting reload ticker")
+        self._ticker = threading.Thread(
+            target=self._reload_loop, name="directory-store-reload", daemon=True
+        )
+        self._ticker.start()
+        return True
 
     def load_policies(self) -> None:
         try:
+            # chaos seam: a latency rule here is the scripted "store
+            # stalls for N seconds" game day; an error rule is a reload
+            # failure — both leave the previous set serving
+            chaos_fire("store.load")
             entries = sorted(os.listdir(self.directory))
+        except ChaosError as e:
+            log.error("policy directory load failed (injected): %s", e)
+            return
         except OSError as e:
             log.error("Error reading policy directory: %s", e)
             return
         ps = PolicySet()
         new_cache: Dict[str, Tuple[str, list]] = {}
+        seen: set = set()
         for name in entries:
             path = os.path.join(self.directory, name)
             if not os.path.isfile(path) or not name.endswith(".cedar"):
                 continue
+            seen.add(name)
             try:
                 with open(path, "r") as f:
                     data = f.read()
@@ -85,10 +137,30 @@ class DirectoryPolicyStore:
                     policies = parse_policies(data, name)
                 except ParseError as e:
                     log.error("Error loading policy file %s: %s", name, e)
+                    quarantine_registry().quarantine("directory", name, str(e))
+                    self._quarantined.add(name)
+                    if cached is not None:
+                        # poison-file quarantine with last-known-good
+                        # retention: the file went bad on disk, but its
+                        # previous parse served fine — keep serving that
+                        # (under the OLD digest, so a fix is re-parsed)
+                        # instead of silently dropping its policies
+                        new_cache[name] = cached
+                        for i, p in enumerate(cached[1]):
+                            ps.add(p, policy_id=f"{name}.policy{i}")
                     continue
+            quarantine_registry().clear("directory", name)
+            self._quarantined.discard(name)
             new_cache[name] = (digest, policies)
             for i, p in enumerate(policies):
                 ps.add(p, policy_id=f"{name}.policy{i}")
+        # deleted files leave quarantine with their policies — including
+        # born-poison files that never made it into the parse cache.
+        # Keyed on files SEEN on disk, not the parse cache: a born-poison
+        # file still present must stay quarantined.
+        for name in self._quarantined - seen:
+            quarantine_registry().clear("directory", name)
+            self._quarantined.discard(name)
         changed = {n: d for n, (d, _) in new_cache.items()} != {
             n: d for n, (d, _) in self._parse_cache.items()
         }

@@ -1,0 +1,104 @@
+"""Poison-object quarantine: last-known-good retention bookkeeping.
+
+A policy object (CRD Policy, directory *.cedar file) that stops parsing —
+or fails the load-time analysis gate — must degrade, not wedge: the store
+keeps serving the object's previous good content (or drops only that
+object) and records the poison here so operators can see exactly WHAT is
+quarantined and WHY on ``/debug/quarantine`` (and alert on the
+``cedar_quarantined_objects`` gauge) instead of diffing reload logs.
+
+One module-level registry serves the whole process: stores quarantine and
+clear under (component, object name) keys, the HTTP debug endpoint reads a
+snapshot. Entries clear automatically when the object loads cleanly again
+(or is deleted)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+class QuarantineRegistry:
+    def __init__(self, clock=time.time):
+        self._items: dict = {}  # (component, name) -> entry dict
+        self._lock = threading.Lock()
+        self._clock = clock
+
+    def quarantine(self, component: str, name: str, reason: str) -> None:
+        """Record (or refresh) one poisoned object. ``reason`` is the
+        parse/gate error text, truncated for the debug payload."""
+        with self._lock:
+            entry = self._items.get((component, name))
+            if entry is None:
+                entry = {
+                    "component": component,
+                    "name": name,
+                    "since_unix": round(self._clock(), 3),
+                    "failures": 0,
+                }
+                self._items[(component, name)] = entry
+                log.error(
+                    "quarantined %s object %r: %s", component, name, reason
+                )
+            entry["failures"] += 1
+            entry["reason"] = str(reason)[:500]
+        self._publish()
+
+    def clear(self, component: str, name: str) -> bool:
+        """Remove one object from quarantine (it loaded cleanly or was
+        deleted); True when it was quarantined."""
+        with self._lock:
+            entry = self._items.pop((component, name), None)
+        if entry is not None:
+            log.warning(
+                "cleared quarantine for %s object %r", component, name
+            )
+            self._publish()
+        return entry is not None
+
+    def is_quarantined(self, component: str, name: str) -> bool:
+        with self._lock:
+            return (component, name) in self._items
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def snapshot(self) -> dict:
+        """/debug/quarantine payload: every quarantined object, newest
+        failure last."""
+        with self._lock:
+            items = [dict(e) for e in self._items.values()]
+        items.sort(key=lambda e: e["since_unix"])
+        return {"count": len(items), "objects": items}
+
+    def reset(self) -> None:
+        """Drop everything (tests)."""
+        with self._lock:
+            self._items.clear()
+        self._publish()
+
+    def _publish(self) -> None:
+        try:
+            from ..server.metrics import set_quarantined_objects
+
+            set_quarantined_objects(self.count())
+        except Exception:  # noqa: BLE001 — metrics must never break a load
+            log.debug("quarantine gauge publish failed", exc_info=True)
+
+
+_default: Optional[QuarantineRegistry] = None
+_default_lock = threading.Lock()
+
+
+def quarantine_registry() -> QuarantineRegistry:
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = QuarantineRegistry()
+    return _default

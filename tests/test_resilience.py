@@ -878,3 +878,922 @@ class TestReadinessAndDrain:
         t.join(timeout=5.0)
         assert results and results[0]["status"]["allowed"] is True
         assert not stopper.is_alive()
+
+
+# --------------------------------------------------------------------------
+# chaos plane: fault-injection registry, scenarios, quarantine, supervision
+# (ISSUE 6; docs/resilience.md "Game days")
+
+from cedar_tpu.chaos import (  # noqa: E402 — grouped with their tests
+    ChaosError,
+    ScenarioError,
+    ThreadKilled,
+    builtin_scenario,
+    default_registry,
+    load_scenario,
+)
+from cedar_tpu.server.supervisor import (  # noqa: E402
+    DeviceRecovery,
+    Heartbeat,
+    HeartbeatGroup,
+    Supervisor,
+    is_fatal_device_error,
+)
+from cedar_tpu.stores.quarantine import quarantine_registry  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _pristine_chaos_registry():
+    """Every test starts and ends with the chaos plane disarmed and empty
+    — an armed leftover scenario would silently poison unrelated tests."""
+    default_registry().reset()
+    yield
+    default_registry().reset()
+
+
+class TestChaosRegistry:
+    def test_disarmed_is_passthrough(self):
+        r = default_registry()
+        r.configure(
+            {"faults": [{"seam": "cache.get", "kind": "error", "count": 99}]}
+        )
+        # configured but NOT armed: nothing fires, payloads pass through
+        from cedar_tpu.chaos import chaos_fire
+
+        assert chaos_fire("cache.get", "payload") == "payload"
+        assert r.stats()["seams"]["cache.get"]["calls"] == 0
+
+    def test_after_and_count_schedule_deterministically(self):
+        r = default_registry()
+        r.configure(
+            {
+                "faults": [
+                    {
+                        "seam": "cache.get",
+                        "kind": "error",
+                        "after": 2,
+                        "count": 2,
+                    }
+                ]
+            }
+        )
+        r.arm()
+        fired = []
+        for _i in range(6):
+            try:
+                r.fire("cache.get")
+                fired.append(False)
+            except ChaosError:
+                fired.append(True)
+        # calls 0,1 skipped (after=2), calls 2,3 fire (count=2), rest pass
+        assert fired == [False, False, True, True, False, False]
+
+    def test_unknown_seam_and_kind_rejected(self):
+        r = default_registry()
+        with pytest.raises(ValueError, match="unknown chaos seam"):
+            r.configure({"faults": [{"seam": "nope", "kind": "error"}]})
+        with pytest.raises(ValueError, match="unknown chaos rule kind"):
+            r.configure(
+                {"faults": [{"seam": "cache.get", "kind": "explode"}]}
+            )
+
+    def test_corrupt_replaces_string_payloads(self):
+        r = default_registry()
+        r.configure(
+            {
+                "faults": [
+                    {
+                        "seam": "store.crd.object",
+                        "kind": "corrupt",
+                        "count": 1,
+                        "replacement": "%% garbage %%",
+                    }
+                ]
+            }
+        )
+        r.arm()
+        assert r.fire("store.crd.object", "permit(...);") == "%% garbage %%"
+        # count exhausted: clean pass-through again
+        assert r.fire("store.crd.object", "permit(...);") == "permit(...);"
+
+    def test_kill_raises_base_exception(self):
+        r = default_registry()
+        r.configure(
+            {"faults": [{"seam": "pipeline.collect", "kind": "kill",
+                         "count": 1}]}
+        )
+        r.arm()
+        with pytest.raises(ThreadKilled):
+            r.fire("pipeline.collect")
+        # ThreadKilled must NOT be an Exception (it has to sail past the
+        # per-batch `except Exception` containment in worker loops)
+        assert not issubclass(ThreadKilled, Exception)
+
+    def test_latency_rule_sleeps(self):
+        from cedar_tpu.chaos.registry import InjectionRule, Seam
+
+        slept = []
+        seam = Seam("store.load", sleep=slept.append)
+        seam.add_rule(InjectionRule(kind="latency", delay_s=2.5, count=1))
+        seam.fire()
+        assert slept == [2.5]
+
+    def test_injection_metric_counted(self):
+        before = metrics.chaos_injections_total._values.get(
+            (("seam", "cache.put"), ("kind", "error")), 0
+        )
+        r = default_registry()
+        r.configure(
+            {"faults": [{"seam": "cache.put", "kind": "error", "count": 1}]}
+        )
+        r.arm()
+        with pytest.raises(ChaosError):
+            r.fire("cache.put")
+        assert metrics.chaos_injections_total._values[
+            (("seam", "cache.put"), ("kind", "error"))
+        ] == before + 1
+
+
+class TestScenarioFiles:
+    def test_builtins_validate(self):
+        for name in ("kill-decode", "device-loss", "poison-crd",
+                     "store-stall"):
+            sc = builtin_scenario(name)
+            assert sc is not None and sc["faults"]
+            assert 0 < sc["slo"]["availability"] <= 1
+        assert builtin_scenario("no-such-thing") is None
+
+    def test_load_scenario_validation(self):
+        with pytest.raises(ScenarioError, match="faults"):
+            load_scenario({"name": "empty"})
+        with pytest.raises(ScenarioError, match="unknown seam"):
+            load_scenario({"faults": [{"seam": "zap", "kind": "error"}]})
+        with pytest.raises(ScenarioError, match="not valid JSON"):
+            load_scenario("{nope")
+        sc = load_scenario(
+            '{"faults": [{"seam": "cache.get", "kind": "latency"}],'
+            ' "slo": {"availability": 0.95}}'
+        )
+        assert sc["slo"]["availability"] == 0.95
+        assert sc["slo"]["recovery_p99_ratio"] > 0  # defaults merged
+
+
+class TestQuarantineRegistry:
+    def test_quarantine_clear_and_gauge(self):
+        q = quarantine_registry()
+        q.reset()
+        q.quarantine("crd", "bad-object", "ParseError: nope")
+        q.quarantine("crd", "bad-object", "ParseError: still nope")
+        assert q.count() == 1
+        snap = q.snapshot()
+        assert snap["count"] == 1
+        assert snap["objects"][0]["name"] == "bad-object"
+        assert snap["objects"][0]["failures"] == 2
+        assert "still nope" in snap["objects"][0]["reason"]
+        assert "cedar_quarantined_objects 1" in metrics.REGISTRY.expose()
+        assert q.clear("crd", "bad-object") is True
+        assert q.clear("crd", "bad-object") is False
+        assert q.count() == 0
+        assert "cedar_quarantined_objects 0" in metrics.REGISTRY.expose()
+
+
+class TestHeartbeatAndSupervisor:
+    def test_idle_heartbeat_never_wedges(self):
+        clock = FakeClock()
+        hb = Heartbeat(clock=clock)
+        hb.idle()
+        clock.advance(1e6)
+        assert hb.is_wedged(1.0) is False
+
+    def test_busy_heartbeat_wedges_past_budget(self):
+        clock = FakeClock()
+        hb = Heartbeat(clock=clock)
+        hb.busy()
+        clock.advance(5.0)
+        assert hb.is_wedged(10.0) is False
+        clock.advance(6.0)
+        assert hb.is_wedged(10.0) is True
+        hb.idle()
+        assert hb.is_wedged(10.0) is False
+
+    def test_heartbeat_group_reads_worst_busy_member(self):
+        clock = FakeClock()
+        beats = {"a": Heartbeat(clock=clock), "b": Heartbeat(clock=clock)}
+        beats["a"].idle()
+        beats["b"].busy()
+        clock.advance(20.0)
+        group = HeartbeatGroup(lambda: beats)
+        assert group.is_wedged(10.0) is True
+        age, busy = group.snapshot()
+        assert busy is True and age >= 20.0
+
+    def test_dead_thread_triggers_restart_with_cooldown(self):
+        clock = FakeClock()
+        sup = Supervisor(interval_s=1.0, clock=clock)
+        dead = threading.Thread(target=lambda: None)
+        dead.start()
+        dead.join()
+        calls = []
+        sup.register(
+            "c", threads=lambda: [dead], restart=lambda r: calls.append(r) or True,
+        )
+        events = sup.check_once()
+        assert len(events) == 1 and events[0]["ok"] is True
+        assert calls and "dead thread" in calls[0]
+        # cooldown: the immediately-following check does nothing
+        assert sup.check_once() == []
+        clock.advance(10.0)
+        assert len(sup.check_once()) == 1
+        st = sup.status()
+        assert st["components"]["c"]["restarts"] == 2
+        assert (
+            'cedar_supervisor_restarts_total{component="c"}'
+            in metrics.REGISTRY.expose()
+        )
+
+    def test_wedged_heartbeat_triggers_forced_restart(self):
+        clock = FakeClock()
+        sup = Supervisor(interval_s=1.0, wedge_budget_s=10.0, clock=clock)
+        live = threading.Thread(target=lambda: time.sleep(0.5), daemon=True)
+        live.start()
+        hb = Heartbeat(clock=clock)
+        hb.busy()
+        reasons = []
+        sup.register(
+            "w",
+            threads=lambda: [live],
+            restart=lambda r: reasons.append(r) or True,
+            heartbeat=hb,
+        )
+        assert sup.check_once() == []  # fresh busy beat: healthy
+        clock.advance(11.0)
+        events = sup.check_once()
+        assert len(events) == 1
+        assert reasons and reasons[0].startswith("wedged")
+
+    def test_failing_restart_counted_not_fatal(self):
+        clock = FakeClock()
+        sup = Supervisor(interval_s=1.0, clock=clock)
+        dead = threading.Thread(target=lambda: None)
+        dead.start()
+        dead.join()
+
+        def bad_restart(reason):
+            raise RuntimeError("revive exploded")
+
+        sup.register("b", threads=lambda: [dead], restart=bad_restart)
+        events = sup.check_once()
+        assert len(events) == 1 and events[0]["ok"] is False
+        assert sup.status()["components"]["b"]["restart_failures"] == 1
+
+
+class _StubEngine:
+    """DeviceRecovery target: counts rebuilds, no real device anywhere."""
+
+    def __init__(self, ok=True):
+        self.ok = ok
+        self.rebuilt = 0
+
+    def rebuild_compiled(self):
+        if not self.ok:
+            raise RuntimeError("rebuild exploded")
+        self.rebuilt += 1
+        return True
+
+
+class TestDeviceRecovery:
+    def test_fatal_classifier(self):
+        assert is_fatal_device_error(RuntimeError("UNAVAILABLE: socket"))
+        assert is_fatal_device_error(ChaosError("x: UNAVAILABLE: injected"))
+        assert is_fatal_device_error(OSError("Connection reset by peer"))
+        assert not is_fatal_device_error(KeyError("policy-id"))
+        assert not is_fatal_device_error(ValueError("bad literal"))
+
+    def test_non_fatal_ignored(self):
+        breaker = CircuitBreaker(name="rec-a", failure_threshold=100)
+        rec = DeviceRecovery(_StubEngine(), breaker=breaker, warm=False)
+        assert rec.observe(ValueError("evaluation bug")) is False
+        assert breaker.state == CLOSED
+        assert rec.rebuilds == 0
+
+    def test_fatal_trips_rebuilds_and_rearms(self):
+        breaker = CircuitBreaker(
+            name="rec-b", failure_threshold=100, recovery_s=3600.0
+        )
+        engine = _StubEngine()
+        rec = DeviceRecovery(engine, breaker=breaker, warm=False)
+        assert rec.observe(RuntimeError("UNAVAILABLE: device lost")) is True
+        deadline = time.monotonic() + 5.0
+        while rec.rebuilds == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rec.rebuilds == 1 and engine.rebuilt == 1
+        # re-armed half-open DESPITE the hour-long recovery window: the
+        # rebuild, not the clock, earned the probe
+        assert breaker.state == HALF_OPEN
+        assert "cedar_device_rebuilds_total" in metrics.REGISTRY.expose()
+
+    def test_failed_rebuild_leaves_breaker_open(self):
+        breaker = CircuitBreaker(
+            name="rec-c", failure_threshold=100, recovery_s=3600.0
+        )
+        rec = DeviceRecovery(_StubEngine(ok=False), breaker=breaker, warm=False)
+        rec.observe(RuntimeError("UNAVAILABLE: device lost"))
+        deadline = time.monotonic() + 5.0
+        while rec.failures == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rec.failures == 1
+        assert breaker.state == OPEN
+
+    def test_breaker_force_open_and_half_open_now(self):
+        clock = FakeClock()
+        breaker = CircuitBreaker(
+            name="rec-d", failure_threshold=100, recovery_s=50.0, clock=clock
+        )
+        breaker.force_open()
+        assert breaker.state == OPEN
+        breaker.half_open_now()
+        assert breaker.state == HALF_OPEN
+        # half_open_now on a non-open breaker is a no-op
+        breaker.record_success()
+        breaker.record_success()
+        assert breaker.state == CLOSED
+        breaker.half_open_now()
+        assert breaker.state == CLOSED
+
+
+class TestWorkerDeathVisibility:
+    def test_serial_batcher_kill_counts_death_and_revives(self):
+        mb = MicroBatcher(lambda items: list(items), window_s=0.0001)
+        try:
+            r = default_registry()
+            r.configure(
+                {"faults": [{"seam": "pipeline.collect", "kind": "kill",
+                             "count": 1}]}
+            )
+            r.arm()
+            with pytest.raises((RuntimeError, DeadlineExceeded)):
+                mb.submit("x", timeout=2.0)
+            r.disarm()
+            deadline = time.monotonic() + 2.0
+            while mb._threads[0].is_alive() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert (
+                'cedar_worker_deaths_total{component="batcher.worker"}'
+                in metrics.REGISTRY.expose()
+            )
+            assert mb.revive() is True
+            assert mb.submit("y", timeout=2.0) == "y"
+        finally:
+            mb.stop()
+
+
+# --------------------------------------------------------------------------
+# supervisor / recovery end-to-end (chaos suite)
+
+from cedar_tpu.engine.batcher import PipelinedBatcher  # noqa: E402
+
+
+def post_status(port, path, doc=None):
+    """POST that returns the HTTP status instead of raising on 4xx."""
+    data = json.dumps(doc or {}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+class _IdentityStages:
+    def pipeline_encode(self, items):
+        return list(items)
+
+    def pipeline_dispatch(self, ctx):
+        return ctx
+
+    def pipeline_decode(self, ctx):
+        return [(i, "ok") for i in ctx]
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestSupervisedPipelineEndToEnd:
+    def test_decode_thread_kill_supervised_restart(self):
+        pb = PipelinedBatcher(_IdentityStages(), window_s=0.0001, depth=2)
+        sup = Supervisor(interval_s=0.05)
+        sup.register(
+            "pipe",
+            threads=lambda: list(pb._threads),
+            restart=lambda r: pb.revive(force=r.startswith("wedged")),
+            heartbeat=HeartbeatGroup(lambda: pb.heartbeats),
+        )
+        sup.start()
+        try:
+            assert pb.submit("a", timeout=2.0) == ("a", "ok")
+            r = default_registry()
+            r.configure(
+                {"faults": [{"seam": "pipeline.decode_q", "kind": "kill",
+                             "count": 1}]}
+            )
+            r.arm()
+            # the killed decode stage strands this submitter's batch: it
+            # must get a bounded error, not a hang
+            with pytest.raises((RuntimeError, DeadlineExceeded)):
+                pb.submit("b", timeout=2.0)
+            r.disarm()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if (
+                    sup.status()["components"]["pipe"]["restarts"] >= 1
+                    and all(t.is_alive() for t in pb._threads)
+                ):
+                    break
+                time.sleep(0.02)
+            assert sup.status()["components"]["pipe"]["restarts"] >= 1
+            # the revived pipeline serves
+            assert pb.submit("c", timeout=2.0) == ("c", "ok")
+            assert (
+                'cedar_worker_deaths_total{component="pipeline.decode"}'
+                in metrics.REGISTRY.expose()
+            )
+        finally:
+            sup.stop()
+            pb.stop()
+
+    def test_wedged_serial_worker_force_restarted(self):
+        block = threading.Event()
+        wedged_once = {"done": False}
+
+        def fn(items):
+            if not wedged_once["done"]:
+                wedged_once["done"] = True
+                block.wait(30.0)  # a hung device call
+            return list(items)
+
+        mb = MicroBatcher(fn, window_s=0.0001)
+        sup = Supervisor(interval_s=0.05, wedge_budget_s=0.3)
+        sup.register(
+            "mb",
+            threads=lambda: list(mb._threads),
+            restart=lambda r: mb.revive(force=r.startswith("wedged")),
+            heartbeat=HeartbeatGroup(lambda: mb.heartbeats),
+        )
+        sup.start()
+        try:
+            stranded = {}
+
+            def submit_first():
+                try:
+                    stranded["result"] = mb.submit("first", timeout=3.0)
+                except Exception as e:  # noqa: BLE001 — recorded for asserts
+                    stranded["error"] = e
+
+            t = threading.Thread(target=submit_first, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if sup.status()["components"]["mb"]["restarts"] >= 1:
+                    break
+                time.sleep(0.02)
+            assert sup.status()["components"]["mb"]["restarts"] >= 1
+            # the fresh worker generation serves while the old one is
+            # still wedged inside fn()
+            assert mb.submit("second", timeout=2.0) == "second"
+            block.set()
+            t.join(timeout=5.0)
+            # the stranded submitter got SOMETHING bounded: its own result
+            # (the wedge released within its budget) or a deadline error
+            assert "result" in stranded or "error" in stranded
+        finally:
+            block.set()
+            sup.stop()
+            mb.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestDeviceLossRebuild:
+    def test_rebuild_compiled_is_compile_free(self):
+        from cedar_tpu.engine.evaluator import TPUPolicyEngine
+        from cedar_tpu.ops.match import kernel_trace_count
+        from cedar_tpu.server.authorizer import record_to_cedar_resource
+        from cedar_tpu.server.http import get_authorizer_attributes
+
+        ps = MemoryStore.from_source("demo", DEMO_POLICY).policy_set()
+        engine = TPUPolicyEngine(name="rebuild-test")
+        engine.load([ps], warm="off")
+        attributes = get_authorizer_attributes(make_sar())
+        entities, request = record_to_cedar_resource(attributes)
+        first = engine.evaluate(entities, request)
+        tc0 = kernel_trace_count()
+        gen0 = engine.load_generation
+        assert engine.rebuild_compiled() is True
+        assert engine.load_generation == gen0 + 1
+        second = engine.evaluate(entities, request)
+        assert second[0] == first[0]
+        # the rebuild re-placed tensors from the retained pack; the jitted
+        # kernels came from the shape-keyed cache — ZERO fresh traces
+        assert kernel_trace_count() == tc0
+
+    def test_injected_device_loss_full_recovery_loop(self):
+        from cedar_tpu.engine.breaker import guarded_call
+        from cedar_tpu.engine.evaluator import TPUPolicyEngine
+        from cedar_tpu.server.authorizer import record_to_cedar_resource
+        from cedar_tpu.server.http import get_authorizer_attributes
+
+        ps = MemoryStore.from_source("demo", DEMO_POLICY).policy_set()
+        stores = TieredPolicyStores(
+            [MemoryStore.from_source("demo", DEMO_POLICY)]
+        )
+        engine = TPUPolicyEngine(name="loss-test")
+        engine.load([ps], warm="off")
+        breaker = CircuitBreaker(
+            name="loss-test", failure_threshold=100, recovery_s=0.3
+        )
+        recovery = DeviceRecovery(
+            engine, breaker=breaker, name="loss-test", warm=False,
+            cooldown_s=0.2,
+        )
+        attributes = get_authorizer_attributes(make_sar())
+        entities, request = record_to_cedar_resource(attributes)
+
+        def evaluate():
+            return guarded_call(
+                breaker,
+                lambda: engine.evaluate(entities, request),
+                lambda: stores.is_authorized(entities, request),
+                "loss-test",
+                on_error=recovery.observe,
+            )
+
+        expected = evaluate()
+        r = default_registry()
+        r.configure(builtin_scenario("device-loss"))
+        r.arm()
+        # drive through the fault: every call still answers (interpreter
+        # fallback while the device plane is "lost"), decisions never flip
+        for _ in range(16):
+            assert evaluate()[0] == expected[0]
+        r.disarm()
+        deadline = time.monotonic() + 5.0
+        while recovery.rebuilds == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert recovery.rebuilds >= 1
+        # re-armed: once the injections stop, probes on the rebuilt plane
+        # close the breaker (a failed probe re-opens on the normal
+        # recovery cadence, so poll through a few cycles)
+        deadline = time.monotonic() + 5.0
+        while breaker.state != CLOSED and time.monotonic() < deadline:
+            assert evaluate()[0] == expected[0]
+            time.sleep(0.05)
+        assert breaker.state == CLOSED
+
+
+class _FakeWatchSource:
+    def __init__(self, objs):
+        self.objs = list(objs)
+
+    def list(self):
+        return list(self.objs)
+
+    def watch(self, on_event, stop):
+        stop.wait()
+
+
+def _policy_object(name, uid, content):
+    from cedar_tpu.apis.v1alpha1 import PolicyObject
+
+    return PolicyObject.from_dict(
+        {"metadata": {"name": name, "uid": uid}, "spec": {"content": content}}
+    )
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestPoisonCRDQuarantine:
+    def test_poison_object_quarantined_readyz_stays_200(self):
+        from cedar_tpu.stores.crd import CRDPolicyStore
+
+        quarantine_registry().reset()
+        obj = _policy_object("poison-me", "uid-1", DEMO_POLICY)
+        store = CRDPolicyStore(source=_FakeWatchSource([obj]), start=False)
+        store._relist()
+        store._load_complete = True
+        gen0 = store.content_generation()
+        srv = make_server(
+            authorizer=CedarWebhookAuthorizer(TieredPolicyStores([store]))
+        )
+        try:
+            assert get_status(srv.bound_metrics_port, "/readyz") == 200
+            doc = post(srv.bound_port, "/v1/authorize", make_sar())
+            assert doc["status"]["allowed"] is True
+
+            r = default_registry()
+            r.configure(builtin_scenario("poison-crd"))
+            r.arm()
+            # a MODIFIED event whose content the armed rule corrupts: the
+            # object must be quarantined, NOT wedge readiness or drop its
+            # last-known-good policies
+            store.on_update(
+                _policy_object("poison-me", "uid-2", DEMO_POLICY + "\n")
+            )
+            r.disarm()
+            assert quarantine_registry().is_quarantined("crd", "poison-me")
+            assert store.content_generation() == gen0  # no recompile churn
+            assert get_status(srv.bound_metrics_port, "/readyz") == 200
+            doc = post(srv.bound_port, "/v1/authorize", make_sar())
+            assert doc["status"]["allowed"] is True  # last-known-good serves
+
+            # the debug surfaces name the poison object
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.bound_metrics_port}/debug/quarantine",
+                timeout=5,
+            ) as resp:
+                q = json.loads(resp.read())
+            assert q["count"] == 1
+            assert q["objects"][0]["name"] == "poison-me"
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.bound_metrics_port}/debug/supervisor",
+                timeout=5,
+            ) as resp:
+                sup_doc = json.loads(resp.read())
+            assert sup_doc["quarantine"]["count"] == 1
+
+            # a clean update heals: quarantine clears, new content serves
+            store.on_update(
+                _policy_object("poison-me", "uid-3", DEMO_POLICY)
+            )
+            assert not quarantine_registry().is_quarantined("crd", "poison-me")
+        finally:
+            srv.stop()
+            quarantine_registry().reset()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestChaosControlEndpoints:
+    def test_control_gated_by_non_prod_flag(self):
+        srv = make_server()  # chaos_control_enabled defaults to False
+        try:
+            assert post_status(srv.bound_metrics_port, "/chaos/arm") == 403
+            # the read-only stats endpoint stays open
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.bound_metrics_port}/debug/chaos",
+                timeout=5,
+            ) as resp:
+                assert json.loads(resp.read())["armed"] is False
+        finally:
+            srv.stop()
+
+    def test_configure_arm_inject_disarm_roundtrip(self):
+        srv = make_server(chaos_control_enabled=True)
+        try:
+            port = srv.bound_metrics_port
+            scenario = {
+                "name": "http-response-fault",
+                "faults": [
+                    {"seam": "response", "kind": "response_error", "count": 1}
+                ],
+            }
+            assert post_status(port, "/chaos/configure", scenario) == 200
+            assert post_status(port, "/chaos/arm") == 200
+            doc = post(srv.bound_port, "/v1/authorize", make_sar())
+            assert doc["status"]["evaluationError"] == "encountered error"
+            # count exhausted: the next answer is clean again
+            doc = post(srv.bound_port, "/v1/authorize", make_sar())
+            assert doc["status"]["allowed"] is True
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/chaos", timeout=5
+            ) as resp:
+                stats = json.loads(resp.read())
+            assert stats["armed"] is True
+            assert stats["scenario"] == "http-response-fault"
+            assert stats["seams"]["response"]["rules"][0]["fired"] == 1
+            assert post_status(port, "/chaos/disarm") == 200
+            assert post_status(port, "/chaos/configure", {"faults": []}) == 400
+            assert post_status(
+                port, "/chaos/configure",
+                {"faults": [{"seam": "nope", "kind": "error"}]},
+            ) == 400
+        finally:
+            srv.stop()
+            default_registry().reset()
+
+
+class TestDirectoryStorePoisonAndStall:
+    def test_poison_file_serves_last_known_good(self, tmp_path):
+        from cedar_tpu.stores.directory import DirectoryPolicyStore
+
+        quarantine_registry().reset()
+        f = tmp_path / "demo.cedar"
+        f.write_text(DEMO_POLICY)
+        store = DirectoryPolicyStore(str(tmp_path), start_ticker=False)
+        assert len(list(store.policy_set().policies())) == 1
+        gen0 = store.content_generation()
+
+        f.write_text("permit (galaxy %% nonsense ;;;")
+        store.load_policies()
+        # the poison file is quarantined; its previous parse keeps serving
+        assert quarantine_registry().is_quarantined("directory", "demo.cedar")
+        assert len(list(store.policy_set().policies())) == 1
+        assert store.content_generation() == gen0
+
+        f.write_text(DEMO_POLICY)
+        store.load_policies()
+        assert not quarantine_registry().is_quarantined(
+            "directory", "demo.cedar"
+        )
+        quarantine_registry().reset()
+
+    def test_store_stall_and_failure_keep_previous_set(self, tmp_path):
+        from cedar_tpu.stores.directory import DirectoryPolicyStore
+
+        (tmp_path / "demo.cedar").write_text(DEMO_POLICY)
+        store = DirectoryPolicyStore(str(tmp_path), start_ticker=False)
+        r = default_registry()
+        r.configure(
+            {
+                "faults": [
+                    {"seam": "store.load", "kind": "latency", "count": 1,
+                     "delay_s": 0.4},
+                    {"seam": "store.load", "kind": "error", "count": 1},
+                ]
+            }
+        )
+        r.arm()
+        t0 = time.monotonic()
+        store.load_policies()  # stalled 0.4s, then loads
+        assert time.monotonic() - t0 >= 0.4
+        assert len(list(store.policy_set().policies())) == 1
+        store.load_policies()  # injected failure: previous set retained
+        assert len(list(store.policy_set().policies())) == 1
+        r.reset()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestChaosDisabledDifferential:
+    def test_1k_bodies_byte_identical_with_plane_disarmed(self):
+        """The acceptance differential: with the chaos plane compiled in
+        but DISARMED — even with a scenario configured — 1k live responses
+        are byte-identical to a pristine registry, through the cached AND
+        uncached serving paths (the cache.get/put seams sit on the hot
+        path)."""
+        from cedar_tpu.cache import DecisionCache
+
+        srv = make_server(
+            decision_cache=DecisionCache(max_entries=4096),
+        )
+        try:
+            rng = __import__("random").Random(17)
+            users = ["test-user", "alice", "bob", "carol"]
+            verbs = ["get", "list", "create", "delete"]
+            resources = ["pods", "secrets", "configmaps"]
+            bodies = [
+                json.dumps(
+                    make_sar(
+                        user=rng.choice(users),
+                        verb=rng.choice(verbs),
+                        resource=rng.choice(resources),
+                    )
+                ).encode()
+                for _ in range(1000)
+            ]
+            default_registry().reset()  # pristine
+            r0 = [
+                json.dumps(srv.handle_authorize(b), sort_keys=True)
+                for b in bodies
+            ]
+            # now configure faults on the hot-path seams... and leave the
+            # plane OFF
+            default_registry().configure(
+                {
+                    "faults": [
+                        {"seam": "cache.get", "kind": "error", "count": 99},
+                        {"seam": "cache.put", "kind": "error", "count": 99},
+                        {"seam": "response", "kind": "response_deny",
+                         "count": 99},
+                        {"seam": "engine.dispatch", "kind": "error",
+                         "count": 99},
+                    ]
+                }
+            )
+            r1 = [
+                json.dumps(srv.handle_authorize(b), sort_keys=True)
+                for b in bodies
+            ]
+            assert r0 == r1
+            stats = default_registry().stats()
+            assert all(
+                s["calls"] == 0 for s in stats["seams"].values()
+            )  # disarmed seams never even counted a call
+        finally:
+            srv.stop()
+            default_registry().reset()
+
+
+class TestReviewRegressions:
+    """Pinned fixes from the chaos-plane review pass."""
+
+    def test_authorizer_cache_fault_is_a_miss_not_an_answer(self):
+        # the interpreter-fallback path's authorizer-level cache must
+        # contain a raising cache exactly like the server-level call sites
+        from cedar_tpu.cache import DecisionCache
+
+        stores = TieredPolicyStores(
+            [MemoryStore.from_source("demo", DEMO_POLICY)]
+        )
+        authorizer = CedarWebhookAuthorizer(
+            stores, cache=DecisionCache(max_entries=64)
+        )
+        from cedar_tpu.server.http import get_authorizer_attributes
+
+        attributes = get_authorizer_attributes(make_sar())
+        r = default_registry()
+        r.configure(
+            {
+                "faults": [
+                    {"seam": "cache.get", "kind": "error", "count": 99},
+                    {"seam": "cache.put", "kind": "error", "count": 99},
+                ]
+            }
+        )
+        r.arm()
+        decision, _reason = authorizer.authorize(attributes)
+        assert decision == DECISION_ALLOW
+        r.reset()
+
+    def test_shadow_offer_kill_contained(self):
+        # a kill rule on shadow.offer must shed, never unwind the live
+        # request thread
+        from cedar_tpu.rollout.report import DiffReport
+        from cedar_tpu.rollout.shadow import ShadowEvaluator
+
+        class _Cand:
+            pass
+
+        shadow = ShadowEvaluator(_Cand(), DiffReport(), sample_rate=1.0)
+        try:
+            r = default_registry()
+            r.configure(
+                {"faults": [{"seam": "shadow.offer", "kind": "kill",
+                             "count": 1}]}
+            )
+            r.arm()
+            assert shadow.offer("authorize", b"{}", ("allow", "")) is False
+            r.disarm()
+            assert shadow.offer("authorize", b"{}", ("allow", "")) is True
+        finally:
+            shadow.stop()
+            default_registry().reset()
+
+    def test_born_poison_file_deletion_clears_quarantine(self, tmp_path):
+        # a file that NEVER parsed has no parse-cache entry; deleting it
+        # must still clear its quarantine record — and while it sits
+        # broken on disk, the record must persist
+        from cedar_tpu.stores.directory import DirectoryPolicyStore
+
+        quarantine_registry().reset()
+        store = DirectoryPolicyStore(str(tmp_path), start_ticker=False)
+        bad = tmp_path / "born-poison.cedar"
+        bad.write_text("%% never valid %%")
+        store.load_policies()
+        assert quarantine_registry().is_quarantined(
+            "directory", "born-poison.cedar"
+        )
+        store.load_policies()  # still on disk, still broken: stays
+        assert quarantine_registry().is_quarantined(
+            "directory", "born-poison.cedar"
+        )
+        bad.unlink()
+        store.load_policies()
+        assert not quarantine_registry().is_quarantined(
+            "directory", "born-poison.cedar"
+        )
+        quarantine_registry().reset()
+
+    def test_crd_relist_clears_quarantine_for_vanished_objects(self):
+        # an object deleted during a watch outage sends no DELETED event;
+        # the reconnect relist must clear its quarantine entry
+        from cedar_tpu.stores.crd import CRDPolicyStore
+
+        quarantine_registry().reset()
+        source = _FakeWatchSource(
+            [_policy_object("ghost", "uid-1", DEMO_POLICY)]
+        )
+        store = CRDPolicyStore(source=source, start=False)
+        store._relist()
+        r = default_registry()
+        r.configure(builtin_scenario("poison-crd"))
+        r.arm()
+        store.on_update(
+            _policy_object("ghost", "uid-2", DEMO_POLICY + "\n")
+        )
+        r.reset()
+        assert quarantine_registry().is_quarantined("crd", "ghost")
+        source.objs = []  # deleted while the watch was down
+        store._relist()
+        assert not quarantine_registry().is_quarantined("crd", "ghost")
+        quarantine_registry().reset()
