@@ -38,7 +38,7 @@ chaos: ## Run the fault-injection resilience suite deterministically (seeded sce
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_resilience.py -q -m chaos
 
 .PHONY: gameday
-gameday: ## Run a scripted chaos game day (cedar-chaos) against a locally spawned server; SCENARIO=kill-decode|device-loss|poison-crd|store-stall
+gameday: ## Run a scripted chaos game day (cedar-chaos) against a locally spawned server; SCENARIO=kill-decode|device-loss|poison-crd|store-stall|replica-loss
 	JAX_PLATFORMS=cpu $(PYTHON) -m cedar_tpu.cli.chaos --spawn \
 	    --scenario $${SCENARIO:-kill-decode}
 
@@ -59,8 +59,12 @@ bench-shadow: ## Shadow-rollout overhead: live p50/p99 + saturated throughput at
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --shadow
 
 .PHONY: bench-chaos
-bench-chaos: ## Game-day suite: availability/correctness/recovery SLOs under scripted faults + chaos-disabled differential (cpu; docs/resilience.md)
+bench-chaos: ## Game-day suite incl. replica-loss: availability/correctness/recovery SLOs under scripted faults + chaos-disabled differential (cpu; docs/resilience.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --chaos
+
+.PHONY: bench-fleet
+bench-fleet: ## Engine-fleet scaling: decisions/sec + lone p99 at 1/2/4 replicas, scaling-efficiency JSON (cpu; docs/fleet.md)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --fleet
 
 .PHONY: hw-validate
 hw-validate: ## Measure kernel planes (int8/bf16/pallas/segred) on the attached device
@@ -80,7 +84,7 @@ graft-check: ## Compile-check the jittable entry + multi-chip dry run
 
 # scoped to the layers with the strongest invariants first; widen as
 # modules are annotated
-LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos
+LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos cedar_tpu/fleet
 
 .PHONY: lint
 lint: ## ruff + mypy over $(LINT_SCOPE) (missing tools are skipped with a note)

@@ -1204,27 +1204,35 @@ def run_chaos_scenario() -> int:
     )
     supervisor.start()
 
-    def drive(stream):
-        """[(clean, decision)], latencies — in-process twin of the
-        cedar-chaos HTTP driver."""
-        results, lat = [], []
-        for body in stream:
-            t = time.monotonic()
-            try:
-                doc = server.handle_authorize(body)
-            except Exception:  # noqa: BLE001 — an escaping error = unavailable
-                results.append((False, None))
+    def make_drive(target):
+        def drive(stream):
+            """[(clean, decision)], latencies — in-process twin of the
+            cedar-chaos HTTP driver."""
+            results, lat = [], []
+            for body in stream:
+                t = time.monotonic()
+                try:
+                    doc = target.handle_authorize(body)
+                except Exception:  # noqa: BLE001 — an escaping error = unavailable
+                    results.append((False, None))
+                    lat.append(time.monotonic() - t)
+                    continue
                 lat.append(time.monotonic() - t)
-                continue
-            lat.append(time.monotonic() - t)
-            status = doc.get("status") or {}
-            results.append(
-                (
-                    not status.get("evaluationError"),
-                    (bool(status.get("allowed")), bool(status.get("denied"))),
+                status = doc.get("status") or {}
+                results.append(
+                    (
+                        not status.get("evaluationError"),
+                        (
+                            bool(status.get("allowed")),
+                            bool(status.get("denied")),
+                        ),
+                    )
                 )
-            )
-        return results, lat
+            return results, lat
+
+        return drive
+
+    drive = make_drive(server)
 
     def p99(lat):
         s = sorted(lat)
@@ -1233,22 +1241,25 @@ def run_chaos_scenario() -> int:
     stream = make_sar_stream(n_requests, seed=5)
     drive(stream[: _n(200, 60)])  # warm every serving shape pre-timing
 
-    def gameday(name, mid_fault=None):
+    def gameday(name, mid_fault=None, drive_fn=None):
         """control -> fault -> recovery protocol for one builtin scenario;
-        ``mid_fault`` runs once while armed (event triggers)."""
+        ``mid_fault`` runs once while armed (event triggers); ``drive_fn``
+        overrides the serving target (the replica-loss day drives the
+        fleet server)."""
+        d = drive_fn if drive_fn is not None else drive
         scenario = builtin_scenario(name)
         slo = scenario["slo"]
         registry.reset()
-        control, _control_lat = drive(stream)
-        control_lat = drive(stream)[1]  # second pass: steady-state p99
+        control, _control_lat = d(stream)
+        control_lat = d(stream)[1]  # second pass: steady-state p99
         registry.configure(scenario)
         registry.arm()
         if mid_fault is not None:
             mid_fault()
-        fault, fault_lat = drive(stream)
+        fault, fault_lat = d(stream)
         registry.disarm()
         time.sleep(1.5)  # supervisor revive + breaker recovery settle
-        recovery_res, recovery_lat = drive(stream)
+        recovery_res, recovery_lat = d(stream)
         clean = sum(1 for ok, _ in fault if ok)
         availability = clean / len(fault)
         wrong = sum(
@@ -1319,6 +1330,63 @@ def run_chaos_scenario() -> int:
     # serving path keeps answering from the compiled set
     results["store-stall"] = gameday("store-stall")
 
+    # replica-loss: a 2-replica engine fleet (cedar_tpu/fleet) over the
+    # same stores; the armed kill unwinds exactly one replica's batcher
+    # worker mid-traffic. The router must spill the stranded request over
+    # to the surviving replica (availability >= 99.5%, ZERO decision
+    # flips) and the supervisor must revive the dead member.
+    from cedar_tpu.fleet import EngineFleet, EngineReplica
+
+    fleet_authorizer = CedarWebhookAuthorizer(stores)
+    fleet_replicas = []
+    for i in range(2):
+        r_engine = TPUPolicyEngine(name=f"authz-r{i}")
+        r_breaker = CircuitBreaker(
+            name=f"authz-r{i}", failure_threshold=3, recovery_s=0.5
+        )
+        r_fast = SARFastPath(r_engine, fleet_authorizer, breaker=r_breaker)
+        fleet_replicas.append(
+            EngineReplica(
+                i, r_engine, r_fast, breaker=r_breaker,
+                max_batch=256, pipeline_depth=2, encode_workers=1,
+            )
+        )
+    fleet = EngineFleet(fleet_replicas)
+    fleet.load([s.policy_set() for s in stores], warm="off")
+    fleet_server = WebhookServer(
+        fleet_authorizer,
+        handler,
+        fleet=fleet,
+        request_timeout_s=0.5,
+    )
+    fleet_supervisor = Supervisor(interval_s=0.1, wedge_budget_s=5.0)
+    for r in fleet_replicas:
+        fleet_supervisor.register(
+            "batcher.authorization",
+            replica=r.name,
+            threads=lambda rr=r: list(rr.batcher._threads),
+            restart=lambda reason, i=r.index: fleet.revive_replica(
+                i, force=reason.startswith("wedged")
+            ),
+            heartbeat=HeartbeatGroup(lambda rr=r: rr.batcher.heartbeats),
+        )
+    fleet_supervisor.start()
+    fleet_drive = make_drive(fleet_server)
+    fleet_drive(stream[: _n(200, 60)])  # warm the replicas pre-timing
+    results["replica-loss"] = gameday("replica-loss", drive_fn=fleet_drive)
+    fleet_restarts = sum(
+        c["restarts"]
+        for c in fleet_supervisor.status()["components"].values()
+    )
+    both_alive = all(r.alive() for r in fleet_replicas)
+    results["replica-loss"]["supervised_revives"] = fleet_restarts
+    results["replica-loss"]["replicas_alive_after"] = both_alive
+    results["replica-loss"]["router"] = fleet.router.stats()
+    results["replica-loss"]["ok"] = bool(
+        results["replica-loss"]["ok"] and fleet_restarts >= 1 and both_alive
+    )
+    fleet_supervisor.stop()
+
     # --- chaos-disabled differential + overhead (the "compiled in but
     # off" claim): responses with a scenario CONFIGURED but disarmed must
     # be byte-identical to a pristine registry, at a cost below the bench
@@ -1379,9 +1447,199 @@ def run_chaos_scenario() -> int:
     result["pass"] = bool(ok)
     print(json.dumps(result))
     server.stop()
+    fleet_server.stop()
     dir_store.close()
     crd_store.close()
     shutil.rmtree(tmpdir, ignore_errors=True)
+    return 0 if ok else 1
+
+
+def run_fleet_scenario() -> int:
+    """``bench.py --fleet`` (``make bench-fleet``): decisions/sec and
+    lone-request p50/p99 through the replicated engine fleet
+    (cedar_tpu/fleet) at 1 / 2 / 4 replicas, on the SAME policy set and
+    SAR stream. Reports per-replica routing splits and the scaling
+    efficiency rate_N / (N * rate_1). On the cpu backend the replicas
+    share the host's cores, so efficiency measures router overhead and
+    contention, not device scale-out — the JSON carries "backend":
+    "cpu-fallback" (like the other cpu benches) so the number can never
+    be read as a device measurement; on real hardware each replica maps
+    to its own device plane (docs/fleet.md). rc 0 iff every routed
+    decision matched the single-replica answers and the 1-replica router
+    overhead stayed sane (lone p99 within 3x of the direct batcher)."""
+    import threading
+
+    import jax
+
+    from cedar_tpu.engine.batcher import PipelinedBatcher
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.engine.fastpath import SARFastPath
+    from cedar_tpu.fleet import EngineFleet, EngineReplica
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    t0 = time.time()
+    n_policies = _n(1000, 80)
+    N_BODIES = _n(6000, 900)
+    LONE = _n(300, 120)
+    THREADS = 8
+
+    ps, users, nss, resources, verbs, groups = build_policy_set(n_policies)
+    stores = TieredPolicyStores([MemoryStore("fleetbench", ps)])
+    authorizer = CedarWebhookAuthorizer(stores)
+
+    rng = random.Random(31)
+
+    def body():
+        return json.dumps(
+            {
+                "apiVersion": "authorization.k8s.io/v1",
+                "kind": "SubjectAccessReview",
+                "spec": {
+                    "user": rng.choice(users),
+                    "uid": "u",
+                    "groups": [rng.choice(groups)],
+                    "resourceAttributes": {
+                        "verb": rng.choice(verbs),
+                        "version": "v1",
+                        "resource": rng.choice(resources),
+                        "namespace": rng.choice(nss),
+                    },
+                },
+            }
+        ).encode()
+
+    bodies = [body() for _ in range(N_BODIES)]
+
+    def pct(lat, q):
+        s = sorted(lat)
+        return s[min(len(s) - 1, int(len(s) * q))] if s else 0.0
+
+    def build_fleet(n_rep):
+        replicas = []
+        for i in range(n_rep):
+            eng = TPUPolicyEngine(
+                segred=True, name=f"fleet{n_rep}-r{i}", warm_max_batch=512
+            )
+            fp = SARFastPath(eng, authorizer)
+            replicas.append(
+                EngineReplica(
+                    i, eng, fp, max_batch=512, pipeline_depth=2,
+                    encode_workers=1, fleet_name=f"bench-fleet{n_rep}",
+                )
+            )
+        fleet = EngineFleet(replicas, name=f"bench-fleet{n_rep}")
+        fleet.load([s.policy_set() for s in stores], warm="off")
+        return fleet
+
+    # reference answers + direct-batcher lone latency (the router-overhead
+    # floor) from a plain single pipelined batcher over its own fast path
+    ref_engine = TPUPolicyEngine(segred=True, name="fleet-ref")
+    ref_engine.load([s.policy_set() for s in stores], warm="off")
+    ref_fast = SARFastPath(ref_engine, authorizer)
+    if not ref_fast.available:
+        print(json.dumps({
+            "metric": "fleet_scaling",
+            "error": "native fast path unavailable (no C++ toolchain)",
+        }))
+        return 1
+    expected = ref_fast.authorize_raw(bodies)
+    direct = PipelinedBatcher(
+        ref_fast, max_batch=512, window_s=0.0002, depth=2, encode_workers=1
+    )
+    direct_lat = []
+    for b in bodies[:LONE]:
+        s0 = time.monotonic()
+        direct.submit(b, timeout=30)
+        direct_lat.append(time.monotonic() - s0)
+    direct.stop()
+    direct_p99 = pct(direct_lat, 0.99)
+
+    results = {}
+    correct = True
+    rate1 = None
+    lone_overhead_ok = True
+    for n_rep in (1, 2, 4):
+        fleet = build_fleet(n_rep)
+        try:
+            # warm the serving shapes off the timed window
+            for b in bodies[:64]:
+                fleet.submit(b, timeout=60)
+            answers = [None] * len(bodies)
+            errors = []
+
+            def worker(lo, hi, answers=answers, errors=errors, fleet=fleet):
+                for j in range(lo, hi):
+                    try:
+                        answers[j] = fleet.submit(bodies[j], timeout=60)
+                    except Exception as e:  # noqa: BLE001 — counted, not raised
+                        errors.append(repr(e))
+
+            per = (len(bodies) + THREADS - 1) // THREADS
+            threads = [
+                threading.Thread(
+                    target=worker, args=(k * per, min((k + 1) * per, len(bodies)))
+                )
+                for k in range(THREADS)
+            ]
+            t_run = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.monotonic() - t_run
+            rate = len(bodies) / elapsed
+            ok = not errors and answers == expected
+            correct = correct and ok
+
+            lone = []
+            for b in bodies[:LONE]:
+                s0 = time.monotonic()
+                fleet.submit(b, timeout=30)
+                lone.append(time.monotonic() - s0)
+            entry = {
+                "decisions_per_sec": round(rate),
+                "lone_p50_us": round(pct(lone, 0.50) * 1e6, 1),
+                "lone_p99_us": round(pct(lone, 0.99) * 1e6, 1),
+                "routed": fleet.router.stats()["routed"],
+                "answers_match": ok,
+                "errors": len(errors),
+            }
+            if rate1 is None:
+                rate1 = rate
+                # router overhead gate: a 1-replica fleet's lone p99 must
+                # stay within 3x of the direct batcher (same batcher
+                # underneath; the delta IS the router)
+                entry["direct_p99_us"] = round(direct_p99 * 1e6, 1)
+                lone_overhead_ok = pct(lone, 0.99) <= max(
+                    3.0 * direct_p99, 0.02
+                )
+                entry["router_overhead_ok"] = bool(lone_overhead_ok)
+            else:
+                entry["scaling_efficiency"] = round(
+                    rate / (n_rep * rate1), 3
+                )
+            results[str(n_rep)] = entry
+        finally:
+            fleet.stop()
+
+    backend = jax.default_backend()
+    fallback_reason = os.environ.get("CEDAR_BENCH_CPU_FALLBACK")
+    result = {
+        "metric": "fleet_scaling",
+        "smoke": _SMOKE,
+        "policies": n_policies,
+        "requests": N_BODIES,
+        "threads": THREADS,
+        "results": results,
+        "backend": "cpu-fallback" if backend == "cpu" else backend,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    if fallback_reason:
+        result["backend_note"] = fallback_reason
+    ok = bool(correct and lone_overhead_ok)
+    result["pass"] = ok
+    print(json.dumps(result))
     return 0 if ok else 1
 
 
@@ -2113,6 +2371,19 @@ if __name__ == "__main__":
 
         jax.config.update("jax_cpu_enable_async_dispatch", True)
         sys.exit(run_shadow_scenario())
+
+    if "--fleet" in sys.argv:
+        # fleet-scaling scenario (make bench-fleet): cpu-only by default —
+        # the replicas share the host cores there, so the JSON is labeled
+        # cpu-fallback and the record measures router overhead +
+        # correctness, with scaling efficiency meaningful only on real
+        # multi-device hardware. Same stage-isolation env rationale as the
+        # pipeline bench.
+        os.environ.setdefault("CEDAR_NATIVE_THREADS", "1")
+        from cedar_tpu.jaxenv import force_cpu
+
+        force_cpu()
+        sys.exit(run_fleet_scenario())
 
     if "--chaos" in sys.argv:
         # game-day suite (make bench-chaos): cpu-only BY DESIGN — the

@@ -97,6 +97,12 @@ SEAMS = {
     "shadow.process": "shadow worker batch processing",
     "rollout.stage": "rollout candidate staging",
     "rollout.promote": "rollout promotion",
+    "fleet.route": "fleet router replica selection (request thread)",
+    "fleet.hedge": "hedged-dispatch fire point (lone-request tail hedge)",
+    "fleet.replica_dispatch": "replica batcher worker loop after claiming "
+    "a batch (kill = one replica lost)",
+    "fleet.promote": "per-replica compiled-set swap inside the fleet "
+    "promotion barrier",
     "response": "final (decision, reason, error) swap (reference parity)",
 }
 

@@ -127,6 +127,22 @@ BUILTIN_SCENARIOS = {
         ],
         "slo": {"availability": 0.995},
     },
+    "replica-loss": {
+        "name": "replica-loss",
+        "seed": 19,
+        "description": "one fleet replica's batcher worker dies "
+        "mid-traffic; the router must route around it with zero decision "
+        "flips, and the supervisor must revive it (requires a fleet of "
+        ">= 2 replicas — cedar-chaos --spawn starts one)",
+        "faults": [
+            {"seam": "fleet.replica_dispatch", "kind": "kill", "after": 10,
+             "count": 1, "message": "replica killed (game day)"},
+        ],
+        "slo": {"availability": 0.995},
+        # hints for cedar-chaos --spawn: the scenario needs a replicated
+        # serving topology (ignored by /chaos/configure)
+        "spawn_args": ["--fleet-replicas", "2"],
+    },
 }
 
 

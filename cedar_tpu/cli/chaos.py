@@ -243,10 +243,12 @@ forbid (
 """
 
 
-def spawn_server(tmpdir: str):
+def spawn_server(tmpdir: str, extra_args=()):
     """Launch a throwaway local webhook (plain HTTP, TPU backend on
     whatever jax backend the env pins, chaos control enabled) and wait for
-    readiness. Returns (process, server_url, control_url)."""
+    readiness. ``extra_args`` appends CLI flags — scenarios that need a
+    particular topology carry them as "spawn_args" (replica-loss spawns
+    --fleet-replicas 2). Returns (process, server_url, control_url)."""
     import os
     import subprocess
 
@@ -278,6 +280,7 @@ def spawn_server(tmpdir: str):
             "--request-timeout-ms", "1000",
             "--supervisor-interval-seconds", "0.2",
             "--breaker-recovery-seconds", "1.0",
+            *[str(a) for a in extra_args],
         ],
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
@@ -293,7 +296,26 @@ def spawn_server(tmpdir: str):
         try:
             status, _ = _http("GET", f"{control_url}/readyz", timeout=2.0)
             if status == 200:
+                if "--fleet-replicas" in extra_args:
+                    # the scenario REQUIRES the replicated topology: a
+                    # server that silently downgraded to single-engine
+                    # (no native fast path) would run the game day with
+                    # no replica to kill and report a vacuous pass
+                    status, _ = _http(
+                        "GET", f"{control_url}/debug/fleet", timeout=2.0
+                    )
+                    if status != 200:
+                        proc.terminate()
+                        raise RuntimeError(
+                            "spawned webhook is not serving a fleet "
+                            "(/debug/fleet answered "
+                            f"{status}); the scenario needs "
+                            "--fleet-replicas support (native fast "
+                            "path required)"
+                        )
                 return proc, server_url, control_url
+        except RuntimeError:
+            raise
         except Exception:  # noqa: BLE001 — still starting
             pass
         time.sleep(0.5)
@@ -384,7 +406,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             import tempfile
 
             tmpdir = tempfile.mkdtemp(prefix="cedar-gameday-")
-            proc, server_url, control_url = spawn_server(tmpdir)
+            proc, server_url, control_url = spawn_server(
+                tmpdir, extra_args=scenario.get("spawn_args") or ()
+            )
         result = run_gameday(
             scenario,
             server_url,

@@ -331,6 +331,84 @@ def build_server(args) -> WebhookServer:
                 native_error(),
             )
 
+    # engine fleet (cedar_tpu/fleet, docs/fleet.md): --fleet-replicas N>=2
+    # replicates the authorization engine into N replicas — independent
+    # engines + breakers + device recoveries + batchers — behind a
+    # health-aware router the server routes through between the decision
+    # cache and the batchers. Replica 0 reuses the objects built above;
+    # replicas 1..N-1 clone the settings. The store reloader compiles once
+    # and adopts into every replica; promotion swaps all replicas under
+    # the fleet's generation barrier. N=1 (default) keeps the single-engine
+    # path byte-identical to previous releases.
+    fleet = None
+    fleet_recoveries = []
+    if args.fleet_replicas > 1 and fastpath is not None:
+        from ..engine.evaluator import TPUPolicyEngine
+        from ..engine.fastpath import SARFastPath
+        from ..fleet import EngineFleet, EngineReplica
+
+        replicas = [
+            EngineReplica(
+                0,
+                engine,
+                fastpath,
+                breaker=authz_breaker,
+                recovery=authz_recovery,
+                max_batch=args.max_batch,
+                window_s=args.batch_window_us / 1e6,
+                pipeline_depth=args.pipeline_depth,
+                encode_workers=args.encode_workers,
+            )
+        ]
+        for i in range(1, args.fleet_replicas):
+            r_breaker = _make_breaker(f"authorization-r{i}")
+            r_engine = TPUPolicyEngine(
+                mesh=mesh, segred=segred, name=f"authorization-r{i}",
+                warm_max_batch=args.max_batch,
+            )
+            r_recovery = None
+            if args.supervisor_interval_seconds > 0:
+                from ..server.supervisor import DeviceRecovery
+
+                r_recovery = DeviceRecovery(
+                    r_engine, breaker=r_breaker,
+                    name=f"authorization-r{i}",
+                    warm_max_batch=args.max_batch,
+                )
+                fleet_recoveries.append(r_recovery)
+            r_fastpath = SARFastPath(r_engine, authorizer, breaker=r_breaker)
+            if r_recovery is not None:
+                r_fastpath.on_device_error = r_recovery.observe
+            replicas.append(
+                EngineReplica(
+                    i,
+                    r_engine,
+                    r_fastpath,
+                    breaker=r_breaker,
+                    recovery=r_recovery,
+                    max_batch=args.max_batch,
+                    window_s=args.batch_window_us / 1e6,
+                    pipeline_depth=args.pipeline_depth,
+                    encode_workers=args.encode_workers,
+                )
+            )
+        fleet = EngineFleet(
+            replicas, hedge_delay_s=args.hedge_delay_ms / 1e3
+        )
+        # the reloader drives the whole fleet through one target: compile
+        # on replica 0, adopt (compile-free) into the rest
+        reloader.targets[0] = (fleet, stores)
+        log.info(
+            "engine fleet enabled: %d replicas, hedge delay %.1fms",
+            args.fleet_replicas,
+            args.hedge_delay_ms,
+        )
+    elif args.fleet_replicas > 1:
+        log.warning(
+            "--fleet-replicas requires --backend tpu with the native fast "
+            "path; serving single-engine"
+        )
+
     # admission gets the allow-all final tier (main.go:111-116); it shares
     # the authz stack's validation posture (the synthetic allow-all tail is
     # trivially lowerable, so the gate treats both stacks identically)
@@ -370,14 +448,22 @@ def build_server(args) -> WebhookServer:
     if args.decision_cache_size > 0:
         from ..cache import DecisionCache
 
-        def _generation_fn(tier_stores, tier_engine):
+        def _generation_fn(tier_stores, tier_engine, tier_fleet=None):
             """Composite cache generation: store CONTENT generations plus
             the engine's load counter when a compiled backend serves the
             decisions. Content alone bumps at the watch/refresh event,
             which precedes the async recompile by up to a reloader tick —
             folding in load_generation makes entries computed from the old
             compiled set die again when the engine actually swaps, instead
-            of outliving the reload under the new content generation."""
+            of outliving the reload under the new content generation.
+            With a fleet, the composite folds the FLEET epoch plus every
+            replica's load generation (cache_epoch) so no replica can
+            answer a cached decision from a stale policy set."""
+            if tier_fleet is not None:
+                return lambda: (
+                    tier_stores.cache_generation(),
+                    tier_fleet.cache_epoch(),
+                )
             if tier_engine is None:
                 return tier_stores.cache_generation
             return lambda: (
@@ -390,7 +476,7 @@ def build_server(args) -> WebhookServer:
             allow_ttl_s=args.decision_cache_allow_ttl_seconds,
             deny_ttl_s=args.decision_cache_deny_ttl_seconds,
             no_opinion_ttl_s=args.decision_cache_no_opinion_ttl_seconds,
-            generation_fn=_generation_fn(stores, engine),
+            generation_fn=_generation_fn(stores, engine, fleet),
             path="authorization",
         )
         if args.decision_cache_admission:
@@ -445,6 +531,7 @@ def build_server(args) -> WebhookServer:
 
         rollout = RolloutController(
             authz_engine=engine,
+            authz_fleet=fleet,
             admission_engine=admission_engine,
             sample_rate=args.shadow_sample_rate,
             queue_depth=args.shadow_queue_depth,
@@ -534,7 +621,7 @@ def build_server(args) -> WebhookServer:
             interval_s=args.supervisor_interval_seconds,
             wedge_budget_s=args.supervisor_wedge_seconds,
         )
-        for rec in (authz_recovery, admission_recovery):
+        for rec in (authz_recovery, admission_recovery, *fleet_recoveries):
             if rec is not None:
                 supervisor.register_recovery(rec)
 
@@ -576,6 +663,7 @@ def build_server(args) -> WebhookServer:
         keyfile=keyfile,
         fastpath=fastpath,
         admission_fastpath=admission_fastpath,
+        fleet=fleet,
         batch_window_s=args.batch_window_us / 1e6,
         max_batch=args.max_batch,
         pipeline_depth=args.pipeline_depth,
@@ -621,6 +709,22 @@ def _register_supervised(supervisor, server, rollout, stores) -> None:
             restart=lambda reason, b=batcher: b.revive(force=_force(reason)),
             heartbeat=HeartbeatGroup(lambda b=batcher: b.heartbeats),
         )
+    fleet = getattr(server, "fleet", None)
+    if fleet is not None:
+        # one supervised component per replica, keyed {component, replica}
+        # so a fleet member's death/restart is attributable; revive goes
+        # through the fleet (it also returns a drained replica to the
+        # routing set)
+        for r in fleet.replicas:
+            supervisor.register(
+                "batcher.authorization",
+                replica=r.name,
+                threads=lambda rr=r: list(rr.batcher._threads),
+                restart=lambda reason, i=r.index, f=fleet: f.revive_replica(
+                    i, force=_force(reason)
+                ),
+                heartbeat=HeartbeatGroup(lambda rr=r: rr.batcher.heartbeats),
+            )
     if rollout is not None:
         supervisor.register(
             "shadow.worker",
@@ -718,6 +822,28 @@ def make_parser() -> argparse.ArgumentParser:
         default=2,
         help="host encode threads feeding the pipelined batcher "
         "(only used with --pipeline-depth > 0)",
+    )
+
+    fleet = parser.add_argument_group("engine fleet")
+    fleet.add_argument(
+        "--fleet-replicas",
+        type=int,
+        default=1,
+        help="replicate the authorization engine into N fleet members "
+        "behind a health-aware router (least-loaded among healthy, "
+        "deterministic spillover around open-breaker/dead/rebuilding "
+        "replicas); 1 keeps the single-engine path (docs/fleet.md). "
+        "Requires --backend tpu with the native fast path",
+    )
+    fleet.add_argument(
+        "--hedge-delay-ms",
+        type=float,
+        default=0.0,
+        help="tail-latency hedge for LONE requests: when the routed "
+        "replica has not answered within this delay, dispatch a "
+        "duplicate to the next-healthiest replica and take the first "
+        "answer (the loser is cancelled); 0 disables hedging "
+        "(docs/fleet.md)",
     )
 
     serving = parser.add_argument_group("secure serving")

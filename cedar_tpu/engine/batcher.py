@@ -43,16 +43,22 @@ T = TypeVar("T")
 R = TypeVar("R")
 
 
-def _record_worker_death(component: str) -> None:
+def _record_worker_death(component: str, replica: str = "") -> None:
     """A worker thread is unwinding on an uncaught exception: make the
-    death VISIBLE (log + cedar_worker_deaths_total) at the point it
-    happens — before supervision, a dead stage just left its bounded
-    queue filling forever with nothing in any dashboard."""
-    log.critical("worker thread %s died on an uncaught exception", component)
+    death VISIBLE (log + cedar_worker_deaths_total{component, replica}) at
+    the point it happens — before supervision, a dead stage just left its
+    bounded queue filling forever with nothing in any dashboard. The
+    replica label names the fleet member the worker served (empty on the
+    single-engine path), so a fleet member's death is attributable."""
+    log.critical(
+        "worker thread %s%s died on an uncaught exception",
+        component,
+        f" [{replica}]" if replica else "",
+    )
     try:
         from ..server.metrics import record_worker_death
 
-        record_worker_death(component)
+        record_worker_death(component, replica)
     except Exception:  # noqa: BLE001 — metrics must never mask the death
         pass
 
@@ -118,6 +124,8 @@ class MicroBatcher:
         max_batch: int = 8192,
         window_s: float = 0.0002,
         metrics_path: Optional[str] = None,
+        replica: str = "",
+        dispatch_seam: Optional[str] = None,
     ):
         self._fn = fn
         self.max_batch = max_batch
@@ -125,6 +133,16 @@ class MicroBatcher:
         # label for cedar_batch_occupancy / cedar_pipeline_stall metrics;
         # None (embedders, tests) records nothing
         self.metrics_path = metrics_path
+        # fleet-member identity for worker-death attribution
+        # (cedar_worker_deaths_total{component, replica}); "" on the
+        # single-engine path so existing label sets stay stable
+        self.replica = replica
+        # optional extra chaos seam fired by the batch-claiming worker loop
+        # (after pipeline.collect, same containment: OUTSIDE the per-batch
+        # try, so a kill rule unwinds the worker like a real crash). The
+        # fleet wires "fleet.replica_dispatch" here so a game day can kill
+        # exactly one replica's worker mid-traffic (docs/fleet.md).
+        self._dispatch_seam = dispatch_seam
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: List[tuple] = []
@@ -194,6 +212,22 @@ class MicroBatcher:
             "window_us": round(self.window_s * 1e6, 1),
         }
 
+    def queue_fill(self) -> int:
+        """Queued (unclaimed) items — the fleet router's load signal."""
+        with self._cv:
+            return len(self._queue)
+
+    def has_pending(self, coalesce_key) -> bool:
+        """True while an entry for this coalesce key is still QUEUED here
+        — the fleet router's coalescing-affinity signal: identical
+        concurrent requests must land on the replica already holding the
+        shared slot, or least-loaded spreading would evaluate K times
+        what one batcher would have evaluated once."""
+        if coalesce_key is None:
+            return False
+        with self._cv:
+            return coalesce_key in self._pending
+
     def submit(
         self,
         item: T,
@@ -217,6 +251,18 @@ class MicroBatcher:
         withdrawn (and its pending registration dropped) only when the LAST
         waiter leaves, so a follower expiry can never cancel the leader or
         strand a result future nobody can reach."""
+        return self.wait_entry(
+            self.enqueue(item, coalesce_key=coalesce_key), timeout=timeout
+        )
+
+    def enqueue(self, item: T, coalesce_key: Optional[str] = None) -> tuple:
+        """Enqueue one item WITHOUT waiting; returns an opaque entry for
+        ``wait_entry``/``entry_done``/``take_result``/``cancel``. The split
+        surface exists for the fleet router's hedged dispatch
+        (cedar_tpu/fleet): a request thread can hold entries on two
+        replicas' batchers and take whichever answers first. Semantics
+        (coalescing, stopped/dead refusal) are exactly submit()'s front
+        half."""
         with self._cv:
             if self._stopped:
                 raise RuntimeError("MicroBatcher is stopped")
@@ -238,6 +284,37 @@ class MicroBatcher:
                     self._pending[coalesce_key] = entry
                 self._queue.append(entry)
                 self._cv.notify()
+        return entry
+
+    @staticmethod
+    def entry_done(entry: tuple) -> bool:
+        """True once the entry's result (or error) landed."""
+        return entry[1].event.is_set()
+
+    @staticmethod
+    def entry_error(entry: tuple) -> Optional[BaseException]:
+        """The completed entry's error, if its batch failed (hedged
+        waiters drop an errored side and keep waiting on the other)."""
+        return entry[1].error
+
+    @staticmethod
+    def entry_wait(entry: tuple, timeout: Optional[float]) -> bool:
+        """Block up to ``timeout`` for the entry's result; True when set.
+        No liveness polling — hedged waiters interleave this with their own
+        ``_alive`` checks (wait_entry is the full-service wait)."""
+        return entry[1].event.wait(timeout)
+
+    def cancel(self, entry: tuple) -> None:
+        """Detach one waiter without waiting (the hedge loser's
+        cancel-on-first-answer): the shared queue slot is withdrawn only
+        when the LAST waiter leaves, exactly like a deadline expiry."""
+        with self._cv:
+            self._withdraw(entry)
+
+    def wait_entry(self, entry: tuple, timeout: Optional[float] = None) -> R:
+        """submit()'s back half: block until the entry's result is
+        available (bounded by ``timeout`` and worker liveness)."""
+        slot = entry[1]
         deadline = None if timeout is None else time.monotonic() + timeout
         while not slot.event.is_set():
             wait = self.LIVENESS_POLL_S
@@ -262,6 +339,12 @@ class MicroBatcher:
                     "batcher dead: worker thread exited without "
                     "delivering results"
                 )
+        return self.take_result(entry)
+
+    @staticmethod
+    def take_result(entry: tuple) -> R:
+        """Result (or raise) for a COMPLETED entry (entry_done() is True)."""
+        slot = entry[1]
         if slot.error is not None:
             if slot.key is not None:
                 # coalesced slots can have MULTIPLE waiters reaching this
@@ -373,7 +456,7 @@ class MicroBatcher:
         try:
             self._run_loop()
         except BaseException:  # noqa: BLE001 — visibility, then unwind
-            _record_worker_death("batcher.worker")
+            _record_worker_death("batcher.worker", self.replica)
             raise
 
     def _run_loop(self) -> None:
@@ -386,9 +469,11 @@ class MicroBatcher:
                 return
             if not batch:
                 continue
-            # chaos seam OUTSIDE the per-batch containment below: a kill
+            # chaos seams OUTSIDE the per-batch containment below: a kill
             # rule unwinds this worker exactly like a C-extension crash
             chaos_fire("pipeline.collect")
+            if self._dispatch_seam is not None:
+                chaos_fire(self._dispatch_seam, self.replica)
             hb.busy()
             try:
                 self._complete_batch(batch, self._fn([it for it, _ in batch]))
@@ -433,6 +518,8 @@ class PipelinedBatcher(MicroBatcher):
         depth: int = 2,
         encode_workers: int = 2,
         metrics_path: Optional[str] = None,
+        replica: str = "",
+        dispatch_seam: Optional[str] = None,
     ):
         from concurrent.futures import ThreadPoolExecutor
 
@@ -454,7 +541,8 @@ class PipelinedBatcher(MicroBatcher):
         self._stall_s = {"collect": 0.0, "dispatch": 0.0, "decode": 0.0}
         super().__init__(
             fn=None, max_batch=max_batch, window_s=window_s,
-            metrics_path=metrics_path,
+            metrics_path=metrics_path, replica=replica,
+            dispatch_seam=dispatch_seam,
         )
 
     def _alive(self) -> bool:
@@ -618,7 +706,7 @@ class PipelinedBatcher(MicroBatcher):
         try:
             self._collect_loop(epoch, dispatch_q, dispatcher)
         except BaseException:  # noqa: BLE001 — visibility, then unwind
-            _record_worker_death("pipeline.collect")
+            _record_worker_death("pipeline.collect", self.replica)
             raise
 
     def _collect_loop(self, epoch, dispatch_q, dispatcher) -> None:
@@ -630,9 +718,11 @@ class PipelinedBatcher(MicroBatcher):
                 break
             if not batch:
                 continue
-            # chaos kill seam OUTSIDE the per-batch containment: unwinds
+            # chaos kill seams OUTSIDE the per-batch containment: unwind
             # this stage like a real crash would
             chaos_fire("pipeline.collect")
+            if self._dispatch_seam is not None:
+                chaos_fire(self._dispatch_seam, self.replica)
             hb.busy()
             self._batches_total += 1
             items = [it for it, _ in batch]
@@ -659,7 +749,7 @@ class PipelinedBatcher(MicroBatcher):
         try:
             self._dispatch_loop(epoch, dispatch_q, decode_q, decoder)
         except BaseException:  # noqa: BLE001 — visibility, then unwind
-            _record_worker_death("pipeline.dispatch")
+            _record_worker_death("pipeline.dispatch", self.replica)
             raise
 
     def _dispatch_loop(self, epoch, dispatch_q, decode_q, decoder) -> None:
@@ -707,7 +797,7 @@ class PipelinedBatcher(MicroBatcher):
         try:
             self._decode_loop(epoch, decode_q)
         except BaseException:  # noqa: BLE001 — visibility, then unwind
-            _record_worker_death("pipeline.decode")
+            _record_worker_death("pipeline.decode", self.replica)
             raise
 
     def _decode_loop(self, epoch, decode_q) -> None:

@@ -764,6 +764,24 @@ class TPUPolicyEngine:
         self._warm_first.set()
         return prior, generation
 
+    def clear_compiled(self, expected=None) -> bool:
+        """Drop the compiled set — the fleet's partial-failure restore for
+        a replica that had NO prior set before a barrier swap
+        (cedar_tpu/fleet): there is nothing to adopt back, so the
+        candidate must come OUT or the replica would serve
+        mixed-generation answers against the restored fleet. ``expected``
+        guards against racing swaps: the clear only happens while the
+        engine still holds that exact set. Bumps load_generation so any
+        cached decisions from the cleared set die."""
+        with self._lock:
+            if expected is not None and self._compiled is not expected:
+                return False
+            if self._compiled is None:
+                return False
+            self._compiled = None
+            self.load_generation += 1
+        return True
+
     def rebuild_compiled(self) -> bool:
         """Re-place the CURRENT compiled set on the backend from its
         retained host-side pack — the device-loss recovery primitive

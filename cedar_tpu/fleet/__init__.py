@@ -1,0 +1,26 @@
+"""Fault-tolerant engine fleet: replicated engines behind one health-aware
+router, hedged dispatch for lone-request tails, and fleet-atomic rollout
+(docs/fleet.md).
+
+Layering: server/http.py routes raw request bodies through
+``EngineFleet.submit`` between the decision cache and the replicas'
+batchers; the rollout controller and the store reloader drive the fleet
+through the same duck-typed surface a single ``TPUPolicyEngine`` exposes
+(``load`` / ``adopt_compiled`` / ``load_generation``); the supervisor
+revives individual replicas (``revive_replica``) keyed
+``{component, replica}``.
+"""
+
+from .fleet import EngineFleet
+from .replica import ACTIVE, DRAINING, RETIRED, EngineReplica
+from .router import FleetRouter, FleetUnavailable
+
+__all__ = [
+    "ACTIVE",
+    "DRAINING",
+    "RETIRED",
+    "EngineFleet",
+    "EngineReplica",
+    "FleetRouter",
+    "FleetUnavailable",
+]

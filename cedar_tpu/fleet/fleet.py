@@ -1,0 +1,318 @@
+"""The engine fleet: N replicas behind one router, with fleet-atomic
+compiled-set swaps and supervisor-driven replica lifecycle.
+
+``EngineFleet`` deliberately duck-types the single engine's lifecycle
+surface so the layers above need no fleet special-casing:
+
+  * the **store reloader** (cli/webhook.py TPUReloader) calls ``load`` —
+    the fleet compiles ONCE on replica 0 and adopts the compiled set into
+    every other replica (the jitted kernels live in the shared cache, so
+    adoption is compile-free);
+  * the **rollout controller** (cedar_tpu/rollout) calls
+    ``adopt_compiled`` — the fleet swaps EVERY replica under a generation
+    barrier or none: a failure on replica k (chaos ``fleet.promote``, a
+    real adoption error) restores replicas 0..k-1 to their prior sets
+    compile-free and re-raises, so no mixed-generation serving is ever
+    observable. ``load_generation`` is the per-replica generation tuple,
+    which makes the controller's existing lineage checks per-replica for
+    free;
+  * the **decision cache** folds ``cache_epoch()`` into its composite
+    generation — the fleet epoch plus every replica's load generation —
+    so no replica can answer a cached decision from a stale policy set.
+
+Replica lifecycle (drain → retire → revive) is exposed for the supervisor
+(cli/webhook.py registers each replica's batcher under
+``{component="batcher.<fleet>", replica="rN"}``) and for operators via
+/debug/fleet (server/http.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional, Sequence
+
+from ..chaos.registry import chaos_fire
+from .replica import EngineReplica
+from .router import FleetRouter, FleetUnavailable
+
+log = logging.getLogger(__name__)
+
+
+class _FleetPrior:
+    """Opaque rollback token from a fleet-atomic adopt: the per-replica
+    prior compiled sets, keyed by replica index. The rollout controller
+    stores it exactly like a single engine's prior set and hands it back
+    to ``adopt_compiled`` on rollback."""
+
+    __slots__ = ("priors",)
+
+    def __init__(self, priors):
+        self.priors = list(priors)  # [(replica index, prior compiled set)]
+
+
+class EngineFleet:
+    def __init__(
+        self,
+        replicas: Sequence[EngineReplica],
+        hedge_delay_s: float = 0.0,
+        name: str = "authorization",
+    ):
+        if not replicas:
+            raise ValueError("EngineFleet: at least one replica required")
+        self.replicas: List[EngineReplica] = list(replicas)
+        self.name = name
+        self._lock = threading.Lock()
+        # promotion barrier: cleared while compiled sets swap (router
+        # submits wait, bounded) so the swap sequence is one generation
+        # step, not a window requests can interleave
+        self._gate = threading.Event()
+        self._gate.set()
+        # fleet lifecycle epoch: bumps on every fleet-wide swap
+        # (load/adopt/restore); folded into the decision cache's composite
+        # generation via cache_epoch()
+        self._epoch = 0
+        self.router = FleetRouter(
+            lambda: self.replicas,
+            fleet_name=name,
+            hedge_delay_s=hedge_delay_s,
+            gate=self._gate,
+        )
+        for r in self.replicas:
+            r.publish_state()
+
+    # ------------------------------------------------------------- serving
+
+    def submit(self, body, timeout: Optional[float] = None, coalesce_key=None):
+        """Route one raw request body through the fleet (router.submit)."""
+        return self.router.submit(
+            body, timeout=timeout, coalesce_key=coalesce_key
+        )
+
+    # ------------------------------------------- engine-like surface
+    # (reloader / rollout controller / decision cache duck-typing)
+
+    @property
+    def template_engine(self):
+        """Replica 0's engine — the settings template for candidate
+        clones (rollout) and the compile target for fleet loads."""
+        return self.replicas[0].engine
+
+    @property
+    def load_generation(self):
+        """Per-replica load-generation tuple: one replica reloading,
+        rebuilding, or being swapped changes the composite — the rollout
+        controller's lineage checks become per-replica without knowing
+        the fleet exists."""
+        return tuple(r.engine.load_generation for r in self.replicas)
+
+    def cache_epoch(self):
+        """Folded into the decision cache's composite generation: any
+        fleet-wide swap or per-replica engine swap kills cached decisions,
+        so no replica can answer from a stale policy set."""
+        return (self._epoch,) + self.load_generation
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "fleet_replicas": len(self.replicas),
+            **self.replicas[0].engine.stats,
+        }
+
+    def warm_ready(self) -> bool:
+        return all(r.engine.warm_ready() for r in self.replicas)
+
+    def load(self, tiers, warm: str = "default") -> dict:
+        """Reloader target: compile the tier stack ONCE (replica 0) and
+        adopt the compiled set into every other replica — the kernel cache
+        is shared, so replicas 1..N-1 pay placement, never compilation.
+
+        Same no-mixed-generation invariant as the promotion barrier: an
+        adoption failing on replica k restores replica 0 and replicas
+        1..k-1 to the prior set before re-raising, so the reloader's
+        "serving previous set" log stays TRUE for the whole fleet (a
+        half-swapped fleet would answer generation-dependent decisions
+        depending on which replica the router picks). The whole operation
+        holds the fleet lock: a reload interleaving with a concurrent
+        promotion's barrier would otherwise leave the two swap sequences
+        half-applied to different replicas — permanently mixed, with both
+        operations reporting success. The compile (r0.load) runs under
+        the lock but OUTSIDE the router gate — serving continues on the
+        prior sets throughout; only the microsecond adoption swaps gate
+        new dispatches."""
+        with self._lock:
+            r0 = self.replicas[0].engine
+            prior = r0.compiled_set
+            stats = r0.load(tiers, warm=warm)
+            cs = r0.compiled_set
+            done = []
+            self._gate.clear()
+            try:
+                for r in self.replicas[1:]:
+                    r.engine.adopt_compiled(cs, donor=r0)
+                    done.append(r)
+            except BaseException:
+                # first-load failures (prior None) leave the un-adopted
+                # replicas compiled-set-less: they don't admit work, so no
+                # mixed serving; with a prior set, restore everyone to it
+                if prior is not None:
+                    for r in (*done, self.replicas[0]):
+                        try:
+                            r.engine.adopt_compiled(prior)
+                        except Exception:  # noqa: BLE001 — keep restoring
+                            log.exception(
+                                "fleet %s: restore of replica %s after a "
+                                "failed reload adoption ALSO failed",
+                                self.name,
+                                r.name,
+                            )
+                raise
+            finally:
+                self._gate.set()
+            self._epoch += 1
+        return stats
+
+    def adopt_compiled(self, compiled, donor=None) -> tuple:
+        """Fleet-atomic swap (module docstring): every replica adopts
+        ``compiled`` under the generation barrier, or none do. Returns
+        (prior token, per-replica generation tuple) — the same contract as
+        ``TPUPolicyEngine.adopt_compiled``, with the prior token accepted
+        back for rollback."""
+        if isinstance(compiled, _FleetPrior):
+            return self._restore(compiled)
+        with self._lock:
+            self._gate.clear()
+            done = []
+            failed_on = None
+            try:
+                for r in self.replicas:
+                    failed_on = r
+                    chaos_fire("fleet.promote", r.name)
+                    prior, _gen = r.engine.adopt_compiled(
+                        compiled, donor=donor
+                    )
+                    done.append((r, prior))
+            except BaseException as e:
+                # partial failure: restore the already-swapped replicas to
+                # their prior sets compile-free — zero mixed-generation
+                # serving survives the barrier. A replica that had NO
+                # prior set (first-load failure state) has the candidate
+                # cleared back out instead: nothing to adopt, and leaving
+                # it on the candidate would be exactly the mixed serving
+                # the barrier forbids.
+                for r, prior in reversed(done):
+                    try:
+                        if prior is None:
+                            r.engine.clear_compiled(expected=compiled)
+                        else:
+                            r.engine.adopt_compiled(prior)
+                    except Exception:  # noqa: BLE001 — keep restoring the rest
+                        log.exception(
+                            "fleet %s: restore of replica %s after a failed "
+                            "promotion ALSO failed",
+                            self.name,
+                            r.name,
+                        )
+                log.error(
+                    "fleet %s: promotion failed on replica %s; %d "
+                    "already-swapped replica(s) restored: %s",
+                    self.name,
+                    failed_on.name if failed_on is not None else "?",
+                    len(done),
+                    e,
+                )
+                self._record_promotion("rolled_back")
+                raise
+            finally:
+                self._gate.set()
+            self._epoch += 1
+        self._record_promotion("committed")
+        return (
+            _FleetPrior([(r.index, prior) for r, prior in done]),
+            self.load_generation,
+        )
+
+    def _restore(self, token: _FleetPrior) -> tuple:
+        """Rollback half of the barrier: hand each replica its own prior
+        set back (compile-free — the sets stayed device-resident)."""
+        by_index = {r.index: r for r in self.replicas}
+        with self._lock:
+            self._gate.clear()
+            current = []
+            try:
+                for idx, prior in token.priors:
+                    r = by_index.get(idx)
+                    if r is None:
+                        continue
+                    if prior is None:
+                        # the replica had no set at the original swap:
+                        # "restoring" it means clearing the adopted set
+                        # back out, never leaving it on a generation the
+                        # rest of the fleet just left
+                        r.engine.clear_compiled()
+                        continue
+                    cur, _gen = r.engine.adopt_compiled(prior)
+                    current.append((idx, cur))
+            finally:
+                self._gate.set()
+            self._epoch += 1
+        return _FleetPrior(current), self.load_generation
+
+    def _record_promotion(self, result: str) -> None:
+        try:
+            from ..server.metrics import record_fleet_promotion
+
+            record_fleet_promotion(result)
+        except Exception:  # noqa: BLE001 — metrics never gate promotion
+            pass
+
+    # ----------------------------------------------------------- lifecycle
+
+    def _replica(self, index: int) -> EngineReplica:
+        for r in self.replicas:
+            if r.index == index:
+                return r
+        raise KeyError(f"no replica with index {index}")
+
+    def drain_replica(self, index: int) -> bool:
+        return self._replica(index).drain()
+
+    def retire_replica(self, index: int, drain_timeout_s: float = 5.0) -> bool:
+        return self._replica(index).retire(drain_timeout_s=drain_timeout_s)
+
+    def revive_replica(self, index: int, force: bool = False) -> bool:
+        return self._replica(index).revive(force=force)
+
+    def stop(self, drain_timeout_s: float = 5.0) -> None:
+        for r in self.replicas:
+            try:
+                r.stop(drain_timeout_s=drain_timeout_s)
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception(
+                    "fleet %s: replica %s stop failed", self.name, r.name
+                )
+
+    # -------------------------------------------------------------- status
+
+    def publish_states(self) -> None:
+        """Refresh cedar_fleet_replica_state for every replica — called at
+        /metrics scrape time (server/http.py) as well as on lifecycle
+        transitions, so a dead/breaker-open replica never keeps exposing
+        its last-known-active gauge value between operator visits to
+        /debug/fleet."""
+        for r in self.replicas:
+            r.publish_state()
+
+    def status(self) -> dict:
+        """The /debug/fleet document."""
+        self.publish_states()
+        return {
+            "fleet": self.name,
+            "replicas": [r.health() for r in self.replicas],
+            "epoch": self._epoch,
+            "load_generation": list(self.load_generation),
+            "router": self.router.stats(),
+        }
+
+
+__all__ = ["EngineFleet", "FleetRouter", "FleetUnavailable"]

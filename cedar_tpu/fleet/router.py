@@ -1,0 +1,333 @@
+"""Health-aware request routing over the replica pool.
+
+The router sits between the HTTP/cache layer and the replicas' batchers
+(server/http.py hands it raw SAR bodies exactly where the single-engine
+path hands its one batcher). Three behaviors:
+
+  * **least-loaded among healthy** — each submit picks the admitting
+    replica with the fewest in-flight requests + queued items; ties break
+    on replica index, so the choice is deterministic for a given load
+    picture (no RNG anywhere in the routing plane).
+  * **deterministic spillover** — a replica that fails MID-flight (dead
+    worker unwinding, raising batcher) is excluded and the request
+    re-dispatches to the next healthy replica with its REMAINING deadline
+    budget; when every replica is excluded the router raises
+    ``FleetUnavailable`` and the server answers from the interpreter path
+    in the request thread — bounded degradation, never an error for a
+    routable request. Replicas whose breaker is open / fast path is
+    unavailable / recovery is rebuilding are excluded up front
+    (EngineReplica.admits), mirroring the single-engine breaker bypass.
+  * **hedged dispatch** — a LONE request (idle replica, nothing queued)
+    optionally hedges its tail: if the primary has not answered within
+    ``hedge_delay_s``, a duplicate dispatches to the next-healthiest
+    replica and the first answer wins; the loser is cancelled through the
+    batcher's waiter accounting (cancel-on-first-answer — a hedge never
+    doubles steady-state device work, only the idle tail's).
+
+Chaos seams: ``fleet.route`` fires on every pick (request thread) and
+``fleet.hedge`` at the hedge fire point (docs/fleet.md, docs/resilience.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..chaos.registry import chaos_fire
+from ..engine.batcher import DeadlineExceeded
+
+log = logging.getLogger(__name__)
+
+# poll granularity while waiting on two hedged entries at once: hedges
+# target tails far above a millisecond (a wedged replica, a recompiling
+# plane), so 1ms of added resolution is noise on the latency they rescue
+_HEDGE_POLL_S = 0.001
+
+
+class FleetUnavailable(RuntimeError):
+    """No replica can currently admit work; the caller serves its
+    interpreter fallback in the request thread (the fleet twin of the
+    single-engine breaker-open bypass)."""
+
+
+class FleetRouter:
+    def __init__(
+        self,
+        replicas_fn: Callable[[], list],
+        fleet_name: str = "authorization",
+        hedge_delay_s: float = 0.0,
+        gate: Optional[threading.Event] = None,
+    ):
+        self._replicas_fn = replicas_fn
+        self.fleet_name = fleet_name
+        # 0 disables hedging (the default: hedges trade idle capacity for
+        # tail latency, an explicit operator choice)
+        self.hedge_delay_s = max(0.0, float(hedge_delay_s))
+        # promotion barrier (EngineFleet.adopt_compiled): cleared while the
+        # fleet swaps compiled sets so no NEW dispatch lands mid-barrier;
+        # the wait is bounded so a wedged promote can never black-hole
+        # serving (in-flight batches use engine snapshots either way)
+        self._gate = gate
+        self._lock = threading.Lock()
+        self.routed: dict = {}  # replica name -> dispatch count
+        self.spillovers = 0
+        self.hedges = 0
+        self.hedge_wins = {"primary": 0, "hedge": 0}
+
+    # ------------------------------------------------------------ selection
+
+    def pick(self, exclude=frozenset(), coalesce_key=None):
+        """The admitting replica with the least load; deterministic
+        (index-ordered) tie-break and spillover. Raises FleetUnavailable
+        with none admitting. A replica already holding a QUEUED entry for
+        ``coalesce_key`` wins outright — least-loaded spreading would
+        otherwise steer identical concurrent requests onto different
+        replicas and defeat the batcher-level dedup exactly in the
+        thundering-herd case it exists for."""
+        chaos_fire("fleet.route")
+        candidates = [
+            r
+            for r in self._replicas_fn()
+            if r.index not in exclude and r.admits()
+        ]
+        if not candidates:
+            raise FleetUnavailable(
+                f"fleet {self.fleet_name!r}: no replica admits work"
+            )
+        if coalesce_key is not None:
+            for r in candidates:
+                if r.batcher.has_pending(coalesce_key):
+                    return r
+        return min(
+            candidates,
+            key=lambda r: (r.inflight + r.batcher.queue_fill(), r.index),
+        )
+
+    # ------------------------------------------------------------- dispatch
+
+    def submit(self, body, timeout: Optional[float] = None, coalesce_key=None):
+        """Route one request: pick → dispatch → (on mid-flight replica
+        failure) spill over with the remaining budget. DeadlineExceeded
+        feeds the owning replica's breaker and propagates (the budget is
+        spent); FleetUnavailable propagates (the caller's interpreter path
+        answers)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if self._gate is not None and not self._gate.is_set():
+            # promotion barrier: NO dispatch may land mid-swap — routing
+            # around a half-promoted fleet is exactly the mixed-generation
+            # serving the barrier forbids. Wait out the request's own
+            # budget (in 1s slices so a re-opened gate releases promptly);
+            # a barrier outliving the budget answers the bounded deadline
+            # error, never a mixed answer. Unbudgeted callers wait like
+            # any unbudgeted submit would.
+            while True:
+                rem = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if rem is not None and rem <= 0:
+                    raise DeadlineExceeded(
+                        "deadline exhausted waiting on the fleet "
+                        "promotion barrier"
+                    )
+                if self._gate.wait(1.0 if rem is None else min(1.0, rem)):
+                    break
+        excluded: set = set()
+        while True:
+            replica = self.pick(excluded, coalesce_key=coalesce_key)
+            remaining = (
+                None if deadline is None else deadline - time.monotonic()
+            )
+            if excluded and remaining is not None and remaining <= 0:
+                # the budget died WITH the failed replica: answering the
+                # expiry here keeps the healthy replica's breaker out of
+                # it — re-dispatching a spent request would feed failure
+                # streaks into replicas that did nothing wrong
+                raise DeadlineExceeded(
+                    f"deadline of {timeout:.3f}s exhausted during "
+                    "replica spillover"
+                )
+            self._record_routed(replica)
+            try:
+                return self._dispatch(replica, body, remaining, coalesce_key)
+            except DeadlineExceeded:
+                # the budget is spent — and a deadline expiry is a
+                # device-plane failure signal for THIS replica, exactly
+                # like the single-engine server's breaker-timeout hook
+                if replica.breaker is not None:
+                    replica.breaker.record_failure()
+                raise
+            except FleetUnavailable:
+                raise
+            except Exception:
+                # a mid-flight replica failure (dead worker, raising
+                # batcher): deterministic spillover to the next healthy
+                # replica; the failed one waits for its supervisor revive
+                log.warning(
+                    "fleet %s: replica %s failed mid-flight; spilling over",
+                    self.fleet_name,
+                    replica.name,
+                    exc_info=True,
+                )
+                excluded.add(replica.index)
+                self._record_spillover()
+
+    def _dispatch(self, replica, body, timeout, coalesce_key):
+        replica.begin_request()
+        try:
+            if self.hedge_delay_s > 0 and replica.lone():
+                return self._hedged(replica, body, timeout, coalesce_key)
+            return replica.batcher.submit(
+                body, timeout=timeout, coalesce_key=coalesce_key
+            )
+        finally:
+            replica.end_request()
+
+    # -------------------------------------------------------------- hedging
+
+    def _hedged(self, primary, body, timeout, coalesce_key):
+        """Tail-latency hedge for a lone request (module docstring)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def remaining():
+            return None if deadline is None else deadline - time.monotonic()
+
+        b1 = primary.batcher
+        e1 = b1.enqueue(body, coalesce_key=coalesce_key)
+        first = self.hedge_delay_s
+        rem = remaining()
+        if rem is not None:
+            first = min(first, max(rem, 0.0))
+        if b1.entry_wait(e1, first):
+            return b1.take_result(e1)
+        chaos_fire("fleet.hedge")
+        try:
+            secondary = self.pick(exclude={primary.index})
+        except FleetUnavailable:
+            secondary = None
+        if secondary is None:
+            # nowhere to hedge onto: fall back to the full-service wait
+            # with whatever budget is left
+            return b1.wait_entry(e1, timeout=remaining())
+        secondary.begin_request()
+        try:
+            try:
+                e2 = secondary.batcher.enqueue(body)
+            except Exception:  # noqa: BLE001 — the primary still answers
+                log.warning(
+                    "fleet %s: hedge enqueue on %s failed",
+                    self.fleet_name,
+                    secondary.name,
+                    exc_info=True,
+                )
+                return b1.wait_entry(e1, timeout=remaining())
+            self._record_hedge()
+            return self._first_answer(
+                [("primary", primary, e1), ("hedge", secondary, e2)],
+                remaining,
+            )
+        finally:
+            secondary.end_request()
+
+    def _first_answer(self, sides, remaining):
+        """Wait on N (replica, entry) sides; first clean completion wins
+        and cancels the rest. An errored or dead side is dropped (its
+        error only surfaces when every side failed); deadline expiry
+        cancels everything."""
+        last_error = None
+        while sides:
+            for label, rep, entry in sides:
+                if not rep.batcher.entry_done(entry):
+                    continue
+                if rep.batcher.entry_error(entry) is not None:
+                    # this side's batch failed; the other may still win
+                    sides.remove((label, rep, entry))
+                    try:
+                        rep.batcher.take_result(entry)
+                    except BaseException as e:  # noqa: BLE001 — kept for re-raise
+                        last_error = e
+                    break
+                for l2, r2, en2 in sides:
+                    if en2 is not entry:
+                        r2.batcher.cancel(en2)
+                self._record_hedge_win(label)
+                return rep.batcher.take_result(entry)
+            else:
+                rem = remaining()
+                if rem is not None and rem <= 0:
+                    for _l, r2, en2 in sides:
+                        r2.batcher.cancel(en2)
+                    raise DeadlineExceeded(
+                        "deadline exceeded waiting for hedged batch result"
+                    )
+                dead = [
+                    s
+                    for s in sides
+                    if not s[1].alive() and not s[1].batcher.entry_done(s[2])
+                ]
+                for s in dead:
+                    s[1].batcher.cancel(s[2])
+                    sides.remove(s)
+                if not sides:
+                    break
+                step = _HEDGE_POLL_S if rem is None else min(_HEDGE_POLL_S, rem)
+                sides[0][1].batcher.entry_wait(sides[0][2], step)
+        if last_error is not None:
+            raise last_error
+        raise RuntimeError("hedged dispatch: every replica died mid-flight")
+
+    # -------------------------------------------------------------- metrics
+
+    def _record_routed(self, replica) -> None:
+        with self._lock:
+            self.routed[replica.name] = self.routed.get(replica.name, 0) + 1
+        try:
+            from ..server.metrics import record_fleet_routed
+
+            record_fleet_routed(self.fleet_name, replica.name)
+        except Exception:  # noqa: BLE001 — metrics must never break routing
+            pass
+
+    def _record_spillover(self) -> None:
+        with self._lock:
+            self.spillovers += 1
+        try:
+            from ..server.metrics import record_fleet_spillover
+
+            record_fleet_spillover(self.fleet_name)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _record_hedge(self) -> None:
+        with self._lock:
+            self.hedges += 1
+        try:
+            from ..server.metrics import record_fleet_hedge
+
+            record_fleet_hedge(self.fleet_name)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _record_hedge_win(self, winner: str) -> None:
+        with self._lock:
+            self.hedge_wins[winner] = self.hedge_wins.get(winner, 0) + 1
+        try:
+            from ..server.metrics import record_fleet_hedge_win
+
+            record_fleet_hedge_win(self.fleet_name, winner)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "routed": dict(self.routed),
+                "spillovers": self.spillovers,
+                "hedges": self.hedges,
+                "hedge_wins": dict(self.hedge_wins),
+                "hedge_delay_ms": round(self.hedge_delay_s * 1e3, 3),
+            }
+
+
+__all__ = ["FleetRouter", "FleetUnavailable"]

@@ -54,6 +54,15 @@ class RolloutError(RuntimeError):
     candidate, diverged lineage)."""
 
 
+def _record_fleet_rollback() -> None:
+    try:
+        from ..server.metrics import record_fleet_promotion
+
+        record_fleet_promotion("rolled_back")
+    except Exception:  # noqa: BLE001 — metrics never gate the restore
+        pass
+
+
 def _clone_engine(name: str, template):
     """A fresh TPUPolicyEngine with the template's backend settings — the
     candidate must compile against the same device/mesh/kernel planes as
@@ -122,10 +131,22 @@ class RolloutController:
         engine_factory=None,
         duty_cycle: float = DEFAULT_DUTY_CYCLE,
         crd_candidate_provider=None,
+        authz_fleet=None,
     ):
         # live engines (None on interpreter-only deployments — staging and
         # shadowing still work through the interpreter; promotion needs
         # the engines and refuses without them)
+        #
+        # authz_fleet: an EngineFleet (cedar_tpu/fleet) replaces the single
+        # authorization engine at the SWAP points — it duck-types
+        # adopt_compiled/load_generation, so promotion becomes
+        # fleet-atomic (every replica swaps under the fleet's generation
+        # barrier or none do) and the lineage checks become per-replica.
+        # The candidate still compiles on ONE clone of the template
+        # engine; adoption into every replica is compile-free.
+        self.authz_fleet = authz_fleet
+        if authz_fleet is not None and authz_engine is None:
+            authz_engine = authz_fleet.template_engine
         self.authz_engine = authz_engine
         self.admission_engine = admission_engine
         self.sample_rate = sample_rate
@@ -415,7 +436,11 @@ class RolloutController:
                 )
             swaps = []
             for role, live, staged in (
-                ("authorization", self.authz_engine, cand.authz_engine),
+                (
+                    "authorization",
+                    self.authz_fleet or self.authz_engine,
+                    cand.authz_engine,
+                ),
                 ("admission", self.admission_engine, cand.admission_engine),
             ):
                 if live is None or staged is None:
@@ -424,13 +449,45 @@ class RolloutController:
                     raise RolloutError(f"promote: candidate {role} engine empty")
                 swaps.append((role, live, staged))
             rollback_points = {}
-            for role, live, staged in swaps:
-                # donor transplant covers the mesh engines' per-instance
-                # pjit-step caches (see adopt_compiled)
-                prior, generation = live.adopt_compiled(
-                    staged.compiled_set, donor=staged
+            done = []
+            failed_role = None
+            try:
+                for role, live, staged in swaps:
+                    failed_role = role
+                    # donor transplant covers the mesh engines'
+                    # per-instance pjit-step caches (see adopt_compiled);
+                    # a fleet swaps every replica under its generation
+                    # barrier here — or raises having restored them all
+                    prior, generation = live.adopt_compiled(
+                        staged.compiled_set, donor=staged
+                    )
+                    done.append((role, live, prior))
+                    rollback_points[role] = (live, prior, generation)
+            except Exception as e:
+                # cross-ROLE atomicity: an admission swap failing after
+                # the authorization swap landed must not leave the two
+                # roles on different policy sets — restore compile-free
+                # and refuse the promotion (the fleet's own barrier
+                # already restored its replicas before raising)
+                for _role, live, prior in reversed(done):
+                    try:
+                        live.adopt_compiled(prior)
+                        if hasattr(live, "replicas"):
+                            # a fleet that committed its barrier and was
+                            # then undone by a LATER role's failure must
+                            # audit as rolled back, or the promotions
+                            # counter shows a commit that never served
+                            _record_fleet_rollback()
+                    except Exception:  # noqa: BLE001 — keep restoring
+                        log.exception(
+                            "promote: restore of %s after a failed swap "
+                            "ALSO failed",
+                            _role,
+                        )
+                raise RolloutError(
+                    f"promote: {failed_role} swap failed; every engine "
+                    f"restored to the prior set: {e}"
                 )
-                rollback_points[role] = (live, prior, generation)
             self._rollback_points = rollback_points
             self._promoted = cand
             self._candidate = None
@@ -601,7 +658,7 @@ class RolloutController:
                 }
             engines = {}
             for role, live in (
-                ("authorization", self.authz_engine),
+                ("authorization", self.authz_fleet or self.authz_engine),
                 ("admission", self.admission_engine),
             ):
                 if live is not None:

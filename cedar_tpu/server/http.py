@@ -29,6 +29,7 @@ from typing import Optional
 
 from ..chaos.registry import chaos_fire
 from ..engine.batcher import DeadlineExceeded
+from ..fleet.router import FleetUnavailable
 from ..entities.admission import AdmissionRequest
 from ..entities.attributes import (
     Attributes,
@@ -180,6 +181,17 @@ def sar_response(
     return resp
 
 
+def _engine_doc(engine) -> dict:
+    """One engine's /debug/engine entry (shared by the single-engine and
+    per-replica renderings)."""
+    return {
+        "name": engine.name,
+        "warm_ready": engine.warm_ready(),
+        "load_generation": engine.load_generation,
+        **engine.stats,
+    }
+
+
 class WebhookServer:
     """Owns the TLS webhook server and the plain health/metrics server."""
 
@@ -197,6 +209,7 @@ class WebhookServer:
         keyfile: Optional[str] = None,
         fastpath=None,
         admission_fastpath=None,
+        fleet=None,
         batch_window_s: float = 0.0002,
         max_batch: int = 8192,
         request_timeout_s: Optional[float] = None,
@@ -243,12 +256,20 @@ class WebhookServer:
                 metrics_path=path,
             )
 
+        # engine fleet (cedar_tpu/fleet, docs/fleet.md): when wired, the
+        # authorization miss path routes through the fleet's health-aware
+        # router between this layer and the replicas' batchers — the
+        # single-engine batcher below is NOT built (each replica owns its
+        # own). The fleet raising FleetUnavailable (no replica admits)
+        # degrades to the interpreter path in the request thread, exactly
+        # like the single-engine breaker-open bypass.
+        self.fleet = fleet
         # native SAR fast path (engine/fastpath.py): request threads funnel
         # raw bodies through a micro-batcher into the C++ encoder + device
         # matcher; unavailable configurations fall back per request
         self.fastpath = fastpath
         self._batcher = None
-        if fastpath is not None:
+        if fastpath is not None and fleet is None:
             self._batcher = _eval_batcher(
                 fastpath, fastpath.authorize_raw, "authorization"
             )
@@ -353,7 +374,10 @@ class WebhookServer:
 
     def warm_ready(self) -> bool:
         """Readiness beyond store load: every wired engine's first serving
-        shape must be compiled (TPUPolicyEngine.warm_ready)."""
+        shape must be compiled (TPUPolicyEngine.warm_ready) — every fleet
+        replica's, when a fleet is wired (adopted sets latch instantly)."""
+        if self.fleet is not None and not self.fleet.warm_ready():
+            return False
         for fp in (self.fastpath, self.admission_fastpath):
             engine = getattr(fp, "engine", None)
             if engine is not None and not engine.warm_ready():
@@ -486,8 +510,30 @@ class WebhookServer:
         coalesce_key: Optional[str] = None,
     ):
         """(decision, reason, error) through the engines — the pre-cache
-        serving path: native fast path behind the breaker, then the python
-        interpreter path."""
+        serving path: the fleet router (when wired) or the native fast
+        path behind the breaker, then the python interpreter path."""
+        if self.fleet is not None:
+            try:
+                return self.fleet.submit(
+                    body,
+                    timeout=self.request_timeout_s,
+                    coalesce_key=coalesce_key,
+                )
+            except DeadlineExceeded as e:
+                # the router already fed the owning replica's breaker
+                metrics.record_deadline_exceeded("authorization")
+                return DECISION_NO_OPINION, "", f"evaluation error: {e}"
+            except FleetUnavailable:
+                # no replica admits (every breaker open / every worker
+                # down): the interpreter path below answers in the request
+                # thread — bounded degradation, the fleet twin of the
+                # single-engine breaker-open bypass
+                pass
+            except Exception as e:  # noqa: BLE001 — always answer
+                log.exception(
+                    "fleet authorize requestId=%s failed", request_id
+                )
+                return DECISION_NO_OPINION, "", f"evaluation error: {e}"
         try:
             use_fastpath = (
                 self._batcher is not None
@@ -817,6 +863,14 @@ class WebhookServer:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                 elif self.path == "/metrics":
+                    if server.fleet is not None:
+                        try:
+                            # scrape-time refresh: the replica-state gauge
+                            # must reflect a dead/open/rebuilding replica
+                            # NOW, not its last lifecycle transition
+                            server.fleet.publish_states()
+                        except Exception:  # noqa: BLE001 — scrape must serve
+                            log.exception("fleet state publish failed")
                     data = metrics.REGISTRY.expose().encode()
                     self.send_response(200)
                     self.send_header(
@@ -848,10 +902,25 @@ class WebhookServer:
                     # per-path engine + batcher pipeline snapshot: mode
                     # (serial/pipelined), pipeline depth, encode workers,
                     # live queue fills, per-stage stall totals, and the
-                    # engine's warm/compile state (docs/performance.md);
-                    # {} with no fast path wired
+                    # engine's warm/compile state (docs/performance.md).
+                    # With a fleet wired, the authorization entry
+                    # enumerates every replica (health + breaker + warm
+                    # state + queue fills, docs/fleet.md); {} with no fast
+                    # path wired
                     doc = {}
                     try:
+                        if server.fleet is not None:
+                            doc["authorization"] = {
+                                "fleet": server.fleet.name,
+                                "replicas": {
+                                    r.name: {
+                                        "pipeline": r.batcher.debug_stats(),
+                                        "engine": _engine_doc(r.engine),
+                                        "health": r.health(),
+                                    }
+                                    for r in server.fleet.replicas
+                                },
+                            }
                         for name, fp, batcher in (
                             (
                                 "authorization",
@@ -869,16 +938,25 @@ class WebhookServer:
                             entry = {"pipeline": batcher.debug_stats()}
                             engine = getattr(fp, "engine", None)
                             if engine is not None:
-                                entry["engine"] = {
-                                    "name": engine.name,
-                                    "warm_ready": engine.warm_ready(),
-                                    "load_generation": engine.load_generation,
-                                    **engine.stats,
-                                }
+                                entry["engine"] = _engine_doc(engine)
                             doc[name] = entry
                     except Exception:  # noqa: BLE001 — debug must not 500
                         log.exception("engine stats failed")
                         doc = {"error": "engine stats failed"}
+                    self._send_json(doc)
+                elif self.path == "/debug/fleet":
+                    # replicated-engine fleet snapshot (docs/fleet.md):
+                    # per-replica health/lifecycle, the fleet epoch, and
+                    # router counters (routed / spillovers / hedges);
+                    # 404 without a fleet
+                    if server.fleet is None:
+                        self.send_error(404)
+                        return
+                    try:
+                        doc = server.fleet.status()
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("fleet status failed")
+                        doc = {"error": "fleet status failed"}
                     self._send_json(doc)
                 elif self.path == "/debug/rollout":
                     # shadow-rollout state + decision-diff report
@@ -1092,8 +1170,11 @@ class WebhookServer:
     def _prebuild_snapshots(self) -> None:
         """Touch the fast paths after a promote/rollback swap so their
         native-encoder snapshots rebuild NOW (a host-side C++ table build)
-        instead of on the first live request."""
-        for fp in (self.fastpath, self.admission_fastpath):
+        instead of on the first live request — every fleet replica's too."""
+        paths = [self.fastpath, self.admission_fastpath]
+        if self.fleet is not None:
+            paths.extend(r.fastpath for r in self.fleet.replicas)
+        for fp in paths:
             try:
                 if fp is not None:
                     fp.available  # noqa: B018 — property triggers the rebuild
@@ -1182,6 +1263,11 @@ class WebhookServer:
         ):
             if batcher is not None:
                 batcher.stop()
+        if self.fleet is not None:
+            try:
+                self.fleet.stop()  # replica batchers drain like the above
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception("fleet stop failed")
         if self.rollout is not None:
             try:
                 self.rollout.stop()  # shadow worker; best-effort by design

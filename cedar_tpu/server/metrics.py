@@ -438,11 +438,12 @@ worker_deaths_total = REGISTRY.register(
         "cedar_worker_deaths_total",
         "Long-lived worker threads that exited on an uncaught exception, "
         "partitioned by component (batcher stages, shadow worker, CRD "
-        "watch, store reload ticker). Any nonzero rate is a bug or an "
-        "injected fault; without supervision a dead worker leaves its "
-        "bounded queue filling forever, so alert on this even before the "
-        "supervisor restarts it.",
-        ["component"],
+        "watch, store reload ticker) and replica (the fleet member the "
+        "worker served; empty on the single-engine path). Any nonzero "
+        "rate is a bug or an injected fault; without supervision a dead "
+        "worker leaves its bounded queue filling forever, so alert on "
+        "this even before the supervisor restarts it.",
+        ["component", "replica"],
     )
 )
 
@@ -450,11 +451,11 @@ supervisor_restarts_total = REGISTRY.register(
     Counter(
         "cedar_supervisor_restarts_total",
         "Component restarts performed by the supervisor watchdog, "
-        "partitioned by component. Dead threads and wedged (stale busy "
-        "heartbeat) stages both count; queued work held by the restarted "
-        "stage is shed with per-request error answers rather than "
-        "stranded.",
-        ["component"],
+        "partitioned by component and replica (empty on the single-engine "
+        "path). Dead threads and wedged (stale busy heartbeat) stages "
+        "both count; queued work held by the restarted stage is shed "
+        "with per-request error answers rather than stranded.",
+        ["component", "replica"],
     )
 )
 
@@ -479,6 +480,78 @@ quarantined_objects = REGISTRY.register(
         [],
     )
 )
+
+# Engine-fleet metrics (cedar_tpu/fleet, docs/fleet.md): the replicated
+# serving tier. Outside the cedar_authorizer_* request subsystem — these
+# describe replica routing and fleet lifecycle, not individual requests.
+fleet_replica_state = REGISTRY.register(
+    Gauge(
+        "cedar_fleet_replica_state",
+        "Per-replica serving state: 0 active (in the routing set), "
+        "1 degraded (breaker open or fastpath unavailable; routed around), "
+        "2 rebuilding (device recovery re-placing the compiled set), "
+        "3 draining (operator drain; no new work), 4 dead/retired "
+        "(worker threads down pending supervisor revive, or retired).",
+        ["fleet", "replica"],
+    )
+)
+
+fleet_routed_total = REGISTRY.register(
+    Counter(
+        "cedar_fleet_routed_total",
+        "Requests dispatched to each fleet replica by the health-aware "
+        "router. A sustained skew under even load means the other "
+        "replicas are being scored unhealthy (see "
+        "cedar_fleet_replica_state).",
+        ["fleet", "replica"],
+    )
+)
+
+fleet_spillover_total = REGISTRY.register(
+    Counter(
+        "cedar_fleet_spillover_total",
+        "Requests re-routed to another replica after their first replica "
+        "failed mid-flight (dead worker, raising batcher). Deterministic "
+        "spillover preserves availability; a nonzero rate names a sick "
+        "replica, not lost requests.",
+        ["fleet"],
+    )
+)
+
+fleet_hedges_total = REGISTRY.register(
+    Counter(
+        "cedar_fleet_hedges_total",
+        "Lone requests that fired a tail-latency hedge: the primary "
+        "replica had not answered within the hedge delay, so a duplicate "
+        "was dispatched to a second healthy replica (first answer wins, "
+        "the loser is cancelled).",
+        ["fleet"],
+    )
+)
+
+fleet_hedge_wins_total = REGISTRY.register(
+    Counter(
+        "cedar_fleet_hedge_wins_total",
+        "Hedged requests partitioned by which dispatch answered first "
+        "(primary / hedge). A high hedge share means the hedge delay is "
+        "below the primary's healthy tail — or a replica is quietly "
+        "slow.",
+        ["fleet", "winner"],
+    )
+)
+
+fleet_promotions_total = REGISTRY.register(
+    Counter(
+        "cedar_fleet_promotions_total",
+        "Fleet-atomic compiled-set swaps partitioned by result: "
+        "committed (every replica adopted the candidate under the "
+        "generation barrier) or rolled_back (a replica swap failed and "
+        "every already-swapped replica was restored to the prior set — "
+        "no mixed-generation serving).",
+        ["result"],
+    )
+)
+
 
 chaos_injections_total = REGISTRY.register(
     Counter(
@@ -593,12 +666,36 @@ def record_analysis_findings(kind: str, n: int) -> None:
         policy_analysis_findings_total.inc(n, kind=kind)
 
 
-def record_worker_death(component: str) -> None:
-    worker_deaths_total.inc(component=component)
+def record_worker_death(component: str, replica: str = "") -> None:
+    worker_deaths_total.inc(component=component, replica=replica)
 
 
-def record_supervisor_restart(component: str) -> None:
-    supervisor_restarts_total.inc(component=component)
+def record_supervisor_restart(component: str, replica: str = "") -> None:
+    supervisor_restarts_total.inc(component=component, replica=replica)
+
+
+def set_fleet_replica_state(fleet: str, replica: str, code: int) -> None:
+    fleet_replica_state.set(code, fleet=fleet, replica=replica)
+
+
+def record_fleet_routed(fleet: str, replica: str) -> None:
+    fleet_routed_total.inc(fleet=fleet, replica=replica)
+
+
+def record_fleet_spillover(fleet: str) -> None:
+    fleet_spillover_total.inc(fleet=fleet)
+
+
+def record_fleet_hedge(fleet: str) -> None:
+    fleet_hedges_total.inc(fleet=fleet)
+
+
+def record_fleet_hedge_win(fleet: str, winner: str) -> None:
+    fleet_hedge_wins_total.inc(fleet=fleet, winner=winner)
+
+
+def record_fleet_promotion(result: str) -> None:
+    fleet_promotions_total.inc(result=result)
 
 
 def record_device_rebuild() -> None:

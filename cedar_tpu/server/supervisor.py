@@ -104,12 +104,20 @@ class HeartbeatGroup:
 
 class _Component:
     __slots__ = (
-        "name", "threads", "restart", "heartbeat", "wedge_budget_s",
-        "cooldown_until", "restarts", "failures", "last_event",
+        "name", "replica", "threads", "restart", "heartbeat",
+        "wedge_budget_s", "cooldown_until", "restarts", "failures",
+        "last_event",
     )
 
-    def __init__(self, name, threads, restart, heartbeat, wedge_budget_s):
+    def __init__(
+        self, name, threads, restart, heartbeat, wedge_budget_s, replica=""
+    ):
         self.name = name
+        # fleet-member identity: components are keyed {component, replica}
+        # so one replica's death/restart is attributable instead of
+        # vanishing into a shared component namespace; "" on the
+        # single-engine path keeps existing keys/metrics stable
+        self.replica = replica
         self.threads = threads  # () -> List[threading.Thread]
         self.restart = restart  # (reason: str) -> bool
         self.heartbeat = heartbeat
@@ -118,6 +126,10 @@ class _Component:
         self.restarts = 0
         self.failures = 0
         self.last_event: Optional[dict] = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.name}/{self.replica}" if self.replica else self.name
 
 
 class Supervisor:
@@ -152,15 +164,19 @@ class Supervisor:
         restart: Callable[[str], bool],
         heartbeat: Optional[Heartbeat] = None,
         wedge_budget_s: Optional[float] = None,
+        replica: str = "",
     ) -> None:
         """Put one component under supervision. ``threads`` returns the
         worker threads that must all be alive; ``restart(reason)`` revives
         the component (returning False when nothing needed doing);
-        ``heartbeat`` enables wedge detection on top of liveness."""
+        ``heartbeat`` enables wedge detection on top of liveness;
+        ``replica`` names the fleet member this component serves (status
+        keys and restart metrics carry it — empty on the single-engine
+        path)."""
         budget = (
             self.wedge_budget_s if wedge_budget_s is None else wedge_budget_s
         )
-        comp = _Component(name, threads, restart, heartbeat, budget)
+        comp = _Component(name, threads, restart, heartbeat, budget, replica)
         with self._lock:
             self._components.append(comp)
 
@@ -205,15 +221,17 @@ class Supervisor:
             if reason is None:
                 continue
             event = {"component": comp.name, "reason": reason, "ok": False}
-            log.warning("supervisor: restarting %s (%s)", comp.name, reason)
+            if comp.replica:
+                event["replica"] = comp.replica
+            log.warning("supervisor: restarting %s (%s)", comp.key, reason)
             try:
                 event["ok"] = bool(comp.restart(reason))
             except Exception:  # noqa: BLE001 — count, retry next tick
-                log.exception("supervisor: restart of %s failed", comp.name)
+                log.exception("supervisor: restart of %s failed", comp.key)
                 comp.failures += 1
             if event["ok"]:
                 comp.restarts += 1
-                _record_restart(comp.name)
+                _record_restart(comp.name, comp.replica)
             # cooldown either way: fresh threads need a tick to come up,
             # and a persistently failing restart must not spin the loop
             comp.cooldown_until = now + max(1.0, 2 * self.interval_s)
@@ -263,6 +281,8 @@ class Supervisor:
                 "restart_failures": comp.failures,
                 "last_event": comp.last_event,
             }
+            if comp.replica:
+                entry["replica"] = comp.replica
             try:
                 threads = comp.threads() or []
                 entry["threads_alive"] = sum(
@@ -274,17 +294,17 @@ class Supervisor:
             if comp.heartbeat is not None:
                 age, busy = comp.heartbeat.snapshot()
                 entry["heartbeat"] = {"age_s": round(age, 3), "busy": busy}
-            out["components"][comp.name] = entry
+            out["components"][comp.key] = entry
         for rec in recoveries:
             out["device_recovery"][rec.name] = rec.status()
         return out
 
 
-def _record_restart(component: str) -> None:
+def _record_restart(component: str, replica: str = "") -> None:
     try:
         from .metrics import record_supervisor_restart
 
-        record_supervisor_restart(component)
+        record_supervisor_restart(component, replica)
     except Exception:  # noqa: BLE001 — metrics must never break recovery
         log.debug("supervisor restart metric publish failed", exc_info=True)
 
@@ -357,6 +377,13 @@ class DeviceRecovery:
         self.failures = 0
         self.last_error: Optional[str] = None
         self.last_traces: Optional[int] = None
+
+    @property
+    def rebuilding(self) -> bool:
+        """True while a rebuild is in flight — the fleet router excludes a
+        rebuilding replica from the serving set so the re-place/warm work
+        happens fully off-path (docs/fleet.md)."""
+        return self._rebuilding
 
     def observe(self, exc: BaseException) -> bool:
         """Classify one evaluator exception; True when it was treated as a

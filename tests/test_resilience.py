@@ -1107,7 +1107,7 @@ class TestHeartbeatAndSupervisor:
         st = sup.status()
         assert st["components"]["c"]["restarts"] == 2
         assert (
-            'cedar_supervisor_restarts_total{component="c"}'
+            'cedar_supervisor_restarts_total{component="c",replica=""}'
             in metrics.REGISTRY.expose()
         )
 
@@ -1204,6 +1204,61 @@ class TestDeviceRecovery:
         assert rec.failures == 1
         assert breaker.state == OPEN
 
+    def test_concurrent_fatals_coalesce_into_one_rebuild(self):
+        """Two fatal errors racing into the cooldown window must coalesce
+        into exactly ONE rebuild and ONE half-open re-arm: the burst a
+        dead device produces (every in-flight batch fails at once) must
+        not stack rebuilds or re-arm a breaker another fatal just
+        re-opened."""
+
+        class _CountingBreaker(CircuitBreaker):
+            def __init__(self):
+                super().__init__(
+                    name="rec-race", failure_threshold=100,
+                    recovery_s=3600.0,
+                )
+                self.half_open_calls = 0
+
+            def half_open_now(self):
+                self.half_open_calls += 1
+                super().half_open_now()
+
+        engine = _StubEngine()
+        breaker = _CountingBreaker()
+        rec = DeviceRecovery(
+            engine, breaker=breaker, warm=False, cooldown_s=60.0
+        )
+        barrier = threading.Barrier(8)
+        observed = []
+
+        def fatal(i):
+            barrier.wait()
+            observed.append(
+                rec.observe(RuntimeError(f"UNAVAILABLE: burst {i}"))
+            )
+
+        threads = [
+            threading.Thread(target=fatal, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        deadline = time.monotonic() + 5.0
+        while rec._rebuilding and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # every racer was TREATED as a device loss (a rebuild running or
+        # just kicked), but only one rebuild and one re-arm happened
+        assert observed == [True] * 8
+        assert engine.rebuilt == 1 and rec.rebuilds == 1
+        assert breaker.half_open_calls == 1
+        assert breaker.state == HALF_OPEN
+        # a fatal arriving AFTER the rebuild but inside the cooldown still
+        # coalesces: no second rebuild, no second re-arm
+        assert rec.observe(RuntimeError("UNAVAILABLE: straggler")) is True
+        time.sleep(0.05)
+        assert engine.rebuilt == 1 and breaker.half_open_calls == 1
+
     def test_breaker_force_open_and_half_open_now(self):
         clock = FakeClock()
         breaker = CircuitBreaker(
@@ -1238,7 +1293,7 @@ class TestWorkerDeathVisibility:
             while mb._threads[0].is_alive() and time.monotonic() < deadline:
                 time.sleep(0.01)
             assert (
-                'cedar_worker_deaths_total{component="batcher.worker"}'
+                'cedar_worker_deaths_total{component="batcher.worker",replica=""}'
                 in metrics.REGISTRY.expose()
             )
             assert mb.revive() is True
@@ -1315,7 +1370,7 @@ class TestSupervisedPipelineEndToEnd:
             # the revived pipeline serves
             assert pb.submit("c", timeout=2.0) == ("c", "ok")
             assert (
-                'cedar_worker_deaths_total{component="pipeline.decode"}'
+                'cedar_worker_deaths_total{component="pipeline.decode",replica=""}'
                 in metrics.REGISTRY.expose()
             )
         finally:
@@ -1468,6 +1523,80 @@ def _policy_object(name, uid, content):
     return PolicyObject.from_dict(
         {"metadata": {"name": name, "uid": uid}, "spec": {"content": content}}
     )
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestDeviceRecoveryCooldownRace:
+    def test_concurrent_injected_fatals_coalesce_one_rebuild(self):
+        """ISSUE 7 satellite: a burst of fatal device errors racing in
+        through the real ``engine.dispatch`` seam (concurrent batches, one
+        armed device-loss scenario) must coalesce into exactly ONE rebuild
+        and ONE half-open re-arm, with every batch still answered
+        correctly from the interpreter fallback."""
+        from cedar_tpu.engine.evaluator import TPUPolicyEngine
+        from cedar_tpu.engine.fastpath import SARFastPath
+        from cedar_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("no C++ toolchain for the native encoder")
+
+        stores = TieredPolicyStores(
+            [MemoryStore.from_source("d", DEMO_POLICY)]
+        )
+        authorizer = CedarWebhookAuthorizer(stores)
+        engine = TPUPolicyEngine(name="race-test")
+        engine.load([s.policy_set() for s in stores], warm="off")
+        breaker = CircuitBreaker(
+            name="race-test", failure_threshold=100, recovery_s=3600.0
+        )
+        half_open_calls = []
+        orig_half_open = breaker.half_open_now
+        breaker.half_open_now = (  # count re-arms without a subclass
+            lambda: (half_open_calls.append(1), orig_half_open())[1]
+        )
+        recovery = DeviceRecovery(
+            engine, breaker=breaker, name="race-test", warm=False,
+            cooldown_s=60.0,
+        )
+        fast = SARFastPath(engine, authorizer, breaker=breaker)
+        fast.on_device_error = recovery.observe
+        body = json.dumps(make_sar()).encode()
+        assert fast.authorize_raw([body])[0][0] == DECISION_ALLOW
+
+        r = default_registry()
+        r.configure(
+            {"faults": [{"seam": "engine.dispatch", "kind": "error",
+                         "count": 16,
+                         "message": "UNAVAILABLE: device lost (race)"}]}
+        )
+        r.arm()
+        barrier = threading.Barrier(4)
+        answers = []
+
+        def one_batch():
+            barrier.wait()
+            answers.append(fast.authorize_raw([body] * 4))
+
+        threads = [threading.Thread(target=one_batch) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        r.disarm()
+        deadline = time.monotonic() + 5.0
+        while (
+            recovery._rebuilding or recovery.rebuilds == 0
+        ) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        # every racing batch answered correctly (interpreter fallback)...
+        assert len(answers) == 4
+        for batch in answers:
+            assert all(res[0] == DECISION_ALLOW for res in batch)
+        # ...and the fatal burst coalesced: one rebuild, one re-arm
+        assert recovery.rebuilds == 1
+        assert len(half_open_calls) == 1
+        r.reset()
 
 
 @pytest.mark.chaos
