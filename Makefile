@@ -62,6 +62,10 @@ bench-shadow: ## Shadow-rollout overhead: live p50/p99 + saturated throughput at
 bench-chaos: ## Game-day suite incl. replica-loss: availability/correctness/recovery SLOs under scripted faults + chaos-disabled differential (cpu; docs/resilience.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --chaos
 
+.PHONY: bench-encode
+bench-encode: ## Host-side budget: native encode µs/req at 1/2/4 threads, packed-vs-per-chunk decode, pallas/lax parity, 3.5µs encode regression gate (cpu; docs/performance.md)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --encode
+
 .PHONY: bench-fleet
 bench-fleet: ## Engine-fleet scaling: decisions/sec + lone p99 at 1/2/4 replicas, scaling-efficiency JSON (cpu; docs/fleet.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --fleet
@@ -84,7 +88,7 @@ graft-check: ## Compile-check the jittable entry + multi-chip dry run
 
 # scoped to the layers with the strongest invariants first; widen as
 # modules are annotated
-LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos cedar_tpu/fleet
+LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos cedar_tpu/fleet cedar_tpu/engine cedar_tpu/ops cedar_tpu/native
 
 .PHONY: lint
 lint: ## ruff + mypy over $(LINT_SCOPE) (missing tools are skipped with a note)

@@ -177,6 +177,27 @@ def build_server(args) -> WebhookServer:
                 "(CEDAR_TPU_SEGRED=0 restores the scan plane)"
             )
 
+    # native encoder worker-pool width: --native-encode-threads overrides
+    # CEDAR_NATIVE_THREADS through the module reset hook, so a flag always
+    # wins over a previously-cached (possibly malformed) env resolution
+    if getattr(args, "native_encode_threads", 0) > 0:
+        from ..native import set_encode_threads
+
+        set_encode_threads(args.native_encode_threads)
+    try:
+        from ..native import _default_encode_threads
+        from ..server.metrics import set_native_encode_threads
+
+        set_native_encode_threads(_default_encode_threads())
+    except Exception:  # noqa: BLE001 — metrics must never block startup
+        pass
+
+    # fused pallas serving kernel: auto (None) = the engine's own
+    # backend-aware default (on for TPU-class backends, off on CPU)
+    use_pallas = {"auto": None, "on": True, "off": False}[
+        getattr(args, "pallas", "auto")
+    ]
+
     config = None
     if args.config:
         with open(args.config) as f:
@@ -248,7 +269,7 @@ def build_server(args) -> WebhookServer:
         # production batch can land on, so no request ever pays a trace
         tier_engine = TPUPolicyEngine(
             mesh=mesh, segred=segred, name=name,
-            warm_max_batch=args.max_batch,
+            warm_max_batch=args.max_batch, use_pallas=use_pallas,
         )
         recovery = None
         if args.supervisor_interval_seconds > 0:
@@ -364,7 +385,7 @@ def build_server(args) -> WebhookServer:
             r_breaker = _make_breaker(f"authorization-r{i}")
             r_engine = TPUPolicyEngine(
                 mesh=mesh, segred=segred, name=f"authorization-r{i}",
-                warm_max_batch=args.max_batch,
+                warm_max_batch=args.max_batch, use_pallas=use_pallas,
             )
             r_recovery = None
             if args.supervisor_interval_seconds > 0:
@@ -819,9 +840,29 @@ def make_parser() -> argparse.ArgumentParser:
     cedar.add_argument(
         "--encode-workers",
         type=int,
-        default=2,
-        help="host encode threads feeding the pipelined batcher "
-        "(only used with --pipeline-depth > 0)",
+        default=0,
+        help="host encode threads feeding the pipelined batcher (only "
+        "used with --pipeline-depth > 0); 0 auto-sizes from the native "
+        "encoder pool width — each worker's chunk encode already fans "
+        "across the persistent C++ worker pool (docs/performance.md)",
+    )
+    cedar.add_argument(
+        "--native-encode-threads",
+        type=int,
+        default=0,
+        help="native (C++) encoder worker-pool width per batch, "
+        "overriding CEDAR_NATIVE_THREADS; 0 = env var, else cpu count "
+        "(capped at 16). The bench projects near-linear encode scaling "
+        "to ~16 cores (docs/performance.md, Host-side budget)",
+    )
+    cedar.add_argument(
+        "--pallas",
+        default="auto",
+        choices=["auto", "on", "off"],
+        help="fused pallas serving kernel (slot-match + clause-reduce + "
+        "tier walk in one device launch): auto enables it on TPU-class "
+        "backends with byte-identical lax fallback for unsupported "
+        "shapes; off pins the XLA planes (docs/performance.md)",
     )
 
     fleet = parser.add_argument_group("engine fleet")

@@ -525,6 +525,16 @@ class PipelinedBatcher(MicroBatcher):
 
         self.stages = stages
         self.depth = max(1, int(depth))
+        if encode_workers <= 0:
+            # auto-size (--encode-workers 0): each encode worker drives a
+            # whole chunk's C++ encode, which itself fans across the
+            # persistent native worker pool (native/encoder.cpp
+            # EncodePool) sized by CEDAR_NATIVE_THREADS / cores — a few
+            # python-level workers keep the dispatch stage fed without
+            # oversubscribing that pool
+            from ..native import _default_encode_threads
+
+            encode_workers = max(2, min(4, _default_encode_threads() // 4))
         self.encode_workers = max(1, int(encode_workers))
         self._pool = ThreadPoolExecutor(
             self.encode_workers, thread_name_prefix="pipe-encode"

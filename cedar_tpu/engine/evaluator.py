@@ -177,6 +177,80 @@ class _StagingPool:
                     bufs.append(a)
 
 
+class _WordPacker:
+    """Batch-wide packed D2H transfer for verdict words.
+
+    The raw fast paths launch a batch as several overlapped chunks, and
+    each chunk's finish() used to materialize its own [B] uint32 word
+    array — one device round trip per chunk, so a 65k-row batch paid 4-6
+    serial readbacks on the high-RTT serving link. The packer instead
+    collects every chunk's DEVICE word array; flush() concatenates them
+    into one packed output buffer on device (a trivial [n] u32 copy
+    kernel) and starts a single async D2H for the whole batch; view()
+    hands each chunk its rows as a zero-copy numpy view of the one host
+    buffer, which the decode stage (and _decode_word_payload's word-cache
+    lookups) consume directly.
+
+    Single-chunk batches skip the concat — flush() just starts the same
+    async copy the unpacked path would have, so a lone request's p99 is
+    byte-for-byte the old path. Not used for want_full/want_bits launches
+    (their payloads dominate the transfer) or mesh engines (concatenating
+    sharded outputs would force a reshard)."""
+
+    def __init__(self):
+        self._parts: list = []  # device word arrays, padded lengths
+        self._offsets: list = []
+        self._packed = None  # device array after flush
+        self._host: Optional[np.ndarray] = None
+        self._flushed = False
+
+    @property
+    def parts(self) -> int:
+        """Chunk word arrays registered so far (metrics)."""
+        return len(self._parts)
+
+    def add(self, words_dev) -> int:
+        """Register one chunk's device word array; returns its part id."""
+        if self._flushed:
+            raise RuntimeError("_WordPacker: add() after flush()")
+        self._offsets.append(
+            self._offsets[-1] + self._parts[-1].shape[0]
+            if self._parts
+            else 0
+        )
+        self._parts.append(words_dev)
+        return len(self._parts) - 1
+
+    def flush(self) -> None:
+        """Pack every registered part into one device buffer and start
+        the single async D2H copy. Idempotent."""
+        if self._flushed:
+            return
+        self._flushed = True
+        if not self._parts:
+            return
+        if len(self._parts) == 1:
+            self._packed = self._parts[0]
+        else:
+            import jax.numpy as jnp
+
+            self._packed = jnp.concatenate(self._parts)
+        try:
+            self._packed.copy_to_host_async()
+        except AttributeError:  # non-jax array (tests)
+            pass
+
+    def view(self, part: int, m: int) -> np.ndarray:
+        """Rows [0, m) of `part` as a view of the packed host buffer
+        (materialized once for the whole batch). Flushes defensively if
+        the caller never did."""
+        self.flush()
+        if self._host is None:
+            self._host = np.asarray(self._packed)
+        lo = self._offsets[part]
+        return self._host[lo : lo + m]
+
+
 def _segment_plan(group_c: np.ndarray, n_rules: int):
     """Static per-chunk (group, start, end) column segments for the
     segmented-reduction kernel plane (ops/match.py _first_match_seg).
@@ -451,13 +525,23 @@ class TPUPolicyEngine:
         self.mesh = mesh
         self.name = name
         self.warm_max_batch = warm_max_batch
-        if use_pallas is None:
-            use_pallas = os.environ.get("CEDAR_TPU_PALLAS", "0") == "1"
         # interpret mode lets the pallas path run (and be tested) on CPU;
         # other non-TPU backends (e.g. GPU) can't lower the Mosaic kernel —
         # keep the XLA path there
         backend = jax.default_backend()
         self._pallas_interpret = backend == "cpu"
+        if use_pallas is None:
+            env = os.environ.get("CEDAR_TPU_PALLAS", "auto")
+            if env == "auto":
+                # hot-path default: TPU-class backends get the fused
+                # slot-match + clause-reduce + tier-walk kernel (one
+                # launch per batch, word-only HBM output), falling back
+                # byte-identically to the lax plane wherever
+                # pallas_supported() rules a shape out. CPU keeps the XLA
+                # plane — interpret mode is a test vehicle, not a server.
+                use_pallas = backend in ("tpu", "axon")
+            else:
+                use_pallas = env == "1"
         if use_pallas and backend not in ("cpu", "tpu", "axon"):
             use_pallas = False
         if mesh is not None:
@@ -1018,6 +1102,8 @@ class TPUPolicyEngine:
         want_full: bool = False,
         cs: Optional["_CompiledSet"] = None,
         want_bits: bool = False,
+        word_pack: Optional["_WordPacker"] = None,
+        valid_rows: Optional[int] = None,
     ):
         """Device-match pre-encoded feature codes (e.g. from the native
         encoder): codes [n, S], extras [n, E] (padded with >= L). Dispatches
@@ -1037,7 +1123,21 @@ class TPUPolicyEngine:
 
         `cs` pins the compiled set the codes were encoded against — callers
         that encoded against a snapshot MUST pass it, or a concurrent policy
-        hot swap would gather the codes through the new set's tables."""
+        hot swap would gather the codes through the new set's tables.
+
+        `word_pack` (a _WordPacker) opts this launch's verdict words into
+        the batch-wide packed D2H transfer: the device arrays register
+        with the packer instead of starting their own readback, the caller
+        flushes once after EVERY chunk of the batch has launched, and
+        finish() consumes its rows as views of the one packed host buffer.
+        Ignored (normal per-launch readback) for want_full/want_bits
+        launches and mesh engines.
+
+        `valid_rows` marks trailing rows as caller-side bucket padding
+        (the fast paths' staged buffers arrive pre-padded so no copy
+        happens here): the want_bits compaction excludes them, exactly as
+        it excludes this function's own padding. Verdict words are still
+        returned for every row; callers slice."""
         cs = cs or self._compiled
         if cs is None:
             raise RuntimeError("TPUPolicyEngine: no policy set loaded")
@@ -1055,9 +1155,10 @@ class TPUPolicyEngine:
 
         held: list = []  # pooled staging buffers, released by finish()
 
-        def one(chunk_c, chunk_e):
-            """-> (words_dev, full_dev_or_None, pack_dev_or_None)"""
-            m = chunk_c.shape[0]
+        def one(chunk_c, chunk_e, m):
+            """-> (words_dev, full_dev_or_None, pack_dev_or_None); m is the
+            VALID row count (excludes caller-side staging padding), used
+            only to mask the want_bits compaction."""
             if cs.mesh is not None:
                 # multi-chip: the pjit step (parallel/mesh.py) shards the
                 # batch over `data` and the rule matmul over `policy`; the
@@ -1081,7 +1182,12 @@ class TPUPolicyEngine:
                 chunk_c, chunk_e, packed.L, held=held
             )
             B = chunk_c.shape[0]
-            if cs.pallas_args is not None:
+            # want_bits launches stay on the XLA planes: the pallas kernel
+            # has no bits plane, and silently dropping the in-call
+            # compaction payload would buy flagged rows in the latency
+            # regime a SECOND serial device round trip — the exact cost
+            # the in-call plane exists to avoid
+            if cs.pallas_args is not None and not want_bits:
                 from ..ops.pallas_match import pallas_supported
 
                 if pallas_supported(B, packed.L, packed.R):
@@ -1177,24 +1283,42 @@ class TPUPolicyEngine:
         # ---- launch: dispatch every sub-batch asynchronously. The returned
         # finish() materializes — callers that interleave host work (e.g.
         # SARFastPath encoding the next chunk) overlap it with the device.
+        use_pack = (
+            word_pack is not None
+            and not want_full
+            and not want_bits
+            and cs.mesh is None
+        )
         outs = []
         for lo in range(0, n, _PIPELINE_SB):
             hi = min(lo + _PIPELINE_SB, n)
+            v = hi - lo if valid_rows is None else max(0, min(hi, valid_rows) - lo)
             if lo == 0 and hi == n:
-                w, f, p = one(codes_arr, extras_arr)
+                w, f, p = one(codes_arr, extras_arr, v)
             else:
-                w, f, p = one(codes_arr[lo:hi], extras_arr[lo:hi])
-            w.copy_to_host_async()
+                w, f, p = one(codes_arr[lo:hi], extras_arr[lo:hi], v)
+            part = None
+            if use_pack:
+                part = word_pack.add(w)
+            else:
+                w.copy_to_host_async()
             if f is not None:
                 f[0].copy_to_host_async()
                 f[1].copy_to_host_async()
-            outs.append((lo, hi - lo, w, f, p))
+            outs.append((lo, hi - lo, w, f, p, part))
 
         def finish():
             bitmap: dict = {}
             host = [
-                (lo, np.asarray(w)[:m], trim_full(f, m) if want_full else None, p)
-                for lo, m, w, f, p in outs
+                (
+                    lo,
+                    word_pack.view(part, m)
+                    if part is not None
+                    else np.asarray(w)[:m],
+                    trim_full(f, m) if want_full else None,
+                    p,
+                )
+                for lo, m, w, f, p, part in outs
             ]
             # outputs are materialized: the device has fully consumed the
             # staged inputs, so their buffers can serve the next batch

@@ -62,10 +62,12 @@ from ..server.authorizer import (
 from ..lang.authorize import ALLOW, DENY
 from ..ops.match import WORD_ERR, WORD_GATE, WORD_MULTI
 from .evaluator import (
+    _BATCH_BUCKETS,
     BITS_INCALL_MAX,
     SERVING_CHUNK,
     TPUPolicyEngine,
     _round_bucket,
+    _WordPacker,
 )
 
 log = logging.getLogger(__name__)
@@ -88,6 +90,16 @@ _GATE_RESULTS = {
 # (decision, reason, error): error non-None mirrors the webhook handler's
 # decode-error / evaluation-error response shapes (server/http.py)
 Result = Tuple[str, str, Optional[str]]
+
+
+def _packed_decode_enabled() -> bool:
+    """CEDAR_TPU_PACKED_DECODE=0 restores per-chunk word readbacks — the
+    operator escape hatch for the batch-wide packed D2H transfer, and the
+    bench's A/B lever (bench.py --encode). Read per batch: the env lookup
+    is noise next to one chunk's encode."""
+    import os
+
+    return os.environ.get("CEDAR_TPU_PACKED_DECODE", "1") != "0"
 
 
 class _Snapshot(NamedTuple):
@@ -229,8 +241,12 @@ class _RawFastPath:
 
     # ----------------------------------------------------- subclass surface
 
-    def _encode(self, snap: _Snapshot, bodies: Sequence[bytes]):
-        """-> (codes, extras, counts, flags, aux) for one chunk."""
+    def _encode_into(
+        self, snap: _Snapshot, bodies, codes, extras, counts, flags
+    ):
+        """C++ encode of one chunk DIRECTLY into the caller's buffers
+        (the engine's pooled staging); returns the path's aux payload
+        (None for SAR, uids for admission)."""
         raise NotImplementedError
 
     def _route_flags(self, flags, results, bodies, aux) -> np.ndarray:
@@ -283,17 +299,25 @@ class _RawFastPath:
 
         Large batches run a two-phase pipeline: each chunk's C++ encode +
         async device launch (_prepare_chunk) happens while the previous
-        chunk's device work is in flight; materialization + verdict decode
+        chunk's device work is in flight; every chunk's verdict words pack
+        into ONE batch-wide D2H transfer (_WordPacker, flushed once all
+        chunks have launched); materialization + verdict decode
         (_finish_words) drains in order; gated and flagged rows across ALL
         chunks resolve in one deferred pass. `last_stage_s` records the
         per-call encode/device/decode split for the bench's stage budget."""
         self.last_stage_s = {"encode": 0.0, "device": 0.0, "decode": 0.0}
+        pack = _WordPacker() if _packed_decode_enabled() else None
         pending = []
         lo = 0
         for size in _chunk_sizes(len(bodies), self._CHUNK, self._TAIL_CHUNK):
             chunk = bodies[lo : lo + size]
             lo += size
-            pending.append((chunk, self._prepare_chunk(snap, chunk)))
+            pending.append(
+                (chunk, self._prepare_chunk(snap, chunk, word_pack=pack))
+            )
+        if pack is not None:
+            pack.flush()
+            self._note_packed(pack)
         ctxs = [self._finish_words(snap, chunk, pre) for chunk, pre in pending]
         self._resolve_deferred(snap, ctxs)
         if len(ctxs) == 1:
@@ -356,18 +380,26 @@ class _RawFastPath:
 
     def pipeline_dispatch(self, ctx):
         """Stage 2 (dispatch thread): launch every chunk's device match
-        asynchronously and return immediately — the caller dispatches the
-        NEXT batch while this one executes."""
+        asynchronously — the batch's verdict words registering with one
+        _WordPacker, flushed into a single packed D2H transfer once the
+        last chunk is away — and return immediately; the caller dispatches
+        the NEXT batch while this one executes."""
         if ctx[0] == "direct":
             return ctx
         _, snap, bodies, encs = ctx
         t0 = time.monotonic()
+        pack = _WordPacker() if _packed_decode_enabled() else None
         try:
             launched = [
-                (chunk, self._launch_chunk(snap, enc)) for chunk, enc in encs
+                (chunk, self._launch_chunk(snap, enc, word_pack=pack))
+                for chunk, enc in encs
             ]
+            if pack is not None:
+                pack.flush()
         except Exception:  # noqa: BLE001 — device failure degrades
             return ("direct", self._pipeline_degrade(bodies, "dispatch"))
+        if pack is not None:
+            self._note_packed(pack)
         return ("run", snap, bodies, launched, t0)
 
     def pipeline_decode(self, ctx) -> list:
@@ -392,6 +424,18 @@ class _RawFastPath:
         for c in ctxs:
             out.extend(c["results"].tolist())
         return out
+
+    def _note_packed(self, pack) -> None:
+        """Count one batch's packed word transfer (metrics are advisory:
+        never let a registry hiccup break serving)."""
+        if not pack.parts:
+            return
+        try:
+            from ..server.metrics import record_packed_decode
+
+            record_packed_decode(self._METRIC_PATH, pack.parts)
+        except Exception:  # noqa: BLE001 — metrics never break serving
+            pass
 
     def _pipeline_degrade(self, bodies: Sequence[bytes], stage: str) -> list:
         """A pipelined stage raised: feed the breaker and answer the whole
@@ -434,24 +478,60 @@ class _RawFastPath:
         record_row_routing(p, "encoder_gate", n - n_fallback - n_ok)
 
     def _encode_chunk(self, snap: _Snapshot, bodies: Sequence[bytes]):
-        """Host-only half of chunk preparation: C++ encode, encoder-gate
-        flag routing, extras-width trim. No device interaction — this is
-        the piece the pipelined batcher runs on its encode worker pool."""
+        """Host-only half of chunk preparation: C++ encode STRAIGHT INTO
+        bucket-padded buffers acquired from the engine's staging pool —
+        the zero-copy staging path. The encoder's worker pool shards the
+        chunk across cores and each shard writes its rows into the pooled
+        buffer in place, so the encoded codes reach the (donated) H2D
+        transfer with no intermediate copy: the engine's _pad_to_bucket
+        sees an exact-bucket array and passes it through untouched. The
+        buffers ride the chunk ctx (`held`) and return to the pool only
+        after the deferred resolve — the device (which may alias numpy
+        inputs on CPU, and holds donated transfers in flight on TPU) is
+        provably done with them there. Any exception on the way abandons
+        the buffers to the GC instead of releasing them: a buffer that
+        MIGHT still back an in-flight transfer must never re-enter the
+        pool (tests/test_hostpath.py pins this).
+
+        No device interaction — this is the piece the pipelined batcher
+        runs on its encode worker pool."""
         chaos_fire("engine.encode")
-        codes, extras, counts, flags, aux = self._encode(snap, bodies)
+        n = len(bodies)
+        staging = self.engine._staging
+        pad_L = snap.cs.packed.L
+        B = _round_bucket(n, _BATCH_BUCKETS)
+        cap = snap.encoder.DEFAULT_EXTRAS_CAP
+        codes = staging.acquire((B, snap.encoder.n_slots), np.int32)
+        extras = staging.acquire((B, cap), np.int32)
+        held = [codes, extras]
+        counts = np.empty((n,), np.int32)
+        flags = np.empty((n,), np.uint8)
+        try:
+            aux = self._encode_into(
+                snap, bodies, codes, extras, counts, flags
+            )
+        except Exception:
+            # the encode never reached the device: the buffers are
+            # provably idle, hand them straight back
+            staging.release(*held)
+            raise
+        if B != n:
+            # bucket-padding rows: all-zero codes activate nothing, >= L
+            # extras match nothing — the exact padding _pad_to_bucket used
+            codes[n:] = 0
+            extras[n:] = pad_L
         # object ndarray, not a list: clean rows scatter in one vectorized
         # fancy-index assignment (_finish_words); per-row assignments
         # (fallback/gate/flag rows) work the same on either container
-        results = np.empty(len(bodies), dtype=object)
+        results = np.empty(n, dtype=object)
         py_rows = self._route_flags(flags, results, bodies, aux)
 
         ok = flags == F_OK
         n_ok = int(ok.sum())
         idx = ok_codes = ok_extras = None
         if n_ok:
-            all_ok = n_ok == len(bodies)
-            idx = np.arange(len(bodies)) if all_ok else np.nonzero(ok)[0]
-            ok_codes = codes if all_ok else codes[idx]
+            all_ok = n_ok == n
+            idx = np.arange(n) if all_ok else np.nonzero(ok)[0]
             # trim the extras buffer to the live width (bucketed to avoid
             # retraces): most requests carry zero extras, and every padded
             # column costs a [B, E, L] broadcast-compare on device
@@ -461,38 +541,56 @@ class _RawFastPath:
             if max_e == 0:
                 E = 1
             else:
-                E = min(
-                    _round_bucket(max_e, (8, 16, 32, 64, 128, 256)),
-                    extras.shape[1],
-                )
-            ok_extras = extras[:, :E] if all_ok else extras[idx, :E]
-        return results, py_rows, idx, ok_codes, ok_extras, aux
+                E = min(_round_bucket(max_e, (8, 16, 32, 64, 128, 256)), cap)
+            if all_ok:
+                ok_codes = codes
+                ok_extras = extras[:, :E]
+            else:
+                # compacting to the ok rows copies them out of the pooled
+                # buffers (fancy indexing), so the staging arrays never
+                # reach the device — release them now
+                ok_codes = codes[idx]
+                ok_extras = extras[idx, :E]
+                staging.release(*held)
+                held = []
+        else:
+            staging.release(*held)
+            held = []
+        return results, py_rows, idx, ok_codes, ok_extras, aux, held
 
-    def _launch_chunk(self, snap: _Snapshot, enc):
+    def _launch_chunk(self, snap: _Snapshot, enc, word_pack=None):
         """Device half of chunk preparation: launch the encoded rows' match
         asynchronously (dispatch only — the readback happens in
-        _finish_words)."""
+        _finish_words). `word_pack` routes this chunk's verdict words into
+        the batch-wide packed D2H transfer (engine/_WordPacker)."""
         chaos_fire("engine.dispatch")
-        results, py_rows, idx, ok_codes, ok_extras, aux = enc
+        results, py_rows, idx, ok_codes, ok_extras, aux, held = enc
         fin = None
         if idx is not None:
             # small batches: rule bitsets for multi/err rows arrive
             # compacted IN the same device call (zero extra round trips
             # over the high-RTT link). Large batches skip the bits plane;
             # the deferred resolve fetches the rare flagged rows' bitsets
-            # in a second fixed-shape call instead.
+            # in a second fixed-shape call instead — and their words ride
+            # the packed batch transfer.
             fin = self.engine.match_arrays_launch(
                 ok_codes, ok_extras, cs=snap.cs,
                 want_bits=len(idx) <= self._BITS_INCALL_MAX,
+                valid_rows=len(idx),
+                word_pack=word_pack,
             )
-        return results, py_rows, idx, ok_codes, ok_extras, fin, aux
+        return results, py_rows, idx, ok_codes, ok_extras, fin, aux, held
 
-    def _prepare_chunk(self, snap: _Snapshot, bodies: Sequence[bytes]):
+    def _prepare_chunk(
+        self, snap: _Snapshot, bodies: Sequence[bytes], word_pack=None
+    ):
         """Encode one chunk natively and LAUNCH its device match; the device
         work proceeds asynchronously while the caller prepares the next
         chunk."""
         t0 = time.monotonic()
-        pre = self._launch_chunk(snap, self._encode_chunk(snap, bodies))
+        pre = self._launch_chunk(
+            snap, self._encode_chunk(snap, bodies), word_pack=word_pack
+        )
         self.last_stage_s["encode"] += time.monotonic() - t0
         return pre
 
@@ -501,7 +599,7 @@ class _RawFastPath:
         (one shared payload per distinct word — the r03 per-row branch
         chain was the serving-path bottleneck at ~10us/row). Gate-flagged
         and multi/err rows are recorded for _resolve_deferred."""
-        results, py_rows, idx, ok_codes, ok_extras, fin, aux = pre
+        results, py_rows, idx, ok_codes, ok_extras, fin, aux, held = pre
         for i in py_rows:
             results[i] = self._fallback_row(bodies[i])
         ctx = {
@@ -511,6 +609,7 @@ class _RawFastPath:
             "aux": aux,
             "ok_codes": ok_codes,
             "ok_extras": ok_extras,
+            "held": held,
             "bitmap": None,
             "gate_rows": [],
             "flag_rows": [],
@@ -528,7 +627,9 @@ class _RawFastPath:
         words, bitmap = out[0], (out[2] if len(out) == 3 else None)
         t1 = time.monotonic()
         self.last_stage_s["device"] += t1 - t0
-        w = words.astype(np.uint32)
+        # staged (bucket-padded) launches return words for the padding
+        # rows too: everything below is indexed against idx, so trim
+        w = words[: len(idx)].astype(np.uint32)
         ctx["bitmap"] = bitmap
         handled = set()
         if snap.cs.packed.has_gate:
@@ -646,6 +747,18 @@ class _RawFastPath:
                 i = int(ctx["idx"][k])
                 ctx["results"][i] = self._emit(payload, i, aux)
 
+        # every device readback for this batch has materialized and every
+        # flagged row's feature bytes have been consumed: the pooled
+        # staging buffers the chunks encoded into are idle — hand them
+        # back. Exception paths anywhere above skip this on purpose: an
+        # abandoned buffer is GC'd, a prematurely released one could be
+        # handed to a later batch while a donated transfer still reads it.
+        staging = self.engine._staging
+        for ctx in ctxs:
+            if ctx["held"]:
+                staging.release(*ctx["held"])
+                ctx["held"] = []
+
 
 def _gather_flag_bits(engine, snap, ctxs) -> dict:
     """Materialize each chunk's async bits fetch and return {feature key:
@@ -730,9 +843,9 @@ class SARFastPath(_RawFastPath):
 
     # --------------------------------------------------------------- hooks
 
-    def _encode(self, snap, bodies):
-        codes, extras, counts, flags = snap.encoder.encode_batch(bodies)
-        return codes, extras, counts, flags, None
+    def _encode_into(self, snap, bodies, codes, extras, counts, flags):
+        snap.encoder.encode_batch_into(bodies, codes, extras, counts, flags)
+        return None
 
     def _route_flags(self, flags, results, bodies, aux):
         for flag, res in _GATE_RESULTS.items():
@@ -916,11 +1029,10 @@ class AdmissionFastPath(_RawFastPath):
 
     # --------------------------------------------------------------- hooks
 
-    def _encode(self, snap, bodies):
-        codes, extras, counts, flags, uids = snap.encoder.encode_adm_batch(
-            bodies
+    def _encode_into(self, snap, bodies, codes, extras, counts, flags):
+        return snap.encoder.encode_adm_batch_into(
+            bodies, codes, extras, counts, flags
         )
-        return codes, extras, counts, flags, uids
 
     def _route_flags(self, flags, results, bodies, uids):
         from ..server.admission import AdmissionResponse

@@ -391,16 +391,22 @@ def native_error() -> Optional[str]:
 
 
 _encode_threads_cache: "Optional[int]" = None
+_encode_threads_override: "Optional[int]" = None
 
 
 def _default_encode_threads() -> int:
-    """Per-batch encode thread count. CEDAR_NATIVE_THREADS pins it
-    (operators sharing cores with other tenants; the pipeline bench uses 1
-    to isolate stage overlap — docs/performance.md); a malformed value is
-    logged ONCE and ignored rather than crashing every native encode into
-    the interpreter-fallback path. Resolved on first use and cached — this
-    runs per micro-batch on the hot path."""
+    """Per-batch encode thread count. An explicit set_encode_threads()
+    override (the webhook CLI's --native-encode-threads flag) wins;
+    otherwise CEDAR_NATIVE_THREADS pins it (operators sharing cores with
+    other tenants; the pipeline bench uses 1 to isolate stage overlap —
+    docs/performance.md); a malformed value is logged ONCE and ignored
+    rather than crashing every native encode into the interpreter-fallback
+    path. Resolved on first use and cached — this runs per micro-batch on
+    the hot path; reset_encode_threads() invalidates the cache so a
+    corrected env var actually takes effect."""
     global _encode_threads_cache
+    if _encode_threads_override is not None:
+        return _encode_threads_override
     if _encode_threads_cache is not None:
         return _encode_threads_cache
     import logging
@@ -423,6 +429,27 @@ def _default_encode_threads() -> int:
         val = min(max(os.cpu_count() or 1, 1), 16)
     _encode_threads_cache = val
     return val
+
+
+def reset_encode_threads() -> None:
+    """Invalidate the cached thread count (and any override): the next
+    encode re-reads CEDAR_NATIVE_THREADS. The cache is a module global
+    resolved once per process — without this hook a malformed-then-
+    corrected env var (or a test that monkeypatches it) silently kept the
+    stale value forever."""
+    global _encode_threads_cache, _encode_threads_override
+    _encode_threads_cache = None
+    _encode_threads_override = None
+
+
+def set_encode_threads(n: Optional[int]) -> None:
+    """Pin the per-batch encode thread count, overriding the env var —
+    the webhook CLI's --native-encode-threads flag. None (or <= 0) clears
+    the override back to env/auto resolution."""
+    global _encode_threads_override
+    reset_encode_threads()
+    if n is not None and n > 0:
+        _encode_threads_override = int(n)
 
 class NativeEncoder:
     """Owns one loaded native activation table; encodes raw SAR JSON batches."""
@@ -457,6 +484,94 @@ class NativeEncoder:
             lib.ce_free_table(self._handle)
             self._handle = None
 
+    @staticmethod
+    def _check_out(name: str, arr: np.ndarray, rows: int, width: int, dtype):
+        """Output-buffer contract for the *_into entries: the C side
+        writes through raw pointers with a fixed row stride, so a wrong
+        dtype/shape/layout is memory corruption, not an exception."""
+        if arr.dtype != np.dtype(dtype):
+            raise ValueError(f"{name}: want dtype {np.dtype(dtype)}, got {arr.dtype}")
+        if not arr.flags["C_CONTIGUOUS"]:
+            raise ValueError(f"{name}: buffer must be C-contiguous")
+        if arr.shape[0] < rows:
+            raise ValueError(f"{name}: {arr.shape[0]} rows < batch size {rows}")
+        if width is not None and (arr.ndim != 2 or arr.shape[1] != width):
+            raise ValueError(f"{name}: want shape [>= {rows}, {width}], got {arr.shape}")
+
+    def encode_batch_into(
+        self,
+        bodies: Sequence[bytes],
+        codes: np.ndarray,
+        extras: np.ndarray,
+        counts: np.ndarray,
+        flags: np.ndarray,
+        n_threads: int = 0,
+    ) -> int:
+        """Encode raw SAR bodies DIRECTLY into caller-provided buffers —
+        the zero-copy staging path (engine/fastpath.py hands in the
+        engine's pooled, bucket-padded staging buffers so encode output
+        needs no intermediate copy before the donated H2D transfer).
+
+        codes [B >= n, n_slots] int32 and extras [B >= n, cap] int32 must
+        be C-contiguous; counts [>= n] int32, flags [>= n] uint8. Only the
+        first len(bodies) rows are written (extras rows are pad-filled to
+        the buffer's cap); rows beyond that — bucket padding — are the
+        caller's to fill. Returns the encoded row count."""
+        lib = _load_library()
+        assert lib is not None
+        n = len(bodies)
+        if n_threads <= 0:
+            n_threads = _default_encode_threads()
+        self._check_out("codes", codes, n, self.n_slots, np.int32)
+        extras_cap = extras.shape[1] if extras.ndim == 2 else 0
+        self._check_out("extras", extras, n, extras_cap, np.int32)
+        self._check_out("counts", counts, n, None, np.int32)
+        self._check_out("flags", flags, n, None, np.uint8)
+        if n == 0:
+            return 0
+        c_codes = codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        c_extras = extras.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        c_counts = counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        c_flags = flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if _pylib is not None and type(bodies) is list:
+            # zero-packing path: the C side reads the bytes objects in
+            # place — no join, no per-item length loop — and pad-fills
+            # every row's unused extras cells itself (extras_pad)
+            _pylib.ce_encode_sar_pylist(
+                self._handle,
+                bodies,
+                n,
+                c_codes,
+                c_extras,
+                extras_cap,
+                self.pad_value,
+                c_counts,
+                c_flags,
+                n_threads,
+            )
+            return n
+        # packed-buffer entry: extras arrives caller-pre-padded (the C
+        # side only writes consumed cells)
+        extras[:n] = self.pad_value
+        buf = b"".join(bodies)
+        lens = np.fromiter((len(b) for b in bodies), dtype=np.uint64, count=n)
+        offsets = np.zeros((n,), dtype=np.uint64)
+        np.cumsum(lens[:-1], out=offsets[1:])
+        lib.ce_encode_sar_batch(
+            self._handle,
+            n,
+            buf,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            c_codes,
+            c_extras,
+            extras_cap,
+            c_counts,
+            c_flags,
+            n_threads,
+        )
+        return n
+
     def encode_batch(
         self,
         bodies: Sequence[bytes],
@@ -469,11 +584,7 @@ class NativeEncoder:
         flags: F_OK rows are device-ready; gate rows (self-allow / system
         skip) carry the decision; F_PARSE_ERROR / F_EXTRAS_OVERFLOW rows
         need the caller's Python fallback."""
-        lib = _load_library()
-        assert lib is not None
         n = len(bodies)
-        if n_threads <= 0:
-            n_threads = _default_encode_threads()
         if n == 0:
             return (
                 np.zeros((0, self.n_slots), np.int32),
@@ -481,50 +592,90 @@ class NativeEncoder:
                 np.zeros((0,), np.int32),
                 np.zeros((0,), np.uint8),
             )
+        # every cell of the first n rows is written by the C side (or the
+        # packed-entry pre-pad in encode_batch_into): np.empty is safe
+        codes = np.empty((n, self.n_slots), dtype=np.int32)
+        extras = np.empty((n, extras_cap), dtype=np.int32)
+        counts = np.empty((n,), dtype=np.int32)
+        flags = np.empty((n,), dtype=np.uint8)
+        self.encode_batch_into(bodies, codes, extras, counts, flags, n_threads)
+        return codes, extras, counts, flags
+
+    def encode_adm_batch_into(
+        self,
+        bodies: Sequence[bytes],
+        codes: np.ndarray,
+        extras: np.ndarray,
+        counts: np.ndarray,
+        flags: np.ndarray,
+        n_threads: int = 0,
+    ) -> List[str]:
+        """Admission twin of encode_batch_into: encode raw AdmissionReview
+        bodies into caller-provided buffers (same shape/layout contract)
+        and return the per-row review uids. Only the first len(bodies)
+        rows are written; bucket-padding rows are the caller's to fill."""
+        lib = _load_library()
+        assert lib is not None
+        n = len(bodies)
+        if n_threads <= 0:
+            n_threads = _default_encode_threads()
+        self._check_out("codes", codes, n, self.n_slots, np.int32)
+        extras_cap = extras.shape[1] if extras.ndim == 2 else 0
+        self._check_out("extras", extras, n, extras_cap, np.int32)
+        self._check_out("counts", counts, n, None, np.int32)
+        self._check_out("flags", flags, n, None, np.uint8)
+        if n == 0:
+            return []
+        uid_buf = ctypes.create_string_buffer(n * 256)
+        uid_lens = np.empty((n,), dtype=np.int32)
+        c_codes = codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        c_extras = extras.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        c_counts = counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        c_flags = flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        c_uid_lens = uid_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
         if _pylib is not None and type(bodies) is list:
-            # zero-packing path: the C side reads the bytes objects in
-            # place — no join, no per-item length loop, and the output
-            # buffers arrive uninitialized (C writes every consumed cell)
-            codes = np.empty((n, self.n_slots), dtype=np.int32)
-            extras = np.empty((n, extras_cap), dtype=np.int32)
-            counts = np.empty((n,), dtype=np.int32)
-            flags = np.empty((n,), dtype=np.uint8)
-            _pylib.ce_encode_sar_pylist(
+            _pylib.ce_encode_adm_pylist(
                 self._handle,
                 bodies,
                 n,
-                codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                extras.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                c_codes,
+                c_extras,
                 extras_cap,
                 self.pad_value,
-                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                c_counts,
+                c_flags,
+                uid_buf,
+                c_uid_lens,
                 n_threads,
             )
-            return codes, extras, counts, flags
-        codes = np.zeros((n, self.n_slots), dtype=np.int32)
-        extras = np.full((n, extras_cap), self.pad_value, dtype=np.int32)
-        counts = np.zeros((n,), dtype=np.int32)
-        flags = np.zeros((n,), dtype=np.uint8)
-
-        buf = b"".join(bodies)
-        lens = np.fromiter((len(b) for b in bodies), dtype=np.uint64, count=n)
-        offsets = np.zeros((n,), dtype=np.uint64)
-        np.cumsum(lens[:-1], out=offsets[1:])
-        lib.ce_encode_sar_batch(
-            self._handle,
-            n,
-            buf,
-            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-            codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            extras.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            extras_cap,
-            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            n_threads,
-        )
-        return codes, extras, counts, flags
+        else:
+            extras[:n] = self.pad_value  # packed entry: caller pre-pads
+            buf = b"".join(bodies)
+            lens = np.fromiter(
+                (len(b) for b in bodies), dtype=np.uint64, count=n
+            )
+            offsets = np.zeros((n,), dtype=np.uint64)
+            np.cumsum(lens[:-1], out=offsets[1:])
+            lib.ce_encode_adm_batch(
+                self._handle,
+                n,
+                buf,
+                offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                c_codes,
+                c_extras,
+                extras_cap,
+                c_counts,
+                c_flags,
+                uid_buf,
+                c_uid_lens,
+                n_threads,
+            )
+        raw = uid_buf.raw
+        return [
+            raw[i * 256 : i * 256 + uid_lens[i]].decode("utf-8", "replace")
+            for i in range(n)
+        ]
 
     def encode_adm_batch(
         self,
@@ -536,11 +687,7 @@ class NativeEncoder:
         flags, uids). Same contract as encode_batch plus: uids[i] is the
         review uid (str) for F_OK / F_ADM_NS_SKIP rows; F_PARSE_ERROR /
         F_ADM_ERROR / F_EXTRAS_OVERFLOW rows need the Python fallback."""
-        lib = _load_library()
-        assert lib is not None
         n = len(bodies)
-        if n_threads <= 0:
-            n_threads = _default_encode_threads()
         if n == 0:
             return (
                 np.zeros((0, self.n_slots), np.int32),
@@ -549,62 +696,11 @@ class NativeEncoder:
                 np.zeros((0,), np.uint8),
                 [],
             )
-        uid_buf = ctypes.create_string_buffer(n * 256)
-        uid_lens = np.empty((n,), dtype=np.int32)
-        if _pylib is not None and type(bodies) is list:
-            codes = np.empty((n, self.n_slots), dtype=np.int32)
-            extras = np.empty((n, extras_cap), dtype=np.int32)
-            counts = np.empty((n,), dtype=np.int32)
-            flags = np.empty((n,), dtype=np.uint8)
-            _pylib.ce_encode_adm_pylist(
-                self._handle,
-                bodies,
-                n,
-                codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                extras.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                extras_cap,
-                self.pad_value,
-                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                uid_buf,
-                uid_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                n_threads,
-            )
-            raw = uid_buf.raw
-            uids = [
-                raw[i * 256 : i * 256 + uid_lens[i]].decode(
-                    "utf-8", "replace"
-                )
-                for i in range(n)
-            ]
-            return codes, extras, counts, flags, uids
-        codes = np.zeros((n, self.n_slots), dtype=np.int32)
-        extras = np.full((n, extras_cap), self.pad_value, dtype=np.int32)
-        counts = np.zeros((n,), dtype=np.int32)
-        flags = np.zeros((n,), dtype=np.uint8)
-
-        buf = b"".join(bodies)
-        lens = np.fromiter((len(b) for b in bodies), dtype=np.uint64, count=n)
-        offsets = np.zeros((n,), dtype=np.uint64)
-        np.cumsum(lens[:-1], out=offsets[1:])
-        lib.ce_encode_adm_batch(
-            self._handle,
-            n,
-            buf,
-            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-            codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            extras.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            extras_cap,
-            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            uid_buf,
-            uid_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            n_threads,
+        codes = np.empty((n, self.n_slots), dtype=np.int32)
+        extras = np.empty((n, extras_cap), dtype=np.int32)
+        counts = np.empty((n,), dtype=np.int32)
+        flags = np.empty((n,), dtype=np.uint8)
+        uids = self.encode_adm_batch_into(
+            bodies, codes, extras, counts, flags, n_threads
         )
-        raw = uid_buf.raw
-        uids = [
-            raw[i * 256 : i * 256 + uid_lens[i]].decode("utf-8", "replace")
-            for i in range(n)
-        ]
         return codes, extras, counts, flags, uids

@@ -44,13 +44,17 @@
 #include <arpa/inet.h>
 
 #include <algorithm>
+#include <atomic>
 #include <charconv>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <functional>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <string_view>
 #include <thread>
@@ -2376,24 +2380,162 @@ struct ReqView {
   uint64_t len;
 };
 
+// Persistent encode worker pool. The original drive_batch spawned fresh
+// std::threads per batch — ~20-60us of clone/join overhead per call,
+// which at serving chunk cadence (a few ms per 16k-row chunk, dozens of
+// chunks/sec) ate a measurable slice of the encode budget and thrashed
+// the scheduler. Workers here are created ONCE (growing to the largest
+// thread count ever requested, capped), parked on a condition variable
+// between batches, and handed (lo, hi) shard ranges through a shared
+// cursor; the CALLING thread always runs one shard itself, so a pool of
+// nt-1 workers serves an nt-way encode and a cold pool costs nothing on
+// the first single-threaded call.
+//
+// Lifetime: the pool object is intentionally leaked (never destroyed).
+// Workers blocked on the cv at process exit are reaped by _exit — unlike
+// a joinable-thread destructor (std::terminate) or a pthread unwinding
+// mid-C++-exception (the XLA warm-thread abort this codebase already
+// guards against), a parked worker holds no lock and touches no state.
+class EncodePool {
+ public:
+  static constexpr int kMaxWorkers = 64;
+
+  // Run work(lo, hi) over [0, n) split into `shards` contiguous ranges,
+  // the calling thread pulling shards alongside the pool workers. Blocks
+  // until every shard completed. Thread-safe across concurrent callers
+  // (each call owns a private Job; workers pull from the active job
+  // queue). A busy or undersized pool degrades to the caller running
+  // more shards itself — never to a deadlock or an unserved range.
+  void run(uint64_t n, uint64_t shards,
+           const std::function<void(uint64_t, uint64_t)> &work) {
+    if (shards > n) shards = n;
+    if (shards <= 1) {
+      work(0, n);
+      return;
+    }
+    ensure_workers(size_t(shards - 1));
+    auto job = std::make_shared<Job>();
+    job->work = &work;
+    job->n = n;
+    job->chunk = (n + shards - 1) / shards;
+    job->next.store(0);
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      jobs_.push_back(job);
+    }
+    cv_work_.notify_all();
+    while (run_one_shard(*job)) {
+    }
+    // every range is claimed INSIDE a pending window (run_one_shard
+    // increments pending before touching the cursor), so pending == 0
+    // with a drained cursor proves no shard — claimed or about to be
+    // claimed — can still call `work` after this wait returns
+    std::unique_lock<std::mutex> lk(job->mu);
+    job->cv_done.wait(lk, [&] { return job->pending == 0 && job->drained; });
+  }
+
+ private:
+  struct Job {
+    const std::function<void(uint64_t, uint64_t)> *work;
+    uint64_t n, chunk;
+    std::atomic<uint64_t> next;
+    std::mutex mu;
+    std::condition_variable cv_done;
+    int pending = 0;       // threads inside run_one_shard's claim window
+    bool drained = false;  // cursor exhausted (job unlinked from queue)
+  };
+
+  // Claim + run the next range of `job`; false when the cursor is dry.
+  // pending is raised BEFORE the cursor read: a thread holding a valid
+  // range is always visible to run()'s completion wait (the gap between
+  // fetch_add and a later increment would let run() return — and destroy
+  // `work` — while this thread still intends to call it).
+  bool run_one_shard(Job &job) {
+    {
+      std::lock_guard<std::mutex> g(job.mu);
+      ++job.pending;
+    }
+    uint64_t lo = job.next.fetch_add(job.chunk);
+    bool ran = lo < job.n;
+    if (ran) {
+      uint64_t hi = lo + job.chunk > job.n ? job.n : lo + job.chunk;
+      (*job.work)(lo, hi);
+    } else {
+      unlink_job(job);
+    }
+    bool notify = false;
+    {
+      std::lock_guard<std::mutex> g(job.mu);
+      --job.pending;
+      notify = job.pending == 0 && job.drained;
+    }
+    if (notify) job.cv_done.notify_all();
+    return ran;
+  }
+
+  void unlink_job(Job &job) {
+    // first thread to see the dry cursor unlinks the job so workers stop
+    // considering it (idempotent: late observers find nothing to erase)
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (size_t i = 0; i < jobs_.size(); ++i) {
+        if (jobs_[i].get() == &job) {
+          jobs_.erase(jobs_.begin() + i);
+          break;
+        }
+      }
+    }
+    std::lock_guard<std::mutex> g(job.mu);
+    job.drained = true;
+  }
+
+  void ensure_workers(size_t want) {
+    if (want > kMaxWorkers) want = kMaxWorkers;
+    std::lock_guard<std::mutex> g(mu_);
+    while (n_workers_ < want) {
+      std::thread([this] { worker_loop(); }).detach();
+      ++n_workers_;
+    }
+  }
+
+  void worker_loop() {
+    for (;;) {
+      std::shared_ptr<Job> job;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_work_.wait(lk, [&] { return !jobs_.empty(); });
+        job = jobs_.front();  // shared_ptr copy: outlives run()'s return
+      }
+      // pull shards until this job's cursor runs dry; other queued jobs
+      // are picked up on the next loop. A stale job (drained between the
+      // copy and here) reads a dry cursor and never touches job->work.
+      while (run_one_shard(*job)) {
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_work_;
+  std::vector<std::shared_ptr<Job>> jobs_;
+  size_t n_workers_ = 0;
+};
+
+EncodePool &encode_pool() {
+  static EncodePool *pool = new EncodePool();  // leaked on purpose
+  return *pool;
+}
+
 // Shared batch threading driver: split [0, n) into n_threads contiguous
-// ranges (per-thread arenas/pools live inside `work`).
+// ranges (per-thread arenas/pools live inside `work`), executed on the
+// persistent pool (the calling thread runs shards too).
 template <class Work>
 void drive_batch(uint64_t n, int32_t n_threads, Work &&work) {
   if (n_threads <= 1 || n < 64) {
     work(uint64_t(0), n);
     return;
   }
-  uint64_t nt = uint64_t(n_threads);
-  if (nt > n) nt = n;
-  std::vector<std::thread> threads;
-  uint64_t chunk = (n + nt - 1) / nt;
-  for (uint64_t k = 0; k < nt; ++k) {
-    uint64_t lo = k * chunk, hi = lo + chunk > n ? n : lo + chunk;
-    if (lo >= hi) break;
-    threads.emplace_back(work, lo, hi);
-  }
-  for (auto &th : threads) th.join();
+  const std::function<void(uint64_t, uint64_t)> fn = work;
+  encode_pool().run(n, uint64_t(n_threads), fn);
 }
 
 // SAR encode over a request range. extras_pad >= 0 means the extras

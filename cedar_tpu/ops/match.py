@@ -538,12 +538,24 @@ def match_rules_codes_pallas(
     so the [B, R] score matrix never reaches HBM. Layouts: W2 [L, R]
     unchunked in either kernel dtype (bf16 with f32 thresh_r, or int8 with
     int32 thresh_r — the lit matrix follows W2's dtype),
-    group_r/policy_r [1, R]."""
-    from .pallas_match import pallas_first_match
+    group_r/policy_r [1, R].
+
+    Without want_full the TIER WALK fuses into the kernel too
+    (pallas_match_words): the serving hot path is one pallas launch from
+    feature codes to packed verdict words, and the per-request HBM output
+    shrinks from 2 x [B, G] int32 to one u32 word. want_full keeps the
+    (first, last) kernel for the host tier-walk callers."""
+    from .pallas_match import pallas_first_match, pallas_match_words
 
     _note_trace()
     n_groups = n_tiers * _GPT + (1 if has_gate else 0)
     lit = _lit_matrix_codes(codes, extras, act_rows, _lit_dtype(W2.dtype))
+    if not want_full:
+        packed = pallas_match_words(
+            lit, W2, thresh_r, group_r, policy_r, n_tiers, has_gate,
+            interpret,
+        )
+        return packed, None
     first, last = pallas_first_match(
         lit, W2, thresh_r, group_r, policy_r, n_groups, interpret
     )
@@ -551,7 +563,7 @@ def match_rules_codes_pallas(
     if has_gate:
         gate = (first[:, n_tiers * _GPT] != INT32_MAX).astype(jnp.uint32)
         packed = packed | (gate << 27)
-    return (packed, (first, last)) if want_full else (packed, None)
+    return packed, (first, last)
 
 
 @functools.partial(jax.jit, static_argnames=("n_groups",))

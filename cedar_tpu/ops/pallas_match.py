@@ -73,14 +73,18 @@ def _tpu_compiler_params(**kwargs):
         return cls(**{k: v for k, v in kwargs.items() if k in names})
 
 
-def _kernel(
-    lit_ref, w_ref, thresh_ref, group_ref, policy_ref, out_ref, last_out_ref,
+def _accum_blocks(
+    lit_ref, w_ref, thresh_ref, group_ref, policy_ref,
     score_ref, acc_ref, last_ref, *, n_groups: int, g_pad: int
 ):
+    """The shared contraction + group-reduction body of both kernels:
+    accumulate this (B, R, L) tile's partial scores in VMEM and, on the
+    last L tile, fold the satisfaction compare + per-group first/last
+    min/max into acc_ref/last_ref. The caller adds its own final-step
+    emit block."""
     k = pl.program_id(2)
     nk = pl.num_programs(2)
     j = pl.program_id(1)
-    nj = pl.num_programs(1)
 
     @pl.when(k == 0)
     def _():
@@ -132,10 +136,111 @@ def _kernel(
         acc_ref[:] = jnp.minimum(acc_ref[:], tile_min)
         last_ref[:] = jnp.maximum(last_ref[:], jnp.concatenate(maxs, axis=1))
 
+
+def _kernel(
+    lit_ref, w_ref, thresh_ref, group_ref, policy_ref, out_ref, last_out_ref,
+    score_ref, acc_ref, last_ref, *, n_groups: int, g_pad: int
+):
+    _accum_blocks(
+        lit_ref, w_ref, thresh_ref, group_ref, policy_ref,
+        score_ref, acc_ref, last_ref, n_groups=n_groups, g_pad=g_pad,
+    )
+    k = pl.program_id(2)
+    nk = pl.num_programs(2)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
     @pl.when(jnp.logical_and(j == nj - 1, k == nk - 1))
     def _():
         out_ref[:] = acc_ref[:]
         last_out_ref[:] = last_ref[:]
+
+
+# packed verdict-word constants, mirrored from ops/match.py (kept literal
+# here so the kernel module has no import cycle with match.py)
+_POLICY_NONE = 0xFFFFFF
+_CODE_ALLOW, _CODE_DENY, _CODE_ERROR = 1, 2, 3
+_GPT = 3
+# lane width of the words output tile: int32-sublane-friendly like g_pad;
+# the host consumes column 0
+_WORD_LANES = 8
+
+
+def _words_kernel(
+    lit_ref, w_ref, thresh_ref, group_ref, policy_ref, word_out_ref,
+    score_ref, acc_ref, last_ref,
+    *, n_groups: int, g_pad: int, n_tiers: int, has_gate: bool
+):
+    """The fully fused serving kernel: slot-match (satisfaction compare),
+    clause-reduce (per-group first/last match), AND the tier walk all run
+    in VMEM — the only HBM output is one packed verdict word per request
+    (int32 bit pattern of ops.match's uint32 word, bitcast by the
+    wrapper). Mirrors ops.match._tier_walk exactly: first tier with any
+    explicit signal wins, err/multi/gate bits as documented there."""
+    _accum_blocks(
+        lit_ref, w_ref, thresh_ref, group_ref, policy_ref,
+        score_ref, acc_ref, last_ref, n_groups=n_groups, g_pad=g_pad,
+    )
+    k = pl.program_id(2)
+    nk = pl.num_programs(2)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(jnp.logical_and(j == nj - 1, k == nk - 1))
+    def _():
+        first = acc_ref[:]  # [TB, g_pad] int32
+        last = last_ref[:]
+        tb = first.shape[0]
+        code = jnp.zeros((tb, 1), jnp.int32)
+        err = jnp.zeros((tb, 1), jnp.int32)
+        multi = jnp.zeros((tb, 1), jnp.int32)
+        pol = jnp.full((tb, 1), _POLICY_NONE, jnp.int32)
+        done = jnp.zeros((tb, 1), jnp.bool_)
+        for t in range(n_tiers):  # static unroll, tiers are 1-3
+            p_f = first[:, t * _GPT : t * _GPT + 1]
+            f_f = first[:, t * _GPT + 1 : t * _GPT + 2]
+            e_f = first[:, t * _GPT + 2 : t * _GPT + 3]
+            has_p = p_f != INT32_MAX
+            has_f = f_f != INT32_MAX
+            has_e = e_f != INT32_MAX
+            c_t = jnp.where(
+                has_f,
+                _CODE_DENY,
+                jnp.where(
+                    has_p,
+                    _CODE_ALLOW,
+                    jnp.where(has_e, _CODE_ERROR, 0),
+                ),
+            ).astype(jnp.int32)
+            pol_t = jnp.where(has_f, f_f, jnp.where(has_p, p_f, e_f))
+            sig = c_t != 0
+            new = jnp.logical_and(jnp.logical_not(done), sig)
+            code = jnp.where(new, c_t, code)
+            pol = jnp.where(new, pol_t, pol)
+            err = jnp.where(
+                new & has_e & (has_p | has_f), jnp.int32(1), err
+            )
+            l_p = last[:, t * _GPT : t * _GPT + 1]
+            l_f = last[:, t * _GPT + 1 : t * _GPT + 2]
+            l_e = last[:, t * _GPT + 2 : t * _GPT + 3]
+            win_first = jnp.where(has_f, f_f, jnp.where(has_p, p_f, e_f))
+            win_last = jnp.where(has_f, l_f, jnp.where(has_p, l_p, l_e))
+            multi = jnp.where(
+                new & sig & (win_first != win_last), jnp.int32(1), multi
+            )
+            done = jnp.logical_or(done, sig)
+        word = (
+            jnp.left_shift(code, 30)
+            | jnp.left_shift(err, 29)
+            | jnp.left_shift(multi, 28)
+            | (pol & jnp.int32(_POLICY_NONE))
+        )
+        if has_gate:
+            gate = (
+                first[:, n_tiers * _GPT : n_tiers * _GPT + 1] != INT32_MAX
+            ).astype(jnp.int32)
+            word = word | jnp.left_shift(gate, 27)
+        word_out_ref[:] = jnp.broadcast_to(word, (tb, _WORD_LANES))
 
 
 @functools.partial(
@@ -215,6 +320,84 @@ def pallas_first_match(
         **call_kwargs,
     )(lit, W, thresh_r, group_r, policy_r)
     return out[:, :n_groups], last[:, :n_groups]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_tiers", "has_gate", "interpret")
+)
+def pallas_match_words(
+    lit, W, thresh_r, group_r, policy_r, n_tiers: int,
+    has_gate: bool = False, interpret: bool = False,
+):
+    """Fused slot-match + clause-reduce + tier-walk: one pallas_call from
+    literal matrix to packed uint32 verdict words [B] — the hot-path
+    variant of pallas_first_match for callers that don't need the full
+    (first, last) matrices. Same layouts as pallas_first_match; the word
+    format (incl. the has_gate bit 27) is ops/match.py's packed word,
+    byte-identical to the lax plane (differential-tested in
+    tests/test_pallas_match.py)."""
+    B, L = lit.shape
+    R = W.shape[1]
+    acc_dtype = jnp.int32 if W.dtype == jnp.int8 else jnp.float32
+    in_bytes = 1 if W.dtype == jnp.int8 else 2
+    n_groups = n_tiers * _GPT + (1 if has_gate else 0)
+    tb = min(_TB, B)
+    tk = min(_TK, L)
+    tr = min(_TR, R)
+    g_pad = -(-n_groups // 8) * 8
+
+    grid = (B // tb, R // tr, L // tk)
+    kernel = functools.partial(
+        _words_kernel, n_groups=n_groups, g_pad=g_pad, n_tiers=n_tiers,
+        has_gate=has_gate,
+    )
+
+    call_kwargs = {}
+    cp = _tpu_compiler_params(
+        dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+    )
+    if cp is not None:
+        call_kwargs["compiler_params"] = cp
+    words = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, _WORD_LANES), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (tb, tk), lambda i, j, k: (i, k), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (tk, tr), lambda i, j, k: (k, j), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, tr), lambda i, j, k: (0, j), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, tr), lambda i, j, k: (0, j), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, tr), lambda i, j, k: (0, j), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tb, _WORD_LANES), lambda i, j, k: (i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tb, tr), acc_dtype),
+            pltpu.VMEM((tb, g_pad), jnp.int32),
+            pltpu.VMEM((tb, g_pad), jnp.int32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * B * L * R,
+            bytes_accessed=B * L * in_bytes + L * R * in_bytes
+            + B * _WORD_LANES * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+        **call_kwargs,
+    )(lit, W, thresh_r, group_r, policy_r)
+    return jax.lax.bitcast_convert_type(words[:, 0], jnp.uint32)
 
 
 def pallas_supported(B: int, L: int, R: int) -> bool:

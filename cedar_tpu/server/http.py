@@ -235,7 +235,9 @@ class WebhookServer:
         # tests/test_pipeline.py pins the differential; the CLI defaults
         # to depth 2, embedders opt in).
         self.pipeline_depth = max(0, int(pipeline_depth))
-        self.encode_workers = max(1, int(encode_workers))
+        # 0 = auto: passed through so PipelinedBatcher sizes the pool from
+        # the native encoder's resolved thread width (engine/batcher.py)
+        self.encode_workers = max(0, int(encode_workers))
 
         def _eval_batcher(fastpath_obj, serial_fn, path):
             from ..engine.batcher import MicroBatcher, PipelinedBatcher

@@ -355,6 +355,37 @@ engine_warmup_seconds = REGISTRY.register(
     )
 )
 
+# Host-side budget metrics (docs/performance.md "Host-side budget"): the
+# packed-decode counters prove the batch-wide word transfer is actually
+# riding one D2H per batch (chunks/transfer > 1 under load), and the
+# encode-threads gauge surfaces the resolved native encoder pool size so
+# a mis-set CEDAR_NATIVE_THREADS is visible without a shell on the host.
+packed_decode_transfers_total = REGISTRY.register(
+    Counter(
+        "cedar_packed_decode_transfers_total",
+        "Batch-wide packed verdict-word D2H transfers (one per native "
+        "batch on the throughput path), partitioned by serving path.",
+        ["path"],
+    )
+)
+packed_decode_chunks_total = REGISTRY.register(
+    Counter(
+        "cedar_packed_decode_chunks_total",
+        "Chunk word arrays folded into packed D2H transfers; divide by "
+        "transfers for the fold factor (1.0 = lone-request regime, no "
+        "packing win; 4+ = saturated batches).",
+        ["path"],
+    )
+)
+native_encode_threads = REGISTRY.register(
+    Gauge(
+        "cedar_native_encode_threads",
+        "Resolved per-batch native encoder worker-pool width "
+        "(CEDAR_NATIVE_THREADS / --native-encode-threads / cpu count).",
+        [],
+    )
+)
+
 
 # Shadow-rollout metrics (cedar_tpu/rollout, docs/rollout.md): shadow
 # evaluation is best-effort work BEHIND the live paths, so its counters
@@ -639,6 +670,16 @@ def record_pipeline_stall(path: str, stage: str, seconds: float) -> None:
 
 def set_engine_warmup_seconds(engine: str, seconds: float) -> None:
     engine_warmup_seconds.set(round(seconds, 6), engine=engine)
+
+
+def record_packed_decode(path: str, chunks: int) -> None:
+    packed_decode_transfers_total.inc(path=path)
+    if chunks:
+        packed_decode_chunks_total.inc(chunks, path=path)
+
+
+def set_native_encode_threads(n: int) -> None:
+    native_encode_threads.set(n)
 
 
 def record_shadow_evaluation(path: str) -> None:

@@ -1,0 +1,390 @@
+"""Host-side budget plumbing (ISSUE 8): sharded zero-copy native encode
+into the engine's pooled staging buffers, the batch-wide word-packed
+decode, staging-buffer lifetime on error paths, and the encode-thread
+resolution hooks.
+
+The differential tests here pin BYTE-level equality between the staged /
+packed paths and their per-copy predecessors — the fast path's whole
+contract is that execution-model changes never show up in answers.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+from cedar_tpu.engine.fastpath import SARFastPath
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.native import native_available
+from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain for the native encoder"
+)
+
+# two permits overlap on (sam, get, pods): that row's verdict word carries
+# the multi bit, exercising the flagged/bits plane alongside clean rows
+POLICIES = """
+permit (principal is k8s::User, action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { principal.name == "sam" && resource.resource == "pods" };
+permit (principal, action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { resource.resource == "pods" };
+forbid (principal, action, resource is k8s::Resource)
+  when { resource.resource == "nodes" };
+"""
+
+
+def _sar(user, verb, resource, ns="default"):
+    return json.dumps(
+        {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": user,
+                "uid": "u",
+                "groups": ["system:authenticated"],
+                "resourceAttributes": {
+                    "verb": verb,
+                    "version": "v1",
+                    "resource": resource,
+                    "namespace": ns,
+                },
+            },
+        }
+    ).encode()
+
+
+def _bodies(n=40):
+    out = []
+    for i in range(n):
+        k = i % 5
+        if k == 0:
+            out.append(_sar("sam", "get", "pods"))  # multi-match (flagged)
+        elif k == 1:
+            out.append(_sar(f"user-{i}", "get", "pods"))  # single permit
+        elif k == 2:
+            out.append(_sar("sam", "get", "nodes"))  # forbid
+        elif k == 3:
+            out.append(_sar("sam", "delete", "secrets"))  # no opinion
+        else:
+            out.append(b'{"not": "valid json')  # parse error -> py row
+    return out
+
+
+def _mk(src=POLICIES):
+    """Engine + SARFastPath with an interpreter-only fallback authorizer:
+    the fallback path never touches the engine's staging pool, so pool
+    observations below see ONLY the fast path's buffers."""
+    engine = TPUPolicyEngine()
+    engine.load([PolicySet.from_source(src, "hp")], warm="off")
+    stores = TieredPolicyStores([MemoryStore.from_source("hp", src)])
+    authorizer = CedarWebhookAuthorizer(stores)
+    fast = SARFastPath(engine, authorizer)
+    assert fast.available
+    return engine, fast
+
+
+def _pool_ids(staging):
+    return {id(a) for bufs in staging._free.values() for a in bufs}
+
+
+# ------------------------------------------------------ encode-into parity
+
+
+def test_encode_batch_into_parity():
+    """encode_batch_into over larger (bucket-padded) pooled-style buffers
+    writes the first n rows bit-identically to encode_batch, leaving the
+    pad region to the caller."""
+    engine, fast = _mk()
+    snap = fast._current_snapshot()
+    enc = snap.encoder
+    bodies = _bodies(24)
+    ref_codes, ref_extras, ref_counts, ref_flags = enc.encode_batch(bodies)
+
+    B = 32  # bucket-padded
+    codes = np.full((B, enc.n_slots), -7, np.int32)
+    extras = np.full((B, enc.DEFAULT_EXTRAS_CAP), -7, np.int32)
+    counts = np.empty((24,), np.int32)
+    flags = np.empty((24,), np.uint8)
+    n = enc.encode_batch_into(bodies, codes, extras, counts, flags)
+    assert n == 24
+    assert (codes[:24] == ref_codes).all()
+    assert (extras[:24] == ref_extras).all()
+    assert (counts == ref_counts).all()
+    assert (flags == ref_flags).all()
+    # rows beyond n are the caller's: untouched
+    assert (codes[24:] == -7).all()
+    assert (extras[24:] == -7).all()
+
+
+def test_encode_into_rejects_bad_buffers():
+    engine, fast = _mk()
+    enc = fast._current_snapshot().encoder
+    bodies = _bodies(8)
+    good = lambda: (  # noqa: E731 — fresh buffers per case
+        np.zeros((8, enc.n_slots), np.int32),
+        np.zeros((8, enc.DEFAULT_EXTRAS_CAP), np.int32),
+        np.zeros((8,), np.int32),
+        np.zeros((8,), np.uint8),
+    )
+    codes, extras, counts, flags = good()
+    with pytest.raises(ValueError, match="dtype"):
+        enc.encode_batch_into(bodies, codes.astype(np.int64), extras, counts, flags)
+    codes, extras, counts, flags = good()
+    with pytest.raises(ValueError, match="contiguous"):
+        enc.encode_batch_into(
+            bodies, np.zeros((8, enc.n_slots * 2), np.int32)[:, ::2],
+            extras, counts, flags,
+        )
+    codes, extras, counts, flags = good()
+    with pytest.raises(ValueError, match="rows"):
+        enc.encode_batch_into(bodies, codes[:4].copy(), extras, counts, flags)
+
+
+def test_encode_adm_batch_into_parity():
+    """Admission twin: uids + buffers bit-identical to encode_adm_batch."""
+    engine, fast = _mk()
+    enc = fast._current_snapshot().encoder
+    review = json.dumps(
+        {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "uid-1",
+                "operation": "CREATE",
+                "userInfo": {"username": "sam", "uid": "u"},
+                "kind": {"group": "", "version": "v1", "kind": "ConfigMap"},
+                "resource": {"group": "", "version": "v1", "resource": "configmaps"},
+                "namespace": "default",
+                "object": {
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {"name": "cm", "namespace": "default"},
+                },
+            },
+        }
+    ).encode()
+    bodies = [review] * 5 + [b"{bad"]
+    ref = enc.encode_adm_batch(bodies)
+    B = 8
+    codes = np.full((B, enc.n_slots), -7, np.int32)
+    extras = np.full((B, enc.DEFAULT_EXTRAS_CAP), -7, np.int32)
+    counts = np.empty((6,), np.int32)
+    flags = np.empty((6,), np.uint8)
+    uids = enc.encode_adm_batch_into(bodies, codes, extras, counts, flags)
+    assert uids == ref[4]
+    assert (codes[:6] == ref[0]).all()
+    assert (extras[:6] == ref[1]).all()
+    assert (counts == ref[2]).all()
+    assert (flags == ref[3]).all()
+
+
+# ------------------------------------------------- encode-thread resolution
+
+
+def test_encode_threads_reset_hook(monkeypatch):
+    from cedar_tpu import native
+
+    try:
+        monkeypatch.setenv("CEDAR_NATIVE_THREADS", "definitely-not-a-number")
+        native.reset_encode_threads()
+        auto = native._default_encode_threads()  # malformed -> auto
+        assert auto >= 1
+        # a corrected env var alone is NOT seen (cached)...
+        monkeypatch.setenv("CEDAR_NATIVE_THREADS", "3")
+        assert native._default_encode_threads() == auto
+        # ...until the reset hook invalidates the cache
+        native.reset_encode_threads()
+        assert native._default_encode_threads() == 3
+        # the explicit override (the --native-encode-threads flag) wins
+        native.set_encode_threads(5)
+        assert native._default_encode_threads() == 5
+        # and clears back to env resolution
+        native.set_encode_threads(None)
+        assert native._default_encode_threads() == 3
+    finally:
+        monkeypatch.delenv("CEDAR_NATIVE_THREADS", raising=False)
+        native.reset_encode_threads()
+
+
+# ------------------------------------------------------ packed-word decode
+
+
+def test_packed_decode_differential(monkeypatch):
+    """The batch-wide packed word transfer must be answer-invisible:
+    identical results with CEDAR_TPU_PACKED_DECODE on and off, across a
+    multi-chunk batch containing clean, flagged (multi), forbid,
+    no-opinion, and parse-error rows."""
+    engine, fast = _mk()
+    bodies = _bodies(40)
+    ref = fast.authorize_raw(bodies)  # default config (packed, one chunk)
+
+    # shrink the chunk plan so the batch spans several chunks and force
+    # the throughput plane (no in-call bits) so the packer engages
+    fast._CHUNK = 8
+    fast._TAIL_CHUNK = 4
+    fast._BITS_INCALL_MAX = 0
+    packed = fast.authorize_raw(bodies)
+    monkeypatch.setenv("CEDAR_TPU_PACKED_DECODE", "0")
+    unpacked = fast.authorize_raw(bodies)
+    assert packed == ref
+    assert unpacked == ref
+
+
+def test_packed_decode_non_bucket_sizes():
+    """Staged (bucket-padded) launches at off-bucket row counts: padding
+    rows must never leak into answers."""
+    engine, fast = _mk()
+    for n in (1, 3, 13, 40):
+        bodies = _bodies(n)
+        got = fast.authorize_raw(bodies)
+        want = [fast._python_fallback(b) for b in bodies]
+        assert [g[0] for g in got] == [w[0] for w in want]
+        assert [g[1] for g in got] == [w[1] for w in want]
+
+
+def test_word_packer_single_and_multi_part():
+    from cedar_tpu.engine.evaluator import _WordPacker
+
+    p = _WordPacker()
+    a = np.arange(4, dtype=np.uint32)
+    b = np.arange(10, 16, dtype=np.uint32)
+    ia = p.add(a)
+    ib = p.add(b)
+    p.flush()
+    assert (p.view(ia, 3) == a[:3]).all()
+    assert (p.view(ib, 6) == b).all()
+    with pytest.raises(RuntimeError):
+        p.add(a)  # late add after flush is a bug, not a silent drop
+    # single part: flush is a pass-through, view defensively flushes
+    q = _WordPacker()
+    i = q.add(a)
+    assert (q.view(i, 4) == a).all()
+
+
+# ----------------------------------------------- staging-buffer lifetime
+
+
+def test_staging_abandoned_on_dispatch_error(monkeypatch):
+    """Satellite 3: a dispatch exception between acquire and finish() must
+    ABANDON the held staging buffers — they can never re-enter the pool,
+    where a later batch could overwrite rows a (possibly still in-flight)
+    donated transfer is reading."""
+    engine, fast = _mk()
+    staging = engine._staging
+    acquired = []
+    orig_acquire = staging.acquire
+
+    def tracking_acquire(shape, dtype):
+        a = orig_acquire(shape, dtype)
+        acquired.append(id(a))
+        return a
+
+    monkeypatch.setattr(staging, "acquire", tracking_acquire)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected dispatch failure")
+
+    monkeypatch.setattr(engine, "match_arrays_launch", boom)
+    # all rows valid: the staged buffers stay HELD through the dispatch
+    # (mixed batches with encoder-fallback rows compact-copy and release
+    # early — a different, device-free path)
+    bodies = [_sar(f"user-{i}", "get", "pods") for i in range(20)]
+    res = fast.authorize_raw(bodies)  # degrades to the interpreter path
+    assert len(res) == 20 and all(r[0] for r in res)
+    assert acquired, "the staged encode should have acquired buffers"
+    leaked_back = set(acquired) & _pool_ids(staging)
+    assert not leaked_back, (
+        "staging buffers from a failed dispatch re-entered the pool: "
+        f"{leaked_back}"
+    )
+
+
+def test_staging_abandoned_on_chaos_dispatch_kill():
+    """The same invariant through the chaos plane's device seam
+    (docs/resilience.md): an armed engine.dispatch error must not let the
+    failed batch's staging buffers be handed to a later batch."""
+    from cedar_tpu.chaos.registry import default_registry
+
+    engine, fast = _mk()
+    staging = engine._staging
+    acquired = []
+    orig_acquire = staging.acquire
+    staging.acquire = lambda shape, dtype: (
+        lambda a: (acquired.append(id(a)), a)[1]
+    )(orig_acquire(shape, dtype))
+    reg = default_registry()
+    try:
+        reg.configure(
+            {
+                "name": "staging-lifetime",
+                "faults": [
+                    {"seam": "engine.dispatch", "kind": "error", "count": 1}
+                ],
+            }
+        )
+        reg.arm()
+        res = fast.authorize_raw(
+            [_sar(f"user-{i}", "get", "pods") for i in range(20)]
+        )
+        assert len(res) == 20
+    finally:
+        reg.reset()
+        staging.acquire = orig_acquire
+    assert not (set(acquired) & _pool_ids(staging))
+
+
+def test_staging_release_waits_for_materialization(monkeypatch):
+    """Held buffers return to the pool only after every chunk's device
+    readback has materialized — never while a launch is still pending."""
+    engine, fast = _mk()
+    staging = engine._staging
+    state = {"pending": 0}
+    tracked = set()
+    orig_launch = engine.match_arrays_launch
+
+    def launch(codes, extras, **kw):
+        tracked.add(id(codes))
+        state["pending"] += 1
+        fin = orig_launch(codes, extras, **kw)
+
+        def wrapped(*a, **k):
+            out = fin(*a, **k)
+            state["pending"] -= 1
+            return out
+
+        return wrapped
+
+    monkeypatch.setattr(engine, "match_arrays_launch", launch)
+    orig_release = staging.release
+
+    def release(*arrays):
+        if any(id(a) in tracked for a in arrays):
+            assert state["pending"] == 0, (
+                "staging buffer released while a launch was still pending"
+            )
+        orig_release(*arrays)
+
+    monkeypatch.setattr(staging, "release", release)
+    fast._CHUNK = 8
+    fast._TAIL_CHUNK = 4
+    res = fast.authorize_raw(_bodies(30))
+    assert len(res) == 30
+    assert tracked, "staged codes buffers should have reached the launch"
+
+
+def test_staging_reused_across_clean_batches():
+    """The steady-state serving loop allocates nothing: batch 2 encodes
+    into exactly the buffers batch 1 returned."""
+    engine, fast = _mk()
+    staging = engine._staging
+    bodies = [_sar("sam", "get", "pods") for _ in range(16)]
+    fast.authorize_raw(bodies)
+    free1 = _pool_ids(staging)
+    assert free1, "clean batch must hand its staging buffers back"
+    fast.authorize_raw(bodies)
+    assert _pool_ids(staging) == free1

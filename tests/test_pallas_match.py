@@ -60,6 +60,72 @@ def test_pallas_first_match_parity(B, L, R, G):
     assert (np.asarray(ref) == np.asarray(out)).all()
 
 
+@pytest.mark.parametrize(
+    "B,L,R,T,gate",
+    [
+        (256, 128, 512, 1, False),
+        (256, 256, 1024, 2, False),  # multi-R-tile, two tiers
+        (256, 128, 512, 2, True),  # gate group rides the word's bit 27
+        (512, 128, 512, 3, False),  # multi-B-tile, three tiers
+    ],
+)
+def test_pallas_words_parity(B, L, R, T, gate):
+    """The fused slot-match + clause-reduce + tier-walk kernel
+    (pallas_match_words) must emit the EXACT packed verdict words of the
+    lax plane — code, policy index, err/multi flags, and the gate bit —
+    for random rule sets that exercise multi-match and error groups."""
+    from cedar_tpu.ops.match import _tier_walk
+    from cedar_tpu.ops.pallas_match import pallas_match_words
+
+    n_groups = T * 3 + (1 if gate else 0)
+    rng = np.random.default_rng(B + L + R + T)
+    # SPARSE rules (1-2 positive literals, occasional negation) so the
+    # random stream actually matches: the dense _random_ruleset needs
+    # ~L/5 specific literals active at once and would make this parity
+    # trivially all-no-match
+    W = np.zeros((L, R), np.float32)
+    for r in range(R):
+        pos = rng.choice(L, size=int(rng.integers(1, 3)), replace=False)
+        W[pos, r] = 1.0
+        if rng.random() < 0.3:
+            W[int(rng.integers(0, L)), r] = -1.0
+    thresh = np.maximum((W > 0).sum(0), 1).astype(np.float32)
+    group = rng.integers(0, n_groups, size=R).astype(np.int32)
+    policy = rng.integers(0, 10000, size=R).astype(np.int32)
+    active = rng.integers(0, L + 1, size=(B, 16)).astype(np.int32)
+    lit = _lit_matrix(jnp.asarray(active), L)
+
+    W3, t3, g3, p3 = chunk_rules(W, thresh, group, policy)
+    ref_first, ref_last, _ = _first_match(
+        lit,
+        jnp.asarray(W3, jnp.bfloat16),
+        jnp.asarray(t3),
+        jnp.asarray(g3),
+        jnp.asarray(p3),
+        n_groups,
+    )
+    ref = _tier_walk(ref_first, ref_last, T)
+    if gate:
+        INT32_MAX = 2**31 - 1
+        gate_bit = (ref_first[:, T * 3] != INT32_MAX).astype(jnp.uint32)
+        ref = ref | (gate_bit << 27)
+    out = pallas_match_words(
+        lit,
+        jnp.asarray(W, jnp.bfloat16),
+        jnp.asarray(thresh)[None, :],
+        jnp.asarray(group)[None, :],
+        jnp.asarray(policy)[None, :],
+        T,
+        gate,
+        interpret=True,
+    )
+    assert (np.asarray(ref) == np.asarray(out)).all()
+    # the random sets must actually exercise the flag planes, or the
+    # parity above proves less than it claims
+    w = np.asarray(ref).astype(np.uint32)
+    assert ((w >> 28) & 1).any() or ((w >> 29) & 1).any()
+
+
 def test_pallas_supported_shapes():
     assert pallas_supported(512, 1024, 10240)
     assert pallas_supported(8, 128, 512)
@@ -112,6 +178,55 @@ def test_engine_pallas_backend_matches_xla():
     for (d1, g1), (d2, g2) in zip(xla_res, pl_res):
         assert d1 == d2
         assert [r.policy for r in g1.reasons] == [r.policy for r in g2.reasons]
+
+
+def test_pallas_engine_keeps_incall_bits_plane():
+    """want_bits launches on a pallas engine must still return the
+    in-call compaction payload: the pallas kernel has no bits plane, so
+    those launches ride the (byte-identical) lax path — otherwise a
+    flagged row in the latency regime pays a second serial device round
+    trip that the in-call plane exists to avoid."""
+    # two permits overlap on (sam, pods): multi bit -> flagged row
+    src = """
+permit (principal is k8s::User, action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { principal.name == "sam" && resource.resource == "pods" };
+permit (principal, action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { resource.resource == "pods" };
+"""
+    tiers = [PolicySet.from_source(src, "bits")]
+    from cedar_tpu.compiler.table import encode_request_codes
+    from cedar_tpu.entities.attributes import Attributes, UserInfo
+    from cedar_tpu.server.authorizer import record_to_cedar_resource
+
+    engine = TPUPolicyEngine(use_pallas=True)
+    engine.load(tiers, warm="off")
+    cs = engine._compiled
+    assert cs.pallas_args is not None
+    packed = cs.packed
+    em, req = record_to_cedar_resource(
+        Attributes(
+            user=UserInfo(name="sam", uid="u"),
+            verb="get",
+            resource="pods",
+            api_version="v1",
+            resource_request=True,
+        )
+    )
+    enc = [encode_request_codes(packed.plan, packed.table, em, req)] * 8
+    codes, extras = engine._encode_batch_arrays(cs, enc, 8)
+    out = engine.match_arrays(codes, extras, cs=cs, want_bits=True)
+    assert len(out) == 3
+    words, _full, bitmap = out
+    from cedar_tpu.ops.match import WORD_MULTI
+
+    flagged = np.nonzero(
+        (np.asarray(words).astype(np.uint32) & WORD_MULTI) != 0
+    )[0]
+    assert flagged.size, "the overlapping permits should flag multi rows"
+    for k in flagged.tolist():
+        assert k in bitmap, "in-call bits payload missing for flagged row"
 
 
 @pytest.mark.parametrize("B,L,R,G", [(256, 128, 512, 3), (256, 256, 1024, 6)])
