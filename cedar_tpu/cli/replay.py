@@ -58,18 +58,14 @@ def _replay_local(recordings, config_path: str):
     from ..server.authorizer import CedarWebhookAuthorizer
     from ..server.http import get_authorizer_attributes
     from ..entities.admission import AdmissionRequest
-    from ..stores.config import cedar_config_stores, parse_config
+    from ..stores.config import load_config_stores
     from ..stores.store import TieredPolicyStores
 
-    with open(config_path) as f:
-        config = parse_config(f.read())
-    stores = cedar_config_stores(config)
-    deadline = time.time() + 30
-    while not all(s.initial_policy_load_complete() for s in stores):
-        if time.time() > deadline:
-            print("stores not ready after 30s", file=sys.stderr)
-            return 1
-        time.sleep(0.2)
+    try:
+        stores = load_config_stores(config_path)
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
+        return 1
     authorizer = CedarWebhookAuthorizer(stores)
     admission = CedarAdmissionHandler(
         TieredPolicyStores(

@@ -34,24 +34,15 @@ from ..rollout.report import (
 def _build_live(config_path: str):
     """(authorizer, admission handler) over the live StoreConfig —
     interpreter oracle, waiting for initial store loads like cedar-replay."""
-    import time
-
     from ..server.admission import (
         CedarAdmissionHandler,
         allow_all_admission_policy_store,
     )
     from ..server.authorizer import CedarWebhookAuthorizer
-    from ..stores.config import cedar_config_stores, parse_config
+    from ..stores.config import load_config_stores
     from ..stores.store import TieredPolicyStores
 
-    with open(config_path) as f:
-        config = parse_config(f.read())
-    stores = cedar_config_stores(config)
-    deadline = time.time() + 30
-    while not all(s.initial_policy_load_complete() for s in stores):
-        if time.time() > deadline:
-            raise RuntimeError("live stores not ready after 30s")
-        time.sleep(0.2)
+    stores = load_config_stores(config_path)
     authorizer = CedarWebhookAuthorizer(stores)
     admission = CedarAdmissionHandler(
         TieredPolicyStores(
@@ -103,16 +94,47 @@ def _load_recordings(paths) -> List[tuple]:
     return out
 
 
+def _offline_attributor(live, candidate):
+    """Interpreter-plane DiffAttributor over the offline stacks so the
+    CLI report carries the same determining-policy attribution the live
+    shadow exemplars do (policy-level — no compiled pack offline)."""
+    from types import SimpleNamespace
+
+    from ..explain import DiffAttributor
+
+    live_authorizer, live_admission = live
+    cand_authorizer, cand_admission = candidate
+    cand_ns = SimpleNamespace(
+        authz_engine=None,
+        admission_engine=None,
+        tiers=[s.policy_set() for s in cand_authorizer.stores],
+        admission_handler=cand_admission,
+    )
+    return DiffAttributor(
+        candidate=cand_ns,
+        live_authz_tiers=[s.policy_set() for s in live_authorizer.stores],
+        live_admission_tiers=[
+            s.policy_set() for s in live_admission.stores
+        ],
+    )
+
+
 def diff_recordings(recordings, live, candidate, exemplar_cap: int = 64):
     """Replay every recording through both stacks and accumulate the diff
     report — the offline twin of rollout/shadow.py's comparison, sharing
     its classify/record/fingerprint implementation
-    (rollout/report.compare_*) so the two reports cannot drift."""
+    (rollout/report.compare_*) so the two reports cannot drift. Diff
+    exemplars carry the same live-vs-candidate attribution the live
+    shadow report records."""
     from ..entities.admission import AdmissionRequest
     from ..server.http import get_authorizer_attributes
 
     live_authorizer, live_admission = live
     cand_authorizer, cand_admission = candidate
+    try:
+        attributor = _offline_attributor(live, candidate)
+    except Exception:  # noqa: BLE001 — attribution is best-effort
+        attributor = None
     report = DiffReport(exemplar_cap=exemplar_cap)
     for _name, endpoint, body in recordings:
         if endpoint == "authorize":
@@ -126,6 +148,7 @@ def diff_recordings(recordings, live, candidate, exemplar_cap: int = 64):
                 attributes,
                 live_authorizer.authorize(attributes),
                 cand_authorizer.authorize(attributes),
+                attributor=attributor,
             )
         else:
             try:
@@ -140,6 +163,7 @@ def diff_recordings(recordings, live, candidate, exemplar_cap: int = 64):
                 req,
                 (live_resp.allowed, live_resp.message or ""),
                 (cand_resp.allowed, cand_resp.message or ""),
+                attributor=attributor,
             )
     return report
 

@@ -83,6 +83,25 @@ class PolicyMeta:
     effect: str
 
 
+@dataclass(frozen=True)
+class RuleClause:
+    """Back-map entry for ONE packed rule column: which policy's clause it
+    lowered from — the explain plane's IR attribution record
+    (cedar_tpu/explain). ``kind`` is "match" (a policy condition clause),
+    "error" (an error-detection clause), or "gate" (a fallback/opaque
+    scope gate rule — no owning clause). ``ordinal`` is the clause's index
+    within the owning policy's clauses (or error_clauses) list, and
+    ``clause`` the IR Clause itself (a tuple of ClauseLit), so the host
+    can render the exact attribute tests a winning rule asserted without
+    re-lowering anything."""
+
+    pm_idx: int  # index into policy_meta; -1 for gate rules
+    group: int
+    kind: str  # "match" | "error" | "gate"
+    ordinal: int
+    clause: object  # ir.Clause, or None for gate rules
+
+
 @dataclass
 class EncodePlan:
     """Inverted indices the host encoder uses to map one request to its
@@ -134,6 +153,12 @@ class PackedPolicySet:
     policy_meta: List[PolicyMeta]
     fallback: list  # List[FallbackPolicy]
     table: object = None  # compiler.table.FeatureTable
+    # per-rule IR back-map (RuleClause, parallel to the first n_rules
+    # columns): the explain plane maps a winning rule index back to its
+    # policy, clause ordinal, and literal tests here. Pure host memory —
+    # references into the already-retained lowered IR, so it costs a few
+    # pointers per rule and survives device loss with the rest of the pack
+    rule_clause: List["RuleClause"] = field(default_factory=list)
     # True when gate rules were packed (group n_tiers * 3)
     has_gate: bool = False
     # lowered policies whose hard literals the NATIVE encoder cannot
@@ -165,7 +190,10 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
     from .dyn import dyn_spec
 
     reg = _LitRegistry()
-    rules: List[Tuple[List[Tuple[int, bool]], int, int]] = []  # (lits, group, pmeta)
+    # (lits, group, pmeta, RuleClause) — the trailing back-map entry rides
+    # the rule through the (group, policy) sort so rule_clause[r] always
+    # describes column r
+    rules: List[Tuple[List[Tuple[int, bool]], int, int, RuleClause]] = []
     policy_meta: List[PolicyMeta] = []
     opaque: List[Policy] = []  # lowered policies the NATIVE encoder can't eval
     _dyn_ok: Dict[int, bool] = {}  # id(expr) -> expr is in the dyn class
@@ -190,13 +218,19 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
         )
         effect_idx = FORBID_IDX if lp.effect == "forbid" else PERMIT_IDX
         group = lp.tier * GROUPS_PER_TIER + effect_idx
-        for clause in lp.clauses:
+        for ci, clause in enumerate(lp.clauses):
             lits = [(reg.intern(cl.lit), cl.negated) for cl in clause]
-            rules.append((lits, group, pm_idx))
+            rules.append(
+                (lits, group, pm_idx,
+                 RuleClause(pm_idx, group, "match", ci, clause))
+            )
         err_group = lp.tier * GROUPS_PER_TIER + ERROR_IDX
-        for clause in lp.error_clauses:
+        for ci, clause in enumerate(lp.error_clauses):
             lits = [(reg.intern(cl.lit), cl.negated) for cl in clause]
-            rules.append((lits, err_group, pm_idx))
+            rules.append(
+                (lits, err_group, pm_idx,
+                 RuleClause(pm_idx, err_group, "error", ci, clause))
+            )
         if _native_opaque(lp):
             opaque.append(p)
 
@@ -211,10 +245,15 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
         from .lower import scope_literals
 
         gate_group = compiled.n_tiers * GROUPS_PER_TIER
-        for gp in [fp.policy for fp in compiled.fallback] + opaque:
+        for gi, gp in enumerate(
+            [fp.policy for fp in compiled.fallback] + opaque
+        ):
             gate_lits, _ = scope_literals(gp)
             lits = [(reg.intern(cl.lit), cl.negated) for cl in gate_lits]
-            rules.append((lits, gate_group, GATE_RULE_POLICY))
+            rules.append(
+                (lits, gate_group, GATE_RULE_POLICY,
+                 RuleClause(-1, gate_group, "gate", gi, None))
+            )
         has_gate = True
 
     # group-contiguous rule layout: sorting by (group, policy) lets the
@@ -236,7 +275,7 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
     rule_group = np.zeros((R,), dtype=np.int32)
     rule_policy = np.full((R,), np.iinfo(np.int32).max, dtype=np.int32)
 
-    for r, (lits, group, pm_idx) in enumerate(rules):
+    for r, (lits, group, pm_idx, _rc) in enumerate(rules):
         npos = 0
         seen_sign: dict = {}
         for lit_id, negated in lits:
@@ -291,6 +330,7 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
         plan=plan,
         policy_meta=policy_meta,
         fallback=list(compiled.fallback),
+        rule_clause=[rc for _lits, _g, _pm, rc in rules],
         has_gate=has_gate,
         native_opaque=len(opaque),
     )

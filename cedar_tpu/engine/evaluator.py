@@ -1514,7 +1514,13 @@ class TPUPolicyEngine:
         """Host tier walk over COMPLETE per-group policy sets (from
         _bits_groups), merged with interpreter-fallback verdicts when
         entities/request are given. Mirrors PolicySet.is_authorized +
-        TieredPolicyStores semantics with full reason lists."""
+        TieredPolicyStores semantics with full reason lists.
+
+        TWIN: cedar_tpu/explain/attribution.py build_explanation walks
+        the same tiers (same ordering, same error-string format) to
+        produce attributed explanations — a semantic change here must be
+        mirrored there, or ?explain answers drift from served answers
+        (tests/test_explain.py's differential pins the covered cases)."""
         T = packed.n_tiers
         fb_allow: List[List[Reason]] = [[] for _ in range(T)]
         fb_deny: List[List[Reason]] = [[] for _ in range(T)]

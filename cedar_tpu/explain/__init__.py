@@ -1,0 +1,45 @@
+"""Decision explainability plane (docs/explainability.md).
+
+``?explain=1`` on /v1/authorize and /v1/admit, the ``cedar-why`` replay
+CLI, and rollout diff attribution all answer through this package: the
+compiled clause IR's per-rule back-map (``compiler.pack
+PackedPolicySet.rule_clause``) turns winning rule indices into the
+determining policy, its clause, and the matched attribute tests with
+source spans. Strictly pay-for-use — importing the serving stack never
+imports this package; the device explain shapes compile on first use per
+(engine, compiled set).
+"""
+
+from .attribution import (
+    SOURCE_DEVICE,
+    SOURCE_GATE,
+    SOURCE_HOST,
+    SOURCE_INTERPRETER,
+    attribution_summary,
+    build_explanation,
+    clause_tests,
+    host_sat,
+    interpreter_explanation,
+    literal_test,
+    sat_from_bits,
+)
+from .explainer import DiffAttributor, Explainer, engine_of
+from .plane import ExplainPlane
+
+__all__ = [
+    "SOURCE_DEVICE",
+    "SOURCE_GATE",
+    "SOURCE_HOST",
+    "SOURCE_INTERPRETER",
+    "DiffAttributor",
+    "ExplainPlane",
+    "Explainer",
+    "attribution_summary",
+    "build_explanation",
+    "clause_tests",
+    "engine_of",
+    "host_sat",
+    "interpreter_explanation",
+    "literal_test",
+    "sat_from_bits",
+]

@@ -247,6 +247,7 @@ class RolloutController:
                 ),
                 queue_depth=self.queue_depth,
                 duty_cycle=self.duty_cycle,
+                attributor=self._build_attributor(cand),
             )
             self._state = STATE_STAGED
             self._bump_generation_locked()
@@ -353,6 +354,23 @@ class RolloutController:
             evaluate=adm_evaluate,
             evaluate_batch=adm_evaluate_batch,
         )
+
+    def _build_attributor(self, cand: _Candidate):
+        """The explain-plane DiffAttributor for this candidate: on a
+        shadow diff the exemplar gains live-vs-candidate
+        determining-policy attribution (docs/explainability.md). Built
+        best-effort — an attributor failure must never gate staging."""
+        try:
+            from ..explain import DiffAttributor
+
+            return DiffAttributor(
+                live_authz_engine=self.authz_engine,
+                live_admission_engine=self.admission_engine,
+                candidate=cand,
+            )
+        except Exception:  # noqa: BLE001 — attribution is optional
+            log.exception("diff attributor construction failed")
+            return None
 
     def _start_warm(self, cand: _Candidate, warm: str) -> None:
         engines = [

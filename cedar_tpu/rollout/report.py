@@ -72,6 +72,7 @@ def compare_authorization(
     live,
     cand,
     publish_metrics: bool = False,
+    attributor=None,
 ) -> Optional[str]:
     """Classify one (live, candidate) authorization result pair —
     (decision, reason) tuples — and record it into the report. The ONE
@@ -79,7 +80,14 @@ def compare_authorization(
     (rollout/shadow.py) and the offline cedar-shadow CLI, so their
     reports can never drift. publish_metrics additionally feeds the
     cedar_shadow_* counters (live serving only — offline replay must not
-    touch process metrics)."""
+    touch process metrics).
+
+    ``attributor`` (cedar_tpu/explain DiffAttributor) is invoked ONLY on
+    a diff: its {"live": ..., "candidate": ...} determining-policy
+    summaries ride the exemplar so the report says WHY the decision
+    flipped, not just that it did. Matching pairs never pay the
+    attribution cost, and a raising attributor degrades to an
+    attribution-less exemplar."""
     mod = None
     if publish_metrics:
         from ..server import metrics as mod
@@ -91,12 +99,23 @@ def compare_authorization(
         return None
     from ..cache.fingerprint import fingerprint_attributes
 
+    attribution = None
+    if attributor is not None:
+        try:
+            attribution = attributor.authorization(attributes)
+        except Exception:  # noqa: BLE001 — attribution is best-effort
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "authorization diff attribution failed"
+            )
     report.record_diff(
         "authorization",
         kind,
         fingerprint_attributes(attributes),
         {"decision": live[0], "reason": live[1]},
         {"decision": cand[0], "reason": cand[1]},
+        attribution=attribution,
     )
     if mod is not None:
         mod.record_shadow_diff(kind)
@@ -109,6 +128,7 @@ def compare_admission(
     live,
     cand,
     publish_metrics: bool = False,
+    attributor=None,
 ) -> Optional[str]:
     """Admission twin of compare_authorization; live/cand are
     (allowed: bool, message: str) pairs and req is the parsed
@@ -129,12 +149,23 @@ def compare_admission(
         return None
     from ..cache.fingerprint import fingerprint_admission_request
 
+    attribution = None
+    if attributor is not None:
+        try:
+            attribution = attributor.admission(req)
+        except Exception:  # noqa: BLE001 — attribution is best-effort
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "admission diff attribution failed"
+            )
     report.record_diff(
         "admission",
         kind,
         fingerprint_admission_request(req),
         {"allowed": live[0], "message": live[1]},
         {"allowed": cand[0], "message": cand[1]},
+        attribution=attribution,
     )
     if mod is not None:
         mod.record_shadow_diff(kind)
@@ -173,19 +204,25 @@ class DiffReport:
         fingerprint: str,
         live,
         candidate,
+        attribution=None,
     ) -> None:
+        """``attribution`` (optional): {"live": summary, "candidate":
+        summary} determining-policy attributions from the explain plane
+        (cedar_tpu/explain.attribution_summary) — WHY each side decided
+        what it did, joined into the exemplar."""
         with self._lock:
             self.evaluations[path] = self.evaluations.get(path, 0) + 1
             self.diffs[kind] = self.diffs.get(kind, 0) + 1
-            self._exemplars.append(
-                {
-                    "fingerprint": fingerprint,
-                    "path": path,
-                    "kind": kind,
-                    "live": live,
-                    "candidate": candidate,
-                }
-            )
+            exemplar = {
+                "fingerprint": fingerprint,
+                "path": path,
+                "kind": kind,
+                "live": live,
+                "candidate": candidate,
+            }
+            if attribution:
+                exemplar["attribution"] = attribution
+            self._exemplars.append(exemplar)
 
     def record_shed(self, path: str) -> None:
         with self._lock:
@@ -260,9 +297,29 @@ class DiffReport:
             )
         if d["candidate_errors"]:
             lines.append(f"# candidate errors: {d['candidate_errors']}")
+        def _why(s: Optional[dict]) -> str:
+            if not s:
+                return "?"
+            out = f"{s.get('effect') or '?'} {s.get('policyId') or '<none>'}"
+            if s.get("clause") is not None:
+                out += f"#clause{s['clause']}"
+            if s.get("tier") is not None:
+                out += f"@tier{s['tier']}"
+            if s.get("fallback"):
+                out += " (fallback)"
+            return out
+
         for e in d["exemplars"]:
             lines.append(
                 f"{e['fingerprint']}\t{e['path']}\t{e['kind']}\t"
                 f"live={e['live']}\tcandidate={e['candidate']}"
             )
+            attr = e.get("attribution")
+            if attr:
+                lines.append(
+                    "  why: live="
+                    + _why(attr.get("live"))
+                    + " -> candidate="
+                    + _why(attr.get("candidate"))
+                )
         return "\n".join(lines)

@@ -86,9 +86,15 @@ class ShadowEvaluator:
         batch_max: int = DEFAULT_BATCH_MAX,
         seed: Optional[int] = None,
         duty_cycle: float = DEFAULT_DUTY_CYCLE,
+        attributor=None,
     ):
         self.candidate = candidate
         self.report = report
+        # optional explain-plane DiffAttributor (cedar_tpu/explain): on a
+        # decision diff the exemplar gains live-vs-candidate
+        # determining-policy attribution. Host-plane only and invoked
+        # solely for diffing rows, so matching shadow traffic pays nothing
+        self.attributor = attributor
         self.sample_rate = max(0.0, min(1.0, float(sample_rate)))
         self.batch_max = max(1, int(batch_max))
         self.duty_cycle = max(0.01, min(1.0, float(duty_cycle)))
@@ -294,7 +300,12 @@ class ShadowEvaluator:
             return
         for (attributes, live), cand in zip(parsed, results):
             compare_authorization(
-                self.report, attributes, live, cand, publish_metrics=True
+                self.report,
+                attributes,
+                live,
+                cand,
+                publish_metrics=True,
+                attributor=self.attributor,
             )
 
     # ----------------------------------------------------------- admission
@@ -333,6 +344,7 @@ class ShadowEvaluator:
                 live,
                 (resp.allowed, resp.message or ""),
                 publish_metrics=True,
+                attributor=self.attributor,
             )
 
     @staticmethod

@@ -113,6 +113,16 @@ class CedarWebhookAuthorizer:
         """The pre-evaluation gates shared by authorize() and
         authorize_batch(): identity self-allows, system:* skips, and the
         store-readiness NoOpinion. None means the request must evaluate."""
+        labeled = self._short_circuit_labeled(attributes)
+        return None if labeled is None else labeled[:2]
+
+    def _short_circuit_labeled(
+        self, attributes: Attributes
+    ) -> Optional[Tuple[str, str, str]]:
+        """(decision, reason, gate label) — the same gates with a stable
+        label naming WHICH gate fired, classified at the gate itself so
+        the explain surface (cedar_tpu/explain) can never mislabel a
+        short-circuit it only saw the result of."""
         user_name = attributes.user.name
         if (
             user_name == CEDAR_AUTHORIZER_IDENTITY_NAME
@@ -123,6 +133,7 @@ class CedarWebhookAuthorizer:
             return (
                 DECISION_ALLOW,
                 "cedar authorizer is always allowed to access policies",
+                "authorizer-self-allow",
             )
         if (
             user_name == CEDAR_AUTHORIZER_IDENTITY_NAME
@@ -132,6 +143,7 @@ class CedarWebhookAuthorizer:
             return (
                 DECISION_ALLOW,
                 "cedar authorizer is always allowed to read RBAC policies",
+                "authorizer-self-allow",
             )
 
         # Skip system users (internal identities) except SAs and nodes
@@ -140,10 +152,10 @@ class CedarWebhookAuthorizer:
             and not user_name.startswith("system:serviceaccount:")
             and not user_name.startswith("system:node:")
         ):
-            return DECISION_NO_OPINION, ""
+            return DECISION_NO_OPINION, "", "system-user-skip"
 
         if not self.ready():
-            return DECISION_NO_OPINION, ""
+            return DECISION_NO_OPINION, "", "stores-not-ready"
         return None
 
     @staticmethod

@@ -365,6 +365,13 @@ class WebhookServer:
         # --confirm-non-prod-inject-errors gate the reference injector
         # uses; GET /debug/chaos stays readable.
         self.chaos_control_enabled = chaos_control_enabled
+        # ?explain=1 support (cedar_tpu/explain, docs/explainability.md):
+        # the Explainer is built LAZILY on the first explain request — the
+        # package is never imported, and no explain kernel shape compiles,
+        # until an operator actually asks (strict pay-for-use; the
+        # non-explain serving path is untouched)
+        self._explainer = None
+        self._explainer_lock = threading.Lock()
         self.drain_grace_s = drain_grace_s
         self._draining = False
         self._inflight = 0
@@ -400,7 +407,94 @@ class WebhookServer:
             return False
         return self.warm_ready()
 
-    def handle_authorize(self, body: bytes) -> dict:
+    def _get_explainer(self):
+        """Build the Explainer on first use (lazy: no explain import or
+        compile cost until the first ?explain=1 request). Engines are
+        discovered from the wired fast paths (with their breakers, so an
+        open breaker routes explain to the host plane), the fleet's
+        template engine, or the authorizer/handler's bound evaluate
+        backend on fastpath-less stacks."""
+        exp = self._explainer
+        if exp is not None:
+            return exp
+        with self._explainer_lock:
+            if self._explainer is None:
+                from ..explain import Explainer, engine_of
+
+                authz_engine = authz_breaker = None
+                if self.fleet is not None:
+                    # the template engine IS replica 0's engine
+                    # (fleet.py), so its breaker must gate explain too:
+                    # an OPEN replica-0 breaker routes ?explain to the
+                    # host plane instead of launching device work on the
+                    # sick (possibly mid-rebuild) device
+                    authz_engine = getattr(
+                        self.fleet, "template_engine", None
+                    )
+                    replicas = getattr(self.fleet, "replicas", None)
+                    if replicas:
+                        authz_breaker = getattr(
+                            replicas[0], "breaker", None
+                        )
+                elif self.fastpath is not None:
+                    authz_engine = self.fastpath.engine
+                    authz_breaker = self.fastpath.breaker
+                elif self.authorizer is not None:
+                    authz_engine = engine_of(self.authorizer._evaluate)
+                adm_engine = adm_breaker = None
+                if self.admission_fastpath is not None:
+                    adm_engine = self.admission_fastpath.engine
+                    adm_breaker = self.admission_fastpath.breaker
+                elif self.admission_handler is not None:
+                    adm_engine = engine_of(self.admission_handler._evaluate)
+                self._explainer = Explainer(
+                    authorizer=self.authorizer,
+                    admission_handler=self.admission_handler,
+                    authz_engine=authz_engine,
+                    admission_engine=adm_engine,
+                    authz_breaker=authz_breaker,
+                    admission_breaker=adm_breaker,
+                )
+        return self._explainer
+
+    def _handle_authorize_explain(self, body: bytes) -> dict:
+        """?explain=1 on /v1/authorize: the decision plus the attribution
+        payload, bypassing the decision cache (never read, never
+        populated — cached entries carry no clause indices), the
+        batchers, the rollout shadow offer, and the error injector
+        (operator surface, not serving traffic)."""
+        start = time.monotonic()
+        request_id = str(uuid.uuid4())
+        decision, error = DECISION_NO_OPINION, None
+        try:
+            metrics.record_explain_request("authorization")
+            decision, reason, error, explanation = (
+                self._get_explainer().explain_authorize(body)
+            )
+            resp = sar_response(decision, reason, error)
+            resp["explanation"] = explanation
+            return resp
+        except Exception as e:  # noqa: BLE001 — always answer the operator
+            log.exception("explain authorize requestId=%s failed", request_id)
+            error = f"evaluation error: {e}"
+            return sar_response(DECISION_NO_OPINION, "", error)
+        finally:
+            # deliberately NOT recorded into the serving request
+            # counter/histogram: a first explain request pays lazy kernel
+            # compiles, and one multi-second sample under the serving
+            # labels would spike the p99 an SLO alert watches —
+            # cedar_explain_requests_total is the explain-traffic signal
+            label = "<error>" if error else _DECISION_LABEL[decision]
+            log.info(
+                "authorize(explain) requestId=%s decision=%s latency=%.6fs",
+                request_id,
+                label,
+                time.monotonic() - start,
+            )
+
+    def handle_authorize(self, body: bytes, explain: bool = False) -> dict:
+        if explain:
+            return self._handle_authorize_explain(body)
         start = time.monotonic()
         request_id = str(uuid.uuid4())
         decision, reason, error = DECISION_NO_OPINION, "", None
@@ -629,7 +723,26 @@ class WebhookServer:
             review = None
         return self._admission_fail_mode(review, e)
 
-    def handle_admit(self, body: bytes) -> dict:
+    def _handle_admit_explain(self, body: bytes) -> dict:
+        """?explain=1 on /v1/admit — the admission twin of
+        _handle_authorize_explain (same bypasses, same lazy plane)."""
+        try:
+            metrics.record_explain_request("admission")
+            response, explanation = self._get_explainer().explain_admit(body)
+            review = response.to_admission_review()
+            review["explanation"] = explanation
+            return review
+        except Exception as e:  # noqa: BLE001 — always answer the operator
+            log.exception("explain admit failed")
+            try:
+                review = json.loads(body)
+            except Exception:  # noqa: BLE001 — uid is best-effort here
+                review = None
+            return self._admission_fail_mode(review, e)
+
+    def handle_admit(self, body: bytes, explain: bool = False) -> dict:
+        if explain:
+            return self._handle_admit_explain(body)
         review = self._handle_admit(body)
         if self.rollout is not None and self._admission_shadowable():
             # non-blocking shadow offer; error/fail-mode responses are
@@ -732,6 +845,17 @@ class WebhookServer:
                 # atomic step: once stop() sets _draining and sees
                 # _inflight == 0 under this lock, no request can slip past
                 # the check and reach a batcher that stop() already joined
+                #
+                # ?explain=1 (docs/explainability.md) splits off the query
+                # string here; the bare-path requests the apiserver sends
+                # take exactly the code path they always did
+                path, _, query = self.path.partition("?")
+                explain = False
+                if query:
+                    from urllib.parse import parse_qs
+
+                    vals = parse_qs(query).get("explain")
+                    explain = bool(vals) and vals[-1] not in ("0", "false", "")
                 with server._inflight_cv:
                     draining = server._draining
                     if not draining:
@@ -741,7 +865,7 @@ class WebhookServer:
                     # steering away; requests that still race in are shed
                     # fast rather than answered by a server mid-teardown
                     metrics.record_shed(
-                        "admission" if self.path == "/v1/admit"
+                        "admission" if path == "/v1/admit"
                         else "authorization"
                     )
                     self.send_error(503, "server is draining")
@@ -761,11 +885,15 @@ class WebhookServer:
                         return
                     body = self.rfile.read(length) if length else b""
                     if server.recorder is not None:
-                        server.recorder.record(self.path, body)
-                    if self.path == "/v1/authorize":
-                        self._write_json(server.handle_authorize(body))
-                    elif self.path == "/v1/admit":
-                        self._write_json(server.handle_admit(body))
+                        server.recorder.record(path, body)
+                    if path == "/v1/authorize":
+                        self._write_json(
+                            server.handle_authorize(body, explain=explain)
+                        )
+                    elif path == "/v1/admit":
+                        self._write_json(
+                            server.handle_admit(body, explain=explain)
+                        )
                     else:
                         self.send_error(404)
                 finally:

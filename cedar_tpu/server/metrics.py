@@ -424,6 +424,31 @@ shadow_shed_total = REGISTRY.register(
     )
 )
 
+# Explainability plane (cedar_tpu/explain, docs/explainability.md):
+# ?explain=1 requests and the lazy explain-plane compiles they trigger.
+explain_requests_total = REGISTRY.register(
+    Counter(
+        "cedar_explain_requests_total",
+        "?explain=1 requests answered, partitioned by path (authorization "
+        "/ admission). Explain traffic bypasses the decision cache and "
+        "the batchers by design — a sustained high rate is an operator "
+        "debugging session, not serving load (docs/explainability.md).",
+        ["path"],
+    )
+)
+
+explain_compiles_total = REGISTRY.register(
+    Counter(
+        "cedar_explain_compiles_total",
+        "Fresh kernel traces paid by the lazily-compiled explain plane "
+        "(the standalone bits shape, on first ?explain use per compiled "
+        "set). Zero until the first explain request per (engine, "
+        "generation) — the pay-for-use contract; nonzero growth outside "
+        "policy reloads means explain traffic is hitting cold sets.",
+        [],
+    )
+)
+
 rollout_generation = REGISTRY.register(
     Gauge(
         "cedar_rollout_generation",
@@ -692,6 +717,15 @@ def record_shadow_diff(kind: str) -> None:
 
 def record_shadow_shed(path: str) -> None:
     shadow_shed_total.inc(path=path)
+
+
+def record_explain_request(path: str) -> None:
+    explain_requests_total.inc(path=path)
+
+
+def record_explain_compiles(n: int) -> None:
+    if n:
+        explain_compiles_total.inc(n)
 
 
 def set_rollout_generation(generation: int) -> None:

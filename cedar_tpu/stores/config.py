@@ -69,3 +69,24 @@ def cedar_config_stores(
                 )
             )
     return TieredPolicyStores(stores, validation_mode=config.validation_mode)
+
+
+def load_config_stores(
+    config_path: str, timeout_s: float = 30.0
+) -> TieredPolicyStores:
+    """Parse a StoreConfig file, build its tiered stores, and WAIT for
+    every store's initial policy load — the one shared open/parse/poll
+    helper behind the offline CLIs (cedar-replay, cedar-shadow,
+    cedar-why). Raises RuntimeError when the stores are not ready within
+    ``timeout_s``."""
+    import time
+
+    with open(config_path) as f:
+        config = parse_config(f.read())
+    stores = cedar_config_stores(config)
+    deadline = time.monotonic() + timeout_s
+    while not all(s.initial_policy_load_complete() for s in stores):
+        if time.monotonic() > deadline:
+            raise RuntimeError(f"stores not ready after {timeout_s:.0f}s")
+        time.sleep(0.2)
+    return stores

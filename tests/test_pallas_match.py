@@ -180,6 +180,69 @@ def test_engine_pallas_backend_matches_xla():
         assert [r.policy for r in g1.reasons] == [r.policy for r in g2.reasons]
 
 
+def test_pallas_engine_want_full_never_takes_words_kernel(monkeypatch):
+    """want_full launches (the explain plane's dispatch,
+    cedar_tpu/explain) on a pallas engine must ride the first/last-match
+    kernel, NEVER the fused words kernel: pallas_match_words emits only
+    packed verdict words — it has no (first, last) matrices to attribute
+    from, so routing an explain launch there would silently drop the
+    attribution payload. Pinned by poisoning the words kernel and
+    asserting full-matrix parity with the lax plane."""
+    src = """
+permit (principal is k8s::User, action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { principal.name == "sam" && resource.resource == "pods" };
+forbid (principal is k8s::User, action == k8s::Action::"get",
+        resource is k8s::Resource)
+  when { resource.resource == "secrets" };
+"""
+    tiers = [PolicySet.from_source(src, "wantfull")]
+    from cedar_tpu.compiler.table import encode_request_codes
+    from cedar_tpu.entities.attributes import Attributes, UserInfo
+    from cedar_tpu.server.authorizer import record_to_cedar_resource
+    import cedar_tpu.ops.pallas_match as pallas_mod
+
+    pl_engine = TPUPolicyEngine(use_pallas=True)
+    pl_engine.load(tiers, warm="off")
+    xla_engine = TPUPolicyEngine(use_pallas=False)
+    xla_engine.load(tiers, warm="off")
+    cs = pl_engine._compiled
+    assert cs.pallas_args is not None
+    packed = cs.packed
+
+    def poisoned_words(*_a, **_k):
+        raise AssertionError(
+            "fused pallas words kernel must never serve a want_full/"
+            "explain launch"
+        )
+
+    monkeypatch.setattr(pallas_mod, "pallas_match_words", poisoned_words)
+
+    em, req = record_to_cedar_resource(
+        Attributes(
+            user=UserInfo(name="sam", uid="u"),
+            verb="get",
+            resource="pods",
+            api_version="v1",
+            resource_request=True,
+        )
+    )
+    enc = [encode_request_codes(packed.plan, packed.table, em, req)] * 4
+    codes, extras = pl_engine._encode_batch_arrays(cs, enc, 4)
+    words_p, full_p = pl_engine.match_arrays(
+        codes, extras, cs=cs, want_full=True
+    )
+    xcs = xla_engine._compiled
+    codes_x, extras_x = xla_engine._encode_batch_arrays(xcs, enc, 4)
+    words_x, full_x = xla_engine.match_arrays(
+        codes_x, extras_x, cs=xcs, want_full=True
+    )
+    assert (np.asarray(words_p) == np.asarray(words_x)).all()
+    assert full_p is not None and full_x is not None
+    assert (np.asarray(full_p[0]) == np.asarray(full_x[0])).all()
+    assert (np.asarray(full_p[1]) == np.asarray(full_x[1])).all()
+
+
 def test_pallas_engine_keeps_incall_bits_plane():
     """want_bits launches on a pallas engine must still return the
     in-call compaction payload: the pallas kernel has no bits plane, so
