@@ -74,6 +74,10 @@ bench-fleet: ## Engine-fleet scaling: decisions/sec + lone p99 at 1/2/4 replicas
 bench-explain: ## Explain-plane pay-for-use: explain-off p99/throughput parity gate, explain-on cost + lazy compiles (cpu; docs/explainability.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --explain
 
+.PHONY: bench-trace
+bench-trace: ## Observability-plane pay-for-use: unsampled-tracing parity gate + byte differential, 100%-sampled cost (cpu; docs/observability.md)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --trace
+
 .PHONY: hw-validate
 hw-validate: ## Measure kernel planes (int8/bf16/pallas/segred) on the attached device
 	$(PYTHON) tools/hw_validate.py
@@ -92,7 +96,7 @@ graft-check: ## Compile-check the jittable entry + multi-chip dry run
 
 # scoped to the layers with the strongest invariants first; widen as
 # modules are annotated
-LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos cedar_tpu/fleet cedar_tpu/engine cedar_tpu/ops cedar_tpu/native cedar_tpu/explain
+LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos cedar_tpu/fleet cedar_tpu/engine cedar_tpu/ops cedar_tpu/native cedar_tpu/explain cedar_tpu/obs
 
 .PHONY: lint
 lint: ## ruff + mypy over $(LINT_SCOPE) (missing tools are skipped with a note)

@@ -2144,6 +2144,181 @@ def run_explain_scenario() -> int:
     return 0 if (p99_ok and tput_ok and parity_ok) else 1
 
 
+def run_trace_scenario() -> int:
+    """``bench.py --trace`` (``make bench-trace``): the observability
+    plane's pay-for-use proof. One engine-backed WebhookServer serves the
+    SAME SAR stream in three phases:
+
+      1. BASELINE — no tracer wired: lone-request p50/p99 + saturated
+         throughput of plain /v1/authorize traffic;
+      2. UNSAMPLED — tracer armed at sample rate 0 (+ SLO tracker): the
+         default production posture, with a per-response byte differential
+         against the baseline answers;
+      3. SAMPLED — sample rate 1.0: every request pays full span
+         bookkeeping; cost measured and reported, not gated.
+
+    The acceptance gate is unsampled parity: p99 within the explain
+    bench's 1.5x + 200µs tolerance of baseline and saturated throughput
+    delta <= 5% — arming tracing must cost the unsampled path nothing
+    measurable. cpu-only by design; rc 0 iff the gates hold."""
+    import statistics
+    import threading
+
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.obs import SLOTracker, Tracer
+    from cedar_tpu.server.admission import (
+        CedarAdmissionHandler,
+        allow_all_admission_policy_store,
+    )
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.server.http import WebhookServer
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    t0 = time.time()
+    n_policies = _n(1000, 120)
+    n_requests = _n(4000, 600)
+    DRIVERS = max(2, min(4, os.cpu_count() or 2))
+
+    ps, users, nss, resources, verbs, groups = build_policy_set(n_policies)
+    engine = TPUPolicyEngine(name="authorization")
+    engine.load([ps], warm="off")
+    store = MemoryStore("bench", ps)
+    stores = TieredPolicyStores([store])
+    authorizer = CedarWebhookAuthorizer(
+        stores,
+        evaluate=engine.evaluate,
+        evaluate_batch=engine.evaluate_batch,
+    )
+    handler = CedarAdmissionHandler(
+        TieredPolicyStores([store, allow_all_admission_policy_store()])
+    )
+    server = WebhookServer(authorizer, handler)
+
+    rng = random.Random(11)
+    stream = []
+    for _ in range(n_requests):
+        sar = {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": rng.choice(users[:32]),
+                "uid": "u",
+                "groups": [rng.choice(groups)],
+                "resourceAttributes": {
+                    "verb": rng.choice(verbs),
+                    "version": "v1",
+                    "resource": rng.choice(resources),
+                    "namespace": rng.choice(nss),
+                },
+            },
+        }
+        stream.append(json.dumps(sar).encode())
+
+    def pct(lat, q):
+        lat = sorted(lat)
+        return lat[min(len(lat) - 1, int(len(lat) * q))]
+
+    LAT_N = _n(400, 120)
+    slices = [stream[i::DRIVERS] for i in range(DRIVERS)]
+
+    def measure_plain():
+        rl = []
+        for body in stream[:LAT_N]:
+            t = time.monotonic()
+            server.handle_authorize(body)
+            rl.append(time.monotonic() - t)
+
+        def drive(chunk):
+            for body in chunk:
+                server.handle_authorize(body)
+
+        threads = [
+            threading.Thread(target=drive, args=(s,)) for s in slices
+        ]
+        t = time.monotonic()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        return pct(rl, 0.5), pct(rl, 0.99), time.monotonic() - t
+
+    # warm the serving shapes, then measure the tracer-less baseline and
+    # snapshot its answers for the byte differential
+    for body in stream[:LAT_N]:
+        server.handle_authorize(body)
+    DIFF_N = _n(400, 120)
+    baseline_docs = [
+        json.dumps(server.handle_authorize(b)) for b in stream[:DIFF_N]
+    ]
+    ROUNDS = _n(3, 3)
+    base_rounds = [measure_plain() for _ in range(ROUNDS)]
+
+    # ---- unsampled phase: tracer armed at rate 0 + SLO tracker — the
+    # default production posture; responses must stay byte-identical
+    server.tracer = Tracer(sample_rate=0.0, tail_latency_s=100.0)
+    server.slo = SLOTracker(latency_budget_s=100.0)
+    mismatches = sum(
+        1
+        for b, want in zip(stream[:DIFF_N], baseline_docs)
+        if json.dumps(server.handle_authorize(b)) != want
+    )
+    unsampled_rounds = [measure_plain() for _ in range(ROUNDS)]
+    unsampled_kept = server.tracer.kept
+
+    # ---- sampled phase: rate 1.0, every request builds its span tree;
+    # measured, never gated (an operator debugging posture)
+    server.tracer.sample_rate = 1.0
+    sl = []
+    for body in stream[:LAT_N]:
+        t = time.monotonic()
+        server.handle_authorize(body)
+        sl.append(time.monotonic() - t)
+    sampled_kept = server.tracer.kept
+
+    base_p99 = statistics.median(r[1] for r in base_rounds)
+    un_p99 = statistics.median(r[1] for r in unsampled_rounds)
+    base_wall = statistics.median(r[2] for r in base_rounds)
+    un_wall = statistics.median(r[2] for r in unsampled_rounds)
+    tput_delta = un_wall / base_wall - 1.0
+    p99_ok = un_p99 <= base_p99 * 1.5 + 200e-6
+    tput_ok = tput_delta <= 0.05
+    parity_ok = mismatches == 0 and unsampled_kept == 0
+
+    result = {
+        "metric": "trace_plane_sar",
+        "smoke": _SMOKE,
+        "policies": n_policies,
+        "requests": n_requests,
+        "drivers": DRIVERS,
+        "trace_off_vs_unsampled": {
+            "baseline_p50_us": round(
+                statistics.median(r[0] for r in base_rounds) * 1e6, 1
+            ),
+            "baseline_p99_us": round(base_p99 * 1e6, 1),
+            "unsampled_p50_us": round(
+                statistics.median(r[0] for r in unsampled_rounds) * 1e6, 1
+            ),
+            "unsampled_p99_us": round(un_p99 * 1e6, 1),
+            "baseline_rps": round(n_requests / base_wall),
+            "unsampled_rps": round(n_requests / un_wall),
+            "tput_delta_pct": round(tput_delta * 100, 2),
+            "unsampled_traces_kept": unsampled_kept,
+        },
+        "sampled_100pct": {
+            "p50_us": round(pct(sl, 0.5) * 1e6, 1),
+            "p99_us": round(pct(sl, 0.99) * 1e6, 1),
+            "traces_kept": sampled_kept,
+        },
+        "byte_identical_ok": bool(mismatches == 0),
+        "p99_parity_ok": bool(p99_ok),
+        "tput_delta_ok": bool(tput_ok),
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps(result))
+    server.stop()
+    return 0 if (p99_ok and tput_ok and parity_ok) else 1
+
+
 def main():
     import jax
 
@@ -2817,6 +2992,18 @@ if __name__ == "__main__":
 
         force_cpu()
         _scenario_exit("explain", run_explain_scenario)
+
+    if "--trace" in sys.argv:
+        # observability-plane pay-for-use proof (make bench-trace):
+        # cpu-only BY DESIGN — the parity claim (armed-but-unsampled
+        # tracing costs the serving path nothing) must not hide behind
+        # device speed, exactly like the explain bench. Same
+        # stage-isolation env rationale as the pipeline bench.
+        os.environ.setdefault("CEDAR_NATIVE_THREADS", "1")
+        from cedar_tpu.jaxenv import force_cpu
+
+        force_cpu()
+        _scenario_exit("trace", run_trace_scenario)
 
     if "--encode" in sys.argv:
         # host-side budget microbench (make bench-encode): cpu-only BY

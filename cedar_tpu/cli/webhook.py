@@ -599,6 +599,57 @@ def build_server(args) -> WebhookServer:
                 admission_fastpath.on_device_error = admission_recovery.observe
             log.info("native admission fast path enabled")
 
+    # observability plane (cedar_tpu/obs, docs/observability.md): tracing
+    # is wired BY DEFAULT at sample rate 0 — the armed-but-unsampled path
+    # is bench-gated to parity (make bench-trace), and tail-keep means
+    # slow/error/fallback requests land in /debug/traces with zero
+    # configuration exactly when an operator needs them.
+    tracer = None
+    if not args.no_trace:
+        from ..obs import Tracer
+
+        tail_ms = args.trace_tail_ms
+        if tail_ms <= 0:
+            # default the tail-keep threshold to the request budget: a
+            # request that burned its deadline budget is by definition
+            # the one worth keeping
+            tail_ms = (
+                args.request_timeout_ms
+                if args.request_timeout_ms > 0
+                else 1000.0
+            )
+        tracer = Tracer(
+            sample_rate=args.trace_sample_rate,
+            ring_capacity=args.trace_ring,
+            tail_latency_s=tail_ms / 1e3,
+            log_file=args.trace_log_file or None,
+        )
+    audit_log = None
+    if args.audit_log_file:
+        from ..obs import AuditLog
+
+        audit_log = AuditLog(
+            args.audit_log_file,
+            max_bytes=args.audit_max_bytes,
+            max_files=args.audit_max_files,
+        )
+    slo = None
+    if args.slo_availability_target > 0:
+        from ..obs import SLOTracker
+
+        budget_ms = args.slo_latency_budget_ms
+        if budget_ms <= 0:
+            budget_ms = (
+                args.request_timeout_ms
+                if args.request_timeout_ms > 0
+                else 2000.0
+            )
+        slo = SLOTracker(
+            availability_target=args.slo_availability_target,
+            latency_target=args.slo_latency_target,
+            latency_budget_s=budget_ms / 1e3,
+        )
+
     injector = ErrorInjector(
         ErrorInjectionConfig(
             enabled=(
@@ -701,6 +752,9 @@ def build_server(args) -> WebhookServer:
         rollout_control_token=rollout_control_token,
         supervisor=supervisor,
         chaos_control_enabled=args.confirm_non_prod_inject_errors,
+        tracer=tracer,
+        audit_log=audit_log,
+        slo=slo,
     )
     if supervisor is not None:
         _register_supervised(supervisor, server, rollout, stores)
@@ -1059,6 +1113,88 @@ def make_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="allow UNAUTHENTICATED rollout lifecycle POSTs on the "
         "metrics listener (trusted-loopback deployments only)",
+    )
+
+    obs = parser.add_argument_group("observability")
+    obs.add_argument(
+        "--trace-sample-rate",
+        type=float,
+        default=0.0,
+        help="head-sample fraction of requests fully traced into "
+        "/debug/traces (0.0-1.0). Independent of the rate, slow "
+        "(past --trace-tail-ms), errored, and fallback-served requests "
+        "are TAIL-KEPT — the default 0.0 still captures exactly the "
+        "requests worth looking at (docs/observability.md)",
+    )
+    obs.add_argument(
+        "--trace-tail-ms",
+        type=float,
+        default=0.0,
+        help="tail-keep latency threshold: finished traces slower than "
+        "this are kept even when unsampled; 0 defaults to "
+        "--request-timeout-ms (a request that burned its budget is the "
+        "one worth keeping)",
+    )
+    obs.add_argument(
+        "--trace-ring",
+        type=int,
+        default=256,
+        help="bounded in-memory ring of kept traces behind /debug/traces",
+    )
+    obs.add_argument(
+        "--trace-log-file",
+        default="",
+        help="append kept traces as JSONL for offline cedar-trace "
+        "analysis (empty disables export; the ring still serves)",
+    )
+    obs.add_argument(
+        "--no-trace",
+        action="store_true",
+        help="disable the tracing plane entirely (no ring, no "
+        "/debug/traces, no per-request span bookkeeping)",
+    )
+    obs.add_argument(
+        "--audit-log-file",
+        default="",
+        help="decision audit log (JSONL): one line per answered "
+        "decision carrying the end-to-end trace id and the canonical "
+        "request fingerprint shared with the recorder and the decision "
+        "cache — joinable against recordings and cedar-why "
+        "(docs/observability.md; empty disables)",
+    )
+    obs.add_argument(
+        "--audit-max-bytes",
+        type=int,
+        default=64 * 1024 * 1024,
+        help="size-based audit rotation threshold per file",
+    )
+    obs.add_argument(
+        "--audit-max-files",
+        type=int,
+        default=3,
+        help="rotated audit generations kept beside the live file",
+    )
+    obs.add_argument(
+        "--slo-availability-target",
+        type=float,
+        default=0.999,
+        help="availability SLO target (non-error answer fraction) behind "
+        "/debug/slo and the cedar_slo_* burn-rate gauges; 0 disables "
+        "the SLO plane",
+    )
+    obs.add_argument(
+        "--slo-latency-target",
+        type=float,
+        default=0.99,
+        help="latency SLO target: the fraction of requests that must "
+        "answer within the latency budget",
+    )
+    obs.add_argument(
+        "--slo-latency-budget-ms",
+        type=float,
+        default=0.0,
+        help="latency SLO budget per request; 0 defaults to "
+        "--request-timeout-ms",
     )
 
     gameday = parser.add_argument_group("gameday")

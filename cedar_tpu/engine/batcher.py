@@ -35,6 +35,7 @@ import time
 from typing import Callable, List, Optional, Sequence, TypeVar
 
 from ..chaos.registry import chaos_fire
+from ..obs.trace import current_trace
 from ..server.supervisor import Heartbeat
 
 log = logging.getLogger(__name__)
@@ -98,8 +99,32 @@ class DeadlineExceeded(Exception):
     fail-mode), so the late result is discarded."""
 
 
+class _StageTimes:
+    """Per-batch monotonic stage stamps, shared by every slot the batch
+    claimed. ONE source of truth for both the request traces
+    (cedar_tpu/obs) and the cedar_pipeline_stage_seconds histograms, so a
+    span tree and a dashboard can never disagree about where a batch
+    spent its time. The worker loops only stamp time.monotonic() — all
+    span construction happens later, in the request thread, and only for
+    requests that carry an active trace."""
+
+    __slots__ = (
+        "claimed", "first_enq",
+        "encode0", "encode1", "dispatch0", "dispatch1",
+        "decode0", "decode1", "eval0", "eval1",
+    )
+
+    def __init__(self, claimed: float):
+        self.claimed = claimed
+        self.first_enq: Optional[float] = None
+        self.encode0 = self.encode1 = None
+        self.dispatch0 = self.dispatch1 = None
+        self.decode0 = self.decode1 = None
+        self.eval0 = self.eval1 = None
+
+
 class _Slot:
-    __slots__ = ("event", "result", "error", "waiters", "key")
+    __slots__ = ("event", "result", "error", "waiters", "key", "t_enq", "times")
 
     def __init__(self):
         self.event = threading.Event()
@@ -109,6 +134,10 @@ class _Slot:
         # the coalesce key it is registered under while still queued
         self.waiters = 1
         self.key = None
+        # queue-wait accounting: when this slot was enqueued, and the
+        # claiming batch's shared stage-stamp record (None until claimed)
+        self.t_enq = time.monotonic()
+        self.times: Optional[_StageTimes] = None
 
 
 class MicroBatcher:
@@ -339,7 +368,59 @@ class MicroBatcher:
                     "batcher dead: worker thread exited without "
                     "delivering results"
                 )
+        self.annotate_trace(entry)
         return self.take_result(entry)
+
+    @staticmethod
+    def annotate_trace(entry: tuple) -> None:
+        """Attach the entry's batch-stage windows to the calling thread's
+        active request trace (cedar_tpu/obs): queue-wait from the slot's
+        own enqueue stamp, then the claiming batch's encode / dispatch /
+        decode (pipelined) or evaluate (serial) windows — the exact
+        timestamps cedar_pipeline_stage_seconds observed. Runs in the
+        REQUEST thread after the result landed; with tracing disarmed the
+        cost is one thread-local read."""
+        tr = current_trace()
+        if tr is None:
+            return
+        slot = entry[1]
+        times = slot.times
+        if times is None:
+            return  # never claimed (withdrawn / failed before a batch)
+        tr.add_span("batch.queue_wait", slot.t_enq, times.claimed)
+        for name, a, b in (
+            ("batch.encode", times.encode0, times.encode1),
+            ("batch.dispatch", times.dispatch0, times.dispatch1),
+            ("batch.decode", times.decode0, times.decode1),
+            ("batch.evaluate", times.eval0, times.eval1),
+        ):
+            if a is not None and b is not None:
+                tr.add_span(name, a, b)
+
+    def _record_batch_stages(self, times: "_StageTimes") -> None:
+        """Publish one claimed batch's stage windows to the
+        cedar_pipeline_stage_seconds histograms — same stamps the traces
+        consume; advisory like every metrics hook here."""
+        if self.metrics_path is None or times is None:
+            return
+        try:
+            from ..server.metrics import record_pipeline_stage
+
+            p = self.metrics_path
+            if times.first_enq is not None:
+                record_pipeline_stage(
+                    p, "queue_wait", times.claimed - times.first_enq
+                )
+            for stage, a, b in (
+                ("encode", times.encode0, times.encode1),
+                ("dispatch", times.dispatch0, times.dispatch1),
+                ("decode", times.decode0, times.decode1),
+                ("evaluate", times.eval0, times.eval1),
+            ):
+                if a is not None and b is not None:
+                    record_pipeline_stage(p, stage, b - a)
+        except Exception:  # noqa: BLE001 — metrics must never break serving
+            pass
 
     @staticmethod
     def take_result(entry: tuple) -> R:
@@ -420,8 +501,14 @@ class MicroBatcher:
             # claimed entries leave the coalesce map: submitters
             # arriving after the claim must enqueue fresh work rather
             # than attach to a result computed against an older policy
-            # snapshot
+            # snapshot. The same pass stamps the batch's shared stage
+            # record (queue-wait measured from the OLDEST member — the
+            # worst wait in the batch is what the claim latency cost).
+            times = _StageTimes(time.monotonic()) if batch else None
             for _, slot in batch:
+                slot.times = times
+                if times.first_enq is None or slot.t_enq < times.first_enq:
+                    times.first_enq = slot.t_enq
                 if (
                     slot.key is not None
                     and self._pending.get(slot.key) is not None
@@ -475,9 +562,21 @@ class MicroBatcher:
             if self._dispatch_seam is not None:
                 chaos_fire(self._dispatch_seam, self.replica)
             hb.busy()
+            times = batch[0][1].times
+            times.eval0 = time.monotonic()
+            # the end stamp lands BEFORE _complete_batch sets any waiter's
+            # event: a woken request thread annotates its trace from these
+            # stamps immediately, and a missing eval1 would silently drop
+            # the batch.evaluate span
             try:
-                self._complete_batch(batch, self._fn([it for it, _ in batch]))
+                results = self._fn([it for it, _ in batch])
+                times.eval1 = time.monotonic()
+                self._record_batch_stages(times)
+                self._complete_batch(batch, results)
             except BaseException as e:  # noqa: BLE001 — propagate per-item
+                if times.eval1 is None:
+                    times.eval1 = time.monotonic()
+                    self._record_batch_stages(times)
                 self._fail_batch(batch, e)
 
 
@@ -691,6 +790,18 @@ class PipelinedBatcher(MicroBatcher):
         with self._inflight_lock:
             self._inflight += n
 
+    def _encode_timed(self, items, times: Optional[_StageTimes]):
+        """pipeline_encode with the batch's encode window stamped — the
+        stage traces and histograms read these (two monotonic calls per
+        batch; the encode itself is unchanged)."""
+        if times is not None:
+            times.encode0 = time.monotonic()
+        try:
+            return self.stages.pipeline_encode(items)
+        finally:
+            if times is not None:
+                times.encode1 = time.monotonic()
+
     def _stall(self, stage: str, seconds: float) -> None:
         if seconds <= 0:
             return
@@ -737,7 +848,9 @@ class PipelinedBatcher(MicroBatcher):
             self._batches_total += 1
             items = [it for it, _ in batch]
             try:
-                fut = self._pool.submit(self.stages.pipeline_encode, items)
+                fut = self._pool.submit(
+                    self._encode_timed, items, batch[0][1].times
+                )
             except RuntimeError as e:  # pool shut down under us
                 self._fail_batch(batch, e)
                 continue
@@ -791,12 +904,16 @@ class PipelinedBatcher(MicroBatcher):
             # time waiting on the encode future = encode stage too slow to
             # keep the device fed
             self._stall("dispatch", time.monotonic() - t0)
+            times = batch[0][1].times
+            times.dispatch0 = time.monotonic()
             try:
                 ctx = self.stages.pipeline_dispatch(ctx)
             except BaseException as e:  # noqa: BLE001 — per-batch isolation
+                times.dispatch1 = time.monotonic()
                 self._inflight_add(-1)
                 self._fail_batch(batch, e)
                 continue
+            times.dispatch1 = time.monotonic()
             if not self._put(decode_q, (batch, ctx), decoder):
                 self._inflight_add(-1)
                 self._fail_batch(
@@ -831,9 +948,19 @@ class PipelinedBatcher(MicroBatcher):
             if item is _SENTINEL:
                 return
             batch, ctx = item
+            times = batch[0][1].times
+            times.decode0 = time.monotonic()
+            # end stamp + histogram BEFORE completing any slot (see the
+            # serial loop): a woken waiter reads these stamps immediately
             try:
-                self._complete_batch(batch, self.stages.pipeline_decode(ctx))
+                results = self.stages.pipeline_decode(ctx)
+                times.decode1 = time.monotonic()
+                self._record_batch_stages(times)
+                self._complete_batch(batch, results)
             except BaseException as e:  # noqa: BLE001 — per-batch isolation
+                if times.decode1 is None:
+                    times.decode1 = time.monotonic()
+                    self._record_batch_stages(times)
                 self._fail_batch(batch, e)
             finally:
                 self._inflight_add(-1)

@@ -37,6 +37,7 @@ from typing import Callable, Optional
 
 from ..chaos.registry import chaos_fire
 from ..engine.batcher import DeadlineExceeded
+from ..obs.trace import current_trace
 
 log = logging.getLogger(__name__)
 
@@ -200,6 +201,7 @@ class FleetRouter:
         if rem is not None:
             first = min(first, max(rem, 0.0))
         if b1.entry_wait(e1, first):
+            b1.annotate_trace(e1)
             return b1.take_result(e1)
         chaos_fire("fleet.hedge")
         try:
@@ -252,6 +254,7 @@ class FleetRouter:
                     if en2 is not entry:
                         r2.batcher.cancel(en2)
                 self._record_hedge_win(label)
+                rep.batcher.annotate_trace(entry)
                 return rep.batcher.take_result(entry)
             else:
                 rem = remaining()
@@ -282,6 +285,13 @@ class FleetRouter:
     def _record_routed(self, replica) -> None:
         with self._lock:
             self.routed[replica.name] = self.routed.get(replica.name, 0) + 1
+        # routing decisions run in the REQUEST thread, so the active
+        # request trace (cedar_tpu/obs) is visible here: a slow request's
+        # span tree names the replica it rode and every spillover/hedge
+        # on the way (disarmed cost: one thread-local read)
+        tr = current_trace()
+        if tr is not None:
+            tr.event("fleet.route", replica=replica.name)
         try:
             from ..server.metrics import record_fleet_routed
 
@@ -292,6 +302,10 @@ class FleetRouter:
     def _record_spillover(self) -> None:
         with self._lock:
             self.spillovers += 1
+        tr = current_trace()
+        if tr is not None:
+            tr.fallback = True  # degraded-path tail-keep trigger
+            tr.event("fleet.spillover")
         try:
             from ..server.metrics import record_fleet_spillover
 
@@ -302,6 +316,9 @@ class FleetRouter:
     def _record_hedge(self) -> None:
         with self._lock:
             self.hedges += 1
+        tr = current_trace()
+        if tr is not None:
+            tr.event("fleet.hedge")
         try:
             from ..server.metrics import record_fleet_hedge
 
@@ -312,6 +329,9 @@ class FleetRouter:
     def _record_hedge_win(self, winner: str) -> None:
         with self._lock:
             self.hedge_wins[winner] = self.hedge_wins.get(winner, 0) + 1
+        tr = current_trace()
+        if tr is not None:
+            tr.event("fleet.hedge_win", winner=winner)
         try:
             from ..server.metrics import record_fleet_hedge_win
 

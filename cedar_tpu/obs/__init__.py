@@ -1,0 +1,36 @@
+"""Request observability plane: tracing, decision audit log, SLO tracking.
+
+docs/observability.md is the operator runbook. The package is
+zero-dependency and strictly pay-for-use: nothing here touches a device,
+and the disarmed serving path's only cost is a thread-local read per
+annotation site (differential- and bench-gated, `bench.py --trace`).
+"""
+
+from .audit import AuditLog, audit_entry
+from .slo import SLOTracker
+from .trace import (
+    Trace,
+    Tracer,
+    current_trace,
+    format_traceparent,
+    ingest_request_id,
+    parse_traceparent,
+    set_current,
+    span,
+    span_tree_coverage,
+)
+
+__all__ = [
+    "AuditLog",
+    "SLOTracker",
+    "Trace",
+    "Tracer",
+    "audit_entry",
+    "current_trace",
+    "format_traceparent",
+    "ingest_request_id",
+    "parse_traceparent",
+    "set_current",
+    "span",
+    "span_tree_coverage",
+]

@@ -23,13 +23,21 @@ import logging
 import ssl
 import threading
 import time
-import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..chaos.registry import chaos_fire
 from ..engine.batcher import DeadlineExceeded
 from ..fleet.router import FleetUnavailable
+from ..obs.trace import (
+    current_trace,
+    format_traceparent,
+    ingest_request_id,
+    new_span_id,
+    new_trace_id,
+    set_current,
+)
+from ..obs.trace import span as trace_span
 from ..entities.admission import AdmissionRequest
 from ..entities.attributes import (
     Attributes,
@@ -67,6 +75,27 @@ _DECISION_LABEL = {
 # metav1.LabelSelectorOperator -> k8s selection.Operator strings
 # (reference server.go:221-226)
 _LABEL_OPS = {"In": "in", "NotIn": "notin", "Exists": "exists", "DoesNotExist": "!"}
+
+# per-request observation context (cedar_tpu/obs): the serving layers
+# report cached/fallback facts UPWARD to the request handler's trace
+# tail-keep + audit line without changing any layer's call contract — a
+# thread-local, like the active trace, because a request owns its thread
+# end to end (singleflight leaders run in the requesting thread)
+_obs_local = threading.local()
+
+
+def _octx() -> Optional[dict]:
+    return getattr(_obs_local, "ctx", None)
+
+
+def _octx_set(ctx: Optional[dict]) -> None:
+    _obs_local.ctx = ctx
+
+
+def _octx_mark(key: str) -> None:
+    ctx = _octx()
+    if ctx is not None:
+        ctx[key] = True
 
 
 def convert_extra(extra: Optional[dict]) -> dict:
@@ -224,6 +253,9 @@ class WebhookServer:
         rollout_control_token: Optional[str] = None,
         supervisor=None,
         chaos_control_enabled: bool = False,
+        tracer=None,
+        audit_log=None,
+        slo=None,
     ):
         self.authorizer = authorizer
         self.admission_handler = admission_handler
@@ -372,6 +404,25 @@ class WebhookServer:
         # non-explain serving path is untouched)
         self._explainer = None
         self._explainer_lock = threading.Lock()
+        # observability plane (cedar_tpu/obs, docs/observability.md):
+        # request tracing (head-sample + tail-keep span trees served at
+        # /debug/traces), the JSONL decision audit log, and the SLO
+        # burn-rate tracker behind /debug/slo + the cedar_slo_* gauges.
+        # All three are strictly optional — None keeps the serving path
+        # at one thread-local read per annotation site.
+        self.tracer = tracer
+        self.audit_log = audit_log
+        self.slo = slo
+        # canonical-fingerprint memos for the audit log, joinable against
+        # recorder filenames and cache keys; the authorization side reuses
+        # the cache's memo when one exists (same bodies, same parses)
+        self._audit_memo = None
+        self._adm_audit_memo = None
+        if audit_log is not None:
+            from ..cache import FingerprintMemo
+
+            self._audit_memo = self._sar_memo or FingerprintMemo(4096)
+            self._adm_audit_memo = FingerprintMemo(4096)
         self.drain_grace_s = drain_grace_s
         self._draining = False
         self._inflight = 0
@@ -457,14 +508,17 @@ class WebhookServer:
                 )
         return self._explainer
 
-    def _handle_authorize_explain(self, body: bytes) -> dict:
+    def _handle_authorize_explain(
+        self, body: bytes, request_id: Optional[str] = None
+    ) -> dict:
         """?explain=1 on /v1/authorize: the decision plus the attribution
         payload, bypassing the decision cache (never read, never
         populated — cached entries carry no clause indices), the
         batchers, the rollout shadow offer, and the error injector
         (operator surface, not serving traffic)."""
         start = time.monotonic()
-        request_id = str(uuid.uuid4())
+        if request_id is None:
+            request_id = new_trace_id()
         decision, error = DECISION_NO_OPINION, None
         try:
             metrics.record_explain_request("authorization")
@@ -492,11 +546,42 @@ class WebhookServer:
                 time.monotonic() - start,
             )
 
-    def handle_authorize(self, body: bytes, explain: bool = False) -> dict:
+    def handle_authorize(
+        self,
+        body: bytes,
+        explain: bool = False,
+        request_id: Optional[str] = None,
+        parent_span_id: Optional[str] = None,
+        root_span_id: Optional[str] = None,
+        sampled: Optional[bool] = None,
+    ) -> dict:
+        """``request_id`` is the end-to-end trace id (the ingested W3C
+        traceparent's trace id when the apiserver sent one — do_POST
+        echoes it back as ``X-Cedar-Trace-Id``); direct embedder calls
+        without one get a fresh id, exactly like before. ``sampled`` is a
+        pre-drawn head-sampling decision (do_POST draws it so the response
+        traceparent's recorded flag is honest); None draws here."""
         if explain:
-            return self._handle_authorize_explain(body)
+            return self._handle_authorize_explain(body, request_id)
         start = time.monotonic()
-        request_id = str(uuid.uuid4())
+        if request_id is None:
+            request_id = new_trace_id()
+        trace = None
+        if self.tracer is not None:
+            trace = self.tracer.begin(
+                "authorization",
+                trace_id=request_id,
+                parent_span_id=parent_span_id,
+                root_span_id=root_span_id,
+                sampled=sampled,
+            )
+            set_current(trace)
+        # per-request facts the layers below report upward for the audit
+        # line and the trace tail-keep policy (cached answer? served by a
+        # degraded/fallback path?) without changing their return contracts
+        octx: dict = {}
+        if trace is not None or self.audit_log is not None:
+            _octx_set(octx)
         decision, reason, error = DECISION_NO_OPINION, "", None
         try:
             decision, reason, error = self._authorize_cached(body, request_id)
@@ -524,10 +609,35 @@ class WebhookServer:
             )
             return sar_response(decision, reason, error)
         finally:
+            _octx_set(None)
             label = "<error>" if error else _DECISION_LABEL[decision]
             latency = time.monotonic() - start
             metrics.record_request_total(label)
             metrics.record_request_latency(label, latency)
+            if self.slo is not None:
+                # fed the SAME measured latency the histogram above just
+                # observed — the burn rates and the dashboards can never
+                # structurally disagree (docs/observability.md)
+                try:
+                    self.slo.record(
+                        "authorization", latency, error is not None
+                    )
+                except Exception:  # noqa: BLE001 — never break serving
+                    log.exception("slo record failed")
+            if trace is not None:
+                set_current(None)
+                trace.fallback = trace.fallback or bool(octx.get("fallback"))
+                try:
+                    self.tracer.finish(
+                        trace, decision=label, error=error is not None
+                    )
+                except Exception:  # noqa: BLE001 — never break serving
+                    log.exception("trace finish failed")
+            if self.audit_log is not None:
+                self._audit(
+                    "authorization", "authorize", body, request_id,
+                    label, reason, error, latency, octx,
+                )
             log.info(
                 "authorize requestId=%s decision=%s latency=%.6fs",
                 request_id,
@@ -557,12 +667,16 @@ class WebhookServer:
         # the uncached path: a sick cache may cost an evaluation, never an
         # answer.
         try:
-            gen = cache.current_generation()
-            hit = cache.get(key)
+            with trace_span("cache.lookup") as sp:
+                gen = cache.current_generation()
+                hit = cache.get(key)
+                if sp is not None:
+                    sp.set_attr("hit", hit is not None)
         except Exception:  # noqa: BLE001 — a sick cache is a miss
             log.exception("decision cache lookup failed; evaluating")
             return self._authorize_uncached(body, request_id)
         if hit is not None:
+            _octx_mark("cached")
             return hit[0], hit[1], None
 
         def _leader():
@@ -610,35 +724,47 @@ class WebhookServer:
         path behind the breaker, then the python interpreter path."""
         if self.fleet is not None:
             try:
-                return self.fleet.submit(
-                    body,
-                    timeout=self.request_timeout_s,
-                    coalesce_key=coalesce_key,
-                )
+                with trace_span("fleet.submit"):
+                    return self.fleet.submit(
+                        body,
+                        timeout=self.request_timeout_s,
+                        coalesce_key=coalesce_key,
+                    )
             except DeadlineExceeded as e:
                 # the router already fed the owning replica's breaker
                 metrics.record_deadline_exceeded("authorization")
+                tr = current_trace()
+                if tr is not None:
+                    tr.event("deadline_exceeded")
                 return DECISION_NO_OPINION, "", f"evaluation error: {e}"
             except FleetUnavailable:
                 # no replica admits (every breaker open / every worker
                 # down): the interpreter path below answers in the request
                 # thread — bounded degradation, the fleet twin of the
                 # single-engine breaker-open bypass
-                pass
+                _octx_mark("fallback")
             except Exception as e:  # noqa: BLE001 — always answer
                 log.exception(
                     "fleet authorize requestId=%s failed", request_id
                 )
                 return DECISION_NO_OPINION, "", f"evaluation error: {e}"
+        # why the interpreter path answered (trace/audit attribution):
+        # no_fastpath = engine-less deployment, the interpreter IS the
+        # serving plane; everything else is a degradation and tail-keeps
+        py_reason = "no_fastpath"
         try:
             use_fastpath = (
-                self._batcher is not None
-                and self.fastpath.available
-                and self._breaker_admits(self.fastpath)
+                self._batcher is not None and self.fastpath.available
             )
+            if use_fastpath and not self._breaker_admits(self.fastpath):
+                use_fastpath = False
+                py_reason = "breaker_open"
+            elif self._batcher is not None and not use_fastpath:
+                py_reason = "fastpath_unavailable"
         except Exception:  # noqa: BLE001 — degrade to the python path
             log.exception("fastpath availability check failed")
             use_fastpath = False
+            py_reason = "availability_check_failed"
         if use_fastpath:
             try:
                 return self._batcher.submit(
@@ -649,33 +775,44 @@ class WebhookServer:
             except DeadlineExceeded as e:
                 metrics.record_deadline_exceeded("authorization")
                 self._record_breaker_timeout(self.fastpath)
+                tr = current_trace()
+                if tr is not None:
+                    tr.event("deadline_exceeded")
                 return DECISION_NO_OPINION, "", f"evaluation error: {e}"
             except Exception as e:  # noqa: BLE001 — always answer
                 log.exception(
                     "fastpath authorize requestId=%s failed", request_id
                 )
                 return DECISION_NO_OPINION, "", f"evaluation error: {e}"
-        try:
-            sar = json.loads(body)
-        except (ValueError, TypeError, RecursionError) as e:
-            return (
-                DECISION_NO_OPINION,
-                "Encountered decoding error",
-                f"failed parsing request body: {e}",
-            )
-        try:
-            attributes = get_authorizer_attributes(sar)
-            # bypass the authorizer-level cache ONLY when the server-level
-            # cache is wired: it already missed on this exact canonical
-            # key, and a second lookup would double-count the miss. With no
-            # server cache, an embedder-wired authorizer cache stays live.
-            decision, reason = self.authorizer.authorize(
-                attributes, use_cache=self.decision_cache is None
-            )
-        except Exception as e:  # noqa: BLE001 — always answer the apiserver
-            log.exception("authorize requestId=%s failed", request_id)
-            return DECISION_NO_OPINION, "", f"evaluation error: {e}"
-        return decision, reason, None
+        if py_reason != "no_fastpath" or self.fleet is not None:
+            # a wired device plane was bypassed: fallback-served, which
+            # tail-keeps the trace and stamps the audit line
+            _octx_mark("fallback")
+        with trace_span("interpreter") as sp:
+            if sp is not None:
+                sp.set_attr("reason", py_reason)
+            try:
+                sar = json.loads(body)
+            except (ValueError, TypeError, RecursionError) as e:
+                return (
+                    DECISION_NO_OPINION,
+                    "Encountered decoding error",
+                    f"failed parsing request body: {e}",
+                )
+            try:
+                attributes = get_authorizer_attributes(sar)
+                # bypass the authorizer-level cache ONLY when the
+                # server-level cache is wired: it already missed on this
+                # exact canonical key, and a second lookup would
+                # double-count the miss. With no server cache, an
+                # embedder-wired authorizer cache stays live.
+                decision, reason = self.authorizer.authorize(
+                    attributes, use_cache=self.decision_cache is None
+                )
+            except Exception as e:  # noqa: BLE001 — always answer
+                log.exception("authorize requestId=%s failed", request_id)
+                return DECISION_NO_OPINION, "", f"evaluation error: {e}"
+            return decision, reason, None
 
     def _breaker_admits(self, fastpath) -> bool:
         """False when the fastpath's circuit breaker is open. Requests then
@@ -723,34 +860,173 @@ class WebhookServer:
             review = None
         return self._admission_fail_mode(review, e)
 
-    def _handle_admit_explain(self, body: bytes) -> dict:
+    def _handle_admit_explain(
+        self, body: bytes, request_id: Optional[str] = None
+    ) -> dict:
         """?explain=1 on /v1/admit — the admission twin of
-        _handle_authorize_explain (same bypasses, same lazy plane)."""
+        _handle_authorize_explain (same bypasses, same lazy plane). The
+        request id is logged so the echoed X-Cedar-Trace-Id joins the
+        serving log here too."""
+        if request_id is None:
+            request_id = new_trace_id()
         try:
             metrics.record_explain_request("admission")
             response, explanation = self._get_explainer().explain_admit(body)
             review = response.to_admission_review()
             review["explanation"] = explanation
+            log.info("admit(explain) requestId=%s answered", request_id)
             return review
         except Exception as e:  # noqa: BLE001 — always answer the operator
-            log.exception("explain admit failed")
+            log.exception("explain admit requestId=%s failed", request_id)
             try:
                 review = json.loads(body)
             except Exception:  # noqa: BLE001 — uid is best-effort here
                 review = None
             return self._admission_fail_mode(review, e)
 
-    def handle_admit(self, body: bytes, explain: bool = False) -> dict:
+    def handle_admit(
+        self,
+        body: bytes,
+        explain: bool = False,
+        request_id: Optional[str] = None,
+        parent_span_id: Optional[str] = None,
+        root_span_id: Optional[str] = None,
+        sampled: Optional[bool] = None,
+    ) -> dict:
+        if request_id is None:
+            request_id = new_trace_id()
         if explain:
-            return self._handle_admit_explain(body)
-        review = self._handle_admit(body)
-        if self.rollout is not None and self._admission_shadowable():
-            # non-blocking shadow offer; error/fail-mode responses are
-            # filtered by the shadow worker (code != 200), but the
-            # pre-ready allow is a CLEAN 200 — it must be gated here or
-            # startup traffic diffs against the always-ready candidate
-            self.rollout.offer("admit", body, review)
-        return review
+            return self._handle_admit_explain(body, request_id)
+        start = time.monotonic()
+        trace = None
+        if self.tracer is not None:
+            trace = self.tracer.begin(
+                "admission",
+                trace_id=request_id,
+                parent_span_id=parent_span_id,
+                root_span_id=root_span_id,
+                sampled=sampled,
+            )
+            set_current(trace)
+        octx: dict = {}
+        if trace is not None or self.audit_log is not None:
+            _octx_set(octx)
+        review = None
+        try:
+            review = self._handle_admit(body)
+            if self.rollout is not None and self._admission_shadowable():
+                # non-blocking shadow offer; error/fail-mode responses are
+                # filtered by the shadow worker (code != 200), but the
+                # pre-ready allow is a CLEAN 200 — it must be gated here or
+                # startup traffic diffs against the always-ready candidate
+                self.rollout.offer("admit", body, review)
+            return review
+        finally:
+            _octx_set(None)
+            if (
+                trace is not None
+                or self.slo is not None
+                or self.audit_log is not None
+            ):
+                self._finish_admit_obs(
+                    body, request_id, review, trace, octx,
+                    time.monotonic() - start,
+                )
+
+    def _finish_admit_obs(
+        self, body, request_id, review, trace, octx, latency
+    ) -> None:
+        """Close out the admission request's observability surfaces
+        (trace finish + tail-keep, SLO record, audit line) from the
+        rendered review — the decision facts are read back out of the
+        response the caller is already returning, so this can never
+        change an answer."""
+        resp = (review or {}).get("response") or {}
+        status = resp.get("status") or {}
+        error = (
+            None
+            if review is not None and status.get("code") in (None, 200)
+            else (status.get("message") or "no response")
+        )
+        label = (
+            "<error>"
+            if error
+            else ("allowed" if resp.get("allowed") else "denied")
+        )
+        if self.slo is not None:
+            try:
+                self.slo.record("admission", latency, error is not None)
+            except Exception:  # noqa: BLE001 — never break serving
+                log.exception("slo record failed")
+        if trace is not None:
+            set_current(None)
+            trace.fallback = trace.fallback or bool(octx.get("fallback"))
+            try:
+                self.tracer.finish(
+                    trace, decision=label, error=error is not None
+                )
+            except Exception:  # noqa: BLE001 — never break serving
+                log.exception("trace finish failed")
+        if self.audit_log is not None:
+            self._audit(
+                "admission", "admit", body, request_id, label,
+                status.get("message") or "", error, latency, octx,
+            )
+
+    def _audit(
+        self, path, endpoint, body, request_id, label, reason, error,
+        latency, octx,
+    ) -> None:
+        """Append one decision audit line (docs/observability.md): the
+        end-to-end trace id, the canonical fingerprint shared with the
+        recorder/cache (memoized — repeat traffic pays one digest), the
+        decision with its determining policies read from the rendered
+        reason, latency, and the fallback/breaker posture it was served
+        under. Best-effort by contract: a failing audit plane logs and
+        serves."""
+        try:
+            from ..obs.audit import audit_entry
+
+            memo = (
+                self._audit_memo
+                if endpoint == "authorize"
+                else self._adm_audit_memo
+            )
+            fp = memo.fingerprint(endpoint, body) if memo is not None else None
+            self.audit_log.record(
+                audit_entry(
+                    path,
+                    request_id,
+                    fp,
+                    label,
+                    reason=reason,
+                    error=error,
+                    latency_s=latency,
+                    breaker_state=self._breaker_state_label(path),
+                    fallback=bool(octx.get("fallback")),
+                    cached=bool(octx.get("cached")),
+                )
+            )
+            metrics.record_audit_record(path)
+        except Exception:  # noqa: BLE001 — audit must never break serving
+            log.exception("audit append failed")
+
+    def _breaker_state_label(self, path: str) -> str:
+        """The serving breaker's state at answer time (audit context;
+        empty when no breaker is wired). With a fleet, replica 0's
+        breaker — the same one the explain plane gates on."""
+        try:
+            if path == "authorization":
+                if self.fleet is not None:
+                    replicas = getattr(self.fleet, "replicas", None)
+                    breaker = replicas[0].breaker if replicas else None
+                else:
+                    breaker = getattr(self.fastpath, "breaker", None)
+            else:
+                breaker = getattr(self.admission_fastpath, "breaker", None)
+            return breaker.state if breaker is not None else ""
+        except Exception:  # noqa: BLE001 — audit context is best-effort
+            return ""
 
     def _admission_shadowable(self) -> bool:
         """Stores ready for admission (latched, like _cache_usable): the
@@ -778,15 +1054,21 @@ class WebhookServer:
             # non-positive remainders make submit() expire immediately
             return None if deadline is None else deadline - time.monotonic()
 
+        py_reason = "no_fastpath"
         try:
             use_fast = (
                 self._adm_raw_batcher is not None
                 and self.admission_fastpath.available
-                and self._breaker_admits(self.admission_fastpath)
             )
+            if use_fast and not self._breaker_admits(self.admission_fastpath):
+                use_fast = False
+                py_reason = "breaker_open"
+            elif self._adm_raw_batcher is not None and not use_fast:
+                py_reason = "fastpath_unavailable"
         except Exception:  # noqa: BLE001 — degrade to the python path
             log.exception("admission fastpath availability check failed")
             use_fast = False
+            py_reason = "availability_check_failed"
         if use_fast:
             try:
                 return self._adm_raw_batcher.submit(
@@ -796,32 +1078,45 @@ class WebhookServer:
                 # the budget is spent: answer the fail-mode now instead of
                 # burning more wall-clock on the python path
                 self._record_breaker_timeout(self.admission_fastpath)
+                tr = current_trace()
+                if tr is not None:
+                    tr.event("deadline_exceeded")
                 return self._admission_deadline(body, e)
             except Exception:  # noqa: BLE001 — python path below still answers
                 log.exception("admission fastpath failed; python path")
-        try:
-            review = json.loads(body)
-        except (ValueError, TypeError, RecursionError) as e:
-            return AdmissionResponse(
-                uid="", allowed=False, code=400, error=f"failed parsing body: {e}"
-            ).to_admission_review()
-        try:
-            req = AdmissionRequest.from_admission_review(review)
-            if self._admission_batcher is not None:
-                return self._admission_batcher.submit(
-                    req, timeout=remaining()
+                py_reason = "fastpath_error"
+        if py_reason != "no_fastpath":
+            _octx_mark("fallback")
+        with trace_span("interpreter") as sp:
+            if sp is not None:
+                sp.set_attr("reason", py_reason)
+            try:
+                review = json.loads(body)
+            except (ValueError, TypeError, RecursionError) as e:
+                return AdmissionResponse(
+                    uid="", allowed=False, code=400,
+                    error=f"failed parsing body: {e}",
                 ).to_admission_review()
-            return self.admission_handler.handle(req).to_admission_review()
-        except DeadlineExceeded as e:
-            metrics.record_deadline_exceeded("admission")
-            return self._admission_fail_mode(review, e)
-        except Exception as e:  # noqa: BLE001 — fail-open like the reference
-            # allow-on-error posture (/root/reference
-            # internal/server/admission/handler.go:90-104 with
-            # allowOnError=true): a conversion/evaluation crash must not
-            # block the cluster's write path
-            log.exception("admit failed")
-            return self._admission_fail_mode(review, e)
+            try:
+                req = AdmissionRequest.from_admission_review(review)
+                if self._admission_batcher is not None:
+                    return self._admission_batcher.submit(
+                        req, timeout=remaining()
+                    ).to_admission_review()
+                return self.admission_handler.handle(req).to_admission_review()
+            except DeadlineExceeded as e:
+                metrics.record_deadline_exceeded("admission")
+                tr = current_trace()
+                if tr is not None:
+                    tr.event("deadline_exceeded")
+                return self._admission_fail_mode(review, e)
+            except Exception as e:  # noqa: BLE001 — fail-open like the ref
+                # allow-on-error posture (/root/reference
+                # internal/server/admission/handler.go:90-104 with
+                # allowOnError=true): a conversion/evaluation crash must
+                # not block the cluster's write path
+                log.exception("admit failed")
+                return self._admission_fail_mode(review, e)
 
     # -------------------------------------------------------------- serving
 
@@ -832,11 +1127,15 @@ class WebhookServer:
             def log_message(self, fmt, *args):
                 log.debug("%s %s", self.address_string(), fmt % args)
 
-            def _write_json(self, doc: dict, code: int = 200):
+            def _write_json(
+                self, doc: dict, code: int = 200, headers: dict = None
+            ):
                 data = json.dumps(doc).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -886,13 +1185,51 @@ class WebhookServer:
                     body = self.rfile.read(length) if length else b""
                     if server.recorder is not None:
                         server.recorder.record(path, body)
+                    # one request id end to end: the ingested W3C
+                    # traceparent's trace id (or a fresh one) becomes the
+                    # logged requestId, the trace id in /debug/traces and
+                    # the audit log, and the X-Cedar-Trace-Id response
+                    # header the caller can quote back to an operator
+                    request_id, parent_span = ingest_request_id(
+                        self.headers.get("traceparent")
+                    )
+                    headers = {"X-Cedar-Trace-Id": request_id}
+                    root_span = sampled = None
+                    if server.tracer is not None:
+                        # propagate: our root span becomes the downstream
+                        # parent, and the recorded flag carries the HEAD
+                        # sampling decision (drawn here, honored by the
+                        # handler's trace) — tail-keep recording is not
+                        # knowable at response time, so the flag must not
+                        # overclaim at the default rate 0
+                        root_span = new_span_id()
+                        sampled = server.tracer.head_sample()
+                        headers["traceparent"] = format_traceparent(
+                            request_id, root_span, sampled
+                        )
                     if path == "/v1/authorize":
                         self._write_json(
-                            server.handle_authorize(body, explain=explain)
+                            server.handle_authorize(
+                                body,
+                                explain=explain,
+                                request_id=request_id,
+                                parent_span_id=parent_span,
+                                root_span_id=root_span,
+                                sampled=sampled,
+                            ),
+                            headers=headers,
                         )
                     elif path == "/v1/admit":
                         self._write_json(
-                            server.handle_admit(body, explain=explain)
+                            server.handle_admit(
+                                body,
+                                explain=explain,
+                                request_id=request_id,
+                                parent_span_id=parent_span,
+                                root_span_id=root_span,
+                                sampled=sampled,
+                            ),
+                            headers=headers,
                         )
                     else:
                         self.send_error(404)
@@ -1001,6 +1338,14 @@ class WebhookServer:
                             server.fleet.publish_states()
                         except Exception:  # noqa: BLE001 — scrape must serve
                             log.exception("fleet state publish failed")
+                    if server.slo is not None:
+                        try:
+                            # burn rates are window functions of time, not
+                            # of events: refresh at scrape so a quiet
+                            # window decays the gauges
+                            server.slo.publish()
+                        except Exception:  # noqa: BLE001 — scrape must serve
+                            log.exception("slo publish failed")
                     data = metrics.REGISTRY.expose().encode()
                     self.send_response(200)
                     self.send_header(
@@ -1137,6 +1482,43 @@ class WebhookServer:
                     except Exception:  # noqa: BLE001 — debug must not 500
                         log.exception("chaos stats failed")
                         doc = {"error": "chaos stats failed"}
+                    self._send_json(doc)
+                elif self.path == "/debug/slo":
+                    # SLO plane (docs/observability.md): targets plus
+                    # per-path, per-window request/error/slow counts and
+                    # burn rates; 404 with no tracker wired
+                    if server.slo is None:
+                        self.send_error(404)
+                        return
+                    try:
+                        doc = server.slo.status()
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("slo status failed")
+                        doc = {"error": "slo status failed"}
+                    self._send_json(doc)
+                elif self.path == "/debug/traces" or self.path.startswith(
+                    "/debug/traces/"
+                ):
+                    # kept request traces (docs/observability.md): the
+                    # bare path lists the ring newest-first; /<trace id>
+                    # (prefix accepted) fetches one full span tree — the
+                    # online half of cedar-trace. 404 with no tracer
+                    if server.tracer is None:
+                        self.send_error(404)
+                        return
+                    trace_id = self.path[len("/debug/traces/"):].strip("/")
+                    try:
+                        if trace_id:
+                            doc = server.tracer.get(trace_id)
+                            if doc is None:
+                                self.send_error(404)
+                                return
+                        else:
+                            doc = server.tracer.stats()
+                            doc["traces"] = server.tracer.list_traces()
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("trace lookup failed")
+                        doc = {"error": "trace lookup failed"}
                     self._send_json(doc)
                 elif self.path == "/debug/analysis":
                     # the last policy-set analysis report (load-time
@@ -1403,6 +1785,12 @@ class WebhookServer:
                 self.rollout.stop()  # shadow worker; best-effort by design
             except Exception:  # noqa: BLE001 — teardown must finish
                 log.exception("rollout stop failed")
+        for closer in (self.tracer, self.audit_log):
+            if closer is not None:
+                try:
+                    closer.close()  # flush trace-log / audit file handles
+                except Exception:  # noqa: BLE001 — teardown must finish
+                    log.exception("observability close failed")
 
     @property
     def bound_port(self) -> Optional[int]:

@@ -106,6 +106,24 @@ class Histogram:
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
 
+    def fraction_over(self, bound: float) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Per-label-set fraction of observations strictly above the
+        largest bucket <= ``bound`` — the public read the SLO plane's
+        histogram cross-check uses (cedar_tpu/obs/slo.py), so nothing
+        outside this class touches the cumulative-bucket representation."""
+        out: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        with self._lock:
+            for key, counts in self._counts.items():
+                total = self._totals.get(key, 0)
+                if not total:
+                    continue
+                under = 0
+                for b, c in zip(self.buckets, counts):
+                    if b <= bound:
+                        under = c
+                out[key] = 1.0 - under / total
+        return out
+
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         with self._lock:
@@ -170,9 +188,32 @@ request_latency = REGISTRY.register(
 e2e_latency = REGISTRY.register(
     Histogram(
         f"{SUBSYSTEM}_e2e_latency_seconds",
-        "End to end latency in seconds partitioned by filename.",
+        "End to end latency in seconds partitioned by filename. The "
+        "filename label is CAPPED: after the first 64 distinct "
+        "filenames, further names fold into the `other` bucket "
+        "(cedar_authorizer_e2e_label_overflow_total counts the folds) — "
+        "replay directories are unbounded and an unbounded label set is "
+        "a scrape-size leak.",
         ["filename"],
         [2.0 * (2.0**i) for i in range(8)],
+    )
+)
+
+# cap for the e2e histogram's filename label set (replay stamps one label
+# per recording file; a big recording directory must not explode the
+# exposition)
+_E2E_LABEL_CAP = 64
+_e2e_labels: set = set()
+_e2e_label_lock = threading.Lock()
+
+e2e_label_overflow_total = REGISTRY.register(
+    Counter(
+        f"{SUBSYSTEM}_e2e_label_overflow_total",
+        "e2e latency observations whose filename label was folded into "
+        "`other` because the bounded label set was full. Nonzero just "
+        "means a big replay; per-file latency for the folded names lives "
+        "in the replay CLI's own output, not the scrape.",
+        [],
     )
 )
 
@@ -341,6 +382,24 @@ pipeline_stall_seconds_total = REGISTRY.register(
         "starvation). Rate > ~0.5 s/s on one stage names the bottleneck "
         "(docs/performance.md has the tuning table).",
         ["path", "stage"],
+    )
+)
+
+pipeline_stage_seconds = REGISTRY.register(
+    Histogram(
+        "cedar_pipeline_stage_seconds",
+        "Per-batch pipeline stage latency partitioned by path and stage "
+        "(queue_wait: oldest submit -> batch claim; encode / dispatch / "
+        "decode on the pipelined batchers; evaluate on the serial "
+        "batcher). Recorded from the SAME monotonic timestamps the "
+        "request traces use (docs/observability.md), so a dashboard and "
+        "a /debug/traces span tree can never disagree about where a "
+        "batch spent its time.",
+        ["path", "stage"],
+        [
+            0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+            0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+        ],
     )
 )
 
@@ -609,6 +668,67 @@ fleet_promotions_total = REGISTRY.register(
 )
 
 
+# Observability plane (cedar_tpu/obs, docs/observability.md): request
+# tracing keep counts, decision audit log rotation, and the SLO burn-rate
+# gauges refreshed at scrape time. Outside the cedar_authorizer_* request
+# subsystem — these describe the observability surfaces, not decisions.
+trace_kept_total = REGISTRY.register(
+    Counter(
+        "cedar_trace_kept_total",
+        "Finished request traces kept into the /debug/traces ring, "
+        "partitioned by path and keep reason (sampled: head sampling; "
+        "slow: tail-keep past the tail latency budget; error: the "
+        "request answered with an evaluation error; fallback: served by "
+        "a degraded path). A rising error/fallback rate with sampled "
+        "flat is the tracing plane catching exactly the requests head "
+        "sampling would have missed.",
+        ["path", "reason"],
+    )
+)
+
+audit_records_total = REGISTRY.register(
+    Counter(
+        "cedar_audit_records_total",
+        "Decision audit log lines appended, partitioned by path. "
+        "Compare with cedar_authorizer_request_total: a persistent gap "
+        "means audit appends are failing (the log disables itself on "
+        "I/O errors rather than slowing serving).",
+        ["path"],
+    )
+)
+
+audit_rotations_total = REGISTRY.register(
+    Counter(
+        "cedar_audit_rotations_total",
+        "Size-based audit log rotations (<path> -> <path>.1 shifts).",
+        [],
+    )
+)
+
+slo_burn_rate = REGISTRY.register(
+    Gauge(
+        "cedar_slo_burn_rate",
+        "Error-budget burn rate per path, objective (availability / "
+        "latency) and trailing window (5m / 1h / 6h): bad-request "
+        "fraction over the window divided by the objective's error "
+        "budget. 1.0 consumes the budget exactly at the sustain rate; "
+        "the canonical fast-burn page is rate > 14.4 on the short "
+        "window AND > 1 on the long one (docs/observability.md).",
+        ["path", "slo", "window"],
+    )
+)
+
+slo_target = REGISTRY.register(
+    Gauge(
+        "cedar_slo_target",
+        "Configured SLO target per path and objective (availability: "
+        "non-error answer fraction; latency: fraction answered within "
+        "the latency budget).",
+        ["path", "slo"],
+    )
+)
+
+
 chaos_injections_total = REGISTRY.register(
     Counter(
         "cedar_chaos_injections_total",
@@ -636,6 +756,17 @@ def record_request_latency(decision: str, latency_s: float) -> None:
 
 
 def record_e2e_latency(filename: str, latency_s: float) -> None:
+    """Observe under a BOUNDED filename label set: the first
+    _E2E_LABEL_CAP distinct names get their own series, everything after
+    folds into `other` (and counts the overflow). `other` is always
+    admitted so the fold can never itself overflow."""
+    with _e2e_label_lock:
+        if filename != "other" and filename not in _e2e_labels:
+            if len(_e2e_labels) >= _E2E_LABEL_CAP:
+                e2e_label_overflow_total.inc()
+                filename = "other"
+            else:
+                _e2e_labels.add(filename)
     e2e_latency.observe(latency_s, filename=filename)
 
 
@@ -691,6 +822,31 @@ def record_batch_occupancy(path: str, n: int) -> None:
 def record_pipeline_stall(path: str, stage: str, seconds: float) -> None:
     if seconds > 0:
         pipeline_stall_seconds_total.inc(seconds, path=path, stage=stage)
+
+
+def record_pipeline_stage(path: str, stage: str, seconds: float) -> None:
+    if seconds >= 0:
+        pipeline_stage_seconds.observe(seconds, path=path, stage=stage)
+
+
+def record_trace_kept(path: str, reason: str) -> None:
+    trace_kept_total.inc(path=path, reason=reason)
+
+
+def record_audit_record(path: str) -> None:
+    audit_records_total.inc(path=path)
+
+
+def record_audit_rotation() -> None:
+    audit_rotations_total.inc()
+
+
+def set_slo_burn_rate(path: str, slo: str, window: str, rate: float) -> None:
+    slo_burn_rate.set(round(rate, 4), path=path, slo=slo, window=window)
+
+
+def set_slo_target(path: str, slo: str, value: float) -> None:
+    slo_target.set(value, path=path, slo=slo)
 
 
 def set_engine_warmup_seconds(engine: str, seconds: float) -> None:
