@@ -2319,6 +2319,168 @@ def run_trace_scenario() -> int:
     return 0 if (p99_ok and tput_ok and parity_ok) else 1
 
 
+def run_scale_scenario() -> int:
+    """Giant-policy-set scenario (make bench-scale, docs/performance.md
+    "Giant policy sets"): a 10k-rule single-cluster set vs a 100k-rule
+    org-wide set served through the partition-pruned sharded plane, plus
+    the single-policy CRD edit path. Gates (rc=1 on breach):
+
+      * edit-to-serving < CEDAR_BENCH_SCALE_EDIT_S (default 1.0s,
+        median over repeated edits — preemption spikes on the shared
+        bench host are trimmed, pipeline-bench protocol): one policy
+        edited -> incremental reload -> the flipped decision
+        observable at the serving path, with ZERO fresh jit traces
+        (trace-counter-pinned: untouched shards swap compile-free) and
+        exactly one dirty shard;
+      * the 100k-rule set serves within CEDAR_BENCH_SCALE_RATIO (1.5x)
+        of the 10k-rule decisions/sec on the same backend.
+    """
+    import statistics
+
+    from cedar_tpu.corpus import synth_corpus
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.ops.match import kernel_trace_count
+
+    t_start = time.time()
+    small_n = _n(10_000, 400)
+    large_n = _n(100_000, 2_000)
+    clusters = _n(10, 5)
+    B = _n(4096, 512)
+    edit_budget_s = float(os.environ.get("CEDAR_BENCH_SCALE_EDIT_S", "1.0"))
+    ratio_budget = float(os.environ.get("CEDAR_BENCH_SCALE_RATIO", "1.5"))
+
+    # ---- small set: one cluster's own 10k policies, no partition needed
+    t0 = time.time()
+    small = synth_corpus(small_n, seed=11, clusters=1)
+    synth_small_s = time.time() - t0
+    engine_small = TPUPolicyEngine(name="scale-small")
+    t0 = time.time()
+    stats_small = engine_small.load(small.tiers(), warm="off")
+    compile_small_s = time.time() - t0
+    items_small = small.sar_items(B, cluster=0, seed=21)
+    rate_small, spread_small = _trial_rates(
+        lambda: engine_small.evaluate_batch(items_small), B, trials=3
+    )
+
+    # ---- large set: the org store, partition-pruned to cluster 0
+    t0 = time.time()
+    large = synth_corpus(large_n, seed=13, clusters=clusters)
+    synth_large_s = time.time() - t0
+    engine = TPUPolicyEngine(name="scale-large", partition=large.spec(0))
+    t0 = time.time()
+    stats_large = engine.load(large.tiers(), warm="off")
+    compile_large_s = time.time() - t0
+    items_large = large.sar_items(B, cluster=0, seed=22)
+    rate_large, spread_large = _trial_rates(
+        lambda: engine.evaluate_batch(items_large), B, trials=3
+    )
+
+    # decision differential: the pruned plane must answer in-universe
+    # traffic exactly like an unsharded, unpruned engine
+    engine_ref = TPUPolicyEngine(name="scale-ref", incremental=False)
+    engine_ref.load(large.tiers(), warm="off")
+    diff_n = _n(2048, 256)
+    want = [d for d, _ in engine_ref.evaluate_batch(items_large[:diff_n])]
+    got = [d for d, _ in engine.evaluate_batch(items_large[:diff_n])]
+    mismatches = sum(1 for a, b in zip(want, got) if a != b)
+
+    # ---- single-policy CRD edit: reload + first flipped decision. The
+    # tier stack is assembled OUTSIDE the window: a store holds its
+    # PolicySet already when the reloader tick fires — the measured span
+    # is reload-to-serving, which is what a CRD edit pays.
+    em, req = large.probe_request()
+    before = engine.evaluate(em, req)[0]  # warms the b=1 serving shape
+    edited = large.with_edit()
+    edited_tiers = edited.tiers()
+    tc0 = kernel_trace_count()
+    t0 = time.monotonic()
+    stats_edit = engine.load(edited_tiers, warm="off")
+    after = engine.evaluate(em, req)[0]
+    edit_to_serving_s = time.monotonic() - t0
+    fresh_traces = kernel_trace_count() - tc0
+    flipped = before == "allow" and after == "deny"
+
+    # repeat-edit latency distribution (flip back and forth). The GATE
+    # reads the MEDIAN: the bench host's cores are shared, and a single
+    # preemption spike mid-reload says nothing about the execution model
+    # — same median-not-wall protocol as `make bench-pipeline`.
+    edit_samples = [edit_to_serving_s]
+    cur = edited
+    for _ in range(_n(6, 2)):
+        cur = cur.with_edit()
+        cur_tiers = cur.tiers()
+        t0 = time.monotonic()
+        engine.load(cur_tiers, warm="off")
+        engine.evaluate(em, req)
+        edit_samples.append(time.monotonic() - t0)
+
+    ratio = rate_small / max(rate_large, 1)
+    edit_p50_s = statistics.median(edit_samples)
+    edit_ok = edit_p50_s < edit_budget_s
+    traces_ok = fresh_traces == 0
+    ratio_ok = ratio <= ratio_budget
+    dirty_ok = stats_edit["dirty_shards"] == 1
+    diff_ok = mismatches == 0
+    ok = edit_ok and traces_ok and ratio_ok and dirty_ok and flipped and diff_ok
+
+    fallback_reason = os.environ.get("CEDAR_BENCH_CPU_FALLBACK", "")
+    result = {
+        "scenario": "scale",
+        "smoke": _SMOKE,
+        **(
+            {"backend": "cpu-fallback", "backend_note": fallback_reason}
+            if fallback_reason
+            else {"backend": "cpu-fallback"}  # make bench-scale pins cpu
+        ),
+        "small": {
+            "policies": small_n,
+            "rules": stats_small["rules"],
+            "compile_s": round(compile_small_s, 2),
+            "synth_s": round(synth_small_s, 2),
+            "rate": rate_small,
+            "rate_spread": spread_small,
+        },
+        "large": {
+            "policies": large_n,
+            "clusters": clusters,
+            "rules_resident": stats_large["rules"],
+            "pruned_policies": stats_large["pruned_policies"],
+            "shards": stats_large["shards"],
+            "compile_s": round(compile_large_s, 2),
+            "synth_s": round(synth_large_s, 2),
+            "rate": rate_large,
+            "rate_spread": spread_large,
+        },
+        "rate_ratio_small_over_large": round(ratio, 3),
+        "edit": {
+            "edit_to_serving_s": round(edit_to_serving_s, 4),
+            "edit_samples_ms": [round(s * 1e3, 1) for s in edit_samples],
+            "edit_p50_ms": round(edit_p50_s * 1e3, 1),
+            "dirty_shards": stats_edit["dirty_shards"],
+            "compile_scope": stats_edit["compile_scope"],
+            "warm_skipped": stats_edit["warm_skipped"],
+            "fresh_traces": fresh_traces,
+            "compile_seconds": stats_edit["compile_seconds"],
+            "probe_flip": f"{before}->{after}",
+        },
+        "differential_mismatches": mismatches,
+        "gates": {
+            "edit_under_s": edit_budget_s,
+            "edit_ok": bool(edit_ok),
+            "traces_ok": bool(traces_ok),
+            "ratio_budget": ratio_budget,
+            "ratio_ok": bool(ratio_ok),
+            "dirty_ok": bool(dirty_ok),
+            "probe_flip_ok": bool(flipped),
+            "differential_ok": bool(diff_ok),
+        },
+        "pass": bool(ok),
+        "elapsed_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 def main():
     import jax
 
@@ -3004,6 +3166,23 @@ if __name__ == "__main__":
 
         force_cpu()
         _scenario_exit("trace", run_trace_scenario)
+
+    if "--scale" in sys.argv:
+        # giant-policy-set scenario (make bench-scale): cpu-only BY
+        # DESIGN — the claims are about the compilation/paging execution
+        # model (incremental recompile latency, pruned-plane serving
+        # ratio), not device speed, and the trace-counter pin needs a
+        # deterministic backend. Async dispatch so the evaluate pipeline
+        # overlaps like an attached device.
+        os.environ.setdefault("CEDAR_NATIVE_THREADS", "1")
+        os.environ.setdefault("CEDAR_TPU_WARM_DEFAULT", "off")
+        from cedar_tpu.jaxenv import force_cpu
+
+        force_cpu()
+        import jax
+
+        jax.config.update("jax_cpu_enable_async_dispatch", True)
+        _scenario_exit("scale", run_scale_scenario)
 
     if "--encode" in sys.argv:
         # host-side budget microbench (make bench-encode): cpu-only BY

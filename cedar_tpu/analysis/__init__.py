@@ -28,6 +28,12 @@ from .loadgate import (
     check_object_policies,
     enforce,
 )
+from .partition import (
+    PartitionSpec,
+    lowered_never_matches,
+    partition_report,
+    quick_never_matches,
+)
 from .report import (
     SEV_ERROR,
     SEV_INFO,
@@ -45,7 +51,11 @@ __all__ = [
     "SEV_ERROR",
     "SEV_INFO",
     "SEV_WARNING",
+    "PartitionSpec",
     "analyze_tiers",
     "check_object_policies",
     "enforce",
+    "lowered_never_matches",
+    "partition_report",
+    "quick_never_matches",
 ]

@@ -23,9 +23,17 @@ from .fingerprint import (
     fingerprint_body,
     recorded_name_parts,
 )
+from .generation import (
+    PlaneGenerations,
+    ShardScopedStamp,
+    plane_composite,
+)
 from .singleflight import SingleFlight
 
 __all__ = [
+    "PlaneGenerations",
+    "ShardScopedStamp",
+    "plane_composite",
     "CLASS_ALLOW",
     "CLASS_DENY",
     "CLASS_NO_OPINION",

@@ -1,0 +1,151 @@
+"""Shard-scoped composite cache generations.
+
+The decision cache invalidates by generation equality: an entry whose
+stamped generation no longer equals the current composite dies at its
+next lookup (decision_cache.py). Historically the composite folded the
+engine's ``load_generation`` — a single counter that bumps on EVERY swap
+— so an incremental reload that recompiled one shard still nuked the
+whole cache. This module replaces that counter with the serving plane's
+shard lineage (engine/evaluator.py PlaneState):
+
+  * ``PlaneGenerations`` — the live composite: (structural plane id,
+    {shard id: shard generation}). It is what ``current_generation()``
+    returns and what un-scopable entries (default denies, gate answers,
+    fallback-reason strings) are stamped with: any shard change kills
+    them, exactly the old posture.
+  * ``ShardScopedStamp`` — the stamp for a decision whose reason names
+    its determining policies: it records ONLY those policies' shards and
+    their generations. At lookup it equals the current composite iff the
+    structural id matches and each recorded shard still has its recorded
+    generation — so an incremental adoption kills exactly the entries
+    whose shard changed, and shard-B-served entries stay warm across a
+    shard-A edit.
+
+Honesty note (documented in docs/caching.md): a cross-shard edit CAN
+change a decision whose determining policy lives in an untouched shard
+(a new earlier-tier forbid, say). Scoped entries therefore trade bounded
+staleness — the decision-class TTL, the same bound kube-apiserver's
+webhook cache accepts, and the bound that ALREADY applied between a
+store content change and the async recompile — for reload-survivable
+warmth. Promotion/rollback/device-rebuild swaps change the structural id
+and kill everything, scoped or not. Comparison against the legacy tuple
+composites returns NotImplemented, which Python resolves to "not equal":
+mixing old and new stamps can only cause a miss, never a stale hit.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Tuple
+
+__all__ = ["PlaneGenerations", "ShardScopedStamp", "plane_composite"]
+
+
+class PlaneGenerations:
+    """The live composite generation for an engine/fleet-served path.
+
+    ``shards`` and ``lookup`` are references to the serving PlaneState's
+    immutable dicts — construction copies nothing, and the ``is`` fast
+    path in ``__eq__`` makes steady-state lookups O(1)."""
+
+    __slots__ = ("base", "shards", "lookup")
+
+    def __init__(
+        self,
+        base: tuple,
+        shards: Mapping[str, int],
+        lookup: Optional[Mapping[str, str]] = None,
+    ):
+        self.base = base
+        self.shards = shards
+        self.lookup = lookup
+
+    def __repr__(self) -> str:
+        return f"PlaneGenerations(base={self.base!r}, shards={len(self.shards)})"
+
+    def __eq__(self, other):
+        if isinstance(other, PlaneGenerations):
+            return self.base == other.base and (
+                self.shards is other.shards or self.shards == other.shards
+            )
+        if isinstance(other, ShardScopedStamp):
+            return other.__eq__(self)
+        return NotImplemented
+
+    def __ne__(self, other):
+        r = self.__eq__(other)
+        return NotImplemented if r is NotImplemented else not r
+
+    def scoped(self, reason: str):
+        """The stamp for a decision with the given already-rendered
+        reason: scoped to the determining policies' shards when every one
+        of them resolves, else this full composite (conservative). Called
+        once per cache INSERT — the parse cost rides the miss path, never
+        a hit."""
+        if not self.lookup or not reason:
+            return self
+        from ..obs.audit import determining_policies
+
+        pols = determining_policies(reason)
+        if not pols:
+            return self
+        shards = set()
+        for pid in pols:
+            sid = self.lookup.get(pid)
+            if sid is None:
+                return self  # unknown/ambiguous policy: full stamp
+            shards.add(sid)
+        return ShardScopedStamp(
+            self.base,
+            tuple(sorted((sid, self.shards.get(sid)) for sid in shards)),
+        )
+
+
+class ShardScopedStamp:
+    """A cache entry's generation stamp scoped to its determining
+    shards (see module docstring)."""
+
+    __slots__ = ("base", "shard_gens")
+
+    def __init__(self, base: tuple, shard_gens: Tuple[Tuple[str, int], ...]):
+        self.base = base
+        self.shard_gens = shard_gens
+
+    def __repr__(self) -> str:
+        return (
+            f"ShardScopedStamp(base={self.base!r}, shards={self.shard_gens!r})"
+        )
+
+    def __eq__(self, other):
+        if isinstance(other, PlaneGenerations):
+            return self.base == other.base and all(
+                other.shards.get(sid) == gen for sid, gen in self.shard_gens
+            )
+        if isinstance(other, ShardScopedStamp):
+            return self.base == other.base and self.shard_gens == other.shard_gens
+        return NotImplemented
+
+    def __ne__(self, other):
+        r = self.__eq__(other)
+        return NotImplemented if r is NotImplemented else not r
+
+
+def plane_composite(stores, target):
+    """The generation_fn body for compiled backends (cli/webhook.py):
+    ``target`` is the engine or fleet serving the decisions. Planes with
+    shard lineage yield a PlaneGenerations (scoped invalidation — store
+    content generations are deliberately NOT folded in: the cache tracks
+    the SERVING set, and the serving set lags store content by up to a
+    reloader tick exactly as the served answers do); anything else falls
+    back to the legacy kill-all composite."""
+    pg = getattr(target, "plane_generation", None)
+    if pg is not None:
+        gen = pg()
+        if isinstance(gen, PlaneGenerations):
+            return gen
+        return (stores.cache_generation(), gen)
+    if hasattr(target, "cache_epoch"):
+        return (stores.cache_generation(), target.cache_epoch())
+    return (
+        stores.cache_generation(),
+        getattr(target, "load_generation", None),
+    )

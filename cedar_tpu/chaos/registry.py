@@ -89,6 +89,8 @@ SEAMS = {
     "cache.put": "decision cache insert",
     "engine.encode": "native/host batch encode (fastpath._encode_chunk)",
     "engine.dispatch": "device batch launch (fastpath + evaluator paths)",
+    "engine.shard_compile": "per-dirty-shard lowering inside the "
+    "incremental compiler (compiler/shard.py ShardCompiler.compile)",
     "engine.decode": "device readback + verdict decode",
     "pipeline.collect": "batcher worker loop after claiming a batch",
     "pipeline.dispatch_q": "pipeline dispatch stage after queue get",

@@ -270,6 +270,9 @@ def build_server(args) -> WebhookServer:
         tier_engine = TPUPolicyEngine(
             mesh=mesh, segred=segred, name=name,
             warm_max_batch=args.max_batch, use_pallas=use_pallas,
+            incremental=not args.no_incremental_compile,
+            shard_buckets=args.shard_buckets,
+            partition=partition_spec,
         )
         recovery = None
         if args.supervisor_interval_seconds > 0:
@@ -306,6 +309,20 @@ def build_server(args) -> WebhookServer:
 
         return tier_engine, evaluate, evaluate_batch, recovery
 
+    # serving-partition spec (analysis/partition.py): prunes provably
+    # never-matching policies off the device plane — the 100k-rule
+    # org-store posture (docs/performance.md "Giant policy sets")
+    partition_spec = None
+    if getattr(args, "partition_spec", ""):
+        from ..analysis.partition import PartitionSpec
+
+        partition_spec = PartitionSpec.from_file(args.partition_spec)
+        log.info(
+            "serving partition %r: %d constrained slot(s)",
+            partition_spec.name,
+            len(partition_spec.allowed),
+        )
+
     evaluate = None
     evaluate_batch = None
     engine = None
@@ -332,7 +349,16 @@ def build_server(args) -> WebhookServer:
     )
 
     fastpath = None
-    if engine is not None and not args.no_native:
+    if engine is not None and partition_spec is not None and not args.no_native:
+        # the raw native path encodes straight from request bytes and
+        # cannot run the partition conformance gate, so a pruned plane
+        # must serve through the python encode path (which routes
+        # non-conforming requests to the exact interpreter walk)
+        log.info(
+            "serving partition set: native SAR fast path disabled "
+            "(python encode path runs the conformance gate)"
+        )
+    elif engine is not None and not args.no_native:
         from ..engine.fastpath import SARFastPath
         from ..native import native_available, native_error
 
@@ -386,6 +412,9 @@ def build_server(args) -> WebhookServer:
             r_engine = TPUPolicyEngine(
                 mesh=mesh, segred=segred, name=f"authorization-r{i}",
                 warm_max_batch=args.max_batch, use_pallas=use_pallas,
+                incremental=not args.no_incremental_compile,
+                shard_buckets=args.shard_buckets,
+                partition=partition_spec,
             )
             r_recovery = None
             if args.supervisor_interval_seconds > 0:
@@ -425,10 +454,17 @@ def build_server(args) -> WebhookServer:
             args.hedge_delay_ms,
         )
     elif args.fleet_replicas > 1:
-        log.warning(
-            "--fleet-replicas requires --backend tpu with the native fast "
-            "path; serving single-engine"
-        )
+        if partition_spec is not None:
+            log.warning(
+                "--fleet-replicas is unavailable with --partition-spec "
+                "(the fleet's raw fast path cannot run the partition "
+                "conformance gate); serving single-engine"
+            )
+        else:
+            log.warning(
+                "--fleet-replicas requires --backend tpu with the native "
+                "fast path; serving single-engine"
+            )
 
     # admission gets the allow-all final tier (main.go:111-116); it shares
     # the authz stack's validation posture (the synthetic allow-all tail is
@@ -470,27 +506,23 @@ def build_server(args) -> WebhookServer:
         from ..cache import DecisionCache
 
         def _generation_fn(tier_stores, tier_engine, tier_fleet=None):
-            """Composite cache generation: store CONTENT generations plus
-            the engine's load counter when a compiled backend serves the
-            decisions. Content alone bumps at the watch/refresh event,
-            which precedes the async recompile by up to a reloader tick —
-            folding in load_generation makes entries computed from the old
-            compiled set die again when the engine actually swaps, instead
-            of outliving the reload under the new content generation.
-            With a fleet, the composite folds the FLEET epoch plus every
-            replica's load generation (cache_epoch) so no replica can
-            answer a cached decision from a stale policy set."""
-            if tier_fleet is not None:
-                return lambda: (
-                    tier_stores.cache_generation(),
-                    tier_fleet.cache_epoch(),
-                )
-            if tier_engine is None:
+            """Composite cache generation. Interpreter-only tiers keep the
+            store CONTENT generations (any reload kills everything, the
+            pre-shard posture). Compiled backends use the serving plane's
+            SHARD lineage (cache/generation.py plane_composite): entries
+            stamp the determining policies' shard generations, so an
+            incremental reload kills exactly the entries whose shard
+            changed — shard-B-served entries stay warm across a shard-A
+            CRD edit — while full compiles, promotions, rollbacks and
+            device rebuilds change the structural plane id and kill all.
+            With a fleet, the per-replica plane bases fold into one
+            composite so a diverged replica still invalidates."""
+            target = tier_fleet if tier_fleet is not None else tier_engine
+            if target is None:
                 return tier_stores.cache_generation
-            return lambda: (
-                tier_stores.cache_generation(),
-                tier_engine.load_generation,
-            )
+            from ..cache.generation import plane_composite
+
+            return lambda: plane_composite(tier_stores, target)
 
         decision_cache = DecisionCache(
             max_entries=args.decision_cache_size,
@@ -917,6 +949,33 @@ def make_parser() -> argparse.ArgumentParser:
         "tier walk in one device launch): auto enables it on TPU-class "
         "backends with byte-identical lax fallback for unsupported "
         "shapes; off pins the XLA planes (docs/performance.md)",
+    )
+    cedar.add_argument(
+        "--shard-buckets",
+        type=int,
+        default=0,
+        help="tier/bucket shards per tier for incremental compilation "
+        "(compiler/shard.py): a CRD edit re-lowers only its own shard, "
+        "so finer sharding = faster edits, coarser = fewer shards to "
+        "hash. 0 defers to CEDAR_TPU_SHARD_BUCKETS (default 64) "
+        "(docs/performance.md, Giant policy sets)",
+    )
+    cedar.add_argument(
+        "--no-incremental-compile",
+        action="store_true",
+        help="disable shard-granular incremental compilation: every "
+        "reload re-lowers the whole corpus (the pre-shard behavior; "
+        "escape hatch, also CEDAR_TPU_INCREMENTAL=0)",
+    )
+    cedar.add_argument(
+        "--partition-spec",
+        default="",
+        help="JSON serving-partition spec ({'name':..., 'slots': "
+        "{'resource.apiGroup': [...]}}): policies provably never "
+        "matching this universe are pruned off the device plane "
+        "(paged host-side); requests outside the universe answer via "
+        "the exact interpreter walk. Disables the native raw fast "
+        "path (docs/performance.md, Giant policy sets)",
     )
 
     fleet = parser.add_argument_group("engine fleet")

@@ -177,7 +177,16 @@ class _LitRegistry:
         self.lits: List[Literal] = []
 
     def intern(self, lit: Literal) -> int:
-        k = lit.key()
+        # the key tuple is memoized on the Literal: with shard-granular
+        # incremental compilation the SAME Literal objects re-intern on
+        # every reload's repack (cached lowered slices), so key() was a
+        # per-reload O(resident literals) tuple-build. Literal is a frozen
+        # dataclass without slots — writing through __dict__ bypasses the
+        # frozen guard without changing equality/hash semantics.
+        d = lit.__dict__
+        k = d.get("_cedar_lit_key")
+        if k is None:
+            k = d["_cedar_lit_key"] = lit.key()
         idx = self.by_key.get(k)
         if idx is None:
             idx = len(self.lits)

@@ -1,0 +1,11 @@
+"""Deterministic synthesis of org-scale policy corpora and traffic.
+
+A GENERATOR, not fixtures: ``bench.py --scale`` and the shard-diff tests
+(tests/test_scale.py) both synthesize their corpora from a seed at run
+time, so nothing multi-megabyte is checked in and every corpus is
+reproducible from (n, seed, clusters).
+"""
+
+from .synth import SynthCorpus, synth_corpus
+
+__all__ = ["SynthCorpus", "synth_corpus"]

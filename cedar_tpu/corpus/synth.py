@@ -1,0 +1,357 @@
+"""Seeded synthesis of realistic org-wide (multi-cluster) policy sets.
+
+The scale story (ROADMAP open item 4 / docs/performance.md "Giant policy
+sets") needs corpora with two properties real 100k-rule org stores have
+and the 10k bench generator lacks:
+
+  * **cluster locality** — most policies target ONE cluster's API groups
+    (the serving-partition discriminator rides ``resource.apiGroup`` as
+    the first ``when`` conjunct, a schema-mandatory attribute, so the
+    partition pruner can prove never-match before lowering); a small
+    fraction is org-wide (core groups, resident in every partition);
+  * **edit stability** — every policy has its own filename + policy id
+    and a per-policy derived RNG, so replacing one policy leaves every
+    other Policy OBJECT (and its cached content fingerprint) untouched:
+    exactly the CRD-store reload shape the shard differ keys on.
+
+Determinism: ``synth_corpus(n, seed, clusters)`` twice yields identical
+sources; per-policy parameters derive from ``Random((seed, i))``, never
+from a shared stream, so an edit cannot reshuffle its neighbors.
+
+The corpus also synthesizes matched traffic: ``sar_items``/``sar_bodies``
+draw requests that hit the generated policies of ONE cluster (the
+partition a serving process owns), and ``probe_request`` targets the
+dedicated probe policy whose effect ``with_edit()`` flips — the
+single-policy CRD edit the <1s edit-to-serving gate measures.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..lang.authorize import PolicySet
+from ..lang.parser import parse_policies
+
+CORE_GROUPS = ("", "apps", "rbac.authorization.k8s.io")
+RESOURCES = (
+    "pods", "services", "secrets", "configmaps", "deployments",
+    "jobs", "statefulsets", "daemonsets", "cronjobs", "endpoints",
+)
+VERBS = ("get", "list", "watch", "create", "update", "delete", "patch")
+
+PROBE_USER = "probe-user"
+PROBE_RESOURCE = "probes"
+
+
+def _cluster_groups(cluster: int) -> Tuple[str, ...]:
+    return (
+        f"platform.c{cluster}.corp",
+        f"data.c{cluster}.corp",
+        f"ml.c{cluster}.corp",
+    )
+
+
+@dataclass
+class _PolicyParams:
+    """The request-relevant parameters one synthesized policy was built
+    from — retained so traffic synthesis can aim at real policies without
+    re-parsing anything."""
+
+    kind: str
+    cluster: int  # -1 = org-wide (core groups)
+    group: str
+    team: str = ""
+    user: str = ""
+    ns: str = ""
+    resource: str = ""
+    verbs: Tuple[str, ...] = ()
+
+
+def _policy_source(i: int, seed: int, clusters: int) -> Tuple[str, _PolicyParams]:
+    rng = random.Random(f"{seed}:{i}")
+    cluster = i % clusters
+    org_wide = rng.random() < 0.02
+    if org_wide:
+        group = rng.choice(CORE_GROUPS)
+        cluster = -1
+    else:
+        group = rng.choice(_cluster_groups(cluster))
+    prefix = "org" if org_wide else f"c{cluster}"
+    team = f"{prefix}-team-{rng.randint(0, 99)}"
+    user = f"{prefix}-user-{rng.randint(0, 499)}"
+    ns = f"{prefix}-ns-{rng.randint(0, 199)}"
+    res = rng.choice(RESOURCES)
+    verbs = tuple(rng.sample(VERBS, rng.randint(1, 3)))
+    acts = ", ".join(f'k8s::Action::"{v}"' for v in verbs)
+    kind = rng.random()
+    if kind < 0.55:
+        src = (
+            f'permit (principal in k8s::Group::"{team}", action in [{acts}], '
+            "resource is k8s::Resource) when { "
+            f'resource.apiGroup == "{group}" && '
+            f'resource.resource == "{res}" && '
+            "resource has namespace && "
+            f'resource.namespace == "{ns}" }};'
+        )
+        params = _PolicyParams(
+            "team", cluster, group, team=team, ns=ns, resource=res,
+            verbs=verbs,
+        )
+    elif kind < 0.75:
+        src = (
+            f"permit (principal is k8s::User, action in [{acts}], "
+            "resource is k8s::Resource) when { "
+            f'resource.apiGroup == "{group}" && '
+            f'principal.name == "{user}" && '
+            f'resource.resource == "{res}" }};'
+        )
+        params = _PolicyParams(
+            "user", cluster, group, user=user, resource=res, verbs=verbs
+        )
+    elif kind < 0.9:
+        src = (
+            "permit (principal, action in [k8s::Action::\"get\", "
+            'k8s::Action::"list", k8s::Action::"watch"], '
+            "resource is k8s::Resource) when { "
+            f'resource.apiGroup == "{group}" && '
+            f'resource.resource == "{res}" && '
+            "resource has namespace && "
+            f'resource.namespace == "{ns}" }};'
+        )
+        params = _PolicyParams(
+            "read", cluster, group, ns=ns, resource=res,
+            verbs=("get", "list", "watch"),
+        )
+    else:
+        src = (
+            f"forbid (principal, action in [{acts}], "
+            "resource is k8s::Resource) when { "
+            f'resource.apiGroup == "{group}" && '
+            f'resource.resource == "secrets" && '
+            "resource has namespace && "
+            f'resource.namespace == "{ns}" }};'
+        )
+        params = _PolicyParams(
+            "forbid", cluster, group, ns=ns, resource="secrets", verbs=verbs
+        )
+    return src, params
+
+
+def _probe_source(effect: str) -> str:
+    group = _cluster_groups(0)[0]
+    return (
+        f'{effect} (principal is k8s::User, action == k8s::Action::"get", '
+        "resource is k8s::Resource) when { "
+        f'resource.apiGroup == "{group}" && '
+        f'principal.name == "{PROBE_USER}" && '
+        f'resource.resource == "{PROBE_RESOURCE}" }};'
+    )
+
+
+@dataclass
+class SynthCorpus:
+    policies: List[object]  # parsed lang.ast.Policy, one filename each
+    params: List[_PolicyParams]
+    n: int
+    seed: int
+    clusters: int
+    probe_index: int = 0
+    probe_effect: str = "permit"
+    _tier_cache: Optional[List[PolicySet]] = field(default=None, repr=False)
+
+    # ----------------------------------------------------------- policy side
+
+    def tiers(self) -> List[PolicySet]:
+        """The corpus as a single-tier stack (cached: repeated loads must
+        hand the engine IDENTICAL Policy objects, like a store would)."""
+        if self._tier_cache is None:
+            self._tier_cache = [PolicySet(list(self.policies))]
+        return self._tier_cache
+
+    def with_edit(self, index: Optional[int] = None) -> "SynthCorpus":
+        """The corpus after one single-policy CRD edit: by default the
+        probe policy's effect flips (permit <-> forbid), re-parsed alone
+        under its own filename — every OTHER Policy object is shared by
+        identity with this corpus, exactly like a CRD-store relist that
+        reparses one changed object."""
+        idx = self.probe_index if index is None else index
+        effect = self.probe_effect
+        if idx == self.probe_index:
+            effect = "forbid" if effect == "permit" else "permit"
+            src = _probe_source(effect)
+        else:
+            src, _ = _policy_source(idx, self.seed, self.clusters)
+            # flip WHICHEVER effect the policy has — a permit-only
+            # replace on a forbid-kind policy would be a silent no-op
+            # edit (identical corpus, dirty_shards == 0) and fail far
+            # from the cause
+            if src.startswith("permit "):
+                src = "forbid " + src[len("permit "):]
+            elif src.startswith("forbid "):
+                src = "permit " + src[len("forbid "):]
+            else:  # unreachable for generated sources; fail loudly
+                raise ValueError(f"with_edit: unrecognized effect in {src[:40]!r}")
+        old = self.policies[idx]
+        p = parse_policies(src, old.filename)[0]
+        p.policy_id = old.policy_id
+        pols = list(self.policies)
+        pols[idx] = p
+        return SynthCorpus(
+            policies=pols,
+            params=self.params,
+            n=self.n,
+            seed=self.seed,
+            clusters=self.clusters,
+            probe_index=self.probe_index,
+            probe_effect=effect,
+        )
+
+    def partition_dict(self, cluster: int) -> dict:
+        """The serving-partition spec for one cluster: its API groups
+        plus the org-wide core groups."""
+        return {
+            "name": f"cluster-{cluster}",
+            "slots": {
+                "resource.apiGroup": list(
+                    CORE_GROUPS + _cluster_groups(cluster)
+                ),
+            },
+        }
+
+    def spec(self, cluster: int):
+        from ..analysis.partition import PartitionSpec
+
+        return PartitionSpec.from_dict(self.partition_dict(cluster))
+
+    # ---------------------------------------------------------- traffic side
+
+    def _attrs(self, rng: random.Random, cluster: int):
+        """One in-partition SAR's attributes, aimed at the generated
+        policies: ~80% target a known policy's (group, resource, ns,
+        verb), the rest draw in-universe misses."""
+        from ..entities.attributes import Attributes, UserInfo
+
+        cluster_params = [
+            p
+            for p in self.params
+            if p.cluster in (cluster, -1) and p.kind != "probe"
+        ]
+        if cluster_params and rng.random() < 0.8:
+            p = rng.choice(cluster_params)
+            user = p.user or f"c{cluster}-user-{rng.randint(0, 499)}"
+            groups: Tuple[str, ...] = (p.team,) if p.team else ()
+            return Attributes(
+                user=UserInfo(name=user, uid="u", groups=groups),
+                verb=rng.choice(p.verbs or VERBS),
+                namespace=p.ns or f"c{cluster}-ns-{rng.randint(0, 199)}",
+                api_group=p.group,
+                api_version="v1",
+                resource=p.resource or rng.choice(RESOURCES),
+                resource_request=True,
+            )
+        group = rng.choice(CORE_GROUPS + _cluster_groups(cluster))
+        return Attributes(
+            user=UserInfo(
+                name=f"c{cluster}-user-{rng.randint(0, 499)}",
+                uid="u",
+                groups=(f"c{cluster}-team-{rng.randint(0, 99)}",),
+            ),
+            verb=rng.choice(VERBS),
+            namespace=f"c{cluster}-ns-{rng.randint(0, 199)}",
+            api_group=group,
+            api_version="v1",
+            resource=rng.choice(RESOURCES),
+            resource_request=True,
+        )
+
+    def sar_items(self, n: int, cluster: int = 0, seed: int = 1) -> list:
+        """n (EntityMap, Request) pairs of in-partition traffic."""
+        from ..server.authorizer import record_to_cedar_resource
+
+        rng = random.Random(f"{self.seed}:sar:{seed}:{cluster}")
+        return [
+            record_to_cedar_resource(self._attrs(rng, cluster))
+            for _ in range(n)
+        ]
+
+    def sar_bodies(self, n: int, cluster: int = 0, seed: int = 1) -> list:
+        """n raw SubjectAccessReview JSON bodies (webhook wire shape)."""
+        rng = random.Random(f"{self.seed}:sar:{seed}:{cluster}")
+        out = []
+        for _ in range(n):
+            a = self._attrs(rng, cluster)
+            out.append(
+                json.dumps(
+                    {
+                        "apiVersion": "authorization.k8s.io/v1",
+                        "kind": "SubjectAccessReview",
+                        "spec": {
+                            "user": a.user.name,
+                            "uid": "u",
+                            "groups": list(a.user.groups),
+                            "resourceAttributes": {
+                                "verb": a.verb,
+                                "group": a.api_group,
+                                "version": "v1",
+                                "resource": a.resource,
+                                "namespace": a.namespace,
+                            },
+                        },
+                    }
+                ).encode()
+            )
+        return out
+
+    def probe_request(self):
+        """(EntityMap, Request) matching exactly the probe policy."""
+        from ..entities.attributes import Attributes, UserInfo
+        from ..server.authorizer import record_to_cedar_resource
+
+        return record_to_cedar_resource(
+            Attributes(
+                user=UserInfo(name=PROBE_USER, uid="u", groups=()),
+                verb="get",
+                namespace="c0-ns-0",
+                api_group=_cluster_groups(0)[0],
+                api_version="v1",
+                resource=PROBE_RESOURCE,
+                resource_request=True,
+            )
+        )
+
+
+def synth_corpus(
+    n: int, seed: int = 0, clusters: int = 10, filename_prefix: str = "synth"
+) -> SynthCorpus:
+    """Synthesize an ``n``-policy org corpus spread over ``clusters``
+    clusters (index 0 carries the probe policy). One combined parse keeps
+    generation fast; each policy then gets its own filename + stable id
+    so edits and shard bucketing behave like per-object CRD stores."""
+    if n < 1:
+        raise ValueError("synth_corpus: n must be >= 1")
+    if clusters < 1:
+        raise ValueError("synth_corpus: clusters must be >= 1")
+    srcs = [_probe_source("permit")]
+    params: List[_PolicyParams] = [
+        _PolicyParams("probe", 0, _cluster_groups(0)[0])
+    ]
+    for i in range(1, n):
+        src, p = _policy_source(i, seed, clusters)
+        srcs.append(src)
+        params.append(p)
+    policies = parse_policies("\n".join(srcs), filename_prefix)
+    for i, p in enumerate(policies):
+        p.policy_id = f"{filename_prefix}-{i:06d}"
+        p.filename = f"{filename_prefix}-{i:06d}.cedar"
+    return SynthCorpus(
+        policies=list(policies),
+        params=params,
+        n=n,
+        seed=seed,
+        clusters=clusters,
+        probe_index=0,
+        probe_effect="permit",
+    )

@@ -37,9 +37,11 @@ tier's default applies.
 from __future__ import annotations
 
 import atexit
+import itertools
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -129,6 +131,40 @@ def warm_shutdown_set() -> bool:
 class WireSpanError(ValueError):
     """A feature code fell outside its slot's u8 wire span (see
     _CompiledSet.pack_wire); the flat code layout must be used instead."""
+
+
+# process-wide structural plane ids: every FULL compile (or topology /
+# partition change, device rebuild, foreign candidate) gets a fresh id, so
+# shard-scoped cache stamps can never match across structurally different
+# planes even when shard generation numbers collide
+_plane_structs = itertools.count(1)
+
+
+@dataclass
+class PlaneState:
+    """Shard lineage of one compiled set — rides the _CompiledSet through
+    adoptions (fleet propagation, rollout promote/rollback), so every
+    engine serving the set exposes the same shard generations and a
+    rollback restores exactly the generations its cache entries were
+    stamped with.
+
+    ``shard_gens`` bumps per dirty shard on an incremental reload;
+    ``structural`` changes whenever the whole plane is new (full compile,
+    tier-topology or partition change, device rebuild). The decision
+    cache's composite generation (cedar_tpu/cache/generation.py) compares
+    (structural, determining shards' gens) — an incremental adoption
+    kills exactly the entries whose shard changed. The dicts are
+    IMMUTABLE once published: an incremental load builds fresh copies, so
+    a generation snapshot taken mid-reload stays internally consistent."""
+
+    structural: int
+    shard_gens: Dict[str, int] = field(default_factory=dict)
+    shard_hashes: Dict[str, str] = field(default_factory=dict)
+    policy_shard: Dict[str, str] = field(default_factory=dict)
+    scope: str = "full"  # how this plane came to be serving
+    dirty: Tuple[str, ...] = ()
+    partition: Optional[str] = None
+    pruned_policies: int = 0
 
 
 def _round_bucket(n: int, buckets) -> int:
@@ -295,6 +331,16 @@ class _CompiledSet:
 
         self.packed = packed
         self.mesh = mesh
+        # shard lineage (PlaneState), attached by the engine load paths;
+        # None for externally assembled sets (tests, legacy embedders)
+        self.plane: Optional[PlaneState] = None
+        # the PartitionSpec this set was PRUNED under (+ the unpruned tier
+        # stack for non-conforming requests) — attached by load() so the
+        # serving-path conformance gate always matches the plane it guards:
+        # a spec installed or cleared mid-flight takes effect only when a
+        # load() produces a plane compiled under it
+        self.partition_spec = None
+        self.retained_tiers: Optional[list] = None
         # literal/code ids fit int16 whenever the id space allows — halves
         # the per-request transfer
         self.active_dtype = np.int16 if packed.L < 32767 else np.int32
@@ -500,6 +546,9 @@ class TPUPolicyEngine:
         segred: Optional[bool] = None,
         name: str = "engine",
         warm_max_batch: int = 512,
+        incremental: Optional[bool] = None,
+        shard_buckets: Optional[int] = None,
+        partition=None,
     ):
         """mesh: an optional jax.sharding.Mesh with ("data", "policy") axes
         (parallel.mesh.make_mesh). When set, compiled sets are placed with
@@ -517,7 +566,18 @@ class TPUPolicyEngine:
         warm_max_batch bounds the batch-bucket ladder warm-up compiles
         (load-time warm threads and warmup() without an explicit
         max_batch) — the webhook CLI sets it to the server's max_batch so
-        no production bucket ever pays a first-request trace."""
+        no production bucket ever pays a first-request trace.
+
+        incremental: shard-granular compilation (compiler/shard.py) —
+        load() diffs per-shard content hashes and re-lowers only the
+        dirty shards, reassembling the fused plane from cached slices.
+        None defers to CEDAR_TPU_INCREMENTAL (default on).
+        shard_buckets: buckets per tier (CEDAR_TPU_SHARD_BUCKETS, 64).
+        partition: an analysis.partition.PartitionSpec naming this
+        serving process's request universe — never-matching policies are
+        pruned from the device plane (paged off), and non-conforming
+        requests answer via an exact interpreter walk over the retained
+        tier stack instead of the pruned plane."""
         import os
 
         self.schema = schema or AUTHZ_SCHEMA_INFO
@@ -570,6 +630,27 @@ class TPUPolicyEngine:
         # one (store content generations alone bump at CONTENT change,
         # which precedes the async recompile by up to a reloader tick)
         self.load_generation = 0
+        # shard-granular incremental compilation (compiler/shard.py)
+        if incremental is None:
+            incremental = os.environ.get("CEDAR_TPU_INCREMENTAL", "1") != "0"
+        self.incremental = bool(incremental)
+        # 0/None both defer to the env default (the CLI passes 0 through)
+        self.shard_buckets = int(
+            shard_buckets
+            or os.environ.get("CEDAR_TPU_SHARD_BUCKETS", "64")
+        )
+        self._shard_compiler = None
+        # monotonically unique shard generation values (never reused, so a
+        # removed-then-re-added shard can't collide with old cache stamps)
+        self._shard_gen_seq = itertools.count(1)
+        self._last_plane = None  # PlaneState of this engine's last load()
+        # the spec the NEXT load prunes under; the serving gate reads the
+        # spec attached to the compiled set itself (_CompiledSet
+        # .partition_spec), so mid-flight changes can't desync the two
+        self._partition = partition
+        # how the serving plane last changed (load scope / adoption /
+        # rebuild) — /debug/engine surfaces it per engine and per replica
+        self.last_adoption_scope = "none"
         self._lock = threading.Lock()
         self._mesh_steps: dict = {}  # (n_tiers, has_gate) -> pjit step
         self._mesh_bits_step = None
@@ -598,26 +679,79 @@ class TPUPolicyEngine:
         The unspecified default resolves through CEDAR_TPU_WARM_DEFAULT
         (else "async") — the test suite sets it to "off" so dozens of
         incidental engine loads don't each spawn a ~20-compile background
-        ladder; explicit warm= arguments are never overridden."""
+        ladder; explicit warm= arguments are never overridden.
+
+        With incremental compilation (the default), only the shards whose
+        content hash changed re-lower (compiler/shard.py); when the fused
+        plane's jitted shapes also match the prior set's, the background
+        warm ladder is SKIPPED outright — every serving executable is
+        already in the shape-keyed kernel cache, so the swap is
+        compile-free end to end (the `bench.py --scale` trace-counter
+        pin). Returns compile stats incl. ``compile_scope``
+        (full/incremental), ``dirty_shards`` and per-phase seconds."""
         import os
 
         if warm == "default":
             warm = os.environ.get("CEDAR_TPU_WARM_DEFAULT", "async")
         if not tiers:
             raise ValueError("TPUPolicyEngine.load: at least one tier required")
-        compiled: CompiledPolicies = lower_tiers(list(tiers), self.schema)
+        t_start = time.monotonic()
+        if self.incremental:
+            if self._shard_compiler is None:
+                from ..compiler.shard import ShardCompiler
+
+                self._shard_compiler = ShardCompiler(
+                    self.schema, buckets=self.shard_buckets
+                )
+                self._shard_compiler.set_partition(self._partition)
+            compiled, info = self._shard_compiler.compile(list(tiers))
+            hash_s = info["phase_seconds"]["hash"]
+            lower_s = info["phase_seconds"]["lower"]
+        else:
+            t_lower = time.monotonic()
+            compiled: CompiledPolicies = lower_tiers(list(tiers), self.schema)
+            hash_s = 0.0
+            lower_s = time.monotonic() - t_lower
+            info = {
+                "compile_scope": "full",
+                "shards": 0,
+                "dirty_shards": 0,
+                "pruned_policies": 0,
+            }
+        t_pack = time.monotonic()
         packed = pack(compiled)
+        pack_s = time.monotonic() - t_pack
+        t_place = time.monotonic()
         new = _CompiledSet(
             packed, self.device, use_pallas=self.use_pallas, mesh=self.mesh,
             segred=self.segred,
         )
+        place_s = time.monotonic() - t_place
+        prior = self._compiled
+        new.plane = self._next_plane(prior, info)
+        if self.incremental and self._partition is not None:
+            # the spec this plane was PRUNED under + the unpruned tiers
+            # ride the set: the conformance gate and the plane it guards
+            # can never desync across swaps/adoptions
+            new.partition_spec = self._partition
+            new.retained_tiers = list(tiers)
         with self._lock:
             self._compiled = new
             self.load_generation += 1
+        self._last_plane = new.plane
+        self.last_adoption_scope = info["compile_scope"]
+        # a same-shape swap needs NO warm-up: the bucketed executables are
+        # keyed by shape in the process-wide jit cache, so every serving
+        # plane of the prior set serves the new one untraced
+        same_shapes = (
+            prior is not None
+            and self._warm_first.is_set()
+            and self._same_plane_shapes(prior, new)
+        )
         if warm == "sync":
             self._warm_kernels(new)
             self._warm_first.set()
-        elif warm != "off":
+        elif warm != "off" and not same_shapes:
             t = threading.Thread(
                 target=self._warm_thread_main, args=(new,), daemon=True
             )
@@ -625,13 +759,174 @@ class TPUPolicyEngine:
             self._warm_live = t
             t.start()
         else:
-            self._warm_first.set()  # warm-up intentionally skipped
+            self._warm_first.set()  # skipped: intentional, or shapes warm
+        total_s = time.monotonic() - t_start
+        scope = info["compile_scope"]
+        try:
+            from ..server.metrics import (
+                observe_compile_seconds,
+                set_shard_state,
+            )
+
+            observe_compile_seconds("hash", scope, hash_s)
+            observe_compile_seconds("lower", scope, lower_s)
+            observe_compile_seconds("pack", scope, pack_s)
+            observe_compile_seconds("place", scope, place_s)
+            observe_compile_seconds("total", scope, total_s)
+            set_shard_state(
+                self.name,
+                info.get("shards", 0),
+                info.get("dirty_shards", 0),
+                info.get("pruned_policies", 0),
+            )
+        except Exception:  # noqa: BLE001 — metrics never break a reload
+            pass
         return {
             **compiled.stats(),
             "L": packed.L,
             "R": packed.R,
             "native_opaque_policies": packed.native_opaque,
+            "compile_scope": scope,
+            "shards": info.get("shards", 0),
+            "dirty_shards": info.get("dirty_shards", 0),
+            "pruned_policies": info.get("pruned_policies", 0),
+            "warm_skipped": bool(same_shapes and warm not in ("sync",)),
+            "compile_seconds": {
+                "hash": round(hash_s, 4),
+                "lower": round(lower_s, 4),
+                "pack": round(pack_s, 4),
+                "place": round(place_s, 4),
+                "total": round(total_s, 4),
+            },
         }
+
+    def _next_plane(self, prior: Optional[_CompiledSet], info: dict):
+        """PlaneState for a freshly compiled set: continue the prior
+        plane's lineage (same structural id, dirty shards' generations
+        bumped) ONLY when the prior serving plane is the one this engine's
+        own last load produced — an adoption in between (promotion,
+        rollback, rebuild) broke the lineage, so a fresh structural id
+        conservatively kills every scoped cache stamp."""
+        scope = info.get("compile_scope")
+        prev_plane = getattr(prior, "plane", None) if prior is not None else None
+        continues = (
+            scope == "incremental"
+            and prev_plane is not None
+            and prev_plane is getattr(self, "_last_plane", None)
+        )
+        hashes = dict(info.get("shard_hashes", ()))
+        if continues:
+            gens = dict(prev_plane.shard_gens)
+            for sid in list(gens):
+                if sid not in hashes:
+                    del gens[sid]
+            for sid in info.get("dirty", ()):
+                if sid in hashes:
+                    gens[sid] = next(self._shard_gen_seq)
+            for sid in hashes:
+                gens.setdefault(sid, next(self._shard_gen_seq))
+            structural = prev_plane.structural
+        else:
+            structural = next(_plane_structs)
+            gens = {sid: next(self._shard_gen_seq) for sid in hashes}
+        return PlaneState(
+            structural=structural,
+            shard_gens=gens,
+            shard_hashes=hashes,
+            policy_shard=dict(info.get("policy_shard", ())),
+            scope=scope or "full",
+            dirty=tuple(info.get("dirty", ())),
+            partition=info.get("partition"),
+            pruned_policies=info.get("pruned_policies", 0),
+        )
+
+    def _same_plane_shapes(self, a: "_CompiledSet", b: "_CompiledSet") -> bool:
+        """True when every jitted serving shape of ``a`` also serves
+        ``b`` — the warm-ladder skip condition for an incremental swap.
+        Conservative: any doubt returns False and the ladder runs."""
+        pa, pb = a.packed, b.packed
+        if (
+            pa.L != pb.L
+            or pa.R != pb.R
+            or pa.n_tiers != pb.n_tiers
+            or pa.has_gate != pb.has_gate
+            or bool(pa.fallback) != bool(pb.fallback)
+            or a.code_dtype != b.code_dtype
+            or a.active_dtype != b.active_dtype
+            or pa.table.rows.shape != pb.table.rows.shape
+            or (a.pallas_args is None) != (b.pallas_args is None)
+            or a.segs != b.segs  # jit-static: a layout change retraces
+        ):
+            return False
+        if (a.wire is None) != (b.wire is None):
+            return False
+        if a.wire is not None:
+            if len(a.wire[0]) + a._wire_pad8 != len(b.wire[0]) + b._wire_pad8:
+                return False
+            if len(a.wire[1]) + a._wire_padw != len(b.wire[1]) + b._wire_padw:
+                return False
+        return True
+
+    def set_partition(self, spec) -> None:
+        """Install (or clear) the serving-partition spec; takes effect
+        ATOMICALLY at the next load() — shards re-filter against the new
+        universe (paging pruned policies on/off the device plane) and the
+        conformance gate follows the new plane, never the old one (the
+        spec rides the compiled set, see _CompiledSet.partition_spec)."""
+        self._partition = spec
+        if self._shard_compiler is not None:
+            self._shard_compiler.set_partition(spec)
+
+    @property
+    def partition(self):
+        return self._partition
+
+    def plane_generation(self):
+        """The decision cache's composite-generation unit for this engine
+        (cedar_tpu/cache/generation.py): a PlaneGenerations over the
+        serving plane's shard lineage when available, else a plain tuple
+        that changes on every swap (the legacy any-reload-kills-all
+        posture). Cheap: wraps references, copies nothing."""
+        cs = self._compiled
+        if cs is None:
+            return ("unloaded", self.load_generation)
+        pl = cs.plane
+        if pl is None:
+            return ("plane", self.load_generation)
+        from ..cache.generation import PlaneGenerations
+
+        return PlaneGenerations(
+            ("plane", pl.structural), pl.shard_gens, pl.policy_shard
+        )
+
+    def shard_status(self) -> dict:
+        """The /debug/engine shard document: shard count/hashes, last
+        reload's scope + dirty set, partition residency."""
+        cs = self._compiled
+        pl = cs.plane if cs is not None else None
+        if pl is None:
+            return {"scope": self.last_adoption_scope, "shards": 0}
+        hashes = dict(sorted(pl.shard_hashes.items())[:256])
+        doc = {
+            "scope": pl.scope,
+            "last_adoption_scope": self.last_adoption_scope,
+            "shards": len(pl.shard_hashes),
+            "dirty": list(pl.dirty),
+            "partition": pl.partition,
+            "pruned_policies": pl.pruned_policies,
+            "structural": pl.structural,
+            "hashes": {sid: h[:12] for sid, h in hashes.items()},
+            "hashes_truncated": len(pl.shard_hashes) > 256,
+        }
+        if self._partition is not None and self._shard_compiler is not None:
+            # paging residency report (analysis/partition.py): what the
+            # serving partition kept on the device vs paged host-side
+            from ..analysis.partition import partition_report
+
+            doc["residency"] = partition_report(
+                self._partition, self._shard_compiler.shard_map()
+            )
+        return doc
 
     def warm_ready(self) -> bool:
         """True once the first serving shape has compiled (or warm-up was
@@ -845,6 +1140,11 @@ class TPUPolicyEngine:
             self._compiled = compiled
             self.load_generation += 1
             generation = self.load_generation
+        # shard lineage rides the set (PlaneState): every engine serving
+        # it exposes the same shard generations, and /debug surfaces how
+        # the plane arrived here
+        pl = getattr(compiled, "plane", None)
+        self.last_adoption_scope = pl.scope if pl is not None else "adopted"
         self._warm_first.set()
         return prior, generation
 
@@ -864,6 +1164,7 @@ class TPUPolicyEngine:
                 return False
             self._compiled = None
             self.load_generation += 1
+        self.last_adoption_scope = "cleared"
         return True
 
     def rebuild_compiled(self) -> bool:
@@ -885,6 +1186,23 @@ class TPUPolicyEngine:
             cs.packed, self.device, use_pallas=self.use_pallas,
             mesh=self.mesh, segred=self.segred,
         )
+        # the rebuilt set serves the same pack: the partition gate (and
+        # its exact-answer tier stack) must survive the device loss too
+        new.partition_spec = cs.partition_spec
+        new.retained_tiers = cs.retained_tiers
+        if cs.plane is not None:
+            # fresh structural id: cached decisions from the dead plane
+            # die (PR 6 posture), even though the pack is unchanged
+            new.plane = PlaneState(
+                structural=next(_plane_structs),
+                shard_gens=dict(cs.plane.shard_gens),
+                shard_hashes=dict(cs.plane.shard_hashes),
+                policy_shard=cs.plane.policy_shard,
+                scope="rebuild",
+                dirty=(),
+                partition=cs.plane.partition,
+                pruned_policies=cs.plane.pruned_policies,
+            )
         with self._lock:
             # a concurrent load()/adopt_compiled() swap wins: its set is
             # newer than the one we re-placed
@@ -892,6 +1210,7 @@ class TPUPolicyEngine:
                 return False
             self._compiled = new
             self.load_generation += 1
+        self.last_adoption_scope = "rebuild"
         return True
 
     def _mesh_step(self, packed: PackedPolicySet):
@@ -916,7 +1235,7 @@ class TPUPolicyEngine:
         c = self._compiled
         if c is None:
             return {}
-        return {
+        out = {
             "rules": c.packed.n_rules,
             "lits": c.packed.n_lits,
             "L": c.packed.L,
@@ -924,6 +1243,13 @@ class TPUPolicyEngine:
             "fallback_policies": len(c.packed.fallback),
             "native_opaque_policies": c.packed.native_opaque,
         }
+        if c.plane is not None:
+            out["shard_count"] = len(c.plane.shard_hashes)
+            out["compile_scope"] = c.plane.scope
+            if c.plane.partition:
+                out["partition"] = c.plane.partition
+                out["pruned_policies"] = c.plane.pruned_policies
+        return out
 
     # ----------------------------------------------------------- evaluation
 
@@ -933,6 +1259,70 @@ class TPUPolicyEngine:
         return self.evaluate_batch([(entities, request)])[0]
 
     def evaluate_batch(
+        self, items: Sequence[Tuple[EntityMap, Request]]
+    ) -> List[Tuple[str, Diagnostics]]:
+        # the gate reads the spec off the SERVING set, not the engine: a
+        # spec installed/cleared via set_partition() guards only planes
+        # actually compiled under it (the engine-level field feeds the
+        # next load), so gate and plane can never desync
+        cs = self._compiled
+        spec = cs.partition_spec if cs is not None else None
+        if spec is not None:
+            # partition-pruned plane: requests OUTSIDE the declared
+            # universe must not be answered from it — the pruned rules
+            # could have matched them. They take the exact interpreter
+            # walk over the retained (unpruned) tier stack instead;
+            # conforming rows ride the device exactly as without a spec.
+            tiers = cs.retained_tiers or []
+            overrides = {
+                i: self._interpret_tiers(tiers, em, req)
+                for i, (em, req) in enumerate(items)
+                if not spec.conforms(em, req)
+            }
+            if overrides:
+                rest = [
+                    it for i, it in enumerate(items) if i not in overrides
+                ]
+                inner = self._evaluate_batch_compiled(rest) if rest else []
+                out: List[Tuple[str, Diagnostics]] = []
+                k = 0
+                for i in range(len(items)):
+                    if i in overrides:
+                        out.append(overrides[i])
+                    else:
+                        out.append(inner[k])
+                        k += 1
+                return out
+        return self._evaluate_batch_compiled(items)
+
+    def _interpret_tiers(
+        self, tiers: list, entities: EntityMap, request: Request
+    ) -> Tuple[str, Diagnostics]:
+        """Exact tiered interpreter walk over the retained (unpruned)
+        policy sets — mirrors TieredPolicyStores.is_authorized INCLUDING
+        its per-tier exception containment: a raising tier reads as
+        deny-with-error (an explicit signal) instead of unwinding into
+        the caller, where guarded_call would misread it as a device
+        failure and feed a healthy plane's breaker."""
+        decision, diag = DENY, Diagnostics()
+        for i, ps in enumerate(tiers):
+            try:
+                decision, diag = ps.is_authorized(entities, request)
+            except Exception as e:  # noqa: BLE001 — one sick tier must not 500
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "partition fallback tier %d evaluation failed", i
+                )
+                decision, diag = DENY, Diagnostics(errors=[f"tier {i}: {e}"])
+            if i == len(tiers) - 1:
+                break
+            if decision == DENY and not diag.reasons and not diag.errors:
+                continue  # no explicit signal; fall through
+            break
+        return decision, diag
+
+    def _evaluate_batch_compiled(
         self, items: Sequence[Tuple[EntityMap, Request]]
     ) -> List[Tuple[str, Diagnostics]]:
         # chaos seam (docs/resilience.md): the hybrid evaluate path's

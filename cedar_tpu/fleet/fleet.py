@@ -112,6 +112,26 @@ class EngineFleet:
         so no replica can answer from a stale policy set."""
         return (self._epoch,) + self.load_generation
 
+    def plane_generation(self):
+        """Shard-scoped composite unit (cedar_tpu/cache/generation.py):
+        the per-replica plane bases folded into one PlaneGenerations over
+        replica 0's shard map. Replicas serve the SAME adopted set under
+        the barrier invariant, so one shard map describes the fleet; a
+        replica that diverges (mid-rebuild, failed restore) changes the
+        folded base, conservatively killing every scoped stamp."""
+        gens = [r.engine.plane_generation() for r in self.replicas]
+        first = gens[0]
+        from ..cache.generation import PlaneGenerations
+
+        if all(isinstance(g, PlaneGenerations) for g in gens):
+            return PlaneGenerations(
+                tuple(g.base for g in gens), first.shards, first.lookup
+            )
+        # some replica has no shard lineage: legacy kill-all composite
+        return (self._epoch,) + tuple(
+            g.base if isinstance(g, PlaneGenerations) else g for g in gens
+        )
+
     @property
     def stats(self) -> dict:
         return {
@@ -311,6 +331,12 @@ class EngineFleet:
             "replicas": [r.health() for r in self.replicas],
             "epoch": self._epoch,
             "load_generation": list(self.load_generation),
+            # per-replica adoption scope: after an incremental reload every
+            # replica should read "incremental" (compile-free propagation);
+            # a stray "full"/"rebuild" marks the replica that diverged
+            "adoption_scope": {
+                r.name: r.engine.last_adoption_scope for r in self.replicas
+            },
             "router": self.router.stats(),
         }
 

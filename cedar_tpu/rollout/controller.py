@@ -78,6 +78,9 @@ def _clone_engine(name: str, template):
         segred=template.segred,
         name=name,
         warm_max_batch=template.warm_max_batch,
+        incremental=template.incremental,
+        shard_buckets=template.shard_buckets,
+        partition=template.partition,
     )
 
 

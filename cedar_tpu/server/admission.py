@@ -251,6 +251,12 @@ class CedarAdmissionHandler:
             return
         key, generation = keyed
         try:
+            # shard-scoped stamp when the message names the determining
+            # policies (cedar_tpu/cache/generation.py): an incremental
+            # reload then kills exactly the entries whose shard changed
+            scoped = getattr(generation, "scoped", None)
+            if scoped is not None and response.message:
+                generation = scoped(response.message)
             self.cache.put(
                 key,
                 (response.allowed, response.message),

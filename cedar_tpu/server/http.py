@@ -213,12 +213,22 @@ def sar_response(
 def _engine_doc(engine) -> dict:
     """One engine's /debug/engine entry (shared by the single-engine and
     per-replica renderings)."""
-    return {
+    doc = {
         "name": engine.name,
         "warm_ready": engine.warm_ready(),
         "load_generation": engine.load_generation,
         **engine.stats,
     }
+    # shard lineage of the serving plane (incremental compilation,
+    # docs/performance.md "Giant policy sets"): per-shard content hashes,
+    # last reload's scope + dirty set, partition residency
+    shard_status = getattr(engine, "shard_status", None)
+    if shard_status is not None:
+        try:
+            doc["shards"] = shard_status()
+        except Exception:  # noqa: BLE001 — debug must not 500
+            log.exception("shard status failed")
+    return doc
 
 
 class WebhookServer:
@@ -683,7 +693,15 @@ class WebhookServer:
             res = self._authorize_uncached(body, request_id, coalesce_key=key)
             if res[2] is None:
                 try:
-                    cache.put(key, (res[0], res[1]), res[0], generation=gen)
+                    # shard-scoped stamp when the reason names the
+                    # determining policies (cache/generation.py): an
+                    # incremental reload then kills exactly the entries
+                    # whose shard changed instead of the whole cache
+                    g = gen
+                    scoped = getattr(gen, "scoped", None)
+                    if scoped is not None:
+                        g = scoped(res[1])
+                    cache.put(key, (res[0], res[1]), res[0], generation=g)
                 except Exception:  # noqa: BLE001 — the answer still serves
                     log.exception("decision cache insert failed")
             return res

@@ -414,6 +414,51 @@ engine_warmup_seconds = REGISTRY.register(
     )
 )
 
+compile_seconds = REGISTRY.register(
+    Histogram(
+        "cedar_compile_seconds",
+        "Policy-set compilation latency partitioned by phase (hash = "
+        "shard-plan fingerprinting, lower = per-shard lowering, pack = "
+        "fused plane assembly, place = device placement, total) and scope "
+        "(full = every shard recompiled, incremental = only dirty shards "
+        "re-lowered, cached slices reused). A CRD edit on a sharded plane "
+        "should show scope=incremental with lower+pack+place well under a "
+        "second (docs/performance.md, Giant policy sets).",
+        ["phase", "scope"],
+        [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120],
+    )
+)
+
+policy_shards = REGISTRY.register(
+    Gauge(
+        "cedar_policy_shards",
+        "Tier/bucket shards in the engine's current compiled plane, "
+        "partitioned by engine.",
+        ["engine"],
+    )
+)
+
+dirty_shards = REGISTRY.register(
+    Gauge(
+        "cedar_dirty_shards",
+        "Shards recompiled by the engine's LAST reload (0 after a no-op "
+        "reload, 1 after a single-policy CRD edit, = cedar_policy_shards "
+        "after a full compile), partitioned by engine.",
+        ["engine"],
+    )
+)
+
+pruned_policies = REGISTRY.register(
+    Gauge(
+        "cedar_pruned_policies",
+        "Policies excluded from the device plane by the serving-partition "
+        "never-match proof (analysis/partition.py), partitioned by engine. "
+        "Pruned policies stay host-side in the shard cache and page back "
+        "in when the partition spec changes.",
+        ["engine"],
+    )
+)
+
 # Host-side budget metrics (docs/performance.md "Host-side budget"): the
 # packed-decode counters prove the batch-wide word transfer is actually
 # riding one D2H per batch (chunks/transfer > 1 under load), and the
@@ -851,6 +896,16 @@ def set_slo_target(path: str, slo: str, value: float) -> None:
 
 def set_engine_warmup_seconds(engine: str, seconds: float) -> None:
     engine_warmup_seconds.set(round(seconds, 6), engine=engine)
+
+
+def observe_compile_seconds(phase: str, scope: str, seconds: float) -> None:
+    compile_seconds.observe(seconds, phase=phase, scope=scope)
+
+
+def set_shard_state(engine: str, shards: int, dirty: int, pruned: int) -> None:
+    policy_shards.set(shards, engine=engine)
+    dirty_shards.set(dirty, engine=engine)
+    pruned_policies.set(pruned, engine=engine)
 
 
 def record_packed_decode(path: str, chunks: int) -> None:

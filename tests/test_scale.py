@@ -1,0 +1,697 @@
+"""Giant policy sets: sharded planes & incremental compilation (ISSUE 11).
+
+Pins the whole scale stack (docs/performance.md "Giant policy sets"):
+
+  * shard plan — stable per-shard content hashes; a single-policy edit
+    changes exactly one shard's hash and recompiles exactly that shard;
+  * incremental loads are decision-equivalent to full compiles (before
+    AND after edits), re-lower only dirty shards, and swap with ZERO
+    fresh jit traces when the bucketed shapes hold (warm-ladder skip);
+  * partition pruning — never-matching policies page off the device
+    plane with in-universe decisions byte-identical to an unpruned
+    engine, and non-conforming requests answered by the exact
+    interpreter walk;
+  * cache scoping — the composite generation folds per-shard
+    generations: an edit to shard A leaves shard-B-served entries WARM
+    (the satellite 2 regression) while full swaps still kill everything;
+  * partial failure — a shard that fails to compile mid-reload (chaos
+    ``engine.shard_compile``) leaves the engine serving the prior
+    complete set, and a fleet adoption failure mid-swap restores the
+    already-swapped replicas compile-free (the PR 7 promotion barrier at
+    shard granularity), including under an armed ``engine.dispatch``
+    device fault;
+  * the synth corpus generator (cedar_tpu/corpus) is deterministic and
+    edit-stable, and /debug/engine + cedar_compile_seconds surface the
+    shard state.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from cedar_tpu.analysis.partition import PartitionSpec
+from cedar_tpu.cache import DecisionCache, PlaneGenerations, plane_composite
+from cedar_tpu.cache.generation import ShardScopedStamp
+from cedar_tpu.chaos import ChaosError
+from cedar_tpu.chaos.registry import default_registry
+from cedar_tpu.compiler import shard as shard_mod
+from cedar_tpu.compiler.shard import (
+    ShardCompiler,
+    policy_fingerprint,
+    shard_bucket,
+)
+from cedar_tpu.corpus import synth_corpus
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.lang.format import format_policy
+from cedar_tpu.ops.match import kernel_trace_count
+from cedar_tpu.server.admission import (
+    CedarAdmissionHandler,
+    allow_all_admission_policy_store,
+)
+from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+from cedar_tpu.server.http import WebhookServer
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+BUCKETS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    default_registry().reset()
+    yield
+    default_registry().reset()
+
+
+def small_corpus(n=120, seed=7, clusters=3):
+    return synth_corpus(n, seed=seed, clusters=clusters)
+
+
+def load_engine(corpus, partition=None, incremental=True, buckets=BUCKETS):
+    e = TPUPolicyEngine(
+        incremental=incremental, shard_buckets=buckets, partition=partition
+    )
+    stats = e.load(corpus.tiers(), warm="off")
+    return e, stats
+
+
+def decisions(engine, items):
+    return [d for d, _ in engine.evaluate_batch(items)]
+
+
+# ----------------------------------------------------------------- shard plan
+
+
+class TestShardPlan:
+    def test_fingerprint_memoized_and_content_sensitive(self):
+        c = small_corpus()
+        p = c.policies[3]
+        fp1 = policy_fingerprint(p)
+        assert policy_fingerprint(p) == fp1  # memoized, stable
+        edited = c.with_edit(3)
+        assert policy_fingerprint(edited.policies[3]) != fp1
+        # untouched neighbors share OBJECTS, so their fingerprints are
+        # literally the same cached strings
+        assert edited.policies[4] is c.policies[4]
+
+    def test_bucket_is_identity_keyed(self):
+        c = small_corpus()
+        edited = c.with_edit(5)
+        assert shard_bucket(c.policies[5], BUCKETS) == shard_bucket(
+            edited.policies[5], BUCKETS
+        )
+
+    def test_single_edit_dirties_exactly_one_shard(self):
+        c = small_corpus()
+        sc = ShardCompiler(buckets=BUCKETS)
+        _, info1 = sc.compile(c.tiers())
+        assert info1["compile_scope"] == "full"
+        assert info1["dirty_shards"] == info1["shards"]
+        _, info_same = sc.compile(c.tiers())
+        assert info_same["dirty_shards"] == 0
+        _, info2 = sc.compile(c.with_edit().tiers())
+        assert info2["compile_scope"] == "incremental"
+        assert info2["dirty_shards"] == 1
+        # exactly one hash differs
+        changed = [
+            sid
+            for sid, h in info2["shard_hashes"].items()
+            if info1["shard_hashes"].get(sid) != h
+        ]
+        assert changed == list(info2["dirty"])
+
+    def test_dirty_shards_relower_only_their_policies(self, monkeypatch):
+        c = small_corpus()
+        sc = ShardCompiler(buckets=BUCKETS)
+        sc.compile(c.tiers())
+        lowered = []
+        real = shard_mod.lower_policy
+
+        def counting(policy, tier, schema):
+            lowered.append(policy.policy_id)
+            return real(policy, tier, schema)
+
+        monkeypatch.setattr(shard_mod, "lower_policy", counting)
+        edited = c.with_edit()
+        _, info = sc.compile(edited.tiers())
+        assert info["dirty_shards"] == 1
+        # only the edited policy's shard members re-lowered
+        probe_id = edited.policies[edited.probe_index].policy_id
+        assert probe_id in lowered
+        dirty_bucket = shard_bucket(edited.policies[edited.probe_index], BUCKETS)
+        assert all(
+            shard_bucket(edited.tiers()[0].get(pid), BUCKETS) == dirty_bucket
+            for pid in lowered
+        )
+        assert len(lowered) < len(c.policies)
+
+    def test_policy_removal_and_topology_change(self):
+        c = small_corpus()
+        sc = ShardCompiler(buckets=BUCKETS)
+        _, info1 = sc.compile(c.tiers())
+        # remove one policy: its shard is dirty (hash changed), not full
+        pols = list(c.policies)
+        removed = pols.pop(10)
+        _, info2 = sc.compile([PolicySet(pols)])
+        assert info2["compile_scope"] == "incremental"
+        assert info2["dirty_shards"] == 1
+        assert shard_bucket(removed, BUCKETS) is not None
+        # tier-topology change forces a full compile
+        _, info3 = sc.compile([PolicySet(pols), PolicySet([])])
+        assert info3["compile_scope"] == "full"
+
+
+# ---------------------------------------------------------------- incremental
+
+
+class TestIncrementalEngine:
+    def test_decision_equivalence_full_vs_incremental(self):
+        c = small_corpus()
+        items = c.sar_items(200, cluster=0)
+        e_inc, _ = load_engine(c)
+        e_full, _ = load_engine(c, incremental=False)
+        assert decisions(e_inc, items) == decisions(e_full, items)
+        edited = c.with_edit()
+        e_inc.load(edited.tiers(), warm="off")
+        e_full.load(edited.tiers(), warm="off")
+        assert decisions(e_inc, items) == decisions(e_full, items)
+        em, req = c.probe_request()
+        assert e_inc.evaluate(em, req)[0] == e_full.evaluate(em, req)[0] == (
+            "deny"
+        )
+
+    def test_edit_swaps_compile_free(self):
+        c = small_corpus()
+        e, stats = load_engine(c)
+        em, req = c.probe_request()
+        assert e.evaluate(em, req)[0] == "allow"  # warms the b=1 shape
+        tc0 = kernel_trace_count()
+        stats2 = e.load(c.with_edit().tiers(), warm="off")
+        assert e.evaluate(em, req)[0] == "deny"
+        assert kernel_trace_count() - tc0 == 0
+        assert stats2["compile_scope"] == "incremental"
+        assert stats2["dirty_shards"] == 1
+        assert stats2["warm_skipped"] is True
+
+    def test_plane_generations_bump_per_shard(self):
+        c = small_corpus()
+        e, _ = load_engine(c)
+        pl1 = e.compiled_set.plane
+        e.load(c.with_edit().tiers(), warm="off")
+        pl2 = e.compiled_set.plane
+        assert pl2.structural == pl1.structural  # same lineage
+        changed = {
+            sid
+            for sid in pl2.shard_gens
+            if pl2.shard_gens[sid] != pl1.shard_gens.get(sid)
+        }
+        assert changed == set(pl2.dirty) and len(changed) == 1
+
+    def test_adoption_breaks_lineage(self):
+        # a foreign compiled set adopted in (rollout promotion shape) must
+        # change the structural id so every scoped cache stamp dies
+        c = small_corpus()
+        e, _ = load_engine(c)
+        donor, _ = load_engine(c.with_edit())
+        s0 = e.compiled_set.plane.structural
+        e.adopt_compiled(donor.compiled_set)
+        assert e.compiled_set.plane.structural != s0
+        assert e.last_adoption_scope == "full"
+
+
+# ------------------------------------------------------------------ partition
+
+
+class TestPartition:
+    def test_pruning_differential_and_residency(self):
+        c = small_corpus(n=200, clusters=4)
+        spec = c.spec(0)
+        e_pruned, stats_p = load_engine(c, partition=spec)
+        e_ref, stats_r = load_engine(c)
+        assert stats_p["pruned_policies"] > 0
+        assert stats_p["rules"] < stats_r["rules"]
+        items = c.sar_items(300, cluster=0)  # in-universe traffic
+        assert decisions(e_pruned, items) == decisions(e_ref, items)
+
+    def test_nonconforming_requests_take_interpreter_walk(self):
+        c = small_corpus(n=200, clusters=4)
+        spec = c.spec(0)
+        e_pruned, _ = load_engine(c, partition=spec)
+        e_ref, _ = load_engine(c)
+        # cluster-1 traffic is OUTSIDE cluster 0's universe
+        items = c.sar_items(200, cluster=1)
+        non_conforming = [
+            it for it in items if not spec.conforms(it[0], it[1])
+        ]
+        assert non_conforming  # the stream must actually exercise the gate
+        assert decisions(e_pruned, items) == decisions(e_ref, items)
+
+    def test_conforms_missing_value_is_safe(self):
+        spec = PartitionSpec.from_dict(
+            {"name": "p", "slots": {"resource.apiGroup": ["", "apps"]}}
+        )
+        c = small_corpus()
+        em, req = c.probe_request()
+        # probe carries a cluster-0 group: out of this universe
+        assert not spec.conforms(em, req)
+
+    def test_error_signals_survive_pruning(self):
+        # a policy whose condition ERRORS in-universe must stay resident
+        # even when another conjunct looks out-of-universe — the error is
+        # an explicit tier-stop signal. The unguarded resource.namespace
+        # access errors when namespace is absent, so the policy has live
+        # error clauses and must NOT be pruned.
+        src = (
+            "permit (principal, action, resource is k8s::Resource) when { "
+            'resource.namespace == "x" && resource.apiGroup == "other" };'
+        )
+        ps = PolicySet.from_source(src, "err")
+        spec = PartitionSpec.from_dict(
+            {"name": "p", "slots": {"resource.apiGroup": [""]}}
+        )
+        e = TPUPolicyEngine(
+            incremental=True, shard_buckets=4, partition=spec
+        )
+        stats = e.load([ps], warm="off")
+        assert stats["pruned_policies"] == 0
+
+    def test_spec_change_repages_shards(self):
+        c = small_corpus(n=200, clusters=4)
+        e, stats0 = load_engine(c, partition=c.spec(0))
+
+        def resident_ids():
+            return {
+                lp.policy.policy_id
+                for s in e._shard_compiler.shard_map().values()
+                for lp in s.lowered
+            }
+
+        ids0 = resident_ids()
+        e.set_partition(c.spec(1))
+        stats1 = e.load(c.tiers(), warm="off")
+        # different universe -> every shard re-filters (paged), and the
+        # resident policy sets actually differ (cluster-0 locals out,
+        # cluster-1 locals in)
+        assert stats1["dirty_shards"] == stats1["shards"]
+        ids1 = resident_ids()
+        assert ids0 - ids1 and ids1 - ids0
+
+
+# -------------------------------------------------------------- cache scoping
+
+
+class TestCacheScoping:
+    def _stamp_env(self):
+        base = ("plane", 1)
+        shards = {"t0b0001": 5, "t0b0002": 9}
+        lookup = {"pa": "t0b0001", "pb": "t0b0002"}
+        return PlaneGenerations(base, shards, lookup)
+
+    def test_scoped_stamp_survives_other_shard_bump(self):
+        gen = self._stamp_env()
+        reason = json.dumps({"reasons": [{"policy": "pb"}]})
+        stamp = gen.scoped(reason)
+        assert isinstance(stamp, ShardScopedStamp)
+        # shard A bumps; B-scoped stamp still equal, A-scoped dies
+        bumped = PlaneGenerations(
+            gen.base, {"t0b0001": 6, "t0b0002": 9}, gen.lookup
+        )
+        assert stamp == bumped and not (stamp != bumped)
+        stamp_a = gen.scoped(json.dumps({"reasons": [{"policy": "pa"}]}))
+        assert stamp_a != bumped
+        # structural change kills both
+        promoted = PlaneGenerations(("plane", 2), gen.shards, gen.lookup)
+        assert stamp != promoted and stamp_a != promoted
+
+    def test_unknown_policy_and_reasonless_fall_back_to_full(self):
+        gen = self._stamp_env()
+        assert gen.scoped("") is gen
+        assert gen.scoped("NonResourcePath") is gen
+        assert gen.scoped(json.dumps({"reasons": [{"policy": "zz"}]})) is gen
+        full = gen.scoped(json.dumps({"reasons": []}))
+        assert full is gen
+        # the full composite dies on ANY shard bump
+        bumped = PlaneGenerations(
+            gen.base, {"t0b0001": 6, "t0b0002": 9}, gen.lookup
+        )
+        assert full != bumped
+
+    def test_legacy_tuple_comparison_is_miss_not_crash(self):
+        gen = self._stamp_env()
+        assert (gen == ("old", "tuple")) is False
+        assert (gen != ("old", "tuple")) is True
+
+    def test_edit_to_shard_a_leaves_shard_b_entries_warm(self):
+        """Satellite 2 regression: end-to-end through the webhook server
+        + decision cache over an engine-backed path."""
+        c = small_corpus(n=60, seed=9, clusters=1)
+        store = MemoryStore("scale", c.tiers()[0])
+        stores = TieredPolicyStores([store])
+        engine = TPUPolicyEngine(incremental=True, shard_buckets=BUCKETS)
+        engine.load([store.policy_set()], warm="off")
+        authorizer = CedarWebhookAuthorizer(
+            stores,
+            evaluate=engine.evaluate,
+            evaluate_batch=engine.evaluate_batch,
+        )
+        handler = CedarAdmissionHandler(
+            TieredPolicyStores([store, allow_all_admission_policy_store()])
+        )
+        cache = DecisionCache(
+            generation_fn=lambda: plane_composite(stores, engine)
+        )
+        server = WebhookServer(
+            authorizer, handler, decision_cache=cache
+        )
+        # two requests whose ALLOW decisions come from policies in
+        # DIFFERENT shards: the probe policy (shard A) and a user-kind
+        # policy from another bucket (shard B)
+        probe = c.policies[c.probe_index]
+        bucket_a = shard_bucket(probe, BUCKETS)
+        body_a = self._probe_body()
+        body_b = None
+        for i, p in enumerate(c.params):
+            if (
+                p.kind == "user"
+                and shard_bucket(c.policies[i], BUCKETS) != bucket_a
+            ):
+                body_b = self._user_body(p)
+                break
+        assert body_b is not None
+        resp_a = server.handle_authorize(body_a)
+        resp_b = server.handle_authorize(body_b)
+        assert resp_a["status"]["allowed"] and resp_b["status"]["allowed"]
+        # edit shard A's policy (probe flips to forbid), reload the engine
+        edited = c.with_edit()
+        store._policies = edited.tiers()[0]
+        engine.load([store.policy_set()], warm="off")
+        h0, m0 = self._counts(cache)
+        resp_b2 = server.handle_authorize(body_b)
+        h1, m1 = self._counts(cache)
+        assert (h1 - h0, m1 - m0) == (1, 0), "shard-B entry must stay warm"
+        assert resp_b2 == resp_b
+        resp_a2 = server.handle_authorize(body_a)
+        h2, m2 = self._counts(cache)
+        assert m2 - m1 == 1, "shard-A entry must die"
+        assert not resp_a2["status"]["allowed"]
+
+    @staticmethod
+    def _counts(cache):
+        s = cache.stats()
+        return s["hits"], s["misses"]
+
+    @staticmethod
+    def _probe_body():
+        from cedar_tpu.corpus.synth import PROBE_RESOURCE, PROBE_USER
+
+        return json.dumps(
+            {
+                "apiVersion": "authorization.k8s.io/v1",
+                "kind": "SubjectAccessReview",
+                "spec": {
+                    "user": PROBE_USER,
+                    "uid": "u",
+                    "groups": [],
+                    "resourceAttributes": {
+                        "verb": "get",
+                        "group": "platform.c0.corp",
+                        "version": "v1",
+                        "resource": PROBE_RESOURCE,
+                        "namespace": "c0-ns-0",
+                    },
+                },
+            }
+        ).encode()
+
+    @staticmethod
+    def _user_body(p):
+        return json.dumps(
+            {
+                "apiVersion": "authorization.k8s.io/v1",
+                "kind": "SubjectAccessReview",
+                "spec": {
+                    "user": p.user,
+                    "uid": "u",
+                    "groups": [],
+                    "resourceAttributes": {
+                        "verb": p.verbs[0],
+                        "group": p.group,
+                        "version": "v1",
+                        "resource": p.resource,
+                        "namespace": "c0-ns-1",
+                    },
+                },
+            }
+        ).encode()
+
+    def test_full_swap_still_kills_everything(self):
+        c = small_corpus(n=40, clusters=1)
+        engine = TPUPolicyEngine(incremental=True, shard_buckets=BUCKETS)
+        engine.load(c.tiers(), warm="off")
+        stores = TieredPolicyStores([MemoryStore("s", c.tiers()[0])])
+        gen0 = plane_composite(stores, engine)
+        # adoption (promotion shape) -> structural change -> nothing matches
+        donor = TPUPolicyEngine(incremental=True, shard_buckets=BUCKETS)
+        donor.load(c.tiers(), warm="off")
+        engine.adopt_compiled(donor.compiled_set)
+        gen1 = plane_composite(stores, engine)
+        assert gen0 != gen1
+
+    def test_fleet_plane_composite(self):
+        from cedar_tpu.engine.batcher import MicroBatcher
+        from cedar_tpu.fleet import EngineFleet, EngineReplica
+
+        c = small_corpus(n=40, clusters=1)
+
+        class _FP:
+            available = True
+
+        replicas = []
+        for i in range(2):
+            e = TPUPolicyEngine(
+                incremental=True, shard_buckets=BUCKETS, name=f"sc-r{i}"
+            )
+            replicas.append(
+                EngineReplica(
+                    i,
+                    e,
+                    _FP(),
+                    batcher=MicroBatcher(lambda bodies: [None] * len(bodies)),
+                )
+            )
+        fleet = EngineFleet(replicas, name="scale-fleet")
+        fleet.load(c.tiers(), warm="off")
+        stores = TieredPolicyStores([MemoryStore("s", c.tiers()[0])])
+        gen0 = plane_composite(stores, fleet)
+        assert isinstance(gen0, PlaneGenerations)
+        # incremental fleet reload: composite base holds, dirty shard bumps
+        fleet.load(c.with_edit().tiers(), warm="off")
+        gen1 = plane_composite(stores, fleet)
+        assert gen1.base == gen0.base
+        assert gen0 != gen1  # some shard generation moved
+        changed = {
+            sid
+            for sid in gen1.shards
+            if gen1.shards[sid] != gen0.shards.get(sid)
+        }
+        assert len(changed) == 1
+        for r in replicas:
+            r.stop(drain_timeout_s=0.5)
+
+
+# ------------------------------------------------------------ partial failure
+
+
+class TestPartialFailure:
+    def test_shard_compile_failure_keeps_prior_set(self):
+        c = small_corpus()
+        e, _ = load_engine(c)
+        em, req = c.probe_request()
+        assert e.evaluate(em, req)[0] == "allow"
+        gen0 = e.load_generation
+        r = default_registry()
+        r.configure(
+            {
+                "faults": [
+                    {
+                        "seam": "engine.shard_compile",
+                        "kind": "error",
+                        "count": 1,
+                    }
+                ]
+            }
+        )
+        r.arm()
+        with pytest.raises(ChaosError):
+            e.load(c.with_edit().tiers(), warm="off")
+        r.disarm()
+        # the engine still serves the PRIOR complete set
+        assert e.load_generation == gen0
+        assert e.evaluate(em, req)[0] == "allow"
+        # the shard cache was not poisoned: the next clean reload sees
+        # exactly the edited shard dirty and lands the edit
+        stats = e.load(c.with_edit().tiers(), warm="off")
+        assert stats["compile_scope"] == "incremental"
+        assert stats["dirty_shards"] == 1
+        assert e.evaluate(em, req)[0] == "deny"
+
+    def test_fleet_adopt_failure_restores_compile_free(self):
+        """PR 7's promotion-barrier semantics at shard granularity: an
+        incremental fleet reload whose adoption fails on replica 1 must
+        restore replica 0 compile-free and leave the WHOLE fleet serving
+        the prior complete set."""
+        from cedar_tpu.engine.batcher import MicroBatcher
+        from cedar_tpu.fleet import EngineFleet, EngineReplica
+
+        c = small_corpus(n=60, clusters=1)
+
+        class _FP:
+            available = True
+
+        replicas = []
+        for i in range(2):
+            e = TPUPolicyEngine(
+                incremental=True, shard_buckets=BUCKETS, name=f"pf-r{i}"
+            )
+            replicas.append(
+                EngineReplica(
+                    i,
+                    e,
+                    _FP(),
+                    batcher=MicroBatcher(lambda bodies: [None] * len(bodies)),
+                )
+            )
+        fleet = EngineFleet(replicas, name="pf-fleet")
+        fleet.load(c.tiers(), warm="off")
+        em, req = c.probe_request()
+        for r_ in replicas:
+            assert r_.engine.evaluate(em, req)[0] == "allow"
+        prior_sets = [r_.engine.compiled_set for r_ in replicas]
+
+        boom = RuntimeError("adoption failed")
+        real_adopt = replicas[1].engine.adopt_compiled
+
+        def failing_adopt(compiled, donor=None):
+            raise boom
+
+        replicas[1].engine.adopt_compiled = failing_adopt
+        tc0 = kernel_trace_count()
+        with pytest.raises(RuntimeError):
+            fleet.load(c.with_edit().tiers(), warm="off")
+        replicas[1].engine.adopt_compiled = real_adopt
+        # restore was compile-free and complete: every replica serves the
+        # prior set, no mixed generations
+        assert kernel_trace_count() - tc0 == 0
+        assert [r_.engine.compiled_set for r_ in replicas] == prior_sets
+        for r_ in replicas:
+            assert r_.engine.evaluate(em, req)[0] == "allow"
+        # recovery: the next clean reload lands incrementally fleet-wide
+        stats = fleet.load(c.with_edit().tiers(), warm="off")
+        assert stats["compile_scope"] == "incremental"
+        for r_ in replicas:
+            assert r_.engine.evaluate(em, req)[0] == "deny"
+            assert r_.engine.last_adoption_scope == "incremental"
+        for r_ in replicas:
+            r_.stop(drain_timeout_s=0.5)
+
+    @pytest.mark.chaos
+    @pytest.mark.slow
+    def test_incremental_reload_under_device_faults(self):
+        """An armed engine.dispatch fault while an incremental reload
+        lands: serving degrades per the normal containment (the chaos
+        error surfaces to the caller exactly like a device loss would),
+        the reload itself is unaffected, and post-fault answers reflect
+        the edit."""
+        c = small_corpus()
+        e, _ = load_engine(c)
+        em, req = c.probe_request()
+        assert e.evaluate(em, req)[0] == "allow"
+        r = default_registry()
+        r.configure(
+            {
+                "faults": [
+                    {"seam": "engine.dispatch", "kind": "error", "count": 2}
+                ]
+            }
+        )
+        r.arm()
+        with pytest.raises(ChaosError):
+            e.evaluate(em, req)
+        stats = e.load(c.with_edit().tiers(), warm="off")
+        assert stats["compile_scope"] == "incremental"
+        with pytest.raises(ChaosError):
+            e.evaluate(em, req)
+        r.disarm()
+        assert e.evaluate(em, req)[0] == "deny"
+
+
+# -------------------------------------------------------------- surfaces etc.
+
+
+class TestSurfaces:
+    def test_shard_status_and_stats(self):
+        c = small_corpus()
+        e, stats = load_engine(c)
+        st = e.shard_status()
+        assert st["shards"] == stats["shards"] > 0
+        assert st["scope"] == "full"
+        e.load(c.with_edit().tiers(), warm="off")
+        st2 = e.shard_status()
+        assert st2["scope"] == "incremental" and len(st2["dirty"]) == 1
+        assert e.stats["shard_count"] == st2["shards"]
+        sid = st2["dirty"][0]
+        assert st["hashes"][sid] != st2["hashes"][sid]
+
+    def test_compile_metrics_collect(self):
+        from cedar_tpu.server import metrics
+
+        c = small_corpus()
+        e, _ = load_engine(c)
+        e.load(c.with_edit().tiers(), warm="off")
+        text = metrics.REGISTRY.expose()
+        assert 'cedar_compile_seconds_bucket{phase="total",scope="full"' in text
+        assert (
+            'cedar_compile_seconds_bucket{phase="total",scope="incremental"'
+            in text
+        )
+        assert "cedar_policy_shards" in text
+        assert "cedar_dirty_shards" in text
+
+    def test_debug_engine_doc_carries_shards(self):
+        from cedar_tpu.server.http import _engine_doc
+
+        c = small_corpus()
+        e, _ = load_engine(c)
+        doc = _engine_doc(e)
+        assert doc["shards"]["shards"] > 0
+        assert "hashes" in doc["shards"]
+
+
+class TestCorpus:
+    def test_deterministic(self):
+        a = synth_corpus(150, seed=4, clusters=4)
+        b = synth_corpus(150, seed=4, clusters=4)
+        assert [format_policy(p) for p in a.policies] == [
+            format_policy(p) for p in b.policies
+        ]
+        other = synth_corpus(150, seed=5, clusters=4)
+        assert [format_policy(p) for p in a.policies] != [
+            format_policy(p) for p in other.policies
+        ]
+
+    def test_edit_shares_untouched_objects(self):
+        c = synth_corpus(80, seed=4, clusters=4)
+        e = c.with_edit()
+        assert e.policies[c.probe_index] is not c.policies[c.probe_index]
+        shared = sum(
+            1 for x, y in zip(c.policies, e.policies) if x is y
+        )
+        assert shared == len(c.policies) - 1
+
+    def test_traffic_is_in_universe(self):
+        c = synth_corpus(150, seed=4, clusters=4)
+        spec = c.spec(0)
+        items = c.sar_items(100, cluster=0)
+        assert all(spec.conforms(em, req) for em, req in items)
