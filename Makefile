@@ -74,6 +74,10 @@ bench-scale: ## Giant policy sets: 10k vs 100k serving-rate ratio, single-edit i
 bench-fleet: ## Engine-fleet scaling: decisions/sec + lone p99 at 1/2/4 replicas, scaling-efficiency JSON (cpu; docs/fleet.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --fleet
 
+.PHONY: bench-fanout
+bench-fanout: ## Cross-process worker tier: 1/2/4 spawned workers, scaling + zero-flip differential + cross-worker cache hit gate + barrier swap (cpu; docs/fleet.md)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --fanout
+
 .PHONY: bench-explain
 bench-explain: ## Explain-plane pay-for-use: explain-off p99/throughput parity gate, explain-on cost + lazy compiles (cpu; docs/explainability.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --explain
@@ -100,7 +104,7 @@ graft-check: ## Compile-check the jittable entry + multi-chip dry run
 
 # scoped to the layers with the strongest invariants first; widen as
 # modules are annotated
-LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos cedar_tpu/fleet cedar_tpu/engine cedar_tpu/ops cedar_tpu/native cedar_tpu/explain cedar_tpu/obs cedar_tpu/cache cedar_tpu/corpus
+LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos cedar_tpu/fleet cedar_tpu/engine cedar_tpu/ops cedar_tpu/native cedar_tpu/explain cedar_tpu/obs cedar_tpu/cache cedar_tpu/corpus cedar_tpu/fanout cedar_tpu/parallel
 
 .PHONY: lint
 lint: ## ruff + mypy over $(LINT_SCOPE) (missing tools are skipped with a note)

@@ -1643,6 +1643,273 @@ def run_fleet_scenario() -> int:
     return 0 if ok else 1
 
 
+def run_fanout_scenario() -> int:
+    """``bench.py --fanout`` (``make bench-fanout``): the cross-process
+    worker tier (cedar_tpu/fanout, docs/fleet.md "Cross-host topology")
+    at 1 / 2 / 4 REAL worker processes spawned by the bench itself, on
+    one synthesized corpus and one Zipf-repeat SAR stream. Measures and
+    gates (rc 1 on breach):
+
+      * decisions/sec per tier size over a UNIQUE-body (evaluation-
+        bound) stream + scaling: speedup_4 = rate_4/rate_1 must reach
+        CEDAR_BENCH_FANOUT_SPEEDUP (default 3.0 — near-linear) on hosts
+        with >= 6 cores. On smaller hosts 4 worker processes time-share
+        the cores and the comparison measures thread-scheduler latency,
+        not tier capacity, so the scaling gate is SKIPPED (reported,
+        with host_cores + the skip reason in the JSON — bench-fleet's
+        cpu-fallback posture) unless the env var forces one;
+      * a multi-worker vs single-worker decision differential over the
+        whole stream (>= 1k bodies full-size): ZERO flips;
+      * cross-worker cache warmth: after a worker kill, its keys rehash
+        to survivors that were gossip-warmed — the post-kill phase must
+        show cross_worker_hit_ratio > 0 AND zero flips;
+      * the tier generation barrier: a single-policy edit swaps every
+        worker incrementally (dirty_shards == 1) and the tier stays
+        plane-coherent.
+    """
+    import threading
+
+    import jax
+
+    from cedar_tpu.corpus.synth import synth_corpus
+    from cedar_tpu.fanout import FanoutFrontend
+    from cedar_tpu.fanout.proc import ProcWorkerHandle, wire_peer_mesh
+
+    t0 = time.time()
+    n_policies = _n(400, 60)
+    SCALE = _n(1500, 400)  # unique bodies for the scaling + differential
+    POOL = _n(400, 120)  # unique SAR bodies under the Zipf repeat stream
+    STREAM = _n(3000, 900)  # Zipf draws over the pool
+    KILL_PHASE = _n(1200, 300)
+    THREADS = 8
+    CHANNELS = 4
+    cores = os.cpu_count() or 1
+
+    corpus = synth_corpus(n_policies, seed=11, clusters=2)
+    # scaling stream: UNIQUE bodies, so every request pays a real
+    # evaluation in its worker process — the work that scales with
+    # workers. (A warm-hit stream measures the front-end's dict-lookup
+    # relay instead: every tier size saturates the routing process and
+    # the comparison reads ~1x however many workers serve behind it.)
+    seen = set()
+    scale_bodies = []
+    chunk = 0
+    while len(scale_bodies) < SCALE and chunk < 20:
+        for b in corpus.sar_bodies(SCALE, cluster=0, seed=100 + chunk):
+            if b not in seen:
+                seen.add(b)
+                scale_bodies.append(b)
+                if len(scale_bodies) == SCALE:
+                    break
+        chunk += 1
+    # warmth stream: Zipf(1.1)-ish rank draws — the kube-apiserver repeat
+    # shape (kubelets/controllers re-issue identical SARs for minutes)
+    pool = corpus.sar_bodies(POOL, cluster=0, seed=21)
+    rng = random.Random(33)
+    weights = [1.0 / ((r + 1) ** 1.1) for r in range(POOL)]
+    stream = rng.choices(range(POOL), weights=weights, k=STREAM)
+    zipf_bodies = [pool[r] for r in stream]
+
+    spec = {
+        "synth": {"n": n_policies, "seed": 11, "clusters": 2},
+        "fastpath": True,
+        "timeout_s": 30,
+        "cache": 65536,
+        # steady-state warmth: the bench measures tier scaling and
+        # cross-worker cache behavior, not TTL churn — short no-opinion
+        # TTLs would expire entries mid-phase and re-measure evaluation
+        "ttls": {"allow": 600.0, "deny": 600.0, "no_opinion": 600.0},
+        # replication must never ride the serving thread in a process tier
+        "gossip_async": True,
+    }
+
+    def drive(fe, bodies, lo, hi, answers):
+        errors = []
+
+        def worker(a, b):
+            for j in range(a, b):
+                try:
+                    answers[j] = fe.authorize(bodies[j])
+                except Exception as e:  # noqa: BLE001 — counted, not raised
+                    errors.append(repr(e))
+
+        per = (hi - lo + THREADS - 1) // THREADS
+        ts = [
+            threading.Thread(
+                target=worker,
+                args=(lo + k * per, min(lo + (k + 1) * per, hi)),
+            )
+            for k in range(THREADS)
+        ]
+        t_run = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return time.monotonic() - t_run, errors
+
+    def peer_served(handles):
+        total = 0
+        for h in handles:
+            if not h.alive():
+                continue
+            peer = (h.stats().get("cache") or {}).get("peer") or {}
+            total += int(peer.get("peer_served", 0))
+        return total
+
+    results = {}
+    baseline = None
+    rate1 = None
+    flips_total = 0
+    zipf = {}
+    barrier = {}
+    for n_workers in (1, 2, 4):
+        handles = [
+            ProcWorkerHandle(f"w{i}", spec, channels=CHANNELS)
+            for i in range(n_workers)
+        ]
+        wire_peer_mesh(handles)
+        fe = FanoutFrontend(handles, name=f"bench-fanout{n_workers}")
+        try:
+            warm = [None] * min(64, len(scale_bodies))
+            drive(fe, scale_bodies, 0, len(warm), warm)  # serving shapes
+            answers = [None] * len(scale_bodies)
+            elapsed, errors = drive(
+                fe, scale_bodies, 0, len(scale_bodies), answers
+            )
+            rate = len(scale_bodies) / elapsed
+            if baseline is None:
+                baseline = answers
+                rate1 = rate
+                flips = 0
+            else:
+                # the multi-worker vs single-worker decision differential
+                # (>= 1k bodies full-size): zero flips
+                flips = sum(
+                    1 for a, b in zip(baseline, answers) if a != b
+                )
+            flips_total += flips
+            entry = {
+                "decisions_per_sec": round(rate),
+                "errors": len(errors),
+                "flips_vs_single": flips,
+                "routed": dict(fe.routed),
+            }
+            if n_workers > 1:
+                entry["speedup_vs_1"] = round(rate / rate1, 2)
+            if n_workers == 4:
+                # Zipf repeat stream on the full tier: fill + repeat
+                # (local hash-affinity hits), then kill one worker — its
+                # keys rehash to gossip-warmed survivors; decisions must
+                # not flip and the post-kill phase must serve some
+                # answers from peer-replicated entries
+                z_answers = [None] * len(zipf_bodies)
+                drive(fe, zipf_bodies, 0, len(zipf_bodies), z_answers)
+                drive(
+                    fe, zipf_bodies, 0, len(zipf_bodies),
+                    [None] * len(zipf_bodies),
+                )
+                victim = handles[-1]
+                served0 = peer_served(handles)
+                victim.kill()
+                k_answers = [None] * KILL_PHASE
+                _k_elapsed, k_errors = drive(
+                    fe, zipf_bodies, 0, KILL_PHASE, k_answers
+                )
+                k_flips = sum(
+                    1
+                    for a, b in zip(z_answers[:KILL_PHASE], k_answers)
+                    if a != b
+                )
+                flips_total += k_flips
+                cross_hits = peer_served(handles) - served0
+                cross_ratio = cross_hits / max(1, KILL_PHASE)
+                zipf = {
+                    "stream": len(zipf_bodies),
+                    "unique_bodies": POOL,
+                    "kill_phase_requests": KILL_PHASE,
+                    "flips": k_flips,
+                    "errors": len(k_errors),
+                    "reroutes": fe.reroutes,
+                    "cross_worker_hits": cross_hits,
+                    "cross_worker_hit_ratio": round(cross_ratio, 4),
+                    "revived": bool(fe.restart_worker(victim.worker_id)),
+                }
+                wire_peer_mesh(handles)
+                # tier generation barrier: one-policy CRD edit, swapped
+                # across every worker process or none
+                t_swap = time.monotonic()
+                stats = fe.load(
+                    {**spec, "synth": {**spec["synth"], "edit_probe": True}}
+                )
+                barrier = {
+                    "swap_ms": round((time.monotonic() - t_swap) * 1e3, 1),
+                    "compile_scope": stats.get("compile_scope"),
+                    "dirty_shards": stats.get("dirty_shards"),
+                    "coherent": fe.plane_coherent(),
+                }
+            results[str(n_workers)] = entry
+        finally:
+            fe.stop()
+
+    speedup4 = results["4"]["decisions_per_sec"] / max(
+        1, results["1"]["decisions_per_sec"]
+    )
+    gate_env = os.environ.get("CEDAR_BENCH_FANOUT_SPEEDUP")
+    gate = None
+    gate_skipped = ""
+    if gate_env:
+        gate = float(gate_env)
+    elif cores >= 6:
+        gate = 3.0  # near-linear at 4 workers: the tier's capacity claim
+    else:
+        # 4 worker processes + the routing front-end need >= ~6 cores
+        # before the scaling number measures tier capacity at all; below
+        # that the processes time-share the cores and the comparison
+        # reads thread-scheduler latency (the profile shows per-request
+        # wall is pipeline-stage hand-offs, not evaluation) — the same
+        # cpu-fallback posture bench-fleet takes for replica scaling.
+        # The speedup is still REPORTED; the correctness / cross-worker
+        # warmth / barrier gates stay hard everywhere.
+        gate_skipped = (
+            f"host has {cores} core(s) for 4 worker processes + a "
+            "front-end; set CEDAR_BENCH_FANOUT_SPEEDUP to force a gate"
+        )
+    cross_ratio = zipf.get("cross_worker_hit_ratio", 0.0)
+    ok = (
+        flips_total == 0
+        and (gate is None or speedup4 >= gate)
+        and cross_ratio > 0
+        and barrier.get("dirty_shards") == 1
+        and bool(barrier.get("coherent"))
+        and all(r["errors"] == 0 for r in results.values())
+        and zipf.get("errors") == 0
+    )
+    backend = jax.default_backend()
+    result = {
+        "metric": "fanout_scaling",
+        "smoke": _SMOKE,
+        "policies": n_policies,
+        "scale_bodies": len(scale_bodies),
+        "threads": THREADS,
+        "channels_per_worker": CHANNELS,
+        "host_cores": cores,
+        "results": results,
+        "speedup_4_vs_1": round(speedup4, 2),
+        "speedup_gate": round(gate, 2) if gate is not None else None,
+        "speedup_gate_skipped": gate_skipped,
+        "decision_flips": flips_total,
+        "zipf": zipf,
+        "cross_worker_hit_ratio": cross_ratio,
+        "barrier": barrier,
+        "backend": "cpu-fallback" if backend == "cpu" else backend,
+        "elapsed_s": round(time.time() - t0, 1),
+        "pass": ok,
+    }
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 def run_encode_scenario() -> int:
     """make bench-encode: the host-side budget microbench (ISSUE 8,
     docs/performance.md "Host-side budget"). Cpu-backend by design — the
@@ -3120,6 +3387,27 @@ if __name__ == "__main__":
 
         force_cpu()
         _scenario_exit("fleet", run_fleet_scenario)
+
+    if "--fanout" in sys.argv:
+        # cross-process worker tier (make bench-fanout): cpu-only by
+        # default — worker processes time-share the host cores, so the
+        # scaling gate adapts to the core count and the JSON carries
+        # host_cores (real deployments put one device behind each
+        # worker). Workers are REAL spawned processes; the parent only
+        # routes, so its own XLA runtime stays tiny. Each worker pins
+        # its XLA cpu backend single-threaded (one-device-per-worker
+        # model): N intra-op pools thrashing the same cores would
+        # measure scheduler noise, not tier scaling.
+        os.environ.setdefault("CEDAR_NATIVE_THREADS", "1")
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_cpu_multi_thread_eigen" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_cpu_multi_thread_eigen=false"
+            ).strip()
+        from cedar_tpu.jaxenv import force_cpu
+
+        force_cpu()
+        _scenario_exit("fanout", run_fanout_scenario)
 
     if "--chaos" in sys.argv:
         # game-day suite (make bench-chaos): cpu-only BY DESIGN — the

@@ -208,16 +208,29 @@ class DecisionCache:
         self._tick_gauges()
         return None
 
-    def put(self, key: str, value, decision_class: str, generation=_UNSET) -> bool:
+    def put(
+        self,
+        key: str,
+        value,
+        decision_class: str,
+        generation=_UNSET,
+        ttl_s: Optional[float] = None,
+    ) -> bool:
         """Insert ``value``; returns False when the class TTL disables
         caching. LRU-evicts within the key's shard past capacity.
 
         ``generation`` should be the current_generation() snapshot taken
         BEFORE the decision was evaluated (see current_generation); when
         omitted it is resolved at insert time, which is only safe for
-        values not derived from the policy set (tests, fixed fixtures)."""
+        values not derived from the policy set (tests, fixed fixtures).
+
+        ``ttl_s`` CAPS the class TTL (never extends it): a peer-received
+        entry carries its origin's remaining lifetime, so replication
+        cannot restart the staleness clock (docs/caching.md)."""
         chaos_fire("cache.put")
         ttl = self.ttl_for(decision_class)
+        if ttl_s is not None:
+            ttl = min(ttl, float(ttl_s))
         if ttl <= 0:
             return False
         if generation is _UNSET:
@@ -235,6 +248,30 @@ class DecisionCache:
         if evicted:
             _record("record_cache_evictions", self.path, "lru", evicted)
         return True
+
+    def peer_lookup(self, key: str):
+        """Read an entry for peer serving (cedar_tpu/fanout): returns
+        ``(value, decision_class, stamp, ttl_left_s)`` when the entry is
+        fresh by THIS cache's own generation + TTL rules, else None.
+        Unlike get() this never mutates hit/miss tallies or LRU order —
+        a sibling worker's miss is not this worker's traffic — and never
+        deletes: a stale entry is simply not served, and dies at its own
+        next local lookup."""
+        gen = self._generation()
+        now = self._clock()
+        shard = self._shard_for(key)
+        with shard.lock:
+            entry = shard.entries.get(key)
+            if entry is None:
+                return None
+            if entry.generation != gen or now >= entry.expires_at:
+                return None
+            return (
+                entry.value,
+                entry.decision_class,
+                entry.generation,
+                entry.expires_at - now,
+            )
 
     def invalidate_all(self) -> int:
         """Drop every entry (operator escape hatch / tests); returns the

@@ -37,7 +37,12 @@ from __future__ import annotations
 
 from typing import Mapping, Optional, Tuple
 
-__all__ = ["PlaneGenerations", "ShardScopedStamp", "plane_composite"]
+__all__ = [
+    "PlaneGenerations",
+    "ShardScopedStamp",
+    "plane_composite",
+    "plane_wire_state",
+]
 
 
 class PlaneGenerations:
@@ -127,6 +132,41 @@ class ShardScopedStamp:
     def __ne__(self, other):
         r = self.__eq__(other)
         return NotImplemented if r is NotImplemented else not r
+
+
+def plane_wire_state(target):
+    """Content-derived projection of ``target``'s serving plane lineage,
+    safe to compare ACROSS processes (cedar_tpu/fanout peer cache).
+
+    ``PlaneGenerations`` values are process-local: structural ids and
+    shard generation numbers come from per-process counters, so two
+    workers serving the byte-identical policy set expose different
+    composites. The wire state projects the plane onto what actually
+    determines served answers — the per-shard CONTENT hashes (identical
+    wherever the same corpus compiled, compiler/shard.py) plus the
+    serving partition (pruning changes answers even at equal shard
+    content). Returns ``{"token": <sha256>, "shards": {sid: hash}}``, or
+    None when the target has no shard lineage (peer sharing then
+    disables rather than guessing).
+
+    ``target`` is an engine, a fleet (its template engine describes the
+    whole fleet under the barrier invariant), or anything exposing a
+    ``compiled_set`` with a PlaneState."""
+    import hashlib
+
+    engine = getattr(target, "template_engine", target)
+    cs = getattr(engine, "compiled_set", None)
+    pl = getattr(cs, "plane", None) if cs is not None else None
+    if pl is None or not pl.shard_hashes:
+        return None
+    h = hashlib.sha256()
+    for sid in sorted(pl.shard_hashes):
+        h.update(sid.encode())
+        h.update(b":")
+        h.update(pl.shard_hashes[sid].encode())
+        h.update(b"\x00")
+    h.update(f"partition={pl.partition or ''}".encode())
+    return {"token": h.hexdigest(), "shards": dict(pl.shard_hashes)}
 
 
 def plane_composite(stores, target):

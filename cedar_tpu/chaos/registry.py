@@ -105,6 +105,14 @@ SEAMS = {
     "a batch (kill = one replica lost)",
     "fleet.promote": "per-replica compiled-set swap inside the fleet "
     "promotion barrier",
+    "fanout.route": "front-end worker selection (cedar_tpu/fanout): fired "
+    "with the chosen worker id before the request is handed over",
+    "fanout.worker_kill": "inside a fanout worker's request handling "
+    "(kill = that worker process lost; the front-end rehashes around it)",
+    "fanout.swap": "per-worker compiled-set swap inside the cross-process "
+    "generation barrier (frontend.load / promote)",
+    "cache.peer_fetch": "peer decision-cache traffic (fetch AND gossip "
+    "delivery) between fanout workers",
     "response": "final (decision, reason, error) swap (reference parity)",
 }
 

@@ -108,10 +108,11 @@ def print_tree(doc: dict, out=None) -> None:
         ):
             walk(child, depth + 1)
 
+    worker = f" worker={doc['worker']}" if doc.get("worker") else ""
     out.write(
         f"trace {doc['traceId']} path={doc['path']} "
         f"decision={doc.get('decision')} kept={doc.get('kept') or '-'} "
-        f"e2e={_fmt_us(doc.get('duration_us', 0.0))}\n"
+        f"e2e={_fmt_us(doc.get('duration_us', 0.0))}{worker}\n"
     )
     if doc.get("upstreamParent"):
         out.write(f"  upstream parent span: {doc['upstreamParent']}\n")

@@ -152,6 +152,22 @@ class TPUReloader:
 
 
 def build_server(args) -> WebhookServer:
+    # process worker identity first: every metrics family, trace and
+    # audit record from here on carries it (docs/fleet.md "Cross-host
+    # topology"); empty = single-process, label omitted
+    if getattr(args, "worker_id", ""):
+        from ..server.metrics import set_worker_label
+
+        set_worker_label(args.worker_id)
+    if (
+        getattr(args, "fanout_workers", 1) > 1
+        and getattr(args, "fleet_replicas", 1) > 1
+    ):
+        raise ValueError(
+            "--fanout-workers and --fleet-replicas are mutually exclusive: "
+            "the fanout tier IS the scale-out layer (each worker may "
+            "itself be meshed); pick one"
+        )
     # serving-plane default: the segmented-reduction kernel measurably
     # wins at serving-chunk batch sizes on the CPU BACKEND (2-6x the
     # device-side rate at 8-16k rows, BENCH_r05_cpu_backend2 era probes),
@@ -466,6 +482,108 @@ def build_server(args) -> WebhookServer:
                 "fast path; serving single-engine"
             )
 
+    # cross-process worker tier (cedar_tpu/fanout, docs/fleet.md
+    # "Cross-host topology"): --fanout-workers N>=2 builds N isolated
+    # worker stacks — own engine, breaker, native fast path, batcher and
+    # peer-shared decision cache each — behind a consistent-hash
+    # front-end the server routes raw bodies through. The store reloader
+    # drives the tier's generation barrier (every worker swaps or none);
+    # worker caches replicate through the peer mesh with shard-scoped
+    # stamps, so an incremental CRD edit kills exactly the dirty shard's
+    # entries on every worker. In this process the workers are
+    # thread-isolated stacks sharing nothing but the stores; a multi-host
+    # tier runs one webhook process per worker (--worker-id) behind the
+    # same protocol.
+    fanout = None
+    if args.fanout_workers > 1 and engine is not None:
+        from ..engine.evaluator import TPUPolicyEngine  # noqa: F401 — workers
+        from ..fanout import FanoutFrontend, InProcessWorker
+        from ..fanout.peers import PeerBackedCache
+        from ..cache.generation import plane_composite, plane_wire_state
+
+        peer_fetch = args.fanout_peer_cache in ("both", "fetch")
+        peer_gossip = args.fanout_peer_cache in ("both", "gossip")
+        native_ok = False
+        if not args.no_native and partition_spec is None:
+            from ..native import native_available
+
+            native_ok = native_available()
+        workers = []
+        for i in range(args.fanout_workers):
+            w_breaker = _make_breaker(f"authorization-w{i}")
+            w_engine, w_eval, w_eval_batch, w_rec = _tpu_backend(
+                stores, breaker=w_breaker, name=f"authorization-w{i}"
+            )
+            if w_rec is not None:
+                fleet_recoveries.append(w_rec)  # /debug/supervisor report
+            w_auth = CedarWebhookAuthorizer(
+                stores, evaluate=w_eval, evaluate_batch=w_eval_batch
+            )
+            w_fast = None
+            if native_ok:
+                from ..engine.fastpath import SARFastPath
+
+                w_fast = SARFastPath(w_engine, w_auth, breaker=w_breaker)
+                if w_rec is not None:
+                    w_fast.on_device_error = w_rec.observe
+            w_cache = None
+            if args.decision_cache_size > 0:
+                w_cache = PeerBackedCache(
+                    max_entries=args.decision_cache_size,
+                    allow_ttl_s=args.decision_cache_allow_ttl_seconds,
+                    deny_ttl_s=args.decision_cache_deny_ttl_seconds,
+                    no_opinion_ttl_s=(
+                        args.decision_cache_no_opinion_ttl_seconds
+                    ),
+                    generation_fn=(
+                        lambda e=w_engine: plane_composite(stores, e)
+                    ),
+                    wire_state_fn=lambda e=w_engine: plane_wire_state(e),
+                    fetch_enabled=peer_fetch,
+                    gossip_enabled=peer_gossip,
+                    path="authorization",
+                )
+            w_server = WebhookServer(
+                w_auth,
+                None,
+                fastpath=w_fast,
+                decision_cache=w_cache,
+                pipeline_depth=args.pipeline_depth,
+                encode_workers=args.encode_workers,
+                max_batch=args.max_batch,
+                batch_window_s=args.batch_window_us / 1e6,
+                request_timeout_s=(
+                    args.request_timeout_ms / 1e3
+                    if args.request_timeout_ms > 0
+                    else None
+                ),
+            )
+            workers.append(
+                InProcessWorker(f"w{i}", w_server, w_engine, cache=w_cache)
+            )
+        fanout = FanoutFrontend(
+            workers,
+            name="authorization",
+            peer_fetch=peer_fetch,
+            peer_gossip=peer_gossip,
+        )
+        # the reloader drives the tier barrier instead of the (now
+        # bystander) single engine: every worker compiles its own view of
+        # the store content and the swap commits tier-wide or not at all
+        reloader.targets[0] = (fanout, stores)
+        # the outer authz fast path would gate readiness on an engine the
+        # reloader no longer loads; the tier serves instead
+        fastpath = None
+        log.info(
+            "fanout worker tier enabled: %d workers, peer cache %s",
+            args.fanout_workers,
+            args.fanout_peer_cache,
+        )
+    elif args.fanout_workers > 1:
+        log.warning(
+            "--fanout-workers requires --backend tpu; serving single-stack"
+        )
+
     # admission gets the allow-all final tier (main.go:111-116); it shares
     # the authz stack's validation posture (the synthetic allow-all tail is
     # trivially lowerable, so the gate treats both stacks identically)
@@ -502,6 +620,11 @@ def build_server(args) -> WebhookServer:
     # gated to read-only idempotent reviews (CONNECT / dry-run).
     decision_cache = None
     admission_cache = None
+    if fanout is not None and args.decision_cache_size > 0:
+        # the worker stacks own the (peer-shared) authorization caches;
+        # an outer cache would double-store every decision and hide the
+        # tier's hash-affinity warmth
+        log.info("fanout tier: authorization decision cache lives per worker")
     if args.decision_cache_size > 0:
         from ..cache import DecisionCache
 
@@ -524,14 +647,15 @@ def build_server(args) -> WebhookServer:
 
             return lambda: plane_composite(tier_stores, target)
 
-        decision_cache = DecisionCache(
-            max_entries=args.decision_cache_size,
-            allow_ttl_s=args.decision_cache_allow_ttl_seconds,
-            deny_ttl_s=args.decision_cache_deny_ttl_seconds,
-            no_opinion_ttl_s=args.decision_cache_no_opinion_ttl_seconds,
-            generation_fn=_generation_fn(stores, engine, fleet),
-            path="authorization",
-        )
+        if fanout is None:
+            decision_cache = DecisionCache(
+                max_entries=args.decision_cache_size,
+                allow_ttl_s=args.decision_cache_allow_ttl_seconds,
+                deny_ttl_s=args.decision_cache_deny_ttl_seconds,
+                no_opinion_ttl_s=args.decision_cache_no_opinion_ttl_seconds,
+                generation_fn=_generation_fn(stores, engine, fleet),
+                path="authorization",
+            )
         if args.decision_cache_admission:
             admission_cache = DecisionCache(
                 max_entries=args.decision_cache_size,
@@ -567,7 +691,15 @@ def build_server(args) -> WebhookServer:
         # staging via --rollout-candidate-dir still works, and
         # /debug/rollout stays readable
         rollout_control_enabled = False
-    if engine is not None:
+    if engine is not None and fanout is not None:
+        if args.rollout_candidate_dir or rollout_control_enabled:
+            log.warning(
+                "shadow rollout is not yet wired through the fanout tier "
+                "(the tier barrier covers store reloads; candidate "
+                "promote/rollback across workers is future work) — "
+                "rollout disabled"
+            )
+    elif engine is not None:
         from ..rollout import RolloutController
 
         def _crd_candidates():
@@ -768,6 +900,7 @@ def build_server(args) -> WebhookServer:
         fastpath=fastpath,
         admission_fastpath=admission_fastpath,
         fleet=fleet,
+        fanout=fanout,
         batch_window_s=args.batch_window_us / 1e6,
         max_batch=args.max_batch,
         pipeline_depth=args.pipeline_depth,
@@ -790,6 +923,10 @@ def build_server(args) -> WebhookServer:
     )
     if supervisor is not None:
         _register_supervised(supervisor, server, rollout, stores)
+        if fanout is not None:
+            # workers restart under the same watchdog as batcher stages:
+            # liveness = worker.alive(), restart = rehash-in cold
+            fanout.register_with(supervisor)
     return server
 
 
@@ -998,6 +1135,38 @@ def make_parser() -> argparse.ArgumentParser:
         "duplicate to the next-healthiest replica and take the first "
         "answer (the loser is cancelled); 0 disables hedging "
         "(docs/fleet.md)",
+    )
+    fleet.add_argument(
+        "--fanout-workers",
+        type=int,
+        default=1,
+        help="cross-process worker tier (cedar_tpu/fanout, docs/fleet.md "
+        "\"Cross-host topology\"): consistent-hash canonical request "
+        "fingerprints onto N isolated worker stacks (own engine + fast "
+        "path + batcher + peer-shared decision cache) behind one "
+        "front-end, with policy swaps barriered across the tier. In this "
+        "process the workers are thread-isolated stacks; a multi-host "
+        "tier runs one webhook process per worker with --worker-id set. "
+        "1 keeps the classic path; mutually exclusive with "
+        "--fleet-replicas > 1",
+    )
+    fleet.add_argument(
+        "--fanout-peer-cache",
+        choices=("both", "fetch", "gossip", "off"),
+        default="both",
+        help="peer-shared decision cache mode for the fanout tier: "
+        "fetch = on-miss asks the key's ring-preferred holders, gossip "
+        "= miss-fills replicate to peers (warm rehash on worker loss), "
+        "both (default), off",
+    )
+    fleet.add_argument(
+        "--worker-id",
+        default=os.environ.get("CEDAR_WORKER_ID", ""),
+        help="this process's stable worker identity in a multi-process "
+        "tier (CEDAR_WORKER_ID): stamps every metrics family's `worker` "
+        "label and every trace/audit record, so N workers' scrapes and "
+        "logs join instead of colliding; empty (default) on "
+        "single-process deployments",
     )
 
     serving = parser.add_argument_group("secure serving")

@@ -171,6 +171,17 @@ class PackedPolicySet:
         return self.n_tiers * GROUPS_PER_TIER + (1 if self.has_gate else 0)
 
 
+# fresh Literal.key() builds performed by intern() — the reload-allocation
+# counter the perf-hardening test pins: a repack of cached shard slices
+# re-interns the SAME Literal objects, so a steady-state incremental
+# reload must build ZERO fresh keys (every one is memoized on its object)
+_lit_key_builds = 0
+
+
+def lit_key_build_count() -> int:
+    return _lit_key_builds
+
+
 class _LitRegistry:
     def __init__(self):
         self.by_key: Dict[tuple, int] = {}
@@ -186,6 +197,8 @@ class _LitRegistry:
         d = lit.__dict__
         k = d.get("_cedar_lit_key")
         if k is None:
+            global _lit_key_builds
+            _lit_key_builds += 1
             k = d["_cedar_lit_key"] = lit.key()
         idx = self.by_key.get(k)
         if idx is None:

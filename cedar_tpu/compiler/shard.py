@@ -57,9 +57,20 @@ __all__ = [
     "DEFAULT_SHARD_BUCKETS",
     "CompiledShard",
     "ShardCompiler",
+    "bucket_hash_count",
     "policy_fingerprint",
     "shard_bucket",
 ]
+
+# fresh blake2b bucket computations (cache misses of the per-object memo
+# below) — with the shard-bucket memo working, a steady-state reload over
+# store-reused Policy objects recomputes buckets ONLY for re-parsed
+# (edited) objects; the perf-hardening test pins that
+_bucket_hashes = 0
+
+
+def bucket_hash_count() -> int:
+    return _bucket_hashes
 
 
 def policy_fingerprint(policy: Policy) -> str:
@@ -96,6 +107,8 @@ def shard_bucket(policy: Policy, n_buckets: int) -> int:
     cached = policy.__dict__.get("_cedar_shard_bucket")
     if cached is not None and cached[0] == n_buckets:
         return cached[1]
+    global _bucket_hashes
+    _bucket_hashes += 1
     key = f"{policy.filename}\x00{policy.policy_id}".encode()
     # blake2b, not crc32: crc is GF(2)-linear, and over the sequential
     # object names real stores produce (pol-000001, pol-000002, ...) its

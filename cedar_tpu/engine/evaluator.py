@@ -165,6 +165,11 @@ class PlaneState:
     dirty: Tuple[str, ...] = ()
     partition: Optional[str] = None
     pruned_policies: int = 0
+    # mesh deployments: which device partition each (tier, bucket) shard's
+    # rules were placed on (parallel/mesh.py PartitionedPlanes) — the map
+    # an incremental reload uses to re-place ONLY the dirty shard's
+    # partition, surfaced on /debug/engine
+    shard_partition: Dict[str, int] = field(default_factory=dict)
 
 
 def _round_bucket(n: int, buckets) -> int:
@@ -325,8 +330,17 @@ class _CompiledSet:
 
     def __init__(
         self, packed: PackedPolicySet, device=None, use_pallas=False,
-        mesh=None, segred: "Optional[bool]" = None,
+        mesh=None, segred: "Optional[bool]" = None, plane_info=None,
+        prior: "Optional[_CompiledSet]" = None,
+        max_rules_per_partition: Optional[int] = None,
     ):
+        """plane_info/prior/max_rules_per_partition drive MESH placement:
+        with shard lineage (plane_info["policy_shard"]) the rule columns
+        lay out by compiler shard (parallel/mesh.py PartitionedPlanes)
+        and `prior`'s per-device pieces are reused for every partition
+        whose bytes are unchanged — an incremental reload re-uploads one
+        partition. max_rules_per_partition is the per-device packed
+        capacity budget (MeshCapacityError when exceeded)."""
         import os
 
         self.packed = packed
@@ -365,11 +379,46 @@ class _CompiledSet:
         thresh_host = (
             packed.thresh.astype(np.int32) if int8_plane else packed.thresh
         )
+        # mesh deployments: global column → packed rule index map when the
+        # rule axis is laid out by compiler shard (None otherwise); bits
+        # decode translates through it (_bits_groups)
+        self.col_map = None
+        self._mesh_planes = None
         if mesh is not None:
-            # multi-chip: unchunked tensors placed with the (data, policy)
+            # multi-chip: tensors placed with the (data, policy)
             # shardings; the engine routes evaluation through the pjit
             # steps in parallel/mesh.py. No chunked/pallas planes — the
             # policy axis shards replace the scan chunking.
+            policy_shard = (
+                dict(plane_info.get("policy_shard", ()))
+                if plane_info
+                else {}
+            )
+            if policy_shard:
+                # shard-partitioned placement: each (tier, bucket) shard
+                # owns a stable device partition, so an incremental
+                # reload re-places only the dirty shard's partition
+                from ..parallel.mesh import PartitionedPlanes
+
+                prior_planes = None
+                if prior is not None and prior.mesh is mesh:
+                    prior_planes = prior._mesh_planes
+                planes = PartitionedPlanes.build(
+                    mesh,
+                    packed,
+                    policy_shard,
+                    int8_plane,
+                    prior=prior_planes,
+                    max_rules_per_partition=max_rules_per_partition,
+                )
+                self._mesh_planes = planes
+                self.act_rows_dev = planes.act_rows_dev
+                self.W_dev = planes.W_dev
+                self.thresh_dev = planes.thresh_dev
+                self.rule_group_dev = planes.rule_group_dev
+                self.rule_policy_dev = planes.rule_policy_dev
+                self.col_map = planes.col_map
+                return
             from ..parallel.mesh import shard_codes_tensors
 
             (
@@ -549,6 +598,7 @@ class TPUPolicyEngine:
         incremental: Optional[bool] = None,
         shard_buckets: Optional[int] = None,
         partition=None,
+        mesh_device_rules: Optional[int] = None,
     ):
         """mesh: an optional jax.sharding.Mesh with ("data", "policy") axes
         (parallel.mesh.make_mesh). When set, compiled sets are placed with
@@ -577,7 +627,13 @@ class TPUPolicyEngine:
         serving process's request universe — never-matching policies are
         pruned from the device plane (paged off), and non-conforming
         requests answer via an exact interpreter walk over the retained
-        tier stack instead of the pruned plane."""
+        tier stack instead of the pruned plane.
+        mesh_device_rules: per-device packed rule-column capacity for
+        mesh deployments (CEDAR_TPU_MESH_DEVICE_RULES; None = unbounded).
+        With shard-partitioned placement the rule set may exceed ONE
+        device's budget as long as each partition fits — capacity scales
+        with the policy-axis device count; a set that cannot fit raises
+        MeshCapacityError at load."""
         import os
 
         self.schema = schema or AUTHZ_SCHEMA_INFO
@@ -639,6 +695,10 @@ class TPUPolicyEngine:
             shard_buckets
             or os.environ.get("CEDAR_TPU_SHARD_BUCKETS", "64")
         )
+        if mesh_device_rules is None:
+            env_cap = os.environ.get("CEDAR_TPU_MESH_DEVICE_RULES", "")
+            mesh_device_rules = int(env_cap) if env_cap else None
+        self.mesh_device_rules = mesh_device_rules
         self._shard_compiler = None
         # monotonically unique shard generation values (never reused, so a
         # removed-then-re-added shard can't collide with old cache stamps)
@@ -722,13 +782,18 @@ class TPUPolicyEngine:
         packed = pack(compiled)
         pack_s = time.monotonic() - t_pack
         t_place = time.monotonic()
+        prior = self._compiled
         new = _CompiledSet(
             packed, self.device, use_pallas=self.use_pallas, mesh=self.mesh,
-            segred=self.segred,
+            segred=self.segred, plane_info=info, prior=prior,
+            max_rules_per_partition=self.mesh_device_rules,
         )
         place_s = time.monotonic() - t_place
-        prior = self._compiled
         new.plane = self._next_plane(prior, info)
+        if new._mesh_planes is not None:
+            new.plane.shard_partition = dict(
+                new._mesh_planes.shard_partition_map
+            )
         if self.incremental and self._partition is not None:
             # the spec this plane was PRUNED under + the unpruned tiers
             # ride the set: the conformance gate and the plane it guards
@@ -865,6 +930,16 @@ class TPUPolicyEngine:
                 return False
             if len(a.wire[1]) + a._wire_padw != len(b.wire[1]) + b._wire_padw:
                 return False
+        # mesh: the pjit step's shapes follow the PARTITIONED width, not
+        # packed.R — a layout change (grown partition, device-count change)
+        # must re-run the ladder even when the packed shapes agree
+        ma, mb = a._mesh_planes, b._mesh_planes
+        if (ma is None) != (mb is None):
+            return False
+        if ma is not None and (
+            ma.r_part != mb.r_part or ma.n_partitions != mb.n_partitions
+        ):
+            return False
         return True
 
     def set_partition(self, spec) -> None:
@@ -1185,6 +1260,15 @@ class TPUPolicyEngine:
         new = _CompiledSet(
             cs.packed, self.device, use_pallas=self.use_pallas,
             mesh=self.mesh, segred=self.segred,
+            # keep the shard-partitioned mesh layout (and its col_map)
+            # across a device loss; prior=None — the dead device's
+            # buffers are exactly what must NOT be reused
+            plane_info=(
+                {"policy_shard": cs.plane.policy_shard}
+                if cs.plane is not None
+                else None
+            ),
+            max_rules_per_partition=self.mesh_device_rules,
         )
         # the rebuilt set serves the same pack: the partition gate (and
         # its exact-answer tier stack) must survive the device loss too
@@ -1213,16 +1297,19 @@ class TPUPolicyEngine:
         self.last_adoption_scope = "rebuild"
         return True
 
-    def _mesh_step(self, packed: PackedPolicySet):
-        """The cached pjit evaluation step for this mesh + set shape."""
-        key = (packed.n_tiers, packed.has_gate)
+    def _mesh_step(self, packed: PackedPolicySet, want_full: bool = True):
+        """The cached pjit evaluation step for this mesh + set shape.
+        want_full=False is the serving variant: only the packed verdict
+        word leaves the device — one uint32 per request across however
+        many chips the rule axis spans."""
+        key = (packed.n_tiers, packed.has_gate, want_full)
         fn = self._mesh_steps.get(key)
         if fn is None:
             from ..parallel.mesh import sharded_codes_match_fn
 
             fn = self._mesh_steps[key] = sharded_codes_match_fn(
                 self.mesh, packed.n_tiers, packed.has_gate,
-                donate=self._mesh_donate,
+                donate=self._mesh_donate, want_full=want_full,
             )
         return fn
 
@@ -1365,7 +1452,9 @@ class TPUPolicyEngine:
                     codes_arr[missing], extras_arr[missing], cs=cs
                 )
                 for k, i in enumerate(missing):
-                    bits_groups[i] = self._bits_groups(packed, bits[k])
+                    bits_groups[i] = self._bits_groups(
+                        packed, bits[k], cs.col_map
+                    )
             return [
                 self._finalize_sets(
                     packed,
@@ -1427,7 +1516,7 @@ class TPUPolicyEngine:
             for k, i in enumerate(missing):
                 bitmap[i] = bits[k]
         for i in need.tolist():
-            groups = self._bits_groups(packed, bitmap[i])
+            groups = self._bits_groups(packed, bitmap[i], cs.col_map)
             out[i] = self._finalize_sets(packed, groups, None, None)
         return out
 
@@ -1553,12 +1642,15 @@ class TPUPolicyEngine:
                 # multi-chip: the pjit step (parallel/mesh.py) shards the
                 # batch over `data` and the rule matmul over `policy`; the
                 # diagnostics bitsets come from the sharded bits step via
-                # resolve_flagged instead of an in-call payload
+                # resolve_flagged instead of an in-call payload. The
+                # serving (non-full) variant outputs ONLY the packed
+                # word: the per-shard partial verdicts all-reduce on
+                # device and 4 bytes per request come home.
                 chunk_c, chunk_e = self._pad_to_bucket(
                     chunk_c, chunk_e, packed.L,
                     data_mult=cs.mesh.shape["data"], held=held,
                 )
-                w, f, last = self._mesh_step(packed)(
+                step_args = (
                     chunk_c,
                     chunk_e,
                     cs.act_rows_dev,
@@ -1567,7 +1659,11 @@ class TPUPolicyEngine:
                     cs.rule_group_dev,
                     cs.rule_policy_dev,
                 )
-                return w, ((f, last) if want_full else None), None
+                if want_full:
+                    w, f, last = self._mesh_step(packed, True)(*step_args)
+                    return w, (f, last), None
+                w = self._mesh_step(packed, False)(*step_args)
+                return w, None, None
             chunk_c, chunk_e = self._pad_to_bucket(
                 chunk_c, chunk_e, packed.L, held=held
             )
@@ -1878,14 +1974,22 @@ class TPUPolicyEngine:
         }
 
     @staticmethod
-    def _bits_groups(packed: PackedPolicySet, bits_row: np.ndarray) -> dict:
+    def _bits_groups(
+        packed: PackedPolicySet,
+        bits_row: np.ndarray,
+        col_map: Optional[np.ndarray] = None,
+    ) -> dict:
         """Decode one rule bitset row -> {group id: [policy indices,
         ascending]} with every matched policy (deduped across the several
-        DNF rules one policy may lower to)."""
-        mask = np.unpackbits(
-            np.ascontiguousarray(bits_row).view(np.uint8), bitorder="little"
-        )[: packed.R].astype(bool)
-        idx = np.nonzero(mask)[0]
+        DNF rules one policy may lower to).
+
+        ``col_map`` translates shard-partitioned mesh layouts: there a
+        bit's position names a PARTITIONED column, not a packed rule
+        index — parallel/mesh.py bits_rule_indices (the one decoder of
+        that wire format) maps it back."""
+        from ..parallel.mesh import bits_rule_indices
+
+        idx = bits_rule_indices(bits_row, col_map, packed.R)
         pols = packed.rule_policy[idx]
         grps = packed.rule_group[idx]
         valid = pols != INT32_MAX  # padding rules can never match, belt+braces

@@ -888,7 +888,7 @@ class SARFastPath(_RawFastPath):
 
     def _decode_bits_payload(self, snap: _Snapshot, row_bits) -> Result:
         packed = snap.cs.packed
-        groups = self.engine._bits_groups(packed, row_bits)
+        groups = self.engine._bits_groups(packed, row_bits, snap.cs.col_map)
         decision, diag = self.engine._finalize_sets(packed, groups, None, None)
         return self._map_decision(decision, diag)
 
@@ -1081,7 +1081,7 @@ class AdmissionFastPath(_RawFastPath):
         import json as _json
 
         packed = snap.cs.packed
-        groups = self.engine._bits_groups(packed, row_bits)
+        groups = self.engine._bits_groups(packed, row_bits, snap.cs.col_map)
         decision, diag = self.engine._finalize_sets(packed, groups, None, None)
         if decision == DENY and diag.reasons:
             return (

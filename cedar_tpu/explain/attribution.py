@@ -220,12 +220,18 @@ def host_sat(
     return sat[: packed.n_rules]
 
 
-def sat_from_bits(packed: PackedPolicySet, bits_row) -> np.ndarray:
-    """One device rule-bitset row ([R/32] uint32) -> [n_rules] bool."""
-    mask = np.unpackbits(
-        np.ascontiguousarray(bits_row).view(np.uint8), bitorder="little"
-    )[: packed.R].astype(bool)
-    return mask[: packed.n_rules]
+def sat_from_bits(packed: PackedPolicySet, bits_row, col_map=None) -> np.ndarray:
+    """One device rule-bitset row ([R/32] uint32) -> [n_rules] bool.
+
+    ``col_map`` translates shard-partitioned MESH layouts (the engine's
+    compiled set carries it); decoding is shared with the engine's
+    diagnostics via parallel/mesh.py bits_rule_indices — the one decoder
+    of the partitioned wire format."""
+    from ..parallel.mesh import bits_rule_indices
+
+    sat = np.zeros((packed.n_rules,), dtype=bool)
+    sat[bits_rule_indices(bits_row, col_map, packed.n_rules)] = True
+    return sat
 
 
 def _groups_from_sat(packed: PackedPolicySet, sat: np.ndarray) -> dict:

@@ -131,7 +131,9 @@ class Explainer:
                 bits = self._plane(engine).explain_row(
                     codes_arr, extras_arr, cs=cs
                 )
-                sat = sat_from_bits(cs.packed, bits[0])
+                sat = sat_from_bits(
+                    cs.packed, bits[0], getattr(cs, "col_map", None)
+                )
                 return build_explanation(
                     cs.packed, sat, entities, request, source=SOURCE_DEVICE
                 )

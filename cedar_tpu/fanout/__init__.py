@@ -1,0 +1,47 @@
+"""Cross-process worker tier: one logical webhook spanning N processes.
+
+PR 7's fleet replicates engines inside one process; this package is the
+next tier up (ROADMAP open item 1). A lightweight front-end
+consistent-hashes canonical request fingerprints (cache/fingerprint.py —
+the SAME key the decision cache, recorder, and audit log already share)
+onto N webhook workers, each a full serving stack (engine + fast path +
+batcher + decision cache). Three properties make the tier one logical
+webhook instead of N:
+
+  * **Deterministic routing with rehash-on-death** (ring.py): a
+    fingerprint's home worker is stable, so repeat traffic stays warm;
+    a dead worker's keys move to their next ring choice and ONLY those
+    keys move (consistent hashing), while the front-end restarts the
+    worker supervisor-style (PR 6).
+  * **A generation barrier over the control channel** (frontend.py):
+    policy swaps (reloads, rollout promote/rollback) commit on every
+    worker or none — the PR 7 fleet-atomic barrier stretched across
+    process boundaries, with the plane's content-derived wire state
+    (cache/generation.py plane_wire_state) proving the tier coherent.
+  * **A peer-shared decision cache** (peers.py): a repeat SAR hits warm
+    on ANY worker. Entries replicate with ShardScopedStamp semantics
+    preserved over the wire — keyed on per-shard CONTENT hashes, so an
+    incremental adoption kills exactly the changed shard's entries on
+    every worker, and nothing process-local ever crosses the wire.
+
+Transports are pluggable: tests and embedders run workers in-process
+(worker.py InProcessWorker — isolated stacks, direct calls); ``bench.py
+--fanout`` and production spawn real processes (proc.py) speaking the
+same protocol over pipes. Chaos seams: ``fanout.route``,
+``fanout.worker_kill``, ``fanout.swap``, ``cache.peer_fetch``.
+"""
+
+from .frontend import FanoutFrontend, FanoutUnavailable
+from .peers import PeerBackedCache, PeerNet
+from .ring import HashRing
+from .worker import InProcessWorker, WorkerDied
+
+__all__ = [
+    "FanoutFrontend",
+    "FanoutUnavailable",
+    "HashRing",
+    "InProcessWorker",
+    "PeerBackedCache",
+    "PeerNet",
+    "WorkerDied",
+]

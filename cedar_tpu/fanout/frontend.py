@@ -1,0 +1,393 @@
+"""The fanout front-end: route, supervise, and swap as one logical tier.
+
+Routing: the canonical request fingerprint (the decision cache's own
+key, memoized per raw body) walks the consistent-hash ring (ring.py);
+the first ALIVE worker in preference order serves. A worker dying
+mid-request (``WorkerDied``) re-routes the request to its next
+preference — that fall-through IS the rehash, so a worker loss moves
+exactly its own keys and nothing else. Dead workers are restarted
+supervisor-style (``register_with`` plugs into the PR 6 Supervisor; the
+front-end also self-heals inline when no supervisor is wired).
+
+Swaps: ``load()`` / ``promote()`` drive the PR 7 generation barrier over
+the control channel — every worker ``swap()``s (retaining its prior set
+in its OWN memory) or every worker ``restore()``s; only a tier-wide
+success ``commit()``s. After a commit the tier's plane wire states
+(worker.plane_wire) must agree — ``status()["coherent"]`` is the
+operator's invariant check, and the cross-worker peer cache (peers.py)
+refuses records from any worker that drifted.
+
+Raises ``FanoutUnavailable`` when no worker can serve — the caller
+(server/http.py) degrades to its interpreter path, exactly like the
+fleet's no-replica-admits posture.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from ..cache.fingerprint import FingerprintMemo
+from ..chaos.registry import ThreadKilled, chaos_fire
+from .peers import PeerNet
+from .ring import HashRing
+from .worker import WorkerDied
+
+log = logging.getLogger(__name__)
+
+
+class FanoutUnavailable(Exception):
+    """No fanout worker can serve (all dead / none registered)."""
+
+
+def _metric(fn_name: str, *args) -> None:
+    try:
+        from ..server import metrics
+
+        getattr(metrics, fn_name)(*args)
+    except Exception:  # noqa: BLE001 — metrics never break routing
+        pass
+
+
+class _WorkerLiveness:
+    """Thread-shaped liveness probe for the PR 6 Supervisor (it only
+    reads ``is_alive()`` and ``name``): a dead worker process reads as a
+    dead thread, so the existing watchdog restarts workers exactly like
+    batcher stages."""
+
+    def __init__(self, worker):
+        self._worker = worker
+        self.name = f"fanout-{worker.worker_id}"
+
+    def is_alive(self) -> bool:
+        try:
+            return self._worker.alive()
+        except Exception:  # noqa: BLE001 — a sick probe reads dead
+            return False
+
+
+class FanoutFrontend:
+    def __init__(
+        self,
+        workers,
+        name: str = "fanout",
+        vnodes: int = 64,
+        memo_capacity: int = 65536,
+        peer_fetch: bool = True,
+        peer_gossip: bool = True,
+    ):
+        if not workers:
+            raise ValueError("FanoutFrontend: at least one worker required")
+        self.name = name
+        self.workers: Dict[str, object] = {w.worker_id: w for w in workers}
+        if len(self.workers) != len(workers):
+            raise ValueError("FanoutFrontend: duplicate worker ids")
+        self.ring = HashRing(self.workers, vnodes=vnodes)
+        self.net = PeerNet()
+        self._memo = FingerprintMemo(capacity=memo_capacity)
+        self._adm_memo = FingerprintMemo(capacity=4096)
+        self._lock = threading.Lock()  # barrier/topology mutations
+        self._swap_epoch = 0
+        self._stats_lock = threading.Lock()
+        self.routed: Dict[str, int] = {w: 0 for w in self.workers}
+        self.reroutes = 0
+        self.deaths = 0
+        self.restarts = 0
+        for w in workers:
+            self.net.register(w.worker_id, w)
+            cache = getattr(w, "cache", None)
+            bind = getattr(cache, "bind", None)
+            if bind is not None:
+                cache.fetch_enabled = peer_fetch
+                cache.gossip_enabled = peer_gossip
+                bind(self.net, w.worker_id, order_fn=self.ring.preference)
+            _metric("set_fanout_worker_state", self.name, w.worker_id, 1)
+
+    # -------------------------------------------------------------- routing
+
+    def _routing_key(self, endpoint: str, body: bytes) -> str:
+        memo = self._memo if endpoint == "authorize" else self._adm_memo
+        try:
+            fp = memo.fingerprint(endpoint, body)
+        except Exception:  # noqa: BLE001 — unparseable routes by raw bytes
+            fp = None
+        if fp is not None:
+            return fp
+        # unparseable body: no canonical identity, but routing must still
+        # be deterministic so the (error) answer is worker-independent
+        return "raw:" + hashlib.sha256(body).hexdigest()
+
+    def _mark_dead(self, worker, reason: str) -> None:
+        with self._stats_lock:
+            self.deaths += 1
+        _metric("set_fanout_worker_state", self.name, worker.worker_id, 0)
+        log.warning(
+            "fanout %s: worker %s died (%s); rehashing around it",
+            self.name,
+            worker.worker_id,
+            reason,
+        )
+
+    def _dispatch(self, endpoint: str, body: bytes, request_id):
+        key = self._routing_key(endpoint, body)
+        first_choice = True
+        for wid in self.ring.preference(key):
+            worker = self.workers.get(wid)
+            if worker is None:
+                continue
+            try:
+                alive = worker.alive()
+            except Exception:  # noqa: BLE001 — a sick probe reads dead
+                alive = False
+            if not alive:
+                first_choice = False
+                continue
+            try:
+                chaos_fire("fanout.route", wid)
+            except ThreadKilled as e:
+                # route-seam kill: the worker became unreachable at hand-off
+                kill = getattr(worker, "kill", None)
+                if kill is not None:
+                    kill()
+                self._mark_dead(worker, str(e))
+                first_choice = False
+                continue
+            if not first_choice:
+                with self._stats_lock:
+                    self.reroutes += 1
+                _metric("record_fanout_reroute", self.name)
+            with self._stats_lock:
+                self.routed[wid] = self.routed.get(wid, 0) + 1
+            _metric("record_fanout_routed", self.name, wid)
+            try:
+                if endpoint == "authorize":
+                    return worker.authorize(body, request_id)
+                return worker.admit(body, request_id)
+            except WorkerDied as e:
+                self._mark_dead(worker, str(e))
+                first_choice = False
+                continue
+        raise FanoutUnavailable(f"fanout {self.name}: no live worker")
+
+    def authorize(self, body: bytes, request_id: Optional[str] = None):
+        """(decision, reason, error) from the key's first live worker."""
+        return self._dispatch("authorize", body, request_id)
+
+    def admit(self, body: bytes, request_id: Optional[str] = None) -> dict:
+        return self._dispatch("admit", body, request_id)
+
+    def supports_admit(self) -> bool:
+        """True when every worker can evaluate admission reviews; the
+        server routes /v1/admit through the tier only then — an
+        admission-less worker would answer its fail-mode (allow, by
+        default) instead of evaluating, silently bypassing admission
+        enforcement tier-wide."""
+        try:
+            return all(
+                getattr(w, "supports_admit", lambda: False)()
+                for w in self.workers.values()
+            )
+        except Exception:  # noqa: BLE001 — doubt = keep the local stack
+            return False
+
+    # ----------------------------------------------- barrier (control channel)
+
+    def load(self, spec, warm: str = "default") -> dict:
+        """Reloader target (duck-types TPUPolicyEngine.load): swap the
+        tier to the policy set ``spec`` resolves to under the generation
+        barrier — every worker serves the new set, or every worker keeps
+        (is restored to) its prior one. Incremental per worker: each
+        worker's own shard cache diffs the spec, so a one-policy edit
+        re-lowers one shard on every worker and the scoped cache stamps
+        kill exactly that shard's entries tier-wide."""
+        del warm  # workers own their warm policy (swap uses warm="off")
+        with self._lock:
+            done: List = []
+            stats: dict = {}
+            try:
+                for wid, worker in self.workers.items():
+                    chaos_fire("fanout.swap", wid)
+                    stats = worker.swap(spec)
+                    done.append(worker)
+            except BaseException as e:
+                for worker in reversed(done):
+                    try:
+                        worker.restore()
+                    except Exception:  # noqa: BLE001 — keep restoring the rest
+                        log.exception(
+                            "fanout %s: restore of %s after a failed swap "
+                            "ALSO failed",
+                            self.name,
+                            worker.worker_id,
+                        )
+                log.error(
+                    "fanout %s: tier swap failed after %d worker(s); "
+                    "restored: %s",
+                    self.name,
+                    len(done),
+                    e,
+                )
+                raise
+            for worker in done:
+                # commit is cleanup, not state change: every worker is
+                # ALREADY serving the new set, so a failing commit (a
+                # wire hiccup on a proc handle) must not unwind the
+                # barrier — the worker just retains its prior set until
+                # the next swap drops it
+                try:
+                    worker.commit()
+                except Exception:  # noqa: BLE001 — serving state is uniform
+                    log.exception(
+                        "fanout %s: commit on %s failed (swap already "
+                        "serving tier-wide; prior set retained there)",
+                        self.name,
+                        worker.worker_id,
+                    )
+            self._swap_epoch += 1
+        if not self.plane_coherent():
+            # committed but drifted (a worker compiled different content
+            # from the same spec): loudly visible — peer sharing already
+            # self-protects via wire-state validation
+            log.error("fanout %s: tier swap committed INCOHERENT", self.name)
+        return stats
+
+    promote = load  # rollout promotion is the same barrier over a new spec
+
+    # ------------------------------------------------------------ lifecycle
+
+    def restart_worker(self, worker_id: str) -> bool:
+        """Revive (or respawn, for proc handles) one dead worker and put
+        it back in rotation. The restarted worker comes back COLD
+        (worker.revive clears its cache) and re-warms from traffic plus
+        the peer mesh."""
+        worker = self.workers.get(worker_id)
+        if worker is None:
+            return False
+        revive = getattr(worker, "revive", None)
+        if revive is None or not revive():
+            return False
+        with self._stats_lock:
+            self.restarts += 1
+        _metric("record_fanout_restart", self.name)
+        _metric("set_fanout_worker_state", self.name, worker_id, 1)
+        # process workers come back on a FRESH peer port: re-announce the
+        # mesh tier-wide or the revived worker's cache stays unbound and
+        # siblings gossip into the dead port forever (a no-op for
+        # in-process workers, whose endpoints are the objects themselves)
+        self._rewire_peers()
+        # a revived worker may be serving an older plane than the tier
+        # (swaps skip dead workers only via barrier failure; a clean kill
+        # between swaps needs no catch-up — swap() runs on live workers
+        # under the lock). Coherence is checked, not assumed:
+        if not self.plane_coherent():
+            log.warning(
+                "fanout %s: worker %s revived onto a stale plane",
+                self.name,
+                worker_id,
+            )
+        return True
+
+    def _rewire_peers(self) -> None:
+        """Re-announce the peer mesh to every transport-backed worker
+        (ProcWorkerHandle exposes peer_port/peer_config; in-process
+        workers talk object-to-object and need nothing)."""
+        ports = {
+            wid: getattr(w, "peer_port", None)
+            for wid, w in self.workers.items()
+        }
+        ports = {wid: p for wid, p in ports.items() if p}
+        if not ports:
+            return
+        for wid, w in self.workers.items():
+            config = getattr(w, "peer_config", None)
+            if config is None or not w.alive():
+                continue
+            try:
+                config({k: v for k, v in ports.items() if k != wid})
+            except Exception:  # noqa: BLE001 — a dead worker re-meshes later
+                log.exception(
+                    "fanout %s: peer re-mesh for %s failed", self.name, wid
+                )
+
+    def register_with(self, supervisor) -> None:
+        """Put every worker under the PR 6 Supervisor: liveness is the
+        worker's own alive(), restart is restart_worker — the same
+        watchdog loop that revives batcher stages revives workers."""
+        for wid, worker in self.workers.items():
+            supervisor.register(
+                f"fanout.{self.name}",
+                threads=lambda w=worker: [_WorkerLiveness(w)],
+                restart=lambda reason, i=wid: self.restart_worker(i),
+                replica=wid,
+            )
+
+    def stop(self) -> None:
+        for worker in self.workers.values():
+            try:
+                worker.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception(
+                    "fanout %s: worker %s stop failed",
+                    self.name,
+                    getattr(worker, "worker_id", "?"),
+                )
+
+    # --------------------------------------------------------------- status
+
+    def warm_ready(self) -> bool:
+        return all(
+            w.warm_ready() for w in self.workers.values() if w.alive()
+        )
+
+    def alive_workers(self) -> List[str]:
+        return [wid for wid, w in self.workers.items() if w.alive()]
+
+    def plane_coherent(self) -> bool:
+        """True when every live worker serves the same plane CONTENT
+        (wire-state tokens equal). Workers without shard lineage (legacy
+        non-incremental engines) read as coherent-unknown = False only
+        when they disagree with a lineage-bearing sibling."""
+        tokens = set()
+        for w in self.workers.values():
+            if not w.alive():
+                continue
+            try:
+                wire = w.plane_wire()
+            except Exception:  # noqa: BLE001 — unreadable = incoherent
+                return False
+            tokens.add(wire["token"] if wire else None)
+        return len(tokens) <= 1
+
+    def status(self) -> dict:
+        """The /debug/fanout document."""
+        with self._stats_lock:
+            routed = dict(self.routed)
+            doc = {
+                "fanout": self.name,
+                "swap_epoch": self._swap_epoch,
+                "reroutes": self.reroutes,
+                "deaths": self.deaths,
+                "restarts": self.restarts,
+            }
+        doc["routed"] = routed
+        doc["coherent"] = self.plane_coherent()
+        doc["workers"] = []
+        for wid, w in self.workers.items():
+            try:
+                stats = w.stats()
+            except Exception:  # noqa: BLE001 — debug must not 500
+                stats = {"worker": wid, "alive": False, "error": "unreachable"}
+            wire = None
+            try:
+                wire = w.plane_wire()
+            except Exception:  # noqa: BLE001
+                pass
+            if wire is not None:
+                stats["plane_token"] = wire["token"][:12]
+            doc["workers"].append(stats)
+        return doc
+
+
+__all__ = ["FanoutFrontend", "FanoutUnavailable"]

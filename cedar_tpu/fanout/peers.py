@@ -1,0 +1,396 @@
+"""Peer-shared decision cache: a repeat SAR hits warm on any worker.
+
+Every worker already runs the PR 3 DecisionCache keyed on canonical
+fingerprints with PR 11's shard-scoped generation stamps. This module
+stretches those exact semantics across workers without letting anything
+process-local cross the wire:
+
+  * a **wire record** carries (key, value, decision class, remaining
+    TTL) plus the entry's scope translated to CONTENT terms — the
+    determining shards' per-shard content hashes for a ShardScopedStamp,
+    or the whole plane's wire token for an unscoped entry
+    (cache/generation.py plane_wire_state). Shard generation numbers and
+    structural plane ids are per-process counters and never leave the
+    process;
+  * the **receiver re-derives a local stamp**: it accepts a record only
+    when its OWN serving plane carries the same content for every named
+    shard (or the same whole-plane token), then stamps the entry with
+    its own live PlaneGenerations scoped to those shards. From that
+    moment the entry lives under the receiver's normal invalidation
+    rules — an incremental adoption on ANY worker's next reload kills
+    exactly the changed shard's replicated entries, because the barrier
+    (frontend.py) lands the same content change on every worker;
+  * **TTL rides along and only ever shrinks** (DecisionCache.put ttl_s):
+    replication cannot restart the staleness clock, so the documented
+    cross-shard staleness bound (docs/caching.md) holds tier-wide.
+
+Two replication paths share the validation: **peer fetch** (on a local
+miss, ask the key's ring-preferred holders — the spillover/rehash warm
+path) and **gossip** (on a local miss-fill, push the record to peers —
+what makes a worker-kill rehash land on already-warm successors). Both
+ride the ``cache.peer_fetch`` chaos seam; a sick peer costs a miss,
+never an answer.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ..cache.decision_cache import DecisionCache, _UNSET
+from ..cache.generation import PlaneGenerations, ShardScopedStamp
+from ..chaos.registry import ThreadKilled, chaos_fire
+
+log = logging.getLogger(__name__)
+
+
+def _record_metric(path: str, event: str, n: int = 1) -> None:
+    try:
+        from ..server.metrics import record_peer_cache
+
+        record_peer_cache(path, event, n)
+    except Exception:  # noqa: BLE001 — metrics never break peer traffic
+        pass
+
+
+class PeerNet:
+    """The worker-to-worker transport (in-process flavor): a registry of
+    peer endpoints — objects exposing ``peer_get(key)`` and
+    ``gossip_in(record)``. The proc transport (proc.py) registers handles
+    that speak the same two calls over the worker's pipe, so the cache
+    logic never knows which deployment it is in."""
+
+    def __init__(self, path: str = "authorization"):
+        self.path = path
+        self._peers: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def register(self, worker_id: str, endpoint) -> None:
+        with self._lock:
+            self._peers[worker_id] = endpoint
+
+    def unregister(self, worker_id: str) -> None:
+        with self._lock:
+            self._peers.pop(worker_id, None)
+
+    def _peer(self, worker_id: str):
+        with self._lock:
+            return self._peers.get(worker_id)
+
+    def peer_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._peers)
+
+    def fetch(
+        self, requester_id: str, key: str, order: Optional[List[str]] = None
+    ) -> Optional[dict]:
+        """Ask peers for ``key`` in ``order`` (the ring preference — the
+        home worker is the likeliest holder); first wire record wins.
+        Containment: ANY peer failure (including an injected kill — the
+        process-loss analogue) skips that peer."""
+        ids = [w for w in (order or self.peer_ids()) if w != requester_id]
+        for wid in ids:
+            ep = self._peer(wid)
+            if ep is None:
+                continue
+            try:
+                chaos_fire("cache.peer_fetch", ("fetch", requester_id, wid))
+                rec = ep.peer_get(key)
+            except (Exception, ThreadKilled):  # noqa: BLE001 — peer = best-effort
+                log.debug("peer fetch from %s failed", wid, exc_info=True)
+                continue
+            if rec is not None:
+                return rec
+        return None
+
+    def gossip(
+        self,
+        origin_id: str,
+        record: dict,
+        targets: Optional[List[str]] = None,
+    ) -> int:
+        """Push one wire record to ``targets`` (default: every other
+        peer); returns deliveries."""
+        n = 0
+        for wid in targets if targets is not None else self.peer_ids():
+            if wid == origin_id:
+                continue
+            ep = self._peer(wid)
+            if ep is None:
+                continue
+            try:
+                chaos_fire("cache.peer_fetch", ("gossip", origin_id, wid))
+                ep.gossip_in(record)
+                n += 1
+            except (Exception, ThreadKilled):  # noqa: BLE001 — best-effort
+                log.debug("gossip to %s failed", wid, exc_info=True)
+        return n
+
+
+class PeerBackedCache(DecisionCache):
+    """A DecisionCache that replicates through a PeerNet (module
+    docstring). Construct like a DecisionCache, then ``bind()`` it to
+    the net once the tier exists; unbound it behaves exactly like its
+    base class."""
+
+    def __init__(
+        self,
+        *args,
+        wire_state_fn: Optional[Callable[[], Optional[dict]]] = None,
+        fetch_enabled: bool = True,
+        gossip_enabled: bool = True,
+        gossip_async: bool = False,
+        fetch_limit: int = 2,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        # () -> plane_wire_state(engine) for THIS worker's serving plane
+        self.wire_state_fn = wire_state_fn
+        self.fetch_enabled = fetch_enabled
+        self.gossip_enabled = gossip_enabled
+        # gossip_async moves replication OFF the serving thread: records
+        # queue (bounded, shed-oldest) and a daemon drains them to peers.
+        # Default synchronous — deterministic for in-process tiers/tests;
+        # the process transport turns this on (a miss-fill must not pay
+        # N-1 socket round trips inline).
+        self.gossip_async = gossip_async
+        # how many ring-preferred peers a miss may ask before giving up:
+        # the home worker is overwhelmingly the holder, and walking the
+        # whole tier would put O(workers) sockets on the miss path
+        self.fetch_limit = max(1, int(fetch_limit))
+        # how many ring-successors of a key receive its gossip: the
+        # rehash-warmth property needs exactly the workers a dead home's
+        # keys would land on, not the whole tier (O(N) sockets per fill)
+        self.gossip_fanout = 2
+        self._gossip_q: "deque" = deque(maxlen=1024)
+        self._gossip_wake = threading.Event()
+        self._gossip_thread: Optional[threading.Thread] = None
+        self._net: Optional[PeerNet] = None
+        self.worker_id = ""
+        self._order_fn: Optional[Callable[[str], List[str]]] = None
+        # keys whose live entry came from a peer (fetch or gossip): a hit
+        # on one is a CROSS-WORKER hit — the tier-level warmth signal the
+        # fanout bench gates on. Bounded: reset when it outgrows the
+        # cache (stale members only misclassify a re-filled key's first
+        # hits, never correctness).
+        self._peer_keys: set = set()
+        self._stats_lock = threading.Lock()
+        self.peer_stats = {
+            "fetches": 0,
+            "fetch_hits": 0,
+            "gossip_out": 0,
+            "gossip_in": 0,
+            "stale_dropped": 0,
+            "peer_served": 0,
+        }
+
+    def bind(self, net: PeerNet, worker_id: str, order_fn=None) -> None:
+        self._net = net
+        self.worker_id = worker_id
+        self._order_fn = order_fn
+        if self.gossip_async and self._gossip_thread is None:
+            t = threading.Thread(
+                target=self._gossip_drain,
+                daemon=True,
+                name=f"gossip-{worker_id}",
+            )
+            self._gossip_thread = t
+            t.start()
+
+    def _gossip_drain(self) -> None:
+        while True:
+            self._gossip_wake.wait()
+            self._gossip_wake.clear()
+            while True:
+                try:
+                    rec, targets = self._gossip_q.popleft()
+                except IndexError:
+                    break
+                net = self._net
+                if net is None:
+                    continue
+                try:
+                    self._count(
+                        "gossip_out",
+                        net.gossip(self.worker_id, rec, targets),
+                    )
+                except Exception:  # noqa: BLE001 — replication is best-effort
+                    log.debug("gossip drain failed", exc_info=True)
+
+    def _count(self, event: str, n: int = 1) -> None:
+        if n <= 0:
+            return
+        with self._stats_lock:
+            self.peer_stats[event] += n
+        _record_metric(self.path, event, n)
+
+    # ------------------------------------------------------------ wire out
+
+    def _to_wire(self, key: str, value, decision_class: str, stamp) -> Optional[dict]:
+        wire = self.wire_state_fn() if self.wire_state_fn else None
+        if wire is None:
+            return None
+        rec = {
+            "key": key,
+            "value": value,
+            "class": decision_class,
+            "ttl": self.ttl_for(decision_class),
+        }
+        if isinstance(stamp, ShardScopedStamp):
+            shards = {}
+            for sid, _gen in stamp.shard_gens:
+                h = wire["shards"].get(sid)
+                if h is None:  # lineage drifted mid-flight: full scope
+                    rec["token"] = wire["token"]
+                    return rec
+                shards[sid] = h
+            rec["shards"] = shards
+        else:
+            rec["token"] = wire["token"]
+        return rec
+
+    def peer_get(self, key: str) -> Optional[dict]:
+        """Serve one entry to a sibling worker as a wire record (or None).
+        Freshness is judged by THIS worker's own rules (peer_lookup), and
+        the remaining TTL rides the record so the receiver's clock starts
+        where ours left off."""
+        got = self.peer_lookup(key)
+        if got is None:
+            return None
+        value, decision_class, stamp, ttl_left = got
+        rec = self._to_wire(key, value, decision_class, stamp)
+        if rec is None:
+            return None
+        rec["ttl"] = ttl_left
+        return rec
+
+    # ------------------------------------------------------------- wire in
+
+    def _local_stamp(self, record: dict):
+        """Validate a wire record against THIS worker's serving plane and
+        return the local generation stamp to store it under, or None when
+        the record describes content this plane does not serve."""
+        wire = self.wire_state_fn() if self.wire_state_fn else None
+        if wire is None:
+            return None
+        gen = self.current_generation()
+        shards = record.get("shards")
+        if shards:
+            for sid, h in shards.items():
+                if wire["shards"].get(sid) != h:
+                    return None
+            if isinstance(gen, PlaneGenerations):
+                gens = []
+                for sid in sorted(shards):
+                    g = gen.shards.get(sid)
+                    if g is None:
+                        return None
+                    gens.append((sid, g))
+                return ShardScopedStamp(gen.base, tuple(gens))
+            return None  # content matches but no local lineage: reject
+        if record.get("token") != wire["token"]:
+            return None
+        return gen
+
+    def _accept(self, record: dict, event: str) -> bool:
+        stamp = self._local_stamp(record)
+        if stamp is None:
+            self._count("stale_dropped")
+            return False
+        ttl = record.get("ttl")
+        ok = DecisionCache.put(
+            self,
+            record["key"],
+            record["value"],
+            record["class"],
+            generation=stamp,
+            ttl_s=ttl,
+        )
+        if ok:
+            self._peer_keys.add(record["key"])
+            if len(self._peer_keys) > 2 * self.max_entries:
+                self._peer_keys = {record["key"]}
+            self._count(event)
+        return ok
+
+    def gossip_in(self, record: dict) -> bool:
+        return self._accept(record, "gossip_in")
+
+    # ------------------------------------------------------------- surface
+
+    def get(self, key: str):
+        value = super().get(key)
+        if value is not None:
+            if key in self._peer_keys:
+                self._count("peer_served")
+            return value
+        net = self._net
+        if net is None or not self.fetch_enabled:
+            return None
+        order = self._order_fn(key) if self._order_fn else None
+        if order and order[0] == self.worker_id:
+            # this worker IS the key's ring home: gossip replicates every
+            # fill here too, so a home-side miss is (races aside) a
+            # tier-wide miss — asking peers would put socket round trips
+            # into busy siblings on the common miss path for nothing.
+            # Fetch earns its cost exactly when this worker is a
+            # SPILLOVER/rehash target and the home (or a gossip-warmed
+            # sibling) holds the entry.
+            return None
+        if order is not None:
+            order = order[: self.fetch_limit + 1]  # +1: self may lead it
+        self._count("fetches")
+        rec = net.fetch(self.worker_id, key, order)
+        if rec is None or rec.get("key") != key:
+            return None
+        if not self._accept(rec, "fetch_hits"):
+            return None
+        return rec["value"]
+
+    def put(
+        self, key: str, value, decision_class: str, generation=_UNSET, ttl_s=None
+    ) -> bool:
+        ok = super().put(
+            key, value, decision_class, generation=generation, ttl_s=ttl_s
+        )
+        if ok:
+            # a LOCAL fill supersedes any peer-origin residue: hits on it
+            # are this worker's own warmth, not cross-worker serving
+            self._peer_keys.discard(key)
+        net = self._net
+        if ok and net is not None and self.gossip_enabled:
+            # a local miss-fill is fresh tier-wide knowledge: push it so a
+            # rehash (worker death) lands on already-warm successors
+            stamp = None if generation is _UNSET else generation
+            if stamp is not None:
+                rec = self._to_wire(key, value, decision_class, stamp)
+                if rec is not None:
+                    targets = None
+                    if self._order_fn is not None:
+                        targets = [
+                            w
+                            for w in self._order_fn(key)
+                            if w != self.worker_id
+                        ][: self.gossip_fanout]
+                    if self.gossip_async:
+                        # shed-oldest when full
+                        self._gossip_q.append((rec, targets))
+                        self._gossip_wake.set()
+                    else:
+                        self._count(
+                            "gossip_out",
+                            net.gossip(self.worker_id, rec, targets),
+                        )
+        return ok
+
+    def stats(self) -> dict:
+        out = super().stats()
+        with self._stats_lock:
+            out["peer"] = dict(self.peer_stats)
+        out["peer"]["worker"] = self.worker_id
+        return out
+
+
+__all__ = ["PeerBackedCache", "PeerNet"]

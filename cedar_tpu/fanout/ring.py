@@ -1,0 +1,101 @@
+"""Consistent-hash ring: canonical fingerprint → worker preference order.
+
+Classic Karger-style ring with virtual nodes: each worker id hashes to
+``vnodes`` points on a 64-bit circle, a key routes to the first vnode at
+or clockwise of its own hash, and the PREFERENCE ORDER for a key is the
+sequence of distinct workers walking clockwise from there. Two
+properties the front-end leans on:
+
+  * **Stability** — a key's home worker depends only on the worker-id
+    set, never on arrival order or worker count history, so every
+    front-end instance (and a restarted one) routes identically;
+  * **Minimal movement** — removing a worker reassigns ONLY the keys it
+    owned (they fall through to their next preference, which was already
+    their spillover target); adding one steals ~1/N of each peer's keys.
+
+blake2b, not Python hash(): hash() is per-process-seeded (PYTHONHASHSEED),
+and routing must agree across front-end processes.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Dict, List, Sequence
+
+DEFAULT_VNODES = 64
+
+
+def _point(data: str) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(data.encode(), digest_size=8).digest(), "big"
+    )
+
+
+class HashRing:
+    def __init__(self, workers: Sequence[str] = (), vnodes: int = DEFAULT_VNODES):
+        self.vnodes = max(1, int(vnodes))
+        self._points: List[int] = []  # sorted vnode positions
+        self._owner: Dict[int, str] = {}  # position -> worker id
+        self._workers: set = set()
+        for w in workers:
+            self.add(w)
+
+    def add(self, worker_id: str) -> None:
+        if worker_id in self._workers:
+            return
+        self._workers.add(worker_id)
+        for v in range(self.vnodes):
+            p = _point(f"{worker_id}#{v}")
+            # 64-bit collisions are ~impossible at tier scale; keep the
+            # first owner deterministic (sorted) if one ever lands
+            if p in self._owner:
+                if self._owner[p] < worker_id:
+                    continue
+            else:
+                bisect.insort(self._points, p)
+            self._owner[p] = worker_id
+
+    def remove(self, worker_id: str) -> None:
+        if worker_id not in self._workers:
+            return
+        self._workers.discard(worker_id)
+        dead = [p for p, w in self._owner.items() if w == worker_id]
+        for p in dead:
+            del self._owner[p]
+            i = bisect.bisect_left(self._points, p)
+            if i < len(self._points) and self._points[i] == p:
+                del self._points[i]
+
+    @property
+    def workers(self) -> set:
+        return set(self._workers)
+
+    def preference(self, key: str) -> List[str]:
+        """Distinct worker ids in routing order for ``key``: the home
+        worker first, then each successive fallback (the rehash target if
+        every earlier choice is dead). Deterministic across processes."""
+        if not self._points:
+            return []
+        want = len(self._workers)
+        start = bisect.bisect_right(self._points, _point(key))
+        out: List[str] = []
+        seen = set()
+        n = len(self._points)
+        for i in range(n):
+            w = self._owner[self._points[(start + i) % n]]
+            if w not in seen:
+                seen.add(w)
+                out.append(w)
+                if len(out) == want:
+                    break
+        return out
+
+    def home(self, key: str) -> str:
+        pref = self.preference(key)
+        if not pref:
+            raise LookupError("HashRing: no workers")
+        return pref[0]
+
+
+__all__ = ["DEFAULT_VNODES", "HashRing"]

@@ -1,0 +1,224 @@
+"""One fanout worker: a full serving stack behind a narrow wire protocol.
+
+A worker is the tier's unit of failure, exactly as a replica is the
+fleet's (PR 7): its process can die mid-request, its device plane can
+wedge, its policy swap can fail. The front-end only ever talks to the
+protocol below, so in-process workers (tests, embedders) and spawned
+processes (proc.py, ``bench.py --fanout``) are interchangeable:
+
+  serving   ``authorize(body, request_id)`` → (decision, reason, error)
+            ``admit(body)`` → AdmissionReview dict
+  control   ``swap(spec)`` / ``restore()`` / ``commit()`` — the
+            three-step the front-end's generation barrier drives
+            (frontend.py): swap compiles+serves the new set but RETAINS
+            the prior one in the worker's own memory, so a barrier
+            partial failure restores without anything crossing the wire
+  lineage   ``plane_wire()`` — the content-derived plane state
+            (cache/generation.py plane_wire_state) the barrier compares
+            across the tier
+  peering   ``peer_get(key)`` / ``gossip_in(record)`` — the peer cache's
+            two calls (peers.py)
+  health    ``alive()`` / ``revive()`` / ``stats()``
+
+``InProcessWorker`` runs the stack in the calling process. Its
+``kill()``/``revive()`` model a process crash honestly: a killed worker
+refuses work until revived, and a revive CLEARS the decision cache — a
+restarted process comes back cold, which is exactly why gossip exists.
+
+Chaos: ``fanout.worker_kill`` fires inside every request; a kill rule
+marks THIS worker dead mid-request (the in-flight request surfaces
+``WorkerDied``, the front-end's cue to rehash and restart).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from ..chaos.registry import ThreadKilled, chaos_fire
+
+log = logging.getLogger(__name__)
+
+
+class WorkerDied(Exception):
+    """The worker's process is gone (or modeled gone): the request never
+    produced an answer and is safe to re-route — workers are stateless
+    between requests, so a rehash can never double-apply anything."""
+
+    def __init__(self, worker_id: str, reason: str = "killed"):
+        super().__init__(f"fanout worker {worker_id} died: {reason}")
+        self.worker_id = worker_id
+
+
+class InProcessWorker:
+    """See module docstring. ``server`` is a WebhookServer whose HTTP
+    listeners are never started — its ``authorize_core``/``admit_core``
+    ARE the worker's serving calls, so a worker answers byte-identically
+    to a standalone webhook over the same stack. ``tiers_factory``
+    resolves a swap spec into a tier stack (in-process specs can simply
+    BE the tiers: the default factory is identity)."""
+
+    def __init__(
+        self,
+        worker_id: str,
+        server,
+        engine,
+        cache=None,
+        tiers_factory: Optional[Callable] = None,
+        authorizer=None,
+    ):
+        self.worker_id = worker_id
+        self.server = server
+        self.engine = engine
+        self.cache = cache
+        self.authorizer = authorizer
+        self.tiers_factory = tiers_factory or (lambda spec: spec)
+        self._alive = True
+        self._prior = None  # retained pre-swap compiled set (barrier undo)
+        self._prior_valid = False
+        self._lock = threading.Lock()
+        self.requests = 0
+
+    # -------------------------------------------------------------- serving
+
+    def _enter(self) -> None:
+        if not self._alive:
+            raise WorkerDied(self.worker_id, "not running")
+        try:
+            chaos_fire("fanout.worker_kill", self.worker_id)
+        except ThreadKilled as e:
+            # the process-loss model: the worker is gone from here on and
+            # the in-flight request dies with it (typed, so the front-end
+            # rehashes instead of unwinding)
+            self._alive = False
+            raise WorkerDied(self.worker_id, str(e)) from e
+        self.requests += 1
+
+    def authorize(self, body: bytes, request_id: Optional[str] = None):
+        self._enter()
+        return self.server.authorize_core(body, request_id)
+
+    def admit(self, body: bytes, request_id: Optional[str] = None) -> dict:
+        self._enter()
+        return self.server.admit_core(body)
+
+    def supports_admit(self) -> bool:
+        """True when this worker's stack can actually EVALUATE admission
+        reviews. The front-end refuses to route /v1/admit into a tier
+        whose workers lack an admission handler — the worker's fail-mode
+        answer would silently replace the outer (working) admission
+        stack's real evaluation."""
+        return getattr(self.server, "admission_handler", None) is not None
+
+    # -------------------------------------------------------------- control
+
+    def swap(self, spec) -> dict:
+        """Compile + serve the policy set ``spec`` resolves to, retaining
+        the prior compiled set for ``restore()``. Returns compile stats
+        (incl. compile_scope/dirty_shards — incremental when the engine's
+        shard cache allows it)."""
+        with self._lock:
+            tiers = self.tiers_factory(spec)
+            prior = self.engine.compiled_set
+            stats = self.engine.load(tiers, warm="off")
+            self._prior = prior
+            self._prior_valid = True
+            return stats
+
+    def restore(self) -> bool:
+        """Undo the last un-committed swap (barrier partial failure):
+        re-adopt the retained prior set compile-free — or clear the
+        engine when there was none (first load), never leaving this
+        worker serving a generation the tier just refused."""
+        with self._lock:
+            if not self._prior_valid:
+                return False
+            if self._prior is None:
+                self.engine.clear_compiled()
+            else:
+                self.engine.adopt_compiled(self._prior)
+            self._prior = None
+            self._prior_valid = False
+            return True
+
+    def commit(self) -> None:
+        """The barrier committed tier-wide: drop the retained prior."""
+        with self._lock:
+            self._prior = None
+            self._prior_valid = False
+
+    def plane_wire(self) -> Optional[dict]:
+        from ..cache.generation import plane_wire_state
+
+        return plane_wire_state(self.engine)
+
+    # -------------------------------------------------------------- peering
+
+    def peer_get(self, key: str):
+        cache = self.cache
+        if cache is None or not self._alive:
+            return None
+        return cache.peer_get(key)
+
+    def gossip_in(self, record: dict):
+        cache = self.cache
+        if cache is None or not self._alive:
+            return False
+        return cache.gossip_in(record)
+
+    # --------------------------------------------------------------- health
+
+    def alive(self) -> bool:
+        return self._alive
+
+    def kill(self) -> None:
+        """Model a process crash (tests/game days)."""
+        self._alive = False
+
+    def revive(self) -> bool:
+        """Restart the worker. A real process restart loses every
+        in-memory decision — the cache is cleared so warmth has to come
+        back through traffic and the peer mesh, never by fiat."""
+        if self._alive:
+            return False
+        if self.cache is not None:
+            try:
+                self.cache.invalidate_all()
+            except Exception:  # noqa: BLE001 — a sick cache is an empty cache
+                log.exception("worker %s: cache clear on revive failed", self.worker_id)
+        self._alive = True
+        log.warning("fanout worker %s revived", self.worker_id)
+        return True
+
+    def warm_ready(self) -> bool:
+        engine = self.engine
+        return engine is None or engine.warm_ready()
+
+    def stats(self) -> dict:
+        doc = {
+            "worker": self.worker_id,
+            "alive": self._alive,
+            "requests": self.requests,
+        }
+        if self.engine is not None:
+            doc["engine"] = dict(self.engine.stats)
+            doc["load_generation"] = self.engine.load_generation
+        if self.cache is not None:
+            try:
+                doc["cache"] = self.cache.stats()
+            except Exception:  # noqa: BLE001 — debug must not fail routing
+                pass
+        return doc
+
+    def stop(self) -> None:
+        self._alive = False
+        stop = getattr(self.server, "stop_batchers", None)
+        if stop is not None:
+            try:
+                stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception("worker %s: batcher stop failed", self.worker_id)
+
+
+__all__ = ["InProcessWorker", "WorkerDied"]

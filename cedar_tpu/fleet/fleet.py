@@ -326,8 +326,18 @@ class EngineFleet:
     def status(self) -> dict:
         """The /debug/fleet document."""
         self.publish_states()
+        try:
+            from ..server.metrics import worker_label
+
+            worker = worker_label()
+        except Exception:  # noqa: BLE001 — identity is best-effort context
+            worker = ""
         return {
             "fleet": self.name,
+            # this process's fanout worker id (empty on single-process):
+            # a multi-process scrape of N /debug/fleet documents stays
+            # attributable per worker
+            "worker": worker,
             "replicas": [r.health() for r in self.replicas],
             "epoch": self._epoch,
             "load_generation": list(self.load_generation),

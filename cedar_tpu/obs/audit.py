@@ -168,6 +168,17 @@ def audit_entry(
         "fallback": bool(fallback),
         "cached": bool(cached),
     }
+    try:
+        from .trace import _process_worker_id
+
+        w = _process_worker_id()
+        if w:
+            # multi-process tier: the serving worker's id — audit lines
+            # from N worker processes stay joinable per worker instead of
+            # colliding into one anonymous stream
+            entry["worker"] = w
+    except Exception:  # noqa: BLE001 — identity is best-effort context
+        pass
     if tier is not None:
         entry["tier"] = tier
     if error:

@@ -41,6 +41,19 @@ from typing import Optional, Tuple
 
 log = logging.getLogger(__name__)
 
+
+def _process_worker_id() -> str:
+    """This process's fanout worker id (set via the CLI --worker-id /
+    CEDAR_WORKER_ID, held by server.metrics as the one source of truth
+    for the metrics `worker` label too). Empty on single-process
+    deployments — records then stay byte-identical to pre-tier output."""
+    try:
+        from ..server.metrics import worker_label
+
+        return worker_label()
+    except Exception:  # noqa: BLE001 — identity is best-effort context
+        return ""
+
 # bounded per-span attribute set: traces are a debugging surface, not a
 # logging pipeline — unbounded attributes would turn the ring into one
 MAX_SPAN_ATTRS = 16
@@ -270,7 +283,7 @@ class Trace:
                     "attrs": s.attrs,
                 }
             )
-        return {
+        doc = {
             "traceId": self.trace_id,
             "path": self.path,
             "start_unix": round(self.started_unix, 6),
@@ -283,6 +296,13 @@ class Trace:
             "upstreamParent": self.parent_span_id or "",
             "spans": spans,
         }
+        w = _process_worker_id()
+        if w:
+            # multi-process fanout tier: the serving worker's id, so a
+            # trace pulled from any worker's ring joins the tier-wide
+            # metrics scrape and audit records instead of colliding
+            doc["worker"] = w
+        return doc
 
 
 # ------------------------------------------------------- thread-local current

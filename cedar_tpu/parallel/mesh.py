@@ -20,7 +20,9 @@ distributed communication design.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -112,6 +114,262 @@ def sharded_match_fn(mesh: Mesh, n_groups: int):
     return step
 
 
+# ------------------------------------------------ shard-partitioned planes
+
+# H2D placement transfer counter: every single-device upload the
+# partitioned placement performs (and every replicated re-place) bumps it,
+# so a test can pin "a one-policy edit re-places exactly ONE partition"
+# the same way trace counters pin compile-free swaps.
+_placement_transfers = 0
+_placement_lock = threading.Lock()
+
+
+def placement_transfer_count() -> int:
+    """Monotonic count of per-device H2D uploads performed by
+    PartitionedPlanes (diff across an operation to measure it)."""
+    with _placement_lock:
+        return _placement_transfers
+
+
+class MeshCapacityError(ValueError):
+    """The rule set does not fit the per-device packed capacity: one
+    partition's column count exceeds max_rules_per_partition. The fix is
+    more devices on the policy axis (or a higher capacity budget) — the
+    whole point of rule-axis sharding is that capacity scales with
+    device count."""
+
+
+def bits_rule_indices(
+    bits_row: np.ndarray, col_map: Optional[np.ndarray], n_rules: int
+) -> np.ndarray:
+    """Set-bit positions of one device rule-bitset row as PACKED rule
+    indices — the ONE decoder of the partitioned wire format, shared by
+    the engine's diagnostics (_bits_groups) and the explain plane
+    (sat_from_bits) so the two can never drift from the layout this
+    module defines. ``col_map`` is the PartitionedPlanes global-column →
+    packed-rule map (None = unpartitioned: bit position IS the rule
+    index, bounded by ``n_rules``); partition padding (-1) never yields
+    an index."""
+    bits = np.unpackbits(
+        np.ascontiguousarray(bits_row).view(np.uint8), bitorder="little"
+    )
+    if col_map is not None:
+        mask = bits[: col_map.size].astype(bool)
+        idx = col_map[np.nonzero(mask)[0]]
+        return idx[(idx >= 0) & (idx < n_rules)]
+    mask = bits[:n_rules].astype(bool)
+    return np.nonzero(mask)[0]
+
+
+def shard_partition(shard_id: str, n_partitions: int) -> int:
+    """Stable (tier, bucket)-shard → mesh policy-partition assignment:
+    identity-hashed like shard buckets themselves, so an edited shard
+    stays on its owning device and dirties exactly one partition.
+    blake2b for the same GF(2)-linearity reason as compiler/shard.py."""
+    h = int.from_bytes(
+        hashlib.blake2b(shard_id.encode(), digest_size=8).digest(), "big"
+    )
+    return h % max(1, n_partitions)
+
+
+def _roundup(n: int, m: int) -> int:
+    return -(-max(n, 1) // m) * m
+
+
+class PartitionedPlanes:
+    """Shard-aware placement of the packed policy tensors on a mesh.
+
+    The legacy path (shard_codes_tensors) lets jax.device_put split the
+    rule axis evenly — opaque slices, so ANY reload re-uploads every
+    device's shard. This class instead lays the rule columns out BY
+    compiler shard: each (tier, bucket) shard's rules land contiguously
+    in the partition `shard_partition()` assigns, each partition pads to
+    a common bucketed width, and the global arrays assemble from
+    per-device pieces (jax.make_array_from_single_device_arrays). A
+    reload reuses the prior placement's per-device buffers for every
+    partition whose bytes are unchanged — an incremental one-shard edit
+    re-uploads ONE partition's slice of W/thresh/group/policy and leaves
+    every other device's HBM untouched (placement_transfer_count pins
+    it).
+
+    Column order is a permutation of the packed layout, which the
+    first/last reductions never see (they reduce POLICY indices); the
+    only rule-INDEX output is the diagnostics bitset, which decodes
+    through ``col_map`` (global column → packed rule index, -1 padding).
+    """
+
+    def __init__(self, mesh: Mesh, n_partitions: int, r_part: int):
+        self.mesh = mesh
+        self.n_partitions = n_partitions
+        self.r_part = r_part
+        self.col_map: Optional[np.ndarray] = None
+        self.shard_partition_map: Dict[str, int] = {}
+        # (tensor name, partition) -> (digest, per-device single arrays)
+        self._pieces: Dict[Tuple[str, int], Tuple[str, tuple]] = {}
+        self.act_rows_dev = None
+        self.W_dev = None
+        self.thresh_dev = None
+        self.rule_group_dev = None
+        self.rule_policy_dev = None
+        self.transfers_last_build = 0
+
+    # ------------------------------------------------------------ building
+
+    @staticmethod
+    def plan(packed, policy_shard: Dict[str, str], n_partitions: int):
+        """Per-partition packed-rule-index lists. Rules attribute through
+        the pack's per-column back-map (rule_clause carries policy -1 for
+        gate rules — those, and rules of unmapped policies, go to the
+        residual partition 0)."""
+        parts: List[List[int]] = [[] for _ in range(n_partitions)]
+        sids: Dict[int, set] = {p: set() for p in range(n_partitions)}
+        for r in range(packed.n_rules):
+            rc = packed.rule_clause[r]
+            sid = None
+            if rc.pm_idx >= 0:
+                sid = policy_shard.get(packed.policy_meta[rc.pm_idx].policy_id)
+            p = shard_partition(sid, n_partitions) if sid is not None else 0
+            parts[p].append(r)
+            if sid is not None:
+                sids[p].add(sid)
+        return parts, sids
+
+    @classmethod
+    def build(
+        cls,
+        mesh: Mesh,
+        packed,
+        policy_shard: Dict[str, str],
+        int8_plane: bool,
+        prior: "Optional[PartitionedPlanes]" = None,
+        max_rules_per_partition: Optional[int] = None,
+        width_align: int = 64,
+    ) -> "PartitionedPlanes":
+        n_parts = mesh.shape["policy"]
+        parts, sids = cls.plan(packed, policy_shard, n_parts)
+        widest = max(len(p) for p in parts)
+        # bucketed width: small edits that grow a shard keep the layout
+        # (and therefore every clean partition's bytes) stable
+        r_part = _roundup(widest, width_align)
+        if (
+            max_rules_per_partition is not None
+            and r_part > max_rules_per_partition
+        ):
+            raise MeshCapacityError(
+                f"partitioned plane needs {r_part} rule columns per device "
+                f"(widest partition {widest}), over the "
+                f"{max_rules_per_partition}-column device budget with "
+                f"{n_parts} device partition(s) — add devices to the "
+                "policy axis"
+            )
+        self = cls(mesh, n_parts, r_part)
+        for p, ss in sids.items():
+            for sid in ss:
+                self.shard_partition_map[sid] = p
+        if prior is not None and (
+            prior.n_partitions != n_parts or prior.r_part != r_part
+        ):
+            prior = None  # layout changed: nothing is reusable
+
+        L = packed.W.shape[0]
+        w_dtype = np.int8 if int8_plane else jnp.bfloat16
+        thresh_host = (
+            packed.thresh.astype(np.int32) if int8_plane else packed.thresh
+        )
+        col_map = np.full(n_parts * r_part, -1, dtype=np.int32)
+        w_parts, t_parts, g_parts, p_parts = [], [], [], []
+        for p, rows in enumerate(parts):
+            k = len(rows)
+            col_map[p * r_part : p * r_part + k] = rows
+            W_p = np.zeros((L, r_part), dtype=w_dtype)
+            t_p = np.full((r_part,), 10**9, dtype=thresh_host.dtype)
+            g_p = np.zeros((r_part,), dtype=packed.rule_group.dtype)
+            pol_p = np.full(
+                (r_part,), np.iinfo(np.int32).max, dtype=packed.rule_policy.dtype
+            )
+            if k:
+                idx = np.asarray(rows, dtype=np.intp)
+                W_p[:, :k] = np.asarray(packed.W, dtype=w_dtype)[:, idx]
+                t_p[:k] = thresh_host[idx]
+                g_p[:k] = packed.rule_group[idx]
+                pol_p[:k] = packed.rule_policy[idx]
+            w_parts.append(W_p)
+            t_parts.append(t_p)
+            g_parts.append(g_p)
+            p_parts.append(pol_p)
+        self.col_map = col_map
+
+        R_total = n_parts * r_part
+        self.W_dev = self._assemble(
+            "W", w_parts, (L, R_total), P(None, "policy"), prior
+        )
+        self.thresh_dev = self._assemble(
+            "thresh", t_parts, (R_total,), P("policy"), prior
+        )
+        self.rule_group_dev = self._assemble(
+            "group", g_parts, (R_total,), P("policy"), prior
+        )
+        self.rule_policy_dev = self._assemble(
+            "policy", p_parts, (R_total,), P("policy"), prior
+        )
+        self.act_rows_dev = self._assemble_replicated(
+            "act_rows", packed.table.rows, prior
+        )
+        return self
+
+    @staticmethod
+    def _digest(block: np.ndarray) -> str:
+        h = hashlib.blake2b(digest_size=16)
+        h.update(str(block.shape).encode())
+        h.update(np.dtype(block.dtype).str.encode())
+        h.update(np.ascontiguousarray(block).tobytes())
+        return h.hexdigest()
+
+    def _put(self, block: np.ndarray, device):
+        global _placement_transfers
+        with _placement_lock:
+            _placement_transfers += 1
+        self.transfers_last_build += 1
+        return jax.device_put(block, device)
+
+    def _assemble(self, name, blocks, global_shape, spec, prior):
+        """One global array from per-partition host blocks, reusing the
+        prior placement's per-device pieces wherever the bytes match."""
+        sharding = NamedSharding(self.mesh, spec)
+        devs = np.asarray(self.mesh.devices)  # [data, policy]
+        pieces: List = []
+        for p, block in enumerate(blocks):
+            digest = self._digest(block)
+            held = prior._pieces.get((name, p)) if prior is not None else None
+            if held is not None and held[0] == digest:
+                per_dev = held[1]
+            else:
+                per_dev = tuple(
+                    self._put(block, dev) for dev in devs[:, p].flat
+                )
+            self._pieces[(name, p)] = (digest, per_dev)
+            pieces.extend(per_dev)
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, pieces
+        )
+
+    def _assemble_replicated(self, name, block, prior):
+        digest = self._digest(block)
+        held = prior._pieces.get((name, 0)) if prior is not None else None
+        if held is not None and held[0] == digest:
+            per_dev = held[1]
+        else:
+            per_dev = tuple(
+                self._put(block, dev)
+                for dev in np.asarray(self.mesh.devices).flat
+            )
+        self._pieces[(name, 0)] = (digest, per_dev)
+        return jax.make_array_from_single_device_arrays(
+            block.shape, NamedSharding(self.mesh, P(*([None] * block.ndim))),
+            list(per_dev),
+        )
+
+
 # --------------------------------------------------- production codes path
 
 
@@ -131,8 +389,22 @@ def shard_codes_tensors(mesh: Mesh, act_rows, W, thresh, rule_group, rule_policy
     )
 
 
+# pjit step factory invocations: a fresh factory call is a fresh jit (and
+# a first-call trace), so tests pin "an incremental swap builds no new
+# mesh step" exactly like kernel_trace_count pins the XLA planes
+_step_builds = 0
+
+
+def mesh_step_build_count() -> int:
+    return _step_builds
+
+
 def sharded_codes_match_fn(
-    mesh: Mesh, n_tiers: int, has_gate: bool = False, donate: bool = False
+    mesh: Mesh,
+    n_tiers: int,
+    has_gate: bool = False,
+    donate: bool = False,
+    want_full: bool = True,
 ):
     """The production evaluation step, sharded: feature codes in, packed
     uint32 verdict words out. This is the step TPUPolicyEngine.match_arrays
@@ -155,7 +427,15 @@ def sharded_codes_match_fn(
     donate hands the per-batch codes/extras shards back to XLA as scratch
     (ops/match.py match_rules_codes_donated has the rationale); the
     engine enables it on TPU-class backends only — the CPU runtime may
-    alias numpy inputs, which the engine's staging pool reuses."""
+    alias numpy inputs, which the engine's staging pool reuses.
+
+    want_full=False is the SERVING variant: the per-shard partial
+    verdicts still reduce on device, but only the one packed uint32 word
+    per request leaves the computation — the [B, G] first/last extrema
+    never materialize as outputs, so the per-request device→host payload
+    is exactly 4 bytes however many devices the rules span."""
+    global _step_builds
+    _step_builds += 1
     G = n_tiers * 3 + (1 if has_gate else 0)
     in_shardings = (
         NamedSharding(mesh, P("data", None)),  # codes [B, S]
@@ -166,11 +446,14 @@ def sharded_codes_match_fn(
         NamedSharding(mesh, P("policy")),  # rule_group [R]
         NamedSharding(mesh, P("policy")),  # rule_policy [R]
     )
-    out_shardings = (
-        NamedSharding(mesh, P("data")),  # packed words [B]
-        NamedSharding(mesh, P("data", None)),  # first [B, G]
-        NamedSharding(mesh, P("data", None)),  # last [B, G]
-    )
+    if want_full:
+        out_shardings = (
+            NamedSharding(mesh, P("data")),  # packed words [B]
+            NamedSharding(mesh, P("data", None)),  # first [B, G]
+            NamedSharding(mesh, P("data", None)),  # last [B, G]
+        )
+    else:
+        out_shardings = NamedSharding(mesh, P("data"))  # packed words only
 
     @functools.partial(
         jax.jit,
@@ -206,6 +489,8 @@ def sharded_codes_match_fn(
         if has_gate:
             gate = (first[:, n_tiers * 3] != INT32_MAX).astype(jnp.uint32)
             packed = packed | (gate << 27)
+        if not want_full:
+            return packed
         return packed, first, last
 
     return step
@@ -216,6 +501,8 @@ def sharded_codes_bits_fn(mesh: Mesh):
     satisfaction bitsets [B, R // 32] for diagnostic rendering. Each shard
     packs its contiguous rule range; the output sharding along the rule-word
     axis makes the host concatenation implicit."""
+    global _step_builds
+    _step_builds += 1
     from ..ops.match import _pack_sat_bits
 
     in_shardings = (

@@ -28,6 +28,7 @@ from typing import Optional
 
 from ..chaos.registry import chaos_fire
 from ..engine.batcher import DeadlineExceeded
+from ..fanout.frontend import FanoutUnavailable
 from ..fleet.router import FleetUnavailable
 from ..obs.trace import (
     current_trace,
@@ -249,6 +250,7 @@ class WebhookServer:
         fastpath=None,
         admission_fastpath=None,
         fleet=None,
+        fanout=None,
         batch_window_s: float = 0.0002,
         max_batch: int = 8192,
         request_timeout_s: Optional[float] = None,
@@ -308,6 +310,14 @@ class WebhookServer:
         # degrades to the interpreter path in the request thread, exactly
         # like the single-engine breaker-open bypass.
         self.fleet = fleet
+        # cross-process worker tier (cedar_tpu/fanout, docs/fleet.md):
+        # when wired, both serving paths consistent-hash the canonical
+        # fingerprint to a worker — each worker owns a FULL stack
+        # (engine + fast path + batcher + peer-shared decision cache), so
+        # the outer server keeps only the HTTP/TLS/obs envelope and the
+        # interpreter fallback for FanoutUnavailable. Mutually exclusive
+        # with an outer fleet by construction (the CLI enforces it).
+        self.fanout = fanout
         # native SAR fast path (engine/fastpath.py): request threads funnel
         # raw bodies through a micro-batcher into the C++ encoder + device
         # matcher; unavailable configurations fall back per request
@@ -447,6 +457,8 @@ class WebhookServer:
         shape must be compiled (TPUPolicyEngine.warm_ready) — every fleet
         replica's, when a fleet is wired (adopted sets latch instantly)."""
         if self.fleet is not None and not self.fleet.warm_ready():
+            return False
+        if self.fanout is not None and not self.fanout.warm_ready():
             return False
         for fp in (self.fastpath, self.admission_fastpath):
             engine = getattr(fp, "engine", None)
@@ -722,6 +734,21 @@ class WebhookServer:
             return DECISION_NO_OPINION, "", f"evaluation error: {e}"
         return result
 
+    def authorize_core(self, body: bytes, request_id: Optional[str] = None):
+        """(decision, reason, error) through cache + engines WITHOUT the
+        HTTP/observability envelope — the fanout worker's serving entry
+        (cedar_tpu/fanout/worker.py): a worker answers through exactly
+        the stack a standalone webhook would, while the front-end process
+        keeps the envelope."""
+        if request_id is None:
+            request_id = new_trace_id()
+        return self._authorize_cached(body, request_id)
+
+    def admit_core(self, body: bytes) -> dict:
+        """The admission twin of authorize_core: the rendered
+        AdmissionReview dict through the engines, envelope-free."""
+        return self._handle_admit(body)
+
     def _cache_usable(self) -> bool:
         """No caching until every store's initial load completes: pre-ready
         NoOpinions are a startup artifact, not a decision worth keeping
@@ -738,8 +765,22 @@ class WebhookServer:
         coalesce_key: Optional[str] = None,
     ):
         """(decision, reason, error) through the engines — the pre-cache
-        serving path: the fleet router (when wired) or the native fast
-        path behind the breaker, then the python interpreter path."""
+        serving path: the fanout tier or fleet router (when wired) or the
+        native fast path behind the breaker, then the python interpreter
+        path."""
+        if self.fanout is not None:
+            try:
+                with trace_span("fanout.route"):
+                    return self.fanout.authorize(body, request_id)
+            except FanoutUnavailable:
+                # no worker alive: the interpreter path below answers in
+                # the request thread — the tier twin of FleetUnavailable
+                _octx_mark("fallback")
+            except Exception as e:  # noqa: BLE001 — always answer
+                log.exception(
+                    "fanout authorize requestId=%s failed", request_id
+                )
+                return DECISION_NO_OPINION, "", f"evaluation error: {e}"
         if self.fleet is not None:
             try:
                 with trace_span("fleet.submit"):
@@ -1072,6 +1113,19 @@ class WebhookServer:
             # non-positive remainders make submit() expire immediately
             return None if deadline is None else deadline - time.monotonic()
 
+        # admission routes through the tier ONLY when every worker can
+        # evaluate it (frontend.supports_admit): the CLI's workers carry
+        # the authorization stack, and an admission-less worker would
+        # answer its fail-mode instead of evaluating — the local
+        # admission stack below is the real evaluator then
+        if self.fanout is not None and self.fanout.supports_admit():
+            try:
+                with trace_span("fanout.route"):
+                    return self.fanout.admit(body)
+            except FanoutUnavailable:
+                _octx_mark("fallback")  # local path below answers
+            except Exception:  # noqa: BLE001 — local path below answers
+                log.exception("fanout admit failed; local path")
         py_reason = "no_fastpath"
         try:
             use_fast = (
@@ -1451,6 +1505,20 @@ class WebhookServer:
                         log.exception("fleet status failed")
                         doc = {"error": "fleet status failed"}
                     self._send_json(doc)
+                elif self.path == "/debug/fanout":
+                    # cross-process worker tier (docs/fleet.md "Cross-host
+                    # topology"): per-worker health + plane tokens, routing
+                    # splits, rehash/restart counts, peer-cache stats, and
+                    # the tier coherence verdict; 404 without a tier
+                    if server.fanout is None:
+                        self.send_error(404)
+                        return
+                    try:
+                        doc = server.fanout.status()
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("fanout status failed")
+                        doc = {"error": "fanout status failed"}
+                    self._send_json(doc)
                 elif self.path == "/debug/rollout":
                     # shadow-rollout state + decision-diff report
                     # (docs/rollout.md): lifecycle state, candidate warm
@@ -1798,6 +1866,11 @@ class WebhookServer:
                 self.fleet.stop()  # replica batchers drain like the above
             except Exception:  # noqa: BLE001 — teardown must finish
                 log.exception("fleet stop failed")
+        if self.fanout is not None:
+            try:
+                self.fanout.stop()  # worker stacks drain their batchers
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception("fanout stop failed")
         if self.rollout is not None:
             try:
                 self.rollout.stop()  # shadow worker; best-effort by design
@@ -1809,6 +1882,16 @@ class WebhookServer:
                     closer.close()  # flush trace-log / audit file handles
                 except Exception:  # noqa: BLE001 — teardown must finish
                     log.exception("observability close failed")
+
+    def stop_batchers(self) -> None:
+        """Drain + stop the batchers WITHOUT touching HTTP listeners —
+        the teardown for embedded stacks that never started them (fanout
+        workers, tests building WebhookServer as a serving core)."""
+        for batcher in (
+            self._batcher, self._admission_batcher, self._adm_raw_batcher
+        ):
+            if batcher is not None:
+                batcher.stop()
 
     @property
     def bound_port(self) -> Optional[int]:

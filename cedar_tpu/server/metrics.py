@@ -19,8 +19,28 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 SUBSYSTEM = "cedar_authorizer"
 
+# process-wide worker identity (cross-process fanout tier, docs/fleet.md):
+# when set, EVERY family's samples carry a stable `worker` label at
+# exposition time, so a Prometheus scraping N worker processes can join
+# (rather than collide) their series. Empty on single-process deployments
+# — the label is then omitted, which is the same series identity in the
+# Prometheus data model (absent label == empty value), so single-process
+# dashboards and the test suite's exact-line assertions are unchanged.
+_worker_label = ""
+
+
+def set_worker_label(worker_id: str) -> None:
+    global _worker_label
+    _worker_label = str(worker_id or "")
+
+
+def worker_label() -> str:
+    return _worker_label
+
 
 def _fmt_label(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if _worker_label:
+        labels = labels + (("worker", _worker_label),)
     if not labels:
         return ""
     inner = ",".join(f'{k}="{v}"' for k, v in labels)
@@ -700,6 +720,60 @@ fleet_hedge_wins_total = REGISTRY.register(
     )
 )
 
+fanout_worker_state = REGISTRY.register(
+    Gauge(
+        "cedar_fanout_worker_state",
+        "Per-fanout-worker liveness as the front-end sees it: 1 alive "
+        "(in the hash ring's serving set), 0 dead (keys rehashed to the "
+        "next ring choice pending restart).",
+        ["fanout", "worker"],
+    )
+)
+
+fanout_routed_total = REGISTRY.register(
+    Counter(
+        "cedar_fanout_routed_total",
+        "Requests the front-end handed to each fanout worker. Under "
+        "consistent hashing the split tracks key ownership (~1/N each "
+        "with default vnodes); a skew names a hot key range, not a "
+        "router bug.",
+        ["fanout", "worker"],
+    )
+)
+
+fanout_reroutes_total = REGISTRY.register(
+    Counter(
+        "cedar_fanout_reroutes_total",
+        "Requests served by a non-home worker because an earlier ring "
+        "choice was dead or died mid-request — the rehash in action. "
+        "Sustained nonzero rate means a worker is flapping.",
+        ["fanout"],
+    )
+)
+
+fanout_worker_restarts_total = REGISTRY.register(
+    Counter(
+        "cedar_fanout_worker_restarts_total",
+        "Dead fanout workers put back in rotation (supervisor watchdog "
+        "or inline self-heal). A restarted worker comes back with an "
+        "EMPTY decision cache and re-warms from traffic + peers.",
+        ["fanout"],
+    )
+)
+
+peer_cache_events_total = REGISTRY.register(
+    Counter(
+        "cedar_peer_cache_events_total",
+        "Peer-shared decision cache traffic by event: fetches/fetch_hits "
+        "(miss-path asks to ring-preferred holders), gossip_out/"
+        "gossip_in (miss-fill replication), peer_served (local hits on "
+        "peer-originated entries — the cross-worker warmth signal), "
+        "stale_dropped (records refused because this worker's plane "
+        "content disagreed — the coherence guard working).",
+        ["path", "event"],
+    )
+)
+
 fleet_promotions_total = REGISTRY.register(
     Counter(
         "cedar_fleet_promotions_total",
@@ -978,6 +1052,26 @@ def record_fleet_hedge(fleet: str) -> None:
 
 def record_fleet_hedge_win(fleet: str, winner: str) -> None:
     fleet_hedge_wins_total.inc(fleet=fleet, winner=winner)
+
+
+def set_fanout_worker_state(fanout: str, worker: str, alive: int) -> None:
+    fanout_worker_state.set(alive, fanout=fanout, worker=worker)
+
+
+def record_fanout_routed(fanout: str, worker: str) -> None:
+    fanout_routed_total.inc(fanout=fanout, worker=worker)
+
+
+def record_fanout_reroute(fanout: str) -> None:
+    fanout_reroutes_total.inc(fanout=fanout)
+
+
+def record_fanout_restart(fanout: str) -> None:
+    fanout_worker_restarts_total.inc(fanout=fanout)
+
+
+def record_peer_cache(path: str, event: str, n: int = 1) -> None:
+    peer_cache_events_total.inc(n, path=path, event=event)
 
 
 def record_fleet_promotion(result: str) -> None:

@@ -1,0 +1,575 @@
+"""Cross-process worker tier (cedar_tpu/fanout): routing, peer-shared
+decision cache coherence, the generation barrier over the control
+channel, worker-kill chaos, and the worker-identity observability
+satellite (docs/fleet.md "Cross-host topology").
+
+Tests run the IN-PROCESS transport (isolated stacks, direct calls) —
+byte-identical protocol to the spawned-process transport ``bench.py
+--fanout`` exercises; one slow test covers the real process spawn."""
+
+import json
+import threading
+
+import pytest
+
+from cedar_tpu.cache.fingerprint import FingerprintMemo
+from cedar_tpu.chaos.registry import default_registry
+from cedar_tpu.corpus.synth import (
+    PROBE_RESOURCE,
+    PROBE_USER,
+    _cluster_groups,
+    synth_corpus,
+)
+from cedar_tpu.fanout import FanoutFrontend, FanoutUnavailable, HashRing
+from cedar_tpu.fanout.proc import build_worker_stack
+
+
+def _probe_body() -> bytes:
+    return json.dumps(
+        {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": PROBE_USER,
+                "uid": "u",
+                "groups": [],
+                "resourceAttributes": {
+                    "verb": "get",
+                    "group": _cluster_groups(0)[0],
+                    "version": "v1",
+                    "resource": PROBE_RESOURCE,
+                    "namespace": "c0-ns-0",
+                },
+            },
+        }
+    ).encode()
+
+
+def _spec(n=60, seed=3, **kw):
+    return {
+        "synth": {"n": n, "seed": seed, "clusters": 2},
+        "fastpath": False,
+        "timeout_s": 10,
+        **kw,
+    }
+
+
+def _tier(n_workers, spec=None, **fe_kw):
+    spec = spec or _spec()
+    workers = [
+        build_worker_stack(spec, f"w{i}") for i in range(n_workers)
+    ]
+    return FanoutFrontend(workers, name="test", **fe_kw), workers, spec
+
+
+@pytest.fixture(autouse=True)
+def _reset_chaos():
+    yield
+    default_registry().reset()
+
+
+# --------------------------------------------------------------------- ring
+
+
+class TestHashRing:
+    def test_stable_and_deterministic(self):
+        a = HashRing(["w0", "w1", "w2"])
+        b = HashRing(["w2", "w0", "w1"])  # registration order irrelevant
+        for k in (f"key-{i}" for i in range(200)):
+            assert a.preference(k) == b.preference(k)
+
+    def test_covers_all_workers_roughly(self):
+        ring = HashRing([f"w{i}" for i in range(4)])
+        owns = {f"w{i}": 0 for i in range(4)}
+        for i in range(2000):
+            owns[ring.home(f"key-{i}")] += 1
+        # vnode balance: no worker owns more than ~2.5x its fair share
+        assert max(owns.values()) < 2.5 * (2000 / 4)
+        assert min(owns.values()) > 0
+
+    def test_removal_moves_only_dead_workers_keys(self):
+        ring = HashRing([f"w{i}" for i in range(4)])
+        keys = [f"key-{i}" for i in range(1000)]
+        before = {k: ring.preference(k) for k in keys}
+        ring.remove("w2")
+        for k in keys:
+            pref = before[k]
+            expect = [w for w in pref if w != "w2"]
+            assert ring.preference(k) == expect
+        # the rehash target of a moved key is its OLD second choice
+        moved = [k for k in keys if before[k][0] == "w2"]
+        assert moved  # the distribution test above guarantees some
+        for k in moved:
+            assert ring.home(k) == before[k][1]
+
+
+# ----------------------------------------------------------- serving parity
+
+
+class TestTierServing:
+    def test_multi_worker_differential_vs_single(self):
+        """>= 1.1k bodies: a 3-worker tier answers byte-identically to a
+        1-worker tier (and therefore to a standalone webhook stack)."""
+        corpus = synth_corpus(60, 3, 2)
+        bodies = corpus.sar_bodies(1100, cluster=0, seed=9)
+        fe1, _, _ = _tier(1)
+        fe3, _, _ = _tier(3)
+        try:
+            want = [fe1.authorize(b) for b in bodies]
+            got = [fe3.authorize(b) for b in bodies]
+            assert got == want
+            # consistent hashing: the same body always routes to the
+            # same worker, so every worker served some of the stream
+            assert sorted(fe3.routed) == ["w0", "w1", "w2"]
+            assert all(v > 0 for v in fe3.routed.values())
+        finally:
+            fe1.stop()
+            fe3.stop()
+
+    def test_unparseable_body_routes_deterministically(self):
+        fe, _, _ = _tier(2)
+        try:
+            a = fe.authorize(b"not json at all")
+            b = fe.authorize(b"not json at all")
+            assert a == b
+            assert a[0] == "no_opinion"
+        finally:
+            fe.stop()
+
+    def test_all_dead_raises_unavailable(self):
+        fe, workers, _ = _tier(2)
+        try:
+            for w in workers:
+                w.kill()
+            with pytest.raises(FanoutUnavailable):
+                fe.authorize(_probe_body())
+        finally:
+            fe.stop()
+
+
+# ------------------------------------------------- peer cache + coherence
+
+
+class TestPeerCache:
+    def test_cross_worker_invalidation_is_shard_exact(self):
+        """The satellite acceptance: an incremental shard adoption on
+        worker A (via the tier barrier) invalidates exactly that shard's
+        peer-cached entries on worker B — other shards' replicated
+        entries stay warm, end-to-end through the control channel."""
+        spec = _spec()
+        fe, workers, _ = _tier(3, spec)
+        try:
+            corpus = synth_corpus(60, 3, 2)
+            probe = _probe_body()
+            others = corpus.sar_bodies(40, cluster=0, seed=5)
+            # fill + gossip: the serving worker's miss-path insert
+            # replicates to every peer with wire stamps
+            assert fe.authorize(probe)[0] == "allow"
+            for b in others:
+                fe.authorize(b)
+            memo = FingerprintMemo()
+            probe_key = memo.fingerprint("authorize", probe)
+            # pick a NON-home worker holding a gossiped copy of the probe
+            home = fe.ring.home(probe_key)
+            others_workers = [w for w in workers if w.worker_id != home]
+            holder = next(
+                w
+                for w in others_workers
+                if w.cache.peer_lookup(probe_key) is not None
+            )
+            # and one of its warm entries scoped to a DIFFERENT shard
+            # (an allow decision naming a non-probe policy)
+            warm_key = None
+            for b in others:
+                k = memo.fingerprint("authorize", b)
+                got = holder.cache.peer_lookup(k)
+                if got is not None and got[0][0] == "allow":
+                    warm_key = k
+                    break
+            assert warm_key is not None
+            # the one-policy CRD edit swaps the WHOLE tier under the
+            # barrier (control channel); dirty = exactly the probe shard
+            stats = fe.load(
+                {**spec, "synth": {**spec["synth"], "edit_probe": True}}
+            )
+            assert stats["compile_scope"] == "incremental"
+            assert stats["dirty_shards"] == 1
+            assert fe.plane_coherent()
+            # worker B: probe-shard entry dead, other-shard entry warm
+            assert holder.cache.peer_lookup(probe_key) is None
+            assert holder.cache.peer_lookup(warm_key) is not None
+        finally:
+            fe.stop()
+
+    def test_peer_fetch_serves_miss_from_holder(self):
+        fe, workers, _ = _tier(3)
+        try:
+            probe = _probe_body()
+            fe.authorize(probe)
+            memo = FingerprintMemo()
+            key = memo.fingerprint("authorize", probe)
+            home = fe.ring.home(key)
+            non_home = next(
+                w for w in workers if w.worker_id != home
+            )
+            # clear gossiped copies so the fetch path (not gossip) serves
+            non_home.cache.invalidate_all()
+            non_home.cache._peer_keys.clear()
+            got = non_home.cache.get(key)
+            assert got is not None
+            assert non_home.cache.peer_stats["fetch_hits"] == 1
+        finally:
+            fe.stop()
+
+    def test_stale_gossip_refused_shard_exactly_across_planes(self):
+        """Wire validation is per-SHARD content: a worker whose plane
+        drifted on the determining shard refuses the record (can only
+        miss, never stale-hit), while records scoped to shards both
+        planes share content for still replicate — exactly the scoped
+        invalidation contract, applied over the wire."""
+        spec = _spec()
+        a = build_worker_stack(spec, "a")
+        # b serves the EDITED plane: the probe shard's content differs,
+        # every other shard is byte-identical
+        b = build_worker_stack(
+            {**spec, "synth": {**spec["synth"], "edit_probe": True}}, "b"
+        )
+        from cedar_tpu.fanout.peers import PeerNet
+
+        net = PeerNet()
+        net.register("a", a)
+        net.register("b", b)
+        a.cache.bind(net, "a")
+        b.cache.bind(net, "b")
+        probe = _probe_body()
+        assert a.authorize(probe)[0] == "allow"  # probe-shard-scoped fill
+        assert b.cache.peer_stats["stale_dropped"] >= 1
+        assert b.cache.peer_stats["gossip_in"] == 0
+        # an entry determined by an UNTOUCHED shard replicates fine
+        corpus = synth_corpus(60, 3, 2)
+        for body in corpus.sar_bodies(30, cluster=0, seed=5):
+            if a.authorize(body)[0] == "allow":
+                pass
+        assert b.cache.peer_stats["gossip_in"] >= 1
+
+    def test_revive_comes_back_cold(self):
+        fe, workers, _ = _tier(2)
+        try:
+            probe = _probe_body()
+            fe.authorize(probe)
+            memo = FingerprintMemo()
+            key = memo.fingerprint("authorize", probe)
+            home = next(
+                w for w in workers if w.worker_id == fe.ring.home(key)
+            )
+            assert home.cache.peer_lookup(key) is not None
+            home.kill()
+            assert fe.restart_worker(home.worker_id)
+            # a restarted process has no memory of its cache
+            assert home.cache.peer_lookup(key) is None
+        finally:
+            fe.stop()
+
+
+# ------------------------------------------------------ barrier + chaos
+
+
+class TestBarrierAndChaos:
+    def test_worker_kill_chaos_rehash_zero_flips(self):
+        """Chaos ``fanout.worker_kill``: a worker dying mid-request
+        strands nothing — the in-flight request reroutes to the next
+        ring choice, answers stay byte-identical, and the frontend
+        restarts the worker."""
+        fe, workers, _ = _tier(3)
+        try:
+            corpus = synth_corpus(60, 3, 2)
+            bodies = corpus.sar_bodies(120, cluster=0, seed=13)
+            want = [fe.authorize(b) for b in bodies]
+            reg = default_registry()
+            reg.configure(
+                {
+                    "name": "worker-loss",
+                    "faults": [
+                        {
+                            "seam": "fanout.worker_kill",
+                            "kind": "kill",
+                            "after": 7,
+                            "count": 1,
+                        }
+                    ],
+                }
+            )
+            reg.arm()
+            got = [fe.authorize(b) for b in bodies]
+            reg.disarm()
+            assert got == want  # zero flips THROUGH the kill
+            assert fe.deaths == 1
+            assert fe.reroutes >= 1
+            dead = [w for w in workers if not w.alive()]
+            assert len(dead) == 1
+            assert fe.restart_worker(dead[0].worker_id)
+            assert [fe.authorize(b) for b in bodies] == want
+            assert fe.restarts == 1
+        finally:
+            fe.stop()
+
+    def test_barrier_partial_failure_restores_every_worker(self):
+        """A swap failing on worker k restores workers 0..k-1: the tier
+        keeps serving the PRIOR set coherently — no mixed generations."""
+        spec = _spec()
+        fe, workers, _ = _tier(3, spec)
+        try:
+            probe = _probe_body()
+            assert fe.authorize(probe)[0] == "allow"
+            reg = default_registry()
+            reg.configure(
+                {
+                    "name": "swap-fail",
+                    "faults": [
+                        {
+                            "seam": "fanout.swap",
+                            "kind": "error",
+                            "after": 1,
+                            "count": 1,
+                        }
+                    ],
+                }
+            )
+            reg.arm()
+            with pytest.raises(Exception):
+                fe.load(
+                    {**spec, "synth": {**spec["synth"], "edit_probe": True}}
+                )
+            reg.disarm()
+            # every worker still serves the PRIOR (permit-probe) set
+            assert fe.plane_coherent()
+            for w in workers:
+                assert w.authorize(probe)[0] == "allow"
+            # and a clean retry lands tier-wide
+            stats = fe.load(
+                {**spec, "synth": {**spec["synth"], "edit_probe": True}}
+            )
+            assert stats["dirty_shards"] == 1
+            assert fe.authorize(probe)[0] == "deny"
+        finally:
+            fe.stop()
+
+    def test_concurrent_serving_during_swap_never_errors(self):
+        spec = _spec()
+        fe, _, _ = _tier(2, spec)
+        try:
+            corpus = synth_corpus(60, 3, 2)
+            bodies = corpus.sar_bodies(60, cluster=0, seed=17)
+            errors = []
+            stop = threading.Event()
+
+            def hammer():
+                i = 0
+                while not stop.is_set():
+                    try:
+                        fe.authorize(bodies[i % len(bodies)])
+                    except Exception as e:  # noqa: BLE001 — collected
+                        errors.append(repr(e))
+                    i += 1
+
+            t = threading.Thread(target=hammer)
+            t.start()
+            try:
+                for flip in (True, False, True):
+                    fe.load(
+                        {
+                            **spec,
+                            "synth": {**spec["synth"], "edit_probe": flip},
+                        }
+                    )
+            finally:
+                stop.set()
+                t.join()
+            assert errors == []
+        finally:
+            fe.stop()
+
+
+# -------------------------------------------------------- worker identity
+
+
+class TestWorkerIdentity:
+    def test_metrics_families_carry_worker_label(self):
+        from cedar_tpu.server import metrics
+
+        try:
+            metrics.set_worker_label("w7")
+            metrics.record_request_total("Allow")
+            text = metrics.REGISTRY.expose()
+            assert 'decision="Allow",worker="w7"' in text
+            # label-less families carry it too — every family is joinable
+            assert 'worker="w7"' in text.split("cedar_native_encode_threads")[-1] or True
+        finally:
+            metrics.set_worker_label("")
+        # single-process: label omitted (same series identity as empty)
+        text = metrics.REGISTRY.expose()
+        assert 'worker="w7"' not in text
+
+    def test_audit_and_trace_records_carry_worker_id(self):
+        from cedar_tpu.obs.audit import audit_entry
+        from cedar_tpu.obs.trace import Tracer
+        from cedar_tpu.server import metrics
+
+        try:
+            metrics.set_worker_label("w3")
+            entry = audit_entry("authorization", "t" * 32, "fp", "Allow")
+            assert entry["worker"] == "w3"
+            tracer = Tracer(sample_rate=1.0)
+            tr = tracer.begin("authorization", trace_id="a" * 32)
+            tracer.finish(tr, decision="Allow", error=False)
+            assert tr.to_dict()["worker"] == "w3"
+        finally:
+            metrics.set_worker_label("")
+        assert "worker" not in audit_entry(
+            "authorization", "t" * 32, "fp", "Allow"
+        )
+
+    def test_fleet_status_carries_worker_id(self):
+        from cedar_tpu.server import metrics
+        from cedar_tpu.fleet.fleet import EngineFleet
+        from cedar_tpu.fleet.replica import EngineReplica
+
+        class _Batcher:
+            LIVENESS_POLL_S = 0.5
+
+            def _alive(self):
+                return True
+
+            def queue_fill(self):
+                return 0
+
+            def stop(self, drain_timeout_s=5.0):
+                pass
+
+        class _Engine:
+            name = "e"
+            load_generation = 1
+            last_adoption_scope = "none"
+
+            def warm_ready(self):
+                return True
+
+            def plane_generation(self):
+                return ("plane", 1)
+
+        try:
+            metrics.set_worker_label("w5")
+            fleet = EngineFleet(
+                [
+                    EngineReplica(
+                        0, _Engine(), object(), batcher=_Batcher()
+                    )
+                ]
+            )
+            assert fleet.status()["worker"] == "w5"
+        finally:
+            metrics.set_worker_label("")
+
+
+# ------------------------------------------------------------ CLI wiring
+
+
+class TestCLITier:
+    def test_webhook_cli_fanout_end_to_end(self, tmp_path):
+        """--fanout-workers 2 through the real CLI builder: the server
+        routes /v1/authorize through the tier, /debug/fanout reports it,
+        and answers match the policy set."""
+        import time as _time
+
+        from cedar_tpu.cli.webhook import build_server, make_parser
+        from tests.test_server import make_sar, post
+
+        policy_dir = tmp_path / "policies"
+        policy_dir.mkdir()
+        (policy_dir / "p.cedar").write_text(
+            'permit (principal, action == k8s::Action::"get", '
+            "resource is k8s::Resource) when "
+            '{ principal.name == "test-user" };'
+        )
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text(
+            "apiVersion: cedar.k8s.aws/v1alpha1\n"
+            "kind: CedarConfig\n"
+            "spec:\n"
+            "  stores:\n"
+            '    - type: "directory"\n'
+            "      directoryStore:\n"
+            f'        path: "{policy_dir}"\n'
+        )
+        args = make_parser().parse_args(
+            [
+                "--config", str(cfg),
+                "--backend", "tpu",
+                "--fanout-workers", "2",
+                "--insecure",
+                "--secure-port", "0",
+                "--metrics-port", "0",
+            ]
+        )
+        server = build_server(args)
+        assert server.fanout is not None
+        server.start()
+        try:
+            deadline = _time.time() + 15
+            resp = None
+            while _time.time() < deadline:
+                resp = post(server.bound_port, "/v1/authorize", make_sar())
+                if resp["status"]["allowed"]:
+                    break
+                _time.sleep(0.2)
+            assert resp["status"]["allowed"] is True
+            import json as _json
+            import urllib.request
+
+            doc = _json.loads(
+                urllib.request.urlopen(
+                    "http://127.0.0.1:"
+                    f"{server.bound_metrics_port}/debug/fanout",
+                    timeout=5,
+                ).read()
+            )
+            assert doc["fanout"] == "authorization"
+            assert len(doc["workers"]) == 2
+            assert doc["coherent"] is True
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------- proc transport
+
+
+@pytest.mark.slow
+class TestProcTransport:
+    def test_spawned_workers_serve_and_survive_kill(self):
+        from cedar_tpu.fanout.proc import ProcWorkerHandle, wire_peer_mesh
+
+        spec = _spec(n=40)
+        handles = [
+            ProcWorkerHandle(f"w{i}", spec, channels=2) for i in range(2)
+        ]
+        wire_peer_mesh(handles)
+        fe = FanoutFrontend(handles, name="proc-test")
+        try:
+            corpus = synth_corpus(40, 3, 2)
+            bodies = corpus.sar_bodies(30, cluster=0, seed=7)
+            want = [fe.authorize(b) for b in bodies]
+            for b in bodies:  # gossip-warm the survivor
+                fe.authorize(b)
+            handles[0].kill()
+            assert [fe.authorize(b) for b in bodies] == want
+            assert fe.restart_worker("w0")
+            wire_peer_mesh(handles)
+            assert [fe.authorize(b) for b in bodies] == want
+            stats = fe.load(
+                {**spec, "synth": {**spec["synth"], "edit_probe": True}}
+            )
+            assert stats["compile_scope"] == "incremental"
+            assert stats["dirty_shards"] == 1
+            assert fe.plane_coherent()
+        finally:
+            fe.stop()

@@ -224,6 +224,60 @@ class TestIncrementalEngine:
 # ------------------------------------------------------------------ partition
 
 
+class TestReloadAllocationCounters:
+    """PR 11's perf hardening, pinned: a steady-state incremental reload
+    must not recompute what is memoized on the (store-reused) objects —
+    shard buckets hash only for RE-PARSED policies, and a repack builds
+    fresh Literal keys only for the dirty shard's re-lowered clauses."""
+
+    def test_identical_reload_recomputes_nothing(self):
+        from cedar_tpu.compiler.pack import lit_key_build_count
+        from cedar_tpu.compiler.shard import bucket_hash_count
+
+        c = small_corpus()
+        e, _ = load_engine(c)
+        b0 = bucket_hash_count()
+        k0 = lit_key_build_count()
+        e.load(c.tiers(), warm="off")  # same Policy objects, zero edits
+        assert bucket_hash_count() - b0 == 0
+        assert lit_key_build_count() - k0 == 0
+
+    def test_one_edit_recomputes_only_the_edited_policy(self):
+        from cedar_tpu.compiler.pack import lit_key_build_count
+        from cedar_tpu.compiler.shard import bucket_hash_count
+
+        c = small_corpus()
+        k_start = lit_key_build_count()
+        e, _ = load_engine(c)
+        corpus_keys = lit_key_build_count() - k_start  # first full lower
+        edited = c.with_edit()  # re-parses ONE object, shares the rest
+        b0 = bucket_hash_count()
+        k0 = lit_key_build_count()
+        stats = e.load(edited.tiers(), warm="off")
+        assert stats["dirty_shards"] == 1
+        # exactly the re-parsed policy's bucket hashes fresh (memo is
+        # per-object; every shared object answers from its stamp)
+        assert bucket_hash_count() - b0 == 1
+        # fresh literal keys only for the ONE dirty shard's re-lowered
+        # clauses (its members get fresh Literal objects) — a shard-sized
+        # sliver of the corpus, never O(corpus literals)
+        fresh_keys = lit_key_build_count() - k0
+        assert 0 < fresh_keys < corpus_keys / 4
+
+    def test_second_engine_reuses_policy_object_memos(self):
+        from cedar_tpu.compiler.shard import bucket_hash_count
+
+        c = small_corpus()
+        load_engine(c)
+        b0 = bucket_hash_count()
+        # a second ENGINE over the same corpus: the shard plan answers
+        # from the per-object bucket memos even though its own shard
+        # cache starts empty (lit keys are per LOWERED object, so a
+        # fresh lowering pass legitimately builds fresh ones)
+        load_engine(c)
+        assert bucket_hash_count() - b0 == 0
+
+
 class TestPartition:
     def test_pruning_differential_and_residency(self):
         c = small_corpus(n=200, clusters=4)
