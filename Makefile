@@ -70,6 +70,10 @@ bench-encode: ## Host-side budget: native encode µs/req at 1/2/4 threads, packe
 bench-scale: ## Giant policy sets: 10k vs 100k serving-rate ratio, single-edit incremental recompile <1s + zero-fresh-trace gate (cpu; docs/performance.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --scale
 
+.PHONY: bench-tenant
+bench-tenant: ## Multi-tenant shared plane: 1 vs 10 fused tenants on one device — zero cross-tenant decision flips, per-tenant p99 budget, tenant-scoped dirty shards (cpu; docs/multitenancy.md)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --tenants
+
 .PHONY: bench-fleet
 bench-fleet: ## Engine-fleet scaling: decisions/sec + lone p99 at 1/2/4 replicas, scaling-efficiency JSON (cpu; docs/fleet.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --fleet
@@ -104,7 +108,7 @@ graft-check: ## Compile-check the jittable entry + multi-chip dry run
 
 # scoped to the layers with the strongest invariants first; widen as
 # modules are annotated
-LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos cedar_tpu/fleet cedar_tpu/engine cedar_tpu/ops cedar_tpu/native cedar_tpu/explain cedar_tpu/obs cedar_tpu/cache cedar_tpu/corpus cedar_tpu/fanout cedar_tpu/parallel
+LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos cedar_tpu/fleet cedar_tpu/engine cedar_tpu/ops cedar_tpu/native cedar_tpu/explain cedar_tpu/obs cedar_tpu/cache cedar_tpu/corpus cedar_tpu/fanout cedar_tpu/parallel cedar_tpu/tenancy
 
 .PHONY: lint
 lint: ## ruff + mypy over $(LINT_SCOPE) (missing tools are skipped with a note)

@@ -82,6 +82,14 @@ def fingerprint_attributes(attributes) -> str:
             (r.field, r.operator, r.value) for r in attributes.field_selector
         ),
     }
+    if getattr(attributes, "tenant", ""):
+        # multi-tenant serving (cedar_tpu/tenancy): two tenants'
+        # byte-identical SARs evaluate against different policy slices, so
+        # the tenant MUST split the key — cache entries, recordings and
+        # audit lines become tenant-scoped. Folded only when present:
+        # single-tenant fingerprints stay byte-identical to every
+        # previously recorded key.
+        doc["tenant"] = attributes.tenant
     return _hash_canonical(doc)
 
 
@@ -109,6 +117,9 @@ def fingerprint_admission_request(req) -> str:
         "object": req.object,
         "oldObject": req.old_object,
     }
+    if getattr(req, "tenant", ""):
+        # tenant-scoped, like fingerprint_attributes above
+        doc["tenant"] = req.tenant
     return _hash_canonical(doc)
 
 
@@ -117,6 +128,10 @@ def fingerprint_body(endpoint: str, body: bytes) -> Optional[str]:
     or ``admit`` (the /v1/ path tail, also the recorder's filename tag).
     Returns None for bodies that do not parse — the serving paths produce
     their decode-error answer uncached."""
+    # a TenantBody (cedar_tpu/tenancy) carries the tenant the front end
+    # resolved — never part of the wire bytes — and the canonical
+    # fingerprint must scope to it
+    tenant = getattr(body, "tenant", "")
     try:
         doc = json.loads(body)
         if not isinstance(doc, dict):
@@ -126,13 +141,17 @@ def fingerprint_body(endpoint: str, body: bytes) -> Optional[str]:
             # must not import it at module load
             from ..server.http import get_authorizer_attributes
 
-            return fingerprint_attributes(get_authorizer_attributes(doc))
+            attrs = get_authorizer_attributes(doc)
+            if tenant:
+                attrs.tenant = tenant
+            return fingerprint_attributes(attrs)
         if endpoint == "admit":
             from ..entities.admission import AdmissionRequest
 
-            return fingerprint_admission_request(
-                AdmissionRequest.from_admission_review(doc)
-            )
+            req = AdmissionRequest.from_admission_review(doc)
+            if tenant:
+                req.tenant = tenant
+            return fingerprint_admission_request(req)
     except Exception:  # noqa: BLE001 — unkeyable bodies are served uncached
         return None
     return None
@@ -157,7 +176,13 @@ class FingerprintMemo:
         self._memo: "OrderedDict[bytes, Optional[str]]" = OrderedDict()
 
     def fingerprint(self, endpoint: str, body: bytes) -> Optional[str]:
-        digest = hashlib.sha256(body).digest()
+        # tenant-scoped memo rows: two tenants' byte-identical bodies map
+        # to DIFFERENT canonical fingerprints, so the raw-digest key must
+        # split on the tenant too or the second tenant would hit the
+        # first's memo row
+        tenant = getattr(body, "tenant", "")
+        raw = body if not tenant else tenant.encode() + b"\x00" + body
+        digest = hashlib.sha256(raw).digest()
         with self._lock:
             if digest in self._memo:
                 self._memo.move_to_end(digest)

@@ -80,12 +80,15 @@ class PlaneGenerations:
         r = self.__eq__(other)
         return NotImplemented if r is NotImplemented else not r
 
-    def scoped(self, reason: str):
+    def scoped(self, reason: str, tenant: str = ""):
         """The stamp for a decision with the given already-rendered
         reason: scoped to the determining policies' shards when every one
         of them resolves, else this full composite (conservative). Called
         once per cache INSERT — the parse cost rides the miss path, never
-        a hit."""
+        a hit. On a fused multi-tenant plane pass the request's resolved
+        ``tenant``: the lookup keys tenant policies as ``<tenant>/<pid>``
+        (compiler/shard.py) because bare policy ids collide across
+        tenants' directory stores."""
         if not self.lookup or not reason:
             return self
         from ..obs.audit import determining_policies
@@ -95,7 +98,11 @@ class PlaneGenerations:
             return self
         shards = set()
         for pid in pols:
-            sid = self.lookup.get(pid)
+            sid = None
+            if tenant:
+                sid = self.lookup.get(f"{tenant}/{pid}")
+            if sid is None:
+                sid = self.lookup.get(pid)
             if sid is None:
                 return self  # unknown/ambiguous policy: full stamp
             shards.add(sid)

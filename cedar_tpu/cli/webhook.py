@@ -222,6 +222,82 @@ def build_server(args) -> WebhookServer:
         # CLI flag overrides the config file's spec.validationMode
         config.validation_mode = args.validation_mode
     stores = cedar_config_stores(config, kubeconfig_path=args.kubeconfig or None)
+
+    # multi-tenant shared plane (cedar_tpu/tenancy, docs/multitenancy.md):
+    # --tenant NAME=POLICY_DIR (repeatable) fuses every tenant's directory
+    # store into ONE engine + batcher + cache stack — the serving tiers
+    # become the registry's fused (guard-wrapped, tenant-stamped) clones,
+    # so EVERY layer below this point is wired exactly like a
+    # single-tenant server and tenant isolation rides the policy plane
+    # itself. The resolver stamps each request's tenant at the front door.
+    tenancy_resolver = None
+    tenant_registry = None
+    if getattr(args, "tenant", None):
+        from ..stores.directory import DirectoryPolicyStore
+        from ..tenancy import TenantRegistry, TenantResolver, fused_tier_stores
+
+        tenant_registry = TenantRegistry()
+        # the analysis gate runs PER TENANT on the pre-fusion originals
+        # (registry.fused_tiers consumes analyzed_policy_sets when the
+        # store offers it) — the fused stack itself stays ungated because
+        # the tenant guards' context access would distort the verdicts
+        tenant_validation = (
+            config.validation_mode
+            if config is not None
+            else getattr(args, "validation_mode", "") or None
+        )
+        for spec in args.tenant:
+            name, sep, tdir = spec.partition("=")
+            if not sep or not name or not tdir:
+                raise ValueError(
+                    f"--tenant wants NAME=POLICY_DIR, got {spec!r}"
+                )
+            tenant_registry.add_tenant(
+                name,
+                stores=TieredPolicyStores(
+                    [
+                        # refresh at the engine-reload cadence: a tenant's
+                        # directory edit must reach the fused plane within
+                        # one reloader tick, not the store default's 60s
+                        DirectoryPolicyStore(
+                            tdir,
+                            refresh_interval_s=max(
+                                1.0, float(args.tpu_reload_seconds)
+                            ),
+                        )
+                    ],
+                    validation_mode=tenant_validation,
+                ),
+            )
+        hosts = {}
+        for spec in getattr(args, "tenant_host", None) or []:
+            host, sep, name = spec.partition("=")
+            if not sep or not host or not name:
+                raise ValueError(
+                    f"--tenant-host wants HOST=TENANT, got {spec!r}"
+                )
+            hosts[host] = name
+        if len(stores.stores):
+            log.warning(
+                "--tenant set: the config's policy stores are replaced "
+                "by the fused tenant stack"
+            )
+        stores = fused_tier_stores(tenant_registry)
+        sources = tuple(
+            s.strip() for s in args.tenant_sources.split(",") if s.strip()
+        )
+        tenancy_resolver = TenantResolver(
+            tenant_registry,
+            header=args.tenant_header,
+            hosts=hosts,
+            default=args.tenant_default or None,
+            sources=sources,
+        )
+        log.info(
+            "multi-tenant plane: %d tenant(s) fused (%s)",
+            len(tenant_registry),
+            ", ".join(tenant_registry.tenants()),
+        )
     if not len(stores.stores):
         log.warning("no policy stores configured; authorizer will no-opinion")
 
@@ -677,6 +753,24 @@ def build_server(args) -> WebhookServer:
     rollout = None
     rollout_control_enabled = True
     rollout_control_token = None
+    if tenant_registry is not None and (
+        args.rollout_candidate_dir
+        or args.rollout_control_token_file
+        or args.rollout_insecure_control
+    ):
+        # the candidate corpus and the shadow diff are single-tenant: a
+        # candidate engine carries no tenant guards, so shadowing fused
+        # traffic against it would answer every request NoOpinion and
+        # report vacuous mass diffs. Per-tenant rollout on a fused plane
+        # is the registry-driven lifecycle (docs/multitenancy.md), not
+        # the candidate-dir one — refuse rather than mislead.
+        raise ValueError(
+            "--tenant cannot combine with shadow-rollout flags "
+            "(--rollout-candidate-dir/--rollout-control-token-file/"
+            "--rollout-insecure-control): the candidate corpus carries "
+            "no tenant guards, so every shadow diff on a fused plane "
+            "would be vacuous (docs/multitenancy.md)"
+        )
     if args.rollout_control_token_file:
         with open(args.rollout_control_token_file) as f:
             rollout_control_token = f.read().strip()
@@ -825,6 +919,18 @@ def build_server(args) -> WebhookServer:
         )
     )
     recorder = RequestRecorder(args.recording_dir) if args.enable_recording else None
+    if recorder is not None and tenant_registry is not None:
+        # a recorded body is the raw wire bytes — the tenant the front
+        # end resolved rides the TenantBody wrapper and is LOST on disk,
+        # so replaying fused-plane recordings (cedar-why, cli.replay,
+        # shadow diffing) would evaluate without context.tenantId and
+        # answer NoOpinion everywhere. Refuse rather than record traffic
+        # that silently cannot replay (docs/multitenancy.md).
+        raise ValueError(
+            "--enable-recording cannot combine with --tenant: recorded "
+            "bodies lose the resolved tenant and cannot replay against "
+            "a fused plane (docs/multitenancy.md)"
+        )
 
     certfile, keyfile = args.tls_cert_file, args.tls_private_key_file
     if not args.insecure and not (certfile and keyfile):
@@ -920,6 +1026,7 @@ def build_server(args) -> WebhookServer:
         tracer=tracer,
         audit_log=audit_log,
         slo=slo,
+        tenancy=tenancy_resolver,
     )
     if supervisor is not None:
         _register_supervised(supervisor, server, rollout, stores)
@@ -1444,6 +1551,49 @@ def make_parser() -> argparse.ArgumentParser:
         "(docs/resilience.md, cedar-chaos)",
     )
 
+    tenancy = parser.add_argument_group("multi-tenancy")
+    tenancy.add_argument(
+        "--tenant",
+        action="append",
+        default=[],
+        metavar="NAME=POLICY_DIR",
+        help="register a tenant served from the fused shared plane "
+        "(repeatable): NAME becomes the tenant id (DNS-label-ish), "
+        "POLICY_DIR its *.cedar policy directory. All tenants compile "
+        "into ONE engine with per-rule tenant discriminators; requests "
+        "route by /t/<name>/v1/... path, the tenant header, or a host "
+        "map (docs/multitenancy.md)",
+    )
+    tenancy.add_argument(
+        "--tenant-header",
+        default="x-cedar-tenant",
+        help="HTTP header carrying the tenant id (default %(default)s)",
+    )
+    tenancy.add_argument(
+        "--tenant-host",
+        action="append",
+        default=[],
+        metavar="HOST=TENANT",
+        help="map a Host/SNI hostname to a tenant (repeatable) — the "
+        "shape a TLS-terminating LB hands multi-SNI traffic over in",
+    )
+    tenancy.add_argument(
+        "--tenant-default",
+        default="",
+        help="tenant to assume when no path/header/host resolves one "
+        "(default: refuse such requests)",
+    )
+    tenancy.add_argument(
+        "--tenant-sources",
+        default="path,header,host",
+        metavar="SRC[,SRC...]",
+        help="which resolution sources to trust, comma-separated subset "
+        "of path,header,host (default %(default)s). Path and header are "
+        "CLIENT-supplied: restrict to 'host' when tenants are "
+        "authenticated by per-tenant SNI/LB routes, or a tenant could "
+        "name a neighbor and evaluate under its policy slice. Enabled "
+        "sources that disagree on a request are rejected (conflict)",
+    )
     debug = parser.add_argument_group("debug")
     debug.add_argument("--profiling", action="store_true")
     debug.add_argument("--enable-recording", action="store_true")

@@ -17,13 +17,14 @@ the hot-swap analogue of the reference's RWMutex PolicySet update
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..lang.ast import Pattern, Policy
 from .ir import (
     CMP,
+    ClauseLit,
     CompiledPolicies,
     ENTITY_IN,
     ENTITY_IN_ANY,
@@ -37,6 +38,7 @@ from .ir import (
     IS,
     LIKE,
     Literal,
+    LoweredPolicy,
     SET_HAS,
     Slot,
 )
@@ -60,6 +62,94 @@ GROUPS_PER_TIER = 3
 # exists). The Python engine path fills hard literals at encode time, so
 # for it only class (a) needs the host-side tier walk.
 GATE_RULE_POLICY = 0  # rule_policy for gate rules: any value != INT32_MAX
+
+# ---------------------------------------------------------------- tenancy
+# The fused multi-tenant plane (cedar_tpu/tenancy) shares ONE packed rule
+# space between many tenants' policy sets. Isolation rides a reserved
+# context slot: every rule of tenant T gets a synthetic FIRST-conjunct EQ
+# literal over ("context", ("tenantId",)) — the same mechanism the
+# partition-spec corpora use for their cluster discriminators — so the
+# slot-match kernel (lax plane, segred plane and the pallas words path
+# alike) masks foreign tenants' rules with zero new kernel code: a request
+# whose context carries tenant A's id satisfies no rule carrying tenant
+# B's literal, INCLUDING B's error clauses (the discriminator precedes
+# the error indicators, exactly like Cedar's && short-circuit kills a
+# foreign policy's errors). The literal is total and access-free (the
+# encoder reads a slot the front end stamps), so discrimination adds no
+# error machinery of its own.
+TENANT_CONTEXT_KEY = "tenantId"
+TENANT_SLOT: Slot = ("context", (TENANT_CONTEXT_KEY,))
+
+_tenant_literals: Dict[str, Literal] = {}
+
+
+def tenant_literal(tenant: str) -> Literal:
+    """The (memoized, per-process-singleton) tenant discriminator literal:
+    one object per tenant id, so repacks re-intern the SAME literal and
+    the reload-allocation counters stay honest."""
+    lit = _tenant_literals.get(tenant)
+    if lit is None:
+        lit = _tenant_literals[tenant] = Literal(
+            EQ,
+            var="context",
+            slot=TENANT_SLOT,
+            data=("s", tenant),
+            accesses=(),
+            total=True,
+        )
+    return lit
+
+
+def discriminate_lowered(lp: LoweredPolicy, tenant: str) -> LoweredPolicy:
+    """A lowered policy with the tenant discriminator prepended to every
+    clause AND error clause — the IR-level twin of prepending
+    ``context.tenantId == "<tenant>" &&`` to the source condition, minus
+    the error clauses a fallible context access would have added."""
+    cl = ClauseLit(tenant_literal(tenant), False)
+    return LoweredPolicy(
+        policy=lp.policy,
+        tier=lp.tier,
+        effect=lp.effect,
+        clauses=[(cl,) + tuple(c) for c in lp.clauses],
+        error_clauses=[(cl,) + tuple(c) for c in lp.error_clauses],
+    )
+
+
+def policy_tenant(policy) -> Optional[str]:
+    """The tenant a policy was fused under (cedar_tpu/tenancy stamps the
+    registry's per-tenant clones), or None outside a fused plane."""
+    return policy.__dict__.get("_cedar_tenant")
+
+
+_tenant_guards: Dict[str, object] = {}
+
+
+def tenant_guard_condition(tenant: str):
+    """Memoized per-tenant AST guard ``when { context.tenantId == t }``.
+
+    The tenant registry prepends it to every fused clone's conditions so
+    the INTERPRETER paths — the tiered-store walk a breaker-open request
+    takes, fallback ``policy_matches``, explain attribution — isolate
+    tenants exactly like the packed discriminator does, with Cedar's own
+    &&-first short-circuit killing foreign policies' condition errors.
+    Per-process singleton: the shard compiler recognizes the guard BY
+    IDENTITY (compiler/shard.py) and lowers the deguarded policy plus
+    ``discriminate_lowered`` instead — the guard's context access would
+    otherwise lower with the error machinery the synthetic total literal
+    exists to avoid."""
+    c = _tenant_guards.get(tenant)
+    if c is None:
+        from ..lang.ast import Binary, Condition, GetAttr, Lit, Var
+
+        c = _tenant_guards[tenant] = Condition(
+            "when",
+            Binary(
+                "==",
+                GetAttr(Var("context"), TENANT_CONTEXT_KEY),
+                Lit(tenant),
+            ),
+        )
+    return c
 
 
 def _bucket(n: int, minimum: int = 128) -> int:
@@ -165,6 +255,11 @@ class PackedPolicySet:
     # evaluate (outside the dyn class); they gate like fallback policies on
     # the native path but evaluate exactly on the Python path
     native_opaque: int = 0
+    # distinct Unlowerable reason codes across the fallback policies —
+    # precomputed so the serving path's fallback burn-down counter
+    # (cedar_fallback_decisions_total{code}) costs a tuple walk per
+    # interpreter-merged decision, never a per-request set build
+    fallback_codes: Tuple[str, ...] = ()
 
     @property
     def n_groups(self) -> int:
@@ -272,6 +367,13 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
         ):
             gate_lits, _ = scope_literals(gp)
             lits = [(reg.intern(cl.lit), cl.negated) for cl in gate_lits]
+            # fused multi-tenant plane: a tenant policy's gate tests the
+            # tenant discriminator too, so a foreign tenant's request
+            # never gate-flags (and never pays the exact Python walk) for
+            # a scope it can't match by construction
+            ten = policy_tenant(gp)
+            if ten is not None:
+                lits.insert(0, (reg.intern(tenant_literal(ten)), False))
             rules.append(
                 (lits, gate_group, GATE_RULE_POLICY,
                  RuleClause(-1, gate_group, "gate", gi, None))
@@ -355,6 +457,14 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
         rule_clause=[rc for _lits, _g, _pm, rc in rules],
         has_gate=has_gate,
         native_opaque=len(opaque),
+        fallback_codes=tuple(
+            sorted(
+                {
+                    getattr(fp, "code", "unlowerable") or "unlowerable"
+                    for fp in compiled.fallback
+                }
+            )
+        ),
     )
 
 

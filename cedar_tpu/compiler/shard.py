@@ -60,6 +60,7 @@ __all__ = [
     "bucket_hash_count",
     "policy_fingerprint",
     "shard_bucket",
+    "shard_tenant",
 ]
 
 # fresh blake2b bucket computations (cache misses of the per-object memo
@@ -122,10 +123,45 @@ def shard_bucket(policy: Policy, n_buckets: int) -> int:
     return b
 
 
-def _shard_id(tier: int, bucket: int) -> str:
+def _shard_id(tier: int, bucket: int, realm: Optional[str] = None) -> str:
     # zero-padded bucket keeps lexicographic order == numeric order, so
-    # sorted-shard assembly is deterministic and tier-grouped
-    return f"t{tier}b{bucket:04d}"
+    # sorted-shard assembly is deterministic and tier-grouped. A fused
+    # multi-tenant plane (cedar_tpu/tenancy) prefixes the owning tenant:
+    # shards become (tenant, tier, bucket), so one tenant's CRD edit
+    # dirties only ITS shards and the scoped cache invalidation / dirty
+    # metrics stay tenant-local (tenant ids are registry-validated to
+    # exclude "/").
+    base = f"t{tier}b{bucket:04d}"
+    return f"{realm}/{base}" if realm else base
+
+
+def shard_tenant(shard_id: str) -> Optional[str]:
+    """The owning tenant of a (tenant, tier, bucket) shard id, or None
+    for a single-tenant shard — the parse every per-tenant rollup
+    (debug docs, bench gates, dirty-scope tests) shares."""
+    if "/" in shard_id:
+        return shard_id.rsplit("/", 1)[0]
+    return None
+
+
+def _deguarded(p: Policy, realm: str) -> Policy:
+    """The policy minus its leading tenant guard condition (identified BY
+    IDENTITY against the per-tenant singleton, compiler/pack.py). A clone
+    whose guard is not the singleton (foreign construction) lowers as-is
+    — correct, just with the guard's own error clauses."""
+    from .pack import tenant_guard_condition
+
+    if p.conditions and p.conditions[0] is tenant_guard_condition(realm):
+        import copy
+
+        q = copy.copy(p)
+        q.conditions = tuple(p.conditions[1:])
+        # the copied content-fingerprint memo describes the GUARDED
+        # source; this twin's content differs, and a stale stamp must
+        # never be read off it
+        q.__dict__.pop("_cedar_content_fp", None)
+        return q
+    return p
 
 
 @dataclass
@@ -188,7 +224,11 @@ class ShardCompiler:
         pos = 0
         n_buckets = self.buckets
         for tier, ps in enumerate(tiers):
-            buckets: List[list] = [[] for _ in range(n_buckets)]
+            # buckets key on (realm, bucket): single-tenant corpora carry
+            # realm None and collapse to the classic per-tier bucket list;
+            # fused multi-tenant tiers (cedar_tpu/tenancy stamps) split
+            # per tenant so no shard ever spans two tenants
+            buckets: Dict[Tuple[Optional[str], int], list] = {}
             for p in ps.policies():
                 d = p.__dict__
                 d["_cedar_ord"] = (epoch, pos)
@@ -198,14 +238,17 @@ class ShardCompiler:
                     b = cached[1]
                 else:
                     b = shard_bucket(p, n_buckets)
-                buckets[b].append(p)
-            for b, pols in enumerate(buckets):
-                if not pols:
-                    continue
+                # inline pack.policy_tenant(): d is already in hand in
+                # this O(corpus) plan pass
+                buckets.setdefault((d.get("_cedar_tenant"), b), []).append(p)
+            for (realm, b) in sorted(
+                buckets, key=lambda k: (k[0] or "", k[1])
+            ):
+                pols = buckets[(realm, b)]
                 digest = hashlib.sha256(
                     "".join([policy_fingerprint(p) for p in pols]).encode()
                 ).hexdigest()
-                plan[_shard_id(tier, b)] = (tier, digest, pols)
+                plan[_shard_id(tier, b, realm)] = (tier, digest, pols)
 
         # a tier-count change re-keys every shard id's meaning: full compile
         topology_changed = self._n_tiers is not None and self._n_tiers != len(
@@ -259,18 +302,30 @@ class ShardCompiler:
             stamp = p.__dict__.get("_cedar_ord")
             return stamp[1] if stamp is not None and stamp[0] == epoch else far
 
+        def _stamp_key(p) -> str:
+            # fused multi-tenant planes qualify the cache-stamp key by the
+            # owning tenant: per-tenant directory stores commonly carry
+            # the SAME bare-filename policy ids (alpha's and beta's
+            # p.cedar.policy0), and an unqualified key would read as a
+            # cross-shard ambiguity — downgrading those decisions' cache
+            # stamps from shard-scoped to kill-on-any-reload. The scoped
+            # lookup re-qualifies with the request's tenant
+            # (cache/generation.py scoped(tenant=...)).
+            t = p.__dict__.get("_cedar_tenant")
+            return f"{t}/{p.policy_id}" if t is not None else p.policy_id
+
         for sid in sorted(fresh):
             cs = fresh[sid]
             pruned += cs.pruned
             for lp in cs.lowered:
                 lowered_entries.append((_pos(lp.policy), lp))
-                pid = lp.policy.policy_id
+                pid = _stamp_key(lp.policy)
                 policy_shard[pid] = (
                     sid if policy_shard.get(pid, sid) == sid else None
                 )
             for fb in cs.fallback:
                 fallback_entries.append((_pos(fb.policy), fb))
-                pid = fb.policy.policy_id
+                pid = _stamp_key(fb.policy)
                 policy_shard[pid] = (
                     sid if policy_shard.get(pid, sid) == sid else None
                 )
@@ -311,16 +366,28 @@ class ShardCompiler:
             lowered_never_matches,
             quick_never_matches,
         )
+        from .pack import discriminate_lowered, policy_tenant
 
         lowered: List[LoweredPolicy] = []
         fallback: List[FallbackPolicy] = []
         pruned = 0
         for p in pols:
-            if spec is not None and quick_never_matches(p, spec, self.schema):
+            realm = policy_tenant(p)
+            # fused multi-tenant clone: lower the DEGUARDED twin — same
+            # lowerability verdict and same clause IR as the tenant's
+            # standalone engine — then prepend the synthetic total
+            # discriminator literal (compiler/pack.py). Lowering the
+            # guarded AST directly would add error clauses for the
+            # guard's fallible context access; the fallback AST keeps the
+            # guard so policy_matches stays tenant-isolated.
+            base = _deguarded(p, realm) if realm is not None else p
+            if spec is not None and quick_never_matches(
+                base, spec, self.schema
+            ):
                 pruned += 1
                 continue
             try:
-                lp = lower_policy(p, tier, self.schema)
+                lp = lower_policy(base, tier, self.schema)
             except Unlowerable as e:
                 fallback.append(
                     FallbackPolicy(
@@ -335,6 +402,13 @@ class ShardCompiler:
             if spec is not None and lowered_never_matches(lp, spec):
                 pruned += 1
                 continue
+            if realm is not None:
+                lp = discriminate_lowered(lp, realm)
+                # the SCANNED clone (not the deguarded twin) must ride the
+                # cached slice: assembly reads the per-reload position
+                # stamps off it, and pack's gate/tenant plumbing reads
+                # the _cedar_tenant stamp
+                lp.policy = p
             lowered.append(lp)
         return CompiledShard(
             sid, tier, content_hash, lowered, fallback, len(pols), pruned,

@@ -6,6 +6,6 @@ time, so nothing multi-megabyte is checked in and every corpus is
 reproducible from (n, seed, clusters).
 """
 
-from .synth import SynthCorpus, synth_corpus
+from .synth import SynthCorpus, synth_corpus, synth_tenant_corpora
 
-__all__ = ["SynthCorpus", "synth_corpus"]
+__all__ = ["SynthCorpus", "synth_corpus", "synth_tenant_corpora"]

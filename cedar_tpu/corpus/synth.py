@@ -46,11 +46,18 @@ PROBE_USER = "probe-user"
 PROBE_RESOURCE = "probes"
 
 
-def _cluster_groups(cluster: int) -> Tuple[str, ...]:
+def _cluster_groups(cluster: int, tenant: str = "") -> Tuple[str, ...]:
+    # a tenant tag namespaces the cluster-local groups: multi-tenant
+    # corpora get DISJOINT apiGroup universes per tenant (cross-tenant
+    # content can never accidentally match), while CORE_GROUPS stay
+    # shared org-wide — the slice that makes the isolation differential
+    # sharp (without discriminators, tenant B's org-wide policies WOULD
+    # flip tenant A's core-group decisions)
+    tag = f"{tenant}." if tenant else ""
     return (
-        f"platform.c{cluster}.corp",
-        f"data.c{cluster}.corp",
-        f"ml.c{cluster}.corp",
+        f"platform.{tag}c{cluster}.corp",
+        f"data.{tag}c{cluster}.corp",
+        f"ml.{tag}c{cluster}.corp",
     )
 
 
@@ -70,7 +77,9 @@ class _PolicyParams:
     verbs: Tuple[str, ...] = ()
 
 
-def _policy_source(i: int, seed: int, clusters: int) -> Tuple[str, _PolicyParams]:
+def _policy_source(
+    i: int, seed: int, clusters: int, tenant: str = ""
+) -> Tuple[str, _PolicyParams]:
     rng = random.Random(f"{seed}:{i}")
     cluster = i % clusters
     org_wide = rng.random() < 0.02
@@ -78,7 +87,7 @@ def _policy_source(i: int, seed: int, clusters: int) -> Tuple[str, _PolicyParams
         group = rng.choice(CORE_GROUPS)
         cluster = -1
     else:
-        group = rng.choice(_cluster_groups(cluster))
+        group = rng.choice(_cluster_groups(cluster, tenant))
     prefix = "org" if org_wide else f"c{cluster}"
     team = f"{prefix}-team-{rng.randint(0, 99)}"
     user = f"{prefix}-user-{rng.randint(0, 499)}"
@@ -140,8 +149,8 @@ def _policy_source(i: int, seed: int, clusters: int) -> Tuple[str, _PolicyParams
     return src, params
 
 
-def _probe_source(effect: str) -> str:
-    group = _cluster_groups(0)[0]
+def _probe_source(effect: str, tenant: str = "") -> str:
+    group = _cluster_groups(0, tenant)[0]
     return (
         f'{effect} (principal is k8s::User, action == k8s::Action::"get", '
         "resource is k8s::Resource) when { "
@@ -160,6 +169,10 @@ class SynthCorpus:
     clusters: int
     probe_index: int = 0
     probe_effect: str = "permit"
+    # multi-tenant corpora (synth_tenant_corpora): the tenant tag that
+    # namespaces this corpus's cluster-local apiGroups — "" keeps every
+    # generated byte identical to the single-tenant form
+    tenant: str = ""
     _tier_cache: Optional[List[PolicySet]] = field(default=None, repr=False)
 
     # ----------------------------------------------------------- policy side
@@ -181,9 +194,9 @@ class SynthCorpus:
         effect = self.probe_effect
         if idx == self.probe_index:
             effect = "forbid" if effect == "permit" else "permit"
-            src = _probe_source(effect)
+            src = _probe_source(effect, self.tenant)
         else:
-            src, _ = _policy_source(idx, self.seed, self.clusters)
+            src, _ = _policy_source(idx, self.seed, self.clusters, self.tenant)
             # flip WHICHEVER effect the policy has — a permit-only
             # replace on a forbid-kind policy would be a silent no-op
             # edit (identical corpus, dirty_shards == 0) and fail far
@@ -207,6 +220,7 @@ class SynthCorpus:
             clusters=self.clusters,
             probe_index=self.probe_index,
             probe_effect=effect,
+            tenant=self.tenant,
         )
 
     def partition_dict(self, cluster: int) -> dict:
@@ -216,7 +230,7 @@ class SynthCorpus:
             "name": f"cluster-{cluster}",
             "slots": {
                 "resource.apiGroup": list(
-                    CORE_GROUPS + _cluster_groups(cluster)
+                    CORE_GROUPS + _cluster_groups(cluster, self.tenant)
                 ),
             },
         }
@@ -251,8 +265,13 @@ class SynthCorpus:
                 api_version="v1",
                 resource=p.resource or rng.choice(RESOURCES),
                 resource_request=True,
+                # tenant-tagged corpora stamp their traffic too, so
+                # sar_items feed a fused plane directly; "" is a no-op
+                tenant=self.tenant,
             )
-        group = rng.choice(CORE_GROUPS + _cluster_groups(cluster))
+        group = rng.choice(
+            CORE_GROUPS + _cluster_groups(cluster, self.tenant)
+        )
         return Attributes(
             user=UserInfo(
                 name=f"c{cluster}-user-{rng.randint(0, 499)}",
@@ -265,6 +284,7 @@ class SynthCorpus:
             api_version="v1",
             resource=rng.choice(RESOURCES),
             resource_request=True,
+            tenant=self.tenant,
         )
 
     def sar_items(self, n: int, cluster: int = 0, seed: int = 1) -> list:
@@ -315,31 +335,38 @@ class SynthCorpus:
                 user=UserInfo(name=PROBE_USER, uid="u", groups=()),
                 verb="get",
                 namespace="c0-ns-0",
-                api_group=_cluster_groups(0)[0],
+                api_group=_cluster_groups(0, self.tenant)[0],
                 api_version="v1",
                 resource=PROBE_RESOURCE,
                 resource_request=True,
+                tenant=self.tenant,
             )
         )
 
 
 def synth_corpus(
-    n: int, seed: int = 0, clusters: int = 10, filename_prefix: str = "synth"
+    n: int,
+    seed: int = 0,
+    clusters: int = 10,
+    filename_prefix: str = "synth",
+    tenant: str = "",
 ) -> SynthCorpus:
     """Synthesize an ``n``-policy org corpus spread over ``clusters``
     clusters (index 0 carries the probe policy). One combined parse keeps
     generation fast; each policy then gets its own filename + stable id
-    so edits and shard bucketing behave like per-object CRD stores."""
+    so edits and shard bucketing behave like per-object CRD stores.
+    ``tenant`` tags the cluster-local apiGroups (multi-tenant corpora,
+    see synth_tenant_corpora); "" is byte-identical to before."""
     if n < 1:
         raise ValueError("synth_corpus: n must be >= 1")
     if clusters < 1:
         raise ValueError("synth_corpus: clusters must be >= 1")
-    srcs = [_probe_source("permit")]
+    srcs = [_probe_source("permit", tenant)]
     params: List[_PolicyParams] = [
-        _PolicyParams("probe", 0, _cluster_groups(0)[0])
+        _PolicyParams("probe", 0, _cluster_groups(0, tenant)[0])
     ]
     for i in range(1, n):
-        src, p = _policy_source(i, seed, clusters)
+        src, p = _policy_source(i, seed, clusters, tenant)
         srcs.append(src)
         params.append(p)
     policies = parse_policies("\n".join(srcs), filename_prefix)
@@ -354,4 +381,33 @@ def synth_corpus(
         clusters=clusters,
         probe_index=0,
         probe_effect="permit",
+        tenant=tenant,
     )
+
+
+def synth_tenant_corpora(
+    n: int, tenants: int, seed: int = 0, clusters: int = 4
+) -> "Dict[str, SynthCorpus]":
+    """``tenants`` deterministic per-tenant corpora of ``n`` policies each
+    (ordered dict: tenant id → corpus) — the multi-tenant bench/test
+    generator (bench.py --tenants, tests/test_tenancy.py).
+
+    Per-tenant DERIVED seeds (never the shared stream, so one tenant's
+    regeneration can't reshuffle a neighbor), DISJOINT cluster-local
+    apiGroup universes (the tenant tag in _cluster_groups), and one
+    shared org-wide slice (CORE_GROUPS policies, ~2%) that overlaps
+    across tenants — the content that would cross-match without the
+    plane's tenant discriminators. Policy ids/filenames are prefixed by
+    tenant, so the fused plane's shard-scoped cache stamps resolve
+    per-tenant."""
+    if tenants < 1:
+        raise ValueError("synth_tenant_corpora: tenants must be >= 1")
+    out: Dict[str, SynthCorpus] = {}
+    for t in range(tenants):
+        tid = f"tenant-{t:02d}"
+        tseed = random.Random(f"{seed}:tenant:{tid}").randrange(1 << 31)
+        out[tid] = synth_corpus(
+            n, seed=tseed, clusters=clusters, filename_prefix=tid,
+            tenant=tid,
+        )
+    return out

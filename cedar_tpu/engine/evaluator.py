@@ -359,6 +359,23 @@ class _CompiledSet:
         # the per-request transfer
         self.active_dtype = np.int16 if packed.L < 32767 else np.int32
         self.code_dtype = packed.table.code_dtype
+        # fused multi-tenant plane (cedar_tpu/tenancy): (slot column,
+        # {value_key: feature row}) of the reserved tenant discriminator
+        # slot, or None. The raw fast paths stamp each request's tenant
+        # code into this column post-encode (the body itself carries no
+        # tenant), which is ALL the device plane needs — the tenant
+        # literal then masks foreign rules like any other EQ test.
+        self.tenant_column = None
+        table = packed.table
+        if table is not None:
+            from ..compiler.pack import TENANT_SLOT
+
+            tcol = table.scalar_slot_of.get(TENANT_SLOT)
+            if tcol is not None:
+                self.tenant_column = (
+                    tcol,
+                    dict(table.scalar_vocab.get(TENANT_SLOT, {})),
+                )
         self.pallas_args = None
         # u8 wire plan (set below for the single-device XLA plane): slots
         # whose nonzero row span fits 255 ship ONE byte per request, re-based
@@ -993,6 +1010,23 @@ class TPUPolicyEngine:
             "hashes": {sid: h[:12] for sid, h in hashes.items()},
             "hashes_truncated": len(pl.shard_hashes) > 256,
         }
+        # fused multi-tenant plane: per-tenant shard/dirty rollup — the
+        # operator-facing proof that one tenant's edit dirtied only its
+        # own (tenant, tier, bucket) shards (docs/multitenancy.md)
+        from ..compiler.shard import shard_tenant
+
+        tenants: Dict[str, dict] = {}
+        for sid in pl.shard_hashes:
+            t = shard_tenant(sid)
+            if t is not None:
+                tenants.setdefault(t, {"shards": 0, "dirty": 0})
+                tenants[t]["shards"] += 1
+        if tenants:
+            for sid in pl.dirty:
+                t = shard_tenant(sid)
+                if t in tenants:
+                    tenants[t]["dirty"] += 1
+            doc["tenants"] = dict(sorted(tenants.items()))
         if self._partition is not None and self._shard_compiler is not None:
             # paging residency report (analysis/partition.py): what the
             # serving partition kept on the device vs paged host-side
@@ -2020,6 +2054,18 @@ class TPUPolicyEngine:
         fb_deny: List[List[Reason]] = [[] for _ in range(T)]
         fb_errors: List[List[str]] = [[] for _ in range(T)]
         if packed.fallback and entities is not None:
+            # fallback burn-down (ROADMAP item 3): this decision is being
+            # interpreter-merged BECAUSE unlowerable policies exist —
+            # count it under each distinct Unlowerable reason code so the
+            # coverage drive can rank offenders by SERVED traffic, not
+            # just by policy count (cedar_fallback_decisions_total{code},
+            # tallied on /debug/engine)
+            try:
+                from ..server.metrics import record_fallback_decision
+
+                record_fallback_decision(packed.fallback_codes)
+            except Exception:  # noqa: BLE001 — metrics never break serving
+                pass
             env = Env(request, entities)
             for fp in packed.fallback:
                 p = fp.policy

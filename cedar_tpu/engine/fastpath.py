@@ -510,6 +510,19 @@ class _RawFastPath:
             aux = self._encode_into(
                 snap, bodies, codes, extras, counts, flags
             )
+            # fused multi-tenant plane (cedar_tpu/tenancy): the body bytes
+            # carry no tenant — stamp each request's tenant feature code
+            # into the reserved discriminator column the front end
+            # resolved for it (TenantBody). Unknown/unstamped tenants
+            # stay code 0, which activates NOTHING: such a request can
+            # match no tenant's rules — fail-safe by construction.
+            tcol = snap.cs.tenant_column
+            if tcol is not None:
+                col, vocab = tcol
+                codes[:n, col] = [
+                    vocab.get(("s", getattr(b, "tenant", "")), 0)
+                    for b in bodies
+                ]
         except Exception:
             # the encode never reached the device: the buffers are
             # provably idle, hand them straight back
@@ -912,6 +925,10 @@ class SARFastPath(_RawFastPath):
             )
         try:
             attributes = get_authorizer_attributes(sar)
+            # tenant stamp (cedar_tpu/tenancy): the interpreter path's
+            # request context must carry the same tenant id the device
+            # plane discriminates on
+            attributes.tenant = getattr(body, "tenant", "")
             decision, reason = self.authorizer.authorize(attributes)
         except Exception as e:  # noqa: BLE001 — always answer the apiserver
             log.exception("fastpath python fallback failed")
@@ -944,6 +961,7 @@ class SARFastPath(_RawFastPath):
                 continue
             try:
                 attributes = get_authorizer_attributes(sar)
+                attributes.tenant = getattr(body, "tenant", "")
                 entities, request = record_to_cedar_resource(attributes)
             except Exception as e:  # noqa: BLE001 — always answer
                 log.exception("fastpath gated entity build failed")
@@ -1117,7 +1135,11 @@ class AdmissionFastPath(_RawFastPath):
         review = None
         try:
             review = json.loads(body)
-            return AdmissionRequest.from_admission_review(review), review, None
+            req = AdmissionRequest.from_admission_review(review)
+            # tenant stamp (cedar_tpu/tenancy): the Python admission path's
+            # context must carry the tenant the device plane masks by
+            req.tenant = getattr(body, "tenant", "")
+            return req, review, None
         except (ValueError, TypeError, RecursionError) as e:
             if review is None:
                 return None, None, AdmissionResponse(

@@ -119,6 +119,11 @@ class AdmissionRequest:
     # (evaluation-identical to the real write); the decision cache's
     # read-only-idempotent gate keys on it (server/admission.py)
     dry_run: bool = False
+    # tenant id the front end resolved for this review (cedar_tpu/tenancy,
+    # never part of the wire body): stamped into context.tenantId so the
+    # fused plane's discriminators isolate admission decisions too, and
+    # folded into the canonical fingerprint (cache/fingerprint.py)
+    tenant: str = ""
 
     @classmethod
     def from_admission_review(cls, review: dict) -> "AdmissionRequest":

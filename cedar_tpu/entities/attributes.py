@@ -55,6 +55,12 @@ class Attributes:
     path: str = ""
     label_selector: Tuple[LabelSelectorRequirement, ...] = ()
     field_selector: Tuple[FieldSelectorRequirement, ...] = ()
+    # tenant id the front end resolved for this request (cedar_tpu/tenancy;
+    # never part of the SAR wire body): stamped into the Cedar request's
+    # context.tenantId and folded into the canonical fingerprint — empty
+    # outside multi-tenant serving, where both stay byte-identical to the
+    # single-tenant forms
+    tenant: str = ""
 
     def is_read_only(self) -> bool:
         return self.verb in READONLY_VERBS

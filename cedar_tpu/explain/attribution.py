@@ -454,6 +454,33 @@ def build_explanation(
 # ------------------------------------------------------- interpreter walk
 
 
+def _reason_policy(ps, r, request):
+    """Resolve a reason's Policy on sets where ids may legally collide
+    across tenants (tenancy's FusedPolicySet — per-tenant directory
+    stores commonly carry the same bare-filename ids): prefer the policy
+    whose fused tenant matches the request's stamped ``context.tenantId``
+    (a foreign clone's effect would mis-attribute the decision), then an
+    exact source-span match, then the first id match."""
+    want = None
+    try:
+        from ..compiler.pack import TENANT_CONTEXT_KEY
+
+        want = request.context.attrs.get(TENANT_CONTEXT_KEY)
+    except Exception:  # noqa: BLE001 — single-tenant shapes
+        want = None
+    first = span = None
+    for p in ps.policies():
+        if p.policy_id != r.policy:
+            continue
+        t = p.__dict__.get("_cedar_tenant")
+        if want is not None and t == want:
+            return p
+        if p.filename == r.filename and p.position == r.position:
+            span = span or p
+        first = first or p
+    return span or first
+
+
 def interpreter_explanation(
     tiers, entities, request
 ) -> Tuple[str, Diagnostics, dict]:
@@ -468,7 +495,7 @@ def interpreter_explanation(
         if diag.reasons or diag.errors:
             docs = []
             for r in diag.reasons:
-                p = ps.get(r.policy)
+                p = _reason_policy(ps, r, request)
                 docs.append(
                     {
                         "policyId": r.policy,

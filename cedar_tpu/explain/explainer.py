@@ -171,6 +171,11 @@ class Explainer:
         try:
             sar = json.loads(body)
             attributes = get_authorizer_attributes(sar)
+            # tenant stamp (cedar_tpu/tenancy): on a fused plane the
+            # explain answer must evaluate under the same context.tenantId
+            # the serving paths stamp, or every tenant-guarded policy
+            # fails its guard and explain contradicts the served decision
+            attributes.tenant = getattr(body, "tenant", "")
         except Exception as e:  # noqa: BLE001 — mirror the decode-error answer
             return (
                 DECISION_NO_OPINION,
@@ -247,6 +252,9 @@ class Explainer:
                 _gate_explanation("stores-not-ready"),
             )
         try:
+            # tenant stamp (cedar_tpu/tenancy): same contract as
+            # explain_authorize — evaluate under the request's tenant
+            req.tenant = getattr(body, "tenant", "")
             entities, cedar_req = handler._build(req)
             decision, diag, explanation = self._explain_eval(
                 "admission", entities, cedar_req

@@ -100,7 +100,12 @@ class PolicySet:
         forbids: List[Reason] = []
         permits: List[Reason] = []
         errors: List[str] = []
-        for pid, p in self._policies.items():
+        # the policy's OWN id, not the container key: subclasses may key
+        # the dict differently (tenancy's FusedPolicySet uses (tenant, id)
+        # so cross-tenant id collisions don't overwrite), and served
+        # Reasons must always carry the policy's id
+        for p in self._policies.values():
+            pid = p.policy_id
             try:
                 matched = policy_matches(p, env)
             except EvalError as e:

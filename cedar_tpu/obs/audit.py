@@ -151,6 +151,7 @@ def audit_entry(
     fallback: bool = False,
     cached: bool = False,
     tier: Optional[int] = None,
+    tenant: str = "",
 ) -> dict:
     """One decision's audit line (docs/observability.md schema). The
     determining policy ids come from the reason diagnostics already in
@@ -181,6 +182,11 @@ def audit_entry(
         pass
     if tier is not None:
         entry["tier"] = tier
+    if tenant:
+        # multi-tenant serving (cedar_tpu/tenancy): the tenant the front
+        # end attributed this decision to — joins the per-tenant metrics
+        # series and the tenant-scoped fingerprint above
+        entry["tenant"] = tenant
     if error:
         entry["error"] = error[:500]
     return entry

@@ -223,7 +223,10 @@ class CedarAdmissionHandler:
             if verdicts is not None:
                 for (i, _, _), (decision, diagnostics) in zip(build, verdicts):
                     responses[i] = self._decide(reqs[i], decision, diagnostics)
-                    self._cache_put(cache_keys.get(i), responses[i], diagnostics)
+                    self._cache_put(
+                        cache_keys.get(i), responses[i], diagnostics,
+                        tenant=getattr(reqs[i], "tenant", ""),
+                    )
             else:
                 for i, em, cr in build:
                     try:
@@ -236,10 +239,16 @@ class CedarAdmissionHandler:
                         )
                         continue
                     responses[i] = self._decide(reqs[i], decision, diagnostics)
-                    self._cache_put(cache_keys.get(i), responses[i], diagnostics)
+                    self._cache_put(
+                        cache_keys.get(i), responses[i], diagnostics,
+                        tenant=getattr(reqs[i], "tenant", ""),
+                    )
         return responses
 
-    def _cache_put(self, keyed, response: AdmissionResponse, diagnostics) -> None:
+    def _cache_put(
+        self, keyed, response: AdmissionResponse, diagnostics,
+        tenant: str = "",
+    ) -> None:
         """Insert a clean decision for a cacheable request. Errored
         responses (allow-on-error posture) AND verdicts carrying
         evaluation-error diagnostics (a raising tier reads as
@@ -256,7 +265,11 @@ class CedarAdmissionHandler:
             # reload then kills exactly the entries whose shard changed
             scoped = getattr(generation, "scoped", None)
             if scoped is not None and response.message:
-                generation = scoped(response.message)
+                generation = (
+                    scoped(response.message, tenant=tenant)
+                    if tenant
+                    else scoped(response.message)
+                )
             self.cache.put(
                 key,
                 (response.allowed, response.message),
@@ -315,6 +328,12 @@ class CedarAdmissionHandler:
         context = {}
         if old_entity is not None:
             context["oldObject"] = old_entity.attrs
+        if getattr(req, "tenant", ""):
+            # fused multi-tenant plane (cedar_tpu/tenancy): the context
+            # carries the tenant id the discriminator literals test
+            from ..compiler.pack import TENANT_CONTEXT_KEY
+
+            context[TENANT_CONTEXT_KEY] = req.tenant
 
         cedar_req = Request(
             principal_uid, action_uid, resource_entity.uid, CedarRecord(context)

@@ -61,7 +61,16 @@ def record_to_cedar_resource(attributes: Attributes) -> Tuple[EntityMap, Request
         entity = non_resource_to_cedar_entity(attributes)
     req_entities.add(entity)
 
-    req = Request(principal_uid, action_uid, entity.uid, CedarRecord())
+    ctx = CedarRecord()
+    if getattr(attributes, "tenant", ""):
+        # fused multi-tenant plane (cedar_tpu/tenancy): the context carries
+        # the tenant id the discriminator literals test — on the Python
+        # engine path via encode_request_codes, on the interpreter paths
+        # via the clones' guard conditions
+        from ..compiler.pack import TENANT_CONTEXT_KEY
+
+        ctx = CedarRecord({TENANT_CONTEXT_KEY: attributes.tenant})
+    req = Request(principal_uid, action_uid, entity.uid, ctx)
     return req_entities, req
 
 

@@ -46,6 +46,7 @@ from ..entities.attributes import (
     LabelSelectorRequirement,
     UserInfo,
 )
+from ..tenancy.frontend import TenantBody
 from . import metrics
 from .admission import AdmissionResponse, CedarAdmissionHandler
 from .authorizer import (
@@ -83,6 +84,25 @@ _LABEL_OPS = {"In": "in", "NotIn": "notin", "Exists": "exists", "DoesNotExist": 
 # thread-local, like the active trace, because a request owns its thread
 # end to end (singleflight leaders run in the requesting thread)
 _obs_local = threading.local()
+
+
+def _admit_outcome(review) -> tuple:
+    """(metric label, error-or-None) for a rendered AdmissionReview —
+    the decision facts read back out of the response the caller is
+    already returning, so this can never change an answer."""
+    resp = (review or {}).get("response") or {}
+    status = resp.get("status") or {}
+    error = (
+        None
+        if review is not None and status.get("code") in (None, 200)
+        else (status.get("message") or "no response")
+    )
+    label = (
+        "<error>"
+        if error
+        else ("allowed" if resp.get("allowed") else "denied")
+    )
+    return label, error
 
 
 def _octx() -> Optional[dict]:
@@ -229,6 +249,26 @@ def _engine_doc(engine) -> dict:
             doc["shards"] = shard_status()
         except Exception:  # noqa: BLE001 — debug must not 500
             log.exception("shard status failed")
+    # fallback burn-down (docs/analysis.md): which Unlowerable codes the
+    # serving plane still carries, per-code policy counts, and the served
+    # interpreter-merged decision tally
+    # (cedar_fallback_decisions_total{code}) — the coverage drive's
+    # operator surface
+    try:
+        cs = getattr(engine, "compiled_set", None)
+        packed = getattr(cs, "packed", None) if cs is not None else None
+        if packed is not None:
+            by_code: dict = {}
+            for fp in packed.fallback:
+                code = getattr(fp, "code", "unlowerable") or "unlowerable"
+                by_code[code] = by_code.get(code, 0) + 1
+            doc["fallback"] = {
+                "policies": len(packed.fallback),
+                "codes": dict(sorted(by_code.items())),
+                "served_decisions": metrics.fallback_decision_counts(),
+            }
+    except Exception:  # noqa: BLE001 — debug must not 500
+        log.exception("fallback status failed")
     return doc
 
 
@@ -268,6 +308,7 @@ class WebhookServer:
         tracer=None,
         audit_log=None,
         slo=None,
+        tenancy=None,
     ):
         self.authorizer = authorizer
         self.admission_handler = admission_handler
@@ -443,6 +484,14 @@ class WebhookServer:
 
             self._audit_memo = self._sar_memo or FingerprintMemo(4096)
             self._adm_audit_memo = FingerprintMemo(4096)
+        # multi-tenant front end (cedar_tpu/tenancy TenantResolver,
+        # docs/multitenancy.md): when wired, every POST resolves a tenant
+        # (path prefix / header / host map), the raw body is wrapped in a
+        # TenantBody so the stamp rides the whole serving stack, and
+        # unresolvable requests are refused BEFORE evaluation — a fused
+        # plane must never answer traffic it cannot attribute to a
+        # tenant. None keeps the single-tenant path byte-identical.
+        self.tenancy = tenancy
         self.drain_grace_s = drain_grace_s
         self._draining = False
         self._inflight = 0
@@ -604,6 +653,9 @@ class WebhookServer:
         octx: dict = {}
         if trace is not None or self.audit_log is not None:
             _octx_set(octx)
+        tenant = getattr(body, "tenant", "")
+        if tenant and trace is not None:
+            trace.root.set_attr("tenant", tenant)
         decision, reason, error = DECISION_NO_OPINION, "", None
         try:
             decision, reason, error = self._authorize_cached(body, request_id)
@@ -636,6 +688,10 @@ class WebhookServer:
             latency = time.monotonic() - start
             metrics.record_request_total(label)
             metrics.record_request_latency(label, latency)
+            if tenant:
+                metrics.record_tenant_request(
+                    "authorization", tenant, label, latency
+                )
             if self.slo is not None:
                 # fed the SAME measured latency the histogram above just
                 # observed — the burn rates and the dashboards can never
@@ -712,7 +768,11 @@ class WebhookServer:
                     g = gen
                     scoped = getattr(gen, "scoped", None)
                     if scoped is not None:
-                        g = scoped(res[1])
+                        # the request's resolved tenant qualifies the
+                        # stamp lookup on fused planes — bare policy ids
+                        # collide across tenants (cache/generation.py)
+                        t = getattr(body, "tenant", "")
+                        g = scoped(res[1], tenant=t) if t else scoped(res[1])
                     cache.put(key, (res[0], res[1]), res[0], generation=g)
                 except Exception:  # noqa: BLE001 — the answer still serves
                     log.exception("decision cache insert failed")
@@ -860,6 +920,10 @@ class WebhookServer:
                 )
             try:
                 attributes = get_authorizer_attributes(sar)
+                # tenant stamp (cedar_tpu/tenancy): the interpreter walk
+                # over the fused stack relies on the guard conditions
+                # reading context.tenantId
+                attributes.tenant = getattr(body, "tenant", "")
                 # bypass the authorizer-level cache ONLY when the
                 # server-level cache is wired: it already missed on this
                 # exact canonical key, and a second lookup would
@@ -970,6 +1034,9 @@ class WebhookServer:
         octx: dict = {}
         if trace is not None or self.audit_log is not None:
             _octx_set(octx)
+        tenant = getattr(body, "tenant", "")
+        if tenant and trace is not None:
+            trace.root.set_attr("tenant", tenant)
         review = None
         try:
             review = self._handle_admit(body)
@@ -982,14 +1049,21 @@ class WebhookServer:
             return review
         finally:
             _octx_set(None)
+            latency = time.monotonic() - start
+            if tenant:
+                # unconditional, like the authorization path's finally —
+                # per-tenant series must not depend on obs being wired
+                label, _error = _admit_outcome(review)
+                metrics.record_tenant_request(
+                    "admission", tenant, label, latency
+                )
             if (
                 trace is not None
                 or self.slo is not None
                 or self.audit_log is not None
             ):
                 self._finish_admit_obs(
-                    body, request_id, review, trace, octx,
-                    time.monotonic() - start,
+                    body, request_id, review, trace, octx, latency,
                 )
 
     def _finish_admit_obs(
@@ -1002,16 +1076,7 @@ class WebhookServer:
         change an answer."""
         resp = (review or {}).get("response") or {}
         status = resp.get("status") or {}
-        error = (
-            None
-            if review is not None and status.get("code") in (None, 200)
-            else (status.get("message") or "no response")
-        )
-        label = (
-            "<error>"
-            if error
-            else ("allowed" if resp.get("allowed") else "denied")
-        )
+        label, error = _admit_outcome(review)
         if self.slo is not None:
             try:
                 self.slo.record("admission", latency, error is not None)
@@ -1064,6 +1129,7 @@ class WebhookServer:
                     breaker_state=self._breaker_state_label(path),
                     fallback=bool(octx.get("fallback")),
                     cached=bool(octx.get("cached")),
+                    tenant=getattr(body, "tenant", ""),
                 )
             )
             metrics.record_audit_record(path)
@@ -1171,6 +1237,9 @@ class WebhookServer:
                 ).to_admission_review()
             try:
                 req = AdmissionRequest.from_admission_review(review)
+                # tenant stamp (cedar_tpu/tenancy): the interpreter path's
+                # context must carry the tenant the device plane masks by
+                req.tenant = getattr(body, "tenant", "")
                 if self._admission_batcher is not None:
                     return self._admission_batcher.submit(
                         req, timeout=remaining()
@@ -1255,6 +1324,24 @@ class WebhookServer:
                         self.send_error(413, "request body too large")
                         return
                     body = self.rfile.read(length) if length else b""
+                    if server.tenancy is not None:
+                        # tenant front end (docs/multitenancy.md): resolve
+                        # path-prefix/header/host → tenant, re-dispatch on
+                        # the stripped path, and wrap the body so every
+                        # layer below (cache keys, recorder filenames,
+                        # encoders, audit) sees the stamp. Unresolvable
+                        # requests answer a clean refusal — never an
+                        # evaluation against a plane with no tenant slice.
+                        tenant, path, why = server.tenancy.resolve(
+                            path,
+                            self.headers,
+                            host=self.headers.get("Host"),
+                        )
+                        if tenant is None:
+                            metrics.record_tenant_rejected(why)
+                            self._reject_tenant(path, body, why)
+                            return
+                        body = TenantBody(body, tenant)
                     if server.recorder is not None:
                         server.recorder.record(path, body)
                     # one request id end to end: the ingested W3C
@@ -1309,6 +1396,42 @@ class WebhookServer:
                     with server._inflight_cv:
                         server._inflight -= 1
                         server._inflight_cv.notify_all()
+
+            def _reject_tenant(self, path: str, body: bytes, why: str):
+                """A clean, well-formed refusal for a request the tenant
+                front end could not attribute: authorization answers
+                NoOpinion + evaluationError (the apiserver treats it as
+                an abstain), admission answers a denied review (403
+                status) — fail-closed, a write must not slip through a
+                misrouted tenant."""
+                msg = {
+                    "unknown": "unknown tenant",
+                    "conflict": "conflicting tenant sources",
+                }.get(why, "no tenant resolved")
+                if path == "/v1/admit":
+                    uid = ""
+                    try:
+                        uid = (json.loads(body).get("request") or {}).get(
+                            "uid", ""
+                        )
+                    except Exception:  # noqa: BLE001 — reject regardless
+                        pass
+                    self._write_json(
+                        AdmissionResponse(
+                            uid=uid,
+                            allowed=False,
+                            code=403,
+                            message=f"tenant rejected: {msg}",
+                        ).to_admission_review()
+                    )
+                else:
+                    self._write_json(
+                        sar_response(
+                            DECISION_NO_OPINION,
+                            "",
+                            f"tenant rejected: {msg}",
+                        )
+                    )
 
             def do_GET(self):
                 if server.enable_profiling and self.path.startswith(
@@ -1490,6 +1613,24 @@ class WebhookServer:
                     except Exception:  # noqa: BLE001 — debug must not 500
                         log.exception("engine stats failed")
                         doc = {"error": "engine stats failed"}
+                    self._send_json(doc)
+                elif self.path == "/debug/tenancy":
+                    # multi-tenant front end + registry snapshot
+                    # (docs/multitenancy.md): registered tenants with
+                    # per-tenant policy counts, resolver config, and the
+                    # serving plane's per-tenant shard rollup (via
+                    # /debug/engine's shards.tenants); 404 single-tenant
+                    if server.tenancy is None:
+                        self.send_error(404)
+                        return
+                    try:
+                        doc = {"resolver": server.tenancy.describe()}
+                        reg = getattr(server.tenancy, "registry", None)
+                        if reg is not None:
+                            doc["registry"] = reg.stats()
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("tenancy status failed")
+                        doc = {"error": "tenancy status failed"}
                     self._send_json(doc)
                 elif self.path == "/debug/fleet":
                     # replicated-engine fleet snapshot (docs/fleet.md):

@@ -89,6 +89,14 @@ class Gauge:
         with self._lock:
             self._values[key] = value
 
+    def remove(self, **labels) -> None:
+        """Drop one labeled row from the exposition (e.g. an offboarded
+        tenant's gauge — a frozen last value would keep reporting state
+        that no longer exists)."""
+        key = tuple((k, labels.get(k, "")) for k in self.label_names)
+        with self._lock:
+            self._values.pop(key, None)
+
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
         with self._lock:
@@ -236,6 +244,138 @@ e2e_label_overflow_total = REGISTRY.register(
         [],
     )
 )
+
+
+# ------------------------------------------------------------- tenancy
+# Multi-tenant shared planes (cedar_tpu/tenancy, docs/multitenancy.md):
+# per-tenant serving series under a BOUNDED tenant label (the e2e
+# filename-cap pattern above) — tenant ids are operator-registered, but a
+# misconfigured front end must not explode the exposition.
+_TENANT_LABEL_CAP = 64
+_tenant_labels: set = set()
+_tenant_label_lock = threading.Lock()
+
+tenant_requests_total = REGISTRY.register(
+    Counter(
+        "cedar_tenant_requests_total",
+        "Requests served per tenant, path and decision on a fused "
+        "multi-tenant plane. The tenant label is CAPPED at 64 distinct "
+        "ids; later ids fold into `other` "
+        "(cedar_tenant_label_overflow_total counts the folds).",
+        ["tenant", "path", "decision"],
+    )
+)
+
+tenant_request_latency = REGISTRY.register(
+    Histogram(
+        "cedar_tenant_request_duration_seconds",
+        "Per-tenant request latency on a fused multi-tenant plane "
+        "(bounded tenant label, see cedar_tenant_requests_total).",
+        ["tenant", "path"],
+        [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1, 5],
+    )
+)
+
+tenant_label_overflow_total = REGISTRY.register(
+    Counter(
+        "cedar_tenant_label_overflow_total",
+        "Tenant-labeled observations folded into `other` because the "
+        "bounded tenant label set was full.",
+        [],
+    )
+)
+
+tenant_rejected_total = REGISTRY.register(
+    Counter(
+        "cedar_tenant_rejected_total",
+        "Requests the tenant front end refused before evaluation, by "
+        "reason: `unknown` = a tenant id resolved but is not registered, "
+        "`missing` = no tenant id resolved and no default configured, "
+        "`conflict` = enabled resolution sources named different tenants.",
+        ["reason"],
+    )
+)
+
+tenant_policies = REGISTRY.register(
+    Gauge(
+        "cedar_tenant_policies",
+        "Policies contributed to the fused plane per tenant.",
+        ["tenant"],
+    )
+)
+
+fallback_decisions_total = REGISTRY.register(
+    Counter(
+        "cedar_fallback_decisions_total",
+        "Decisions whose evaluation was interpreter-merged because the "
+        "serving plane carries unlowerable policies, partitioned by "
+        "Unlowerable reason code (one increment per decision per distinct "
+        "code present). The burn-down signal for the lowerability "
+        "coverage drive: lowering a construct family drops its code's "
+        "rate to zero (docs/analysis.md; tallied on /debug/engine).",
+        ["code"],
+    )
+)
+
+
+def _tenant_label_for(tenant: str) -> str:
+    with _tenant_label_lock:
+        if tenant != "other" and tenant not in _tenant_labels:
+            if len(_tenant_labels) >= _TENANT_LABEL_CAP:
+                tenant_label_overflow_total.inc()
+                return "other"
+            _tenant_labels.add(tenant)
+    return tenant
+
+
+def record_tenant_request(
+    path: str, tenant: str, decision: str, latency_s: float
+) -> None:
+    if not tenant:
+        return
+    t = _tenant_label_for(tenant)
+    tenant_requests_total.inc(tenant=t, path=path, decision=decision)
+    tenant_request_latency.observe(latency_s, tenant=t, path=path)
+
+
+def record_tenant_rejected(reason: str) -> None:
+    tenant_rejected_total.inc(reason=reason)
+
+
+def set_tenant_policies(tenant: str, n: int) -> None:
+    tenant_policies.set(n, tenant=_tenant_label_for(tenant))
+
+
+def clear_tenant_policies(tenant: str) -> None:
+    """Drop an offboarded tenant's policy-count gauge row AND free its
+    slot in the bounded tenant label set — with tenant churn, departed
+    ids must not consume the cap forever or every newly onboarded tenant
+    folds into ``other`` while live tenancy is far below the limit.
+    (The departed tenant's counter/histogram rows keep their last values
+    — counters never un-count — but new observations for a re-onboarded
+    id register afresh.) Tenants that were folded into ``other`` are
+    left alone — that row aggregates several tenants."""
+    with _tenant_label_lock:
+        known = tenant in _tenant_labels
+        _tenant_labels.discard(tenant)
+    if known:
+        tenant_policies.remove(tenant=tenant)
+
+
+def record_fallback_decision(codes) -> None:
+    """One interpreter-merged decision under each distinct Unlowerable
+    code it was served with (precomputed tuple, compiler/pack.py)."""
+    for code in codes or ("unlowerable",):
+        fallback_decisions_total.inc(code=code)
+
+
+def fallback_decision_counts() -> dict:
+    """Snapshot of cedar_fallback_decisions_total for /debug/engine."""
+    with fallback_decisions_total._lock:
+        return {
+            dict(key).get("code", ""): int(v)
+            for key, v in fallback_decisions_total._values.items()
+        }
 
 
 row_routing_total = REGISTRY.register(

@@ -1,0 +1,1239 @@
+"""Multi-tenant shared planes (cedar_tpu/tenancy, docs/multitenancy.md).
+
+The isolation contract, pinned:
+
+  * **differential** — every tenant's traffic answers byte-identically
+    (decision AND reason set) on the fused plane and on that tenant's
+    standalone single-tenant engine, including the shared org-wide
+    policy slice that WOULD cross-match without the discriminators, and
+    including interpreter-fallback (unlowerable) policies;
+  * **poisoned neighbor** — tenant B's ``engine.shard_compile`` chaos
+    fault never perturbs tenant A's answers or cache warmth;
+  * **scoped lifecycle** — one tenant's edit dirties only its own
+    (tenant, tier, bucket) shards, kills only its own scoped cache
+    entries, and leaves neighbors' entries warm;
+  * **front end** — path/header/host resolution, unknown-tenant
+    refusal, tenant-scoped cache keys and fingerprints, the
+    /debug/tenancy + per-tenant /debug/engine surfaces, and the fallback
+    burn-down counter satellite.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from cedar_tpu.cache import DecisionCache, plane_composite
+from cedar_tpu.chaos import ChaosError
+from cedar_tpu.chaos.registry import default_registry
+from cedar_tpu.compiler.shard import shard_tenant
+from cedar_tpu.corpus import synth_tenant_corpora
+from cedar_tpu.corpus.synth import PROBE_RESOURCE, PROBE_USER
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+from cedar_tpu.engine.fastpath import SARFastPath
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.lang.parser import parse_policies
+from cedar_tpu.server.admission import CedarAdmissionHandler
+from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+from cedar_tpu.server.http import WebhookServer
+from cedar_tpu.stores.store import TieredPolicyStores
+from cedar_tpu.tenancy import (
+    TenantBody,
+    TenantError,
+    TenantRegistry,
+    TenantResolver,
+    fused_tier_stores,
+)
+
+BUCKETS = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    r = default_registry()
+    r.reset()
+    yield
+    r.reset()
+
+
+def mk_policy(src: str, pid: str, filename: str):
+    p = parse_policies(src, filename)[0]
+    p.policy_id = pid
+    return p
+
+
+def two_tenant_registry():
+    """alpha permits apps-group reads; beta forbids the same — the
+    sharpest cross-match trap: identical scopes, opposite effects."""
+    a = mk_policy(
+        "permit (principal, action, resource is k8s::Resource) when "
+        '{ resource.apiGroup == "apps" };',
+        "pol-a",
+        "a.cedar",
+    )
+    b = mk_policy(
+        "forbid (principal, action, resource is k8s::Resource) when "
+        '{ resource.apiGroup == "apps" };',
+        "pol-b",
+        "b.cedar",
+    )
+    reg = TenantRegistry()
+    reg.add_tenant("alpha", tiers=[PolicySet([a])])
+    reg.add_tenant("beta", tiers=[PolicySet([b])])
+    return reg
+
+
+def sar_body(user="u1", group="apps", resource="deployments"):
+    return json.dumps(
+        {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": user,
+                "uid": "u",
+                "groups": ["g1"],
+                "resourceAttributes": {
+                    "verb": "get",
+                    "group": group,
+                    "version": "v1",
+                    "resource": resource,
+                    "namespace": "ns",
+                },
+            },
+        }
+    ).encode()
+
+
+def corpora_and_engines(n=80, tenants=3, seed=5):
+    corpora = synth_tenant_corpora(n, tenants, seed=seed, clusters=2)
+    solo = {}
+    for tid, c in corpora.items():
+        e = TPUPolicyEngine(
+            incremental=True, shard_buckets=BUCKETS, name=f"solo-{tid}"
+        )
+        e.load(c.tiers(), warm="off")
+        solo[tid] = e
+    live = dict(corpora)
+    reg = TenantRegistry()
+    for tid in corpora:
+        reg.add_tenant(tid, tiers_fn=(lambda t=tid: live[t].tiers()))
+    fused = TPUPolicyEngine(
+        incremental=True, shard_buckets=BUCKETS, name="fused"
+    )
+    fused.load(reg.fused_tiers(), warm="off")
+    return corpora, live, solo, reg, fused
+
+
+# -------------------------------------------------------------- registry
+
+
+class TestRegistry:
+    def test_tenant_id_validation(self):
+        reg = TenantRegistry()
+        for bad in ("", "a/b", "UPPER/SLASH", "-lead", "x" * 70):
+            with pytest.raises(TenantError):
+                reg.add_tenant(bad, tiers=[PolicySet([])])
+
+    def test_duplicate_and_remove(self):
+        reg = two_tenant_registry()
+        with pytest.raises(TenantError):
+            reg.add_tenant("alpha", tiers=[PolicySet([])])
+        assert reg.tenants() == ["alpha", "beta"]
+        assert reg.remove_tenant("beta")
+        assert not reg.remove_tenant("beta")
+        assert reg.tenants() == ["alpha"]
+
+    def test_clone_identity_stable_across_fusions(self):
+        reg = two_tenant_registry()
+        t1 = reg.fused_tiers()
+        t2 = reg.fused_tiers()
+        assert t1 is t2  # identity-cached until content changes
+        ids1 = [id(p) for p in t1[0].policies()]
+        reg._fused_cache = None  # force a re-fuse over the same originals
+        t3 = reg.fused_tiers()
+        assert [id(p) for p in t3[0].policies()] == ids1
+
+    def test_cross_tenant_policy_id_collision_keeps_both(self):
+        # both tenants name their policy "p0": the fused tier must carry
+        # BOTH (the base PolicySet would silently overwrite one)
+        a = mk_policy(
+            "permit (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };',
+            "p0",
+            "a.cedar",
+        )
+        b = mk_policy(
+            "forbid (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };',
+            "p0",
+            "b.cedar",
+        )
+        reg = TenantRegistry()
+        reg.add_tenant("alpha", tiers=[PolicySet([a])])
+        reg.add_tenant("beta", tiers=[PolicySet([b])])
+        assert len(reg.fused_tiers()[0]) == 2
+
+    def test_counterless_store_content_swap_detected(self):
+        """A store-backed tenant whose store lacks content_generation
+        must STILL have content swaps reach the fused plane: the
+        identity-proxy counter (the TieredPolicyStores.cache_generation
+        pattern) bumps when policy_set() identity moves — a constant
+        fingerprint here once meant a new forbid silently never served."""
+
+        class _BareStore:
+            def __init__(self, ps):
+                self.ps = ps
+
+            def name(self):
+                return "bare"
+
+            def policy_set(self):
+                return self.ps
+
+            def initial_policy_load_complete(self):
+                return True
+
+        permit = mk_policy(
+            "permit (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };',
+            "p0",
+            "a.cedar",
+        )
+        forbid = mk_policy(
+            "forbid (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };',
+            "p0",
+            "a.cedar",
+        )
+        store = _BareStore(PolicySet([permit]))
+        reg = TenantRegistry()
+        reg.add_tenant("alpha", stores=TieredPolicyStores([store]))
+        token1 = reg.content_fingerprint()
+        assert "?" not in token1
+        assert [p.effect for p in reg.fused_tiers()[0].policies()] == [
+            "permit"
+        ]
+        store.ps = PolicySet([forbid])  # the reloader's content swap
+        assert reg.content_fingerprint() != token1
+        assert [p.effect for p in reg.fused_tiers()[0].policies()] == [
+            "forbid"
+        ]
+
+    def test_offboard_clears_policy_gauge(self):
+        from cedar_tpu.server import metrics
+
+        a = mk_policy(
+            "permit (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };',
+            "p0",
+            "a.cedar",
+        )
+        reg = TenantRegistry()
+        reg.add_tenant("gauge-offboard-t", tiers=[PolicySet([a])])
+        reg.fused_tiers()  # publishes cedar_tenant_policies
+        row = 'cedar_tenant_policies{tenant="gauge-offboard-t"}'
+        assert any(
+            row in line for line in metrics.tenant_policies.collect()
+        )
+        reg.remove_tenant("gauge-offboard-t")
+        assert not any(
+            row in line for line in metrics.tenant_policies.collect()
+        )
+        # the label-cap slot is freed too: with tenant churn, departed
+        # ids must not consume the bounded set forever
+        assert "gauge-offboard-t" not in metrics._tenant_labels
+
+    def test_onboard_deeper_tenant_than_wired_stack_raises(self):
+        """fused_tier_stores freezes the served tier count; a tenant
+        onboarded later with MORE tiers must fail loudly instead of the
+        stack silently never serving its higher tiers' policies."""
+        reg = two_tenant_registry()
+        fused_tier_stores(reg)  # wires a 1-tier stack
+        deep = mk_policy(
+            "forbid (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };',
+            "deep0",
+            "d.cedar",
+        )
+        reg.add_tenant(
+            "deep", tiers=[PolicySet([]), PolicySet([deep])]
+        )
+        with pytest.raises(TenantError, match="wired"):
+            reg.fused_tiers()
+
+    def test_colliding_ids_keep_tenant_scoped_cache_stamps(self):
+        """Per-tenant directory stores commonly carry the SAME
+        bare-filename policy ids (every tenant's p.cedar.policy0). The
+        plane's cache-stamp lookup must key them per tenant — an
+        unqualified key would read as ambiguous and silently downgrade
+        every such decision's stamp from shard-scoped to
+        kill-on-any-reload."""
+        a = mk_policy(
+            "permit (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };',
+            "p.cedar.policy0",
+            "p.cedar",
+        )
+        b = mk_policy(
+            "forbid (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };',
+            "p.cedar.policy0",
+            "p.cedar",
+        )
+        reg = TenantRegistry()
+        reg.add_tenant("alpha", tiers=[PolicySet([a])])
+        reg.add_tenant("beta", tiers=[PolicySet([b])])
+        eng = TPUPolicyEngine(
+            incremental=True, shard_buckets=BUCKETS, name="collide"
+        )
+        eng.load(reg.fused_tiers(), warm="off")
+        lookup = eng.compiled_set.plane.policy_shard
+        assert "alpha/p.cedar.policy0" in lookup
+        assert "beta/p.cedar.policy0" in lookup
+        assert "p.cedar.policy0" not in lookup
+        from cedar_tpu.cache.generation import ShardScopedStamp
+
+        gen = eng.plane_generation()
+        reason = json.dumps({"reasons": [{"policy": "p.cedar.policy0"}]})
+        stamp = gen.scoped(reason, tenant="alpha")
+        assert isinstance(stamp, ShardScopedStamp)
+        assert all(
+            sid.startswith("alpha/") for sid, _ in stamp.shard_gens
+        )
+        # no tenant in hand: conservative full stamp, never a wrong scope
+        assert gen.scoped(reason) is gen
+
+    def test_explain_attribution_under_colliding_ids(self):
+        """The interpreter explain walk must attribute a decision to the
+        REQUEST tenant's policy when ids collide across tenants — the
+        first id match could be a foreign clone whose effect contradicts
+        the served decision."""
+        from cedar_tpu.entities.attributes import Attributes, UserInfo
+        from cedar_tpu.explain.attribution import interpreter_explanation
+        from cedar_tpu.server.authorizer import record_to_cedar_resource
+
+        a = mk_policy(
+            "permit (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };',
+            "p.cedar.policy0",
+            "p.cedar",
+        )
+        b = mk_policy(
+            "forbid (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };',
+            "p.cedar.policy0",
+            "p.cedar",
+        )
+        reg = TenantRegistry()
+        reg.add_tenant("alpha", tiers=[PolicySet([a])])
+        reg.add_tenant("beta", tiers=[PolicySet([b])])
+        tiers = reg.fused_tiers()
+        for tenant, want_decision, want_effect in [
+            ("alpha", "allow", "permit"),
+            ("beta", "deny", "forbid"),
+        ]:
+            em, req = record_to_cedar_resource(
+                Attributes(
+                    user=UserInfo(name="u1", uid="u"),
+                    verb="get",
+                    namespace="ns",
+                    api_group="apps",
+                    api_version="v1",
+                    resource="deployments",
+                    resource_request=True,
+                    tenant=tenant,
+                )
+            )
+            decision, _diag, doc = interpreter_explanation(tiers, em, req)
+            assert decision == want_decision
+            assert doc["determining"]["effect"] == want_effect
+
+    def test_originals_never_mutated(self):
+        a = mk_policy(
+            "permit (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };',
+            "pol-a",
+            "a.cedar",
+        )
+        conds = a.conditions
+        reg = TenantRegistry()
+        reg.add_tenant("alpha", tiers=[PolicySet([a])])
+        reg.fused_tiers()
+        assert a.conditions is conds
+        assert "_cedar_tenant" not in a.__dict__
+
+
+# ------------------------------------------------- fused-plane isolation
+
+
+class TestIsolation:
+    def test_opposite_effects_on_identical_scopes(self):
+        reg = two_tenant_registry()
+        eng = TPUPolicyEngine(shard_buckets=BUCKETS, name="iso")
+        eng.load(reg.fused_tiers(), warm="off")
+        auth = CedarWebhookAuthorizer(
+            fused_tier_stores(reg),
+            evaluate=eng.evaluate,
+            evaluate_batch=eng.evaluate_batch,
+        )
+        fp = SARFastPath(eng, auth)
+        bodies = [
+            TenantBody(sar_body(), "alpha"),
+            TenantBody(sar_body(), "beta"),
+            TenantBody(sar_body(), "ghost"),
+            sar_body(),  # unstamped: matches NOTHING — fail-safe
+        ]
+        res = fp.authorize_raw(bodies)
+        assert [r[0] for r in res] == [
+            "allow", "deny", "no_opinion", "no_opinion",
+        ]
+        assert "pol-a" in res[0][1] and "pol-b" in res[1][1]
+
+    def test_differential_decisions_and_reason_sets(self):
+        """Acceptance differential: every tenant's traffic vs the fused
+        plane vs that tenant's standalone engine — identical decisions
+        AND reason sets. The corpora share the org-wide CORE_GROUPS
+        slice, which WOULD cross-match without discriminators."""
+        corpora, _live, solo, _reg, fused = corpora_and_engines()
+        checked = 0
+        for tid, corpus in corpora.items():
+            items = corpus.sar_items(64, cluster=0, seed=7)
+            want = solo[tid].evaluate_batch(items)
+            got = fused.evaluate_batch(items)
+            for (wd, wdiag), (gd, gdiag) in zip(want, got):
+                checked += 1
+                assert wd == gd
+                assert sorted(r.policy for r in wdiag.reasons) == sorted(
+                    r.policy for r in gdiag.reasons
+                )
+        assert checked == 64 * len(corpora)
+
+    def test_org_wide_slice_would_cross_match_without_discriminator(self):
+        """The differential above is only meaningful if the corpora
+        actually contain cross-tenant-matchable content: aim a request
+        at a NEIGHBOR tenant's org-wide (CORE_GROUPS) permit and show a
+        naive no-discriminator merge flips the decision the tenant's own
+        standalone engine serves."""
+        from cedar_tpu.corpus.synth import CORE_GROUPS
+        from cedar_tpu.entities.attributes import Attributes, UserInfo
+        from cedar_tpu.server.authorizer import record_to_cedar_resource
+
+        corpora = synth_tenant_corpora(120, 3, seed=5, clusters=2)
+        tids = list(corpora)
+        # find an org-wide permit in some tenant other than tenant 0
+        donor = param = None
+        for tid in tids[1:]:
+            for p in corpora[tid].params:
+                if p.cluster == -1 and p.kind in ("team", "user"):
+                    donor, param = tid, p
+                    break
+            if donor:
+                break
+        assert donor is not None, (
+            "no org-wide permit synthesized; grow n or fix the corpus"
+        )
+        assert param.group in CORE_GROUPS
+        em, req = record_to_cedar_resource(
+            Attributes(
+                user=UserInfo(
+                    name=param.user or "someone",
+                    uid="u",
+                    groups=(param.team,) if param.team else (),
+                ),
+                verb=param.verbs[0],
+                namespace=param.ns or "ns",
+                api_group=param.group,
+                api_version="v1",
+                resource=param.resource,
+                resource_request=True,
+            )
+        )
+        merged = PolicySet(
+            [p for c in corpora.values() for p in c.policies]
+        )
+        naive = TPUPolicyEngine(shard_buckets=BUCKETS, name="naive")
+        naive.load([merged], warm="off")
+        solo0 = TPUPolicyEngine(shard_buckets=BUCKETS, name="solo0")
+        solo0.load(corpora[tids[0]].tiers(), warm="off")
+        want, wdiag = solo0.evaluate(em, req)
+        got, gdiag = naive.evaluate(em, req)
+        assert (want, sorted(r.policy for r in wdiag.reasons)) != (
+            got,
+            sorted(r.policy for r in gdiag.reasons),
+        ), (
+            "the naive merge did not cross-match; the isolation "
+            "differential would be vacuous"
+        )
+
+    def test_interpreter_walk_isolates(self):
+        reg = two_tenant_registry()
+        stores = fused_tier_stores(reg)
+        from cedar_tpu.entities.attributes import Attributes, UserInfo
+        from cedar_tpu.server.authorizer import record_to_cedar_resource
+
+        def verdict(tenant):
+            em, req = record_to_cedar_resource(
+                Attributes(
+                    user=UserInfo(name="u1", uid="u"),
+                    verb="get",
+                    namespace="ns",
+                    api_group="apps",
+                    api_version="v1",
+                    resource="deployments",
+                    resource_request=True,
+                    tenant=tenant,
+                )
+            )
+            return stores.is_authorized(em, req)
+
+        assert verdict("alpha")[0] == "allow"
+        assert verdict("beta")[0] == "deny"
+        d, diag = verdict("ghost")
+        assert d == "deny" and not diag.reasons and not diag.errors
+
+    def test_explain_answers_under_the_request_tenant(self):
+        """Regression: ``?explain`` must evaluate under the SAME
+        context.tenantId the serving paths stamp from the TenantBody —
+        an unstamped explain walk fails every tenant guard and the
+        explain answer contradicts the served decision."""
+        from cedar_tpu.explain import Explainer
+
+        reg = two_tenant_registry()
+        eng = TPUPolicyEngine(shard_buckets=BUCKETS, name="exp-tenant")
+        eng.load(reg.fused_tiers(), warm="off")
+        auth = CedarWebhookAuthorizer(
+            fused_tier_stores(reg),
+            evaluate=eng.evaluate,
+            evaluate_batch=eng.evaluate_batch,
+        )
+        exp = Explainer(authorizer=auth, authz_engine=eng)
+        for body, want in [
+            (TenantBody(sar_body(), "alpha"), "allow"),
+            (TenantBody(sar_body(), "beta"), "deny"),
+            (sar_body(), "no_opinion"),  # unstamped matches NOTHING
+        ]:
+            decision, _reason, error, explanation = exp.explain_authorize(
+                body
+            )
+            assert error is None
+            assert decision == want
+            assert explanation["webhookDecision"] == want
+
+    def test_explain_admit_answers_under_the_request_tenant(self):
+        """The admission twin of the regression above: explain_admit's
+        verdict must match what the handler serves for the same
+        tenant-stamped body."""
+        from cedar_tpu.entities.admission import AdmissionRequest
+        from cedar_tpu.explain import Explainer
+
+        forbid = mk_policy(
+            "forbid (principal is k8s::User, "
+            'action == k8s::admission::Action::"create", '
+            "resource is core::v1::ConfigMap);",
+            "adm-a",
+            "adm-a.cedar",
+        )
+        permit = mk_policy(
+            "permit (principal is k8s::User, "
+            'action == k8s::admission::Action::"create", '
+            "resource is core::v1::ConfigMap);",
+            "adm-b",
+            "adm-b.cedar",
+        )
+        reg = TenantRegistry()
+        reg.add_tenant("alpha", tiers=[PolicySet([forbid])])
+        reg.add_tenant("beta", tiers=[PolicySet([permit])])
+        stores = fused_tier_stores(reg)
+        handler = CedarAdmissionHandler(stores)
+        exp = Explainer(admission_handler=handler)
+        body = json.dumps(
+            {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {
+                    "uid": "u-1",
+                    "operation": "CREATE",
+                    "userInfo": {"username": "sam", "groups": []},
+                    "kind": {
+                        "group": "", "version": "v1", "kind": "ConfigMap",
+                    },
+                    "resource": {
+                        "group": "", "version": "v1",
+                        "resource": "configmaps",
+                    },
+                    "namespace": "default",
+                    "name": "cm",
+                    "object": {
+                        "apiVersion": "v1",
+                        "kind": "ConfigMap",
+                        "metadata": {
+                            "name": "cm", "namespace": "default",
+                        },
+                    },
+                },
+            }
+        ).encode()
+        for tenant in ("alpha", "beta"):
+            tbody = TenantBody(body, tenant)
+            # the server-path answer: parse, stamp, handle
+            req = AdmissionRequest.from_admission_review(json.loads(body))
+            req.tenant = tenant
+            served = handler.handle(req)
+            resp, _explanation = exp.explain_admit(tbody)
+            assert resp.allowed == served.allowed, tenant
+        # and the guarded forbid actually discriminates: alpha denied,
+        # beta untouched — the differential above can't be vacuous
+        denied, _ = exp.explain_admit(TenantBody(body, "alpha"))
+        allowed, _ = exp.explain_admit(TenantBody(body, "beta"))
+        assert not denied.allowed and allowed.allowed
+
+    def test_unlowerable_fallback_policy_stays_tenant_scoped(self):
+        """A fallback (interpreter-evaluated) policy of tenant beta must
+        never fire for tenant alpha: the guard condition isolates the
+        policy_matches walk, and the discriminated gate rule keeps
+        alpha's rows off the gated path."""
+        a = mk_policy(
+            "permit (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };',
+            "pol-a",
+            "a.cedar",
+        )
+        # >32 literals in one clause exceeds the lowering limit
+        # (literal_limit) — an interpreter-fallback policy that MATCHES
+        conj = " && ".join(f'principal.name != "x{i}"' for i in range(40))
+        b = mk_policy(
+            "permit (principal is k8s::User, action, "
+            "resource is k8s::Resource) when { " + conj + " };",
+            "pol-b-fb",
+            "b.cedar",
+        )
+        reg = TenantRegistry()
+        reg.add_tenant("alpha", tiers=[PolicySet([a])])
+        reg.add_tenant("beta", tiers=[PolicySet([b])])
+        eng = TPUPolicyEngine(shard_buckets=BUCKETS, name="fb")
+        stats = eng.load(reg.fused_tiers(), warm="off")
+        assert stats["fallback_policies"] == 1
+        from cedar_tpu.entities.attributes import Attributes, UserInfo
+        from cedar_tpu.server.authorizer import record_to_cedar_resource
+
+        def verdict(tenant, group="apps"):
+            em, req = record_to_cedar_resource(
+                Attributes(
+                    user=UserInfo(name="u1", uid="u"),
+                    verb="get",
+                    namespace="ns",
+                    api_group=group,
+                    api_version="v1",
+                    resource="deployments",
+                    resource_request=True,
+                    tenant=tenant,
+                )
+            )
+            return eng.evaluate(em, req)
+
+        d, diag = verdict("beta")
+        assert d == "allow"
+        assert [r.policy for r in diag.reasons] == ["pol-b-fb"]
+        # alpha's answer comes from alpha's policy ONLY — beta's
+        # fallback permit must not leak in (group "core" would
+        # otherwise match the like-policy's unconstrained scope)
+        d, diag = verdict("alpha")
+        assert sorted(r.policy for r in diag.reasons) == ["pol-a"]
+        d, diag = verdict("alpha", group="")
+        assert d == "deny" and not diag.reasons
+
+
+# ------------------------------------------ tenant-scoped shard lifecycle
+
+
+class TestScopedLifecycle:
+    def test_edit_dirties_only_owning_tenant(self):
+        corpora, live, _solo, reg, fused = corpora_and_engines()
+        tids = list(corpora)
+        edit_tid = tids[1]
+        em, req = corpora[edit_tid].probe_request()
+        assert fused.evaluate(em, req)[0] == "allow"
+        live[edit_tid] = corpora[edit_tid].with_edit()
+        stats = fused.load(reg.fused_tiers(), warm="off")
+        assert stats["compile_scope"] == "incremental"
+        assert stats["dirty_shards"] == 1
+        dirty = list(fused.compiled_set.plane.dirty)
+        assert dirty and all(
+            shard_tenant(sid) == edit_tid for sid in dirty
+        )
+        assert fused.evaluate(em, req)[0] == "deny"
+        # every shard id is tenant-qualified on a fused plane
+        for sid in fused.compiled_set.plane.shard_hashes:
+            assert shard_tenant(sid) in corpora
+
+    def test_offboard_removes_tenant_from_plane(self):
+        reg = two_tenant_registry()
+        eng = TPUPolicyEngine(shard_buckets=BUCKETS, name="off")
+        eng.load(reg.fused_tiers(), warm="off")
+        assert any(
+            shard_tenant(s) == "beta"
+            for s in eng.compiled_set.plane.shard_hashes
+        )
+        reg.remove_tenant("beta")
+        eng.load(reg.fused_tiers(), warm="off")
+        assert not any(
+            shard_tenant(s) == "beta"
+            for s in eng.compiled_set.plane.shard_hashes
+        )
+
+    def test_poisoned_neighbor_chaos(self):
+        """Tenant B's shard-compile fault must not perturb tenant A's
+        answers or cache warmth (acceptance satellite). Only B's shard
+        is dirty, so the count=1 error fires exactly on B's compile."""
+        corpora, live, _solo, reg, fused = corpora_and_engines()
+        tids = list(corpora)
+        a_tid, b_tid = tids[0], tids[1]
+        stores = fused_tier_stores(reg)
+        cache = DecisionCache(
+            generation_fn=lambda: plane_composite(stores, fused)
+        )
+        authorizer = CedarWebhookAuthorizer(
+            stores,
+            evaluate=fused.evaluate,
+            evaluate_batch=fused.evaluate_batch,
+        )
+        handler = CedarAdmissionHandler(stores)
+        server = WebhookServer(authorizer, handler, decision_cache=cache)
+
+        a_em, a_req = corpora[a_tid].probe_request()
+        a_before = fused.evaluate(a_em, a_req)
+        a_body = TenantBody(
+            sar_body(
+                user=PROBE_USER,
+                group=f"platform.{a_tid}.c0.corp",
+                resource=PROBE_RESOURCE,
+            ),
+            a_tid,
+        )
+        resp_a = server.handle_authorize(a_body)
+        assert resp_a["status"]["allowed"]
+
+        live[b_tid] = corpora[b_tid].with_edit()
+        r = default_registry()
+        r.configure(
+            {
+                "faults": [
+                    {
+                        "seam": "engine.shard_compile",
+                        "kind": "error",
+                        "count": 1,
+                    }
+                ]
+            }
+        )
+        r.arm()
+        with pytest.raises(ChaosError):
+            fused.load(reg.fused_tiers(), warm="off")
+        r.disarm()
+        # A's answers are untouched and its cache entry still warm
+        assert fused.evaluate(a_em, a_req) == a_before
+        h0 = cache.stats()["hits"]
+        assert server.handle_authorize(a_body) == resp_a
+        assert cache.stats()["hits"] == h0 + 1
+        # the clean retry lands B's edit; A stays warm THROUGH it
+        stats = fused.load(reg.fused_tiers(), warm="off")
+        assert stats["dirty_shards"] == 1
+        assert all(
+            shard_tenant(s) == b_tid
+            for s in fused.compiled_set.plane.dirty
+        )
+        assert server.handle_authorize(a_body) == resp_a
+        assert cache.stats()["hits"] == h0 + 2
+
+    def test_neighbor_edit_leaves_scoped_cache_entries_warm(self):
+        corpora, live, _solo, reg, fused = corpora_and_engines()
+        tids = list(corpora)
+        a_tid, b_tid = tids[0], tids[2]
+        stores = fused_tier_stores(reg)
+        cache = DecisionCache(
+            generation_fn=lambda: plane_composite(stores, fused)
+        )
+        authorizer = CedarWebhookAuthorizer(
+            stores,
+            evaluate=fused.evaluate,
+            evaluate_batch=fused.evaluate_batch,
+        )
+        server = WebhookServer(
+            authorizer, CedarAdmissionHandler(stores), decision_cache=cache
+        )
+        a_body = TenantBody(
+            sar_body(
+                user=PROBE_USER,
+                group=f"platform.{a_tid}.c0.corp",
+                resource=PROBE_RESOURCE,
+            ),
+            a_tid,
+        )
+        b_body = TenantBody(
+            sar_body(
+                user=PROBE_USER,
+                group=f"platform.{b_tid}.c0.corp",
+                resource=PROBE_RESOURCE,
+            ),
+            b_tid,
+        )
+        resp_a = server.handle_authorize(a_body)
+        resp_b = server.handle_authorize(b_body)
+        assert resp_a["status"]["allowed"] and resp_b["status"]["allowed"]
+        live[b_tid] = corpora[b_tid].with_edit()
+        fused.load(reg.fused_tiers(), warm="off")
+        h0, m0 = cache.stats()["hits"], cache.stats()["misses"]
+        resp_a2 = server.handle_authorize(a_body)
+        h1, m1 = cache.stats()["hits"], cache.stats()["misses"]
+        assert (h1 - h0, m1 - m0) == (1, 0), "tenant A must stay warm"
+        assert resp_a2 == resp_a
+        resp_b2 = server.handle_authorize(b_body)
+        _, m2 = cache.stats()["hits"], cache.stats()["misses"]
+        assert m2 - m1 == 1, "tenant B's entry must die"
+        assert not resp_b2["status"]["allowed"]
+
+
+# --------------------------------------------------------------- frontend
+
+
+class TestFrontend:
+    def test_resolution_order_and_stripping(self):
+        reg = two_tenant_registry()
+        res = TenantResolver(
+            reg, hosts={"alpha.cedar.corp": "alpha"}, default=None
+        )
+        t, path, why = res.resolve("/t/alpha/v1/authorize", {})
+        assert (t, path, why) == ("alpha", "/v1/authorize", "path")
+        t, path, why = res.resolve(
+            "/v1/authorize", {"x-cedar-tenant": "beta"}
+        )
+        assert (t, why) == ("beta", "header")
+        t, _, why = res.resolve(
+            "/v1/authorize", {}, host="ALPHA.cedar.corp:8443"
+        )
+        assert (t, why) == ("alpha", "host")
+        t, _, why = res.resolve("/v1/authorize", {})
+        assert (t, why) == (None, "missing")
+        t, _, why = res.resolve("/t/ghost/v1/authorize", {})
+        assert (t, why) == (None, "unknown")
+
+    def test_restricted_sources_ignore_client_supplied(self):
+        """--tenant-sources host: path and header are client-supplied
+        and must not resolve (cross-tenant impersonation guard,
+        docs/multitenancy.md routing trust)."""
+        reg = two_tenant_registry()
+        res = TenantResolver(
+            reg, hosts={"alpha.cedar.corp": "alpha"}, sources=("host",)
+        )
+        t, _, why = res.resolve("/t/beta/v1/authorize", {})
+        assert (t, why) == (None, "missing")
+        t, _, why = res.resolve(
+            "/v1/authorize", {"x-cedar-tenant": "beta"}
+        )
+        assert (t, why) == (None, "missing")
+        t, _, why = res.resolve(
+            "/v1/authorize", {}, host="alpha.cedar.corp"
+        )
+        assert (t, why) == ("alpha", "host")
+        with pytest.raises(ValueError):
+            TenantResolver(reg, sources=("path", "bogus"))
+
+    def test_conflicting_sources_rejected(self):
+        """A host-mapped request whose client-supplied path or header
+        names a DIFFERENT tenant is refused — the client source must
+        never override the operator-configured route."""
+        reg = two_tenant_registry()
+        res = TenantResolver(reg, hosts={"alpha.cedar.corp": "alpha"})
+        t, _, why = res.resolve(
+            "/t/beta/v1/authorize", {}, host="alpha.cedar.corp"
+        )
+        assert (t, why) == (None, "conflict")
+        t, _, why = res.resolve(
+            "/v1/authorize",
+            {"x-cedar-tenant": "beta"},
+            host="alpha.cedar.corp",
+        )
+        assert (t, why) == (None, "conflict")
+        # agreeing sources are fine
+        t, _, why = res.resolve(
+            "/t/alpha/v1/authorize", {}, host="alpha.cedar.corp"
+        )
+        assert (t, why) == ("alpha", "path")
+
+    def test_ipv6_host_resolution(self):
+        """A bracketed IPv6 Host without a port ends in ']' and must not
+        lose its tail to the :port strip — with and without a port both
+        resolve to the registered tenant."""
+        reg = two_tenant_registry()
+        res = TenantResolver(reg, hosts={"[::1]": "alpha"})
+        t, _, why = res.resolve("/v1/authorize", {}, host="[::1]")
+        assert (t, why) == ("alpha", "host")
+        t, _, why = res.resolve("/v1/authorize", {}, host="[::1]:8443")
+        assert (t, why) == ("alpha", "host")
+
+    def test_default_tenant(self):
+        reg = two_tenant_registry()
+        res = TenantResolver(reg, default="alpha")
+        t, _, why = res.resolve("/v1/authorize", {})
+        assert (t, why) == ("alpha", "default")
+
+    def test_tenant_body_rides_bytes(self):
+        b = TenantBody(b'{"x":1}', "alpha")
+        assert bytes(b) == b'{"x":1}' and b.tenant == "alpha"
+        assert json.loads(b) == {"x": 1}
+
+    def test_fingerprints_are_tenant_scoped(self):
+        from cedar_tpu.cache.fingerprint import (
+            FingerprintMemo,
+            fingerprint_body,
+        )
+
+        raw = sar_body()
+        fp_a = fingerprint_body("authorize", TenantBody(raw, "alpha"))
+        fp_b = fingerprint_body("authorize", TenantBody(raw, "beta"))
+        fp_plain = fingerprint_body("authorize", raw)
+        assert len({fp_a, fp_b, fp_plain}) == 3
+        memo = FingerprintMemo()
+        assert memo.fingerprint("authorize", TenantBody(raw, "alpha")) == fp_a
+        assert memo.fingerprint("authorize", TenantBody(raw, "beta")) == fp_b
+
+    def test_http_end_to_end_with_tenancy(self):
+        """Path-routed tenants over real HTTP: opposite decisions for
+        the same body, unknown tenant refused, /debug/tenancy serves,
+        /debug/engine carries the per-tenant shard rollup."""
+        import urllib.request
+
+        reg = two_tenant_registry()
+        stores = fused_tier_stores(reg)
+        eng = TPUPolicyEngine(shard_buckets=BUCKETS, name="e2e")
+        eng.load(reg.fused_tiers(), warm="off")
+        authorizer = CedarWebhookAuthorizer(
+            stores, evaluate=eng.evaluate, evaluate_batch=eng.evaluate_batch
+        )
+        fp = SARFastPath(eng, authorizer)
+        server = WebhookServer(
+            authorizer,
+            CedarAdmissionHandler(stores),
+            fastpath=fp,
+            port=0,
+            metrics_port=0,
+            tenancy=TenantResolver(reg),
+        )
+        server.start()
+        try:
+            from tests.test_server import post
+
+            doc = json.loads(sar_body())
+            resp = post(
+                server.bound_port, "/t/alpha/v1/authorize", doc
+            )
+            assert resp["status"]["allowed"] is True
+            resp = post(server.bound_port, "/t/beta/v1/authorize", doc)
+            assert resp["status"]["denied"] is True
+            resp = post(server.bound_port, "/t/ghost/v1/authorize", doc)
+            assert resp["status"]["allowed"] is False
+            assert "tenant rejected" in resp["status"]["evaluationError"]
+            resp = post(server.bound_port, "/v1/authorize", doc)
+            assert "tenant rejected" in resp["status"]["evaluationError"]
+            mport = server.bound_metrics_port
+            ten = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/debug/tenancy", timeout=5
+                ).read()
+            )
+            assert ten["registry"]["tenants"] == 2
+            edoc = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/debug/engine", timeout=5
+                ).read()
+            )
+            shards = edoc["authorization"]["engine"]["shards"]
+            assert set(shards["tenants"]) == {"alpha", "beta"}
+            scrape = (
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/metrics", timeout=5
+                )
+                .read()
+                .decode()
+            )
+            assert 'cedar_tenant_requests_total{tenant="alpha"' in scrape
+            assert 'cedar_tenant_rejected_total{reason="unknown"}' in scrape
+        finally:
+            server.stop()
+
+    def test_header_resolution_over_http(self):
+        import urllib.request
+
+        reg = two_tenant_registry()
+        stores = fused_tier_stores(reg)
+        eng = TPUPolicyEngine(shard_buckets=BUCKETS, name="hdr")
+        eng.load(reg.fused_tiers(), warm="off")
+        authorizer = CedarWebhookAuthorizer(
+            stores, evaluate=eng.evaluate, evaluate_batch=eng.evaluate_batch
+        )
+        server = WebhookServer(
+            authorizer,
+            CedarAdmissionHandler(stores),
+            fastpath=SARFastPath(eng, authorizer),
+            port=0,
+            metrics_port=0,
+            tenancy=TenantResolver(reg),
+        )
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.bound_port}/v1/authorize",
+                data=sar_body(),
+                headers={
+                    "Content-Type": "application/json",
+                    "X-Cedar-Tenant": "beta",
+                },
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                doc = json.loads(resp.read())
+            assert doc["status"]["denied"] is True
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------------ corpus generator
+
+
+class TestTenantCorpora:
+    def test_deterministic_and_derived_seeds(self):
+        a = synth_tenant_corpora(40, 3, seed=9)
+        b = synth_tenant_corpora(40, 3, seed=9)
+        from cedar_tpu.lang.format import format_policy
+
+        for tid in a:
+            assert [format_policy(p) for p in a[tid].policies] == [
+                format_policy(p) for p in b[tid].policies
+            ]
+        # per-tenant derived seeds: tenants differ from each other
+        t0, t1 = list(a)[:2]
+        assert a[t0].seed != a[t1].seed
+
+    def test_disjoint_universes_and_shared_org_slice(self):
+        corpora = synth_tenant_corpora(60, 3, seed=9)
+        from cedar_tpu.corpus.synth import CORE_GROUPS
+
+        locals_by_tenant = {}
+        org_wide = set()
+        for tid, c in corpora.items():
+            groups = {p.group for p in c.params}
+            locals_by_tenant[tid] = {
+                g for g in groups if g not in CORE_GROUPS
+            }
+            org_wide |= groups & set(CORE_GROUPS)
+        tids = list(corpora)
+        for i, t1 in enumerate(tids):
+            for t2 in tids[i + 1:]:
+                assert not (
+                    locals_by_tenant[t1] & locals_by_tenant[t2]
+                ), "cluster-local apiGroups must be disjoint per tenant"
+        assert org_wide, "the shared org-wide slice must exist"
+
+    def test_ids_are_tenant_prefixed(self):
+        corpora = synth_tenant_corpora(10, 2, seed=9)
+        for tid, c in corpora.items():
+            for p in c.policies:
+                assert p.policy_id.startswith(tid)
+                assert p.filename.startswith(tid)
+
+
+# --------------------------------------------- fallback burn-down counter
+
+
+class TestFallbackBurnDown:
+    def test_counter_and_debug_engine_tally(self):
+        from cedar_tpu.server import metrics as m
+
+        from cedar_tpu.stores.store import MemoryStore
+
+        store = MemoryStore(
+            "fb",
+            PolicySet(
+                [
+                    mk_policy(
+                        "permit (principal is k8s::User, action, "
+                        "resource is k8s::Resource) when { "
+                        + " && ".join(
+                            f'principal.name != "x{i}"' for i in range(40)
+                        )
+                        + " };",
+                        "pol-fb",
+                        "fb.cedar",
+                    )
+                ]
+            ),
+        )
+        stores = TieredPolicyStores([store])
+        eng = TPUPolicyEngine(shard_buckets=BUCKETS, name="bd")
+        eng.load([store.policy_set()], warm="off")
+        assert eng.compiled_set.packed.fallback_codes
+        before = m.fallback_decision_counts()
+        from cedar_tpu.entities.attributes import Attributes, UserInfo
+        from cedar_tpu.server.authorizer import record_to_cedar_resource
+
+        em, req = record_to_cedar_resource(
+            Attributes(
+                user=UserInfo(name="u1", uid="u"),
+                verb="get",
+                namespace="ns",
+                api_group="apps",
+                api_version="v1",
+                resource="pods",
+                resource_request=True,
+            )
+        )
+        assert eng.evaluate(em, req)[0] == "allow"
+        after = m.fallback_decision_counts()
+        code = eng.compiled_set.packed.fallback_codes[0]
+        assert after.get(code, 0) == before.get(code, 0) + 1
+        # /debug/engine surfaces the tally
+        authorizer = CedarWebhookAuthorizer(
+            stores, evaluate=eng.evaluate, evaluate_batch=eng.evaluate_batch
+        )
+        fp = SARFastPath(eng, authorizer)
+        server = WebhookServer(
+            authorizer,
+            CedarAdmissionHandler(stores),
+            fastpath=fp,
+            port=0,
+            metrics_port=0,
+        )
+        server.start()
+        try:
+            import urllib.request
+
+            doc = json.loads(
+                urllib.request.urlopen(
+                    "http://127.0.0.1:"
+                    f"{server.bound_metrics_port}/debug/engine",
+                    timeout=5,
+                ).read()
+            )
+            fb = doc["authorization"]["engine"]["fallback"]
+            assert fb["policies"] == 1
+            assert code in fb["codes"]
+            assert fb["served_decisions"].get(code, 0) >= 1
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------------------ CLI wiring
+
+
+class TestCLI:
+    def test_build_server_with_tenant_flags(self, tmp_path):
+        """--tenant NAME=DIR (x2) fuses two directory stores into one
+        engine; requests route by path prefix over real HTTP and answer
+        from the right tenant's slice only."""
+        import time as _time
+
+        from cedar_tpu.cli.webhook import build_server, make_parser
+        from tests.test_server import post
+
+        a_dir = tmp_path / "alpha"
+        b_dir = tmp_path / "beta"
+        a_dir.mkdir()
+        b_dir.mkdir()
+        (a_dir / "p.cedar").write_text(
+            "permit (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };'
+        )
+        (b_dir / "p.cedar").write_text(
+            "forbid (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };'
+        )
+        args = make_parser().parse_args(
+            [
+                "--tenant", f"alpha={a_dir}",
+                "--tenant", f"beta={b_dir}",
+                "--backend", "tpu",
+                "--insecure",
+                "--secure-port", "0",
+                "--metrics-port", "0",
+            ]
+        )
+        server = build_server(args)
+        assert server.tenancy is not None
+        server.start()
+        try:
+            doc = json.loads(sar_body())
+            deadline = _time.time() + 15
+            resp = None
+            while _time.time() < deadline:
+                resp = post(server.bound_port, "/t/alpha/v1/authorize", doc)
+                if resp["status"]["allowed"]:
+                    break
+                _time.sleep(0.2)
+            assert resp["status"]["allowed"] is True
+            resp = post(server.bound_port, "/t/beta/v1/authorize", doc)
+            assert resp["status"]["denied"] is True
+            resp = post(server.bound_port, "/t/nobody/v1/authorize", doc)
+            assert "tenant rejected" in resp["status"]["evaluationError"]
+        finally:
+            server.stop()
+
+    def test_bad_tenant_flag_rejected(self):
+        from cedar_tpu.cli.webhook import build_server, make_parser
+
+        args = make_parser().parse_args(
+            ["--tenant", "no-equals-sign", "--backend", "interpreter"]
+        )
+        with pytest.raises(ValueError):
+            build_server(args)
+
+    def test_recording_refused_with_tenants(self, tmp_path):
+        """Recorded bodies lose the TenantBody stamp on disk, so
+        fused-plane recordings could never replay — refused at startup
+        (docs/multitenancy.md)."""
+        from cedar_tpu.cli.webhook import build_server, make_parser
+
+        a_dir = tmp_path / "alpha"
+        a_dir.mkdir()
+        (a_dir / "p.cedar").write_text(
+            "permit (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };'
+        )
+        args = make_parser().parse_args(
+            [
+                "--tenant", f"alpha={a_dir}",
+                "--backend", "interpreter",
+                "--enable-recording",
+                "--recording-dir", str(tmp_path / "rec"),
+                "--insecure",
+                "--secure-port", "0",
+                "--metrics-port", "0",
+            ]
+        )
+        with pytest.raises(ValueError, match="enable-recording"):
+            build_server(args)
+
+    def test_rollout_refused_with_tenants(self, tmp_path):
+        """A candidate engine carries no tenant guards — shadow diffs on
+        a fused plane would be vacuous, so the combination is refused at
+        startup (docs/multitenancy.md)."""
+        from cedar_tpu.cli.webhook import build_server, make_parser
+
+        a_dir = tmp_path / "alpha"
+        a_dir.mkdir()
+        (a_dir / "p.cedar").write_text(
+            "permit (principal, action, resource is k8s::Resource) when "
+            '{ resource.apiGroup == "apps" };'
+        )
+        args = make_parser().parse_args(
+            [
+                "--tenant", f"alpha={a_dir}",
+                "--backend", "interpreter",
+                "--rollout-candidate-dir", str(tmp_path / "cand"),
+                "--insecure",
+                "--secure-port", "0",
+                "--metrics-port", "0",
+            ]
+        )
+        with pytest.raises(ValueError, match="rollout"):
+            build_server(args)
