@@ -2994,6 +2994,699 @@ def run_tenants_scenario() -> int:
     return 0 if ok else 1
 
 
+def run_storm_scenario() -> int:
+    """``bench.py --storm`` (``make bench-storm``): the open-loop overload
+    harness for the admission-control plane (cedar_tpu/load,
+    docs/performance.md "Serving under overload").
+
+    Every other bench is closed-loop — offered load can never exceed
+    capacity, so nothing is ever refused. This one drives seeded OPEN-LOOP
+    arrival processes (Poisson sustained overload + controller-hot-loop
+    bursts + a node-reconnect flash crowd, Zipf-skewed principals, mixed
+    SAR / admission / explain traffic) against one in-process
+    WebhookServer with the real serving stack, a deterministic
+    device-dispatch floor (chaos ``engine.dispatch`` latency seam — the
+    cpu backend alone is far too fast to overdrive from a python driver,
+    and the floor makes measured capacity reproducible), a wired
+    AdmissionController, and a started SLO-adaptive batch tuner.
+
+    Phases and gates (rc 0 iff all hold):
+      1. capacity probe — closed-loop saturation over the floored stack;
+         the storm rate is 5x this measured number, never a guess.
+      2. no-overload parity — the SAME polite stream through the gate-on
+         and gate-off paths: byte-identical decisions, zero sheds, and
+         median throughput delta inside max(2x noise floor, 5%) (the
+         chaos-differential protocol).
+      3. 5x sustained storm — high-priority availability >= 99.9%,
+         high-priority p99 of served answers within the request budget,
+         shed accounting EXACT (offered == admitted + shed at the gate,
+         and the driver's observed shed answers == gate sheds + eval
+         sheds), >= 1 logged adaptive-tuner move, and the device breaker
+         CLOSED at the end (queue-burned deadline expiries must not trip
+         it — the shedder, not the breaker, owns overload).
+
+    The 5x-overdrive gate follows bench-fanout's honest-host posture: the
+    achieved factor is always REPORTED, but only gated on hosts with >= 4
+    cores (below that the python driver time-shares the serving stack's
+    cores and the number measures GIL scheduling, not offered load);
+    CEDAR_BENCH_STORM_OVERDRIVE forces a gate anywhere. cpu-only BY
+    DESIGN: every claim is about the overload-control execution model,
+    not device speed."""
+    import threading
+    from bisect import bisect_left
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+
+    from cedar_tpu.chaos import default_registry
+    from cedar_tpu.engine.breaker import CLOSED, CircuitBreaker
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.engine.fastpath import SARFastPath
+    from cedar_tpu.load import (
+        AdaptiveBatchTuner,
+        AdmissionController,
+        TuningBounds,
+        burst_schedule,
+        flash_crowd_schedule,
+        poisson_schedule,
+    )
+    from cedar_tpu.obs.slo import SLOTracker
+    from cedar_tpu.server.admission import (
+        CedarAdmissionHandler,
+        allow_all_admission_policy_store,
+    )
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.server.http import WebhookServer
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    t_start = time.time()
+    cores = os.cpu_count() or 1
+
+    # ------------------------------------------------------- serving stack
+    # budget/knob constants: the request budget is the apiserver-webhook
+    # deadline the p99 gate measures against; the SLO latency budget is
+    # deliberately tighter so the latency objective starts burning (and
+    # the tuner starts moving) well before requests actually die. Sizing
+    # is coupled: at full saturation the batcher's worst queue wait is
+    # ~ MAX_INFLIGHT / capacity (capacity ~ HOME_BATCH / FLOOR_S), and
+    # that wait must sit well inside BUDGET_S or high-priority traffic
+    # dies of deadline expiry instead of being served — the exact failure
+    # the admission controller exists to prevent. 64/~350rps gives ~0.18s
+    # worst-case wait: a shared host's effective capacity can sag ~5x
+    # mid-run (cgroup shares, noisy neighbors) before the budget breaks.
+    BUDGET_S = 1.0
+    SLO_BUDGET_S = 0.15
+    FLOOR_S = 0.02  # per-dispatch device floor => capacity ~ batch/floor
+    HOME_BATCH = 8
+    HOME_LINGER_S = 0.001
+    MAX_INFLIGHT = 64
+
+    rng = random.Random(14)
+    users = [f"controller-{i}" for i in range(48)]
+    resources = ["pods", "services", "secrets", "configmaps", "nodes"]
+    verbs = ["get", "list", "watch", "create"]
+    pols = []
+    for _ in range(_n(200, 50)):
+        pols.append(
+            f'permit (principal, action == k8s::Action::"{rng.choice(verbs)}", '
+            "resource is k8s::Resource) when { "
+            f'principal.name == "{rng.choice(users)}" && '
+            f'resource.resource == "{rng.choice(resources)}" }};'
+        )
+    # kubelets read their own node objects: give high-priority traffic a
+    # real allow path so its decisions exercise the full plane (explicit
+    # EQ per node, not `like` — a wildcard would lower differently and
+    # change the capacity model this bench pins)
+    for n in range(16):
+        pols.append(
+            'permit (principal, action in [k8s::Action::"get", '
+            'k8s::Action::"list"], resource is k8s::Resource) when { '
+            f'principal.name == "system:node:node-{n}" && '
+            'resource.resource == "nodes" };'
+        )
+    src = "\n".join(pols)
+    stores = TieredPolicyStores([MemoryStore.from_source("storm", src)])
+    adm_stores = TieredPolicyStores(
+        [
+            MemoryStore.from_source("storm", src),
+            allow_all_admission_policy_store(),
+        ]
+    )
+    engine = TPUPolicyEngine(name="authorization")
+    engine.load([s.policy_set() for s in stores], warm="off")
+    # synchronous warmup BEFORE any request: a first-dispatch XLA compile
+    # takes seconds, which burns that batch's whole deadline budget in the
+    # DISPATCH stage — five in a row trips the breaker and the rest of the
+    # bench measures the interpreter instead of the floored device plane
+    engine.warmup(max_batch=64)
+    breaker = CircuitBreaker(
+        name="authorization", failure_threshold=5, recovery_s=0.5
+    )
+    authorizer = CedarWebhookAuthorizer(stores)
+    fastpath = SARFastPath(engine, authorizer, breaker=breaker)
+    slo = SLOTracker(latency_budget_s=SLO_BUDGET_S)
+    server = WebhookServer(
+        authorizer,
+        CedarAdmissionHandler(adm_stores),
+        fastpath=fastpath,
+        pipeline_depth=2,
+        max_batch=HOME_BATCH,
+        batch_window_s=HOME_LINGER_S,
+        request_timeout_s=BUDGET_S,
+        slo=slo,
+    )
+
+    # deterministic device-dispatch floor (module docstring): every
+    # fastpath batch dispatch pays FLOOR_S, so capacity ~ batch/floor and
+    # the 5x storm rate is reachable from a python driver
+    registry = default_registry()
+    registry.reset()
+    registry.configure(
+        {
+            "name": "storm-floor",
+            "seed": 14,
+            "faults": [
+                {"seam": "engine.dispatch", "kind": "latency",
+                 "delay_s": FLOOR_S},
+            ],
+        }
+    )
+    registry.arm()
+
+    # ------------------------------------------------------ traffic makers
+    # Zipf(1.1) principal skew (the cache bench's apiserver shape) with
+    # the PR 11 derived-stream pattern: every draw is a pure function of
+    # (stream, i), so schedules and bodies replay bit-for-bit
+    zipf_w = [1.0 / (r + 1) ** 1.1 for r in range(len(users))]
+    zipf_cum, acc = [], 0.0
+    for w in zipf_w:
+        acc += w
+        zipf_cum.append(acc)
+
+    def zipf_user(stream: str, i: int) -> str:
+        x = random.Random(f"storm:{stream}:{i}").random() * zipf_cum[-1]
+        return users[min(len(users) - 1, bisect_left(zipf_cum, x))]
+
+    def sar_body(user: str, resource: str, verb: str) -> bytes:
+        return json.dumps(
+            {
+                "apiVersion": "authorization.k8s.io/v1",
+                "kind": "SubjectAccessReview",
+                "spec": {
+                    "user": user,
+                    "uid": "u",
+                    "groups": [],
+                    "resourceAttributes": {
+                        "verb": verb,
+                        "version": "v1",
+                        "resource": resource,
+                        "namespace": "default",
+                    },
+                },
+            }
+        ).encode()
+
+    def high_body(i: int) -> bytes:
+        r = random.Random(f"storm:high:{i}")
+        return sar_body(
+            f"system:node:node-{r.randrange(16)}", "nodes",
+            r.choice(["get", "list"]),
+        )
+
+    def normal_body(stream: str, i: int) -> bytes:
+        r = random.Random(f"storm:norm:{stream}:{i}")
+        return sar_body(
+            zipf_user(stream, i), r.choice(resources), r.choice(verbs)
+        )
+
+    def adm_body(stream: str, i: int) -> bytes:
+        return json.dumps(
+            {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {
+                    "uid": f"storm-{stream}-{i}",
+                    "operation": "CREATE",
+                    "userInfo": {
+                        "username": zipf_user(f"adm:{stream}", i),
+                        "groups": [],
+                    },
+                    "kind": {
+                        "group": "", "version": "v1", "kind": "ConfigMap",
+                    },
+                    "resource": {
+                        "group": "", "version": "v1",
+                        "resource": "configmaps",
+                    },
+                    "namespace": "default",
+                    "name": f"c-{i}",
+                    "object": {
+                        "apiVersion": "v1",
+                        "kind": "ConfigMap",
+                        "metadata": {
+                            "name": f"c-{i}", "namespace": "default",
+                        },
+                    },
+                },
+            }
+        ).encode()
+
+    # mix: kubelet/system SARs (high), controller SARs + admission reviews
+    # (normal), explain requests (sheddable). High is a MINORITY of the
+    # offered storm (0.04 x 5x = 0.2x measured capacity — kubelets are a
+    # small constant slice of real webhook traffic) — the gate reserves
+    # the load band above shed_normal_at for exactly this sliver, and the
+    # availability gate proves the reservation holds even when a shared
+    # host's effective capacity sags mid-run
+    MIX = (("high", 0.04), ("adm", 0.15), ("explain", 0.12), ("norm", 0.69))
+
+    def mk_item(stream: str, i: int):
+        """(kind, body, explain) for the i-th arrival of a stream."""
+        x = random.Random(f"storm:kind:{stream}:{i}").random()
+        for kind, frac in MIX:
+            if x < frac:
+                break
+            x -= frac
+        else:
+            kind = "norm"
+        if kind == "high":
+            return ("high", high_body(i), False)
+        if kind == "adm":
+            return ("adm", adm_body(stream, i), False)
+        if kind == "explain":
+            return ("explain", normal_body(f"x:{stream}", i), True)
+        return ("norm", normal_body(stream, i), False)
+
+    # --------------------------------------------------------- drive logic
+
+    def fire(item, gated: bool, canon: bool = False):
+        """One request through the in-process serving entry; returns
+        (kind, ok, shed, latency_s, canonical_json_or_None). ``canon``
+        renders the response canonically for the byte differential — the
+        parity phase only; the storm driver skips the dump (it would be
+        pure GIL cost at thousands of fires/second)."""
+        kind, body, explain = item
+        t = time.monotonic()
+        try:
+            if kind == "adm":
+                doc = (
+                    server.serve_admit(body)
+                    if gated
+                    else server.handle_admit(body)
+                )
+            else:
+                doc = (
+                    server.serve_authorize(body, explain=explain)
+                    if gated
+                    else server.handle_authorize(body, explain=explain)
+                )
+        except Exception as e:  # noqa: BLE001 — an escaping error = down
+            return kind, False, False, time.monotonic() - t, f"error:{e}"
+        lat = time.monotonic() - t
+        if kind == "adm":
+            # a real admission DECISION (allow or deny) is available; only
+            # error-shaped answers (code 500: sheds, deadline fail-mode,
+            # evaluator errors) count against availability
+            status = ((doc.get("response") or {}).get("status") or {})
+            msg = status.get("message") or ""
+            shed = "shed under overload" in msg
+            ok = not shed and status.get("code") != 500
+        else:
+            msg = (doc.get("status") or {}).get("evaluationError") or ""
+            shed = "shed under overload" in msg
+            ok = not msg
+        return (
+            kind, ok, shed, lat,
+            json.dumps(doc, sort_keys=True) if canon else None,
+        )
+
+    def closed_loop(items, threads: int, gated: bool, canon: bool = False):
+        """Fixed-concurrency closed-loop drive; returns (results in item
+        order, elapsed_s)."""
+        out = [None] * len(items)
+        it = iter(range(len(items)))
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    i = next(it, None)
+                if i is None:
+                    return
+                out[i] = fire(items[i], gated, canon)
+
+        ts = [threading.Thread(target=worker) for _ in range(threads)]
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return out, time.monotonic() - t0
+
+    def open_loop(schedule, items, workers: int):
+        """THE storm driver: fire items[i] at schedule[i] seconds from
+        stream start and never wait for answers — offered load is the
+        schedule's, not the server's. ``workers`` must comfortably exceed
+        max_inflight + the shed-render concurrency: a too-small pool
+        queues arrivals INSIDE the executor and silently turns the storm
+        closed-loop (the smoke run that motivated this comment shed
+        nothing at 5x overload). Returns (results, achieved_rate,
+        wall_s, drive_lag_p99_ms)."""
+        out = [None] * len(items)
+        lags = []
+
+        def one(i):
+            out[i] = fire(items[i], gated=True)
+
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            t0 = time.monotonic()
+            for i, due in enumerate(schedule):
+                now = time.monotonic() - t0
+                if due > now:
+                    time.sleep(due - now)
+                    now = due
+                lags.append(max(0.0, now - due))
+                ex.submit(one, i)
+            submit_span = time.monotonic() - t0
+        wall = time.monotonic() - t0  # includes the post-schedule drain
+        lags.sort()
+        lag_p99 = lags[min(len(lags) - 1, int(len(lags) * 0.99))] if lags else 0.0
+        return (
+            out, len(items) / max(1e-9, submit_span), wall, lag_p99 * 1e3,
+        )
+
+    # warm every serving shape + the lazy explain plane outside timing
+    warm_items = [mk_item("warm", i) for i in range(_n(96, 32))]
+    closed_loop(warm_items, 8, gated=False)
+    server.handle_authorize(normal_body("warmx", 0), explain=True)
+
+    # ------------------------------------------------- phase 1: capacity
+    probe_items = [("norm", normal_body("probe", i), False)
+                   for i in range(_n(1400, 320))]
+    _, probe_s = closed_loop(probe_items, 32, gated=False)
+    capacity = len(probe_items) / probe_s
+
+    # ------------------------------------- phase 2: no-overload parity
+    # the gate-enabled-but-idle differential: a POLITE stream (inflight
+    # far below the pressure threshold) must be answered byte-identically
+    # with the gate on and off, at a throughput delta inside the noise
+    # floor — admission control must cost nothing until it acts. Explain
+    # traffic is excluded: it is sheddable at *pressure*, and this phase
+    # asserts zero sheds.
+    parity_items = []
+    for i in range(_n(1000, 260)):
+        item = mk_item("parity", i)
+        if item[0] == "explain":
+            item = ("norm", normal_body("parity2", i), False)
+        parity_items.append(item)
+    ctrl_parity = AdmissionController(max_inflight=MAX_INFLIGHT)
+    server.load = ctrl_parity
+    r_on, _ = closed_loop(parity_items, 4, gated=True, canon=True)
+    server.load = None
+    r_off, _ = closed_loop(parity_items, 4, gated=False, canon=True)
+    parity_identical = [r[4] for r in r_on] == [r[4] for r in r_off]
+    parity_stats = ctrl_parity.stats()
+    parity_no_sheds = parity_stats["shed"] == 0 and parity_stats[
+        "eval_shed"
+    ] == 0
+
+    # Timing protocol: alternating off/on pairs, BEST-of-N per side. The
+    # closed-loop driver is lockstep — all 4 threads finish a batch
+    # together and resubmit inside the linger window, so batches stay
+    # full — and a scheduling hiccup in the first rounds can split them
+    # into two phase-locked groups the 1ms linger never re-merges across
+    # the 20ms floor: a metastable halved-throughput mode that is an
+    # artifact of the synchronized driver + deterministic floor, not a
+    # cost of the gate (open-loop arrivals have no lockstep to lose; the
+    # probe that motivated this comment measured the gate at ~1% in the
+    # merged mode and +70% whenever a run started split, on EITHER
+    # side). Best-of-N measures the intrinsic per-request cost: it
+    # filters the split mode and background scheduler noise
+    # symmetrically from both sides.
+    w_offs, w_ons = [], []
+    for _ in range(4):
+        server.load = None
+        _, w_off = closed_loop(parity_items, 4, gated=False)
+        server.load = AdmissionController(max_inflight=MAX_INFLIGHT)
+        _, w_on = closed_loop(parity_items, 4, gated=True)
+        w_offs.append(w_off)
+        w_ons.append(w_on)
+    server.load = None
+    parity_overhead = min(w_ons) / min(w_offs) - 1.0
+    parity_noise = max(w_offs) / min(w_offs) - 1.0
+    tput_delta_max = float(
+        os.environ.get("CEDAR_BENCH_STORM_TPUT_DELTA", "0.05")
+    )
+    parity_tput_ok = parity_overhead <= max(2.0 * parity_noise,
+                                            tput_delta_max)
+
+    # ----------------------------------------------- phase 3: the storm
+    STORM_X = 5.0
+    duration = _n(8.0, 3.0)
+    storm_rate = STORM_X * capacity
+    sched = list(poisson_schedule(storm_rate, duration, seed="storm:base"))
+    n_base = len(sched)
+    # controller hot loop: square-wave bursts of one hot client on top
+    burst = burst_schedule(
+        0.0, capacity * 1.0, period_s=2.0, duty=0.25,
+        duration_s=duration, seed="storm:burst",
+    )
+    # node-reconnect flash crowd: a mid-storm relist ramp
+    flash = flash_crowd_schedule(
+        0.0, capacity * 2.0, at_s=duration * 0.4,
+        ramp_s=duration * 0.12, duration_s=duration, seed="storm:flash",
+    )
+    items = [mk_item("storm", i) for i in range(n_base)]
+    items += [
+        ("norm", sar_body("controller-0", "pods", "list"), False)
+        for _ in burst
+    ]
+    items += [
+        ("norm", normal_body("flash", i), False)
+        for i in range(len(flash))
+    ]
+    sched += list(burst) + list(flash)
+    order = sorted(range(len(sched)), key=lambda i: sched[i])
+    sched = [sched[i] for i in order]
+    items = [items[i] for i in order]
+
+    overdrive_env = os.environ.get("CEDAR_BENCH_STORM_OVERDRIVE")
+    over_gate = None
+    over_skipped = ""
+    if overdrive_env:
+        over_gate = float(overdrive_env)
+    elif cores >= 4:
+        over_gate = 4.0  # sustained overload proven (5.0 scheduled)
+    else:
+        over_skipped = (
+            f"host has {cores} core(s) shared by the driver and the "
+            "serving stack: the achieved rate measures GIL scheduling, "
+            "not offered load; set CEDAR_BENCH_STORM_OVERDRIVE to force"
+        )
+    high_avail_min = float(
+        os.environ.get("CEDAR_BENCH_STORM_HIGH_AVAIL", "0.999")
+    )
+
+    def pct(lat, q):
+        s = sorted(lat)
+        return s[min(len(s) - 1, int(len(s) * q))] if s else 0.0
+
+    PRIO = {"high": "high", "norm": "normal", "adm": "normal",
+            "explain": "sheddable"}
+
+    def run_storm_once():
+        """One full storm drive over the SAME seeded schedule (a retry
+        replays bit-for-bit), with fresh gate/tuner state and the batcher
+        knobs back at home."""
+        server._batcher.max_batch = HOME_BATCH
+        server._batcher.window_s = HOME_LINGER_S
+        ctrl = AdmissionController(
+            max_inflight=MAX_INFLIGHT,
+            # gentler thresholds than the serving defaults: the band
+            # above shed_normal_at is the high-priority reservation (see
+            # MIX), and python-driver arrivals bunch under GIL
+            # scheduling, so the reservation must absorb a burst, not
+            # just the mean
+            shed_sheddable_at=0.30,
+            shed_normal_at=0.45,
+            client_qps=25.0,
+            client_burst=50.0,
+            # enforce the fair-share quota from the pressure band: above
+            # shed_normal_at the load gate sheds normal traffic wholesale
+            # anyway, so a quota enforced only past 0.5 would never act —
+            # the burst stream's hot controller must hit its bucket
+            client_enforce_at=0.30,
+            retry_after_s=1.0,
+        )
+        server.load = ctrl
+        tuner = AdaptiveBatchTuner(
+            server._batcher,
+            slo,
+            path="authorization",
+            bounds=TuningBounds(
+                min_batch=4, max_batch=16,
+                min_window_s=100e-6, max_window_s=2000e-6,
+            ),
+            interval_s=0.25,
+            window_s=1.0,
+        )
+        tuner.start()
+        storm_res, achieved_rate, storm_wall, lag_p99_ms = open_loop(
+            sched, items, workers=_n(192, 128)
+        )
+        tuner.stop()
+        server.load = None
+        stats = ctrl.stats()
+
+        # per-priority rollup from the driver's own observations
+        roll = {
+            p: {"offered": 0, "ok": 0, "shed": 0, "error": 0, "lat": []}
+            for p in ("high", "normal", "sheddable")
+        }
+        for kind, req_ok, shed, lat, _resp in storm_res:
+            r = roll[PRIO[kind]]
+            r["offered"] += 1
+            if shed:
+                r["shed"] += 1
+            elif req_ok:
+                r["ok"] += 1
+                r["lat"].append(lat)
+            else:
+                r["error"] += 1
+        high = roll["high"]
+        driver_sheds = sum(r["shed"] for r in roll.values())
+        # honest accounting, twice over: the gate's own identity AND the
+        # driver's independent tally of shed-shaped answers
+        accounting_ok = (
+            stats["offered"] == len(items)
+            and stats["offered"] == stats["admitted"] + stats["shed"]
+            and driver_sheds == stats["shed"] + stats["eval_shed"]
+        )
+        return {
+            "stats": stats,
+            "tuner_status": tuner.status(),
+            "roll": roll,
+            "achieved_rate": achieved_rate,
+            "storm_wall": storm_wall,
+            "lag_p99_ms": lag_p99_ms,
+            "high_avail": high["ok"] / max(1, high["offered"]),
+            "high_p99": pct(high["lat"], 0.99),
+            "goodput": sum(r["ok"] for r in roll.values())
+            / max(1e-9, storm_wall),
+            "accounting_ok": accounting_ok,
+            "overdrive": achieved_rate / max(1e-9, capacity),
+            "breaker_closed": breaker.state == CLOSED,
+        }
+
+    def storm_gates(a: dict) -> bool:
+        # a 5x storm that sheds NOTHING wasn't a storm (the driver
+        # queued arrivals instead of offering them): the gate refusing
+        # real traffic is the very thing under test
+        return (
+            a["stats"]["shed"] > 0
+            and a["high_avail"] >= high_avail_min
+            and a["high_p99"] <= BUDGET_S
+            and a["accounting_ok"]
+            and a["tuner_status"]["moves"] >= 1
+            and a["breaker_closed"]
+            and (over_gate is None or a["overdrive"] >= over_gate)
+        )
+
+    # On a shared/cgroup-throttled host a neighbor burst can starve the
+    # DRIVER mid-storm — submissions fall behind their own schedule, so
+    # measured "latency" is mostly driver-side thread scheduling and the
+    # server genuinely collapses under an arrival pattern no schedule
+    # asked for. The driver's own lag_p99 is the independent evidence
+    # (it involves no server code); one retry is allowed iff the gates
+    # failed AND the driver demonstrably starved. Every attempt's lag
+    # and verdict are reported.
+    LAG_SICK_MS = 150.0
+    attempt_log = []
+    for attempt_i in range(2):
+        if attempt_i:
+            # let the prior failed storm fully drain: pressure off,
+            # breaker (if an attempt's starved dispatches tripped it)
+            # probed back CLOSED by a polite settle stream, SLO ring
+            # cooled past the tuner's 1s window
+            time.sleep(1.5)
+            closed_loop(
+                [("norm", normal_body("settle", i), False)
+                 for i in range(48)],
+                4, gated=False,
+            )
+        a = run_storm_once()
+        storm_ok = storm_gates(a)
+        attempt_log.append({
+            "drive_lag_p99_ms": round(a["lag_p99_ms"], 2),
+            "high_availability": round(a["high_avail"], 4),
+            "high_p99_ms": round(a["high_p99"] * 1e3, 1),
+            "pass": bool(storm_ok),
+        })
+        if storm_ok or a["lag_p99_ms"] <= LAG_SICK_MS:
+            break
+
+    stats = a["stats"]
+    tuner_status = a["tuner_status"]
+    roll = a["roll"]
+    high_avail, high_p99 = a["high_avail"], a["high_p99"]
+    breaker_closed = a["breaker_closed"]
+
+    ok = bool(
+        parity_identical
+        and parity_no_sheds
+        and parity_tput_ok
+        and storm_ok
+    )
+
+    registry.reset()
+    backend = jax.default_backend()
+    result = {
+        "metric": "storm_overload_suite",
+        "smoke": _SMOKE,
+        "host_cores": cores,
+        "request_budget_ms": BUDGET_S * 1e3,
+        "slo_latency_budget_ms": SLO_BUDGET_S * 1e3,
+        "dispatch_floor_ms": FLOOR_S * 1e3,
+        "capacity_rps": round(capacity, 1),
+        "parity": {
+            "requests": len(parity_items),
+            "byte_identical": bool(parity_identical),
+            "sheds": parity_stats["shed"] + parity_stats["eval_shed"],
+            "tput_delta_pct": round(parity_overhead * 100, 2),
+            "noise_floor_pct": round(parity_noise * 100, 2),
+            "tput_ok": bool(parity_tput_ok),
+        },
+        "storm": {
+            "scheduled_x": STORM_X,
+            "duration_s": duration,
+            "offered": len(items),
+            "achieved_rps": round(a["achieved_rate"], 1),
+            "overdrive_x": round(a["overdrive"], 2),
+            "overdrive_gate": over_gate,
+            "overdrive_gate_skipped": over_skipped,
+            "drive_lag_p99_ms": round(a["lag_p99_ms"], 2),
+            "attempts": attempt_log,
+            "wall_s": round(a["storm_wall"], 2),
+            "goodput_rps": round(a["goodput"], 1),
+            "shed_happened": stats["shed"] > 0,
+            "by_priority": {
+                p: {
+                    "offered": r["offered"],
+                    "served_ok": r["ok"],
+                    "shed": r["shed"],
+                    "errors": r["error"],
+                    "availability": round(
+                        r["ok"] / max(1, r["offered"]), 4
+                    ),
+                    "served_p50_ms": round(pct(r["lat"], 0.5) * 1e3, 1),
+                    "served_p99_ms": round(pct(r["lat"], 0.99) * 1e3, 1),
+                }
+                for p, r in roll.items()
+            },
+            "admission_control": stats,
+            "accounting_exact": bool(a["accounting_ok"]),
+            "high_availability": round(high_avail, 4),
+            "high_availability_min": high_avail_min,
+            "high_p99_ms": round(high_p99 * 1e3, 1),
+            "breaker_closed": bool(breaker_closed),
+        },
+        "tuning": {
+            "moves": tuner_status["moves"],
+            "ticks": tuner_status["ticks"],
+            "max_batch": tuner_status["max_batch"],
+            "linger_us": tuner_status["linger_us"],
+            "home": tuner_status["home"],
+            "decisions": tuner_status["decisions"][-6:],
+        },
+        "backend": "cpu-fallback" if backend == "cpu" else backend,
+        "pass": bool(ok),
+        "elapsed_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(result))
+    server.stop()
+    return 0 if ok else 1
+
+
 def main():
     import jax
 
@@ -3654,6 +4347,31 @@ if __name__ == "__main__":
 
         force_cpu()
         _scenario_exit("fanout", run_fanout_scenario)
+
+    if "--storm" in sys.argv:
+        # open-loop overload harness (make bench-storm): cpu-only BY
+        # DESIGN — the gates are about the overload-control execution
+        # model (honest sheds, priority isolation, adaptive batching),
+        # not device speed, and the deterministic dispatch floor (chaos
+        # latency seam) needs a deterministic backend. Same
+        # stage-isolation env rationale as the pipeline bench: the python
+        # driver and the serving stack share the host cores, so
+        # multithreaded XLA would turn the capacity probe into scheduler
+        # noise. Async cpu dispatch so the pipelined batcher overlaps
+        # like an attached device.
+        os.environ.setdefault("CEDAR_NATIVE_THREADS", "1")
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_cpu_multi_thread_eigen" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_cpu_multi_thread_eigen=false"
+            ).strip()
+        from cedar_tpu.jaxenv import force_cpu
+
+        force_cpu()
+        import jax
+
+        jax.config.update("jax_cpu_enable_async_dispatch", True)
+        _scenario_exit("storm", run_storm_scenario)
 
     if "--chaos" in sys.argv:
         # game-day suite (make bench-chaos): cpu-only BY DESIGN — the

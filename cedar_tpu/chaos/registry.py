@@ -113,6 +113,9 @@ SEAMS = {
     "generation barrier (frontend.load / promote)",
     "cache.peer_fetch": "peer decision-cache traffic (fetch AND gossip "
     "delivery) between fanout workers",
+    "load.shed": "admission-control gate verdict (cedar_tpu/load): a "
+    "`corrupt` rule forces the verdict to a shed — storm game days prove "
+    "the shed answer path and the breaker's indifference to it",
     "response": "final (decision, reason, error) swap (reference parity)",
 }
 

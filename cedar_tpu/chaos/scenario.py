@@ -143,6 +143,24 @@ BUILTIN_SCENARIOS = {
         # serving topology (ignored by /chaos/configure)
         "spawn_args": ["--fleet-replicas", "2"],
     },
+    "shed-storm": {
+        "name": "shed-storm",
+        "seed": 23,
+        "description": "the admission-control gate starts force-shedding "
+        "a slice of admitted-looking traffic (the storm shape without "
+        "needing real overload); every shed must answer honestly "
+        "(NoOpinion + Retry-After / admission fail-mode), the device "
+        "breaker must stay CLOSED throughout, and accounting must stay "
+        "exact (offered == admitted + shed)",
+        "faults": [
+            {"seam": "load.shed", "kind": "corrupt", "after": 5,
+             "probability": 0.5, "count": 200},
+        ],
+        "slo": {"availability": 0.0},  # sheds ARE the scenario: the gates
+        # that matter are zero decision flips among served answers and a
+        # closed breaker, asserted by the runner/tests directly
+        "spawn_args": ["--max-inflight", "64"],
+    },
 }
 
 

@@ -151,6 +151,17 @@ class TPUReloader:
         self._stop.set()
 
 
+def _client_enforce_at(args) -> float:
+    """Load fraction where per-client quota enforcement starts. The
+    derived default (--client-enforce-at < 0) is the pressure threshold:
+    the quota's whole point is the band below shed_normal_at — above it
+    the load gate sheds normal traffic wholesale anyway, so a fixed value
+    past that line would be silently inert."""
+    if args.client_enforce_at >= 0:
+        return args.client_enforce_at
+    return args.shed_sheddable_at
+
+
 def build_server(args) -> WebhookServer:
     # process worker identity first: every metrics family, trace and
     # audit record from here on carries it (docs/fleet.md "Cross-host
@@ -992,6 +1003,33 @@ def build_server(args) -> WebhookServer:
             scenario.get("name", args.chaos_scenario),
         )
 
+    # overload-control plane (cedar_tpu/load, docs/performance.md
+    # "Serving under overload"): priority-aware ingress admission control
+    # sized by --max-inflight; 0 keeps the gate-free serving path
+    load_ctrl = None
+    if getattr(args, "max_inflight", 0) > 0:
+        from ..load import AdmissionController
+
+        load_ctrl = AdmissionController(
+            max_inflight=args.max_inflight,
+            shed_sheddable_at=args.shed_sheddable_at,
+            shed_normal_at=args.shed_normal_at,
+            client_qps=args.client_qps,
+            client_burst=args.client_burst,
+            client_enforce_at=_client_enforce_at(args),
+            retry_after_s=args.shed_retry_after_seconds,
+        )
+
+    if getattr(args, "adaptive_batching", False) and slo is None:
+        # refuse BEFORE the server exists: WebhookServer() starts batcher
+        # (and fleet/fanout) worker threads that an error path here would
+        # leak with no stop_batchers() caller
+        raise ValueError(
+            "--adaptive-batching requires the SLO tracker "
+            "(--slo-availability-target > 0): the burn rate is the "
+            "control signal (docs/performance.md)"
+        )
+
     server = WebhookServer(
         authorizer=authorizer,
         admission_handler=admission_handler,
@@ -1027,7 +1065,36 @@ def build_server(args) -> WebhookServer:
         audit_log=audit_log,
         slo=slo,
         tenancy=tenancy_resolver,
+        load=load_ctrl,
     )
+    if getattr(args, "adaptive_batching", False):
+        # SLO-adaptive batching: one tuner per wired batcher, sensing the
+        # burn rates the serving path is already measuring (the no-SLO
+        # case was refused above, before any worker thread existed)
+        from ..load import AdaptiveBatchTuner, TuningBounds
+
+        bounds = TuningBounds(
+            min_batch=args.tuner_min_batch,
+            max_batch=args.tuner_max_batch,
+            min_window_s=args.tuner_min_linger_us / 1e6,
+            max_window_s=args.tuner_max_linger_us / 1e6,
+        )
+        for path, batcher in (
+            ("authorization", server._batcher),
+            ("admission", server._adm_raw_batcher),
+        ):
+            if batcher is None:
+                continue
+            tuner = AdaptiveBatchTuner(
+                batcher,
+                slo,
+                path=path,
+                bounds=bounds,
+                interval_s=args.tuner_interval_seconds,
+                window_s=args.tuner_burn_window_seconds,
+            )
+            tuner.start()
+            server.tuners.append(tuner)
     if supervisor is not None:
         _register_supervised(supervisor, server, rollout, stores)
         if fanout is not None:
@@ -1059,6 +1126,18 @@ def _register_supervised(supervisor, server, rollout, stores) -> None:
             threads=lambda b=batcher: list(b._threads),
             restart=lambda reason, b=batcher: b.revive(force=_force(reason)),
             heartbeat=HeartbeatGroup(lambda b=batcher: b.heartbeats),
+        )
+    for tuner in getattr(server, "tuners", []):
+        # the adaptive batch tuner is a long-lived control thread like any
+        # batcher stage: a dead/wedged tuner must restart, not silently
+        # stop tuning (start() is idempotent on a live thread)
+        supervisor.register(
+            f"tuner.{tuner.path}",
+            threads=lambda t=tuner: (
+                [t._thread] if t._thread is not None else []
+            ),
+            restart=lambda reason, t=tuner: (t.start(), True)[1],
+            heartbeat=HeartbeatGroup(lambda t=tuner: {"tick": t.heartbeat}),
         )
     fleet = getattr(server, "fleet", None)
     if fleet is not None:
@@ -1361,6 +1440,104 @@ def make_parser() -> argparse.ArgumentParser:
         default=5.0,
         help="drain window on SIGTERM: /readyz flips to 503, new requests "
         "are shed, in-flight requests get this long to finish",
+    )
+
+    overload = parser.add_argument_group("overload control")
+    overload.add_argument(
+        "--max-inflight",
+        type=int,
+        default=0,
+        help="size of the overload-control plane (cedar_tpu/load): "
+        "requests are classified at ingress (kubelet/system SARs high, "
+        "controller/admission normal, explain sheddable) and shed by "
+        "priority as inflight/max-inflight crosses the graduated load "
+        "states; sheds answer honestly (NoOpinion + Retry-After / the "
+        "admission fail-mode) and /readyz reports the state (0 disables "
+        "admission control entirely; docs/performance.md)",
+    )
+    overload.add_argument(
+        "--shed-sheddable-at",
+        type=float,
+        default=0.5,
+        help="load fraction at which sheddable (explain/operator) traffic "
+        "sheds — the `pressure` state",
+    )
+    overload.add_argument(
+        "--shed-normal-at",
+        type=float,
+        default=0.8,
+        help="load fraction at which normal (controller/admission) "
+        "traffic sheds — the `overload` state; high-priority traffic "
+        "sheds only at saturation (load >= 1.0)",
+    )
+    overload.add_argument(
+        "--client-qps",
+        type=float,
+        default=0.0,
+        help="per-client fair-share quota (tokens/second) enforced under "
+        "pressure so one hot controller cannot starve the kubelets; keyed "
+        "by the SAR/admission username, high priority exempt (0 disables)",
+    )
+    overload.add_argument(
+        "--client-burst",
+        type=float,
+        default=0.0,
+        help="per-client quota burst headroom (0 = qps/2, min 1)",
+    )
+    overload.add_argument(
+        "--client-enforce-at",
+        type=float,
+        default=-1.0,
+        help="load fraction at which the per-client quota starts being "
+        "enforced; default (-1) derives it from --shed-sheddable-at so "
+        "the quota acts across the whole pressure band — a fixed value "
+        "above --shed-normal-at would never act (normal traffic sheds "
+        "wholesale first)",
+    )
+    overload.add_argument(
+        "--shed-retry-after-seconds",
+        type=float,
+        default=1.0,
+        help="the Retry-After hint shed answers carry",
+    )
+    overload.add_argument(
+        "--adaptive-batching",
+        action="store_true",
+        help="SLO-adaptive batch tuning (cedar_tpu/load/tuner.py): a "
+        "control loop reads the SLO latency burn rate and retunes each "
+        "wired batcher's max-batch/linger inside the bounds below — grow "
+        "batches while p99 has headroom, shrink linger the moment the "
+        "latency objective burns; decisions logged at /debug/load. "
+        "Requires the SLO tracker (--slo-availability-target > 0)",
+    )
+    overload.add_argument(
+        "--tuner-interval-seconds",
+        type=float,
+        default=1.0,
+        help="adaptive-batching control cadence (one knob move per tick)",
+    )
+    overload.add_argument(
+        "--tuner-burn-window-seconds",
+        type=float,
+        default=60.0,
+        help="trailing window the tuner reads the latency burn rate over "
+        "(floored to one 10s SLO ring bucket)",
+    )
+    overload.add_argument(
+        "--tuner-min-batch", type=int, default=64,
+        help="adaptive-batching lower clamp on max-batch",
+    )
+    overload.add_argument(
+        "--tuner-max-batch", type=int, default=16384,
+        help="adaptive-batching upper clamp on max-batch",
+    )
+    overload.add_argument(
+        "--tuner-min-linger-us", type=float, default=50.0,
+        help="adaptive-batching lower clamp on the batch linger window",
+    )
+    overload.add_argument(
+        "--tuner-max-linger-us", type=float, default=2000.0,
+        help="adaptive-batching upper clamp on the batch linger window",
     )
 
     cache = parser.add_argument_group("decision cache")

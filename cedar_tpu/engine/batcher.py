@@ -96,7 +96,22 @@ class DeadlineExceeded(Exception):
     """A submitter's per-request budget elapsed before its batch result
     arrived. The request may still be evaluated by the batch thread; the
     caller has already answered (NoOpinion / configured admission
-    fail-mode), so the late result is discarded."""
+    fail-mode), so the late result is discarded.
+
+    ``queued`` is True when the budget demonstrably burned in the submit
+    queue of a MOVING plane: some batch finished after this slot
+    enqueued (progress — an overloaded device keeps completing batches;
+    a hung one completes nothing, and then the expiry is the breaker's
+    only signal, so it must keep counting) AND the slot was either still
+    unclaimed at expiry or claimed only after more than half the budget
+    was already gone (the batch got the tail end of a spent deadline).
+    Under open-loop overload these are the dominant expiry shapes, and
+    they must not feed the device breaker's latency-breach accounting
+    (server/http.py): the breaker watches the device plane, and a queue
+    drowning in offered load is the admission controller's problem, not
+    a sick accelerator's."""
+
+    queued = False
 
 
 class _StageTimes:
@@ -187,6 +202,13 @@ class MicroBatcher:
         # wedged-and-abandoned) generation can never race the fresh one
         # for queued work
         self._epoch = 0
+        # when the last batch finished (monotonic; completion or failure
+        # both count — either proves the plane is MOVING): the deadline
+        # expiry accounting uses it to tell overload (batches completing,
+        # this slot just never got its turn → spare the breaker) from a
+        # wedge (nothing has finished since this slot enqueued → the
+        # expiry is the only signal a hung device ever emits)
+        self._last_batch_done = 0.0
         # per-stage liveness beacons for the supervisor's wedge detection
         # (server/supervisor.py): busy+stale = wedged, idle = healthy
         self.heartbeats: dict = {}
@@ -354,10 +376,27 @@ class MicroBatcher:
                         self._withdraw(entry)
                     if slot.event.is_set():
                         break  # result landed while we were withdrawing
-                    raise DeadlineExceeded(
+                    err = DeadlineExceeded(
                         f"deadline of {timeout:.3f}s exceeded waiting for "
                         "batch result"
                     )
+                    # queue-burned (class docstring) iff (a) the plane is
+                    # demonstrably MOVING — some batch finished after this
+                    # slot enqueued; a wedged device finishes nothing, and
+                    # then the expiry is the breaker's only signal — AND
+                    # (b) the budget burned waiting for a turn: still
+                    # unclaimed, or claimed only after more than half the
+                    # budget was already gone (the batch got the tail end
+                    # of a spent deadline)
+                    err.queued = self._last_batch_done > slot.t_enq and (
+                        slot.times is None
+                        or (
+                            timeout is not None
+                            and slot.times.claimed - slot.t_enq
+                            > 0.5 * timeout
+                        )
+                    )
+                    raise err
                 wait = min(wait, remaining)
             if slot.event.wait(wait):
                 break
@@ -525,6 +564,7 @@ class MicroBatcher:
                 f"batch fn returned {len(results)} results for "
                 f"{len(batch)} items"
             )
+        self._last_batch_done = time.monotonic()
         for (_, slot), res in zip(batch, results):
             slot.result = res
             slot.event.set()
@@ -533,6 +573,7 @@ class MicroBatcher:
         # one fresh exception per slot: sharing a single exception
         # object (and its traceback) across request threads interleaves
         # tracebacks and leaks one request's error text into others
+        self._last_batch_done = time.monotonic()
         for _, slot in batch:
             err = RuntimeError(f"batch evaluation failed: {e!r}")
             err.__cause__ = e  # keep the original traceback reachable
@@ -646,6 +687,9 @@ class PipelinedBatcher(MicroBatcher):
         # LOAD/ADD/STORE and loses updates under contention, which would
         # pin the decode-stall accounting on forever-idle servers)
         self._inflight = 0
+        # the same, in ENTRIES (every batch's len added/removed at the
+        # exact sites _inflight moves): backlog()'s in-pipeline half
+        self._inflight_entries = 0
         self._inflight_lock = threading.Lock()
         self._stall_s = {"collect": 0.0, "dispatch": 0.0, "decode": 0.0}
         super().__init__(
@@ -726,6 +770,7 @@ class PipelinedBatcher(MicroBatcher):
         shed += self._shed_queues(old_qs)
         with self._inflight_lock:
             self._inflight = 0
+            self._inflight_entries = 0
         with self._cv:
             if self._stopped:
                 return False
@@ -786,9 +831,21 @@ class PipelinedBatcher(MicroBatcher):
 
     # ------------------------------------------------------------- plumbing
 
-    def _inflight_add(self, n: int) -> None:
+    def _inflight_add(self, n: int, entries: int = 0) -> None:
         with self._inflight_lock:
             self._inflight += n
+            self._inflight_entries += entries
+
+    def backlog(self) -> int:
+        """Submitted-but-unanswered entries across the whole batcher:
+        queued PLUS claimed into the pipeline stages. The adaptive batch
+        tuner's demand signal (cedar_tpu/load/tuner.py) — under
+        saturation most waiting happens inside the stage hand-off
+        queues, which queue_fill() (the router's pre-claim load signal)
+        deliberately excludes."""
+        with self._inflight_lock:
+            entries = self._inflight_entries
+        return self.queue_fill() + entries
 
     def _encode_timed(self, items, times: Optional[_StageTimes]):
         """pipeline_encode with the batch's encode window stamped — the
@@ -855,13 +912,13 @@ class PipelinedBatcher(MicroBatcher):
                 self._fail_batch(batch, e)
                 continue
             t0 = time.monotonic()
-            self._inflight_add(1)
+            self._inflight_add(1, len(batch))
             ok = self._put(dispatch_q, (batch, fut), dispatcher)
             # time blocked on a full dispatch queue = downstream (device or
             # decode) backpressure reaching the collector
             self._stall("collect", time.monotonic() - t0)
             if not ok:
-                self._inflight_add(-1)
+                self._inflight_add(-1, -len(batch))
                 self._fail_batch(
                     batch, RuntimeError("pipeline dispatch stage died")
                 )
@@ -898,7 +955,7 @@ class PipelinedBatcher(MicroBatcher):
             try:
                 ctx = fut.result()  # wait for the encode worker
             except BaseException as e:  # noqa: BLE001 — per-batch isolation
-                self._inflight_add(-1)
+                self._inflight_add(-1, -len(batch))
                 self._fail_batch(batch, e)
                 continue
             # time waiting on the encode future = encode stage too slow to
@@ -910,12 +967,12 @@ class PipelinedBatcher(MicroBatcher):
                 ctx = self.stages.pipeline_dispatch(ctx)
             except BaseException as e:  # noqa: BLE001 — per-batch isolation
                 times.dispatch1 = time.monotonic()
-                self._inflight_add(-1)
+                self._inflight_add(-1, -len(batch))
                 self._fail_batch(batch, e)
                 continue
             times.dispatch1 = time.monotonic()
             if not self._put(decode_q, (batch, ctx), decoder):
-                self._inflight_add(-1)
+                self._inflight_add(-1, -len(batch))
                 self._fail_batch(
                     batch, RuntimeError("pipeline decode stage died")
                 )
@@ -963,7 +1020,7 @@ class PipelinedBatcher(MicroBatcher):
                     self._record_batch_stages(times)
                 self._fail_batch(batch, e)
             finally:
-                self._inflight_add(-1)
+                self._inflight_add(-1, -len(batch))
 
     def stop(self, drain_timeout_s: float = 5.0) -> None:
         """Drain the whole pipeline: the collector pushes every remaining

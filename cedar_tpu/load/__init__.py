@@ -1,0 +1,57 @@
+"""Overload-control plane (docs/performance.md "Serving under overload").
+
+The layer between the HTTP front end and the batcher/fleet/fanout tiers
+that keeps the server honest when offered load exceeds capacity:
+
+  * ``admission`` — priority-aware ingress gating with graduated load
+    states and per-client fair-share quotas; sheds answer honestly and
+    ``offered == admitted + shed`` is exact by construction.
+  * ``tuner`` — SLO-adaptive batching: a control loop that grows
+    ``max_batch`` for throughput while the latency objective has headroom
+    and shrinks the linger window the moment it starts burning.
+  * ``arrivals`` — seeded open-loop arrival processes (Poisson / burst /
+    flash crowd) for the ``bench.py --storm`` harness and its tests.
+"""
+
+from .admission import (
+    PRIORITIES,
+    PRIORITY_HIGH,
+    PRIORITY_NORMAL,
+    PRIORITY_SHEDDABLE,
+    STATE_CODES,
+    STATE_OK,
+    STATE_OVERLOAD,
+    STATE_PRESSURE,
+    STATE_SATURATED,
+    AdmissionController,
+    RequestShed,
+    Shed,
+    classify,
+)
+from .arrivals import (
+    burst_schedule,
+    flash_crowd_schedule,
+    poisson_schedule,
+)
+from .tuner import AdaptiveBatchTuner, TuningBounds
+
+__all__ = [
+    "AdaptiveBatchTuner",
+    "AdmissionController",
+    "PRIORITIES",
+    "PRIORITY_HIGH",
+    "PRIORITY_NORMAL",
+    "PRIORITY_SHEDDABLE",
+    "RequestShed",
+    "STATE_CODES",
+    "STATE_OK",
+    "STATE_OVERLOAD",
+    "STATE_PRESSURE",
+    "STATE_SATURATED",
+    "Shed",
+    "TuningBounds",
+    "burst_schedule",
+    "classify",
+    "flash_crowd_schedule",
+    "poisson_schedule",
+]

@@ -104,6 +104,38 @@ class SLOTracker:
                 ring = self._rings[path] = _PathRing()
             ring.add(epoch, error, slow)
 
+    # ----------------------------------------------------------- burn queries
+
+    def latency_burn(self, path: str, window_s: float) -> float:
+        """Latency-objective burn rate over an arbitrary trailing window —
+        the SLO-adaptive batch tuner's sensor (cedar_tpu/load/tuner.py).
+        The window floors to one ring bucket so short storms still
+        register; a path with no traffic reads 0.0 (nothing is burning)."""
+        _, _, slow, total = self._window_counts(path, window_s)
+        if not total:
+            return 0.0
+        return (slow / total) / (1.0 - self.latency_target)
+
+    def availability_burn(self, path: str, window_s: float) -> float:
+        """Availability-objective burn rate over an arbitrary trailing
+        window (error answers / budget) — same floor semantics as
+        latency_burn."""
+        _, errors, _, total = self._window_counts(path, window_s)
+        if not total:
+            return 0.0
+        return (errors / total) / (1.0 - self.availability_target)
+
+    def _window_counts(self, path: str, window_s: float):
+        """(epoch, errors, slow, total) over the trailing window, floored
+        to one bucket."""
+        epoch = int(self._clock() / _BUCKET_S)
+        with self._lock:
+            ring = self._rings.get(path)
+        if ring is None:
+            return epoch, 0, 0, 0
+        total, errors, slow = ring.window(epoch, max(window_s, _BUCKET_S))
+        return epoch, errors, slow, total
+
     # -------------------------------------------------------------- reporting
 
     def status(self) -> dict:

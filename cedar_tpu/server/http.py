@@ -18,6 +18,7 @@ Behavior parity with reference internal/server/server.go + health.go:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import ssl
@@ -30,6 +31,7 @@ from ..chaos.registry import chaos_fire
 from ..engine.batcher import DeadlineExceeded
 from ..fanout.frontend import FanoutUnavailable
 from ..fleet.router import FleetUnavailable
+from ..load.admission import STATE_SATURATED, RequestShed
 from ..obs.trace import (
     current_trace,
     format_traceparent,
@@ -309,6 +311,7 @@ class WebhookServer:
         audit_log=None,
         slo=None,
         tenancy=None,
+        load=None,
     ):
         self.authorizer = authorizer
         self.admission_handler = admission_handler
@@ -492,6 +495,20 @@ class WebhookServer:
         # plane must never answer traffic it cannot attribute to a
         # tenant. None keeps the single-tenant path byte-identical.
         self.tenancy = tenancy
+        # overload-control plane (cedar_tpu/load, docs/performance.md
+        # "Serving under overload"): when wired, every POST is classified
+        # and gated at ingress BEFORE the recorder/trace/serving path —
+        # sheds answer honestly (SAR NoOpinion + Retry-After, admission
+        # per the fail-open/closed flag) and admitted requests run inside
+        # load.track() so the inflight count IS the load signal. None
+        # keeps the gate-free path byte-identical (bench.py --storm gates
+        # the enabled-but-idle differential).
+        self.load = load
+        # SLO-adaptive batch tuners (cedar_tpu/load/tuner.py), appended by
+        # the CLI (or embedders) after construction — the server owns
+        # their lifecycle (stop()) and serves their decision logs on
+        # /debug/load
+        self.tuners: list = []
         self.drain_grace_s = drain_grace_s
         self._draining = False
         self._inflight = 0
@@ -528,6 +545,62 @@ class WebhookServer:
             log.exception("readiness check failed")
             return False
         return self.warm_ready()
+
+    # ----------------------------------------------------- overload control
+
+    def render_shed(self, path_label: str, body: bytes, shed) -> dict:
+        """The honest answer for a request the overload gate refused
+        WITHOUT evaluating: authorization abstains (NoOpinion + an
+        evaluationError naming the shed and the retry hint — the apiserver
+        falls through its authorizer chain), admission answers the
+        configured fail-open/closed posture exactly like a deadline
+        expiry would. ``shed`` is a Shed or RequestShed."""
+        msg = (
+            f"request shed under overload ({shed.reason}); "
+            f"retry after {shed.retry_after_s:g}s"
+        )
+        if path_label != "admission":
+            return sar_response(DECISION_NO_OPINION, "", msg)
+        from ..entities.admission import review_request_uid
+
+        uid = ""
+        try:
+            uid = review_request_uid(json.loads(body)) or ""
+        except Exception:  # noqa: BLE001 — uid is best-effort on a shed
+            pass
+        allowed = self.admission_fail_open
+        # error forces status.code 500 on the wire (to_admission_review)
+        # — the shape the shadow worker's code!=200 filter and the storm
+        # harness's availability check both key on
+        return AdmissionResponse(
+            uid=uid, allowed=allowed,
+            error=f"{msg} ({'allowed' if allowed else 'denied'} on shed)",
+        ).to_admission_review()
+
+    def serve_authorize(self, body: bytes, explain: bool = False) -> dict:
+        """Ingress-gated in-process serving entry — the exact gate +
+        track + handle sequence do_POST runs, for embedders and the storm
+        harness (bench.py --storm) that drive the server without HTTP.
+        With no overload plane wired this IS handle_authorize."""
+        if self.load is None:
+            return self.handle_authorize(body, explain=explain)
+        priority, shed = self.load.admit("authorization", body, explain)
+        if shed is not None:
+            return self.render_shed("authorization", body, shed)
+        with self.load.track("authorization", priority):
+            return self.handle_authorize(
+                body, explain=explain, priority=priority
+            )
+
+    def serve_admit(self, body: bytes, explain: bool = False) -> dict:
+        """The admission twin of serve_authorize."""
+        if self.load is None:
+            return self.handle_admit(body, explain=explain)
+        priority, shed = self.load.admit("admission", body, explain)
+        if shed is not None:
+            return self.render_shed("admission", body, shed)
+        with self.load.track("admission", priority):
+            return self.handle_admit(body, explain=explain, priority=priority)
 
     def _get_explainer(self):
         """Build the Explainer on first use (lazy: no explain import or
@@ -625,13 +698,18 @@ class WebhookServer:
         parent_span_id: Optional[str] = None,
         root_span_id: Optional[str] = None,
         sampled: Optional[bool] = None,
+        priority: str = "",
     ) -> dict:
         """``request_id`` is the end-to-end trace id (the ingested W3C
         traceparent's trace id when the apiserver sent one — do_POST
         echoes it back as ``X-Cedar-Trace-Id``); direct embedder calls
         without one get a fresh id, exactly like before. ``sampled`` is a
         pre-drawn head-sampling decision (do_POST draws it so the response
-        traceparent's recorded flag is honest); None draws here."""
+        traceparent's recorded flag is honest); None draws here.
+        ``priority`` is the ingress gate's classification (cedar_tpu/load)
+        — non-empty only for requests admitted through serve_authorize/
+        do_POST with an overload plane wired; it arms the evaluation-stage
+        shed gate on the miss path."""
         if explain:
             return self._handle_authorize_explain(body, request_id)
         start = time.monotonic()
@@ -658,7 +736,19 @@ class WebhookServer:
             trace.root.set_attr("tenant", tenant)
         decision, reason, error = DECISION_NO_OPINION, "", None
         try:
-            decision, reason, error = self._authorize_cached(body, request_id)
+            try:
+                decision, reason, error = self._authorize_cached(
+                    body, request_id, priority=priority
+                )
+            except RequestShed as e:
+                # the evaluation-stage gate refused an already-admitted
+                # request (server saturated by the time its cache-missed
+                # evaluation would submit): bounded honest answer, breaker
+                # untouched — the shedder doing its job is not a sick
+                # device (cedar_tpu/load/admission.py)
+                decision, reason, error = (
+                    DECISION_NO_OPINION, "", str(e),
+                )
             if error is not None:
                 return sar_response(decision, reason, error)
             if self.rollout is not None and self._cache_usable():
@@ -723,21 +813,36 @@ class WebhookServer:
                 latency,
             )
 
-    def _authorize_cached(self, body: bytes, request_id: str):
+    def _authorize_cached(
+        self, body: bytes, request_id: str, priority: str = ""
+    ):
         """(decision, reason, error) through the decision cache: hit →
         answered without touching any engine; miss → singleflight-coalesced
         evaluation whose clean result is inserted for the next arrival.
         Error results (decode failures, deadline expiries, evaluator
-        crashes) are transient and never cached."""
+        crashes) are transient and never cached. One deadline budget for
+        the whole request: the submits below spend the REMAINING budget
+        (queue/cache/coalesce wait included), never a fresh one — the
+        admission path's posture, and the basis for the breaker's
+        queue-wait-aware expiry accounting."""
+        deadline = (
+            None
+            if self.request_timeout_s is None
+            else time.monotonic() + self.request_timeout_s
+        )
         cache = self.decision_cache
         if cache is None or not self._cache_usable():
-            return self._authorize_uncached(body, request_id)
+            return self._authorize_uncached(
+                body, request_id, priority=priority, deadline=deadline
+            )
         key = self._sar_memo.fingerprint("authorize", body)
         if key is None:
             # unparseable body: the uncached path produces the exact
             # decode-error answer (never cached — the fingerprint requires
             # a parse, so decode errors cannot collide onto a key)
-            return self._authorize_uncached(body, request_id)
+            return self._authorize_uncached(
+                body, request_id, priority=priority, deadline=deadline
+            )
         # generation snapshot BEFORE evaluation: a reload landing while the
         # leader evaluates leaves the entry stamped pre-reload, so it dies
         # at its first post-reload lookup instead of surviving the reload.
@@ -752,13 +857,18 @@ class WebhookServer:
                     sp.set_attr("hit", hit is not None)
         except Exception:  # noqa: BLE001 — a sick cache is a miss
             log.exception("decision cache lookup failed; evaluating")
-            return self._authorize_uncached(body, request_id)
+            return self._authorize_uncached(
+                body, request_id, priority=priority, deadline=deadline
+            )
         if hit is not None:
             _octx_mark("cached")
             return hit[0], hit[1], None
 
         def _leader():
-            res = self._authorize_uncached(body, request_id, coalesce_key=key)
+            res = self._authorize_uncached(
+                body, request_id, coalesce_key=key,
+                priority=priority, deadline=deadline,
+            )
             if res[2] is None:
                 try:
                     # shard-scoped stamp when the reason names the
@@ -782,12 +892,22 @@ class WebhookServer:
             result, _ = self._sar_flights.do(
                 key, _leader, timeout=self.request_timeout_s
             )
+        except RequestShed:
+            raise  # the leader was shed: handle_authorize renders it
         except DeadlineExceeded as e:
             # a FOLLOWER's budget expired waiting on the leader; the leader
             # keeps running and its result still warms the cache
             metrics.record_deadline_exceeded("authorization")
             return DECISION_NO_OPINION, "", f"evaluation error: {e}"
         except Exception as e:  # noqa: BLE001 — always answer the apiserver
+            if isinstance(e.__cause__, RequestShed):
+                # a follower coalesced behind a leader that admission
+                # control shed: unwrap the singleflight wrapper so every
+                # waiter receives the SAME honest shed answer immediately
+                # (bounded error, breaker untouched) instead of an opaque
+                # "coalesced evaluation failed" — tests/test_load.py pins
+                # this regression
+                raise e.__cause__
             log.exception(
                 "coalesced authorize requestId=%s failed", request_id
             )
@@ -823,11 +943,27 @@ class WebhookServer:
         body: bytes,
         request_id: str,
         coalesce_key: Optional[str] = None,
+        priority: str = "",
+        deadline: Optional[float] = None,
     ):
         """(decision, reason, error) through the engines — the pre-cache
         serving path: the fanout tier or fleet router (when wired) or the
         native fast path behind the breaker, then the python interpreter
-        path."""
+        path. ``deadline`` is the request's absolute budget deadline (set
+        by _authorize_cached): submits spend what remains of it."""
+        if self.load is not None and priority:
+            # evaluation-stage gate: a request admitted at ingress can
+            # find the server saturated by the time its cache-missed
+            # evaluation submits — shed NOW (RequestShed, rendered by
+            # handle_authorize and fanned to any coalesced followers)
+            # instead of burning a batcher slot and the whole budget
+            self.load.check_eval(priority)
+
+        def _remaining() -> Optional[float]:
+            if deadline is None:
+                return self.request_timeout_s
+            return deadline - time.monotonic()
+
         if self.fanout is not None:
             try:
                 with trace_span("fanout.route"):
@@ -846,7 +982,7 @@ class WebhookServer:
                 with trace_span("fleet.submit"):
                     return self.fleet.submit(
                         body,
-                        timeout=self.request_timeout_s,
+                        timeout=_remaining(),
                         coalesce_key=coalesce_key,
                     )
             except DeadlineExceeded as e:
@@ -888,12 +1024,21 @@ class WebhookServer:
             try:
                 return self._batcher.submit(
                     body,
-                    timeout=self.request_timeout_s,
+                    timeout=_remaining(),
                     coalesce_key=coalesce_key,
                 )
             except DeadlineExceeded as e:
                 metrics.record_deadline_exceeded("authorization")
-                self._record_breaker_timeout(self.fastpath)
+                if not getattr(e, "queued", False):
+                    # feed the breaker only when the device plane actually
+                    # held the request: an expiry whose whole budget burned
+                    # in the submit queue (e.queued — the dominant shape
+                    # under open-loop overload) says the server is drowning
+                    # in offered load, not that the accelerator is sick.
+                    # The shedder handles the former; tripping the breaker
+                    # would route EVERYTHING to the slower interpreter and
+                    # deepen the storm (tests/test_load.py pins this).
+                    self._record_breaker_timeout(self.fastpath)
                 tr = current_trace()
                 if tr is not None:
                     tr.event("deadline_exceeded")
@@ -1015,6 +1160,7 @@ class WebhookServer:
         parent_span_id: Optional[str] = None,
         root_span_id: Optional[str] = None,
         sampled: Optional[bool] = None,
+        priority: str = "",
     ) -> dict:
         if request_id is None:
             request_id = new_trace_id()
@@ -1039,7 +1185,7 @@ class WebhookServer:
             trace.root.set_attr("tenant", tenant)
         review = None
         try:
-            review = self._handle_admit(body)
+            review = self._handle_admit(body, priority=priority)
             if self.rollout is not None and self._admission_shadowable():
                 # non-blocking shadow offer; error/fail-mode responses are
                 # filtered by the shadow worker (code != 200), but the
@@ -1165,7 +1311,15 @@ class WebhookServer:
         except Exception:  # noqa: BLE001 — unready reads as unshadowable
             return False
 
-    def _handle_admit(self, body: bytes) -> dict:
+    def _handle_admit(self, body: bytes, priority: str = "") -> dict:
+        if self.load is not None and priority:
+            # evaluation-stage gate, the authorization path's twin: a
+            # saturated server answers the configured fail-mode NOW
+            # (docstring of AdmissionController.check_eval)
+            try:
+                self.load.check_eval(priority)
+            except RequestShed as e:
+                return self.render_shed("admission", body, e)
         # one deadline budget for the whole request: a fastpath failure that
         # falls through to the python path spends the REMAINING budget, not
         # a fresh one, so the apiserver never waits ~2x the configured limit
@@ -1214,8 +1368,11 @@ class WebhookServer:
                 ).to_admission_review()
             except DeadlineExceeded as e:
                 # the budget is spent: answer the fail-mode now instead of
-                # burning more wall-clock on the python path
-                self._record_breaker_timeout(self.admission_fastpath)
+                # burning more wall-clock on the python path. Queue-burned
+                # expiries spare the breaker, exactly like the
+                # authorization path above.
+                if not getattr(e, "queued", False):
+                    self._record_breaker_timeout(self.admission_fastpath)
                 tr = current_trace()
                 if tr is not None:
                     tr.event("deadline_exceeded")
@@ -1342,6 +1499,34 @@ class WebhookServer:
                             self._reject_tenant(path, body, why)
                             return
                         body = TenantBody(body, tenant)
+                    path_label = (
+                        "authorization" if path == "/v1/authorize"
+                        else "admission" if path == "/v1/admit"
+                        else None
+                    )
+                    priority = ""
+                    if server.load is not None and path_label is not None:
+                        # ingress overload gate (cedar_tpu/load,
+                        # docs/performance.md "Serving under overload"):
+                        # refused requests answer the honest shed BEFORE
+                        # the recorder/trace/serving path — never served,
+                        # so the serving histograms and SLO rings never
+                        # see them; cedar_load_shed_total{priority,reason}
+                        # is the signal, and Retry-After tells a
+                        # well-behaved caller when to come back
+                        priority, shed = server.load.admit(
+                            path_label, body, explain=explain
+                        )
+                        if shed is not None:
+                            self._write_json(
+                                server.render_shed(path_label, body, shed),
+                                headers={
+                                    "Retry-After": str(
+                                        max(1, round(shed.retry_after_s))
+                                    )
+                                },
+                            )
+                            return
                     if server.recorder is not None:
                         server.recorder.record(path, body)
                     # one request id end to end: the ingested W3C
@@ -1366,32 +1551,43 @@ class WebhookServer:
                         headers["traceparent"] = format_traceparent(
                             request_id, root_span, sampled
                         )
-                    if path == "/v1/authorize":
-                        self._write_json(
-                            server.handle_authorize(
-                                body,
-                                explain=explain,
-                                request_id=request_id,
-                                parent_span_id=parent_span,
-                                root_span_id=root_span,
-                                sampled=sampled,
-                            ),
-                            headers=headers,
-                        )
-                    elif path == "/v1/admit":
-                        self._write_json(
-                            server.handle_admit(
-                                body,
-                                explain=explain,
-                                request_id=request_id,
-                                parent_span_id=parent_span,
-                                root_span_id=root_span,
-                                sampled=sampled,
-                            ),
-                            headers=headers,
-                        )
-                    else:
-                        self.send_error(404)
+                    # admitted requests run inside load.track(): the
+                    # inflight count (queue wait + evaluation, end to
+                    # end) IS the load signal the graduated states read
+                    tracked = (
+                        server.load.track(path_label, priority)
+                        if server.load is not None and path_label is not None
+                        else contextlib.nullcontext()
+                    )
+                    with tracked:
+                        if path == "/v1/authorize":
+                            self._write_json(
+                                server.handle_authorize(
+                                    body,
+                                    explain=explain,
+                                    request_id=request_id,
+                                    parent_span_id=parent_span,
+                                    root_span_id=root_span,
+                                    sampled=sampled,
+                                    priority=priority,
+                                ),
+                                headers=headers,
+                            )
+                        elif path == "/v1/admit":
+                            self._write_json(
+                                server.handle_admit(
+                                    body,
+                                    explain=explain,
+                                    request_id=request_id,
+                                    parent_span_id=parent_span,
+                                    root_span_id=root_span,
+                                    sampled=sampled,
+                                    priority=priority,
+                                ),
+                                headers=headers,
+                            )
+                        else:
+                            self.send_error(404)
                 finally:
                     with server._inflight_cv:
                         server._inflight -= 1
@@ -1519,11 +1715,30 @@ class WebhookServer:
                     # initial policy load completes, and until the engines'
                     # first serving shape is compiled — so a fresh server's
                     # first live request never eats an XLA compile inside
-                    # the apiserver's 3s webhook deadline
+                    # the apiserver's 3s webhook deadline.
+                    #
+                    # With an overload plane wired, readiness is GRADUATED
+                    # (docs/performance.md "Serving under overload"): the
+                    # body and X-Cedar-Load-State header carry the load
+                    # state (ok / pressure / overload / saturated), and
+                    # saturation reads 503 so an apiserver honoring
+                    # readiness steers new traffic to a healthier member
+                    # while the shedder protects this one
                     ready = server.ready()
+                    body = b""
+                    state = ""
+                    if server.load is not None:
+                        state = server.load.load_state()
+                        body = state.encode()
+                        if state == STATE_SATURATED:
+                            ready = False
                     self.send_response(200 if ready else 503)
-                    self.send_header("Content-Length", "0")
+                    if state:
+                        self.send_header("X-Cedar-Load-State", state)
+                    self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
+                    if body:
+                        self.wfile.write(body)
                 elif self.path == "/metrics":
                     if server.fleet is not None:
                         try:
@@ -1709,6 +1924,28 @@ class WebhookServer:
                     except Exception:  # noqa: BLE001 — debug must not 500
                         log.exception("chaos stats failed")
                         doc = {"error": "chaos stats failed"}
+                    self._send_json(doc)
+                elif self.path == "/debug/load":
+                    # overload-control plane (docs/performance.md "Serving
+                    # under overload"): graduated load state, honest shed
+                    # accounting (offered == admitted + shed), per-client
+                    # quota posture, and each adaptive batch tuner's live
+                    # knobs + decision log with the measurement that
+                    # justified every move; 404 with no plane wired
+                    if server.load is None and not server.tuners:
+                        self.send_error(404)
+                        return
+                    doc = {}
+                    try:
+                        if server.load is not None:
+                            doc["admission_control"] = server.load.stats()
+                        if server.tuners:
+                            doc["tuning"] = {
+                                t.path: t.status() for t in server.tuners
+                            }
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("load status failed")
+                        doc = {"error": "load status failed"}
                     self._send_json(doc)
                 elif self.path == "/debug/slo":
                     # SLO plane (docs/observability.md): targets plus
@@ -1977,6 +2214,13 @@ class WebhookServer:
                 self.supervisor.stop()
             except Exception:  # noqa: BLE001 — teardown must finish
                 log.exception("supervisor stop failed")
+        for tuner in self.tuners:
+            # stop tuning FIRST: a control loop mutating batcher knobs
+            # mid-drain would race the batcher joins below
+            try:
+                tuner.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception("batch tuner stop failed")
         self.begin_drain()
         deadline = time.monotonic() + grace
         with self._inflight_cv:
@@ -2028,6 +2272,11 @@ class WebhookServer:
         """Drain + stop the batchers WITHOUT touching HTTP listeners —
         the teardown for embedded stacks that never started them (fanout
         workers, tests building WebhookServer as a serving core)."""
+        for tuner in self.tuners:
+            try:
+                tuner.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception("batch tuner stop failed")
         for batcher in (
             self._batcher, self._admission_batcher, self._adm_raw_batcher
         ):

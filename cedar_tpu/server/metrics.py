@@ -378,6 +378,106 @@ def fallback_decision_counts() -> dict:
         }
 
 
+# --------------------------------------------------------- overload control
+# Priority-aware admission control + SLO-adaptive batching
+# (cedar_tpu/load, docs/performance.md "Serving under overload"). The
+# client label on the throttle counter is BOUNDED like the tenant/e2e
+# label sets above: a reconnect storm minting principals must not explode
+# the exposition.
+_CLIENT_LABEL_CAP = 64
+_client_labels: set = set()
+_client_label_lock = threading.Lock()
+
+load_shed_total = REGISTRY.register(
+    Counter(
+        "cedar_load_shed_total",
+        "Requests refused by the overload-control plane, by priority and "
+        "reason (load_pressure / load_overload / saturated / client_quota "
+        "/ eval_saturated / chaos). Sheds answer honestly — SAR NoOpinion "
+        "+ Retry-After, admission per the fail-open/closed flag — and "
+        "offered == admitted + shed holds exactly at the ingress gate.",
+        ["priority", "reason"],
+    )
+)
+
+inflight_requests = REGISTRY.register(
+    Gauge(
+        "cedar_inflight_requests",
+        "Admitted requests currently in flight (queue wait + evaluation), "
+        "per path and priority — the load signal the admission "
+        "controller's graduated states derive from.",
+        ["path", "priority"],
+    )
+)
+
+load_state_gauge = REGISTRY.register(
+    Gauge(
+        "cedar_load_state",
+        "Graduated overload state: 0 ok, 1 pressure (sheddable traffic "
+        "shedding), 2 overload (normal traffic shedding), 3 saturated "
+        "(everything sheds; /readyz reads 503).",
+        [],
+    )
+)
+
+batch_tuning = REGISTRY.register(
+    Gauge(
+        "cedar_batch_tuning",
+        "Live value of each adaptive-batching knob per serving path "
+        "(param: max_batch, linger_us) — watch the SLO-adaptive "
+        "controller move during a storm (decision log at /debug/load).",
+        ["path", "param"],
+    )
+)
+
+client_throttled_total = REGISTRY.register(
+    Counter(
+        "cedar_client_throttled_total",
+        "Requests shed by a per-client fair-share quota, by client "
+        "(the SAR/admission username; CAPPED at 64 distinct ids, later "
+        "ids fold into `other` — cedar_client_label_overflow_total "
+        "counts the folds).",
+        ["client"],
+    )
+)
+
+client_label_overflow_total = REGISTRY.register(
+    Counter(
+        "cedar_client_label_overflow_total",
+        "Client-labeled throttle observations folded into `other` "
+        "because the bounded client label set was full.",
+        [],
+    )
+)
+
+
+def record_load_shed(priority: str, reason: str) -> None:
+    load_shed_total.inc(priority=priority, reason=reason)
+
+
+def set_inflight(path: str, priority: str, n: int) -> None:
+    inflight_requests.set(n, path=path, priority=priority)
+
+
+def set_load_state(code: int) -> None:
+    load_state_gauge.set(code)
+
+
+def set_batch_tuning(path: str, param: str, value: float) -> None:
+    batch_tuning.set(value, path=path, param=param)
+
+
+def record_client_throttled(client: str) -> None:
+    with _client_label_lock:
+        if client != "other" and client not in _client_labels:
+            if len(_client_labels) >= _CLIENT_LABEL_CAP:
+                client_label_overflow_total.inc()
+                client = "other"
+            else:
+                _client_labels.add(client)
+    client_throttled_total.inc(client=client)
+
+
 row_routing_total = REGISTRY.register(
     Counter(
         f"{SUBSYSTEM}_row_routing_total",

@@ -710,10 +710,10 @@ class TestServerCaching:
 
         real = server._authorize_uncached
 
-        def slow_uncached(body, request_id, coalesce_key=None):
+        def slow_uncached(body, request_id, coalesce_key=None, **kw):
             calls.append(1)
             release.wait(5)
-            return real(body, request_id, coalesce_key=coalesce_key)
+            return real(body, request_id, coalesce_key=coalesce_key, **kw)
 
         server._authorize_uncached = slow_uncached
         body = json.dumps(make_sar()).encode()
@@ -744,10 +744,10 @@ class TestServerCaching:
 
         real = server._authorize_uncached
 
-        def slow_uncached(body, request_id, coalesce_key=None):
+        def slow_uncached(body, request_id, coalesce_key=None, **kw):
             entered.set()
             release.wait(5)
-            return real(body, request_id, coalesce_key=coalesce_key)
+            return real(body, request_id, coalesce_key=coalesce_key, **kw)
 
         server._authorize_uncached = slow_uncached
         body = json.dumps(make_sar()).encode()
@@ -880,8 +880,8 @@ class TestDifferential:
         real = server._authorize_uncached
         fired = []
 
-        def reload_mid_eval(b, request_id, coalesce_key=None):
-            res = real(b, request_id, coalesce_key=coalesce_key)
+        def reload_mid_eval(b, request_id, coalesce_key=None, **kw):
+            res = real(b, request_id, coalesce_key=coalesce_key, **kw)
             if not fired:  # the reload lands AFTER evaluation, BEFORE put
                 fired.append(1)
                 store.swap(PolicySet.from_source(RELOADED_POLICY, "m"))
