@@ -70,6 +70,10 @@ bench-encode: ## Host-side budget: native encode µs/req at 1/2/4 threads, packe
 bench-scale: ## Giant policy sets: 10k vs 100k serving-rate ratio, single-edit incremental recompile <1s + zero-fresh-trace gate (cpu; docs/performance.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --scale
 
+.PHONY: bench-coverage
+bench-coverage: ## Lowerability burn-down gate: full-vs-legacy compiler coverage % on the adversarial corpus (strictly higher + pinned floor), per-family fallback-vs-device serving ratio (cpu; docs/lowering.md)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --coverage
+
 .PHONY: bench-tenant
 bench-tenant: ## Multi-tenant shared plane: 1 vs 10 fused tenants on one device — zero cross-tenant decision flips, per-tenant p99 budget, tenant-scoped dirty shards (cpu; docs/multitenancy.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --tenants

@@ -55,16 +55,20 @@ class PolicyInfo:
 
 
 def lower_all(
-    tiers: Sequence, schema: Optional[SchemaInfo] = None
+    tiers: Sequence, schema: Optional[SchemaInfo] = None, opts=None
 ) -> List[PolicyInfo]:
     """Lower every policy of every tier individually, capturing the
-    Unlowerable reason instead of aggregating like lower_tiers does."""
+    Unlowerable reason instead of aggregating like lower_tiers does.
+    ``opts`` (lower.LowerOptions; None = the full compiler) selects the
+    compiler's feature gates — bench.py --coverage measures LEGACY_OPTS
+    vs the default compiler on the same corpus through this entry
+    point."""
     schema = schema or AUTHZ_SCHEMA_INFO
     infos: List[PolicyInfo] = []
     for tier_idx, ps in enumerate(tiers):
         for policy in ps.policies():
             try:
-                lp = lower_policy(policy, tier_idx, schema)
+                lp = lower_policy(policy, tier_idx, schema, opts)
                 infos.append(PolicyInfo(policy, tier_idx, lowered=lp))
             except Unlowerable as e:
                 infos.append(
@@ -158,7 +162,19 @@ def lint_lowerability(infos: List[PolicyInfo]) -> List[Finding]:
                     f"{len(hard)} host-evaluated sub-expression(s): {shown}",
                 )
             )
-        if len(lp.clauses) >= CLAUSE_HEAVY:
+        if lp.spilled:
+            findings.append(
+                _finding(
+                    "spilled",
+                    info,
+                    "lowered past the preferred packing budgets "
+                    f"({len(lp.clauses)} DNF rules, widest clause "
+                    f"{max((len(c) for c in lp.clauses), default=0)} "
+                    "literals) via clause spillover — device-served, but "
+                    "paying extra rule columns",
+                )
+            )
+        elif len(lp.clauses) >= CLAUSE_HEAVY:
             findings.append(
                 _finding(
                     "clause_heavy",
@@ -362,6 +378,7 @@ def capacity_report(infos: List[PolicyInfo], n_tiers: int) -> dict:
                 "error_rules": len(lp.error_clauses),
                 "literals": len(lits),
                 "slots": len(slots),
+                "spilled": lp.spilled,
             }
         )
     return {
@@ -385,20 +402,47 @@ def capacity_report(infos: List[PolicyInfo], n_tiers: int) -> dict:
     }
 
 
+def coverage_summary(infos: List[PolicyInfo]) -> dict:
+    """The lowerability-coverage rollup (ROADMAP item 3 burn-down): %
+    of policies fully lowerable, per-Unlowerable-code fallback counts,
+    and the spillover count. /debug/analysis joins the served-traffic
+    ranking (cedar_fallback_decisions_total{code}) onto this so the next
+    burn-down target is one glance away; the CLI prints it standalone."""
+    n = len(infos)
+    by_code: Dict[str, int] = {}
+    spilled = 0
+    for i in infos:
+        if i.fallback is not None:
+            code = i.fallback.code or "unlowerable"
+            by_code[code] = by_code.get(code, 0) + 1
+        elif i.lowered.spilled:
+            spilled += 1
+    n_fallback = sum(by_code.values())
+    return {
+        "policies": n,
+        "lowerable": n - n_fallback,
+        "lowerable_pct": round(100.0 * (n - n_fallback) / n, 2) if n else 100.0,
+        "fallback_codes": dict(sorted(by_code.items())),
+        "spilled": spilled,
+    }
+
+
 def analyze_tiers(
     tiers: Sequence,
     schema: Optional[SchemaInfo] = None,
     pair_budget: int = PAIR_BUDGET,
     capacity: bool = True,
+    opts=None,
 ) -> AnalysisReport:
     """Analyze a whole tiered policy set (list of PolicySet, tier order).
 
     Returns the full report: lowerability findings for every policy,
     shadowing/unreachability, permit/forbid conflicts, per-tier
-    lowerability stats, and (unless capacity=False) the static capacity
-    report."""
-    infos = lower_all(tiers, schema)
+    lowerability stats, the lowerability-coverage summary, and (unless
+    capacity=False) the static capacity report."""
+    infos = lower_all(tiers, schema, opts)
     report = AnalysisReport()
+    report.coverage = coverage_summary(infos)
     report.findings.extend(lint_lowerability(infos))
     budget = _Budget(pair_budget)
     shadow_findings = find_shadowing(infos, budget)

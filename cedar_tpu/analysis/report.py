@@ -26,29 +26,33 @@ REASONS: Dict[str, Tuple[str, str, str]] = {
     "clause_limit": (
         "fastpath",
         SEV_ERROR,
-        "the condition's ordered-DNF expansion exceeds the clause budget "
-        "(MAX_CLAUSES); split the policy into several narrower policies or "
+        "the condition's ordered-DNF expansion exceeds even the spillover "
+        "ceiling (SPILL_MAX_CLAUSES) — a genuinely exponential alternation "
+        "product; split the policy into several narrower policies or "
         "flatten nested ||/&& alternations",
     ),
     "literal_limit": (
         "fastpath",
         SEV_ERROR,
-        "one evaluation path conjoins more literals than a rule can hold "
-        "(MAX_LITERALS); split the condition across several policies",
+        "one evaluation path conjoins more literals than the spillover "
+        "ceiling admits (SPILL_MAX_LITERALS); split the condition across "
+        "several policies",
     ),
     "negated_opaque": (
         "fastpath",
         SEV_ERROR,
-        "a negated (unless/!=/!) expression the compiler cannot prove "
-        "error-free; add `has` guards for every attribute it touches, or "
+        "a negated (unless/!=/!) expression outside the host-guardable "
+        "class (compiler/dyn.host_guardable) — its evaluation behavior is "
+        "unproven; add `has` guards for every attribute it touches, or "
         "rewrite without the negation",
     ),
     "negated_untyped": (
         "fastpath",
         SEV_ERROR,
         "a negated typed test (like/</contains) on an attribute whose "
-        "static type is unknown; guard with `is` to pin the entity type, "
-        "or move the test out of unless/negation",
+        "type neither the schema nor clause flow-typing proves, with "
+        "TYPE_ERR guards disabled; guard with `is` to pin the entity "
+        "type, or move the test out of unless/negation",
     ),
     "unlowerable": (
         "fastpath",
@@ -125,6 +129,15 @@ REASONS: Dict[str, Tuple[str, str, str]] = {
         "the policy expands to many DNF rules, paying rule-table columns "
         "for each; prefer `in [..]` sets over ==-chains where possible",
     ),
+    "spilled": (
+        "capacity",
+        SEV_INFO,
+        "the ordered-DNF expansion exceeded the preferred packing budgets "
+        "(MAX_CLAUSES rules or MAX_LITERALS per clause) and lowered via "
+        "clause spillover — still device-served, but each extra rule is a "
+        "packed matmul column; prefer `in [..]` sets over ==-chains to "
+        "shrink the expansion",
+    ),
 }
 
 
@@ -180,6 +193,12 @@ class AnalysisReport:
     findings: List[Finding] = field(default_factory=list)
     # per-tier {tier: {"policies": n, "lowerable": n, "fallback": n}}
     tiers: Dict[int, Dict[str, int]] = field(default_factory=dict)
+    # lowerability-coverage rollup (analyze.coverage_summary): overall
+    # fully-lowerable %, per-Unlowerable-code fallback counts, spillover
+    # count — the burn-down dashboard's source of truth. /debug/analysis
+    # joins the served-decision ranking
+    # (cedar_fallback_decisions_total{code}) under "served_decisions".
+    coverage: dict = field(default_factory=dict)
     capacity: dict = field(default_factory=dict)
     # pair-comparison budget ran out: shadowing/conflict coverage is partial
     truncated: bool = False
@@ -205,6 +224,7 @@ class AnalysisReport:
         return {
             "findings": [f.to_dict() for f in self.findings],
             "tiers": {str(t): dict(v) for t, v in sorted(self.tiers.items())},
+            "coverage": self.coverage,
             "capacity": self.capacity,
             "truncated": self.truncated,
             "counts": self.counts(),
@@ -228,6 +248,30 @@ class AnalysisReport:
                 f"tier {t}: {stats['lowerable']}/{stats['policies']} policies "
                 f"fastpath-lowerable, {stats['fallback']} interpreter-fallback"
             )
+        cov = self.coverage
+        if cov:
+            line = (
+                f"coverage: {cov['lowerable_pct']}% of {cov['policies']} "
+                "policies fully lowerable"
+            )
+            if cov.get("fallback_codes"):
+                served = cov.get("served_decisions") or {}
+                per = ", ".join(
+                    f"{code} x{n}"
+                    + (
+                        f" ({served[code]} served decisions)"
+                        if code in served
+                        else ""
+                    )
+                    for code, n in sorted(
+                        cov["fallback_codes"].items(),
+                        key=lambda kv: (-served.get(kv[0], 0), -kv[1], kv[0]),
+                    )
+                )
+                line += f" — fallback by code: {per}"
+            if cov.get("spilled"):
+                line += f"; {cov['spilled']} spilled past packing budgets"
+            lines.append(line)
         cap = self.capacity
         if cap:
             lines.append(

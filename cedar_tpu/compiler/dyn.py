@@ -173,6 +173,92 @@ def _tmpl_of(e: ast.Expr) -> Optional[Tmpl]:
     return None
 
 
+# AST shapes the interpreter (lang/eval.evaluate) is known to evaluate to
+# a value or an EvalError — nothing else. Membership is what makes the
+# HARD_OK/HARD_ERR guard mechanism applicable to a NEGATED hard literal:
+# the host evaluates the expression with the real interpreter, a bool
+# result activates the OK guard, an error activates the ERR indicator and
+# leaves the guard inactive (killing the clause on the same path Cedar
+# skips the policy). The class is wider than the native template grammar
+# on purpose: common negated arithmetic/string expressions lower through
+# the guard path instead of dragging the whole policy to the interpreter
+# fallback; the owning policy merely becomes native-opaque (scope-gated
+# rows re-run the exact Python path, compiler/pack.py).
+_GUARDABLE_METHODS = frozenset(
+    {
+        "contains",
+        "containsAll",
+        "containsAny",
+        "isIpv4",
+        "isIpv6",
+        "isLoopback",
+        "isMulticast",
+        "isInRange",
+        "lessThan",
+        "lessThanOrEqual",
+        "greaterThan",
+        "greaterThanOrEqual",
+    }
+)
+_GUARDABLE_EXT = frozenset({"ip", "decimal"})
+_GUARDABLE_UNARY = frozenset({"!", "neg"})
+_GUARDABLE_BINARY = frozenset(
+    {"==", "!=", "<", "<=", ">", ">=", "in", "+", "-", "*"}
+)
+
+
+def host_guardable(expr: ast.Expr) -> bool:
+    """True when the PYTHON encoder can evaluate ``expr`` per request with
+    the reference interpreter and classify the outcome as bool / error —
+    the admission condition for the negated-hard HARD_OK guard path
+    (lower.harden_clause). Structural whitelist over the AST: every node
+    kind here is handled by lang/eval.evaluate; an unknown node kind (a
+    future parser extension this compiler predates) must NOT ride the
+    guard path, because its evaluation behavior is unproven."""
+    e = expr
+    if isinstance(e, (ast.Lit, ast.EntityLit, ast.Var)):
+        return True
+    if isinstance(e, (ast.GetAttr, ast.HasAttr)):
+        return host_guardable(e.obj)
+    if isinstance(e, (ast.And, ast.Or)):
+        return host_guardable(e.left) and host_guardable(e.right)
+    if isinstance(e, ast.Unary):
+        return e.op in _GUARDABLE_UNARY and host_guardable(e.arg)
+    if isinstance(e, ast.Binary):
+        return (
+            e.op in _GUARDABLE_BINARY
+            and host_guardable(e.left)
+            and host_guardable(e.right)
+        )
+    if isinstance(e, ast.If):
+        return (
+            host_guardable(e.cond)
+            and host_guardable(e.then)
+            and host_guardable(e.els)
+        )
+    if isinstance(e, ast.Like):
+        return host_guardable(e.obj)
+    if isinstance(e, ast.Is):
+        return host_guardable(e.obj) and (
+            e.in_entity is None or host_guardable(e.in_entity)
+        )
+    if isinstance(e, ast.SetLit):
+        return all(host_guardable(x) for x in e.elems)
+    if isinstance(e, ast.RecordLit):
+        return all(host_guardable(v) for _k, v in e.pairs)
+    if isinstance(e, ast.MethodCall):
+        return (
+            e.method in _GUARDABLE_METHODS
+            and host_guardable(e.obj)
+            and all(host_guardable(a) for a in e.args)
+        )
+    if isinstance(e, ast.ExtCall):
+        return e.func in _GUARDABLE_EXT and all(
+            host_guardable(a) for a in e.args
+        )
+    return False
+
+
 def dyn_spec(expr: ast.Expr):
     """DynContains/DynEq/DynCmp for a natively-evaluable hard expression,
     else None."""

@@ -12,10 +12,41 @@ from typing import List, Optional
 
 from ..lang.entities import EntityMap
 from ..lang.eval import Env, Request, evaluate
-from ..lang.values import CedarRecord, CedarSet, EvalError, value_key
+from ..lang.values import (
+    CedarRecord,
+    CedarSet,
+    Decimal,
+    EntityUID,
+    EvalError,
+    IPAddr,
+    value_key,
+)
 from .pack import EncodePlan
 
 _MISSING = object()
+
+
+def value_tag(v) -> str:
+    """The value_key tag of a Cedar value in O(1) (no element hashing):
+    the runtime type fact TYPE_ERR literals test."""
+    t = type(v)
+    if t is bool:
+        return "b"
+    if t is int:
+        return "l"
+    if t is str:
+        return "s"
+    if isinstance(v, EntityUID):
+        return "e"
+    if isinstance(v, CedarSet):
+        return "S"
+    if isinstance(v, CedarRecord):
+        return "R"
+    if isinstance(v, Decimal):
+        return "d"
+    if isinstance(v, IPAddr):
+        return "i"
+    return "?"
 
 
 def _slot_value(plan_root, path):
@@ -30,18 +61,10 @@ def _slot_value(plan_root, path):
 
 
 def _ancestors_or_self(entities: EntityMap, uid):
-    seen = {uid}
-    stack = [uid]
-    while stack:
-        cur = stack.pop()
-        ent = entities.get(cur)
-        if ent is None:
-            continue
-        for p in ent.parents:
-            if p not in seen:
-                seen.add(p)
-                stack.append(p)
-    return seen
+    # memoized on the map (EntityMap.closure_of): a deep ancestor chain
+    # costs one walk per map, after which every literal/slot/request
+    # sharing the map reads the precomputed closure
+    return entities.closure_of(uid)
 
 
 def encode_request(
@@ -111,6 +134,18 @@ def encode_request(
                 except EvalError:
                     continue
                 for lid in sh.get(ek, ()):
+                    active.add(lid)
+        isl = plan.in_slot_idx.get(slot)
+        if isl is not None and isinstance(v, EntityUID):
+            # ancestor-closure `in`: every closure member's target hits
+            for anc in entities.closure_of(v):
+                for lid in isl.get((anc.type, anc.id), ()):
+                    active.add(lid)
+        te = plan.type_err_idx.get(slot)
+        if te is not None:
+            tag = value_tag(v)
+            for lid, want in te:
+                if want != tag:
                     active.add(lid)
 
     # hard literals: interpreter-evaluated. An EvalError activates the

@@ -44,6 +44,16 @@ IS = "is"  # var entity type == type name
 EQ_ENTITY = "eq_entity"  # var uid == constant uid
 ENTITY_IN = "entity_in"  # var uid in (descendant-of) constant uid
 ENTITY_IN_ANY = "entity_in_any"  # var uid in any of constant uids
+IN_SLOT = "in_slot"  # slot (an entity ref) in any of constant uids: the
+# encoder resolves the slot value and tests its ancestor-or-self closure
+# (EntityMap.closure_of) against the targets — deep ancestor-graph `in`
+# over attribute chains becomes a real literal instead of a HARD expr
+TYPE_ERR = "type_err"  # slot present but its runtime value-key tag differs
+# from `data` (the tag a typed operation needs: "s" like, "l" cmp, "S"
+# contains, "e" in). Positive in error clauses it makes Cedar's type
+# errors an explicit device signal; negated before a typed literal it is
+# the guard that makes NEGATED typed tests on statically-untyped slots
+# error-exact (the flow-typing twin of the HAS presence guard)
 HARD = "hard"  # arbitrary expr evaluated host-side by the interpreter
 HARD_ERR = "hard_err"  # host evaluation of the expr raised an EvalError
 HARD_OK = "hard_ok"  # host evaluation produced a bool (no error): the
@@ -114,6 +124,11 @@ class LoweredPolicy:
     # (store.go:37) and are surfaced in diagnostics, so the device must
     # detect them, not just fail to match.
     error_clauses: List[Clause] = field(default_factory=list)
+    # True when the policy exceeded the preferred packing budgets
+    # (MAX_CLAUSES DNF rows or MAX_LITERALS per clause) and lowered via
+    # spillover instead of falling back — surfaced by the analyzer as a
+    # capacity finding, never a semantics cliff
+    spilled: bool = False
 
 
 @dataclass

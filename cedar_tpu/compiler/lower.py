@@ -52,6 +52,7 @@ from .ir import (
     HARD_OK,
     HAS,
     IN_SET,
+    IN_SLOT,
     IS,
     LIKE,
     Literal,
@@ -59,11 +60,59 @@ from .ir import (
     SET_HAS,
     Slot,
     TRUE,
+    TYPE_ERR,
     Unlowerable,
 )
 
 MAX_CLAUSES = 96
 MAX_LITERALS = 32
+
+# Spillover ceilings: the W-matmul rule form holds a conjunction of ANY
+# width (one [L] column, thresh = #positive literals) and a policy's DNF
+# rows are just sibling columns in its (tier, effect) group, so MAX_CLAUSES
+# / MAX_LITERALS are *work budgets* on the ordered-DNF expansion, not
+# device limits. Past the preferred budgets the lowerer keeps going — the
+# policy packs as extra clause rows / wider columns and is flagged
+# ``spilled`` for the capacity analyzer — up to these hard ceilings, which
+# exist only to stop genuinely exponential alternations from eating the
+# compile. Only past THEM does the policy fall back to the interpreter.
+SPILL_MAX_CLAUSES = 2048
+SPILL_MAX_LITERALS = 512
+
+
+@dataclass(frozen=True)
+class LowerOptions:
+    """Feature gates of the lowering pipeline. The defaults are the full
+    compiler; ``LEGACY_OPTS`` reproduces the pre-spillover behavior so the
+    coverage bench (bench.py --coverage) can measure each mechanism's
+    contribution against the same corpus with the same code."""
+
+    # clause/literal spillover past the preferred packing budgets
+    spill: bool = True
+    # thread value-type facts proven by earlier positive literals through
+    # the clause (flow-sensitive typing for negated typed tests)
+    flow_typing: bool = True
+    # TYPE_ERR literals: exact device detection of Cedar type errors on
+    # statically-untyped slots (and the negated-literal type guard)
+    type_guards: bool = True
+    # admit the full host-guardable expression class (dyn.host_guardable)
+    # to the negated-hard HARD_OK guard path, not just the native dyn class
+    host_guard: bool = True
+    # lower `<attr-chain> in Entity` to IN_SLOT ancestor-closure literals
+    slot_in: bool = True
+
+
+DEFAULT_OPTS = LowerOptions()
+LEGACY_OPTS = LowerOptions(
+    spill=False,
+    flow_typing=False,
+    type_guards=False,
+    host_guard=False,
+    slot_in=False,
+)
+
+# value_key tag a typed operation requires of its operand
+_WANT_TAG = {LIKE: "s", CMP: "l", SET_HAS: "S", IN_SLOT: "e"}
 
 # Coarse Cedar types for static safety analysis of the closed authz schema.
 STR, LONG, BOOL, SET, RECORD, ENTITY, UNKNOWN = (
@@ -75,6 +124,16 @@ STR, LONG, BOOL, SET, RECORD, ENTITY, UNKNOWN = (
     "entity",
     "?",
 )
+
+# static schema type -> runtime value_key tag (UNKNOWN has no entry)
+_STATIC_TAG = {
+    STR: "s",
+    LONG: "l",
+    BOOL: "b",
+    SET: "S",
+    RECORD: "R",
+    ENTITY: "e",
+}
 
 AUTHZ_ATTR_TYPES: Dict[str, Dict[str, str]] = {
     "k8s::User": {"name": STR, "extra": SET},
@@ -204,7 +263,9 @@ def slot_accesses(slot: Slot, include_last: bool = True) -> Tuple[Slot, ...]:
 # --------------------------------------------------------- literal building
 
 
-def leaf_literal(e: ast.Expr) -> Tuple[Literal, bool]:
+def leaf_literal(
+    e: ast.Expr, opts: LowerOptions = DEFAULT_OPTS
+) -> Tuple[Literal, bool]:
     """Lower a leaf boolean expression to (Literal, negated)."""
     if isinstance(e, ast.Binary) and e.op in ("==", "!="):
         neg = e.op == "!="
@@ -276,6 +337,37 @@ def leaf_literal(e: ast.Expr) -> Tuple[Literal, bool]:
                     (x.uid.type, x.uid.id) for x in e.right.elems
                 )
                 return (Literal(ENTITY_IN_ANY, var=var, data=uids), False)
+        if opts.slot_in:
+            # `<attr-chain> in <entity lits>`: the encoder resolves the
+            # slot value and tests its precomputed ancestor-or-self
+            # closure (EntityMap.closure_of) against the targets — one
+            # slot-match literal instead of an opaque HARD expr. A
+            # non-entity value is a Cedar type error; harden_clause's
+            # TYPE_ERR machinery (want tag "e") makes that path exact.
+            s = slot_of(e.left)
+            if s is not None and s[1]:
+                uids = None
+                if isinstance(e.right, ast.EntityLit):
+                    u = e.right.uid
+                    uids = frozenset({(u.type, u.id)})
+                elif isinstance(e.right, ast.SetLit) and all(
+                    isinstance(x, ast.EntityLit) for x in e.right.elems
+                ):
+                    uids = frozenset(
+                        (x.uid.type, x.uid.id) for x in e.right.elems
+                    )
+                if uids is not None:
+                    return (
+                        Literal(
+                            IN_SLOT,
+                            var=s[0],
+                            slot=s,
+                            data=uids,
+                            accesses=slot_accesses(s),
+                            total=False,
+                        ),
+                        False,
+                    )
         return _hard(e), False
     if isinstance(e, ast.HasAttr):
         s = slot_of(e.obj)
@@ -355,17 +447,23 @@ def _hard(e: ast.Expr) -> Literal:
 # ------------------------------------------- ordered-DNF expansion (T and F)
 
 
-def _conj(prefixes: List[Clause], suffixes: List[Clause]) -> List[Clause]:
+def _conj(
+    prefixes: List[Clause],
+    suffixes: List[Clause],
+    opts: LowerOptions = DEFAULT_OPTS,
+) -> List[Clause]:
+    lit_cap = SPILL_MAX_LITERALS if opts.spill else MAX_LITERALS
+    clause_cap = SPILL_MAX_CLAUSES if opts.spill else MAX_CLAUSES
     out = []
     for p in prefixes:
         for s in suffixes:
             c = p + s
-            if len(c) > MAX_LITERALS:
+            if len(c) > lit_cap:
                 raise Unlowerable(
                     "clause literal limit exceeded", code="literal_limit"
                 )
             out.append(c)
-            if len(out) > MAX_CLAUSES:
+            if len(out) > clause_cap:
                 raise Unlowerable(
                     "clause count limit exceeded", code="clause_limit"
                 )
@@ -391,30 +489,38 @@ def _rewrite_elem_total(e: ast.Expr) -> bool:
     return False
 
 
-def expand(e: ast.Expr, want: bool) -> List[Clause]:
+def expand(
+    e: ast.Expr, want: bool, opts: LowerOptions = DEFAULT_OPTS
+) -> List[Clause]:
     """Clause set whose disjunction == (e evaluates to `want`), with each
     clause one short-circuit evaluation path."""
     if isinstance(e, ast.Lit) and type(e.value) is bool:
         return [()] if e.value is want else []
     if isinstance(e, ast.Unary) and e.op == "!":
-        return expand(e.arg, not want)
+        return expand(e.arg, not want, opts)
     if isinstance(e, ast.And):
-        t_left = expand(e.left, True)
+        t_left = expand(e.left, True, opts)
         if want:
-            return _conj(t_left, expand(e.right, True))
-        return expand(e.left, False) + _conj(t_left, expand(e.right, False))
+            return _conj(t_left, expand(e.right, True, opts), opts)
+        return expand(e.left, False, opts) + _conj(
+            t_left, expand(e.right, False, opts), opts
+        )
     if isinstance(e, ast.Or):
-        f_left = expand(e.left, False)
+        f_left = expand(e.left, False, opts)
         if want:
-            return expand(e.left, True) + _conj(f_left, expand(e.right, True))
-        return _conj(f_left, expand(e.right, False))
+            return expand(e.left, True, opts) + _conj(
+                f_left, expand(e.right, True, opts), opts
+            )
+        return _conj(f_left, expand(e.right, False, opts), opts)
     if isinstance(e, ast.If):
-        t_c, f_c = expand(e.cond, True), expand(e.cond, False)
-        return _conj(t_c, expand(e.then, want)) + _conj(f_c, expand(e.els, want))
+        t_c, f_c = expand(e.cond, True, opts), expand(e.cond, False, opts)
+        return _conj(t_c, expand(e.then, want, opts), opts) + _conj(
+            f_c, expand(e.els, want, opts), opts
+        )
     if isinstance(e, ast.Is) and e.in_entity is not None:
         # x is T in y  ==  (x is T) && (x in y)
         conj = ast.And(ast.Is(e.obj, e.entity_type), ast.Binary("in", e.obj, e.in_entity))
-        return expand(conj, want)
+        return expand(conj, want, opts)
     if (
         isinstance(e, ast.MethodCall)
         and e.method in ("containsAny", "containsAll")
@@ -434,8 +540,8 @@ def expand(e: ast.Expr, want: bool) -> List[Clause]:
         chain: ast.Expr = ast.MethodCall(e.obj, "contains", (e.args[0].elems[0],))
         for el in e.args[0].elems[1:]:
             chain = op(chain, ast.MethodCall(e.obj, "contains", (el,)))
-        return expand(chain, want)
-    lit, neg = leaf_literal(e)
+        return expand(chain, want, opts)
+    lit, neg = leaf_literal(e, opts)
     if lit.kind == TRUE:
         # constant-folded leaf: (TRUE xor neg) == want?
         return [()] if (not neg) == want else []
@@ -594,12 +700,15 @@ def _has_lit(acc: Slot) -> Literal:
 
 
 def harden_clause(
-    clause: Clause, policy_type_ctx: Dict[str, Optional[str]], schema: SchemaInfo
+    clause: Clause,
+    policy_type_ctx: Dict[str, Optional[str]],
+    schema: SchemaInfo,
+    opts: LowerOptions = DEFAULT_OPTS,
 ) -> Tuple[Clause, List[Clause]]:
     """Make the clause error-exact w.r.t. Cedar semantics. Returns
     (hardened match clause, error clauses).
 
-    Two mechanisms:
+    Three mechanisms:
 
     1. A negated literal whose attribute access could error would evaluate
        true on the device while Cedar skips the policy; insert a synthetic
@@ -612,12 +721,27 @@ def harden_clause(
        evaluation of this policy errors there. Unlowerable hard
        sub-expressions get a HARD_ERR indicator the host encoder activates
        when interpretation raises.
+    3. A typed operation (like/cmp/contains/slot-`in`) whose operand type
+       is not statically certain can raise a Cedar TYPE error. The clause
+       threads a little flow-typing state: value-tag facts proven by
+       earlier positive literals on the same slot (an EQ against a string
+       constant proves "s", a passed `like` proves "s", a passed slot-`in`
+       proves "e", ...). Where neither schema nor flow proves the operand
+       type, a TYPE_ERR literal makes the error path exact: positive in an
+       error clause (the device detects the type error Cedar raises), and
+       negated as a guard before a NEGATED typed literal (the type-error
+       path kills the clause exactly where Cedar skips the policy).
 
-    Raises Unlowerable where neither helps: negated typed operations
-    (like/cmp/contains) on attributes of statically unknown type, and
-    negated opaque expressions that may error for non-presence reasons."""
+    Raises Unlowerable only where the enabled mechanisms don't apply:
+    with ``opts.type_guards`` off, negated typed operations on attributes
+    of unknown type; with ``opts.host_guard`` off, negated opaque
+    expressions outside the native dyn class."""
+    from .dyn import dyn_spec, host_guardable
+
     proven: Set[Slot] = set()
     type_ctx = dict(policy_type_ctx)
+    # slot -> proven runtime value_key tag on every live evaluation path
+    slot_tags: Dict[Slot, str] = {}
     out: List[ClauseLit] = []
     errors: List[Clause] = []
     for cl in clause:
@@ -638,15 +762,18 @@ def harden_clause(
             if not ok or t != BOOL:
                 if cl.negated:
                     # a negated hard literal that errors would evaluate true
-                    # on the device while Cedar skips the policy. For the
-                    # native-evaluable dyn class we insert a positive
-                    # HARD_OK guard (active iff host evaluation produced a
-                    # bool) right before it — error kills the clause on the
-                    # same path Cedar kills the policy. Anything else stays
-                    # interpreter-fallback (hybrid gate).
-                    from .dyn import dyn_spec
-
-                    if dyn_spec(lit.expr) is None:
+                    # on the device while Cedar skips the policy. For any
+                    # expression the host encoder can evaluate-and-classify
+                    # (the native dyn class, or — with opts.host_guard —
+                    # the full interpreter-evaluable class) we insert a
+                    # positive HARD_OK guard (active iff host evaluation
+                    # produced a bool) right before it — error kills the
+                    # clause on the same path Cedar kills the policy.
+                    # Anything else stays interpreter-fallback.
+                    guardable = dyn_spec(lit.expr) is not None or (
+                        opts.host_guard and host_guardable(lit.expr)
+                    )
+                    if not guardable:
                         raise Unlowerable(
                             "negated unlowerable expression may error at runtime",
                             code="negated_opaque",
@@ -662,21 +789,42 @@ def harden_clause(
                     out.append(
                         ClauseLit(Literal(HARD_OK, expr=lit.expr), False)
                     )
-        if cl.negated and not lit.total and lit.kind != HARD:
-            # typed operations need the operand type to be static; a
-            # presence guard can't prevent a type error
-            if lit.kind in (LIKE, CMP, SET_HAS):
-                want = {LIKE: STR, CMP: LONG, SET_HAS: SET}[lit.kind]
-                got = schema.attr_type(type_ctx.get(lit.var), lit.var, lit.slot[1])
-                if got != want:
+        type_guard: Optional[ClauseLit] = None
+        want_tag = _WANT_TAG.get(lit.kind) if not lit.total else None
+        if want_tag is not None:
+            got = schema.attr_type(type_ctx.get(lit.var), lit.var, lit.slot[1])
+            type_safe = _STATIC_TAG.get(got) == want_tag or (
+                opts.flow_typing and slot_tags.get(lit.slot) == want_tag
+            )
+            if not type_safe:
+                if opts.type_guards:
+                    te = Literal(
+                        TYPE_ERR, var=lit.var, slot=lit.slot, data=want_tag
+                    )
+                    # Cedar raises a type error exactly when the accesses
+                    # succeeded (presence guards) and the value's tag is
+                    # wrong — an explicit tier-stop signal the device must
+                    # detect, for POSITIVE literals too (a silent no-match
+                    # would resume a tier descent the error stops)
+                    errors.append(
+                        tuple(out) + tuple(guards) + (ClauseLit(te, False),)
+                    )
+                    if cl.negated:
+                        type_guard = ClauseLit(te, True)
+                elif cl.negated:
+                    # legacy mode: a presence guard can't prevent a type
+                    # error, so the policy falls back
                     raise Unlowerable(
                         f"negated {lit.kind} on attribute of uncertain type",
                         code="negated_untyped",
                     )
+        if cl.negated and not lit.total and lit.kind != HARD:
             # presence guards keep the device path aligned with Cedar's
             # error-skip on the negated literal
             out.extend(guards)
             proven.update(g.lit.slot for g in guards)
+            if type_guard is not None:
+                out.append(type_guard)
         if not cl.negated:
             if lit.kind == IS and lit.var in type_ctx and type_ctx[lit.var] is None:
                 type_ctx[lit.var] = lit.data
@@ -685,8 +833,24 @@ def harden_clause(
                 proven.update(lit.accesses)
             elif lit.accesses:
                 proven.update(lit.accesses)
+            # flow-typing facts: a passed positive literal pins the slot
+            # value's runtime tag on every live path from here on
+            if lit.slot is not None:
+                if lit.kind == EQ and isinstance(lit.data, tuple):
+                    slot_tags[lit.slot] = lit.data[0]
+                elif lit.kind == IN_SET:
+                    tags = {k[0] for k in lit.data if isinstance(k, tuple)}
+                    if len(tags) == 1:
+                        slot_tags[lit.slot] = next(iter(tags))
+        if want_tag is not None:
+            # the typed literal itself was processed without falling back:
+            # on every path where the clause is still live past it, the
+            # operand had the required tag (positive: the test passed;
+            # negated: the schema/flow proof or the TYPE_ERR guard holds)
+            slot_tags[lit.slot] = want_tag
         out.append(cl)
-    if len(out) > MAX_LITERALS:
+    lit_cap = SPILL_MAX_LITERALS if opts.spill else MAX_LITERALS
+    if len(out) > lit_cap:
         raise Unlowerable(
             "clause literal limit exceeded after hardening",
             code="literal_limit",
@@ -745,15 +909,20 @@ def scope_literals(policy: ast.Policy) -> Tuple[List[ClauseLit], Dict[str, Optio
 
 
 def lower_policy(
-    policy: ast.Policy, tier: int, schema: SchemaInfo = AUTHZ_SCHEMA_INFO
+    policy: ast.Policy,
+    tier: int,
+    schema: SchemaInfo = AUTHZ_SCHEMA_INFO,
+    opts: Optional[LowerOptions] = None,
 ) -> LoweredPolicy:
+    opts = opts or DEFAULT_OPTS  # None always means the full compiler
     prefix, type_ctx = scope_literals(policy)
 
     # conditions are evaluated in order: when{c} == c, unless{c} == !c
     cond_clauses: List[Clause] = [()]
     for cond in policy.conditions:
         body = cond.body if cond.kind == "when" else ast.Unary("!", cond.body)
-        cond_clauses = _conj(cond_clauses, expand(body, True))
+        cond_clauses = _conj(cond_clauses, expand(body, True, opts), opts)
+    spilled = len(cond_clauses) > MAX_CLAUSES
 
     clauses: List[Clause] = []
     error_clauses: List[Clause] = []
@@ -773,13 +942,13 @@ def lower_policy(
         # and is correct to harden post-simplification. (Unlowerable from
         # either call propagates: if the error behavior needs the
         # interpreter, the policy falls back.)
-        _dropped, errs = harden_clause(full, type_ctx, schema)
+        _dropped, errs = harden_clause(full, type_ctx, schema, opts)
         if simplified is not None:
             if simplified == full:  # common case: nothing was simplified
                 hardened = _dropped
             else:
                 hardened, _errs_simplified = harden_clause(
-                    simplified, type_ctx, schema
+                    simplified, type_ctx, schema, opts
                 )
             # re-simplify AFTER hardening: an inserted presence guard can
             # contradict an existing negated HAS on the same access (e.g.
@@ -800,23 +969,27 @@ def lower_policy(
             if key not in seen_err:
                 seen_err.add(key)
                 error_clauses.append(ec)
+    spilled = spilled or any(len(c) > MAX_LITERALS for c in clauses)
     return LoweredPolicy(
         policy=policy,
         tier=tier,
         effect=policy.effect,
         clauses=clauses,
         error_clauses=error_clauses,
+        spilled=spilled,
     )
 
 
 def lower_tiers(
-    tiers: List[PolicySet], schema: SchemaInfo = AUTHZ_SCHEMA_INFO
+    tiers: List[PolicySet],
+    schema: SchemaInfo = AUTHZ_SCHEMA_INFO,
+    opts: Optional[LowerOptions] = None,
 ) -> CompiledPolicies:
     out = CompiledPolicies(n_tiers=len(tiers))
     for tier_idx, ps in enumerate(tiers):
         for policy in ps.policies():
             try:
-                out.lowered.append(lower_policy(policy, tier_idx, schema))
+                out.lowered.append(lower_policy(policy, tier_idx, schema, opts))
             except Unlowerable as e:
                 out.fallback.append(
                     FallbackPolicy(

@@ -35,12 +35,14 @@ from .ir import (
     HARD_OK,
     HAS,
     IN_SET,
+    IN_SLOT,
     IS,
     LIKE,
     Literal,
     LoweredPolicy,
     SET_HAS,
     Slot,
+    TYPE_ERR,
 )
 
 PERMIT_IDX = 0
@@ -212,6 +214,19 @@ class EncodePlan:
     entity_in_idx: Dict[str, Dict[Tuple[str, str], List[int]]] = field(
         default_factory=dict
     )
+    # slot-valued entity `in`: slot -> target (type, id) -> literal ids;
+    # the encoder resolves the slot value and tests its ancestor-or-self
+    # closure (EntityMap.closure_of) against the targets
+    in_slot_idx: Dict[Slot, Dict[Tuple[str, str], List[int]]] = field(
+        default_factory=dict
+    )
+    # type-error indicators: slot -> [(literal id, required value_key
+    # tag)]; active when the slot is present with a differently-tagged
+    # value (in-vocab values ride the activation table rows, out-of-vocab
+    # values are host-tagged into extras)
+    type_err_idx: Dict[Slot, List[Tuple[int, str]]] = field(
+        default_factory=dict
+    )
     is_idx: Dict[str, Dict[str, List[int]]] = field(default_factory=dict)
     # (lit id, ok lit id, expr, error lit id) — each id -1 when absent. The
     # encoder evaluates expr per request: a bool result activates ok (and
@@ -325,6 +340,13 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
                         ok = _dyn_ok[id(e)] = dyn_spec(e) is not None
                     if not ok:
                         return True
+                elif cl.lit.kind == IN_SLOT:
+                    # the C++ encoder has no entity graph to walk a
+                    # closure over; IN_SLOT stays inactive in native
+                    # encodes, so the owning policy must gate (scope rows
+                    # re-run the exact Python path) — under-activation of
+                    # a GATED policy's rules is the one sound direction
+                    return True
         return False
 
     for lp in compiled.lowered:
@@ -520,6 +542,16 @@ def _build_plan(lits: List[Literal]) -> EncodePlan:
             d = plan.entity_in_idx.setdefault(lit.var, {})
             for uid in lit.data:
                 d.setdefault(uid, []).append(i)
+            max_active += 1
+        elif lit.kind == IN_SLOT:
+            d = plan.in_slot_idx.setdefault(lit.slot, {})
+            for uid in lit.data:
+                d.setdefault(uid, []).append(i)
+            slots.add(lit.slot)
+            max_active += 1
+        elif lit.kind == TYPE_ERR:
+            plan.type_err_idx.setdefault(lit.slot, []).append((i, lit.data))
+            slots.add(lit.slot)
             max_active += 1
         elif lit.kind == IS:
             plan.is_idx.setdefault(lit.var, {}).setdefault(lit.data, []).append(i)

@@ -188,9 +188,14 @@ class ShardCompiler:
         self,
         schema: Optional[SchemaInfo] = None,
         buckets: int = DEFAULT_SHARD_BUCKETS,
+        opts=None,
     ):
         self.schema = schema or AUTHZ_SCHEMA_INFO
         self.buckets = max(1, int(buckets))
+        # lowering feature gates (lower.LowerOptions); fixed per compiler
+        # instance, so cached shard slices never mix verdicts from two
+        # different option sets
+        self.opts = opts
         self.partition = None  # analysis.partition.PartitionSpec
         self._shards: Dict[str, CompiledShard] = {}
         self._n_tiers: Optional[int] = None
@@ -387,7 +392,7 @@ class ShardCompiler:
                 pruned += 1
                 continue
             try:
-                lp = lower_policy(base, tier, self.schema)
+                lp = lower_policy(base, tier, self.schema, self.opts)
             except Unlowerable as e:
                 fallback.append(
                     FallbackPolicy(

@@ -33,8 +33,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..lang.eval import Env, evaluate
-from ..lang.values import CedarRecord, CedarSet, EvalError, value_key
-from .encode import _MISSING, _ancestors_or_self, _slot_value
+from ..lang.values import CedarRecord, CedarSet, EntityUID, EvalError, value_key
+from .encode import _MISSING, _ancestors_or_self, _slot_value, value_tag
 from .ir import Slot
 
 # ancestor slots per request variable (beyond these, entity-in activations
@@ -175,13 +175,15 @@ def build_table(plan, n_lits: int, L: int) -> FeatureTable:
         if in_lids:
             anc_vocab[(var, etype, eid)] = rb.add(in_lids)
 
-    # ---- scalar slot rows: eq / in-set / like / cmp / has, folded per value
+    # ---- scalar slot rows: eq / in-set / like / cmp / has / type-err,
+    # folded per value
     for slot in plan.slots:
         has_lids = list(plan.has_idx.get(slot, ()))
         eq = plan.eq_idx.get(slot, {})
         inset = plan.inset_idx.get(slot, {})
         like = plan.like_idx.get(slot, ())
         cmp_tests = plan.cmp_idx.get(slot, ())
+        type_errs = plan.type_err_idx.get(slot, ())
         vocab: Dict[object, int] = {}
         for vk in sorted(set(eq) | set(inset), key=repr):
             lids = list(eq.get(vk, ())) + list(inset.get(vk, ())) + has_lids
@@ -198,6 +200,11 @@ def build_table(plan, n_lits: int, L: int) -> FeatureTable:
                     or (op == ">" and v > c)
                     or (op == ">=" and v >= c)
                 ]
+            # the vocab key's tag IS the value's runtime type: in-vocab
+            # type errors ride the activation row (native path included —
+            # rows are shared device state), only out-of-vocab values need
+            # host tagging into extras
+            lids += [lid for lid, want in type_errs if want != vk[0]]
             vocab[vk] = rb.add(lids)
         scalar_vocab[slot] = vocab
         # present-but-out-of-vocab: `has` always fires; like/cmp are
@@ -295,7 +302,7 @@ def encode_request_codes(
             codes[sidx] = row
         else:
             # out-of-vocabulary value: `has` fires via the present row;
-            # like/cmp tests are host-evaluated
+            # like/cmp/type-err tests are host-evaluated
             codes[sidx] = table.present_row[slot]
             for lid, pattern in plan.like_idx.get(slot, ()):
                 if isinstance(v, str) and pattern.match(v):
@@ -309,6 +316,10 @@ def encode_request_codes(
                         or (op == ">=" and v >= c)
                     ):
                         extras.append(lid)
+            te = plan.type_err_idx.get(slot)
+            if te:
+                tag = value_tag(v)
+                extras.extend(lid for lid, want in te if want != tag)
         # set-contains tests depend on every element: host-side always
         sh = plan.set_has_idx.get(slot)
         if sh is not None and isinstance(v, CedarSet):
@@ -318,6 +329,12 @@ def encode_request_codes(
                 except EvalError:
                     continue
                 extras.extend(sh.get(ek, ()))
+        # ancestor-closure `in`: the precomputed closure's target hits
+        # (EntityMap.closure_of — one walk per map) ride the extras list
+        isl = plan.in_slot_idx.get(slot)
+        if isl is not None and isinstance(v, EntityUID):
+            for anc in entities.closure_of(v):
+                extras.extend(isl.get((anc.type, anc.id), ()))
 
     if plan.hard_lits:
         env = Env(request, entities)

@@ -385,6 +385,281 @@ def synth_corpus(
     )
 
 
+# --------------------------------------------------------------- coverage
+# Adversarial lowerability corpus (ROADMAP item 3 / bench.py --coverage):
+# every Unlowerable family the burn-down tracks, generated deterministically
+# against the same schema-generator/RBAC-converter shapes as the scale
+# corpus, plus matched traffic that exercises each family's match, miss,
+# presence-guard, and error paths.
+
+# family -> what the full compiler does with it
+COVERAGE_FAMILIES = (
+    "spill",            # DNF expansion past MAX_CLAUSES: lowers via spillover
+    "negated_untyped",  # negated like/cmp/contains on untyped context attrs:
+                        # lowers via TYPE_ERR guards + clause flow-typing
+    "ancestor_in",      # attr-chain `in` over deep ancestor graphs: lowers
+                        # to IN_SLOT closure literals
+    "opaque",           # negated arithmetic/ext exprs: lowers via the
+                        # host-guardable HARD_OK path
+    "blowup",           # expansion past SPILL_MAX_CLAUSES: still fallback
+)
+
+_COV_CHANNELS = ("beta", "stable", "canary", "dev")
+_COV_CHAIN_DEPTH = 16  # parent-chain length behind each coverage root group
+
+
+def _coverage_policy(
+    i: int, family: str, seed: int, clusters: int
+) -> Tuple[str, _PolicyParams]:
+    """One adversarial policy of ``family``, scoped like a real cluster
+    policy (apiGroup discriminator first, the schema-generator shape)."""
+    rng = random.Random(f"{seed}:cov:{family}:{i}")
+    cluster = i % clusters
+    group = rng.choice(_cluster_groups(cluster))
+    res = rng.choice(RESOURCES)
+    scope = (
+        f'resource.apiGroup == "{group}" && resource.resource == "{res}"'
+    )
+    params = _PolicyParams(f"cov-{family}", cluster, group, resource=res,
+                           verbs=VERBS)
+    if family in ("spill", "blowup"):
+        # alternation product: ==-chains stay linear per slot (exclusivity
+        # simplification), so clauses multiply ACROSS slots. 12x12=144
+        # raw clauses clears MAX_CLAUSES=96 (spillover territory);
+        # 13x13x13=2197 clears SPILL_MAX_CLAUSES=2048 (genuine fallback).
+        per = 13 if family == "blowup" else 12
+        names = " || ".join(
+            f'resource.name == "cov-n{rng.randint(0, 7)}-{j}"'
+            for j in range(per)
+        )
+        nss = " || ".join(
+            f'resource.namespace == "cov-ns{rng.randint(0, 7)}-{j}"'
+            for j in range(per)
+        )
+        body = f"({names}) && ({nss})"
+        if family == "blowup":
+            subs = " || ".join(
+                f'resource.subresource == "cov-s-{j}"' for j in range(per)
+            )
+            body += f" && ({subs})"
+        src = (
+            "permit (principal, action, resource is k8s::Resource) "
+            f"when {{ {scope} && ({body}) }};"
+        )
+    elif family == "negated_untyped":
+        shape = rng.randrange(3)
+        if shape == 0:
+            neg = f'context.channel like "{rng.choice(_COV_CHANNELS)}*"'
+        elif shape == 1:
+            neg = f"context.build < {rng.randint(10, 99)}"
+        else:
+            neg = f'context.tags.contains("restricted-{rng.randint(0, 3)}")'
+        src = (
+            "permit (principal, action, resource is k8s::Resource) "
+            f"when {{ {scope} }} unless {{ {neg} }};"
+        )
+    elif family == "ancestor_in":
+        root = f"cov-root-{rng.randint(0, 3)}"
+        kw = "unless" if rng.random() < 0.3 else "when"
+        cond = f'context.team in k8s::Group::"{root}"'
+        if kw == "when":
+            src = (
+                "permit (principal, action, resource is k8s::Resource) "
+                f"when {{ {scope} && {cond} }};"
+            )
+        else:
+            src = (
+                "permit (principal, action, resource is k8s::Resource) "
+                f"when {{ {scope} }} unless {{ {cond} }};"
+            )
+    elif family == "opaque":
+        shape = rng.randrange(3)
+        if shape == 0:
+            neg = f"context.n + 1 == {rng.randint(2, 9)}"
+        elif shape == 1:
+            neg = f"context.a * 2 < context.b"
+        else:
+            neg = "ip(context.addr).isLoopback()"
+        src = (
+            "permit (principal, action, resource is k8s::Resource) "
+            f"when {{ {scope} }} unless {{ {neg} }};"
+        )
+    else:
+        raise ValueError(f"unknown coverage family {family!r}")
+    return src, params
+
+
+@dataclass
+class CoverageCorpus:
+    """The adversarial corpus plus its matched traffic. ``families`` maps
+    each family name to the policy ids generated for it, so benches and
+    tests can assert per-family lowering outcomes."""
+
+    policies: List[object]
+    params: List[_PolicyParams]
+    families: Dict[str, List[str]]
+    seed: int
+    clusters: int
+    _tier_cache: Optional[List[PolicySet]] = field(default=None, repr=False)
+
+    def tiers(self) -> List[PolicySet]:
+        if self._tier_cache is None:
+            self._tier_cache = [PolicySet(list(self.policies))]
+        return self._tier_cache
+
+    def chain_entities(self):
+        """The deep ancestor chains behind the ancestor_in roots: each
+        root group ``cov-root-k`` sits atop a ``_COV_CHAIN_DEPTH``-deep
+        parent chain; traffic teams enter at the chain bottom."""
+        from ..lang.entities import Entity
+        from ..lang.values import EntityUID
+
+        ents = []
+        for k in range(4):
+            chain = [f"cov-root-{k}"] + [
+                f"cov-mid-{k}-{d}" for d in range(_COV_CHAIN_DEPTH)
+            ]
+            for child, parent in zip(chain[1:], chain[:-1]):
+                ents.append(
+                    Entity(
+                        EntityUID("k8s::Group", child),
+                        parents=(EntityUID("k8s::Group", parent),),
+                    )
+                )
+        return ents
+
+    def _context(self, rng: random.Random):
+        """One request context drawing every family's keys with mixed
+        types: matches, misses, absent keys (presence-guard paths), and
+        wrong-typed values (the TYPE_ERR / guard-error paths)."""
+        from ..lang.values import CedarRecord, CedarSet, EntityUID
+
+        ctx: Dict[str, object] = {}
+        r = rng.random()
+        if r < 0.7:
+            ctx["channel"] = (
+                f"{rng.choice(_COV_CHANNELS)}-{rng.randint(0, 9)}"
+            )
+        elif r < 0.85:
+            ctx["channel"] = rng.randint(0, 9)  # type error under `like`
+        if rng.random() < 0.8:
+            ctx["build"] = (
+                rng.randint(0, 120) if rng.random() < 0.85 else "not-a-long"
+            )
+        if rng.random() < 0.8:
+            ctx["tags"] = (
+                CedarSet(
+                    [f"restricted-{rng.randint(0, 5)}", "public"]
+                )
+                if rng.random() < 0.85
+                else "restricted-0"  # type error under .contains
+            )
+        r = rng.random()
+        if r < 0.6:
+            k, d = rng.randint(0, 3), rng.randint(0, _COV_CHAIN_DEPTH - 1)
+            ctx["team"] = EntityUID("k8s::Group", f"cov-mid-{k}-{d}")
+        elif r < 0.75:
+            ctx["team"] = EntityUID("k8s::Group", f"other-{rng.randint(0, 3)}")
+        elif r < 0.85:
+            ctx["team"] = "not-an-entity"  # type error under `in`
+        if rng.random() < 0.8:
+            ctx["n"] = rng.randint(0, 9)
+        if rng.random() < 0.8:
+            ctx["a"] = rng.randint(0, 9)
+            ctx["b"] = rng.randint(0, 20)
+        r = rng.random()
+        if r < 0.5:
+            ctx["addr"] = rng.choice(("127.0.0.1", "10.1.2.3", "::1"))
+        elif r < 0.7:
+            ctx["addr"] = "not-an-ip"  # guard-error path
+        return CedarRecord(ctx)
+
+    def items(self, n: int, seed: int = 1) -> list:
+        """n (EntityMap, Request) pairs aimed at the corpus: SAR-shaped
+        resource/principal attributes targeting the generated policies'
+        (group, resource, name, namespace) universe, contexts drawing
+        every family's keys, and the deep group chains merged into each
+        entity map."""
+        from ..entities.attributes import Attributes, UserInfo
+        from ..lang.eval import Request
+        from ..server.authorizer import record_to_cedar_resource
+
+        rng = random.Random(f"{self.seed}:covsar:{seed}")
+        chain = self.chain_entities()
+        out = []
+        for _ in range(n):
+            p = rng.choice(self.params)
+            a = Attributes(
+                user=UserInfo(
+                    name=f"cov-user-{rng.randint(0, 49)}",
+                    uid="u",
+                    groups=(f"cov-team-{rng.randint(0, 9)}",),
+                ),
+                verb=rng.choice(VERBS),
+                namespace=f"cov-ns{rng.randint(0, 7)}-{rng.randint(0, 13)}",
+                api_group=p.group if rng.random() < 0.8 else "other.corp",
+                api_version="v1",
+                resource=p.resource or rng.choice(RESOURCES),
+                name=f"cov-n{rng.randint(0, 7)}-{rng.randint(0, 13)}",
+                resource_request=True,
+            )
+            em, req = record_to_cedar_resource(a)
+            for e in chain:
+                em.add(e)
+            out.append(
+                (em, Request(req.principal, req.action, req.resource,
+                             self._context(rng)))
+            )
+        return out
+
+
+def coverage_corpus(
+    per_family: int = 4,
+    base: int = 24,
+    seed: int = 0,
+    clusters: int = 4,
+    filename_prefix: str = "cov",
+) -> CoverageCorpus:
+    """The adversarial lowerability corpus: ``base`` realistic policies
+    (the scale generator's shapes) + ``per_family`` policies of each
+    COVERAGE_FAMILIES entry, deterministically derived from ``seed``.
+    Coverage numbers measured on it answer "what fraction of a realistic
+    set with THESE constructs serves from the device plane?"."""
+    if per_family < 1:
+        raise ValueError("coverage_corpus: per_family must be >= 1")
+    srcs: List[str] = []
+    params: List[_PolicyParams] = []
+    fam_of: List[str] = []
+    for i in range(base):
+        src, p = _policy_source(i + 1, seed, clusters)
+        srcs.append(src)
+        params.append(p)
+        fam_of.append("base")
+    for family in COVERAGE_FAMILIES:
+        for i in range(per_family):
+            src, p = _coverage_policy(i, family, seed, clusters)
+            srcs.append(src)
+            params.append(p)
+            fam_of.append(family)
+    policies = parse_policies("\n".join(srcs), filename_prefix)
+    if len(policies) != len(srcs):
+        raise RuntimeError("coverage_corpus: parse produced a policy-count "
+                           f"mismatch ({len(policies)} != {len(srcs)})")
+    families: Dict[str, List[str]] = {f: [] for f in COVERAGE_FAMILIES}
+    families["base"] = []
+    for i, p in enumerate(policies):
+        p.policy_id = f"{filename_prefix}-{fam_of[i]}-{i:04d}"
+        p.filename = f"{filename_prefix}-{i:04d}.cedar"
+        families[fam_of[i]].append(p.policy_id)
+    return CoverageCorpus(
+        policies=list(policies),
+        params=params,
+        families=families,
+        seed=seed,
+        clusters=clusters,
+    )
+
+
 def synth_tenant_corpora(
     n: int, tenants: int, seed: int = 0, clusters: int = 4
 ) -> "Dict[str, SynthCorpus]":

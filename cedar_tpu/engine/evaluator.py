@@ -616,6 +616,7 @@ class TPUPolicyEngine:
         shard_buckets: Optional[int] = None,
         partition=None,
         mesh_device_rules: Optional[int] = None,
+        lower_opts=None,
     ):
         """mesh: an optional jax.sharding.Mesh with ("data", "policy") axes
         (parallel.mesh.make_mesh). When set, compiled sets are placed with
@@ -654,6 +655,11 @@ class TPUPolicyEngine:
         import os
 
         self.schema = schema or AUTHZ_SCHEMA_INFO
+        # lowering feature gates (compiler/lower.LowerOptions); None = the
+        # full compiler. bench.py --coverage builds LEGACY_OPTS engines to
+        # measure each newly-lowered family's fallback-vs-device ratio
+        # with the same code on both sides.
+        self.lower_opts = lower_opts
         self.device = device
         self.mesh = mesh
         self.name = name
@@ -778,7 +784,8 @@ class TPUPolicyEngine:
                 from ..compiler.shard import ShardCompiler
 
                 self._shard_compiler = ShardCompiler(
-                    self.schema, buckets=self.shard_buckets
+                    self.schema, buckets=self.shard_buckets,
+                    opts=self.lower_opts,
                 )
                 self._shard_compiler.set_partition(self._partition)
             compiled, info = self._shard_compiler.compile(list(tiers))
@@ -786,7 +793,9 @@ class TPUPolicyEngine:
             lower_s = info["phase_seconds"]["lower"]
         else:
             t_lower = time.monotonic()
-            compiled: CompiledPolicies = lower_tiers(list(tiers), self.schema)
+            compiled: CompiledPolicies = lower_tiers(
+                list(tiers), self.schema, opts=self.lower_opts
+            )
             hash_s = 0.0
             lower_s = time.monotonic() - t_lower
             info = {
@@ -2063,7 +2072,7 @@ class TPUPolicyEngine:
             try:
                 from ..server.metrics import record_fallback_decision
 
-                record_fallback_decision(packed.fallback_codes)
+                record_fallback_decision(packed.fallback_codes, self.name)
             except Exception:  # noqa: BLE001 — metrics never break serving
                 pass
             env = Env(request, entities)

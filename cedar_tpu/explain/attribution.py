@@ -45,10 +45,12 @@ from ..compiler.ir import (
     HARD_OK,
     HAS,
     IN_SET,
+    IN_SLOT,
     IS,
     LIKE,
     SET_HAS,
     TRUE,
+    TYPE_ERR,
 )
 from ..compiler.pack import (
     ERROR_IDX,
@@ -116,6 +118,13 @@ def _uid_str(data) -> str:
     return f'{t}::"{i}"'
 
 
+# value_key tag byte -> operator-facing Cedar type name (TYPE_ERR tests)
+_TAG_NAMES = {
+    "s": "string", "l": "long", "b": "bool", "S": "set",
+    "R": "record", "e": "entity", "d": "decimal", "i": "ipaddr",
+}
+
+
 def literal_test(cl) -> dict:
     """One ClauseLit -> {"attribute", "operator", "value", "negated",
     "source"}: the operator-facing rendering of one attribute test of a
@@ -156,6 +165,16 @@ def literal_test(cl) -> dict:
     elif kind == ENTITY_IN_ANY:
         operator = "in"
         value = [_uid_str(u) for u in lit.data]
+    elif kind == IN_SLOT:
+        # ancestor-closure `in` over an attribute-chain entity value
+        operator = "in"
+        value = sorted(_uid_str(u) for u in lit.data)
+    elif kind == TYPE_ERR:
+        # positive: a Cedar type error was detected (the slot's runtime
+        # value tag differs from what the typed operation needs);
+        # negated: the guard proving the operand had the right type
+        operator = "type-error"
+        value = _TAG_NAMES.get(lit.data, lit.data)
     elif kind in (HARD, HARD_OK, HARD_ERR):
         operator = {
             HARD: "expr",

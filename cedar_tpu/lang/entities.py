@@ -35,11 +35,19 @@ class EntityMap:
 
     def __init__(self, entities: Iterable[Entity] = ()):
         self._by_uid: Dict[EntityUID, Entity] = {}
+        # uid -> frozenset(ancestors-or-self): the precomputed transitive
+        # closure the encoders' `in` tests read (compiler/encode.py,
+        # compiler/table.py). Built lazily per queried uid, invalidated on
+        # add() — a deep ancestor chain costs ONE graph walk per map, not
+        # one per literal per request.
+        self._closure: Dict[EntityUID, frozenset] = {}
         for e in entities:
             self._by_uid[e.uid] = e
 
     def add(self, e: Entity) -> None:
         self._by_uid[e.uid] = e
+        if self._closure:
+            self._closure = {}
 
     def get(self, uid: EntityUID) -> Optional[Entity]:
         return self._by_uid.get(uid)
@@ -56,6 +64,25 @@ class EntityMap:
     def attrs_of(self, uid: EntityUID) -> CedarRecord:
         e = self._by_uid.get(uid)
         return e.attrs if e is not None else CedarRecord()
+
+    def closure_of(self, uid: EntityUID) -> frozenset:
+        """The ancestor-or-self transitive closure of ``uid``, memoized on
+        the map. Cycle-safe (seen-set walk); a dangling uid closes over
+        just itself, matching ``is_ancestor_or_self``'s self-equality."""
+        got = self._closure.get(uid)
+        if got is None:
+            seen = {uid}
+            stack = [uid]
+            while stack:
+                ent = self._by_uid.get(stack.pop())
+                if ent is None:
+                    continue
+                for p in ent.parents:
+                    if p not in seen:
+                        seen.add(p)
+                        stack.append(p)
+            got = self._closure[uid] = frozenset(seen)
+        return got
 
     def is_ancestor_or_self(self, child: EntityUID, anc: EntityUID) -> bool:
         """``child in anc``: true iff child == anc or anc is a transitive

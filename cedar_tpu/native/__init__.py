@@ -12,7 +12,7 @@ returns None and callers keep the pure-Python encode path.
 
 Blob format (little-endian; must match BlobReader in encoder.cpp):
 
-  i32 magic "CTB3" (0x43544233)
+  i32 magic "CTB4" (0x43544234)
   i32 n_slots
   3x var sections (principal, action, resource):
       i32 type_slot, i32 uid_slot, i32 n_anc, i32 anc_slots[...]
@@ -34,6 +34,7 @@ Blob format (little-endian; must match BlobReader in encoder.cpp):
                                                 i32 lit, i32 ok, i32 err,
                                                 kind<=2: tmpl
                                                 kind>=3: i32 n, { tmpl } }
+                          type_err: i32 count, { i32 lit, u8 want-tag } }
   tmpl = u8 kind: 0 const  { str canon }
                 | 2 record { i32 n, { str name, tmpl } }   (names sorted)
                 | 3 set    { i32 n, { tmpl } }             (sorted at runtime)
@@ -174,7 +175,7 @@ def _write_tmpl(w: "_BlobWriter", t) -> None:
 
 def _serialize_table(plan, table) -> bytes:
     w = _BlobWriter()
-    w.i32(0x43544233)
+    w.i32(0x43544234)
     w.i32(table.n_slots)
 
     vars3 = ("principal", "action", "resource")
@@ -282,6 +283,12 @@ def _serialize_table(plan, table) -> bytes:
                     _write_tmpl(w, t)
             else:
                 _write_tmpl(w, spec.tmpl)
+
+        type_errs = plan.type_err_idx.get(slot, ())
+        w.i32(len(type_errs))
+        for lid, want in type_errs:
+            w.i32(lid)
+            w.u8(ord(want))
 
     return w.blob()
 

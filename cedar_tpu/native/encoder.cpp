@@ -425,6 +425,11 @@ struct DynTest {
   std::vector<Tmpl> tmpls;  // kinds 3-4 (eagerly-evaluated element set)
 };
 
+struct TypeErrTest {
+  int32_t lit;   // TYPE_ERR literal id
+  uint8_t want;  // required value_key tag byte ('s', 'l', 'S', 'e', ...)
+};
+
 struct ScalarSlot {
   uint8_t var;       // 0 principal, 1 action, 2 resource, 3 context/other
   bool deep;         // multi-component path => value always missing (authz;
@@ -438,6 +443,11 @@ struct ScalarSlot {
   std::vector<CmpTest> cmps;
   SvMap<std::vector<int32_t>> set_has;
   std::vector<DynTest> dyns;
+  // type-error indicators: active when the slot is PRESENT with a value
+  // whose tag differs from `want` (in-vocab values ride the activation
+  // rows; this list serves the vocab-miss branch, mirroring the Python
+  // lane's value_tag extras)
+  std::vector<TypeErrTest> type_errs;
 };
 
 struct Table {
@@ -511,7 +521,7 @@ bool read_tmpl(BlobReader &r, Tmpl &t, int depth = 0) {
 
 Table *load_table(const uint8_t *blob, size_t len) {
   BlobReader r(blob, len);
-  if (r.i32() != 0x43544233) return nullptr;  // "CTB3"
+  if (r.i32() != 0x43544234) return nullptr;  // "CTB4"
   auto t = std::make_unique<Table>();
   t->n_slots = r.i32();
   for (int v = 0; v < 3; ++v) {
@@ -612,6 +622,13 @@ Table *load_table(const uint8_t *blob, size_t len) {
         return nullptr;
       }
       s.dyns.push_back(std::move(d));
+    }
+    int32_t nte = r.i32();
+    for (int32_t j = 0; j < nte; ++j) {
+      TypeErrTest te;
+      te.lit = r.i32();
+      te.want = r.u8();
+      s.type_errs.push_back(te);
     }
     t->slots.push_back(std::move(s));
   }
@@ -1453,6 +1470,12 @@ void encode_one(const Table &t, Features &f, int32_t *codes, ExtrasOut &extras,
         for (const auto &lt : s.likes)
           if (like_match(lt.comps, v.str)) extras.push(lt.lit);
         // cmp tests only apply to longs; authz values are strings
+      }
+      if (!s.type_errs.empty()) {
+        // authz slot values are strings or string sets
+        const uint8_t tag = v.kind == Value::STRV ? 's' : 'S';
+        for (const auto &te : s.type_errs)
+          if (te.want != tag) extras.push(te.lit);
       }
     }
     if (v.kind == Value::SETV && !s.set_has.empty()) {
@@ -2302,6 +2325,22 @@ void encode_adm_one(const Table &t, AdmFeatures &f, int32_t *codes,
                                   : x >= ct.c;
           if (hit) extras.push(ct.lit);
         }
+      }
+      if (!s.type_errs.empty()) {
+        // mirror compiler/encode.value_tag over the CVal kinds
+        uint8_t tag;
+        switch (v->kind) {
+          case CVal::STRV: tag = 's'; break;
+          case CVal::LONGV: tag = 'l'; break;
+          case CVal::BOOLV: tag = 'b'; break;
+          case CVal::IPV: tag = 'i'; break;
+          case CVal::SETV: tag = 'S'; break;
+          case CVal::RECV: tag = 'R'; break;
+          case CVal::ENTV: tag = 'e'; break;
+          default: tag = '?'; break;
+        }
+        for (const auto &te : s.type_errs)
+          if (te.want != tag) extras.push(te.lit);
       }
     }
     if (is_set && !s.set_has.empty()) {

@@ -267,7 +267,9 @@ def _engine_doc(engine) -> dict:
             doc["fallback"] = {
                 "policies": len(packed.fallback),
                 "codes": dict(sorted(by_code.items())),
-                "served_decisions": metrics.fallback_decision_counts(),
+                "served_decisions": metrics.fallback_decision_counts(
+                    engine.name
+                ),
             }
     except Exception:  # noqa: BLE001 — debug must not 500
         log.exception("fallback status failed")
@@ -1993,6 +1995,38 @@ class WebhookServer:
                         return
                     try:
                         doc = server.analysis_provider() or {}
+                        # join the served-traffic ranking onto the static
+                        # coverage rollup: which Unlowerable codes carry
+                        # real decisions (cedar_fallback_decisions_total)
+                        # tells the operator the next burn-down target,
+                        # not just which codes exist in the set. The
+                        # provider doc is either one report or a dict of
+                        # per-engine reports keyed by engine name
+                        # ({"authorization": ...}) — nested reports join
+                        # THEIR engine's slice of the counter, so one
+                        # plane's served fallback traffic never reads as
+                        # another's burn-down signal.
+                        def _joined(rep, engine=None):
+                            if not isinstance(rep, dict):
+                                return rep
+                            if isinstance(rep.get("coverage"), dict):
+                                rep = dict(rep)
+                                rep["coverage"] = dict(
+                                    rep["coverage"],
+                                    served_decisions=(
+                                        metrics.fallback_decision_counts(
+                                            engine
+                                        )
+                                    ),
+                                )
+                            return rep
+
+                        doc = _joined(doc)
+                        if isinstance(doc, dict):
+                            doc = {
+                                k: _joined(v, engine=k)
+                                for k, v in doc.items()
+                            }
                     except Exception:  # noqa: BLE001 — debug must not 500
                         log.exception("analysis provider failed")
                         doc = {"error": "analysis provider failed"}

@@ -310,10 +310,13 @@ fallback_decisions_total = REGISTRY.register(
         "Decisions whose evaluation was interpreter-merged because the "
         "serving plane carries unlowerable policies, partitioned by "
         "Unlowerable reason code (one increment per decision per distinct "
-        "code present). The burn-down signal for the lowerability "
-        "coverage drive: lowering a construct family drops its code's "
-        "rate to zero (docs/analysis.md; tallied on /debug/engine).",
-        ["code"],
+        "code present) and serving engine (authorization/admission/"
+        "replica — names come from code, never request data, so the "
+        "label set is bounded). The burn-down signal for the "
+        "lowerability coverage drive: lowering a construct family drops "
+        "its code's rate to zero (docs/analysis.md; tallied on "
+        "/debug/engine).",
+        ["code", "engine"],
     )
 )
 
@@ -362,20 +365,35 @@ def clear_tenant_policies(tenant: str) -> None:
         tenant_policies.remove(tenant=tenant)
 
 
-def record_fallback_decision(codes) -> None:
+def record_fallback_decision(codes, engine: str = "") -> None:
     """One interpreter-merged decision under each distinct Unlowerable
-    code it was served with (precomputed tuple, compiler/pack.py)."""
+    code it was served with (precomputed tuple, compiler/pack.py), on the
+    named serving engine."""
+    eng = engine or "unknown"
     for code in codes or ("unlowerable",):
-        fallback_decisions_total.inc(code=code)
+        fallback_decisions_total.inc(code=code, engine=eng)
 
 
-def fallback_decision_counts() -> dict:
-    """Snapshot of cedar_fallback_decisions_total for /debug/engine."""
+def fallback_decision_counts(engine=None) -> dict:
+    """Per-code snapshot of cedar_fallback_decisions_total for
+    /debug/engine and /debug/analysis: codes aggregated across all
+    engines by default, or one serving PLANE's slice when ``engine`` is
+    given — an authorization plane's served fallback traffic must never
+    read as the admission plane's burn-down signal. A plane filter
+    includes its fleet replicas (``<engine>-r<i>``, cli/webhook.py): the
+    replicas serve the same policy plane, so their fallback decisions
+    belong to its burn-down ranking."""
     with fallback_decisions_total._lock:
-        return {
-            dict(key).get("code", ""): int(v)
-            for key, v in fallback_decisions_total._values.items()
-        }
+        out: dict = {}
+        for key, v in fallback_decisions_total._values.items():
+            kd = dict(key)
+            if engine is not None:
+                got = kd.get("engine", "")
+                if got != engine and not got.startswith(f"{engine}-r"):
+                    continue
+            code = kd.get("code", "")
+            out[code] = out.get(code, 0) + int(v)
+        return out
 
 
 # --------------------------------------------------------- overload control

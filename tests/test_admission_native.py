@@ -679,20 +679,27 @@ def test_admission_fastpath_hybrid_with_fallback_policies():
     fallback scopes become device gate rules (compiler.pack), gate-flagged
     rows re-run the exact Python path, and every other row stays native —
     one unlowerable policy no longer disables the whole fast path."""
-    # a negated dynamic extension call is a negated unlowerable
-    # expression — a genuine interpreter-fallback policy (the ==/!= joins
-    # that used to serve this role are native dyn classes now)
-    src = """
+    # an ordered-DNF alternation product past the spillover ceiling (2^12
+    # > SPILL_MAX_CLAUSES) — a genuine interpreter-fallback policy
+    # (negated extension calls lower via the host-guard path now); each
+    # factor is true in the "default" namespace, so the fallback forbid
+    # fires for the SA's own-namespace create and not for ns "other"
+    _blowup = " && ".join(
+        '(resource.metadata.namespace == "default" '
+        '|| resource.metadata.name == "zzz")'
+        for _ in range(12)
+    )
+    src = f"""
 forbid (principal is k8s::ServiceAccount,
         action == k8s::admission::Action::"create",
         resource is core::v1::ConfigMap)
-  unless { ip(resource.metadata.name).isLoopback() };
+  when {{ {_blowup} }};
 forbid (principal, action == k8s::admission::Action::"create",
         resource is core::v1::ConfigMap)
-  when {
+  when {{
     resource.metadata has labels &&
-    resource.metadata.labels.contains({key: "env", value: "prod"})
-  };
+    resource.metadata.labels.contains({{key: "env", value: "prod"}})
+  }};
 """
     engine, handler, fast, stats = _build_fallback_set(src)
     assert stats["fallback_policies"] >= 1
@@ -838,11 +845,16 @@ def test_admission_fastpath_dyn_contains_randomized():
 def test_admission_fastpath_gate_respects_hot_swap():
     """Hot-swapping from a fallback-bearing set to a device-pure set drops
     the gate plane (and vice versa) without rebuild races."""
-    src_fb = """
+    _blowup = " && ".join(
+        '(resource.metadata.name == "10.0.0.5" '
+        '|| resource.metadata.namespace == "zzz")'
+        for _ in range(12)
+    )
+    src_fb = f"""
 forbid (principal is k8s::ServiceAccount,
         action == k8s::admission::Action::"create",
         resource is core::v1::ConfigMap)
-  unless { ip(resource.metadata.name).isLoopback() };
+  when {{ {_blowup} }};
 """
     src_pure = """
 forbid (principal, action == k8s::admission::Action::"create",
@@ -853,8 +865,8 @@ forbid (principal, action == k8s::admission::Action::"create",
     engine, handler, fast, stats = _build_fallback_set(src_fb)
     assert stats["fallback_policies"] == 1
     sa = "system:serviceaccount:default:builder"
-    # name "10.0.0.5": valid non-loopback ip -> the unless is false -> the
-    # fallback forbid fires (via the gated python path)
+    # name "10.0.0.5": every alternation factor true -> the fallback
+    # forbid fires (via the gated python path)
     body_sa = json.dumps(
         review(obj=obj_cm(name="10.0.0.5"), user=sa, groups=())
     ).encode()

@@ -39,34 +39,53 @@ from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
 
 LOWERABLE = 'permit (principal, action, resource) when { resource.resource == "pods" };'
 
-# every fallback reason code -> a policy exercising exactly it
+# every fallback reason code -> (policy exercising exactly it, LowerOptions
+# or None for the default compiler). The lowerability burn-down
+# (docs/lowering.md) made negated_opaque/negated_untyped unreachable with
+# the full compiler — host-guardable negation and TYPE_ERR guards lower
+# those constructs now — so their codes are exercised through the same
+# LowerOptions gates bench.py --coverage measures with.
+from cedar_tpu.compiler.lower import LowerOptions  # noqa: E402
+
 FALLBACK_MATRIX = {
-    # negated opaque expression (containsAll over an error-prone element
-    # on a literal set: outside both the rewrite and the dyn class)
+    # negated opaque expression with the host-guard path disabled
+    # (default compiler: lowers via the HARD_OK guard)
     "negated_opaque": (
         "permit (principal, action, resource) "
-        "unless { [1, 2].containsAll([resource.name]) };"
+        "unless { [1, 2].containsAll([resource.name]) };",
+        LowerOptions(host_guard=False),
     ),
-    # negated typed test on a context attribute (static type unknown)
+    # negated typed test on a context attribute with TYPE_ERR guards
+    # disabled (default compiler: lowers with an exact type-error guard)
     "negated_untyped": (
         "permit (principal, action, resource) "
-        'unless { context.path like "/api*" };'
+        'unless { context.path like "/api*" };',
+        LowerOptions(type_guards=False),
     ),
-    # 2^7 = 128 > MAX_CLAUSES evaluation paths
+    # 2^12 = 4096 > SPILL_MAX_CLAUSES evaluation paths: past even the
+    # spillover ceiling
     "clause_limit": (
         "permit (principal, action, resource) when { "
         + " && ".join(
-            f'(context.a{i} == "x" || context.b{i} == "x")' for i in range(7)
+            f'(context.a{i} == "x" || context.b{i} == "x")' for i in range(12)
         )
-        + " };"
+        + " };",
+        None,
     ),
-    # one conjunction of 33 > MAX_LITERALS literals
+    # hardening triples each negated untyped literal (HAS guard +
+    # TYPE_ERR guard + the literal): 180 x 3 = 540 > SPILL_MAX_LITERALS
     "literal_limit": (
         "permit (principal, action, resource) when { "
-        + " && ".join(f'context.a{i} == "x"' for i in range(33))
-        + " };"
+        + " && ".join(f'!(context.a{i} like "x*")' for i in range(180))
+        + " };",
+        None,
     ),
 }
+
+# a policy the DEFAULT compiler still cannot lower (the loadgate / CRD /
+# CLI fixtures): the past-the-ceiling alternation blowup
+BAD = FALLBACK_MATRIX["clause_limit"][0]
+BAD_CODE = "clause_limit"
 
 
 def analyze_src(*tier_sources, **kw):
@@ -90,7 +109,8 @@ def codes_of(report, kind=None):
 
 @pytest.mark.parametrize("code", sorted(FALLBACK_MATRIX))
 def test_fallback_reason_codes(code):
-    report = analyze_src(FALLBACK_MATRIX[code])
+    src, opts = FALLBACK_MATRIX[code]
+    report = analyze_src(src, opts=opts)
     errors = [f for f in report.findings if f.severity == SEV_ERROR]
     assert [f.code for f in errors] == [code]
     assert errors[0].policy_id == "policy0"
@@ -112,9 +132,20 @@ def test_fallback_matrix_is_exhaustive():
 
 
 def test_offending_construct_is_reported():
-    report = analyze_src(FALLBACK_MATRIX["negated_opaque"])
+    src, opts = FALLBACK_MATRIX["negated_opaque"]
+    report = analyze_src(src, opts=opts)
     (f,) = [f for f in report.findings if f.severity == SEV_ERROR]
     assert "containsAll" in f.message
+
+
+def test_default_compiler_lowers_former_fallback_families():
+    """The burn-down contract: the constructs that used to define
+    negated_opaque / negated_untyped lower with the DEFAULT compiler."""
+    for code in ("negated_opaque", "negated_untyped"):
+        src, _opts = FALLBACK_MATRIX[code]
+        report = analyze_src(src)
+        assert report.tiers[0]["fallback"] == 0, code
+        assert report.coverage["lowerable_pct"] == 100.0
 
 
 def test_lowerable_set_is_clean():
@@ -176,7 +207,7 @@ def test_clause_heavy_capacity_info():
 
 
 def test_reason_catalog_complete():
-    report = analyze_src(*FALLBACK_MATRIX.values())
+    report = analyze_src(*(src for src, _o in FALLBACK_MATRIX.values()))
     for f in report.findings:
         assert f.code in REASONS
         assert f.kind and f.severity and f.hint
@@ -395,14 +426,12 @@ def test_capacity_report():
     per = {p["policy"]: p for p in cap["per_policy"]}
     assert all(p["rules"] >= 1 for p in per.values())
     # fallback policies appear in the count, not per-policy rows
-    report2 = analyze_src(FALLBACK_MATRIX["negated_opaque"])
+    report2 = analyze_src(BAD)
     assert report2.capacity["fallback_policies"] == 1
     assert report2.capacity["gate_rules"] == 1
 
 
 # ------------------------------------------------------------ load-time gate
-
-BAD = FALLBACK_MATRIX["negated_opaque"]
 
 
 def _tiered(mode):
@@ -417,7 +446,7 @@ def test_loadgate_permissive_annotates():
     tiers = ts.analyzed_policy_sets()
     assert [len(t) for t in tiers] == [2]
     assert ts.last_analysis is not None
-    assert "negated_opaque" in ts.last_analysis.counts()
+    assert BAD_CODE in ts.last_analysis.counts()
 
 
 def test_loadgate_partial_drops_offender():
@@ -433,7 +462,7 @@ def test_loadgate_strict_rejects():
     ts = _tiered("strict")
     with pytest.raises(AnalysisRejected) as ei:
         ts.analyzed_policy_sets()
-    assert "negated_opaque" in str(ei.value)
+    assert BAD_CODE in str(ei.value)
     assert ts.last_analysis is not None  # report survives for debugging
 
 
@@ -479,7 +508,7 @@ def test_check_object_policies():
     pols = parse_policies(LOWERABLE + "\n" + BAD, "obj")
     checked = check_object_policies(pols)
     assert [f is None for _p, f in checked] == [True, False]
-    assert checked[1][1].code == "negated_opaque"
+    assert checked[1][1].code == BAD_CODE
 
 
 def test_crd_store_strict_rejects_non_lowerable():
@@ -591,7 +620,7 @@ def test_debug_analysis_endpoint():
         ) as resp:
             doc = json.loads(resp.read())
         counts = doc["authorization"]["counts"]
-        assert counts.get("negated_opaque") == 1
+        assert counts.get(BAD_CODE) == 1
         assert doc["authorization"]["capacity"]["n_rules"] > 0
     finally:
         server.stop()
@@ -612,7 +641,7 @@ def test_cli_check_modes(tmp_path, capsys):
     assert main([str(dirty)]) == 0  # report-only never fails
     assert main([str(tmp_path / "missing.cedar")]) == 2
     out = capsys.readouterr().out
-    assert "negated_opaque" in out
+    assert BAD_CODE in out
 
 
 def test_cli_json_and_manifest(tmp_path, capsys):

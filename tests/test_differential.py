@@ -349,8 +349,12 @@ when { principal.name == "test-user" && resource.resource == "pods" };
     assert engine.stats["fallback_policies"] == 0
 
 
-def test_unlowerable_negated_expression_goes_to_fallback():
-    # negated arithmetic can overflow-error: no guard can help -> interpreter
+def test_negated_arithmetic_lowers_via_host_guard():
+    # negated arithmetic can overflow-error; the HARD_OK guard path
+    # (compiler/dyn.host_guardable) now lowers it — host evaluation
+    # classifies bool-vs-error per request, so the clause dies on exactly
+    # the requests where Cedar skips the policy — instead of dragging the
+    # whole policy to the interpreter
     src = """
 permit (principal, action, resource)
 unless { context has n && context.n + 1 == 2 };
@@ -358,6 +362,25 @@ permit (principal, action, resource)
 when { principal.name == "test-user" && resource.resource == "pods" };
 """
     cases = [sar(), sar(resource="svc")]
+    engine = check([src], cases)
+    assert engine.stats["fallback_policies"] == 0
+
+
+def test_unlowerable_alternation_blowup_goes_to_fallback():
+    # an ordered-DNF expansion past the spillover ceiling
+    # (SPILL_MAX_CLAUSES) is the construct that still falls back: 13^3
+    # alternation product = 2197 raw clauses
+    names = " || ".join(f'resource.name == "n{i}"' for i in range(13))
+    nss = " || ".join(f'resource.namespace == "ns{i}"' for i in range(13))
+    subs = " || ".join(f'resource.subresource == "s{i}"' for i in range(13))
+    src = f"""
+permit (principal, action, resource)
+when {{ ({names}) && ({nss}) && ({subs}) }};
+permit (principal, action, resource)
+when {{ principal.name == "test-user" && resource.resource == "pods" }};
+"""
+    cases = [sar(), sar(resource="svc"), sar(name="n3", namespace="ns5",
+                                             subresource="s7")]
     engine = check([src], cases)
     assert engine.stats["fallback_policies"] >= 1
 

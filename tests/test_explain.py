@@ -65,9 +65,17 @@ forbid (principal is k8s::User,
 
 # the overlapping pods permits make alice/pods a multi-reason row
 
+# still unlowerable AFTER the burn-down (docs/lowering.md): an ordered-DNF
+# alternation product past the spillover ceiling (2^12 > SPILL_MAX_CLAUSES).
+# Every disjunction is true for the test SAR (resource == "pods"), so the
+# interpreter fallback ALLOWS it.
 UNLOWERABLE = (
-    "permit (principal, action, resource) "
-    "unless { [1, 2].containsAll([resource.name]) };"
+    "permit (principal, action, resource) when { "
+    + " && ".join(
+        f'(resource.resource == "pods" || resource.name == "z{i}")'
+        for i in range(12)
+    )
+    + " };"
 )
 
 
@@ -366,7 +374,7 @@ class TestHostPlanes:
         det = e["determining"]
         assert det["fallback"] is True
         assert det["clause"] is None
-        assert det["unlowerable"]["code"] == "negated_opaque"
+        assert det["unlowerable"]["code"] == "clause_limit"
 
 
 # ----------------------------------------------------------- pay-for-use

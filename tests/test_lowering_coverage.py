@@ -1,0 +1,496 @@
+"""Differential tests for the lowerability burn-down (ROADMAP item 3):
+every newly-lowered Unlowerable family — clause/literal spillover,
+flow-typed negation with TYPE_ERR guards, ancestor-closure slot-`in`,
+and the widened host-guardable dyn class — must be decision-, reason-set-
+AND error-signal-equivalent to the interpreter oracle, explain correctly
+on the breaker-open host plane, and survive incremental (dirty-shard)
+reloads.
+
+The corpus side drives corpus.synth.coverage_corpus (the same adversarial
+generator bench.py --coverage gates on); the targeted side pins each
+mechanism with hand-written policies whose match, miss, presence-guard,
+type-error, and eval-error paths are all exercised.
+"""
+
+import re
+
+import pytest
+
+from cedar_tpu.analysis.analyze import coverage_summary, lower_all
+from cedar_tpu.compiler.lower import (
+    DEFAULT_OPTS,
+    LEGACY_OPTS,
+    MAX_CLAUSES,
+    MAX_LITERALS,
+    lower_policy,
+)
+from cedar_tpu.corpus.synth import COVERAGE_FAMILIES, coverage_corpus
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+from cedar_tpu.lang.authorize import PolicySet
+from cedar_tpu.lang.entities import Entity, EntityMap
+from cedar_tpu.lang.eval import Request
+from cedar_tpu.lang.values import CedarRecord, CedarSet, EntityUID
+
+
+def _err_policies(errors):
+    return {
+        m.group(1)
+        for m in (re.search(r"`([^`]+)`", e) for e in errors)
+        if m
+    }
+
+
+def _check_items(engine, ps, items):
+    """Engine vs interpreter oracle: decision, reason set, erroring-policy
+    set — the tier-stop error signal included — for every item."""
+    results = engine.evaluate_batch(items)
+    for (em, req), (dec, diag) in zip(items, results):
+        idec, idiag = ps.is_authorized(em, req)
+        assert dec == idec, f"decision mismatch: {dec} != {idec} for {req}"
+        got = {r.policy for r in diag.reasons}
+        want = {r.policy for r in idiag.reasons}
+        assert got == want, f"reason mismatch: {got} != {want} for {req}"
+        assert _err_policies(diag.errors) == _err_policies(idiag.errors), (
+            f"error-set mismatch: {diag.errors} != {idiag.errors} for {req}"
+        )
+
+
+def _mini_request(ctx=None, entities=()):
+    em = EntityMap(entities)
+    req = Request(
+        EntityUID("k8s::User", "u"),
+        EntityUID("k8s::Action", "get"),
+        EntityUID("k8s::Resource", "r"),
+        CedarRecord(ctx or {}),
+    )
+    return em, req
+
+
+def _chain(root="root", depth=12):
+    """Entities forming root <- mid-0 <- ... <- mid-(depth-1)."""
+    names = [root] + [f"mid-{d}" for d in range(depth)]
+    return [
+        Entity(
+            EntityUID("k8s::Group", child),
+            parents=(EntityUID("k8s::Group", parent),),
+        )
+        for child, parent in zip(names[1:], names[:-1])
+    ]
+
+
+# ------------------------------------------------------------ family level
+
+
+class TestFamilyLowering:
+    def test_full_compiler_lowers_every_burned_down_family(self):
+        c = coverage_corpus(per_family=3, base=8, seed=11)
+        fam_by_id = {
+            pid: f for f, ids in c.families.items() for pid in ids
+        }
+        infos = lower_all(c.tiers())
+        outcomes = {}
+        for i in infos:
+            f = fam_by_id[i.policy.policy_id]
+            outcomes.setdefault(f, []).append(i)
+        for fam in ("spill", "negated_untyped", "ancestor_in", "opaque"):
+            assert all(i.fallback is None for i in outcomes[fam]), fam
+        # the spill family really exceeded the preferred budgets
+        assert all(i.lowered.spilled for i in outcomes["spill"])
+        # the past-the-ceiling residue still falls back, with its code
+        assert all(
+            i.fallback is not None and i.fallback.code == "clause_limit"
+            for i in outcomes["blowup"]
+        )
+
+    def test_legacy_opts_reproduce_the_pre_spillover_compiler(self):
+        c = coverage_corpus(per_family=3, base=8, seed=11)
+        fam_by_id = {
+            pid: f for f, ids in c.families.items() for pid in ids
+        }
+        infos = lower_all(c.tiers(), opts=LEGACY_OPTS)
+        for i in infos:
+            f = fam_by_id[i.policy.policy_id]
+            if f in ("spill", "negated_untyped", "opaque", "blowup"):
+                assert i.fallback is not None, f
+        cov_l = coverage_summary(infos)
+        cov_f = coverage_summary(lower_all(c.tiers()))
+        assert cov_f["lowerable_pct"] > cov_l["lowerable_pct"]
+        assert cov_f["spilled"] > 0 and cov_l["spilled"] == 0
+
+    def test_coverage_corpus_is_deterministic(self):
+        a = coverage_corpus(per_family=2, base=6, seed=3)
+        b = coverage_corpus(per_family=2, base=6, seed=3)
+        assert [str(p.policy_id) for p in a.policies] == [
+            str(p.policy_id) for p in b.policies
+        ]
+        assert COVERAGE_FAMILIES == tuple(
+            f for f in a.families if f != "base"
+        )
+        ra = [(req.principal, repr(req.context)) for _em, req in
+              a.items(40, seed=5)]
+        rb = [(req.principal, repr(req.context)) for _em, req in
+              b.items(40, seed=5)]
+        assert ra == rb
+
+
+# ------------------------------------------------- corpus differentials
+
+
+class TestCorpusDifferential:
+    @pytest.fixture(scope="class")
+    def corpus(self):
+        return coverage_corpus(per_family=3, base=12, seed=0)
+
+    @pytest.fixture(scope="class")
+    def items(self, corpus):
+        return corpus.items(160, seed=1)
+
+    def test_full_compiler_matches_oracle(self, corpus, items):
+        engine = TPUPolicyEngine()
+        engine.load(corpus.tiers(), warm="off")
+        # precondition: only the blowup residue falls back
+        assert engine.stats["fallback_policies"] == len(
+            corpus.families["blowup"]
+        )
+        _check_items(engine, corpus.tiers()[0], items)
+
+    def test_legacy_compiler_matches_oracle(self, corpus, items):
+        # the pre-spillover compiler must stay correct too (it serves the
+        # same traffic through the interpreter merge)
+        engine = TPUPolicyEngine(lower_opts=LEGACY_OPTS)
+        engine.load(corpus.tiers(), warm="off")
+        assert engine.stats["fallback_policies"] > len(
+            corpus.families["blowup"]
+        )
+        _check_items(engine, corpus.tiers()[0], items)
+
+
+# ----------------------------------------------- targeted mechanism pins
+
+
+class TestSpillover:
+    def test_wide_conjunction_spills_and_matches(self):
+        # one clause conjoining > MAX_LITERALS literals: spillover keeps
+        # it on the plane (the rule column is just wider)
+        n = MAX_LITERALS + 8
+        cond = " && ".join(f'context.k{i} == "v{i}"' for i in range(n))
+        src = f"permit (principal, action, resource) when {{ {cond} }};"
+        ps = PolicySet.from_source(src, "t0")
+        lp = lower_policy(ps.policies()[0], 0)
+        assert lp.spilled
+        assert any(len(c) > MAX_LITERALS for c in lp.clauses)
+        engine = TPUPolicyEngine()
+        engine.load([ps], warm="off")
+        assert engine.stats["fallback_policies"] == 0
+        full = {f"k{i}": f"v{i}" for i in range(n)}
+        near = dict(full, k7="wrong")
+        missing = {k: v for k, v in full.items() if k != "k3"}
+        items = [
+            _mini_request(full), _mini_request(near), _mini_request(missing),
+            _mini_request({}),
+        ]
+        _check_items(engine, ps, items)
+
+    def test_alternation_product_spills_and_matches(self):
+        # 12x12 ==-chain product: 144 raw clauses > MAX_CLAUSES=96
+        a = " || ".join(f'context.x == "a{i}"' for i in range(12))
+        b = " || ".join(f'context.y == "b{i}"' for i in range(12))
+        src = (
+            "permit (principal, action, resource) "
+            f"when {{ ({a}) && ({b}) }};"
+        )
+        ps = PolicySet.from_source(src, "t0")
+        lp = lower_policy(ps.policies()[0], 0)
+        assert lp.spilled and len(lp.clauses) > MAX_CLAUSES
+        engine = TPUPolicyEngine()
+        engine.load([ps], warm="off")
+        assert engine.stats["fallback_policies"] == 0
+        items = [
+            _mini_request({"x": "a3", "y": "b11"}),
+            _mini_request({"x": "a3", "y": "nope"}),
+            _mini_request({"x": "nope", "y": "b0"}),
+            _mini_request({"x": "a11"}),
+            _mini_request({}),
+        ]
+        _check_items(engine, ps, items)
+
+
+class TestFlowTypedNegation:
+    SRC = """
+permit (principal, action, resource)
+when { context has x && context.x == "abc" && !(context.x like "ab*") };
+permit (principal, action, resource)
+when { context has tag && context.tag == "live" };
+"""
+
+    def test_earlier_eq_proves_type_for_negated_like(self):
+        ps = PolicySet.from_source(self.SRC, "t0")
+        engine = TPUPolicyEngine()
+        engine.load([ps], warm="off")
+        assert engine.stats["fallback_policies"] == 0
+        items = [
+            _mini_request({"x": "abc"}),       # eq passes, like kills unless
+            _mini_request({"x": "zz"}),
+            _mini_request({"x": 7}),           # eq false on a long: no error
+            _mini_request({"tag": "live"}),
+            _mini_request({}),
+        ]
+        _check_items(engine, ps, items)
+
+    def test_type_err_guard_makes_negated_untyped_exact(self):
+        # no flow proof available: the TYPE_ERR guard must kill the
+        # clause exactly where Cedar raises (wrong-typed context.x), and
+        # the error must surface as the tier-stop signal
+        src = """
+permit (principal, action, resource)
+when { context has x } unless { context.x like "deny*" };
+permit (principal, action, resource)
+when { context has ok && context.ok == "y" };
+"""
+        ps = PolicySet.from_source(src, "t0")
+        engine = TPUPolicyEngine()
+        engine.load([ps], warm="off")
+        assert engine.stats["fallback_policies"] == 0
+        items = [
+            _mini_request({"x": "deny-1"}),
+            _mini_request({"x": "allow"}),
+            _mini_request({"x": 42, "ok": "y"}),   # type error, tier-stop
+            _mini_request({"x": CedarSet(["deny-1"]), "ok": "y"}),
+            _mini_request({"ok": "y"}),
+        ]
+        _check_items(engine, ps, items)
+
+    def test_positive_typed_op_error_clause(self):
+        # a POSITIVE like/cmp on an untyped slot: a silent device no-match
+        # would resume the tier walk that Cedar's type error stops — the
+        # TYPE_ERR error clause must detect it
+        src = """
+forbid (principal, action, resource)
+when { context has lvl && context.lvl < 3 };
+permit (principal, action, resource);
+"""
+        ps = PolicySet.from_source(src, "t0")
+        engine = TPUPolicyEngine()
+        engine.load([ps], warm="off")
+        assert engine.stats["fallback_policies"] == 0
+        items = [
+            _mini_request({"lvl": 1}),
+            _mini_request({"lvl": 9}),
+            _mini_request({"lvl": "high"}),  # type error in the forbid
+            _mini_request({}),
+        ]
+        _check_items(engine, ps, items)
+
+
+class TestAncestorClosureIn:
+    def test_deep_chain_slot_in(self):
+        src = """
+permit (principal, action, resource)
+when { context has team && context.team in k8s::Group::"root" };
+"""
+        ps = PolicySet.from_source(src, "t0")
+        engine = TPUPolicyEngine()
+        engine.load([ps], warm="off")
+        assert engine.stats["fallback_policies"] == 0
+        chain = _chain("root", depth=12)
+        items = [
+            _mini_request({"team": EntityUID("k8s::Group", "mid-11")}, chain),
+            _mini_request({"team": EntityUID("k8s::Group", "mid-0")}, chain),
+            _mini_request({"team": EntityUID("k8s::Group", "root")}, chain),
+            _mini_request({"team": EntityUID("k8s::Group", "other")}, chain),
+            _mini_request({"team": EntityUID("k8s::Group", "dangling")}),
+            _mini_request({}, chain),
+        ]
+        _check_items(engine, ps, items)
+
+    def test_negated_slot_in_with_type_error(self):
+        src = """
+permit (principal, action, resource)
+when { context has team } unless { context.team in k8s::Group::"root" };
+permit (principal, action, resource)
+when { context has ok && context.ok == "y" };
+"""
+        ps = PolicySet.from_source(src, "t0")
+        engine = TPUPolicyEngine()
+        engine.load([ps], warm="off")
+        assert engine.stats["fallback_policies"] == 0
+        chain = _chain("root", depth=8)
+        items = [
+            _mini_request({"team": EntityUID("k8s::Group", "mid-7")}, chain),
+            _mini_request({"team": EntityUID("k8s::Group", "other")}, chain),
+            # non-entity team: Cedar type error on `in` skips the policy
+            _mini_request({"team": "not-an-entity", "ok": "y"}, chain),
+            _mini_request({"ok": "y"}, chain),
+        ]
+        _check_items(engine, ps, items)
+
+    def test_set_target_slot_in(self):
+        src = """
+permit (principal, action, resource)
+when {
+  context has team &&
+  context.team in [k8s::Group::"root", k8s::Group::"alt"]
+};
+"""
+        ps = PolicySet.from_source(src, "t0")
+        engine = TPUPolicyEngine()
+        engine.load([ps], warm="off")
+        assert engine.stats["fallback_policies"] == 0
+        chain = _chain("root", depth=6) + _chain("alt", depth=3)
+        items = [
+            _mini_request({"team": EntityUID("k8s::Group", "mid-5")}, chain),
+            _mini_request({"team": EntityUID("k8s::Group", "alt")}, chain),
+            _mini_request({"team": EntityUID("k8s::Group", "zzz")}, chain),
+        ]
+        _check_items(engine, ps, items)
+
+
+class TestHostGuardedOpaque:
+    def test_negated_arithmetic_rides_the_guard_path(self):
+        src = """
+permit (principal, action, resource)
+when { context has n } unless { context.n + 1 == 2 };
+permit (principal, action, resource)
+when { context has ok && context.ok == "y" };
+"""
+        ps = PolicySet.from_source(src, "t0")
+        engine = TPUPolicyEngine()
+        engine.load([ps], warm="off")
+        assert engine.stats["fallback_policies"] == 0
+        items = [
+            _mini_request({"n": 1}),                    # unless fires
+            _mini_request({"n": 5}),
+            _mini_request({"n": "NaN", "ok": "y"}),     # eval error: skip
+            _mini_request({"n": (1 << 62), "ok": "y"}),  # overflow error
+            _mini_request({"ok": "y"}),
+        ]
+        _check_items(engine, ps, items)
+
+    def test_negated_ext_call_rides_the_guard_path(self):
+        src = """
+permit (principal, action, resource)
+when { context has addr } unless { ip(context.addr).isLoopback() };
+permit (principal, action, resource)
+when { context has ok && context.ok == "y" };
+"""
+        ps = PolicySet.from_source(src, "t0")
+        engine = TPUPolicyEngine()
+        engine.load([ps], warm="off")
+        assert engine.stats["fallback_policies"] == 0
+        items = [
+            _mini_request({"addr": "127.0.0.1"}),
+            _mini_request({"addr": "10.0.0.9"}),
+            _mini_request({"addr": "not-an-ip", "ok": "y"}),  # eval error
+            _mini_request({"ok": "y"}),
+        ]
+        _check_items(engine, ps, items)
+
+
+# ------------------------------------- explain / breaker-open host plane
+
+
+class TestHostPlaneAndExplain:
+    def test_host_plane_explains_newly_lowered_constructs(self):
+        """The numpy host plane (what ?explain=1 and a breaker-open
+        serving path use) must agree with the interpreter oracle on the
+        adversarial corpus, and its determining attribution must name a
+        policy from the oracle's reason set."""
+        from cedar_tpu.compiler.table import encode_request_codes
+        from cedar_tpu.explain.attribution import build_explanation, host_sat
+
+        c = coverage_corpus(per_family=3, base=10, seed=5)
+        engine = TPUPolicyEngine()
+        engine.load(c.tiers(), warm="off")
+        packed = engine.compiled_set.packed
+        ps = c.tiers()[0]
+        for em, req in c.items(80, seed=2):
+            codes, extras = encode_request_codes(
+                packed.plan, packed.table, em, req
+            )
+            sat = host_sat(packed, codes, extras)
+            dec, diag, expl = build_explanation(
+                packed, sat, em, req, source="host"
+            )
+            idec, idiag = ps.is_authorized(em, req)
+            assert dec == idec
+            got = {r.policy for r in diag.reasons}
+            want = {r.policy for r in idiag.reasons}
+            assert got == want
+            assert _err_policies(diag.errors) == _err_policies(idiag.errors)
+            assert expl["source"] == "host"
+            if want:
+                assert expl["determining"]["policyId"] in want
+
+    def test_spilled_policy_attribution_names_clause_tests(self):
+        from cedar_tpu.compiler.table import encode_request_codes
+        from cedar_tpu.explain.attribution import build_explanation, host_sat
+
+        a = " || ".join(f'context.x == "a{i}"' for i in range(12))
+        b = " || ".join(f'context.y == "b{i}"' for i in range(12))
+        src = (
+            "permit (principal, action, resource) "
+            f"when {{ ({a}) && ({b}) }};"
+        )
+        ps = PolicySet.from_source(src, "t0")
+        engine = TPUPolicyEngine()
+        engine.load([ps], warm="off")
+        packed = engine.compiled_set.packed
+        em, req = _mini_request({"x": "a7", "y": "b2"})
+        codes, extras = encode_request_codes(packed.plan, packed.table, em, req)
+        dec, _diag, expl = build_explanation(
+            packed, host_sat(packed, codes, extras), em, req, source="host"
+        )
+        assert dec == "allow"
+        det = expl["determining"]
+        assert det["policyId"] == "policy0"
+        assert det["clause"]["tests"]  # the satisfied spilled clause
+
+
+# ------------------------------------------- incremental reload equivalence
+
+
+class TestIncrementalReload:
+    def test_dirty_shard_reload_keeps_equivalence(self):
+        """Flip one coverage policy's effect and reload incrementally: the
+        dirty-shard recompile must touch only that shard and the reloaded
+        plane must match both a fresh full compile and the oracle."""
+        c = coverage_corpus(per_family=3, base=12, seed=9)
+        engine = TPUPolicyEngine()
+        stats0 = engine.load(c.tiers(), warm="off")
+        assert stats0["compile_scope"] == "full"
+        items = c.items(120, seed=3)
+        _check_items(engine, c.tiers()[0], items)
+
+        # single-policy CRD-style edit on an ancestor_in policy: every
+        # other Policy object shared by identity, like a store relist
+        edit_id = c.families["ancestor_in"][0]
+        from cedar_tpu.corpus.synth import _coverage_policy
+        from cedar_tpu.lang.parser import parse_policies
+
+        pols = list(c.policies)
+        idx = next(
+            i for i, p in enumerate(pols) if p.policy_id == edit_id
+        )
+        old = pols[idx]
+        # re-derive the generated source (the corpus generator is
+        # deterministic) and flip its effect
+        src, _params = _coverage_policy(0, "ancestor_in", c.seed, c.clusters)
+        assert src.startswith("permit ")
+        p = parse_policies("forbid " + src[len("permit "):], old.filename)[0]
+        p.policy_id = old.policy_id
+        pols[idx] = p
+        edited = PolicySet(pols)
+
+        stats1 = engine.load([edited], warm="off")
+        assert stats1["compile_scope"] == "incremental"
+        assert 1 <= stats1["dirty_shards"] <= 2
+        fresh = TPUPolicyEngine()
+        fresh.load([edited], warm="off")
+        res_inc = engine.evaluate_batch(items)
+        res_fresh = fresh.evaluate_batch(items)
+        for (dec_a, diag_a), (dec_b, diag_b) in zip(res_inc, res_fresh):
+            assert dec_a == dec_b
+            assert {r.policy for r in diag_a.reasons} == {
+                r.policy for r in diag_b.reasons
+            }
+        _check_items(engine, edited, items)

@@ -296,12 +296,21 @@ def test_fastpath_hybrid_with_fallback_policy():
     path: its scope becomes a device gate rule, gated rows re-run the exact
     Python path (hybrid merge), every other row stays native — decision
     parity must hold across both kinds of row."""
-    # a NEGATED dynamic extension call is a negated unlowerable expression
-    # (the ==/!= joins that used to serve this role are native dyn classes)
-    src = POLICIES + """
+    # negated extension calls now lower through the HARD_OK guard path
+    # (compiler/dyn.host_guardable), so the one construct that still
+    # falls back is an ordered-DNF expansion past the SPILL ceiling: a
+    # 13x13x13 alternation product (2197 raw clauses > SPILL_MAX_CLAUSES)
+    names = " || ".join(
+        f'resource.name == "{v}"'
+        for v in ["10.0.0.9", "127.0.0.1", "not-an-ip"]
+        + [f"a{i}" for i in range(10)]
+    )
+    nss = " || ".join(f'resource.namespace == "ns{i}"' for i in range(13))
+    subs = " || ".join(f'resource.subresource == "s{i}"' for i in range(13))
+    src = POLICIES + f"""
 permit (principal in k8s::Group::"fbgroup", action == k8s::Action::"get",
         resource is k8s::Resource)
-  unless { ip(resource.name).isLoopback() };
+  when {{ ({names}) && ({nss}) && ({subs}) }};
 """
     engine = TPUPolicyEngine()
     engine.load([PolicySet.from_source(src, "hybrid")], warm="off")

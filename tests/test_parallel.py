@@ -114,13 +114,18 @@ def _mesh_policy_sources():
         'forbid (principal, action == k8s::Action::"get",'
         ' resource is k8s::Resource) when { resource.namespace == "locked" };'
     )
-    # interpreter fallback: negated dynamic extension call -> gate
-    # plane (the ==/!= joins that used to serve this role are
-    # native dyn classes now)
+    # interpreter fallback: an ordered-DNF alternation product past the
+    # spillover ceiling (2^12 > SPILL_MAX_CLAUSES) -> gate plane (negated
+    # extension calls lower via the host-guard path now); each factor is
+    # true for resource "r1", so the policy matches joiners GET r1 rows
+    blowup = " && ".join(
+        '(resource.resource == "r1" || resource.name == "never")'
+        for _ in range(12)
+    )
     pols.append(
         'permit (principal in k8s::Group::"joiners",'
         ' action == k8s::Action::"get", resource is k8s::Resource)'
-        " unless { ip(resource.name).isLoopback() };"
+        f" when {{ {blowup} }};"
     )
     return "\n".join(pols)
 

@@ -474,10 +474,14 @@ class TestStageGate:
         engine = TPUPolicyEngine(name="authorization", warm_max_batch=1)
         engine.load(_tiers(LIVE_POLICIES), warm="off")
         rollout = RolloutController(authz_engine=engine)
+        _blowup = " && ".join(
+            '(resource.resource == "r1" || resource.name == "never")'
+            for _ in range(12)
+        )  # 2^12 > SPILL_MAX_CLAUSES: still unlowerable
         bad = LIVE_POLICIES + (
             'permit (principal in k8s::Group::"joiners", '
             'action == k8s::Action::"get", resource is k8s::Resource)\n'
-            "  unless { ip(resource.name).isLoopback() };\n"
+            f"  when {{ {_blowup} }};\n"
         )
         with pytest.raises(RolloutError, match="analysis"):
             rollout.stage(

@@ -44,14 +44,22 @@ forbid (principal is k8s::User,
          resource.metadata.labels.contains({key: "env", value: "prod"}) };
 """
 
-# a genuine interpreter-fallback policy: a NEGATED dynamic extension call
-# is a negated unlowerable expression (the ==/!= joins that used to serve
-# this role are native dyn classes now)
-FALLBACK_POLICY = """
-permit (principal in k8s::Group::"joiners", action == k8s::Action::"get",
-        resource is k8s::Resource)
-  unless { ip(resource.name).isLoopback() };
-"""
+# a genuine interpreter-fallback policy: an ordered-DNF alternation
+# product past the spillover ceiling (2^12 > SPILL_MAX_CLAUSES; negated
+# extension calls lower via the host-guard path now). Each factor is true
+# for resource "widgets", so gated joiners-GET-widgets rows allow via the
+# python path.
+FALLBACK_POLICY = (
+    'permit (principal in k8s::Group::"joiners", '
+    'action == k8s::Action::"get",\n'
+    "        resource is k8s::Resource)\n"
+    "  when { "
+    + " && ".join(
+        '(resource.resource == "widgets" || resource.name == "10.0.0.1")'
+        for _ in range(12)
+    )
+    + " };\n"
+)
 
 # a principal/resource join: a hard literal in the native dyn-eq class
 # (compiler/dyn.py DynEq) — the C++ encoder evaluates it per request, so

@@ -599,9 +599,14 @@ class TestIsolation:
             "pol-a",
             "a.cedar",
         )
-        # >32 literals in one clause exceeds the lowering limit
-        # (literal_limit) — an interpreter-fallback policy that MATCHES
-        conj = " && ".join(f'principal.name != "x{i}"' for i in range(40))
+        # a 2^12 alternation product exceeds the spillover ceiling
+        # (clause_limit; wide conjunctions spill-lower now) — an
+        # interpreter-fallback policy that MATCHES (both disjuncts of
+        # every factor are true for user "u1")
+        conj = " && ".join(
+            f'(principal.name != "x{i}a" || principal.name != "x{i}b")'
+            for i in range(12)
+        )
         b = mk_policy(
             "permit (principal is k8s::User, action, "
             "resource is k8s::Resource) when { " + conj + " };",
@@ -1060,7 +1065,9 @@ class TestFallbackBurnDown:
                         "permit (principal is k8s::User, action, "
                         "resource is k8s::Resource) when { "
                         + " && ".join(
-                            f'principal.name != "x{i}"' for i in range(40)
+                            f'(principal.name != "x{i}a" '
+                            f'|| principal.name != "x{i}b")'
+                            for i in range(12)
                         )
                         + " };",
                         "pol-fb",
