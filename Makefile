@@ -90,6 +90,10 @@ bench-fanout: ## Cross-process worker tier: 1/2/4 spawned workers, scaling + zer
 bench-storm: ## Open-loop overload: 5x sustained storm — high-priority availability >=99.9% within budget, exact shed accounting, >=1 adaptive-tuner move, no-overload byte parity (cpu; docs/performance.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --storm
 
+.PHONY: bench-lifecycle
+bench-lifecycle: ## Declarative lifecycle fleet: staggered tenant rollouts under storm traffic — zero-touch auto-promotion, halt+rollback at each gate tier, zero live flips, crash-mid-canary resume (cpu; docs/rollout.md)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --lifecycle
+
 .PHONY: bench-explain
 bench-explain: ## Explain-plane pay-for-use: explain-off p99/throughput parity gate, explain-on cost + lazy compiles (cpu; docs/explainability.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --explain
@@ -116,7 +120,7 @@ graft-check: ## Compile-check the jittable entry + multi-chip dry run
 
 # scoped to the layers with the strongest invariants first; widen as
 # modules are annotated
-LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos cedar_tpu/fleet cedar_tpu/engine cedar_tpu/ops cedar_tpu/native cedar_tpu/explain cedar_tpu/obs cedar_tpu/cache cedar_tpu/corpus cedar_tpu/fanout cedar_tpu/parallel cedar_tpu/tenancy cedar_tpu/load
+LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos cedar_tpu/fleet cedar_tpu/engine cedar_tpu/ops cedar_tpu/native cedar_tpu/explain cedar_tpu/obs cedar_tpu/cache cedar_tpu/corpus cedar_tpu/fanout cedar_tpu/parallel cedar_tpu/tenancy cedar_tpu/load cedar_tpu/lifecycle
 
 .PHONY: lint
 lint: ## ruff + mypy over $(LINT_SCOPE) (missing tools are skipped with a note)

@@ -3012,6 +3012,399 @@ def run_tenants_scenario() -> int:
     return 0 if ok else 1
 
 
+def run_lifecycle_scenario() -> int:
+    """``bench.py --lifecycle`` (``make bench-lifecycle``): the
+    declarative policy-lifecycle acceptance harness (cedar_tpu/lifecycle,
+    docs/rollout.md "Declarative lifecycle"). A fleet of tenants'
+    PolicyRollout specs — staggered applies, Poisson storm traffic on
+    every live path — drives author → verify → shadow → canary → promote
+    as a self-driving loop. Gates (rc=1 on breach):
+
+      * every GOOD tenant auto-promotes with ZERO manual interventions
+        (no approve calls, no rollout POSTs) and its probe-policy edit is
+        observably serving post-promotion (probe decision flips);
+      * one seeded bad candidate is halted + auto-rolled-back at EACH
+        gate tier — lowerability (verify-time blocking analysis finding),
+        shadow_diff (a broad forbid the diff report catches), slo_burn
+        (a candidate plane that fails at canary-evaluation time, the
+        lifecycle-breach game-day shape) — and each ends ``rolled_back``
+        with its serving plane back to live-only;
+      * ZERO live decision flips across the whole run: every answer
+        served while the fleet rolled out equals the pre-run baseline
+        (good candidates are probe-only edits; disagreeing canary
+        answers never serve);
+      * a controller crash mid-canary (chaos ``kill`` on the
+        ``lifecycle.journal`` seam) resumes from the journal with NO
+        mixed-generation window: first post-resume answers come from the
+        live lineage, and promotion is re-earned end to end.
+    """
+    from cedar_tpu.chaos import ThreadKilled, default_registry
+    from cedar_tpu.corpus import synth_tenant_corpora
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.lang import PolicySet
+    from cedar_tpu.lifecycle import (
+        TERMINAL_STAGES,
+        LifecycleController,
+        LifecycleJournal,
+        PolicyRolloutSpec,
+        RolloutLifecycleDriver,
+    )
+    from cedar_tpu.load import poisson_schedule
+    from cedar_tpu.obs import SLOTracker
+    from cedar_tpu.rollout import RolloutController
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.server.http import get_authorizer_attributes
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    t_start = time.time()
+    n_good = _n(7, 3)
+    n_tenants = n_good + 3  # + one bad candidate per gate tier
+    per_tenant = _n(120, 40)
+    baseline_n = _n(60, 30)
+    shadow_min = _n(150, 60)
+    canary_min = _n(8, 4)
+    rate_hz = float(os.environ.get("CEDAR_BENCH_LIFECYCLE_RATE", "300"))
+    window_s = 0.06  # storm slice pumped between controller ticks
+    max_ticks = int(os.environ.get("CEDAR_BENCH_LIFECYCLE_TICKS", "600"))
+    wall_budget_s = float(
+        os.environ.get("CEDAR_BENCH_LIFECYCLE_BUDGET_S", "1500")
+    )
+    deadline_s = 600.0  # per-stage; generous for shared-host cpu runs
+
+    corpora = synth_tenant_corpora(
+        per_tenant, n_tenants, seed=23, clusters=1
+    )
+    tenants = list(corpora)
+    good = tenants[:n_good]
+    bad_lower, bad_shadow, bad_slo = tenants[n_good:]
+
+    _blowup = " && ".join(
+        '(resource.resource == "r1" || resource.name == "never")'
+        for _ in range(12)
+    )  # 2^12 DNF clauses: a blocking analysis finding at verify time
+    unlowerable_tier = PolicySet.from_source(
+        'permit (principal is k8s::User, action == k8s::Action::"get", '
+        "resource is k8s::Resource)\n"
+        f"  when {{ {_blowup} }};\n",
+        "bad-candidate",
+    )
+    broad_forbid_tier = PolicySet.from_source(
+        "forbid (principal is k8s::User, action, "
+        "resource is k8s::Resource);",
+        "bad-candidate",
+    )  # lowerable, but flips every allow: the shadow gate's catch
+
+    class _FailingCanaryDriver(RolloutLifecycleDriver):
+        """The slo_burn tenant's candidate plane dies at evaluation
+        time inside the canary slice (the lifecycle-breach game-day
+        failure shape) — live answers keep flowing, the canary SLO
+        burns, the burn gate halts the rollout."""
+
+        def _candidate_answer(self, body):
+            raise RuntimeError("candidate evaluation failed (game day)")
+
+    slo = SLOTracker(availability_target=0.999)
+
+    class _Plane:
+        """One tenant's serving plane + lifecycle driver binding."""
+
+        def __init__(self, tid, corpus, driver_cls=RolloutLifecycleDriver):
+            self.tid = tid
+            self.corpus = corpus
+            self.engine = TPUPolicyEngine(name=f"live-{tid}")
+            self.engine.load(corpus.tiers(), warm="off")
+            stores = TieredPolicyStores(
+                [MemoryStore(tid, corpus.tiers()[0])]
+            )
+            self.authorizer = CedarWebhookAuthorizer(
+                stores,
+                evaluate=self.engine.evaluate,
+                evaluate_batch=self.engine.evaluate_batch,
+            )
+            self.rollout = RolloutController(authz_engine=self.engine)
+            self.driver = driver_cls(
+                tid, self.rollout, slo=slo, live_eval=self.live_eval
+            )
+            self.bodies = corpus.sar_bodies(baseline_n * 4, seed=47)
+            self.baseline = {
+                b: self.live_eval(b)[0] for b in self.bodies[:baseline_n]
+            }
+            self.probe = corpus.probe_request()
+            self.probe_before = self.engine.evaluate(*self.probe)[0]
+            self.served = 0
+            self.flips = 0
+            self.cursor = 0
+
+        def live_eval(self, body):
+            attrs = get_authorizer_attributes(json.loads(body))
+            return self.authorizer.authorize_batch([attrs])[0]
+
+        def pump(self, n):
+            """Serve n storm arrivals through the lifecycle router,
+            checking every answer against the pre-run baseline."""
+            for _ in range(n):
+                body = self.bodies[self.cursor % len(self.bodies)]
+                self.cursor += 1
+                decision, _reason = self.driver.serve(body)
+                self.served += 1
+                want = self.baseline.get(body)
+                if want is not None and decision != want:
+                    self.flips += 1
+
+    def _spec(tid, candidate_tiers):
+        return PolicyRolloutSpec(
+            tenant=tid,
+            candidate={"tiers": candidate_tiers},
+            shadow_min_samples=shadow_min,
+            shadow_diff_budget=0,
+            canary_min_decisions=canary_min,
+            canary_max_flips=0,
+            canary_ladder=(10, 50, 100),
+            stage_deadline_s=deadline_s,
+            max_retries=3,
+        )
+
+    t0 = time.time()
+    planes = {}
+    specs = {}
+    for tid in tenants:
+        corpus = corpora[tid]
+        driver_cls = (
+            _FailingCanaryDriver if tid == bad_slo
+            else RolloutLifecycleDriver
+        )
+        planes[tid] = _Plane(tid, corpus, driver_cls)
+        if tid == bad_lower:
+            cand = corpus.tiers() + [unlowerable_tier]
+        elif tid == bad_shadow:
+            cand = corpus.tiers() + [broad_forbid_tier]
+        else:
+            # the real rollout: the tenant's probe-policy edit — zero
+            # diffs on storm traffic, an observable flip on the probe
+            cand = corpus.with_edit().tiers()
+        specs[tid] = _spec(tid, cand)
+    build_s = time.time() - t0
+
+    # stagger the bad candidates through the fleet so their halts land
+    # while neighbors are mid-rollout
+    apply_order = list(good)
+    apply_order.insert(1, bad_lower)
+    apply_order.insert(len(apply_order) // 2, bad_shadow)
+    apply_order.append(bad_slo)
+
+    audit_records = []
+
+    class _Audit:
+        @staticmethod
+        def record(entry):
+            audit_records.append(entry)
+
+    ctrl = LifecycleController(
+        audit_log=_Audit(), backoff_base_s=0.01, backoff_cap_s=0.1
+    )
+
+    # ------------------------------------------------ fleet storm run
+    t0 = time.time()
+    ticks = 0
+    applied = 0
+    truncated = None
+    while ticks < max_ticks:
+        if applied < len(apply_order) and ticks % 2 == 0:
+            tid = apply_order[applied]
+            ctrl.apply(specs[tid], planes[tid].driver)
+            applied += 1
+        stages = ctrl.tick()
+        ticks += 1
+        for tid, stage in stages.items():
+            if stage in ("shadowing", "canary"):
+                arrivals = poisson_schedule(
+                    rate_hz, window_s, seed=f"{tid}:{ticks}"
+                )
+                planes[tid].pump(len(arrivals))
+                planes[tid].rollout.drain(10)
+        if applied == len(apply_order) and all(
+            s in TERMINAL_STAGES for s in stages.values()
+        ):
+            break
+        if time.time() - t_start > wall_budget_s:
+            truncated = (
+                f"wall budget {wall_budget_s:.0f}s exhausted at tick "
+                f"{ticks}; gates below fail honestly"
+            )
+            break
+    fleet_s = time.time() - t0
+
+    status = ctrl.status()["tenants"]
+    manual_interventions = sum(
+        1 for r in audit_records if r.get("event") == "approved"
+    )
+
+    good_ok = all(
+        status[tid]["stage"] == "promoted"
+        and planes[tid].rollout.status()["state"] == "promoted"
+        for tid in good
+    ) and manual_interventions == 0
+    probe_flips = {
+        tid: f"{planes[tid].probe_before}->"
+        f"{planes[tid].engine.evaluate(*planes[tid].probe)[0]}"
+        for tid in tenants
+    }
+    probe_ok = all(
+        probe_flips[tid] == "allow->deny" for tid in good
+    ) and all(
+        probe_flips[tid] == "allow->allow"
+        for tid in (bad_lower, bad_shadow, bad_slo)
+    )
+
+    def _halted_at(tid, gate):
+        doc = status[tid]
+        return (
+            doc["stage"] == "rolled_back"
+            and doc.get("halt", {}).get("gate") == gate
+            and planes[tid].rollout.status()["state"] == "idle"
+        )
+
+    tiers_ok = (
+        _halted_at(bad_lower, "lowerability")
+        and _halted_at(bad_shadow, "shadow_diff")
+        and _halted_at(bad_slo, "slo_burn")
+    )
+    total_served = sum(p.served for p in planes.values())
+    total_flips = sum(p.flips for p in planes.values())
+    flips_ok = total_served > 0 and total_flips == 0
+
+    # ------------------------------------- crash-mid-canary resume drill
+    drill_tid = "drill"
+    drill_corpus = synth_tenant_corpora(per_tenant, 1, seed=29, clusters=1)
+    drill_corpus = drill_corpus[list(drill_corpus)[0]]
+    drill = _Plane(drill_tid, drill_corpus)
+    drill_spec = _spec(drill_tid, drill_corpus.with_edit().tiers())
+    import tempfile
+
+    journal_path = os.path.join(
+        tempfile.mkdtemp(prefix="cedar-lifecycle-"), "journal.jsonl"
+    )
+    default_registry().reset()
+    default_registry().configure(
+        {
+            "faults": [
+                {
+                    # append 4 = the first canary rung-advance transition:
+                    # the controller dies with the canary split live
+                    "seam": "lifecycle.journal",
+                    "kind": "kill",
+                    "after": 4,
+                    "count": 1,
+                }
+            ]
+        }
+    )
+    default_registry().arm()
+    ctrl_a = LifecycleController(journal=LifecycleJournal(journal_path))
+    ctrl_a.apply(drill_spec, drill.driver)
+    killed = False
+    for i in range(max_ticks):
+        try:
+            stage = ctrl_a.tick()[drill_tid]
+        except ThreadKilled:
+            killed = True
+            break
+        if stage in ("shadowing", "canary"):
+            drill.pump(
+                len(poisson_schedule(rate_hz, window_s, seed=f"drill:{i}"))
+            )
+            drill.rollout.drain(10)
+        if stage in TERMINAL_STAGES:
+            break
+    ctrl_a.journal.close()
+    default_registry().reset()
+    # the replacement controller process: resume from the journal
+    ctrl_b = LifecycleController(journal=LifecycleJournal(journal_path))
+    resumed = ctrl_b.resume({drill_tid: drill.driver})
+    # no mixed-generation window: the canary split is gone and the first
+    # post-resume answers come from the untouched live lineage
+    no_mixed_window = (
+        drill.driver.canary_fraction == 0.0
+        and drill.rollout.status()["state"] == "idle"
+        and drill.engine.evaluate(*drill.probe)[0] == drill.probe_before
+    )
+    drill.flips = 0
+    for i in range(max_ticks):
+        stage = ctrl_b.tick()[drill_tid]
+        if stage in TERMINAL_STAGES:
+            break
+        if stage in ("shadowing", "canary"):
+            drill.pump(
+                len(poisson_schedule(rate_hz, window_s, seed=f"drillb:{i}"))
+            )
+            drill.rollout.drain(10)
+        if time.time() - t_start > wall_budget_s:
+            break
+    resume_ok = (
+        killed
+        and resumed == {drill_tid: "pending"}
+        and no_mixed_window
+        and ctrl_b.stages()[drill_tid] == "promoted"
+        and drill.flips == 0
+        and drill.engine.evaluate(*drill.probe)[0] == "deny"
+    )
+
+    ok = good_ok and probe_ok and tiers_ok and flips_ok and resume_ok
+
+    fallback_reason = os.environ.get("CEDAR_BENCH_CPU_FALLBACK", "")
+    import jax
+
+    backend = jax.default_backend()
+    result = {
+        "scenario": "lifecycle",
+        "smoke": _SMOKE,
+        **(
+            {"backend": backend, "backend_note": fallback_reason}
+            if fallback_reason
+            else {"backend": backend}
+        ),
+        "tenants": n_tenants,
+        "good_tenants": n_good,
+        "policies_per_tenant": per_tenant,
+        "build_s": round(build_s, 2),
+        "fleet": {
+            "ticks": ticks,
+            "fleet_s": round(fleet_s, 2),
+            "served": total_served,
+            "live_flips": total_flips,
+            "manual_interventions": manual_interventions,
+            "stages": {t: status[t]["stage"] for t in tenants},
+            "transitions_audited": sum(
+                1 for r in audit_records if r.get("event") == "transition"
+            ),
+            **({"truncated": truncated} if truncated else {}),
+        },
+        "breaches": {
+            "lowerability": status[bad_lower].get("halt"),
+            "shadow_diff": status[bad_shadow].get("halt"),
+            "slo_burn": status[bad_slo].get("halt"),
+        },
+        "probe_flips": probe_flips,
+        "crash_drill": {
+            "killed_mid_run": killed,
+            "resumed": resumed,
+            "no_mixed_generation_window": bool(no_mixed_window),
+            "final_stage": ctrl_b.stages().get(drill_tid),
+        },
+        "gates": {
+            "good_auto_promoted_ok": bool(good_ok),
+            "probe_edits_serving_ok": bool(probe_ok),
+            "gate_tiers_ok": bool(tiers_ok),
+            "zero_live_flips_ok": bool(flips_ok),
+            "crash_resume_ok": bool(resume_ok),
+        },
+        "pass": bool(ok),
+        "elapsed_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 def run_storm_scenario() -> int:
     """``bench.py --storm`` (``make bench-storm``): the open-loop overload
     harness for the admission-control plane (cedar_tpu/load,
@@ -4649,6 +5042,23 @@ if __name__ == "__main__":
 
         jax.config.update("jax_cpu_enable_async_dispatch", True)
         _scenario_exit("tenants", run_tenants_scenario)
+
+    if "--lifecycle" in sys.argv:
+        # declarative policy-lifecycle scenario (make bench-lifecycle):
+        # cpu-only BY DESIGN — the gates are about the control loop
+        # (evidence-gated promotion, halt + rollback at each gate tier,
+        # crash resume with no mixed-generation window), not device
+        # speed. Async dispatch so the evaluate pipeline overlaps like
+        # an attached device.
+        os.environ.setdefault("CEDAR_NATIVE_THREADS", "1")
+        os.environ.setdefault("CEDAR_TPU_WARM_DEFAULT", "off")
+        from cedar_tpu.jaxenv import force_cpu
+
+        force_cpu()
+        import jax
+
+        jax.config.update("jax_cpu_enable_async_dispatch", True)
+        _scenario_exit("lifecycle", run_lifecycle_scenario)
 
     if "--encode" in sys.argv:
         # host-side budget microbench (make bench-encode): cpu-only BY

@@ -116,6 +116,15 @@ SEAMS = {
     "load.shed": "admission-control gate verdict (cedar_tpu/load): a "
     "`corrupt` rule forces the verdict to a shed — storm game days prove "
     "the shed answer path and the breaker's indifference to it",
+    "lifecycle.gate": "lifecycle gate evaluation (cedar_tpu/lifecycle): "
+    "fired before each verify/shadow/canary evidence check — `error` "
+    "rules exercise the transient-retry path, `kill` a controller crash "
+    "at a stage boundary",
+    "lifecycle.canary": "per-request canary-slice candidate evaluation "
+    "inside the lifecycle canary router — an `error` rule makes the "
+    "canary slice burn its SLO budget (the lifecycle-breach game day)",
+    "lifecycle.journal": "lifecycle journal append (crash-point seam: "
+    "`kill` = controller dies mid-transition; resume must replay)",
     "response": "final (decision, reason, error) swap (reference parity)",
 }
 

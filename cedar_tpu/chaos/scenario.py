@@ -161,6 +161,24 @@ BUILTIN_SCENARIOS = {
         # closed breaker, asserted by the runner/tests directly
         "spawn_args": ["--max-inflight", "64"],
     },
+    "lifecycle-breach": {
+        "name": "lifecycle-breach",
+        "seed": 29,
+        "description": "a staged candidate goes bad mid-canary: canary-"
+        "slice evaluations start erroring, the lifecycle controller's SLO "
+        "burn gate must halt the rollout and roll the candidate back "
+        "automatically, and live traffic must see zero decision flips "
+        "(the canary slice answers from the live engine on candidate "
+        "error, so availability holds)",
+        "faults": [
+            {"seam": "lifecycle.canary", "kind": "error", "after": 5,
+             "probability": 0.8, "count": 200,
+             "message": "candidate evaluation failed (game day)"},
+        ],
+        "slo": {"availability": 0.0},  # canary errors ARE the scenario:
+        # the gates that matter — automatic rollback, zero decision flips
+        # on live traffic — are asserted by bench --lifecycle / tests
+    },
 }
 
 

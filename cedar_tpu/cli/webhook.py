@@ -902,6 +902,12 @@ def build_server(args) -> WebhookServer:
             max_bytes=args.audit_max_bytes,
             max_files=args.audit_max_files,
         )
+    if rollout is not None and audit_log is not None:
+        # rollout lifecycle actions (stage/promote/rollback and refusals,
+        # with divergence detail) land in the same audit stream as
+        # policy-admin actions; late-bound because the audit log is built
+        # after the rollout controller
+        rollout.set_audit_sink(audit_log.record)
     slo = None
     if args.slo_availability_target > 0:
         from ..obs import SLOTracker
@@ -1030,6 +1036,75 @@ def build_server(args) -> WebhookServer:
             "control signal (docs/performance.md)"
         )
 
+    # declarative policy lifecycle (cedar_tpu/lifecycle, docs/rollout.md
+    # "Declarative lifecycle"): PolicyRollout specs drive the rollout
+    # controller through verify → shadow → (canary) → promote with
+    # evidence gates, journaled for crash resume
+    lifecycle = None
+    if args.lifecycle_spec_dir:
+        if rollout is None:
+            raise ValueError(
+                "--lifecycle-spec-dir requires the shadow-rollout plane "
+                "(--backend tpu, no fanout): the lifecycle controller "
+                "drives stage/promote/rollback on the rollout controller "
+                "(docs/rollout.md)"
+            )
+        from ..lifecycle import (
+            LifecycleController,
+            LifecycleJournal,
+            RolloutLifecycleDriver,
+            load_specs_dir,
+        )
+
+        specs = load_specs_dir(args.lifecycle_spec_dir)
+
+        def _lifecycle_driver(spec):
+            # server deployments have no in-process canary router on the
+            # live serving path (live_eval=None): specs should use an
+            # empty canary_ladder and promote on verify+shadow evidence
+            if spec.canary_ladder:
+                log.warning(
+                    "lifecycle spec %r has a canary ladder but the "
+                    "webhook server has no embedded canary router; the "
+                    "canary quorum will never fill and the stage "
+                    "deadline will halt the rollout — use "
+                    '"canaryLadder": [] in server deployments',
+                    spec.tenant,
+                )
+            return RolloutLifecycleDriver(
+                spec.tenant,
+                rollout,
+                slo=slo,
+                warm="async",
+                sample_rate=args.shadow_sample_rate,
+            )
+
+        journal = LifecycleJournal(args.lifecycle_journal_file or None)
+        lifecycle = LifecycleController(journal=journal, audit_log=audit_log)
+        by_tenant = {s.tenant: s for s in specs}
+        resumed = lifecycle.resume(
+            {t: _lifecycle_driver(s) for t, s in by_tenant.items()},
+            specs=by_tenant,
+        )
+        for spec in specs:
+            if spec.tenant in resumed:
+                continue
+            lifecycle.apply(spec, _lifecycle_driver(spec))
+        if len(specs) > 1:
+            log.warning(
+                "%d lifecycle specs share one rollout controller: the "
+                "shadow plane holds ONE candidate at a time, so rollouts "
+                "serialize (a second stage while one is in flight "
+                "retries under its deadline)",
+                len(specs),
+            )
+        lifecycle.start(args.lifecycle_interval_seconds)
+    elif args.lifecycle_journal_file:
+        log.warning(
+            "--lifecycle-journal-file without --lifecycle-spec-dir is "
+            "inert; ignoring"
+        )
+
     server = WebhookServer(
         authorizer=authorizer,
         admission_handler=admission_handler,
@@ -1066,6 +1141,7 @@ def build_server(args) -> WebhookServer:
         slo=slo,
         tenancy=tenancy_resolver,
         load=load_ctrl,
+        lifecycle=lifecycle,
     )
     if getattr(args, "adaptive_batching", False):
         # SLO-adaptive batching: one tuner per wired batcher, sensing the
@@ -1625,6 +1701,32 @@ def make_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="allow UNAUTHENTICATED rollout lifecycle POSTs on the "
         "metrics listener (trusted-loopback deployments only)",
+    )
+    rollout.add_argument(
+        "--lifecycle-spec-dir",
+        default="",
+        help="directory of PolicyRollout manifests (*.json) driven by "
+        "the declarative lifecycle controller: verify → shadow → promote "
+        "with evidence gates, automatic halt + rollback on breach "
+        '(docs/rollout.md "Declarative lifecycle"). Requires the '
+        "shadow-rollout plane (--backend tpu, no fanout); server specs "
+        'should set "canaryLadder": [] — the in-process canary router '
+        "is the embedded/bench deployment shape",
+    )
+    rollout.add_argument(
+        "--lifecycle-journal-file",
+        default="",
+        help="JSONL write-ahead journal for lifecycle transitions; on "
+        "restart the controller replays it, unwinds anything in flight "
+        "to the live-only plane, and restarts those rollouts from "
+        "pending (crash resume with no mixed-generation window). "
+        "Default: in-memory (no resume across restarts)",
+    )
+    rollout.add_argument(
+        "--lifecycle-interval-seconds",
+        type=float,
+        default=1.0,
+        help="reconcile-loop period of the lifecycle controller",
     )
 
     obs = parser.add_argument_group("observability")

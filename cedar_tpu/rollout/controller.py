@@ -51,7 +51,13 @@ STATE_PROMOTED = "promoted"
 
 class RolloutError(RuntimeError):
     """A lifecycle operation could not be performed (bad state, rejected
-    candidate, diverged lineage)."""
+    candidate, diverged lineage). ``detail``, when present, is a
+    JSON-shaped dict the HTTP layer returns in the 409 body (e.g. the
+    per-replica lineage-divergence breakdown on a refused rollback)."""
+
+    def __init__(self, message: str, detail: Optional[dict] = None):
+        super().__init__(message)
+        self.detail = detail
 
 
 def _record_fleet_rollback() -> None:
@@ -135,6 +141,7 @@ class RolloutController:
         duty_cycle: float = DEFAULT_DUTY_CYCLE,
         crd_candidate_provider=None,
         authz_fleet=None,
+        audit_sink=None,
     ):
         # live engines (None on interpreter-only deployments — staging and
         # shadowing still work through the interpreter; promotion needs
@@ -166,6 +173,12 @@ class RolloutController:
         # (stores withhold them from live serving); stage(crd=True) builds
         # the candidate corpus from them (cli/webhook.py wires this)
         self._crd_candidate_provider = crd_candidate_provider
+        # entry-dict callable (AuditLog.record-compatible): every
+        # stage/promote/rollback — including refusals — lands one record,
+        # so the audit trail shows WHO changed what served, not just the
+        # decisions that followed. Best-effort: a sick sink never gates a
+        # lifecycle operation.
+        self._audit_sink = audit_sink
         self._lock = threading.Lock()
         self._state = STATE_IDLE
         self._candidate: Optional[_Candidate] = None
@@ -262,7 +275,30 @@ class RolloutController:
             len(tiers),
             warm,
         )
+        self._audit("staged", description=cand.description, tiers=len(tiers))
         return self.status()
+
+    def set_audit_sink(self, sink) -> None:
+        """Late-bind the audit sink (the CLI builds the AuditLog after
+        the rollout controller)."""
+        self._audit_sink = sink
+
+    def _audit(self, event: str, **fields) -> None:
+        sink = self._audit_sink
+        if sink is None:
+            return
+        try:
+            sink(
+                {
+                    "kind": "rollout",
+                    "event": event,
+                    "ts": time.time(),
+                    "generation": self.generation,
+                    **fields,
+                }
+            )
+        except Exception:  # noqa: BLE001 — audit never gates the lifecycle
+            log.exception("rollout audit record failed")
 
     def _finalize_or_refuse_promotion(self) -> None:
         """Staging over an ACTIVE promotion would strand its rollback
@@ -521,6 +557,11 @@ class RolloutController:
             cand.description,
             len(self._rollback_points),
         )
+        self._audit(
+            "promoted",
+            description=cand.description,
+            roles=sorted(self._rollback_points),
+        )
         return self.status()
 
     def rollback(self) -> dict:
@@ -546,18 +587,33 @@ class RolloutController:
                 discarded = False
         if discarded:
             self._stop_shadow(old_shadow)
+            self._audit("rollback_discarded")
             # status() re-acquires the (non-reentrant) lock — outside only
             return self.status()
         with self._lock:
             if self._state != STATE_PROMOTED:
                 raise RolloutError("rollback: nothing staged or promoted")
-            for role, (live, prior, generation) in self._rollback_points.items():
-                if live.load_generation != generation:
-                    raise RolloutError(
-                        f"rollback: live {role} engine reloaded since "
-                        "promotion (store content changed); the saved set is "
-                        "stale — restore by reverting the store content"
-                    )
+            diverged = [
+                self._divergence_entry(role, live, generation)
+                for role, (live, _prior, generation)
+                in self._rollback_points.items()
+                if live.load_generation != generation
+            ]
+            if diverged:
+                detail = {
+                    "diverged": diverged,
+                    "classification": self._classify_divergence(diverged),
+                }
+                self._audit("rollback_refused", detail=detail)
+                raise RolloutError(
+                    "rollback: live engine(s) reloaded since promotion "
+                    "(store content changed); the saved set is stale — "
+                    "restore by reverting the store content ("
+                    + ", ".join(e["role"] for e in diverged)
+                    + " diverged)",
+                    detail=detail,
+                )
+            for role, (live, prior, _generation) in self._rollback_points.items():
                 if prior is None:
                     raise RolloutError(
                         f"rollback: no prior compiled set for {role}"
@@ -569,7 +625,49 @@ class RolloutController:
             self._state = STATE_IDLE
             self._bump_generation_locked()
         log.info("rolled back to the pre-promotion compiled sets")
+        self._audit("rolled_back")
         return self.status()
+
+    @staticmethod
+    def _divergence_entry(role: str, live, generation) -> dict:
+        """One role's lineage-divergence breakdown for the refusal body
+        and audit record: expected (post-promotion) vs live generations,
+        per replica when the live side is a fleet — so operators can
+        tell a whole-plane store reload from a single wedged replica."""
+        def _doc(g):
+            return list(g) if isinstance(g, tuple) else g
+
+        entry = {
+            "role": role,
+            "expected_generation": _doc(generation),
+            "live_generation": _doc(live.load_generation),
+        }
+        replicas = getattr(live, "replicas", None)
+        if replicas is not None and isinstance(generation, tuple):
+            entry["replicas"] = [
+                {
+                    "replica": r.name,
+                    "expected_generation": expected,
+                    "live_generation": r.engine.load_generation,
+                    "diverged": r.engine.load_generation != expected,
+                }
+                for r, expected in zip(replicas, generation)
+            ]
+        return entry
+
+    def _classify_divergence(self, diverged) -> str:
+        """``store_reload_superseded`` — every engine (and every fleet
+        replica) moved on uniformly, the signature of a store-content
+        reload; ``partial_promotion_wedge`` — only a subset diverged,
+        which means the serving plane is split across lineages and needs
+        operator attention beyond a store revert."""
+        if len(diverged) < len(self._rollback_points):
+            return "partial_promotion_wedge"
+        for entry in diverged:
+            reps = entry.get("replicas")
+            if reps and not all(r["diverged"] for r in reps):
+                return "partial_promotion_wedge"
+        return "store_reload_superseded"
 
     def stop(self) -> None:
         self._stop_shadow(self._detach_shadow())
@@ -635,6 +733,16 @@ class RolloutController:
     def drain(self, timeout_s: float = 10.0) -> bool:
         shadow = self._shadow
         return True if shadow is None else shadow.drain(timeout_s)
+
+    def candidate_stack(self):
+        """(authorizer, admission_handler) of the STAGED candidate, or
+        None — the lifecycle canary router (cedar_tpu/lifecycle) answers
+        its canary slice through these: the same cache-bypassing stacks
+        the shadow evaluator evaluates against."""
+        cand = self._candidate
+        if cand is None:
+            return None
+        return cand.authorizer, cand.admission_handler
 
     @property
     def report(self) -> Optional[DiffReport]:

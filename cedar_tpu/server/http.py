@@ -314,6 +314,7 @@ class WebhookServer:
         slo=None,
         tenancy=None,
         load=None,
+        lifecycle=None,
     ):
         self.authorizer = authorizer
         self.admission_handler = admission_handler
@@ -506,6 +507,11 @@ class WebhookServer:
         # keeps the gate-free path byte-identical (bench.py --storm gates
         # the enabled-but-idle differential).
         self.load = load
+        # declarative lifecycle controller (cedar_tpu/lifecycle): the
+        # server serves its /debug/lifecycle document and the
+        # /lifecycle/approve control verb, and stops its reconcile loop
+        # on shutdown; the CLI (--lifecycle-spec-dir) wires it
+        self.lifecycle = lifecycle
         # SLO-adaptive batch tuners (cedar_tpu/load/tuner.py), appended by
         # the CLI (or embedders) after construction — the server owns
         # their lifecycle (stop()) and serves their decision logs on
@@ -1949,6 +1955,20 @@ class WebhookServer:
                         log.exception("load status failed")
                         doc = {"error": "load status failed"}
                     self._send_json(doc)
+                elif self.path == "/debug/lifecycle":
+                    # declarative lifecycle controller (docs/rollout.md
+                    # "Declarative lifecycle"): per-tenant stage, rung,
+                    # gate evidence, halt reason, and the journal path;
+                    # 404 with no controller wired
+                    if server.lifecycle is None:
+                        self.send_error(404)
+                        return
+                    try:
+                        doc = server.lifecycle.status()
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("lifecycle status failed")
+                        doc = {"error": "lifecycle status failed"}
+                    self._send_json(doc)
                 elif self.path == "/debug/slo":
                     # SLO plane (docs/observability.md): targets plus
                     # per-path, per-window request/error/slow counts and
@@ -2044,7 +2064,7 @@ class WebhookServer:
                 if self.path.startswith("/chaos/"):
                     self._chaos_control()
                     return
-                if server.rollout is None:
+                if server.rollout is None and server.lifecycle is None:
                     self.send_error(404)
                     return
                 if not server.rollout_control_enabled:
@@ -2090,10 +2110,16 @@ class WebhookServer:
                 except (ValueError, TypeError) as e:
                     self._send_json({"error": f"bad JSON body: {e}"}, 400)
                     return
+                from ..lifecycle import LifecycleError
                 from ..rollout import RolloutError
                 from ..rollout.source import CandidateSourceError
 
                 try:
+                    if self.path.startswith("/rollout/") and (
+                        server.rollout is None
+                    ):
+                        self.send_error(404)
+                        return
                     if self.path == "/rollout/stage":
                         out = server.rollout.stage(
                             directory=doc.get("directory"),
@@ -2111,11 +2137,30 @@ class WebhookServer:
                     elif self.path == "/rollout/rollback":
                         out = server.rollout.rollback()
                         server._prebuild_snapshots()
+                    elif self.path == "/lifecycle/approve":
+                        # manual-promotion consent for a declarative
+                        # rollout holding at its last canary rung
+                        if server.lifecycle is None:
+                            self.send_error(404)
+                            return
+                        out = server.lifecycle.approve(
+                            doc.get("tenant") or ""
+                        )
                     else:
                         self.send_error(404)
                         return
-                except (RolloutError, CandidateSourceError) as e:
-                    self._send_json({"error": str(e)}, 409)
+                except (
+                    RolloutError, CandidateSourceError, LifecycleError
+                ) as e:
+                    # a structured refusal (e.g. the per-replica lineage
+                    # divergence on a refused rollback) rides the body so
+                    # callers can distinguish "store reload superseded"
+                    # from "partial promotion wedge" without parsing prose
+                    body = {"error": str(e)}
+                    detail = getattr(e, "detail", None)
+                    if detail:
+                        body["detail"] = detail
+                    self._send_json(body, 409)
                     return
                 except Exception as e:  # noqa: BLE001 — report, never crash
                     log.exception("rollout control %s failed", self.path)
@@ -2290,6 +2335,14 @@ class WebhookServer:
                 self.fanout.stop()  # worker stacks drain their batchers
             except Exception:  # noqa: BLE001 — teardown must finish
                 log.exception("fanout stop failed")
+        if self.lifecycle is not None:
+            try:
+                # reconcile loop BEFORE the rollout controller: a tick
+                # landing mid-teardown would drive stage/promote against
+                # a stack that is being dismantled
+                self.lifecycle.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception("lifecycle stop failed")
         if self.rollout is not None:
             try:
                 self.rollout.stop()  # shadow worker; best-effort by design

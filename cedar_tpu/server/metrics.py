@@ -365,6 +365,90 @@ def clear_tenant_policies(tenant: str) -> None:
         tenant_policies.remove(tenant=tenant)
 
 
+# ----------------------------------------------------------- lifecycle
+# Declarative policy-lifecycle controller (cedar_tpu/lifecycle,
+# docs/rollout.md "Declarative lifecycle"): per-tenant rollout stage and
+# transition accounting under the same bounded tenant label as the
+# tenancy families above — lifecycle specs are operator-authored, but a
+# runaway spec directory must not explode the exposition either.
+
+lifecycle_stage = REGISTRY.register(
+    Gauge(
+        "cedar_lifecycle_stage",
+        "Current lifecycle stage per tenant rollout, as a code: 0=pending "
+        "1=verifying 2=shadowing 3=canary 4=promoting 5=promoted "
+        "6=halted 7=rolled_back 8=failed. Bounded tenant label (see "
+        "cedar_tenant_requests_total); the row is removed when the "
+        "tenant's rollout spec is deleted.",
+        ["tenant"],
+    )
+)
+
+lifecycle_transitions_total = REGISTRY.register(
+    Counter(
+        "cedar_lifecycle_transitions_total",
+        "Lifecycle stage transitions per tenant rollout (bounded tenant "
+        "label). `from`/`to` are stage names; alert on any transition "
+        "into `halted`/`failed`.",
+        ["tenant", "from", "to"],
+    )
+)
+
+lifecycle_gate_breaches_total = REGISTRY.register(
+    Counter(
+        "cedar_lifecycle_gate_breaches_total",
+        "Gate breaches that halted a tenant's rollout, by gate tier "
+        "(`lowerability`, `shadow_diff`, `slo_burn`, `deadline`). Each "
+        "breach triggers automatic halt + rollback.",
+        ["tenant", "gate"],
+    )
+)
+
+lifecycle_retries_total = REGISTRY.register(
+    Counter(
+        "cedar_lifecycle_retries_total",
+        "Transient stage-failure retries per tenant rollout and stage "
+        "(decorrelated-jitter backoff under the per-stage deadline).",
+        ["tenant", "stage"],
+    )
+)
+
+
+def set_lifecycle_stage(tenant: str, code: int) -> None:
+    lifecycle_stage.set(code, tenant=_tenant_label_for(tenant))
+
+
+def record_lifecycle_transition(tenant: str, frm: str, to: str) -> None:
+    # "from" is a keyword, so the label dict is spelled out
+    lifecycle_transitions_total.inc(
+        **{"tenant": _tenant_label_for(tenant), "from": frm, "to": to}
+    )
+
+
+def record_lifecycle_gate_breach(tenant: str, gate: str) -> None:
+    lifecycle_gate_breaches_total.inc(
+        tenant=_tenant_label_for(tenant), gate=gate
+    )
+
+
+def record_lifecycle_retry(tenant: str, stage: str) -> None:
+    lifecycle_retries_total.inc(
+        tenant=_tenant_label_for(tenant), stage=stage
+    )
+
+
+def clear_lifecycle_tenant(tenant: str) -> None:
+    """Drop a deleted rollout spec's stage gauge row and free the
+    tenant's slot in the bounded label set (the clear_tenant_policies
+    contract: counters keep their last values, gauges must not keep
+    reporting a rollout that no longer exists)."""
+    with _tenant_label_lock:
+        known = tenant in _tenant_labels
+        _tenant_labels.discard(tenant)
+    if known:
+        lifecycle_stage.remove(tenant=tenant)
+
+
 def record_fallback_decision(codes, engine: str = "") -> None:
     """One interpreter-merged decision under each distinct Unlowerable
     code it was served with (precomputed tuple, compiler/pack.py), on the

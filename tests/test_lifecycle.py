@@ -1,0 +1,874 @@
+"""Declarative policy-lifecycle controller tests (cedar_tpu/lifecycle,
+docs/rollout.md "Declarative lifecycle").
+
+The load-bearing pieces:
+
+  * the good path: a PolicyRollout spec drives author → verify → shadow →
+    canary (3 rungs) → promote with ZERO manual interventions, every
+    transition journaled + audited;
+  * a bad candidate halted + auto-rolled-back at EACH gate tier —
+    lowerability (verify), shadow_diff (shadow), canary_flip / slo_burn
+    (canary) — with live answers untouched throughout;
+  * crash-resume at EVERY stage boundary: a chaos ``kill`` rule on the
+    ``lifecycle.journal`` seam murders the controller mid-transition; a
+    fresh controller over the same journal file resumes, unwinds the
+    serving plane to live-only (no mixed-generation window), and re-earns
+    promotion from scratch;
+  * the satellite fixes: rollback-refusal 409s carrying structured
+    divergence detail (store_reload_superseded vs
+    partial_promotion_wedge), bounded tenant-label metrics with gauge-row
+    removal on spec deletion, and the /debug/lifecycle +
+    /lifecycle/approve HTTP surface.
+"""
+
+import json
+
+import pytest
+from test_rollout import (
+    CANDIDATE_POLICIES,
+    FILENAME,
+    LIVE_POLICIES,
+    _tiers,
+    sar_body,
+)
+
+from cedar_tpu.chaos import ThreadKilled, builtin_scenario, default_registry
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+from cedar_tpu.lang import PolicySet
+from cedar_tpu.lifecycle import (
+    STAGE_PROMOTED,
+    STAGE_ROLLED_BACK,
+    LifecycleController,
+    LifecycleError,
+    LifecycleJournal,
+    PolicyRolloutSpec,
+    RolloutLifecycleDriver,
+    SpecError,
+    load_specs_dir,
+    spec_from_dict,
+)
+from cedar_tpu.obs import SLOTracker
+from cedar_tpu.rollout import RolloutController, RolloutError
+from cedar_tpu.server import metrics
+from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+from cedar_tpu.server.http import get_authorizer_attributes
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+TENANT = "team-a"
+
+# lifecycle specs stage candidates from inline ``source`` text; the live
+# stack builds its tiers through the SAME loader so policy ids (and thus
+# reason strings) match and the only shadow diffs are real decision /
+# reason changes, never naming noise
+_LIVE_FILENAME = "candidate.cedar"
+
+
+def _live_tiers(src):
+    from cedar_tpu.rollout.source import candidate_tiers_from_source
+
+    return candidate_tiers_from_source(src)
+
+# 2^12 DNF clauses > SPILL_MAX_CLAUSES: permissive analysis reports a
+# blocking finding, the verify gate's lowerability breach
+_BLOWUP = " && ".join(
+    '(resource.resource == "r1" || resource.name == "never")'
+    for _ in range(12)
+)
+UNLOWERABLE_POLICIES = LIVE_POLICIES + (
+    'permit (principal in k8s::Group::"joiners", '
+    'action == k8s::Action::"get", resource is k8s::Resource)\n'
+    f"  when {{ {_BLOWUP} }};\n"
+)
+
+
+@pytest.fixture(autouse=True)
+def _pristine_chaos_registry():
+    default_registry().reset()
+    yield
+    default_registry().reset()
+
+
+def _bodies(n=200):
+    """n distinct SAR bodies: enough spread that every canary rung's
+    crc32 slice holds a few (the slice is deterministic per body)."""
+    out = []
+    for i in range(n):
+        out.append(sar_body(user=f"u{i:03d}", resource="pods"))
+    out.append(sar_body("alice", "pods"))  # decision flips in CANDIDATE
+    return out
+
+
+class _Stack:
+    """One tenant's serving plane: live TPU engine + authorizer +
+    rollout controller + SLO tracker + the lifecycle driver over them."""
+
+    def __init__(self, live_src=LIVE_POLICIES, tenant=TENANT):
+        self.engine = TPUPolicyEngine(name="authorization", warm_max_batch=8)
+        self.engine.load(_live_tiers(live_src), warm="off")
+        self.stores = TieredPolicyStores(
+            [MemoryStore(_LIVE_FILENAME, _live_tiers(live_src)[0])]
+        )
+        self.authorizer = CedarWebhookAuthorizer(
+            self.stores,
+            evaluate=self.engine.evaluate,
+            evaluate_batch=self.engine.evaluate_batch,
+        )
+        self.rollout = RolloutController(authz_engine=self.engine)
+        self.slo = SLOTracker(availability_target=0.999)
+        self.driver = RolloutLifecycleDriver(
+            tenant, self.rollout, slo=self.slo, live_eval=self.live_eval
+        )
+
+    def live_eval(self, body):
+        attrs = get_authorizer_attributes(json.loads(body))
+        return self.authorizer.authorize_batch([attrs])[0]
+
+    def stop(self):
+        self.rollout.stop()
+
+
+def _controller(**kwargs):
+    """Fast-retry controller: zero-jitter backoff so transient-failure
+    tests don't sleep."""
+    kwargs.setdefault("backoff_base_s", 0.0)
+    kwargs.setdefault("backoff_cap_s", 0.001)
+    kwargs.setdefault("backoff_uniform", lambda a, b: 0.0)
+    return LifecycleController(**kwargs)
+
+
+def _spec(**overrides):
+    base = dict(
+        tenant=TENANT,
+        candidate={"source": LIVE_POLICIES},
+        shadow_min_samples=20,
+        canary_min_decisions=3,
+        canary_ladder=(10, 50, 100),
+        stage_deadline_s=300.0,
+        max_retries=3,
+    )
+    base.update(overrides)
+    return PolicyRolloutSpec(**base)
+
+
+def _run(ctrl, stack, bodies=None, max_ticks=200, drain_s=10.0):
+    """Tick the controller to a terminal stage, pumping live traffic
+    between ticks the way a serving loop would."""
+    bodies = bodies if bodies is not None else _bodies()
+    from cedar_tpu.lifecycle import TERMINAL_STAGES
+
+    for _ in range(max_ticks):
+        stages = ctrl.tick()
+        stage = stages[TENANT]
+        if stage in TERMINAL_STAGES:
+            return stage
+        if stage in ("shadowing", "canary"):
+            for b in bodies:
+                stack.driver.serve(b)
+            stack.rollout.drain(drain_s)
+    raise AssertionError(
+        f"no terminal stage after {max_ticks} ticks: {ctrl.status()}"
+    )
+
+
+# ---------------------------------------------------------------- good path
+
+
+class TestGoodPath:
+    def test_auto_promotion_zero_interventions(self):
+        stack = _Stack()
+        records = []
+
+        class _Audit:
+            @staticmethod
+            def record(entry):
+                records.append(entry)
+
+        ctrl = _controller(audit_log=_Audit())
+        try:
+            ctrl.apply(_spec(), stack.driver)
+            assert _run(ctrl, stack) == STAGE_PROMOTED
+            # the rollout controller finished a full promotion
+            assert stack.rollout.status()["state"] == "promoted"
+            # every stage advanced on recorded evidence
+            doc = ctrl.status()["tenants"][TENANT]
+            assert doc["evidence"]["verify"]["blocking"] == 0
+            assert doc["evidence"]["shadow"]["samples"] >= 20
+            assert doc["evidence"]["shadow"]["diffs"] == 0
+            assert doc["evidence"]["canary"]["flips"] == 0
+            assert doc["rung"] == 2  # climbed the whole ladder
+            # the journal holds the full transition history, WAL-ordered
+            tos = [r["to"] for r in ctrl.journal.records() if r.get("to")]
+            assert tos == [
+                "verifying", "shadowing", "canary", "canary", "canary",
+                "promoting", "promoted",
+            ]
+            # audited end to end (applied + each transition)
+            events = [r["event"] for r in records]
+            assert events.count("transition") == 7
+            assert "applied" in events
+        finally:
+            stack.stop()
+
+    def test_empty_ladder_promotes_on_shadow_evidence(self):
+        """The webhook-server posture: no in-process canary router, so
+        the spec skips canary and shadow evidence is the final gate."""
+        stack = _Stack()
+        ctrl = _controller()
+        try:
+            ctrl.apply(_spec(canary_ladder=()), stack.driver)
+            assert _run(ctrl, stack) == STAGE_PROMOTED
+            assert "canary" not in ctrl.status()["tenants"][TENANT]["evidence"]
+        finally:
+            stack.stop()
+
+    def test_manual_promotion_holds_for_approval(self):
+        stack = _Stack()
+        ctrl = _controller()
+        try:
+            ctrl.apply(
+                _spec(promotion="manual", canary_ladder=()), stack.driver
+            )
+            bodies = _bodies()
+            for _ in range(40):
+                ctrl.tick()
+                for b in bodies:
+                    stack.driver.serve(b)
+                stack.rollout.drain(10)
+                if ctrl.status()["tenants"][TENANT]["awaiting_approval"]:
+                    break
+            doc = ctrl.status()["tenants"][TENANT]
+            assert doc["awaiting_approval"]
+            assert doc["stage"] == "shadowing"  # held, not promoted
+            assert stack.rollout.status()["state"] == "staged"
+            events = [r.get("event") for r in ctrl.journal.records()]
+            assert "awaiting_approval" in events
+            ctrl.approve(TENANT)
+            assert _run(ctrl, stack) == STAGE_PROMOTED
+        finally:
+            stack.stop()
+
+
+# ------------------------------------------------- gate breaches, per tier
+
+
+class TestGateBreaches:
+    def _assert_rolled_back(self, ctrl, stack, gate):
+        doc = ctrl.status()["tenants"][TENANT]
+        assert doc["stage"] == STAGE_ROLLED_BACK
+        assert doc["halt"]["gate"] == gate
+        # the serving plane is back to live-only
+        assert stack.rollout.status()["state"] == "idle"
+        # gate-breach metric counted for this tenant
+        key = (("tenant", TENANT), ("gate", gate))
+        assert metrics.lifecycle_gate_breaches_total._values.get(key, 0) >= 1
+
+    def test_tier1_lowerability_blocking_findings(self):
+        stack = _Stack()
+        ctrl = _controller()
+        try:
+            ctrl.apply(
+                _spec(candidate={"source": UNLOWERABLE_POLICIES}),
+                stack.driver,
+            )
+            assert _run(ctrl, stack) == STAGE_ROLLED_BACK
+            self._assert_rolled_back(ctrl, stack, "lowerability")
+            assert ctrl.status()["tenants"][TENANT]["halt"]["evidence"][
+                "blocking"
+            ] > 0
+        finally:
+            stack.stop()
+
+    def test_tier1_lowerability_coverage_floor(self):
+        """Zero blocking findings but coverage under the spec's floor is
+        the same breach: the floor is a promise about the fast path."""
+        stack = _Stack()
+        ctrl = _controller()
+        try:
+            ctrl.apply(_spec(lowerability_floor_pct=101.0), stack.driver)
+            assert _run(ctrl, stack) == STAGE_ROLLED_BACK
+            self._assert_rolled_back(ctrl, stack, "lowerability")
+        finally:
+            stack.stop()
+
+    def test_tier2_shadow_diff_budget(self):
+        stack = _Stack()
+        ctrl = _controller()
+        alice = sar_body("alice", "pods")
+        live_before = stack.live_eval(alice)
+        try:
+            ctrl.apply(
+                _spec(candidate={"source": CANDIDATE_POLICIES}),
+                stack.driver,
+            )
+            assert _run(ctrl, stack) == STAGE_ROLLED_BACK
+            self._assert_rolled_back(ctrl, stack, "shadow_diff")
+            evidence = ctrl.status()["tenants"][TENANT]["halt"]["evidence"]
+            assert evidence["diffs"] > 0
+            # live answers never moved: shadow diffs are evidence, not
+            # serving changes
+            assert stack.live_eval(alice) == live_before
+        finally:
+            stack.stop()
+
+    def test_tier3_canary_flip_fail_safe(self):
+        """A decision flip the shadow window missed: the disagreeing
+        candidate answer must NOT serve, and the rollout halts."""
+        stack = _Stack()
+        ctrl = _controller()
+        alice = sar_body("alice", "pods")
+        live_answer = stack.live_eval(alice)
+        try:
+            # shadow gate vacuous (0 samples needed) so the flip body
+            # first meets the candidate inside the canary slice
+            ctrl.apply(
+                _spec(
+                    candidate={"source": CANDIDATE_POLICIES},
+                    shadow_min_samples=0,
+                    canary_ladder=(100,),
+                    canary_min_decisions=1,
+                ),
+                stack.driver,
+            )
+            served = None
+            from cedar_tpu.lifecycle import TERMINAL_STAGES
+
+            for _ in range(50):
+                stage = ctrl.tick()[TENANT]
+                if stage in TERMINAL_STAGES:
+                    break
+                if stage == "canary":
+                    served = stack.driver.serve(alice)
+            self._assert_rolled_back(ctrl, stack, "canary_flip")
+            # fail-safe: the flip was counted, the LIVE answer served
+            assert served == live_answer
+        finally:
+            stack.stop()
+
+    def test_tier3_slo_burn(self):
+        """Injected canary-slice failures (the lifecycle-breach game
+        day) burn the canary SLO; the burn gate halts and rolls back
+        while live answers keep flowing from the live engine."""
+        stack = _Stack()
+        ctrl = _controller()
+        default_registry().configure(
+            {
+                "faults": [
+                    {
+                        "seam": "lifecycle.canary",
+                        "kind": "error",
+                        "count": 100000,
+                        "message": "candidate evaluation failed (game day)",
+                    }
+                ]
+            }
+        )
+        default_registry().arm()
+        alice = sar_body("alice", "pods")
+        live_answer = stack.live_eval(alice)
+        try:
+            ctrl.apply(
+                _spec(
+                    shadow_min_samples=0,
+                    canary_ladder=(100,),
+                    canary_min_decisions=1,
+                ),
+                stack.driver,
+            )
+            from cedar_tpu.lifecycle import TERMINAL_STAGES
+
+            for _ in range(50):
+                stage = ctrl.tick()[TENANT]
+                if stage in TERMINAL_STAGES:
+                    break
+                if stage == "canary":
+                    # every canary evaluation errors; live still answers
+                    assert stack.driver.serve(alice) == live_answer
+            self._assert_rolled_back(ctrl, stack, "slo_burn")
+            assert (
+                ctrl.status()["tenants"][TENANT]["halt"]["evidence"]["burn"]
+                > 2.0
+            )
+        finally:
+            stack.stop()
+
+    def test_neighbor_unaffected_by_breach(self):
+        """Per-tenant isolation: tenant B's rollout promotes while tenant
+        A's candidate is halted at the verify gate."""
+        stack_a = _Stack(tenant="team-a")
+        stack_b = _Stack(tenant="team-b")
+        ctrl = _controller()
+        try:
+            ctrl.apply(
+                _spec(candidate={"source": UNLOWERABLE_POLICIES}),
+                stack_a.driver,
+            )
+            ctrl.apply(_spec(tenant="team-b"), stack_b.driver)
+            bodies = _bodies()
+            from cedar_tpu.lifecycle import TERMINAL_STAGES
+
+            for _ in range(200):
+                stages = ctrl.tick()
+                if all(s in TERMINAL_STAGES for s in stages.values()):
+                    break
+                if stages["team-b"] in ("shadowing", "canary"):
+                    for b in bodies:
+                        stack_b.driver.serve(b)
+                    stack_b.rollout.drain(10)
+            assert ctrl.stages() == {
+                "team-a": STAGE_ROLLED_BACK,
+                "team-b": STAGE_PROMOTED,
+            }
+        finally:
+            stack_a.stop()
+            stack_b.stop()
+
+
+# ----------------------------------------------- retries, deadlines, chaos
+
+
+class TestSelfHealing:
+    def test_transient_gate_failures_retry_then_succeed(self):
+        stack = _Stack()
+        ctrl = _controller()
+        default_registry().configure(
+            {
+                "faults": [
+                    {"seam": "lifecycle.gate", "kind": "error", "count": 2}
+                ]
+            }
+        )
+        default_registry().arm()
+        try:
+            ctrl.apply(_spec(), stack.driver)
+            assert _run(ctrl, stack) == STAGE_PROMOTED
+            key = (("tenant", TENANT), ("stage", "verifying"))
+            assert metrics.lifecycle_retries_total._values.get(key, 0) >= 1
+        finally:
+            stack.stop()
+
+    def test_retry_exhaustion_is_a_breach(self):
+        stack = _Stack()
+        ctrl = _controller()
+        default_registry().configure(
+            {
+                "faults": [
+                    {
+                        "seam": "lifecycle.gate",
+                        "kind": "error",
+                        "count": 100000,
+                    }
+                ]
+            }
+        )
+        default_registry().arm()
+        try:
+            ctrl.apply(_spec(max_retries=1), stack.driver)
+            assert _run(ctrl, stack) == STAGE_ROLLED_BACK
+            doc = ctrl.status()["tenants"][TENANT]
+            assert doc["halt"]["gate"] == "retry_exhausted"
+        finally:
+            stack.stop()
+
+    def test_stage_deadline_breach(self):
+        """A shadow window that never fills (no traffic) breaches the
+        per-stage deadline instead of wedging forever."""
+        fake = [0.0]
+        stack = _Stack()
+        ctrl = _controller(clock=lambda: fake[0])
+        try:
+            ctrl.apply(_spec(stage_deadline_s=5.0), stack.driver)
+            ctrl.tick()  # pending -> verifying
+            ctrl.tick()  # verifying -> shadowing (stage + shadow start)
+            assert ctrl.stages()[TENANT] == "shadowing"
+            ctrl.tick()  # samples 0 < min, inside deadline: no-op
+            assert ctrl.stages()[TENANT] == "shadowing"
+            fake[0] += 10.0
+            ctrl.tick()  # deadline breach -> halted
+            ctrl.tick()  # halted -> rolled_back
+            doc = ctrl.status()["tenants"][TENANT]
+            assert doc["stage"] == STAGE_ROLLED_BACK
+            assert doc["halt"]["gate"] == "deadline"
+            assert stack.rollout.status()["state"] == "idle"
+        finally:
+            stack.stop()
+
+
+# ------------------------------------------------------------ crash resume
+
+
+# journal append index of each stage boundary for the default spec
+# (applied=0): killing append k crashes the controller AT that boundary —
+# the record never lands, resume() restarts from the pre-transition stage
+_BOUNDARIES = {
+    1: "pending->verifying",
+    2: "verifying->shadowing",
+    3: "shadowing->canary",
+    4: "canary rung 0->1",
+    5: "canary rung 1->2",
+    6: "canary->promoting",
+    7: "promoting->promoted",
+}
+
+
+class TestCrashResume:
+    @pytest.mark.parametrize(
+        "kill_at", sorted(_BOUNDARIES), ids=_BOUNDARIES.get
+    )
+    def test_kill_at_every_stage_boundary(self, tmp_path, kill_at):
+        path = str(tmp_path / "journal.jsonl")
+        stack = _Stack()
+        ctrl = _controller(journal=LifecycleJournal(path))
+        default_registry().configure(
+            {
+                "faults": [
+                    {
+                        "seam": "lifecycle.journal",
+                        "kind": "kill",
+                        "after": kill_at,
+                        "count": 1,
+                    }
+                ]
+            }
+        )
+        default_registry().arm()
+        bodies = _bodies()
+        try:
+            ctrl.apply(_spec(), stack.driver)  # journal append 0
+            killed = False
+            for _ in range(200):
+                try:
+                    stages = ctrl.tick()
+                except ThreadKilled:
+                    killed = True
+                    break
+                stage = stages[TENANT]
+                assert stage != STAGE_PROMOTED, (
+                    "reached terminal before the kill fired"
+                )
+                if stage in ("shadowing", "canary"):
+                    for b in bodies:
+                        stack.driver.serve(b)
+                    stack.rollout.drain(10)
+            assert killed, f"kill at append {kill_at} never fired"
+            ctrl.journal.close()  # the dead controller's file handle
+
+            # --- a fresh controller process over the same journal file
+            ctrl2 = _controller(journal=LifecycleJournal(path))
+            resumed = ctrl2.resume({TENANT: stack.driver})
+            # anything in flight unwound to the live-only serving plane:
+            # no staged candidate, no canary split, no half-promotion
+            assert resumed == {TENANT: "pending"}
+            assert stack.rollout.status()["state"] == "idle"
+            assert stack.driver.canary_fraction == 0.0
+            # ... and promotion is re-earned from fresh evidence
+            assert _run(ctrl2, stack, bodies=bodies) == STAGE_PROMOTED
+            assert stack.rollout.status()["state"] == "promoted"
+            tos = [r["to"] for r in ctrl2.journal.records() if r.get("to")]
+            assert tos[-1] == STAGE_PROMOTED
+            assert "resumed" in [
+                r.get("event") for r in ctrl2.journal.records()
+            ]
+        finally:
+            stack.stop()
+
+    def test_resume_mid_canary_no_mixed_generation_window(self, tmp_path):
+        """The acceptance drill: die with the canary split live, resume,
+        and prove the very first post-resume answers come from exactly
+        one lineage (the live engine)."""
+        path = str(tmp_path / "journal.jsonl")
+        stack = _Stack()
+        ctrl = _controller(journal=LifecycleJournal(path))
+        default_registry().configure(
+            {
+                "faults": [
+                    {
+                        "seam": "lifecycle.journal",
+                        "kind": "kill",
+                        "after": 4,  # first rung-advance transition
+                        "count": 1,
+                    }
+                ]
+            }
+        )
+        default_registry().arm()
+        bodies = _bodies()
+        gen_live = stack.engine.load_generation
+        try:
+            ctrl.apply(
+                _spec(candidate={"source": CANDIDATE_POLICIES},
+                      shadow_diff_budget=10**6),
+                stack.driver,
+            )
+            with pytest.raises(ThreadKilled):
+                for _ in range(200):
+                    ctrl.tick()
+                    for b in bodies:
+                        stack.driver.serve(b)
+                    stack.rollout.drain(10)
+            # died mid-canary: the split was live when the kill landed
+            ctrl.journal.close()
+            ctrl2 = _controller(journal=LifecycleJournal(path))
+            ctrl2.resume({TENANT: stack.driver})
+            # live engine never promoted, split zeroed: every answer now
+            # comes from the pre-rollout lineage
+            assert stack.engine.load_generation == gen_live
+            assert stack.driver.canary_fraction == 0.0
+            alice = sar_body("alice", "pods")
+            assert stack.driver.serve(alice) == stack.live_eval(alice)
+        finally:
+            stack.stop()
+
+    def test_terminal_stages_stay_terminal_on_resume(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        stack = _Stack()
+        ctrl = _controller(journal=LifecycleJournal(path))
+        try:
+            ctrl.apply(_spec(), stack.driver)
+            assert _run(ctrl, stack) == STAGE_PROMOTED
+            ctrl.journal.close()
+            ctrl2 = _controller(journal=LifecycleJournal(path))
+            resumed = ctrl2.resume({TENANT: stack.driver})
+            assert resumed == {TENANT: STAGE_PROMOTED}
+            # no unwind: the finished promotion is left serving
+            assert stack.rollout.status()["state"] == "promoted"
+        finally:
+            stack.stop()
+
+
+# ------------------------------------------------- journal + spec parsing
+
+
+class TestJournal:
+    def test_seq_recovery_and_torn_tail(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = LifecycleJournal(path)
+        j.append({"event": "applied", "tenant": "t1", "spec": {}})
+        j.append(
+            {"event": "transition", "tenant": "t1",
+             "from": "pending", "to": "verifying"}
+        )
+        j.close()
+        with open(path, "a") as f:
+            f.write('{"seq": 3, "event": "transition", "ten')  # torn
+        j2 = LifecycleJournal(path)
+        recs = j2.records()
+        assert [r["seq"] for r in recs] == [1, 2]
+        j2.append({"event": "deleted", "tenant": "t1"})
+        assert j2.records()[-1]["seq"] == 3  # monotonic past the tear
+        assert j2.replay() == {}  # deleted tenants are omitted
+
+    def test_replay_tracks_last_stage_and_spec(self):
+        j = LifecycleJournal()
+        spec_doc = _spec().to_dict()
+        j.append({"event": "applied", "tenant": TENANT, "spec": spec_doc})
+        j.append({"event": "transition", "tenant": TENANT,
+                  "from": "pending", "to": "verifying"})
+        j.append({"event": "transition", "tenant": TENANT,
+                  "from": "verifying", "to": "shadowing"})
+        entry = j.replay()[TENANT]
+        assert entry["stage"] == "shadowing"
+        assert entry["spec"] == spec_doc
+        # the journaled spec round-trips through the parser
+        assert spec_from_dict(entry["spec"]) == _spec()
+
+
+class TestSpec:
+    def test_manifest_round_trip(self):
+        spec = _spec(candidate={"directory": "/etc/cedar/candidate"})
+        assert spec_from_dict(spec.to_dict()) == spec
+
+    @pytest.mark.parametrize(
+        "overrides",
+        [
+            {"tenant": "-bad-"},
+            {"candidate": {}},
+            {"candidate": {"directory": "/x", "source": "permit;"}},
+            {"promotion": "yolo"},
+            {"canary_ladder": (50, 10)},
+            {"canary_ladder": (0,)},
+            {"canary_ladder": (101,)},
+            {"stage_deadline_s": 0},
+            {"max_retries": -1},
+        ],
+    )
+    def test_validation_rejects(self, overrides):
+        with pytest.raises(SpecError):
+            _spec(**overrides)
+
+    def test_specs_dir_sorted_and_duplicate_tenant_refused(self, tmp_path):
+        doc_a = _spec(tenant="team-a").to_dict()
+        doc_b = _spec(tenant="team-b").to_dict()
+        (tmp_path / "b.json").write_text(json.dumps(doc_b))
+        (tmp_path / "a.json").write_text(json.dumps(doc_a))
+        (tmp_path / "ignored.yaml").write_text("not json")
+        specs = load_specs_dir(str(tmp_path))
+        assert [s.tenant for s in specs] == ["team-a", "team-b"]
+        (tmp_path / "c.json").write_text(json.dumps(doc_a))
+        with pytest.raises(SpecError, match="duplicate"):
+            load_specs_dir(str(tmp_path))
+
+
+# --------------------------------------------------- controller lifecycle
+
+
+class TestControllerAdmin:
+    def test_apply_refuses_in_flight_then_delete_frees(self):
+        stack = _Stack()
+        ctrl = _controller()
+        try:
+            ctrl.apply(_spec(), stack.driver)
+            with pytest.raises(LifecycleError, match="in flight"):
+                ctrl.apply(_spec(), stack.driver)
+            # gauge row exists while the spec does
+            key = (("tenant", TENANT),)
+            assert key in metrics.lifecycle_stage._values
+            ctrl.delete(TENANT)
+            # gauge row removed + label slot freed on deletion
+            assert key not in metrics.lifecycle_stage._values
+            with pytest.raises(LifecycleError):
+                ctrl.delete(TENANT)
+            # tenant can be re-applied after deletion
+            ctrl.apply(_spec(), stack.driver)
+        finally:
+            stack.stop()
+
+    def test_lifecycle_breach_scenario_is_loadable(self):
+        scenario = builtin_scenario("lifecycle-breach")
+        assert scenario is not None
+        default_registry().configure(scenario)  # seams must all exist
+        assert any(
+            f["seam"] == "lifecycle.canary" for f in scenario["faults"]
+        )
+
+
+# ------------------------------------- rollback-refusal divergence detail
+
+
+class TestRollbackDivergenceDetail:
+    def _controller_with_audit(self, *, admission=False):
+        engine = TPUPolicyEngine(name="authorization", warm_max_batch=1)
+        engine.load(_tiers(LIVE_POLICIES), warm="off")
+        adm = None
+        if admission:
+            adm = TPUPolicyEngine(name="admission", warm_max_batch=1)
+            adm.load(_tiers(LIVE_POLICIES), warm="off")
+        rollout = RolloutController(
+            authz_engine=engine, admission_engine=adm
+        )
+        records = []
+        rollout.set_audit_sink(records.append)
+        return engine, adm, rollout, records
+
+    def test_store_reload_superseded(self):
+        engine, _, rollout, records = self._controller_with_audit()
+        rollout.stage(
+            tiers=[PolicySet.from_source(CANDIDATE_POLICIES, FILENAME)],
+            warm="off",
+        )
+        rollout.promote(force=True)
+        engine.load(_tiers(LIVE_POLICIES), warm="off")  # reloader fired
+        with pytest.raises(RolloutError, match="reloaded since") as ei:
+            rollout.rollback()
+        detail = ei.value.detail
+        assert detail["classification"] == "store_reload_superseded"
+        assert [d["role"] for d in detail["diverged"]] == ["authorization"]
+        entry = detail["diverged"][0]
+        assert entry["expected_generation"] != entry["live_generation"]
+        # the refusal is audited with the same structured detail
+        refused = [r for r in records if r["event"] == "rollback_refused"]
+        assert refused and refused[0]["detail"] == detail
+
+    def test_partial_promotion_wedge(self):
+        """Only ONE of the promoted roles diverged: that is a wedged
+        partial promotion (mixed lineages live), not a store reload."""
+        engine, adm, rollout, records = self._controller_with_audit(
+            admission=True
+        )
+        rollout.stage(
+            tiers=[PolicySet.from_source(CANDIDATE_POLICIES, FILENAME)],
+            warm="off",
+        )
+        rollout.promote(force=True)
+        adm.load(_tiers(LIVE_POLICIES), warm="off")  # admission only
+        with pytest.raises(RolloutError) as ei:
+            rollout.rollback()
+        detail = ei.value.detail
+        assert detail["classification"] == "partial_promotion_wedge"
+        assert [d["role"] for d in detail["diverged"]] == ["admission"]
+
+    def test_rollback_audit_trail_on_success(self):
+        engine, _, rollout, records = self._controller_with_audit()
+        rollout.stage(
+            tiers=[PolicySet.from_source(CANDIDATE_POLICIES, FILENAME)],
+            warm="off",
+        )
+        rollout.promote(force=True)
+        rollout.rollback()
+        events = [r["event"] for r in records]
+        assert events == ["staged", "promoted", "rolled_back"]
+
+
+# ------------------------------------------------------------ HTTP surface
+
+
+class TestHTTPSurface:
+    def test_debug_lifecycle_approve_and_409_detail(self):
+        import urllib.error
+        import urllib.request
+
+        from test_rollout import _engine_stack
+
+        engine, adm_engine, server, stores, cache = _engine_stack(
+            LIVE_POLICIES, warm_max_batch=1
+        )
+        rollout = RolloutController(authz_engine=engine)
+        ctrl = _controller()
+        stack_driver = RolloutLifecycleDriver(TENANT, rollout)
+        ctrl.apply(
+            _spec(promotion="manual", canary_ladder=()), stack_driver
+        )
+        server.rollout = rollout
+        server.lifecycle = ctrl
+        server.start()
+        port = server.bound_metrics_port
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as resp:
+                return json.loads(resp.read())
+
+        def post(path, doc=None, expect=200):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(doc or {}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    assert resp.status == expect
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                assert e.code == expect, (e.code, e.read())
+                return json.loads(e.read())
+
+        try:
+            doc = get("/debug/lifecycle")
+            assert doc["tenants"][TENANT]["stage"] == "pending"
+            out = post("/lifecycle/approve", {"tenant": TENANT})
+            assert out["approved"] is True
+            # unknown tenant -> 409 with the error message
+            out = post("/lifecycle/approve", {"tenant": "nope"}, expect=409)
+            assert "no rollout" in out["error"]
+            # rollback refusal carries the structured divergence detail
+            post("/rollout/stage", {"source": CANDIDATE_POLICIES})
+            post("/rollout/promote", {"force": True})
+            engine.load(_tiers(LIVE_POLICIES), warm="off")
+            out = post("/rollout/rollback", expect=409)
+            assert (
+                out["detail"]["classification"] == "store_reload_superseded"
+            )
+            assert out["detail"]["diverged"][0]["role"] == "authorization"
+        finally:
+            server.stop()

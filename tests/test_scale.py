@@ -129,9 +129,9 @@ class TestShardPlan:
         lowered = []
         real = shard_mod.lower_policy
 
-        def counting(policy, tier, schema):
+        def counting(policy, tier, schema, opts=None):
             lowered.append(policy.policy_id)
-            return real(policy, tier, schema)
+            return real(policy, tier, schema, opts)
 
         monkeypatch.setattr(shard_mod, "lower_policy", counting)
         edited = c.with_edit()
