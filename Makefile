@@ -94,6 +94,10 @@ bench-storm: ## Open-loop overload: 5x sustained storm — high-priority availab
 bench-lifecycle: ## Declarative lifecycle fleet: staggered tenant rollouts under storm traffic — zero-touch auto-promotion, halt+rollback at each gate tier, zero live flips, crash-mid-canary resume (cpu; docs/rollout.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --lifecycle
 
+.PHONY: bench-analyze
+bench-analyze: ## Device-exact policy-space analysis: 10k-rule universe sweep through the rule-bitset kernel (zero dead rules, zero oracle disagreements), exact one-edit semantic diff, lifecycle analyze gate halt+rollback with zero live flips (cpu; docs/analysis.md)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --analyze
+
 .PHONY: bench-explain
 bench-explain: ## Explain-plane pay-for-use: explain-off p99/throughput parity gate, explain-on cost + lazy compiles (cpu; docs/explainability.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --explain
@@ -118,9 +122,9 @@ graft-check: ## Compile-check the jittable entry + multi-chip dry run
 
 ##@ Static analysis
 
-# scoped to the layers with the strongest invariants first; widen as
-# modules are annotated
-LINT_SCOPE ?= cedar_tpu/compiler cedar_tpu/analysis cedar_tpu/lang cedar_tpu/rollout cedar_tpu/chaos cedar_tpu/fleet cedar_tpu/engine cedar_tpu/ops cedar_tpu/native cedar_tpu/explain cedar_tpu/obs cedar_tpu/cache cedar_tpu/corpus cedar_tpu/fanout cedar_tpu/parallel cedar_tpu/tenancy cedar_tpu/load cedar_tpu/lifecycle
+# the whole package: the hand-picked subdirectory list silently left
+# server/stores/schema/apis/cli/entities/rbac un-linted
+LINT_SCOPE ?= cedar_tpu
 
 .PHONY: lint
 lint: ## ruff + mypy over $(LINT_SCOPE) (missing tools are skipped with a note)
@@ -140,6 +144,13 @@ analyze: ## Whole-policy-set static analysis over the demo + test corpora (cedar
 	$(PYTHON) -m cedar_tpu.cli.analyze --check demo/authorization-policy.yaml
 	$(PYTHON) -m cedar_tpu.cli.analyze --check demo/admission-policy.yaml
 	$(PYTHON) -m cedar_tpu.cli.analyze --check tests/testdata/rbac
+	$(PYTHON) -m cedar_tpu.cli.analyze --check tests/testdata/lifecycle/live
+	$(PYTHON) -m cedar_tpu.cli.analyze --check tests/testdata/lifecycle/candidate
+	$(PYTHON) -m cedar_tpu.cli.analyze --semantic-diff --check --flip-budget 1 \
+	    tests/testdata/lifecycle/live --candidate tests/testdata/lifecycle/candidate
+
+.PHONY: static
+static: lint analyze ## The full static gate: lint + policy-set analysis
 
 ##@ Schema & policies
 

@@ -3405,6 +3405,281 @@ def run_lifecycle_scenario() -> int:
     return 0 if ok else 1
 
 
+def run_analyze_scenario() -> int:
+    """``bench.py --analyze`` (``make bench-analyze``): the device-exact
+    policy-space analysis harness (cedar_tpu/analysis/space.py +
+    semdiff.py, docs/analysis.md "Device-exact analysis"). Gates (rc=1
+    on breach):
+
+      * a 10k-rule synth corpus sweeps through the packed plane's
+        batched rule-bitset kernel in seconds (wall-budget gate on the
+        sweep itself, engine build excluded), every policy proven alive
+        by its directed clause witness (ZERO dead rules) and ZERO
+        interpreter-oracle disagreements on the sampled cross-check;
+      * the semantic diff of a single-policy effect edit over the same
+        corpus finds flips of EXACTLY that edit's kind (allow_to_deny
+        only, at least one, oracle-clean) with concrete exemplars;
+      * the lifecycle ``analyze`` gate halts + auto-rolls-back a
+        candidate whose flip is OUTSIDE the spec's allowed intents
+        BEFORE any shadow or canary traffic sees it — zero live flips,
+        breach evidence (with flipped-request exemplars) in the audit
+        stream — while the SAME candidate under a matching
+        allowed-intent selector promotes and its edit serves.
+    """
+    from cedar_tpu.analysis.semdiff import semantic_diff, sweep
+    from cedar_tpu.corpus import synth_corpus
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.lifecycle import (
+        TERMINAL_STAGES,
+        LifecycleController,
+        RolloutLifecycleDriver,
+        spec_from_dict,
+    )
+    from cedar_tpu.rollout import RolloutController
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.server.http import get_authorizer_attributes
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    t_start = time.time()
+    sweep_n = _n(10_000, 600)
+    sweep_budget = _n(12_288, 2_048)
+    oracle_sample = _n(64, 32)
+    sweep_wall_s = _n(120.0, 60.0)
+    per_tenant = _n(120, 40)
+    baseline_n = _n(60, 30)
+    max_ticks = 400
+
+    # ---------------------------------------- part A: 10k-rule sweep
+    corpus = synth_corpus(sweep_n, seed=29, clusters=4)
+    tiers = corpus.tiers()
+    t0 = time.time()
+    engine = TPUPolicyEngine(name="analyze-sweep")
+    engine.load(tiers, warm="off")
+    build_s = time.time() - t0
+    res = sweep(
+        tiers,
+        budget=sweep_budget,
+        seed=0,
+        oracle_sample=oracle_sample,
+        engine=engine,
+        packed=engine._compiled.packed,
+    )
+    sweep_wall_ok = res.seconds < sweep_wall_s
+    sweep_alive_ok = not res.dead
+    sweep_oracle_ok = res.oracle.get("disagreements", 0) == 0
+
+    # one-policy effect edit: the diff must find that flip kind and
+    # nothing else, and the oracle slice must agree with the plane
+    diff = semantic_diff(
+        tiers,
+        corpus.with_edit(0).tiers(),
+        budget=sweep_budget,
+        seed=0,
+        oracle_sample=oracle_sample,
+    )
+    diff_exact_flip_ok = (
+        set(diff.flip_counts) == {"allow_to_deny"}
+        and diff.total_flips >= 1
+        and diff.oracle.get("disagreements", 0) == 0
+        and bool(diff.flips and diff.flips[0].get("request"))
+    )
+
+    # ------------------------------- part B: lifecycle analyze gate
+    audit_records = []
+
+    class _Audit:
+        @staticmethod
+        def record(entry):
+            audit_records.append(entry)
+
+    ctrl = LifecycleController(
+        audit_log=_Audit(), backoff_base_s=0.01, backoff_cap_s=0.1
+    )
+
+    class _Plane:
+        """One tenant's serving plane + analyze-gated lifecycle driver."""
+
+        def __init__(self, tid, corpus):
+            self.corpus = corpus
+            self.engine = TPUPolicyEngine(name=f"analyze-{tid}")
+            self.engine.load(corpus.tiers(), warm="off")
+            stores = TieredPolicyStores(
+                [MemoryStore(tid, corpus.tiers()[0])]
+            )
+            self.authorizer = CedarWebhookAuthorizer(
+                stores,
+                evaluate=self.engine.evaluate,
+                evaluate_batch=self.engine.evaluate_batch,
+            )
+            self.rollout = RolloutController(authz_engine=self.engine)
+            self.driver = RolloutLifecycleDriver(
+                tid,
+                self.rollout,
+                live_eval=self.live_eval,
+                live_tiers=corpus.tiers,
+            )
+            self.bodies = corpus.sar_bodies(baseline_n * 2, seed=47)
+            self.baseline = {
+                b: self.live_eval(b)[0] for b in self.bodies[:baseline_n]
+            }
+            self.flips = 0
+            self.cursor = 0
+
+        def live_eval(self, body):
+            attrs = get_authorizer_attributes(json.loads(body))
+            return self.authorizer.authorize_batch([attrs])[0]
+
+        def pump(self, n):
+            for _ in range(n):
+                body = self.bodies[self.cursor % len(self.bodies)]
+                self.cursor += 1
+                decision, _reason = self.driver.serve(body)
+                want = self.baseline.get(body)
+                if want is not None and decision != want:
+                    self.flips += 1
+
+    def analyze_spec(tid, corpus, intents):
+        return spec_from_dict({
+            "kind": "PolicyRollout",
+            "metadata": {"name": tid},
+            "spec": {
+                "candidate": {"tiers": corpus.with_edit(0).tiers()},
+                "gates": {
+                    "analyze": {
+                        "flip_budget": 0,
+                        "allowed_intents": intents,
+                        "universe_budget": 2048,
+                        "oracle_sample": 32,
+                    },
+                    "shadow": {"min_samples": 20, "diff_budget": 0},
+                },
+                # no in-process canary router on this path: promote
+                # directly from shadow evidence
+                "promotion": {"mode": "auto", "canary_ladder": []},
+                "stage_deadline_s": 300,
+            },
+        })
+
+    small = synth_corpus(per_tenant, seed=31, clusters=1)
+    # bad: the probe-effect flip matches NO allowed intent — the analyze
+    # gate must halt before the candidate is ever staged
+    bad = _Plane("analyze-bad", small)
+    ctrl.apply(analyze_spec("analyze-bad", small, []), bad.driver)
+    # good: the SAME candidate, but the operator declared the intent
+    good = _Plane("analyze-good", small)
+    ctrl.apply(
+        analyze_spec(
+            "analyze-good", small,
+            [{"kind": "allow_to_deny", "action": "k8s::Action::*"}],
+        ),
+        good.driver,
+    )
+    probe = good.corpus.probe_request()
+    probe_before = good.engine.evaluate(*probe)[0]
+
+    for _ in range(max_ticks):
+        stages = ctrl.tick()
+        for plane in (bad, good):
+            plane.pump(8)
+            plane.rollout.drain(10)
+        if all(s in TERMINAL_STAGES for s in stages.values()):
+            break
+    status = ctrl.status()["tenants"]
+
+    bad_halt = status["analyze-bad"].get("halt") or {}
+    bad_exemplars = (bad_halt.get("evidence") or {}).get("exemplars") or []
+    # the breach lands in the audit stream as the transition into
+    # `halted`, carrying the gate name and the full analyze evidence
+    audit_breaches = [
+        r for r in audit_records
+        if r.get("event") == "transition"
+        and r.get("tenant") == "analyze-bad"
+        and r.get("to") == "halted"
+        and r.get("gate") == "semantic_diff"
+        and (r.get("evidence") or {}).get("exemplars")
+    ]
+    analyze_halt_ok = (
+        status["analyze-bad"]["stage"] == "rolled_back"
+        and bad_halt.get("gate") == "semantic_diff"
+        and bad_halt.get("stage") == "analyzing"
+        and bool(bad_exemplars)
+        and bad.rollout.status().get("state") == "idle"
+        and bool(audit_breaches)
+    )
+    probe_after = good.engine.evaluate(*probe)[0]
+    analyze_intent_ok = (
+        status["analyze-good"]["stage"] == "promoted"
+        and probe_before == "allow"
+        and probe_after == "deny"
+    )
+    zero_live_flips_ok = bad.flips == 0 and good.flips == 0
+
+    ok = (
+        sweep_wall_ok
+        and sweep_alive_ok
+        and sweep_oracle_ok
+        and diff_exact_flip_ok
+        and analyze_halt_ok
+        and analyze_intent_ok
+        and zero_live_flips_ok
+    )
+
+    fallback_reason = os.environ.get("CEDAR_BENCH_CPU_FALLBACK", "")
+    import jax
+
+    backend = jax.default_backend()
+    result = {
+        "scenario": "analyze",
+        "smoke": _SMOKE,
+        **(
+            {"backend": backend, "backend_note": fallback_reason}
+            if fallback_reason
+            else {"backend": backend}
+        ),
+        "sweep": {
+            "policies": sweep_n,
+            "rules": res.n_rules,
+            "requests": res.universe.size,
+            "exhaustive": res.universe.exhaustive,
+            "strata": res.universe.strata,
+            "build_s": round(build_s, 2),
+            "sweep_s": round(res.seconds, 2),
+            "dead": len(res.dead),
+            "shadowed": len(res.shadowed),
+            "overlap_pairs": len(res.overlaps),
+            "oracle": res.oracle,
+        },
+        "semdiff": {
+            "requests": diff.n_requests,
+            "flips": dict(diff.flip_counts),
+            "oracle": diff.oracle,
+            "seconds": round(diff.seconds, 2),
+        },
+        "lifecycle": {
+            "bad_stage": status["analyze-bad"]["stage"],
+            "bad_halt_gate": bad_halt.get("gate"),
+            "bad_exemplars": len(bad_exemplars),
+            "good_stage": status["analyze-good"]["stage"],
+            "probe": {"before": probe_before, "after": probe_after},
+            "live_flips": bad.flips + good.flips,
+            "audit_breaches": len(audit_breaches),
+        },
+        "gates": {
+            "sweep_wall_ok": bool(sweep_wall_ok),
+            "sweep_alive_ok": bool(sweep_alive_ok),
+            "sweep_oracle_ok": bool(sweep_oracle_ok),
+            "diff_exact_flip_ok": bool(diff_exact_flip_ok),
+            "analyze_halt_ok": bool(analyze_halt_ok),
+            "analyze_intent_ok": bool(analyze_intent_ok),
+            "zero_live_flips_ok": bool(zero_live_flips_ok),
+        },
+        "pass": bool(ok),
+        "elapsed_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 def run_storm_scenario() -> int:
     """``bench.py --storm`` (``make bench-storm``): the open-loop overload
     harness for the admission-control plane (cedar_tpu/load,
@@ -5059,6 +5334,23 @@ if __name__ == "__main__":
 
         jax.config.update("jax_cpu_enable_async_dispatch", True)
         _scenario_exit("lifecycle", run_lifecycle_scenario)
+
+    if "--analyze" in sys.argv:
+        # device-exact policy-space analysis scenario (make
+        # bench-analyze): cpu-only BY DESIGN — the gates are about the
+        # request-universe sweep's exactness (zero oracle disagreements)
+        # and the lifecycle analyze gate's halt semantics, not device
+        # speed. Async cpu dispatch so the rule-bitset kernel overlaps
+        # like an attached device.
+        os.environ.setdefault("CEDAR_NATIVE_THREADS", "1")
+        os.environ.setdefault("CEDAR_TPU_WARM_DEFAULT", "off")
+        from cedar_tpu.jaxenv import force_cpu
+
+        force_cpu()
+        import jax
+
+        jax.config.update("jax_cpu_enable_async_dispatch", True)
+        _scenario_exit("analyze", run_analyze_scenario)
 
     if "--encode" in sys.argv:
         # host-side budget microbench (make bench-encode): cpu-only BY

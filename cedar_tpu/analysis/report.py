@@ -10,7 +10,7 @@ offending policies, permissive annotates.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 SEV_ERROR = "error"
 SEV_WARNING = "warning"
@@ -122,6 +122,33 @@ REASONS: Dict[str, Tuple[str, str, str]] = {
         "(forbid-overrides within a tier, tier order across tiers) — "
         "expected for carve-outs, worth reviewing otherwise",
     ),
+    # ---- device-exact sweep (kind "coverage") ---------------------------
+    # Codes emitted by analysis/semdiff.py. Their findings carry
+    # provenance "exact" when the enumerated universe was exhaustive
+    # over the encoding quotient (space.py), else "conservative" — the
+    # same sampled-hint strength as the host analyzer's passes.
+    "dead_rule": (
+        "coverage",
+        SEV_WARNING,
+        "the policy-space sweep found no request in the typed universe "
+        "that this policy matches (and none it errors on); it is dead "
+        "weight — delete it or fix the condition",
+    ),
+    "shadowed_exact": (
+        "shadowing",
+        SEV_WARNING,
+        "every universe request this policy matches is also matched by a "
+        "policy that pre-empts it in the tier walk (earlier tier, "
+        "same-tier forbid-overrides, or a same-effect cover); it never "
+        "determines a decision — delete it or narrow the shadower",
+    ),
+    "oracle_disagreement": (
+        "coverage",
+        SEV_ERROR,
+        "the compiled plane and the interpreter oracle disagreed on a "
+        "sampled universe request — a compiler or encoder bug, not a "
+        "policy problem; report it with the exemplar request",
+    ),
     # ---- capacity (kind "capacity") -------------------------------------
     "clause_heavy": (
         "capacity",
@@ -151,6 +178,10 @@ class Finding:
     message: str
     # policy ids this finding relates to (the shadower, the conflicting twin)
     related: Tuple[str, ...] = ()
+    # "exact" when backed by a device-exact exhaustive sweep
+    # (analysis/semdiff.py), "conservative" for the host analyzer's
+    # may-miss/may-over-report passes and sampled sweep hints
+    provenance: str = "conservative"
 
     @property
     def kind(self) -> str:
@@ -185,6 +216,7 @@ class Finding:
             "message": self.message,
             "hint": self.hint,
             "related": list(self.related),
+            "provenance": self.provenance,
         }
 
 
@@ -202,6 +234,10 @@ class AnalysisReport:
     capacity: dict = field(default_factory=dict)
     # pair-comparison budget ran out: shadowing/conflict coverage is partial
     truncated: bool = False
+    # device-exact sweep summary (semdiff.SweepResult.to_dict) when the
+    # CLI ran with --exact; {} otherwise. Always present in to_dict so
+    # consumers (lifecycle, dashboards) can key on it unconditionally.
+    sweep: dict = field(default_factory=dict)
 
     def by_severity(self, severity: str) -> List[Finding]:
         return [f for f in self.findings if f.severity == severity]
@@ -228,6 +264,7 @@ class AnalysisReport:
             "capacity": self.capacity,
             "truncated": self.truncated,
             "counts": self.counts(),
+            "sweep": self.sweep,
         }
 
     def render_text(self) -> str:
@@ -238,7 +275,8 @@ class AnalysisReport:
             self.findings,
             key=lambda f: (order[f.severity], f.tier, f.filename, f.position),
         ):
-            lines.append(f"{f.severity}[{f.code}] {f.location()}")
+            tag = f"{f.code}/exact" if f.provenance == "exact" else f.code
+            lines.append(f"{f.severity}[{tag}] {f.location()}")
             lines.append(f"  {f.message}")
             lines.append(f"  hint: {f.hint}")
             if f.related:
@@ -289,6 +327,18 @@ class AnalysisReport:
                     "  note: a bucket is exactly full — the next policy "
                     "added recompiles the device executables (bucket step)"
                 )
+        sw = self.sweep
+        if sw:
+            mode = "exhaustive" if sw.get("exact") else "stratified"
+            orc = sw.get("oracle", {})
+            lines.append(
+                f"sweep: {sw.get('universe', {}).get('size', 0)} requests "
+                f"({mode}), {len(sw.get('dead', ()))} dead, "
+                f"{len(sw.get('shadowed', ()))} shadowed, "
+                f"{len(sw.get('overlaps', ()))} overlapping pairs, oracle "
+                f"{orc.get('disagreements', 0)}/{orc.get('sampled', 0)} "
+                f"disagreements, {sw.get('seconds', 0)}s"
+            )
         if self.truncated:
             lines.append(
                 "note: pair-comparison budget exhausted; shadowing/conflict "

@@ -34,7 +34,7 @@ import logging
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Optional
 
 from ..chaos.registry import chaos_fire
 

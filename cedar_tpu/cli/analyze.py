@@ -19,6 +19,20 @@ or Kubernetes manifests (``.yaml``/``.yml``/``.json`` documents whose
 ``--check`` is the CI mode: exit 1 when any finding at or above
 ``--fail-level`` (default: error) exists. See docs/analysis.md for the
 reason-code catalog.
+
+``--exact`` additionally runs the device-exact policy-space sweep
+(analysis/semdiff.py): the typed request universe is enumerated from
+the compiled vocab tables and pushed through the packed plane, refuting
+or confirming the conservative findings (reason provenance ``exact`` vs
+``conservative``) and adding ``dead_rule``/``shadowed_exact`` verdicts
+with an interpreter-oracle cross-check.
+
+``--semantic-diff`` switches modes entirely: positional tiers are the
+LIVE set, ``--candidate`` (repeatable, in tier order) the candidate
+set; the report is the decision diff over their joint request universe
+with concrete flipped-request exemplars. With ``--check``, exits 1 when
+total flips exceed ``--flip-budget`` (default 0) or the oracle slice
+disagrees.
 """
 
 from __future__ import annotations
@@ -122,10 +136,50 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="clause-pair comparison budget for the quadratic "
         "shadowing/conflict passes; exhaustion is reported, never silent",
     )
+    parser.add_argument(
+        "--exact",
+        action="store_true",
+        help="run the device-exact policy-space sweep and upgrade/refute "
+        "the conservative findings (adds the `sweep` report section)",
+    )
+    parser.add_argument(
+        "--semantic-diff",
+        action="store_true",
+        help="diff mode: positional tiers are the live set, --candidate "
+        "the candidate set; report decision flips over the joint universe",
+    )
+    parser.add_argument(
+        "--candidate",
+        action="append",
+        default=[],
+        metavar="TIER",
+        help="candidate tier (repeatable, in tier order) for "
+        "--semantic-diff",
+    )
+    parser.add_argument(
+        "--flip-budget",
+        type=int,
+        default=0,
+        help="--semantic-diff --check fails when total decision flips "
+        "exceed this (default: 0)",
+    )
+    parser.add_argument(
+        "--universe-budget",
+        type=int,
+        default=4096,
+        help="request-universe size cap for --exact/--semantic-diff",
+    )
+    parser.add_argument(
+        "--seed",
+        type=int,
+        default=0,
+        help="stratified-universe seed for --exact/--semantic-diff",
+    )
     args = parser.parse_args(argv)
 
     try:
         tiers = [load_tier(t) for t in args.tiers]
+        cand_tiers = [load_tier(t) for t in args.candidate]
     except Exception as e:  # noqa: BLE001 — file/parse problems are exit 2
         print(f"cedar-analyze: {e}", file=sys.stderr)
         return 2
@@ -133,11 +187,48 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("cedar-analyze: no policies found", file=sys.stderr)
         return 2
 
+    if args.semantic_diff:
+        if not any(len(ps) for ps in cand_tiers):
+            print(
+                "cedar-analyze: --semantic-diff needs --candidate tiers",
+                file=sys.stderr,
+            )
+            return 2
+        from ..analysis.semdiff import semantic_diff
+
+        diff = semantic_diff(
+            tiers,
+            cand_tiers,
+            budget=args.universe_budget,
+            seed=args.seed,
+        )
+        if args.json:
+            print(json.dumps(diff.to_dict(), indent=2))
+        else:
+            print(_render_diff(diff))
+        if args.check and (
+            diff.total_flips > args.flip_budget
+            or diff.oracle.get("disagreements")
+        ):
+            return 1
+        return 0
+
     report = analyze_tiers(
         tiers,
         pair_budget=args.pair_budget,
         capacity=not args.no_capacity,
     )
+    if args.exact:
+        from ..analysis.semdiff import apply_sweep, pack_tiers, sweep
+
+        packed = pack_tiers(tiers)
+        res = sweep(
+            tiers,
+            budget=args.universe_budget,
+            seed=args.seed,
+            packed=packed,
+        )
+        apply_sweep(report, res, packed)
     if args.json:
         print(json.dumps(report.to_dict(), indent=2))
     else:
@@ -145,6 +236,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.check and report.at_or_above(args.fail_level):
         return 1
     return 0
+
+
+def _render_diff(diff) -> str:
+    lines = []
+    mode = "exhaustive" if diff.exact else "stratified"
+    lines.append(
+        f"semantic diff: {diff.total_flips} decision flips over "
+        f"{diff.n_requests} requests ({mode} universe), oracle "
+        f"{diff.oracle.get('disagreements', 0)}/"
+        f"{diff.oracle.get('sampled', 0)} disagreements, "
+        f"{round(diff.seconds, 3)}s"
+    )
+    for kind, n in sorted(diff.flip_counts.items()):
+        lines.append(f"  {kind}: {n}")
+    for f in diff.flips[:20]:
+        req = f["request"]
+        lines.append(
+            f"  {f['kind']}: principal={req['principal']} "
+            f"action={req['action']} resource={req['resource']}"
+        )
+    if len(diff.flips) > 20:
+        lines.append(f"  ... {len(diff.flips) - 20} more exemplars")
+    return "\n".join(lines)
 
 
 if __name__ == "__main__":

@@ -1077,6 +1077,10 @@ def build_server(args) -> WebhookServer:
                 slo=slo,
                 warm="async",
                 sample_rate=args.shadow_sample_rate,
+                # the analyze gate diffs the candidate against what the
+                # authz engine actually serves: the same analyzed tier
+                # view the reloader compiles from
+                live_tiers=lambda: TPUReloader._tiers_for(stores),
             )
 
         journal = LifecycleJournal(args.lifecycle_journal_file or None)

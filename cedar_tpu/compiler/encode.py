@@ -8,7 +8,7 @@ contract lives in cedar_tpu/native.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 from ..lang.entities import EntityMap
 from ..lang.eval import Env, Request, evaluate

@@ -28,7 +28,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, FrozenSet, List, Optional, Tuple
 
-from ..lang.ast import Expr, Pattern, Policy
+from ..lang.ast import Expr, Policy
 
 # slot = (var, path): var in {"principal", "action", "resource", "context"}
 Slot = Tuple[str, Tuple[str, ...]]

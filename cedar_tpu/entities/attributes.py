@@ -9,7 +9,7 @@ requirements.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Tuple
 
 READONLY_VERBS = frozenset({"get", "list", "watch"})
 

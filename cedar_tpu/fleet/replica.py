@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Optional
 
 from ..engine.batcher import MicroBatcher, PipelinedBatcher
 

@@ -11,7 +11,7 @@ arithmetic, and the ip/decimal extension constructors and methods.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Optional, Tuple
 
 from .values import EntityUID

@@ -15,7 +15,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from .ast import FORBID, PERMIT, Policy
+from .ast import FORBID, Policy
 from .entities import EntityMap
 from .eval import Env, Request, policy_matches
 from .parser import parse_policies

@@ -8,7 +8,7 @@ internal/server/entities/entities.go:7).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, Optional
+from typing import Dict, Iterable, Optional
 
 from .values import CedarRecord, EntityUID
 

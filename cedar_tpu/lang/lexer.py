@@ -8,9 +8,8 @@ literal asterisk (Cedar only permits that escape inside patterns).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import List
 
-from .values import EvalError
 
 
 class ParseError(Exception):

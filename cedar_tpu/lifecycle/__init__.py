@@ -12,6 +12,7 @@ storm-backed acceptance harness.
 """
 
 from .controller import (
+    STAGE_ANALYZING,
     STAGE_CANARY,
     STAGE_CODES,
     STAGE_FAILED,
@@ -55,6 +56,7 @@ __all__ = [
     "STAGE_CODES",
     "STAGE_PENDING",
     "STAGE_VERIFYING",
+    "STAGE_ANALYZING",
     "STAGE_SHADOWING",
     "STAGE_CANARY",
     "STAGE_PROMOTING",

@@ -5,8 +5,8 @@ Every rollout primitive in the repo is evidence-producing but
 operator-driven; this controller closes the loop. Each tenant's
 ``PolicyRolloutSpec`` (spec.py) compiles into a per-tenant state machine:
 
-    pending → verifying → shadowing → canary (ladder rungs) → promoting
-            → promoted
+    pending → verifying → [analyzing] → shadowing → canary (ladder rungs)
+            → promoting → promoted
     any gate breach → halted → rolled_back       (automatic)
     rollback failure / retry exhaustion → failed
 
@@ -47,6 +47,7 @@ log = logging.getLogger(__name__)
 
 STAGE_PENDING = "pending"
 STAGE_VERIFYING = "verifying"
+STAGE_ANALYZING = "analyzing"
 STAGE_SHADOWING = "shadowing"
 STAGE_CANARY = "canary"
 STAGE_PROMOTING = "promoting"
@@ -66,6 +67,9 @@ STAGE_CODES = {
     STAGE_HALTED: 6,
     STAGE_ROLLED_BACK: 7,
     STAGE_FAILED: 8,
+    # appended (not renumbered) so dashboards keyed on 0-8 stay valid:
+    # the opt-in semantic-diff gate between verifying and shadowing
+    STAGE_ANALYZING: 9,
 }
 
 
@@ -248,6 +252,28 @@ class LifecycleController:
                 ev.get("lowerable_pct", 0.0) < spec.lowerability_floor_pct
             ):
                 raise GateBreach("lowerability", ev)
+            if spec.analyze_enabled:
+                # opt-in semantic-diff gate runs BEFORE any live traffic
+                # (shadow mirroring included) sees the candidate
+                self._transition(m, STAGE_ANALYZING, evidence=ev)
+                return
+            m.driver.start_shadow(spec)
+            self._transition(m, STAGE_SHADOWING, evidence=ev)
+            return
+
+        if m.stage == STAGE_ANALYZING:
+            chaos_fire(
+                "lifecycle.gate",
+                payload={"tenant": tenant, "stage": m.stage},
+            )
+            ev = m.driver.analyze(spec)
+            m.evidence["analyze"] = ev
+            if ev.get("oracle_disagreements", 0) > 0:
+                # the plane and the interpreter disagreed on a sampled
+                # request: a compiler bug, never promotable evidence
+                raise GateBreach("analyze_oracle", ev)
+            if ev.get("out_of_intent_flips", 0) > spec.analyze_flip_budget:
+                raise GateBreach("semantic_diff", ev)
             m.driver.start_shadow(spec)
             self._transition(m, STAGE_SHADOWING, evidence=ev)
             return

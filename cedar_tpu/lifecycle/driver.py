@@ -75,11 +75,16 @@ class RolloutLifecycleDriver:
         promote_force: bool = False,
         sample_rate: float = 1.0,
         clock: Callable[[], float] = time.perf_counter,
+        live_tiers: Optional[Callable[[], list]] = None,
     ):
         self.tenant = tenant
         self.rollout = rollout
         self.slo = slo
         self.live_eval = live_eval
+        # provider of the LIVE tier PolicySets, required only when the
+        # spec enables the analyze gate (the semantic diff needs both
+        # sides; the rollout controller only knows the candidate)
+        self.live_tiers = live_tiers
         self.warm = warm
         self.promote_force = promote_force
         self.sample_rate = sample_rate
@@ -132,6 +137,54 @@ class RolloutLifecycleDriver:
             "policies": cov.get("policies", 0),
             "lowerable_pct": float(cov.get("lowerable_pct", 0.0)),
             "blocking": len(report.blocking()),
+        }
+
+    def analyze(self, spec) -> dict:
+        """Tier-1.5 evidence (opt-in): the device-exact semantic diff
+        between the live and candidate tiers (analysis/semdiff.py), run
+        entirely host-side BEFORE any live traffic touches the
+        candidate. Returns flip counts split by allowed-intent coverage
+        plus the interpreter-oracle cross-check; the controller breaches
+        on out-of-intent flips over the budget or any disagreement."""
+        from ..analysis.semdiff import semantic_diff
+
+        if self.live_tiers is None:
+            raise DriverError(
+                "analyze: spec enables the analyze gate but no live_tiers "
+                "provider is wired on the driver"
+            )
+        try:
+            live = list(self.live_tiers())
+            cand = self._resolve_tiers(spec)
+            diff = semantic_diff(
+                live,
+                cand,
+                budget=spec.analyze_universe_budget,
+                oracle_sample=spec.analyze_oracle_sample,
+            )
+        except DriverError:
+            raise
+        except Exception as e:  # noqa: BLE001 — compile/source hiccups retry
+            raise DriverError(f"analyze: {e}") from e
+        out_of_intent = diff.out_of_intent(spec.analyze_allowed_intents)
+        try:
+            from ..server.metrics import record_semdiff_flips
+
+            for kind, n in diff.flip_counts.items():
+                record_semdiff_flips(self.tenant, kind, n)
+        except Exception:  # noqa: BLE001 — metrics never gate the machine
+            pass
+        return {
+            "requests": diff.n_requests,
+            "exhaustive": diff.exact,
+            "flips": dict(diff.flip_counts),
+            "total_flips": diff.total_flips,
+            "out_of_intent_flips": out_of_intent,
+            "oracle_sampled": diff.oracle.get("sampled", 0),
+            "oracle_disagreements": diff.oracle.get("disagreements", 0),
+            # a few concrete flipped requests for the WAL/audit evidence
+            "exemplars": diff.flips[:5],
+            "seconds": round(diff.seconds, 3),
         }
 
     def start_shadow(self, spec) -> None:

@@ -17,6 +17,7 @@ Manifest shape (docs/rollout.md "Declarative lifecycle"):
         "candidate": {"directory": "/etc/cedar/candidate"},
         "gates": {
           "lowerability_floor_pct": 95.0,
+          "analyze": {"flip_budget": 0, "allowed_intents": []},
           "shadow": {"min_samples": 200, "diff_budget": 0},
           "canary": {"min_decisions": 50, "max_flips": 0},
           "slo": {"burn_ceiling": 2.0, "window_s": 300}
@@ -66,6 +67,19 @@ class PolicyRolloutSpec:
     # gate tier 1: verify — blocking findings always halt; additionally
     # the fully-lowerable coverage percent must meet the floor
     lowerability_floor_pct: float = 0.0
+    # gate tier 1.5 (opt-in via gates.analyze in the manifest): the
+    # device-exact semantic diff between the live and candidate sets
+    # (analysis/semdiff.py). Decision flips outside the allowed-intent
+    # selectors beyond the flip budget breach BEFORE any live traffic
+    # sees the candidate; an oracle disagreement always breaches.
+    analyze_enabled: bool = False
+    analyze_flip_budget: int = 0
+    # each selector is a dict of optional keys: kind
+    # ("allow_to_deny"/"deny_to_allow") matched exactly; principal/
+    # action/resource globs matched against the exemplar's Type::id
+    analyze_allowed_intents: Tuple[dict, ...] = ()
+    analyze_universe_budget: int = 2048
+    analyze_oracle_sample: int = 32
     # gate tier 2: shadow — evidence window and diff budget
     shadow_min_samples: int = 100
     shadow_diff_budget: int = 0
@@ -103,9 +117,21 @@ class PolicyRolloutSpec:
             raise SpecError(f"canary_ladder must ascend: {ladder}")
         object.__setattr__(self, "canary_ladder", ladder)
         for name in ("shadow_min_samples", "canary_min_decisions",
-                     "max_retries"):
+                     "max_retries", "analyze_flip_budget"):
             if getattr(self, name) < 0:
                 raise SpecError(f"{name} must be >= 0")
+        if self.analyze_universe_budget <= 0 or self.analyze_oracle_sample < 0:
+            raise SpecError(
+                "analyze universe_budget must be > 0 and oracle_sample >= 0"
+            )
+        intents = tuple(dict(s) for s in self.analyze_allowed_intents)
+        for s in intents:
+            bad = set(s) - {"kind", "principal", "action", "resource"}
+            if bad:
+                raise SpecError(
+                    f"unknown allowed-intent selector keys: {sorted(bad)}"
+                )
+        object.__setattr__(self, "analyze_allowed_intents", intents)
         if self.stage_deadline_s <= 0:
             raise SpecError("stage_deadline_s must be > 0")
 
@@ -134,6 +160,21 @@ class PolicyRolloutSpec:
                 "candidate": cand,
                 "gates": {
                     "lowerability_floor_pct": self.lowerability_floor_pct,
+                    **(
+                        {
+                            "analyze": {
+                                "flip_budget": self.analyze_flip_budget,
+                                "allowed_intents": [
+                                    dict(s)
+                                    for s in self.analyze_allowed_intents
+                                ],
+                                "universe_budget": self.analyze_universe_budget,
+                                "oracle_sample": self.analyze_oracle_sample,
+                            }
+                        }
+                        if self.analyze_enabled
+                        else {}
+                    ),
                     "shadow": {
                         "min_samples": self.shadow_min_samples,
                         "diff_budget": self.shadow_diff_budget,
@@ -174,6 +215,7 @@ def spec_from_dict(doc: dict) -> PolicyRolloutSpec:
     shadow = gates.get("shadow") or {}
     canary = gates.get("canary") or {}
     slo = gates.get("slo") or {}
+    analyze = gates.get("analyze")
     promotion = spec.get("promotion") or {}
     try:
         return PolicyRolloutSpec(
@@ -181,6 +223,17 @@ def spec_from_dict(doc: dict) -> PolicyRolloutSpec:
             candidate=dict(spec.get("candidate") or {}),
             lowerability_floor_pct=float(
                 gates.get("lowerability_floor_pct", 0.0)
+            ),
+            analyze_enabled=analyze is not None,
+            analyze_flip_budget=int((analyze or {}).get("flip_budget", 0)),
+            analyze_allowed_intents=tuple(
+                (analyze or {}).get("allowed_intents", ())
+            ),
+            analyze_universe_budget=int(
+                (analyze or {}).get("universe_budget", 2048)
+            ),
+            analyze_oracle_sample=int(
+                (analyze or {}).get("oracle_sample", 32)
             ),
             shadow_min_samples=int(shadow.get("min_samples", 100)),
             shadow_diff_budget=int(shadow.get("diff_budget", 0)),

@@ -9,7 +9,7 @@ quirk that a Record-typed attribute always serializes an ``attributes`` key
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 STRING_TYPE = "String"
 LONG_TYPE = "Long"

@@ -15,7 +15,7 @@ reference leans on client_golang + component-base legacyregistry).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 SUBSYSTEM = "cedar_authorizer"
 
@@ -377,7 +377,8 @@ lifecycle_stage = REGISTRY.register(
         "cedar_lifecycle_stage",
         "Current lifecycle stage per tenant rollout, as a code: 0=pending "
         "1=verifying 2=shadowing 3=canary 4=promoting 5=promoted "
-        "6=halted 7=rolled_back 8=failed. Bounded tenant label (see "
+        "6=halted 7=rolled_back 8=failed 9=analyzing (appended so "
+        "dashboards keyed on 0-8 stay valid). Bounded tenant label (see "
         "cedar_tenant_requests_total); the row is removed when the "
         "tenant's rollout spec is deleted.",
         ["tenant"],
@@ -398,8 +399,9 @@ lifecycle_gate_breaches_total = REGISTRY.register(
     Counter(
         "cedar_lifecycle_gate_breaches_total",
         "Gate breaches that halted a tenant's rollout, by gate tier "
-        "(`lowerability`, `shadow_diff`, `slo_burn`, `deadline`). Each "
-        "breach triggers automatic halt + rollback.",
+        "(`lowerability`, `analyze_oracle`, `semantic_diff`, "
+        "`shadow_diff`, `slo_burn`, `deadline`). Each breach triggers "
+        "automatic halt + rollback.",
         ["tenant", "gate"],
     )
 )
@@ -950,6 +952,53 @@ policy_analysis_findings_total = REGISTRY.register(
     )
 )
 
+# Device-exact policy-space analysis (analysis/space.py + semdiff.py):
+# the enumerated request universe pushed through the packed plane. Mode
+# is `sweep` (dead/shadowing/overlap verdicts) or `semdiff` (live vs
+# candidate decision diff).
+analysis_sweep_seconds = REGISTRY.register(
+    Gauge(
+        "cedar_analysis_sweep_seconds",
+        "Wall-clock seconds of the last device-exact policy-space pass, "
+        "by mode (`sweep`/`semdiff`). Scales with universe budget x "
+        "rule count; watch for growth as the policy set grows.",
+        ["mode"],
+    )
+)
+
+analysis_universe_requests = REGISTRY.register(
+    Gauge(
+        "cedar_analysis_universe_requests",
+        "Typed request-universe size of the last device-exact pass, by "
+        "mode (`sweep`/`semdiff`). `exhaustive` reports whether the "
+        "universe covered every vocab equivalence class (1) or was "
+        "stratified under the budget (0).",
+        ["mode", "exhaustive"],
+    )
+)
+
+analysis_oracle_disagreements_total = REGISTRY.register(
+    Counter(
+        "cedar_analysis_oracle_disagreements_total",
+        "Device-exact sweep verdicts that disagreed with the interpreter "
+        "oracle on the sampled cross-check slice. Any nonzero value is a "
+        "compiler or encoder bug, not a policy problem — page on it.",
+        [],
+    )
+)
+
+analysis_semdiff_flips_total = REGISTRY.register(
+    Counter(
+        "cedar_analysis_semdiff_flips_total",
+        "Decision flips found by the lifecycle analyze gate's semantic "
+        "diff (live vs candidate), by flip kind (`allow_to_deny`/"
+        "`deny_to_allow`) under the bounded tenant label. Flips outside "
+        "the spec's allowed intents breach the gate before any live "
+        "traffic sees the candidate.",
+        ["tenant", "kind"],
+    )
+)
+
 
 # Supervision / chaos metrics (server/supervisor.py, cedar_tpu/chaos,
 # docs/resilience.md "Game days"): the self-healing plane. Outside the
@@ -1366,6 +1415,26 @@ def set_fastpath_lowerable(tier: int, count: int) -> None:
 def record_analysis_findings(kind: str, n: int) -> None:
     if n:
         policy_analysis_findings_total.inc(n, kind=kind)
+
+
+def record_analysis_sweep(mode: str, requests: int, exhaustive: bool,
+                          seconds: float) -> None:
+    analysis_sweep_seconds.set(seconds, mode=mode)
+    analysis_universe_requests.set(
+        requests, mode=mode, exhaustive="1" if exhaustive else "0"
+    )
+
+
+def record_analysis_oracle_disagreements(n: int) -> None:
+    if n:
+        analysis_oracle_disagreements_total.inc(n)
+
+
+def record_semdiff_flips(tenant: str, kind: str, n: int) -> None:
+    if n:
+        analysis_semdiff_flips_total.inc(
+            n, tenant=_tenant_label_for(tenant), kind=kind
+        )
 
 
 def record_worker_death(component: str, replica: str = "") -> None:

@@ -1,0 +1,577 @@
+"""Device-exact policy-space analysis tests (cedar_tpu/analysis/space.py
++ semdiff.py, docs/analysis.md "Device-exact analysis").
+
+The load-bearing pieces:
+
+  * the typed request universe: exhaustive enumeration when the vocab
+    product fits the budget, stratified-with-seed otherwise, with every
+    match clause owning a directed witness (aliveness is proven, not
+    sampled);
+  * exact verdicts over the packed plane: dead rules, shadowing as
+    match-set inclusion, permit/forbid overlaps with concrete witnessed
+    requests — each cross-checked against the interpreter oracle;
+  * the semantic diff: live-vs-candidate decision flips with exemplars,
+    allowed-intent selectors, and the flip budget the lifecycle analyze
+    gate enforces;
+  * the soundness fuzz: the conservative clause prover
+    (clause_subsumes / clause_pair_satisfiable) never invents a cover
+    and never reports unsatisfiable for a non-empty intersection,
+    checked against the device-exact sweep on random policy pairs;
+  * the CLI surface: ``cedar-analyze --exact`` / ``--semantic-diff``
+    exit codes across ``--fail-level`` and the pinned ``--json`` report
+    schema (``sweep`` section + per-finding ``provenance``).
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from cedar_tpu.analysis.semdiff import (
+    DiffResult,
+    _policy_matrices,
+    apply_sweep,
+    flip_in_intent,
+    pack_tiers,
+    sat_matrix,
+    semantic_diff,
+    sweep,
+)
+from cedar_tpu.analysis.space import enumerate_universe
+from cedar_tpu.analysis import analyze_tiers
+from cedar_tpu.analysis.analyze import lower_all
+from cedar_tpu.analysis.subsume import clause_pair_satisfiable, clause_subsumes
+from cedar_tpu.lang.authorize import PolicySet
+from cedar_tpu.lifecycle import (
+    STAGE_CODES,
+    DriverError,
+    LifecycleController,
+    RolloutLifecycleDriver,
+    spec_from_dict,
+)
+from cedar_tpu.lifecycle.spec import SpecError
+
+
+def _tiers(*sources):
+    return [PolicySet.from_source(src, f"tier{i}.cedar")
+            for i, src in enumerate(sources)]
+
+
+SCOPE = "(principal is k8s::User, action, resource is k8s::Resource)"
+
+BROAD_PERMIT = (
+    f'permit {SCOPE} when {{ resource.resource == "pods" }};\n'
+)
+NARROW_PERMIT = (
+    f"permit {SCOPE} when "
+    '{ resource.resource == "pods" && principal.name == "alice" };\n'
+)
+DEAD_PERMIT = (
+    f"permit {SCOPE} when "
+    '{ resource.resource == "pods" && resource.resource == "secrets" };\n'
+)
+FORBID_PODS = (
+    f'forbid {SCOPE} when {{ resource.resource == "pods" '
+    '&& principal.name == "mallory" };\n'
+)
+
+TINY = BROAD_PERMIT + NARROW_PERMIT + DEAD_PERMIT + FORBID_PODS
+
+
+# ---------------------------------------------------------------- universe
+
+
+class TestUniverse:
+    def test_tiny_set_is_exhaustive(self):
+        tiers = _tiers(TINY)
+        packed = pack_tiers(tiers)
+        uni = enumerate_universe([packed], budget=4096)
+        assert uni.exhaustive
+        assert 0 < uni.size <= 4096
+        # every item is a materialized (entities, request) pair
+        em, req = uni.items[0]
+        assert req.principal.type == "k8s::User"
+        assert em
+
+    def test_budget_forces_stratification(self):
+        tiers = _tiers(TINY)
+        packed = pack_tiers(tiers)
+        uni = enumerate_universe([packed], budget=8)
+        assert not uni.exhaustive
+        assert uni.size <= 8
+
+    def test_stratified_clause_witnesses_win_over_cover(self):
+        # a corpus too big to enumerate: every live policy still gets
+        # its directed clause witness before the cover sweep spends the
+        # remaining budget (aliveness is proven, not sampled)
+        from cedar_tpu.corpus import synth_corpus
+
+        tiers = synth_corpus(60, seed=3, clusters=2).tiers()
+        packed = pack_tiers(tiers)
+        uni = enumerate_universe([packed], budget=96)
+        assert not uni.exhaustive
+        assert uni.strata.get("clause", 0) >= 60
+
+    def test_seed_determinism(self):
+        tiers = _tiers(TINY)
+        packed = pack_tiers(tiers)
+        a = enumerate_universe([packed], budget=64, seed=5)
+        b = enumerate_universe([packed], budget=64, seed=5)
+        ka = [(r.principal, r.action, r.resource) for _, r in a.items]
+        kb = [(r.principal, r.action, r.resource) for _, r in b.items]
+        assert ka == kb
+
+
+# ------------------------------------------------------------------- sweep
+
+
+class TestSweep:
+    def test_exact_verdicts_on_tiny_set(self):
+        res = sweep(_tiers(TINY), budget=4096)
+        assert res.exact
+        dead = {d["policy"] for d in res.dead}
+        assert any("policy2" in p for p in dead)  # the contradiction
+        assert len(dead) == 1
+        shadowed = {s["policy"] for s in res.shadowed}
+        assert any("policy1" in p for p in shadowed)  # narrow ⊂ broad
+        assert res.overlaps  # permit pods × forbid pods/mallory
+        for o in res.overlaps:
+            assert o["provenance"] == "exact"
+            assert o["exemplar"]["principal"]
+        assert res.oracle["disagreements"] == 0
+        assert res.oracle["sampled"] > 0
+
+    def test_synth_corpus_all_alive_oracle_clean(self):
+        from cedar_tpu.corpus import synth_corpus
+
+        tiers = synth_corpus(80, seed=13, clusters=2).tiers()
+        res = sweep(tiers, budget=512, oracle_sample=32)
+        assert not res.dead
+        assert res.oracle["disagreements"] == 0
+
+    def test_engine_batcher_path_matches_host_path(self):
+        from cedar_tpu.engine.evaluator import TPUPolicyEngine
+
+        tiers = _tiers(TINY)
+        engine = TPUPolicyEngine(name="semdiff-test")
+        engine.load(tiers, warm="off")
+        try:
+            res_e = sweep(
+                tiers, budget=4096, engine=engine,
+                packed=engine._compiled.packed,
+            )
+        finally:
+            engine.close() if hasattr(engine, "close") else None
+        res_h = sweep(tiers, budget=4096)
+        assert {d["policy"] for d in res_e.dead} == {
+            d["policy"] for d in res_h.dead
+        }
+        assert {s["policy"] for s in res_e.shadowed} == {
+            s["policy"] for s in res_h.shadowed
+        }
+        assert res_e.oracle["disagreements"] == 0
+
+    def test_apply_sweep_upgrades_report(self):
+        tiers = _tiers(TINY)
+        report = analyze_tiers(tiers, capacity=False)
+        packed = pack_tiers(tiers)
+        res = sweep(tiers, budget=4096, packed=packed)
+        apply_sweep(report, res, packed)
+        codes = {f.code for f in report.findings}
+        assert "dead_rule" in codes
+        assert report.sweep["universe"]["size"] > 0
+        exact = [f for f in report.findings if f.provenance == "exact"]
+        assert exact
+        # exact tags render in the text report
+        assert "/exact]" in report.render_text()
+
+
+# ----------------------------------------------------------- semantic diff
+
+
+class TestSemanticDiff:
+    def test_identical_sets_zero_flips(self):
+        diff = semantic_diff(_tiers(TINY), _tiers(TINY), budget=2048)
+        assert diff.total_flips == 0
+        assert diff.oracle["disagreements"] == 0
+
+    def test_effect_flip_found_with_exemplar(self):
+        live = _tiers(BROAD_PERMIT)
+        cand = _tiers(BROAD_PERMIT.replace("permit ", "forbid ", 1))
+        diff = semantic_diff(live, cand, budget=2048)
+        assert set(diff.flip_counts) == {"allow_to_deny"}
+        assert diff.total_flips >= 1
+        ex = diff.flips[0]
+        assert ex["live"]["decision"] == "allow"
+        assert ex["candidate"]["decision"] == "deny"
+        assert ex["request"]["resource"].startswith("k8s::Resource::")
+        assert diff.oracle["disagreements"] == 0
+
+    def test_intent_selectors(self):
+        live = _tiers(BROAD_PERMIT)
+        cand = _tiers(BROAD_PERMIT.replace("permit ", "forbid ", 1))
+        diff = semantic_diff(live, cand, budget=2048)
+        # no selectors: every flip is out of intent
+        assert diff.out_of_intent(()) == diff.total_flips
+        # a kind selector that covers the edit: all in intent
+        assert diff.out_of_intent(({"kind": "allow_to_deny"},)) == 0
+        # a selector for the other kind covers nothing
+        assert (
+            diff.out_of_intent(({"kind": "deny_to_allow"},))
+            == diff.total_flips
+        )
+        # glob selectors match the exemplar's Type::id
+        flip = diff.flips[0]
+        assert flip_in_intent(flip, {"principal": "k8s::User::*"})
+        assert not flip_in_intent(flip, {"principal": "k8s::Group::*"})
+
+    def test_uncapped_flips_count_out_of_intent(self):
+        # flips beyond the exemplar cap cannot be intent-matched — the
+        # gate must fail loudly rather than silently under-count
+        d = DiffResult(
+            universe=None, exact=False, n_requests=10,
+            flips=[{"kind": "allow_to_deny", "request": {
+                "principal": "k8s::User::u", "action": "k8s::Action::get",
+                "resource": "k8s::Resource::r"}}],
+            flip_counts={"allow_to_deny": 5},
+            oracle={"sampled": 0, "disagreements": 0, "examples": []},
+            seconds=0.0,
+        )
+        assert d.total_flips == 5
+        # 1 exemplar in intent, 4 uncapped => 4 out of intent
+        assert d.out_of_intent(({"kind": "allow_to_deny"},)) == 4
+
+
+# -------------------------------------------------- soundness fuzz (prover)
+
+_ATOMS = (
+    'principal.name == "alice"',
+    'principal.name == "bob"',
+    'principal.name like "a*"',
+    'resource.resource == "pods"',
+    'resource.resource == "secrets"',
+    'resource.namespace == "ns1"',
+    'resource.namespace == "ns2"',
+    'resource.name == "x"',
+    'action == k8s::Action::"get"',
+    'action == k8s::Action::"list"',
+)
+
+
+def _random_policy(rng):
+    k = rng.randint(1, 2)
+    atoms = rng.sample(_ATOMS, k)
+    return f"permit {SCOPE} when {{ {' && '.join(atoms)} }};\n"
+
+
+class TestProverSoundnessFuzz:
+    """The conservative prover's documented direction, checked against
+    the device-exact sweep: ``clause_subsumes(a, b)`` may miss covers
+    but never invent one; ``clause_pair_satisfiable`` may report
+    satisfiable for empty intersections but never the reverse."""
+
+    def test_random_pairs_against_exact_match_sets(self):
+        rng = random.Random(1234)
+        for trial in range(25):
+            src = _random_policy(rng) + _random_policy(rng)
+            tiers = _tiers(src)
+            infos = lower_all(tiers)
+            assert all(i.lowered is not None for i in infos), src
+            packed = pack_tiers(tiers)
+            uni = enumerate_universe([packed], budget=2048)
+            sat = sat_matrix(packed, uni)
+            M, _E, _pms = _policy_matrices(packed, sat)
+            match = [set(np.nonzero(M[p])[0]) for p in range(2)]
+            ca = infos[0].lowered.clauses
+            cb = infos[1].lowered.clauses
+            # single-clause policies: the clause match set IS the
+            # policy match set
+            assert len(ca) == 1 and len(cb) == 1, src
+            if clause_subsumes(ca[0], cb[0]):
+                assert match[1] <= match[0], (
+                    f"invented cover in trial {trial}: {src}"
+                )
+            if clause_subsumes(cb[0], ca[0]):
+                assert match[0] <= match[1], (
+                    f"invented cover in trial {trial}: {src}"
+                )
+            if not clause_pair_satisfiable(ca[0], cb[0]):
+                assert not (match[0] & match[1]), (
+                    f"false unsatisfiable in trial {trial}: {src}"
+                )
+
+
+# ----------------------------------------------------- lifecycle analyze gate
+
+
+class _ScriptedDriver:
+    """A driver whose analyze() evidence is scripted — isolates the
+    controller's gate logic from the real semantic diff."""
+
+    def __init__(self, analyze_ev=None):
+        self.analyze_ev = analyze_ev or {}
+        self.calls = []
+
+    def verify(self, spec):
+        self.calls.append("verify")
+        return {"policies": 1, "lowerable_pct": 100.0, "blocking": 0}
+
+    def analyze(self, spec):
+        self.calls.append("analyze")
+        return dict(self.analyze_ev)
+
+    def start_shadow(self, spec):
+        self.calls.append("start_shadow")
+
+    def shadow_evidence(self):
+        return {"samples": 1000, "diffs": 0}
+
+    def set_canary(self, percent):
+        self.calls.append(f"canary:{percent}")
+
+    def canary_evidence(self, window_s):
+        return {"decisions": 100, "flips": 0, "burn": 0.0}
+
+    def promote(self):
+        self.calls.append("promote")
+
+    def rollback(self):
+        self.calls.append("rollback")
+
+    def reset(self):
+        self.calls.append("reset")
+
+
+def _analyze_spec(tenant, analyze=None):
+    gates = {"shadow": {"min_samples": 0, "diff_budget": 0}}
+    if analyze is not None:
+        gates["analyze"] = analyze
+    return spec_from_dict({
+        "kind": "PolicyRollout",
+        "metadata": {"name": tenant},
+        "spec": {
+            "candidate": {"source": "permit (principal, action, resource);"},
+            "gates": gates,
+            "promotion": {"mode": "auto", "canary_ladder": []},
+        },
+    })
+
+
+def _run(ctrl, tenant, ticks=30):
+    for _ in range(ticks):
+        stages = ctrl.tick()
+        if stages.get(tenant) in ("promoted", "rolled_back", "failed"):
+            break
+    return ctrl.status()["tenants"][tenant]
+
+
+class TestLifecycleAnalyzeGate:
+    def test_stage_code_appended_not_renumbered(self):
+        assert STAGE_CODES["analyzing"] == 9
+        assert STAGE_CODES["failed"] == 8  # 0-8 untouched
+
+    def test_out_of_intent_flips_breach_semantic_diff_gate(self):
+        drv = _ScriptedDriver({
+            "out_of_intent_flips": 2, "oracle_disagreements": 0,
+            "total_flips": 2, "exemplars": [{"kind": "allow_to_deny"}],
+        })
+        ctrl = LifecycleController(backoff_base_s=0.0, backoff_cap_s=0.001)
+        ctrl.apply(_analyze_spec("t-flip", {"flip_budget": 0}), drv)
+        doc = _run(ctrl, "t-flip")
+        assert doc["stage"] == "rolled_back"
+        assert doc["halt"]["gate"] == "semantic_diff"
+        assert doc["halt"]["stage"] == "analyzing"
+        assert doc["halt"]["evidence"]["exemplars"]
+        assert "start_shadow" not in drv.calls
+        assert "rollback" in drv.calls
+
+    def test_oracle_disagreement_always_breaches(self):
+        drv = _ScriptedDriver({
+            "out_of_intent_flips": 0, "oracle_disagreements": 1,
+        })
+        ctrl = LifecycleController(backoff_base_s=0.0, backoff_cap_s=0.001)
+        ctrl.apply(_analyze_spec("t-oracle", {"flip_budget": 100}), drv)
+        doc = _run(ctrl, "t-oracle")
+        assert doc["stage"] == "rolled_back"
+        assert doc["halt"]["gate"] == "analyze_oracle"
+
+    def test_flips_within_budget_proceed(self):
+        drv = _ScriptedDriver({
+            "out_of_intent_flips": 1, "oracle_disagreements": 0,
+        })
+        ctrl = LifecycleController(backoff_base_s=0.0, backoff_cap_s=0.001)
+        ctrl.apply(_analyze_spec("t-budget", {"flip_budget": 1}), drv)
+        doc = _run(ctrl, "t-budget")
+        assert doc["stage"] == "promoted"
+        assert "analyze" in drv.calls
+        assert drv.calls.index("analyze") < drv.calls.index("start_shadow")
+        assert doc["evidence"]["analyze"]["out_of_intent_flips"] == 1
+
+    def test_gate_absent_skips_analyze_stage(self):
+        drv = _ScriptedDriver()
+        ctrl = LifecycleController(backoff_base_s=0.0, backoff_cap_s=0.001)
+        ctrl.apply(_analyze_spec("t-skip", analyze=None), drv)
+        doc = _run(ctrl, "t-skip")
+        assert doc["stage"] == "promoted"
+        assert "analyze" not in drv.calls
+
+    def test_real_driver_requires_live_tiers(self):
+        drv = RolloutLifecycleDriver("t", rollout=None)
+        with pytest.raises(DriverError, match="live_tiers"):
+            drv.analyze(_analyze_spec("t", {"flip_budget": 0}))
+
+    def test_real_driver_analyze_evidence(self):
+        live = _tiers(BROAD_PERMIT)
+        drv = RolloutLifecycleDriver(
+            "t", rollout=None, live_tiers=lambda: live
+        )
+        spec = spec_from_dict({
+            "kind": "PolicyRollout",
+            "metadata": {"name": "t"},
+            "spec": {
+                "candidate": {
+                    "source": BROAD_PERMIT.replace("permit ", "forbid ", 1)
+                },
+                "gates": {"analyze": {
+                    "flip_budget": 0, "universe_budget": 512,
+                    "oracle_sample": 8,
+                }},
+            },
+        })
+        ev = drv.analyze(spec)
+        assert ev["out_of_intent_flips"] >= 1
+        assert ev["oracle_disagreements"] == 0
+        assert ev["exemplars"]
+
+    def test_spec_analyze_roundtrip(self):
+        spec = _analyze_spec("t-rt", {
+            "flip_budget": 3,
+            "allowed_intents": [{"kind": "allow_to_deny",
+                                 "principal": "k8s::User::*"}],
+            "universe_budget": 777,
+            "oracle_sample": 9,
+        })
+        assert spec.analyze_enabled
+        doc = spec.to_dict()
+        spec2 = spec_from_dict(doc)
+        assert spec2.analyze_flip_budget == 3
+        assert spec2.analyze_universe_budget == 777
+        assert spec2.analyze_oracle_sample == 9
+        assert spec2.analyze_allowed_intents == (
+            {"kind": "allow_to_deny", "principal": "k8s::User::*"},
+        )
+        # disabled specs don't serialize an analyze gate
+        off = _analyze_spec("t-off", analyze=None)
+        assert "analyze" not in off.to_dict()["spec"]["gates"]
+
+    def test_spec_analyze_validation(self):
+        with pytest.raises(SpecError, match="selector"):
+            _analyze_spec("t-bad", {
+                "flip_budget": 0,
+                "allowed_intents": [{"verb": "get"}],
+            })
+        with pytest.raises(SpecError):
+            _analyze_spec("t-neg", {"flip_budget": -1})
+        with pytest.raises(SpecError):
+            _analyze_spec("t-zero", {"universe_budget": 0})
+
+
+# --------------------------------------------------------------------- CLI
+
+
+@pytest.fixture()
+def cli(tmp_path):
+    from cedar_tpu.cli.analyze import main
+
+    def run(*args, sources=None):
+        paths = []
+        for i, src in enumerate(sources or ()):
+            p = tmp_path / f"set{i}.cedar"
+            p.write_text(src)
+            paths.append(str(p))
+        return main(list(args) + paths), paths
+
+    return run
+
+
+class TestAnalyzeCLI:
+    def test_check_fail_levels(self, cli, capsys):
+        # duplicate policies: a warning-level finding, no errors
+        dup = BROAD_PERMIT + BROAD_PERMIT
+        rc, _ = cli("--check", sources=[dup])
+        assert rc == 0  # default --fail-level error
+        rc, _ = cli("--check", "--fail-level", "warning", sources=[dup])
+        assert rc == 1
+        rc, _ = cli("--check", "--fail-level", "info", sources=[dup])
+        assert rc == 1
+        capsys.readouterr()
+
+    def test_check_error_level(self, cli, capsys):
+        blowup = " && ".join(
+            '(resource.resource == "r1" || resource.name == "never")'
+            for _ in range(12)
+        )
+        src = f"permit {SCOPE} when {{ {blowup} }};\n"
+        rc, _ = cli("--check", sources=[src])
+        assert rc == 1
+        capsys.readouterr()
+
+    def test_missing_input_is_exit_2(self, capsys):
+        from cedar_tpu.cli.analyze import main
+
+        assert main(["/nonexistent/path.cedar"]) == 2
+        capsys.readouterr()
+
+    def test_exact_json_schema(self, cli, capsys):
+        rc, _ = cli("--exact", "--json", sources=[TINY])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert "sweep" in doc
+        assert doc["sweep"]["universe"]["size"] > 0
+        assert "dead" in doc["sweep"]
+        assert doc["sweep"]["oracle"]["disagreements"] == 0
+        for f in doc["findings"]:
+            assert f["provenance"] in ("exact", "conservative")
+        assert any(
+            f["code"] == "dead_rule" and f["provenance"] == "exact"
+            for f in doc["findings"]
+        )
+
+    def test_json_without_exact_pins_schema(self, cli, capsys):
+        rc, _ = cli("--json", sources=[TINY])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["sweep"] == {}  # section always present
+        assert all("provenance" in f for f in doc["findings"])
+
+    def test_semantic_diff_check_budget(self, cli, capsys, tmp_path):
+        from cedar_tpu.cli.analyze import main
+
+        live = tmp_path / "live.cedar"
+        cand = tmp_path / "cand.cedar"
+        live.write_text(BROAD_PERMIT)
+        cand.write_text(BROAD_PERMIT.replace("permit ", "forbid ", 1))
+        base = ["--semantic-diff", str(live), "--candidate", str(cand),
+                "--universe-budget", "512"]
+        assert main(base + ["--check"]) == 1  # default budget 0
+        assert main(base + ["--check", "--flip-budget", "1000"]) == 0
+        # diff mode without --candidate is a usage error
+        assert main(["--semantic-diff", str(live)]) == 2
+        out = capsys.readouterr().out
+        assert "allow_to_deny" in out
+
+    def test_semantic_diff_json(self, cli, capsys, tmp_path):
+        from cedar_tpu.cli.analyze import main
+
+        live = tmp_path / "live.cedar"
+        cand = tmp_path / "cand.cedar"
+        live.write_text(BROAD_PERMIT)
+        cand.write_text(BROAD_PERMIT.replace("permit ", "forbid ", 1))
+        rc = main(["--semantic-diff", str(live), "--candidate", str(cand),
+                   "--universe-budget", "512", "--json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["flip_counts"] == {"allow_to_deny": doc["total_flips"]}
+        assert doc["flips"][0]["request"]["principal"]
+        assert doc["oracle"]["disagreements"] == 0

@@ -1,0 +1,50 @@
+"""Regenerate tests/testdata/lifecycle/: the committed live/candidate
+corpus pair that `make analyze` gates with cedar-analyze.
+
+The pair mirrors the bench-lifecycle candidate shape: `live/` is a
+24-policy synth corpus (probe policy first, effect permit), `candidate/`
+is the SAME corpus after the single-policy probe edit (permit -> forbid)
+— the one-decision-flip semantic diff the lifecycle analyze gate and
+`cedar-analyze --semantic-diff --check --flip-budget 1` both measure.
+
+Deterministic: synth_corpus(24, seed=7, clusters=1) twice yields
+identical sources, so re-running this script is a no-op unless the
+generator itself changed.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from cedar_tpu.corpus.synth import _policy_source, _probe_source  # noqa: E402
+
+N = 24
+SEED = 7
+CLUSTERS = 1
+
+
+def sources(probe_effect: str) -> list:
+    out = [_probe_source(probe_effect)]
+    for i in range(1, N):
+        src, _params = _policy_source(i, SEED, CLUSTERS)
+        out.append(src)
+    return out
+
+
+def main() -> int:
+    root = pathlib.Path(__file__).resolve().parents[1]
+    base = root / "tests" / "testdata" / "lifecycle"
+    for name, effect in (("live", "permit"), ("candidate", "forbid")):
+        d = base / name
+        d.mkdir(parents=True, exist_ok=True)
+        path = d / "corpus.cedar"
+        path.write_text("\n".join(sources(effect)) + "\n")
+        print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
