@@ -90,6 +90,10 @@ bench-fanout: ## Cross-process worker tier: 1/2/4 spawned workers, scaling + zer
 bench-storm: ## Open-loop overload: 5x sustained storm — high-priority availability >=99.9% within budget, exact shed accounting, >=1 adaptive-tuner move, no-overload byte parity (cpu; docs/performance.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --storm
 
+.PHONY: bench-mesh
+bench-mesh: ## Mixed-protocol PDP: Zipf SAR + ext_authz + batch streams on ONE plane — zero decision flips vs the interpreter oracle, >=1 three-protocol coalesced tick, ext_authz p99 within budget (cpu; docs/pdp.md)
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --mesh-traffic
+
 .PHONY: bench-lifecycle
 bench-lifecycle: ## Declarative lifecycle fleet: staggered tenant rollouts under storm traffic — zero-touch auto-promotion, halt+rollback at each gate tier, zero live flips, crash-mid-canary resume (cpu; docs/rollout.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --lifecycle

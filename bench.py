@@ -4373,6 +4373,464 @@ def run_storm_scenario() -> int:
     return 0 if ok else 1
 
 
+def run_mesh_traffic_scenario() -> int:
+    """``bench.py --mesh-traffic`` (``make bench-mesh``): the PDP
+    front-end suite (cedar_tpu/pdp, docs/pdp.md) — mixed Zipf-distributed
+    SAR + Envoy ext_authz + AVP-style batch streams against ONE in-process
+    serving stack (real fastpath, pipelined batcher, decision cache,
+    admission gate, dispatch floor), with three gates (rc 0 iff all hold):
+
+      1. zero cross-protocol decision flips: every unique served body
+         (all three protocols) re-derived by the interpreter oracle
+         (pdp/oracle.py) must answer identically — the differential that
+         localizes any mapping/encode/cache divergence;
+      2. coalescing shown: at least one micro-batcher tick carries all
+         THREE protocols in a single device dispatch (the batcher's
+         protocol_mix tally — the tenancy slot-literal property: zero
+         kernel changes);
+      3. ext_authz served p99 within the webhook latency budget at the
+         mixed offered load.
+
+    Fail postures are exercised inline (malformed check → deny, malformed
+    batch body → 400, malformed tuple → per-tuple error with its
+    neighbours answered). cpu-only BY DESIGN: every claim is about the
+    protocol machinery, not device speed."""
+    import threading
+    from bisect import bisect_left
+
+    import jax
+
+    from cedar_tpu.cache.decision_cache import DecisionCache
+    from cedar_tpu.chaos import default_registry
+    from cedar_tpu.engine.breaker import CircuitBreaker
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.engine.fastpath import SARFastPath
+    from cedar_tpu.load import AdmissionController
+    from cedar_tpu.obs.slo import SLOTracker
+    from cedar_tpu.pdp import PdpConfig, PdpListener, PdpOracle
+    from cedar_tpu.pdp.extauthz import check_body
+    from cedar_tpu.pdp.mapper import (
+        PROTOCOL_BATCH,
+        batch_tuple_to_sar,
+        encode_pdp_body,
+    )
+    from cedar_tpu.server.admission import (
+        CedarAdmissionHandler,
+        allow_all_admission_policy_store,
+    )
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.server.http import WebhookServer
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    t_start = time.time()
+
+    BUDGET_S = 1.0  # the webhook latency budget the ext_authz p99 gates on
+    FLOOR_S = 0.005  # deterministic per-dispatch device floor (chaos seam)
+    HOME_BATCH = 16
+    HOME_LINGER_S = 0.001
+
+    # ------------------------------------------------------- serving stack
+    # one policy set spanning all three vocabularies: k8s resource SARs,
+    # ext_authz non-resource checks (http:* verbs), AVP-style tuples
+    # (avp:* verbs) — value-disjoint by construction (schema/consts.py)
+    rng = random.Random(18)
+    k8s_users = [f"controller-{i}" for i in range(32)]
+    mesh_users = [f"user-{i}" for i in range(64)]
+    app_users = [f"App::User::u{i}" for i in range(48)]
+    resources = ["pods", "services", "secrets", "configmaps"]
+    verbs = ["get", "list", "watch", "create"]
+    mesh_paths = [f"/api/items/{i}" for i in range(40)]
+    docs = [f"/docs/d{i}" for i in range(40)]
+    pols = []
+    for _ in range(_n(120, 30)):
+        pols.append(
+            f'permit (principal, action == k8s::Action::"{rng.choice(verbs)}", '
+            "resource is k8s::Resource) when { "
+            f'principal.name == "{rng.choice(k8s_users)}" && '
+            f'resource.resource == "{rng.choice(resources)}" }};'
+        )
+    for _ in range(_n(120, 30)):
+        pols.append(
+            'permit (principal, action == k8s::Action::"http:get", '
+            "resource is k8s::NonResourceURL) when { "
+            f'principal.name == "{rng.choice(mesh_users)}" && '
+            f'resource.path == "{rng.choice(mesh_paths)}" }};'
+        )
+    for _ in range(_n(120, 30)):
+        pols.append(
+            f'permit (principal, action == k8s::Action::"avp:'
+            f'{rng.choice(["view", "edit"])}", '
+            "resource is k8s::NonResourceURL) when { "
+            f'principal.name == "{rng.choice(app_users)}" && '
+            f'resource.path == "{rng.choice(docs)}" }};'
+        )
+    src = "\n".join(pols)
+    stores = TieredPolicyStores([MemoryStore.from_source("mesh", src)])
+    adm_stores = TieredPolicyStores(
+        [
+            MemoryStore.from_source("mesh", src),
+            allow_all_admission_policy_store(),
+        ]
+    )
+    engine = TPUPolicyEngine(name="authorization")
+    engine.load([s.policy_set() for s in stores], warm="off")
+    # synchronous warmup BEFORE traffic: a first-dispatch XLA compile
+    # would burn whole deadline budgets (the storm-bench rationale)
+    engine.warmup(max_batch=64)
+    breaker = CircuitBreaker(
+        name="authorization", failure_threshold=5, recovery_s=0.5
+    )
+    authorizer = CedarWebhookAuthorizer(stores)
+    fastpath = SARFastPath(engine, authorizer, breaker=breaker)
+    listener = PdpListener(
+        config=PdpConfig(context_headers=("x-request-id",))
+    )
+    server = WebhookServer(
+        authorizer,
+        CedarAdmissionHandler(adm_stores),
+        fastpath=fastpath,
+        pipeline_depth=2,
+        max_batch=HOME_BATCH,
+        batch_window_s=HOME_LINGER_S,
+        request_timeout_s=BUDGET_S,
+        decision_cache=DecisionCache(),
+        slo=SLOTracker(latency_budget_s=0.15),
+        load=AdmissionController(max_inflight=256),
+        pdp=listener,
+    )
+    oracle = PdpOracle(stores)
+
+    registry = default_registry()
+    registry.reset()
+    registry.configure(
+        {
+            "name": "mesh-floor",
+            "seed": 18,
+            "faults": [
+                {"seam": "engine.dispatch", "kind": "latency",
+                 "delay_s": FLOOR_S},
+            ],
+        }
+    )
+    registry.arm()
+
+    # ------------------------------------------------------ traffic makers
+    # Zipf(1.1) principal skew with the derived-stream pattern: every draw
+    # is a pure function of (stream, i) — replayable bit-for-bit
+    def zipf_cum_of(pool):
+        cum, acc = [], 0.0
+        for r in range(len(pool)):
+            acc += 1.0 / (r + 1) ** 1.1
+            cum.append(acc)
+        return cum
+
+    def zipf_pick(pool, cum, stream: str, i: int):
+        x = random.Random(f"mesh:{stream}:{i}").random() * cum[-1]
+        return pool[min(len(pool) - 1, bisect_left(cum, x))]
+
+    k8s_cum = zipf_cum_of(k8s_users)
+    mesh_cum = zipf_cum_of(mesh_users)
+    app_cum = zipf_cum_of(app_users)
+
+    def sar_body(i: int) -> bytes:
+        r = random.Random(f"mesh:sar:{i}")
+        return json.dumps(
+            {
+                "apiVersion": "authorization.k8s.io/v1",
+                "kind": "SubjectAccessReview",
+                "spec": {
+                    "user": zipf_pick(k8s_users, k8s_cum, "sar-u", i),
+                    "uid": "u",
+                    "groups": [],
+                    "resourceAttributes": {
+                        "verb": r.choice(verbs),
+                        "version": "v1",
+                        "resource": r.choice(resources),
+                        "namespace": "default",
+                    },
+                },
+            }
+        ).encode()
+
+    def ext_body(i: int):
+        r = random.Random(f"mesh:ext:{i}")
+        return check_body(
+            "GET",
+            r.choice(mesh_paths),
+            {
+                "x-forwarded-user": zipf_pick(
+                    mesh_users, mesh_cum, "ext-u", i
+                ),
+                "x-request-id": f"req-{i}",
+                "host": "mesh.local",
+            },
+            listener.config,
+        )
+
+    def batch_tuples(i: int, k: int = 8):
+        r = random.Random(f"mesh:batch:{i}")
+        return [
+            {
+                "principal": zipf_pick(app_users, app_cum, f"bat-u:{i}", j),
+                "action": r.choice(["view", "edit"]),
+                "resource": r.choice(docs).lstrip("/"),
+                "context": {"request": f"b{i}-{j}"},
+            }
+            for j in range(k)
+        ]
+
+    def decision_of(doc: dict) -> str:
+        status = (doc or {}).get("status") or {}
+        if status.get("evaluationError"):
+            return "<error>"
+        if status.get("allowed"):
+            return "allow"
+        if status.get("denied"):
+            return "deny"
+        return "no_opinion"
+
+    # ------------------------------------------------- phase 1: mixed load
+    N_SAR = _n(1600, 160)
+    N_EXT = _n(1600, 160)
+    N_BATCH = _n(120, 12)  # posts of 8 tuples each
+    served: dict = {}  # body bytes+protocol key -> (body, served decision)
+    served_lock = threading.Lock()
+    lat = {"sar": [], "extauthz": [], "batch_post": []}
+    shed_count = [0]
+
+    def record(body, label: str) -> None:
+        if label == "<error>":
+            # sheds/availability are accounted separately; an errored
+            # answer is not a DECISION and has no oracle twin
+            shed_count[0] += 1
+            return
+        key = (getattr(body, "protocol", ""), bytes(body))
+        with served_lock:
+            prev = served.get(key)
+            if prev is not None and prev[1] != label:
+                # same body answered two ways within one run: a flip the
+                # oracle pass below would miss — poison the entry
+                served[key] = (body, f"unstable:{prev[1]}|{label}")
+            elif prev is None:
+                served[key] = (body, label)
+
+    def drive(n, threads, fn):
+        idx = iter(range(n))
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    i = next(idx, None)
+                if i is None:
+                    return
+                fn(i)
+
+        ts = [threading.Thread(target=worker) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    def fire_sar(i: int) -> None:
+        body = sar_body(i)
+        t = time.monotonic()
+        doc = server.serve_authorize(body)
+        lat["sar"].append(time.monotonic() - t)
+        record(body, decision_of(doc))
+
+    def fire_ext(i: int) -> None:
+        body = ext_body(i)
+        t = time.monotonic()
+        doc = server.serve_authorize(body)
+        lat["extauthz"].append(time.monotonic() - t)
+        record(body, decision_of(doc))
+
+    def fire_batch(i: int) -> None:
+        tuples = batch_tuples(i)
+        raw = json.dumps({"requests": tuples}).encode()
+        t = time.monotonic()
+        status, doc = listener.batch(raw)
+        lat["batch_post"].append(time.monotonic() - t)
+        if status != 200:
+            shed_count[0] += len(tuples)
+            return
+        for item, entry in zip(doc["responses"], tuples):
+            # the differential needs the exact wire body the front end
+            # evaluated: re-map deterministically (mapper is pure)
+            body = encode_pdp_body(
+                batch_tuple_to_sar(entry, listener.config),
+                PROTOCOL_BATCH,
+                listener.config,
+            )
+            label = (
+                "<error>"
+                if item.get("errors")
+                else item["decision"].lower()
+            )
+            record(body, label)
+
+    mesh_t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=drive, args=(N_SAR, 4, fire_sar)),
+        threading.Thread(target=drive, args=(N_EXT, 4, fire_ext)),
+        threading.Thread(target=drive, args=(N_BATCH, 4, fire_batch)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mesh_wall = time.monotonic() - mesh_t0
+    offered = N_SAR + N_EXT + N_BATCH * 8
+
+    # --------------------------------- phase 2: forced three-protocol ticks
+    # the mixed phase coalesces opportunistically; this phase PINS the
+    # property: per round, one fresh body of each protocol released
+    # through a barrier within one batch-forming window must share a tick
+    R = _n(30, 8)
+    barrier = threading.Barrier(3)
+
+    def trio(kind: str) -> None:
+        for r in range(R):
+            if kind == "sar":
+                body = sar_body(10_000_000 + r)
+            elif kind == "ext":
+                body = ext_body(10_000_000 + r)
+            else:
+                body = encode_pdp_body(
+                    batch_tuple_to_sar(
+                        {
+                            "principal": f"App::User::coal{r}",
+                            "action": "view",
+                            "resource": f"docs/coal{r}",
+                        },
+                        listener.config,
+                    ),
+                    PROTOCOL_BATCH,
+                    listener.config,
+                )
+            barrier.wait()
+            doc = server.serve_authorize(body)
+            record(body, decision_of(doc))
+
+    trio_threads = [
+        threading.Thread(target=trio, args=(k,))
+        for k in ("sar", "ext", "batch")
+    ]
+    for t in trio_threads:
+        t.start()
+    for t in trio_threads:
+        t.join()
+
+    mix = server._batcher.debug_stats().get("protocol_mix", {})
+    all3 = sum(
+        n
+        for sig, n in mix.items()
+        if {"sar", "extauthz", "batch"} <= set(sig.split(","))
+    )
+    coalesced_ok = all3 >= 1
+
+    # ------------------------------------- phase 3: oracle differential
+    flips = []
+    unstable = 0
+    for (protocol, _), (body, label) in sorted(served.items()):
+        if label.startswith("unstable:"):
+            unstable += 1
+            continue
+        want, _reason = oracle.authorize_body(body)
+        if want != label:
+            flips.append(
+                {"protocol": protocol or "sar", "served": label,
+                 "oracle": want}
+            )
+    flips_ok = not flips and not unstable
+
+    # ------------------------------------------- fail postures, inline
+    bad_check = listener.check("GET", "no-slash", {})
+    bad_body = listener.batch(b"{not json")
+    bad_tuple = listener.batch(
+        json.dumps(
+            {
+                "requests": [
+                    {"principal": "App::User::u0", "action": "view",
+                     "resource": "docs/d0"},
+                    {"principal": ""},
+                ]
+            }
+        ).encode()
+    )
+    fail_posture_ok = (
+        bad_check[0] == 403
+        and bad_body[0] == 400
+        and bad_tuple[0] == 200
+        and bad_tuple[1]["responses"][1].get("errors")
+        and "decision" in bad_tuple[1]["responses"][0]
+    )
+
+    def pct(vals, q):
+        s = sorted(vals)
+        return s[min(len(s) - 1, int(len(s) * q))] if s else 0.0
+
+    ext_p99 = pct(lat["extauthz"], 0.99)
+    p99_ok = ext_p99 <= BUDGET_S
+
+    ok = bool(flips_ok and coalesced_ok and p99_ok and fail_posture_ok)
+
+    registry.reset()
+    backend = jax.default_backend()
+    result = {
+        "metric": "mesh_traffic_suite",
+        "smoke": _SMOKE,
+        "request_budget_ms": BUDGET_S * 1e3,
+        "dispatch_floor_ms": FLOOR_S * 1e3,
+        "offered": offered,
+        "wall_s": round(mesh_wall, 2),
+        "achieved_rps": round(offered / max(mesh_wall, 1e-9), 1),
+        "streams": {
+            "sar": {
+                "n": N_SAR,
+                "p50_ms": round(pct(lat["sar"], 0.5) * 1e3, 2),
+                "p99_ms": round(pct(lat["sar"], 0.99) * 1e3, 2),
+            },
+            "extauthz": {
+                "n": N_EXT,
+                "p50_ms": round(pct(lat["extauthz"], 0.5) * 1e3, 2),
+                "p99_ms": round(ext_p99 * 1e3, 2),
+                "p99_ok": bool(p99_ok),
+            },
+            "batch": {
+                "posts": N_BATCH,
+                "tuples": N_BATCH * 8,
+                "post_p50_ms": round(
+                    pct(lat["batch_post"], 0.5) * 1e3, 2
+                ),
+            },
+        },
+        "differential": {
+            "unique_bodies": len(served),
+            "flips": len(flips),
+            "unstable": unstable,
+            "examples": flips[:5],
+            "errored_answers": shed_count[0],
+            "ok": bool(flips_ok),
+        },
+        "coalescing": {
+            "protocol_mix": mix,
+            "all_three_ticks": all3,
+            "ok": bool(coalesced_ok),
+        },
+        "fail_posture_ok": bool(fail_posture_ok),
+        "cache": server.decision_cache.stats(),
+        "fallback_codes": _fallback_codes(engine),
+        "backend": "cpu-fallback" if backend == "cpu" else backend,
+        "pass": bool(ok),
+        "elapsed_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(result))
+    server.stop()  # handles the (unstarted) pdp listener + batchers
+    return 0 if ok else 1
+
+
 # pinned lowerability floor for the adversarial coverage corpus: the full
 # compiler lowers every family except the deliberate past-the-ceiling
 # `blowup` residue, which is ~9% of the corpus — a regression in any
@@ -5225,6 +5683,29 @@ if __name__ == "__main__":
 
         jax.config.update("jax_cpu_enable_async_dispatch", True)
         _scenario_exit("storm", run_storm_scenario)
+
+    if "--mesh-traffic" in sys.argv:
+        # mixed-protocol PDP suite (make bench-mesh): cpu-only BY DESIGN
+        # — the gates are about the protocol machinery (mapping fidelity
+        # vs the interpreter oracle, cross-protocol tick coalescing, the
+        # ext_authz latency budget under mixed load), not device speed,
+        # and the dispatch floor needs a deterministic backend. Same
+        # single-thread + async-dispatch posture as the storm bench: the
+        # three protocol drivers and the serving stack share the host
+        # cores.
+        os.environ.setdefault("CEDAR_NATIVE_THREADS", "1")
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_cpu_multi_thread_eigen" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_cpu_multi_thread_eigen=false"
+            ).strip()
+        from cedar_tpu.jaxenv import force_cpu
+
+        force_cpu()
+        import jax
+
+        jax.config.update("jax_cpu_enable_async_dispatch", True)
+        _scenario_exit("mesh_traffic", run_mesh_traffic_scenario)
 
     if "--chaos" in sys.argv:
         # game-day suite (make bench-chaos): cpu-only BY DESIGN — the

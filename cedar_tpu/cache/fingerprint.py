@@ -90,6 +90,13 @@ def fingerprint_attributes(attributes) -> str:
         # single-tenant fingerprints stay byte-identical to every
         # previously recorded key.
         doc["tenant"] = attributes.tenant
+    if getattr(attributes, "protocol", ""):
+        # PDP front end (cedar_tpu/pdp): an ext_authz check or batch tuple
+        # is mapped into the SAR attribute shape, so without a protocol tag
+        # a mapped request could collide with a genuine SAR's cache /
+        # recorder / audit key. Folded only when present: native-webhook
+        # fingerprints stay byte-identical (regression-pinned).
+        doc["protocol"] = attributes.protocol
     return _hash_canonical(doc)
 
 
@@ -130,8 +137,10 @@ def fingerprint_body(endpoint: str, body: bytes) -> Optional[str]:
     their decode-error answer uncached."""
     # a TenantBody (cedar_tpu/tenancy) carries the tenant the front end
     # resolved — never part of the wire bytes — and the canonical
-    # fingerprint must scope to it
+    # fingerprint must scope to it; a PdpBody (cedar_tpu/pdp) additionally
+    # carries the wire protocol, which must domain-separate the key
     tenant = getattr(body, "tenant", "")
+    protocol = getattr(body, "protocol", "")
     try:
         doc = json.loads(body)
         if not isinstance(doc, dict):
@@ -144,6 +153,8 @@ def fingerprint_body(endpoint: str, body: bytes) -> Optional[str]:
             attrs = get_authorizer_attributes(doc)
             if tenant:
                 attrs.tenant = tenant
+            if protocol:
+                attrs.protocol = protocol
             return fingerprint_attributes(attrs)
         if endpoint == "admit":
             from ..entities.admission import AdmissionRequest
@@ -179,9 +190,15 @@ class FingerprintMemo:
         # tenant-scoped memo rows: two tenants' byte-identical bodies map
         # to DIFFERENT canonical fingerprints, so the raw-digest key must
         # split on the tenant too or the second tenant would hit the
-        # first's memo row
+        # first's memo row. Protocol splits rows the same way (a PDP-mapped
+        # body must never hit a SAR row); \x01 vs \x00 separators keep the
+        # two prefixes unambiguous, and protocol-less tenant-less bodies
+        # keep the bare-body key.
         tenant = getattr(body, "tenant", "")
+        protocol = getattr(body, "protocol", "")
         raw = body if not tenant else tenant.encode() + b"\x00" + body
+        if protocol:
+            raw = protocol.encode() + b"\x01" + raw
         digest = hashlib.sha256(raw).digest()
         with self._lock:
             if digest in self._memo:

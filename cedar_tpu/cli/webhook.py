@@ -1109,6 +1109,26 @@ def build_server(args) -> WebhookServer:
             "inert; ignoring"
         )
 
+    pdp = None
+    if getattr(args, "pdp_listen", ""):
+        # second front end (cedar_tpu/pdp): built here, lifecycle owned by
+        # the WebhookServer (start()/stop() bring it up and down with the
+        # webhook listeners)
+        from ..pdp import PdpConfig, PdpListener
+
+        pdp_config = (
+            PdpConfig.load(args.pdp_schema)
+            if getattr(args, "pdp_schema", "")
+            else PdpConfig()
+        )
+        listen = str(args.pdp_listen)
+        if ":" in listen:
+            host, _, p = listen.rpartition(":")
+            pdp_addr, pdp_port = (host or args.bind_address), int(p)
+        else:
+            pdp_addr, pdp_port = args.bind_address, int(listen)
+        pdp = PdpListener(config=pdp_config, address=pdp_addr, port=pdp_port)
+
     server = WebhookServer(
         authorizer=authorizer,
         admission_handler=admission_handler,
@@ -1146,6 +1166,7 @@ def build_server(args) -> WebhookServer:
         tenancy=tenancy_resolver,
         load=load_ctrl,
         lifecycle=lifecycle,
+        pdp=pdp,
     )
     if getattr(args, "adaptive_batching", False):
         # SLO-adaptive batching: one tuner per wired batcher, sensing the
@@ -1876,6 +1897,27 @@ def make_parser() -> argparse.ArgumentParser:
         "authenticated by per-tenant SNI/LB routes, or a tenant could "
         "name a neighbor and evaluate under its policy slice. Enabled "
         "sources that disagree on a request are rejected (conflict)",
+    )
+    pdp = parser.add_argument_group("pdp front end")
+    pdp.add_argument(
+        "--pdp-listen",
+        default="",
+        metavar="[ADDR:]PORT",
+        help="start the general PDP front end (cedar_tpu/pdp, "
+        "docs/pdp.md) on this address: Envoy ext_authz HTTP-service "
+        "checks on every path plus AVP-style POST /v1/batch-authorize; "
+        "both map into the same planes, batcher ticks, cache and "
+        "admission gate the webhook serves from (ADDR defaults to "
+        "--bind-address; empty disables)",
+    )
+    pdp.add_argument(
+        "--pdp-schema",
+        default="",
+        metavar="FILE",
+        help="JSON attribute-mapping/fail-posture config for the PDP "
+        "front end (identity/context headers, "
+        "extauthz_deny_on_unavailable, tenant stamp, batch tuple cap); "
+        "omitted = defaults (see docs/pdp.md)",
     )
     debug = parser.add_argument_group("debug")
     debug.add_argument("--profiling", action="store_true")

@@ -212,6 +212,12 @@ class MicroBatcher:
         # per-stage liveness beacons for the supervisor's wedge detection
         # (server/supervisor.py): busy+stale = wedged, idle = healthy
         self.heartbeats: dict = {}
+        # batches claimed per protocol-mix signature ("sar" for plain
+        # bodies; PDP bodies carry .protocol): a multi-protocol signature
+        # is the direct evidence that SAR + ext_authz + batch traffic
+        # sharing a tick landed in ONE device dispatch (docs/pdp.md;
+        # asserted by bench.py --mesh-traffic and /debug/engine)
+        self._protocol_mix: dict = {}
         self._start_workers()
 
     def _start_workers(self) -> None:
@@ -256,11 +262,13 @@ class MicroBatcher:
         """Live queue/config snapshot for /debug/engine."""
         with self._cv:
             q = len(self._queue)
+            mix = dict(self._protocol_mix)
         return {
             "mode": "serial",
             "queue": q,
             "max_batch": self.max_batch,
             "window_us": round(self.window_s * 1e6, 1),
+            "protocol_mix": mix,
         }
 
     def queue_fill(self) -> int:
@@ -554,6 +562,16 @@ class MicroBatcher:
                     and self._pending[slot.key][1] is slot
                 ):
                     del self._pending[slot.key]
+            if batch:
+                sig = ",".join(
+                    sorted(
+                        {
+                            getattr(item, "protocol", "") or "sar"
+                            for item, _ in batch
+                        }
+                    )
+                )
+                self._protocol_mix[sig] = self._protocol_mix.get(sig, 0) + 1
         if batch:
             _record_occupancy(self.metrics_path, len(batch))
         return batch
@@ -814,8 +832,10 @@ class PipelinedBatcher(MicroBatcher):
     def debug_stats(self) -> dict:
         with self._cv:
             q = len(self._queue)
+            mix = dict(self._protocol_mix)
         return {
             "mode": "pipelined",
+            "protocol_mix": mix,
             "queue": q,
             "max_batch": self.max_batch,
             "window_us": round(self.window_s * 1e6, 1),

@@ -61,6 +61,12 @@ class Attributes:
     # outside multi-tenant serving, where both stay byte-identical to the
     # single-tenant forms
     tenant: str = ""
+    # wire protocol the front end received this request on (cedar_tpu/pdp;
+    # never part of the wire body): empty for the native SAR/AdmissionReview
+    # webhook, "extauthz" / "batch" for the PDP front end.  Folded into the
+    # canonical fingerprint only when non-empty so SAR fingerprints stay
+    # byte-identical while PDP-mapped requests can never collide with them.
+    protocol: str = ""
 
     def is_read_only(self) -> bool:
         return self.verb in READONLY_VERBS

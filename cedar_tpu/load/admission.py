@@ -99,9 +99,18 @@ def classify(path: str, body: bytes, explain: bool = False) -> str:
     ("authorization" / "admission"), ``body`` the raw wire bytes. Explain
     traffic is an operator surface, not serving traffic → sheddable.
     Admission reviews are controller/apiserver write-path traffic →
-    normal. Authorization SARs from system-critical principals → high."""
+    normal. Authorization SARs from system-critical principals → high.
+
+    PDP data-plane traffic (cedar_tpu/pdp: a body stamped with a non-empty
+    ``protocol``) is NEVER high: the high tier exists so control-plane
+    health survives overload, and the marker byte-scan must not let an
+    ext_authz header or batch tuple that happens to contain
+    ``"system:node:`` buy kubelet priority. Mesh traffic classifies
+    normal and is shed before control-plane SARs."""
     if explain:
         return PRIORITY_SHEDDABLE
+    if getattr(body, "protocol", ""):
+        return PRIORITY_NORMAL
     if path == "authorization":
         for marker in _HIGH_MARKERS:
             if marker in body:

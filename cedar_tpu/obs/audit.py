@@ -152,6 +152,7 @@ def audit_entry(
     cached: bool = False,
     tier: Optional[int] = None,
     tenant: str = "",
+    protocol: str = "",
 ) -> dict:
     """One decision's audit line (docs/observability.md schema). The
     determining policy ids come from the reason diagnostics already in
@@ -187,6 +188,11 @@ def audit_entry(
         # end attributed this decision to — joins the per-tenant metrics
         # series and the tenant-scoped fingerprint above
         entry["tenant"] = tenant
+    if protocol:
+        # PDP front end (cedar_tpu/pdp): the wire protocol this decision
+        # was served over ("extauthz" / "batch") — absent for the native
+        # webhook so existing audit lines keep their exact shape
+        entry["protocol"] = protocol
     if error:
         entry["error"] = error[:500]
     return entry

@@ -1,0 +1,36 @@
+"""General batched PDP front end (docs/pdp.md).
+
+A second front end for the same serving stack: Envoy external
+authorization in its HTTP-service mode plus an AVP-style
+``POST /v1/batch-authorize`` JSON API. Both protocols map request
+attributes into the SubjectAccessReview attribute shape (disjoint at the
+value level — schema/consts.py PDP verb prefixes) and ride the existing
+pipeline end to end: tenant slots, native encode path, PipelinedBatcher,
+decision cache, load-shed admission control, audit, traces and metrics.
+SAR, ext_authz and batch-authorize requests sharing a tick land in ONE
+device dispatch (engine/batcher.py protocol_mix is the evidence).
+"""
+
+from .config import PdpConfig
+from .mapper import (
+    PROTOCOL_BATCH,
+    PROTOCOL_EXTAUTHZ,
+    PdpBody,
+    PdpMappingError,
+    batch_tuple_to_sar,
+    extauthz_to_sar,
+)
+from .listener import PdpListener
+from .oracle import PdpOracle
+
+__all__ = [
+    "PROTOCOL_BATCH",
+    "PROTOCOL_EXTAUTHZ",
+    "PdpBody",
+    "PdpConfig",
+    "PdpListener",
+    "PdpMappingError",
+    "PdpOracle",
+    "batch_tuple_to_sar",
+    "extauthz_to_sar",
+]

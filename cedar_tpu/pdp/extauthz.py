@@ -1,0 +1,64 @@
+"""Envoy ext_authz protocol rendering (HTTP-service mode).
+
+Envoy's HTTP authorization service contract is status-code driven: any
+2xx response allows the request (response headers may be appended
+upstream), anything else denies it and the status/body are returned
+downstream. The JSON bodies here are for operators and tests — Envoy
+itself only reads the status line on allow.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from .mapper import PROTOCOL_EXTAUTHZ, PdpMappingError, encode_pdp_body
+from .mapper import extauthz_to_sar as _map_check
+
+
+def check_body(method: str, path: str, headers: dict, config):
+    """Mapped + stamped wire body for one ext_authz check. Raises
+    PdpMappingError for requests that cannot be mapped."""
+    doc = _map_check(method, path, headers, config)
+    return encode_pdp_body(doc, PROTOCOL_EXTAUTHZ, config)
+
+
+def render_check_response(sar_response: dict, config) -> Tuple[int, dict]:
+    """(status, body) for a served check, read back from the rendered SAR
+    response so the wire answer can never disagree with what the serving
+    stack decided. Fail-posture matrix (docs/pdp.md): allow → 200; deny /
+    no-opinion → 403 (the PDP is the final authority on its routes — no
+    authorizer chain to fall through to, so abstention denies);
+    evaluation error (including an overload shed) → the configured
+    unavailable posture: deny (403, default) or allow (200, flagged
+    degraded so the choice is visible in the response and in scrapes of
+    the <error> decision label)."""
+    status = (sar_response or {}).get("status") or {}
+    reason = str(status.get("reason") or "")
+    error: Optional[str] = status.get("evaluationError")
+    if error is not None:
+        if config.extauthz_deny_on_unavailable:
+            return 403, {
+                "decision": "deny",
+                "reason": "evaluation unavailable (deny-on-unavailable)",
+                "error": error,
+            }
+        return 200, {
+            "decision": "allow",
+            "reason": "evaluation unavailable (allow-on-unavailable)",
+            "degraded": True,
+            "error": error,
+        }
+    if status.get("allowed"):
+        return 200, {"decision": "allow", "reason": reason}
+    return 403, {"decision": "deny", "reason": reason}
+
+
+def render_malformed(e: PdpMappingError) -> Tuple[int, dict]:
+    """An unmappable check is a client error, never an evaluation: 403
+    (deny) regardless of the unavailable posture — allow-on-unavailable
+    exists to survive PDP outages, not to approve requests that cannot
+    even name a principal/action/resource."""
+    return 403, {"decision": "deny", "reason": f"unmappable request: {e}"}
+
+
+__all__ = ["check_body", "render_check_response", "render_malformed"]

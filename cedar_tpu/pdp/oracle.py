@@ -1,0 +1,39 @@
+"""Interpreter oracle for PDP-mapped requests.
+
+The differential referee for the PDP front end: a pure-Python
+interpreter evaluation (no device plane, no batcher, no cache) of the
+EXACT mapped attributes a PDP body carries. bench.py --mesh-traffic and
+tests/test_pdp.py compare every served decision against it — zero flips
+is the acceptance gate, and any divergence localizes to the serving
+pipeline (encode, plane, cache) because both sides consume the same
+mapped document.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Tuple
+
+
+class PdpOracle:
+    def __init__(self, stores):
+        # default-constructed authorizer = interpreter evaluation over the
+        # policy stores, the same reference semantics the device plane's
+        # differential suites pin against
+        from ..server.authorizer import CedarWebhookAuthorizer
+
+        self._authorizer = CedarWebhookAuthorizer(stores)
+
+    def authorize_body(self, body: bytes) -> Tuple[str, str]:
+        """(decision, reason) for one raw (synthetic-SAR) body, with the
+        same tenant/protocol stamps the serving path applies. Uncached by
+        construction — an oracle must re-derive every answer."""
+        from ..server.http import get_authorizer_attributes
+
+        attributes = get_authorizer_attributes(json.loads(body))
+        attributes.tenant = getattr(body, "tenant", "")
+        attributes.protocol = getattr(body, "protocol", "")
+        return self._authorizer.authorize(attributes, use_cache=False)
+
+
+__all__ = ["PdpOracle"]

@@ -53,6 +53,18 @@ ADMISSION_ACTION_UPDATE = "update"
 ADMISSION_ACTION_DELETE = "delete"
 ADMISSION_ACTION_CONNECT = "connect"
 
+# PDP front-end verb namespaces (cedar_tpu/pdp, docs/pdp.md). Both PDP
+# protocols map into the SAR non-resource attribute shape — same entity
+# types, same tenant slots, same compiled planes — and stay disjoint from
+# genuine k8s traffic at the VALUE level: every mapped action id carries a
+# protocol prefix no k8s verb has (k8s verbs are bare words, see
+# AUTHORIZATION_VERBS above), so an ext_authz GET is k8s::Action::"http:get"
+# and an AVP-style tuple's action "viewPhoto" is k8s::Action::"avp:viewPhoto".
+# The canonical-fingerprint protocol tag (cache/fingerprint.py) makes the
+# separation robust even for adversarially crafted tuples.
+PDP_EXTAUTHZ_VERB_PREFIX = "http:"
+PDP_BATCH_VERB_PREFIX = "avp:"
+
 AUTHORIZATION_PRINCIPAL_TYPES = (
     USER_ENTITY_TYPE,
     GROUP_ENTITY_TYPE,

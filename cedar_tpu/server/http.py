@@ -315,6 +315,7 @@ class WebhookServer:
         tenancy=None,
         load=None,
         lifecycle=None,
+        pdp=None,
     ):
         self.authorizer = authorizer
         self.admission_handler = admission_handler
@@ -507,6 +508,14 @@ class WebhookServer:
         # keeps the gate-free path byte-identical (bench.py --storm gates
         # the enabled-but-idle differential).
         self.load = load
+        # optional second front end (cedar_tpu/pdp): an Envoy ext_authz +
+        # batch-authorize listener that maps mesh traffic into this
+        # server's serving stack (serve_authorize), so its lifecycle is
+        # owned here — start()/stop() bring it up and down with the
+        # webhook listeners
+        self.pdp = pdp
+        if pdp is not None:
+            pdp.bind(self)
         # declarative lifecycle controller (cedar_tpu/lifecycle): the
         # server serves its /debug/lifecycle document and the
         # /lifecycle/approve control verb, and stops its reconcile loop
@@ -742,6 +751,13 @@ class WebhookServer:
         tenant = getattr(body, "tenant", "")
         if tenant and trace is not None:
             trace.root.set_attr("tenant", tenant)
+        # wire protocol (cedar_tpu/pdp): non-empty only for PDP-mapped
+        # bodies — joins the trace root span, the request metric families
+        # (bounded label) and the audit line, so mesh traffic stays
+        # distinguishable from control-plane SARs on every obs surface
+        protocol = getattr(body, "protocol", "")
+        if protocol and trace is not None:
+            trace.root.set_attr("protocol", protocol)
         decision, reason, error = DECISION_NO_OPINION, "", None
         try:
             try:
@@ -784,8 +800,8 @@ class WebhookServer:
             _octx_set(None)
             label = "<error>" if error else _DECISION_LABEL[decision]
             latency = time.monotonic() - start
-            metrics.record_request_total(label)
-            metrics.record_request_latency(label, latency)
+            metrics.record_request_total(label, protocol=protocol)
+            metrics.record_request_latency(label, latency, protocol=protocol)
             if tenant:
                 metrics.record_tenant_request(
                     "authorization", tenant, label, latency
@@ -1077,6 +1093,10 @@ class WebhookServer:
                 # over the fused stack relies on the guard conditions
                 # reading context.tenantId
                 attributes.tenant = getattr(body, "tenant", "")
+                # protocol stamp (cedar_tpu/pdp): keeps any
+                # authorizer-level cache key domain-separated exactly
+                # like the server-level fingerprint
+                attributes.protocol = getattr(body, "protocol", "")
                 # bypass the authorizer-level cache ONLY when the
                 # server-level cache is wired: it already missed on this
                 # exact canonical key, and a second lookup would
@@ -1284,6 +1304,7 @@ class WebhookServer:
                     fallback=bool(octx.get("fallback")),
                     cached=bool(octx.get("cached")),
                     tenant=getattr(body, "tenant", ""),
+                    protocol=getattr(body, "protocol", ""),
                 )
             )
             metrics.record_audit_record(path)
@@ -2261,6 +2282,8 @@ class WebhookServer:
             name="metrics-server",
             daemon=True,
         ).start()
+        if self.pdp is not None:
+            self.pdp.start()
         if self.supervisor is not None:
             self.supervisor.start()
         scheme = "https" if self.certfile else "http"
@@ -2318,6 +2341,15 @@ class WebhookServer:
                 httpd.server_close()
         self._httpd = None
         self._metrics_httpd = None
+        if self.pdp is not None:
+            try:
+                # after the webhook listeners (drain covered both fronts:
+                # PDP requests route through serve_authorize and count in
+                # the same in-flight picture), before the batchers so no
+                # PDP submit races a joining worker
+                self.pdp.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception("pdp listener stop failed")
         # batcher stop drains the queue: every already-accepted request
         # still gets its answer before the worker joins
         for batcher in (

@@ -61,8 +61,12 @@ class Counter:
         self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
         self._lock = threading.Lock()
 
-    def inc(self, amount: float = 1.0, **labels) -> None:
-        key = tuple((k, labels.get(k, "")) for k in self.label_names)
+    def inc(self, amount: float = 1.0, extra: Tuple = (), **labels) -> None:
+        # ``extra`` appends OPTIONAL label pairs to the series key (e.g. the
+        # bounded ``protocol`` label on the request families): absent label
+        # == empty label to Prometheus, so callers that never pass it keep
+        # their exposition byte-identical.
+        key = tuple((k, labels.get(k, "")) for k in self.label_names) + tuple(extra)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
@@ -124,8 +128,9 @@ class Histogram:
         self._totals: Dict[Tuple[Tuple[str, str], ...], int] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, **labels) -> None:
-        key = tuple((k, labels.get(k, "")) for k in self.label_names)
+    def observe(self, value: float, extra: Tuple = (), **labels) -> None:
+        # ``extra``: optional appended label pairs, as on Counter.inc
+        key = tuple((k, labels.get(k, "")) for k in self.label_names) + tuple(extra)
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
             for i, b in enumerate(self.buckets):
@@ -281,6 +286,25 @@ tenant_label_overflow_total = REGISTRY.register(
         "cedar_tenant_label_overflow_total",
         "Tenant-labeled observations folded into `other` because the "
         "bounded tenant label set was full.",
+        [],
+    )
+)
+
+# PDP front end (cedar_tpu/pdp, docs/pdp.md): the wire protocol a request
+# arrived on joins the request counter/latency families as an OPTIONAL
+# appended label (Counter.inc extra=) — the native webhook passes no
+# protocol, so single-protocol deployments' exposition stays byte-identical.
+# Protocol names come from code ("extauthz"/"batch"), but the cap guards
+# against a future front end stamping request-derived values.
+_PROTOCOL_LABEL_CAP = 8
+_protocol_labels: set = set()
+_protocol_label_lock = threading.Lock()
+
+protocol_label_overflow_total = REGISTRY.register(
+    Counter(
+        "cedar_protocol_label_overflow_total",
+        "Protocol-labeled observations folded into `other` because the "
+        "bounded protocol label set was full.",
         [],
     )
 )
@@ -1252,8 +1276,28 @@ chaos_injections_total = REGISTRY.register(
 )
 
 
-def record_request_total(decision: str) -> None:
-    request_total.inc(decision=decision)
+def _protocol_label_for(protocol: str) -> str:
+    with _protocol_label_lock:
+        if protocol != "other" and protocol not in _protocol_labels:
+            if len(_protocol_labels) >= _PROTOCOL_LABEL_CAP:
+                protocol_label_overflow_total.inc()
+                return "other"
+            _protocol_labels.add(protocol)
+    return protocol
+
+
+def _protocol_extra(protocol: str) -> Tuple:
+    """Appended label pairs for the request families: empty protocol (the
+    native SAR/AdmissionReview webhook) appends NOTHING, keeping
+    single-protocol expositions byte-identical; PDP protocols append a
+    bounded ``protocol`` label."""
+    if not protocol:
+        return ()
+    return (("protocol", _protocol_label_for(protocol)),)
+
+
+def record_request_total(decision: str, protocol: str = "") -> None:
+    request_total.inc(decision=decision, extra=_protocol_extra(protocol))
 
 
 def record_row_routing(path: str, row_class: str, n: int) -> None:
@@ -1261,8 +1305,12 @@ def record_row_routing(path: str, row_class: str, n: int) -> None:
         row_routing_total.inc(n, path=path, row_class=row_class)
 
 
-def record_request_latency(decision: str, latency_s: float) -> None:
-    request_latency.observe(latency_s, decision=decision)
+def record_request_latency(
+    decision: str, latency_s: float, protocol: str = ""
+) -> None:
+    request_latency.observe(
+        latency_s, decision=decision, extra=_protocol_extra(protocol)
+    )
 
 
 def record_e2e_latency(filename: str, latency_s: float) -> None:

@@ -1,0 +1,680 @@
+"""The general PDP front end (cedar_tpu/pdp, docs/pdp.md).
+
+The protocol contract, pinned:
+
+  * **domain separation** — native SAR fingerprints are byte-identical
+    to before the feature (hard-pinned hex), PDP-mapped requests fold
+    the wire protocol into fingerprint / memo / cache keys, and an
+    ext_authz check never shares a cache entry with a byte-identical
+    hand-built SAR;
+  * **mapping** — ext_authz method/path/headers and batch tuples become
+    synthetic SARs with protocol-prefixed verbs (``http:`` / ``avp:``),
+    value-disjoint from the Kubernetes verb vocabulary;
+  * **fail posture** — per protocol: ext_authz deny-on-unavailable
+    (configurable allow + degraded flag), batch partial answers,
+    malformed always-deny / whole-body 400;
+  * **shared plane** — PDP bodies ride the same serving entry: normal
+    (never high) admission priority, protocol-tagged audit/metrics with
+    byte-identical single-protocol exposition, cross-protocol batcher
+    ticks tallied in ``protocol_mix``;
+  * **differential** — a seeded corpus on both protocols answers
+    identically through the serving stack and the interpreter oracle.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from cedar_tpu.cache import DecisionCache
+from cedar_tpu.cache.fingerprint import FingerprintMemo, fingerprint_body
+from cedar_tpu.engine.batcher import MicroBatcher
+from cedar_tpu.load.admission import (
+    PRIORITY_HIGH,
+    PRIORITY_NORMAL,
+    classify,
+)
+from cedar_tpu.obs.audit import audit_entry
+from cedar_tpu.pdp import (
+    PdpBody,
+    PdpConfig,
+    PdpListener,
+    PdpMappingError,
+    PdpOracle,
+    batch_tuple_to_sar,
+    extauthz_to_sar,
+)
+from cedar_tpu.pdp.batch import handle_batch, parse_batch
+from cedar_tpu.pdp.extauthz import (
+    check_body,
+    render_check_response,
+    render_malformed,
+)
+from cedar_tpu.pdp.mapper import (
+    PROTOCOL_BATCH,
+    PROTOCOL_EXTAUTHZ,
+    encode_pdp_body,
+)
+from cedar_tpu.server import metrics
+from cedar_tpu.server.admission import (
+    CedarAdmissionHandler,
+    allow_all_admission_policy_store,
+)
+from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+from cedar_tpu.server.http import WebhookServer
+from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+POLICIES = """
+permit (
+  principal,
+  action == k8s::Action::"http:get",
+  resource is k8s::NonResourceURL
+) when { principal.name == "alice" && resource.path == "/shop/cart" };
+
+permit (
+  principal,
+  action == k8s::Action::"avp:viewPhoto",
+  resource is k8s::NonResourceURL
+) when { principal.name == "App::User::alice" };
+
+forbid (
+  principal,
+  action == k8s::Action::"avp:deleteAll",
+  resource is k8s::NonResourceURL
+) when { principal.name == "App::User::mallory" };
+
+permit (
+  principal,
+  action == k8s::Action::"get",
+  resource is k8s::Resource
+) when { principal.name == "controller-a" && resource.resource == "pods" };
+"""
+
+SAR_BODY = json.dumps(
+    {
+        "apiVersion": "authorization.k8s.io/v1",
+        "kind": "SubjectAccessReview",
+        "spec": {
+            "user": "alice",
+            "uid": "u1",
+            "groups": ["dev"],
+            "resourceAttributes": {
+                "verb": "get",
+                "version": "v1",
+                "resource": "pods",
+                "namespace": "default",
+            },
+        },
+    },
+    sort_keys=True,
+).encode()
+
+
+def mk_stack(decision_cache=None, pdp=None):
+    stores = TieredPolicyStores([MemoryStore.from_source("pdp", POLICIES)])
+    adm = TieredPolicyStores(
+        [
+            MemoryStore.from_source("pdp", POLICIES),
+            allow_all_admission_policy_store(),
+        ]
+    )
+    server = WebhookServer(
+        CedarWebhookAuthorizer(stores),
+        CedarAdmissionHandler(adm),
+        decision_cache=decision_cache,
+        pdp=pdp,
+    )
+    return stores, server
+
+
+def decision_of(doc: dict) -> str:
+    status = (doc or {}).get("status") or {}
+    if status.get("evaluationError"):
+        return "<error>"
+    if status.get("allowed"):
+        return "allow"
+    if status.get("denied"):
+        return "deny"
+    return "no_opinion"
+
+
+class TestFingerprintDomainSeparation:
+    # the native-SAR canonical fingerprint, HARD-PINNED: if this moves,
+    # every warm cache, recording filename and audit join key in every
+    # deployment breaks — the PDP feature must not touch it
+    SAR_PIN = "aff94bdb4fae452f123f39c0d9cd0e71"
+
+    def test_native_sar_fingerprint_regression_pin(self):
+        assert fingerprint_body("authorize", SAR_BODY) == self.SAR_PIN
+
+    def test_protocol_folds_into_fingerprint(self):
+        plain = fingerprint_body("authorize", SAR_BODY)
+        ext = fingerprint_body(
+            "authorize", PdpBody(SAR_BODY, PROTOCOL_EXTAUTHZ)
+        )
+        bat = fingerprint_body("authorize", PdpBody(SAR_BODY, PROTOCOL_BATCH))
+        assert len({plain, ext, bat}) == 3
+        # the separated keys are stable too (cache survives restarts)
+        assert ext == "523a7f7274c089f4b721ce0d061ec020"
+        assert bat == "d83e540375a955c3669b8072526c7f44"
+
+    def test_memo_splits_rows_on_protocol(self):
+        memo = FingerprintMemo()
+        plain = memo.fingerprint("authorize", SAR_BODY)
+        ext = memo.fingerprint(
+            "authorize", PdpBody(SAR_BODY, PROTOCOL_EXTAUTHZ)
+        )
+        assert plain == self.SAR_PIN and ext != plain
+        # repeat hits return the memoized split values, not each other's
+        assert memo.fingerprint("authorize", SAR_BODY) == plain
+        assert (
+            memo.fingerprint(
+                "authorize", PdpBody(SAR_BODY, PROTOCOL_EXTAUTHZ)
+            )
+            == ext
+        )
+
+    def test_tenant_and_protocol_compose(self):
+        t = PdpBody(SAR_BODY, PROTOCOL_EXTAUTHZ, tenant="alpha")
+        u = PdpBody(SAR_BODY, PROTOCOL_EXTAUTHZ, tenant="beta")
+        assert fingerprint_body("authorize", t) != fingerprint_body(
+            "authorize", u
+        )
+
+
+class TestConfig:
+    def test_defaults(self):
+        c = PdpConfig()
+        assert c.principal_header == "x-forwarded-user"
+        assert c.extauthz_deny_on_unavailable is True
+        assert c.batch_max_tuples == 256
+
+    def test_from_dict_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown"):
+            PdpConfig.from_dict({"principal_headr": "x-user"})
+
+    def test_load_file(self, tmp_path):
+        p = tmp_path / "pdp.json"
+        p.write_text(
+            json.dumps(
+                {
+                    "principal_header": "X-User",
+                    "context_headers": ["X-Request-Id"],
+                    "extauthz_deny_on_unavailable": False,
+                    "tenant": "alpha",
+                }
+            )
+        )
+        c = PdpConfig.load(str(p))
+        # header names are case-insensitive on the wire: stored folded
+        assert c.principal_header == "x-user"
+        assert c.context_headers == ("x-request-id",)
+        assert c.extauthz_deny_on_unavailable is False
+        assert c.tenant == "alpha"
+
+    def test_bad_cap_rejected(self):
+        with pytest.raises(ValueError):
+            PdpConfig(batch_max_tuples=0)
+
+
+class TestExtAuthzMapping:
+    CFG = PdpConfig(context_headers=("x-request-id",))
+
+    def test_maps_method_path_headers(self):
+        doc = extauthz_to_sar(
+            "GET",
+            "/shop/cart",
+            {
+                "x-forwarded-user": "alice",
+                "x-forwarded-uid": "u9",
+                "x-forwarded-groups": "dev,ops",
+                "x-request-id": "r1",
+                "x-forwarded-for": "10.0.0.9",
+                "host": "shop.local",
+            },
+            self.CFG,
+        )
+        spec = doc["spec"]
+        assert spec["user"] == "alice" and spec["uid"] == "u9"
+        assert spec["groups"] == ["dev", "ops"]
+        nra = spec["nonResourceAttributes"]
+        # the protocol-prefixed verb keeps the mapped vocabulary disjoint
+        # from bare k8s verbs: no `get` policy can match mesh traffic
+        assert nra["verb"] == "http:get"
+        assert nra["path"] == "/shop/cart"
+        extra = spec["extra"]
+        assert extra["pdp:header:x-request-id"] == ["r1"]
+        assert extra["pdp:source"] == ["10.0.0.9"]
+        assert extra["pdp:destination"] == ["shop.local"]
+
+    def test_header_names_case_insensitive(self):
+        doc = extauthz_to_sar(
+            "GET", "/x", {"X-Forwarded-User": "bob"}, self.CFG
+        )
+        assert doc["spec"]["user"] == "bob"
+
+    def test_rejects_unmappable(self):
+        with pytest.raises(PdpMappingError):
+            extauthz_to_sar("", "/x", {}, self.CFG)
+        with pytest.raises(PdpMappingError):
+            extauthz_to_sar("GET", "no-slash", {}, self.CFG)
+
+    def test_encode_is_deterministic(self):
+        a = check_body("GET", "/x", {"x-forwarded-user": "u"}, self.CFG)
+        b = check_body("GET", "/x", {"x-forwarded-user": "u"}, self.CFG)
+        # byte-identical repeats are what the memo and the coalescing
+        # singleflight key on
+        assert bytes(a) == bytes(b)
+        assert a.protocol == PROTOCOL_EXTAUTHZ
+
+
+class TestBatchMapping:
+    CFG = PdpConfig()
+
+    def test_string_and_object_entity_forms(self):
+        doc = batch_tuple_to_sar(
+            {
+                "principal": {"entityType": "App::User", "entityId": "bob"},
+                "action": {"actionType": "Action", "actionId": "viewPhoto"},
+                "resource": "photos/v.jpg",
+                "context": {"ip": "1.2.3.4", "n": 7},
+            },
+            self.CFG,
+        )
+        spec = doc["spec"]
+        assert spec["user"] == "App::User::bob"
+        nra = spec["nonResourceAttributes"]
+        # the object form keeps its declared action type in the verb;
+        # the common string form maps to the bare avp:<action>
+        assert nra["verb"] == "avp:Action::viewPhoto"
+        assert nra["path"] == "/photos/v.jpg"
+        assert spec["extra"]["pdp:ctx:ip"] == ["1.2.3.4"]
+        assert spec["extra"]["pdp:ctx:n"] == ["7"]
+
+    def test_rejects_empty_principal(self):
+        with pytest.raises(PdpMappingError):
+            batch_tuple_to_sar(
+                {"principal": "", "action": "a", "resource": "r"}, self.CFG
+            )
+
+    def test_parse_batch_caps_tuples(self):
+        cfg = PdpConfig(batch_max_tuples=2)
+        raw = json.dumps(
+            {"requests": [{"principal": "p"}] * 3}
+        ).encode()
+        with pytest.raises(PdpMappingError):
+            parse_batch(raw, cfg)
+
+
+class TestFailPosture:
+    def test_allow_is_200(self):
+        status, doc = render_check_response(
+            {"status": {"allowed": True, "reason": "policy0"}}, PdpConfig()
+        )
+        assert status == 200 and doc["decision"] == "allow"
+
+    def test_deny_and_no_opinion_are_403(self):
+        for st in ({"allowed": False, "denied": True}, {"allowed": False}):
+            status, doc = render_check_response({"status": st}, PdpConfig())
+            assert status == 403 and doc["decision"] == "deny"
+
+    def test_unavailable_denies_by_default(self):
+        status, doc = render_check_response(
+            {"status": {"evaluationError": "shed"}}, PdpConfig()
+        )
+        assert status == 403 and "unavailable" in doc["reason"]
+
+    def test_unavailable_allow_posture_is_flagged(self):
+        cfg = PdpConfig(extauthz_deny_on_unavailable=False)
+        status, doc = render_check_response(
+            {"status": {"evaluationError": "shed"}}, cfg
+        )
+        assert status == 200 and doc["degraded"] is True
+
+    def test_malformed_denies_even_on_allow_posture(self):
+        status, doc = render_malformed(PdpMappingError("bad"))
+        assert status == 403 and doc["decision"] == "deny"
+
+
+class TestBatchHandler:
+    CFG = PdpConfig()
+
+    def _pool(self):
+        return ThreadPoolExecutor(max_workers=4)
+
+    def test_partial_answers_on_eval_error(self):
+        def serve(body):
+            doc = json.loads(body)
+            if doc["spec"]["user"] == "App::User::boom":
+                raise RuntimeError("engine down")
+            return {"status": {"allowed": True, "reason": "policy0"}}
+
+        raw = json.dumps(
+            {
+                "requests": [
+                    {"principal": "App::User::a", "action": "v",
+                     "resource": "r"},
+                    {"principal": "App::User::boom", "action": "v",
+                     "resource": "r"},
+                    {"principal": "App::User::b", "action": "v",
+                     "resource": "r"},
+                ]
+            }
+        ).encode()
+        status, doc = handle_batch(serve, raw, self.CFG, self._pool())
+        assert status == 200
+        r = doc["responses"]
+        assert [x["index"] for x in r] == [0, 1, 2]
+        assert r[0]["decision"] == "ALLOW"
+        assert r[1]["decision"] == "NO_OPINION" and r[1]["errors"]
+        assert r[2]["decision"] == "ALLOW"
+
+    def test_malformed_tuple_denies_neighbours_answer(self):
+        def serve(body):
+            return {"status": {"allowed": True, "reason": "policy0"}}
+
+        raw = json.dumps(
+            {
+                "requests": [
+                    {"principal": "App::User::a", "action": "v",
+                     "resource": "r"},
+                    {"principal": ""},
+                ]
+            }
+        ).encode()
+        status, doc = handle_batch(serve, raw, self.CFG, self._pool())
+        assert status == 200
+        r = doc["responses"]
+        assert r[0]["decision"] == "ALLOW"
+        assert r[1]["decision"] == "DENY" and r[1]["errors"]
+
+    def test_whole_body_refusals_are_400(self):
+        pool = self._pool()
+        for raw in (b"{not json", b'{"nope": 1}', b'{"requests": []}'):
+            status, _doc = handle_batch(lambda b: {}, raw, self.CFG, pool)
+            assert status == 400
+
+    def test_determining_policies_surface(self):
+        reason = json.dumps({"reasons": [{"policy": "policy3"}]})
+
+        def serve(body):
+            return {"status": {"allowed": True, "reason": reason}}
+
+        raw = json.dumps(
+            {
+                "requests": [
+                    {"principal": "App::User::a", "action": "v",
+                     "resource": "r"},
+                ]
+            }
+        ).encode()
+        _status, doc = handle_batch(serve, raw, self.CFG, self._pool())
+        assert doc["responses"][0]["determiningPolicies"] == [
+            {"policyId": "policy3"}
+        ]
+
+
+class TestSharedPlane:
+    def test_cross_protocol_cache_isolation(self):
+        cache = DecisionCache()
+        _stores, server = mk_stack(decision_cache=cache)
+        try:
+            body = check_body(
+                "GET", "/shop/cart", {"x-forwarded-user": "alice"},
+                PdpConfig(),
+            )
+            # a hand-built SAR with the SAME bytes, arriving as native
+            # webhook traffic: the sharpest collision trap
+            plain = bytes(body)
+            d_pdp = decision_of(server.serve_authorize(body))
+            d_sar = decision_of(server.serve_authorize(plain))
+            assert d_pdp == "allow" == d_sar  # same policy matches both
+            s = cache.stats()
+            assert s["misses"] == 2 and s["hits"] == 0
+            # repeats hit their OWN entries — still zero cross hits
+            server.serve_authorize(check_body(
+                "GET", "/shop/cart", {"x-forwarded-user": "alice"},
+                PdpConfig(),
+            ))
+            server.serve_authorize(plain)
+            s = cache.stats()
+            assert s["misses"] == 2 and s["hits"] == 2
+        finally:
+            server.stop_batchers()
+
+    def test_pdp_body_never_classifies_high(self):
+        marker = b'{"spec": {"user": "system:node:node-1"}}'
+        assert classify("authorization", marker) == PRIORITY_HIGH
+        assert (
+            classify(
+                "authorization", PdpBody(marker, PROTOCOL_EXTAUTHZ)
+            )
+            == PRIORITY_NORMAL
+        )
+
+    def test_audit_entry_carries_protocol(self):
+        with_p = audit_entry(
+            path="authorization",
+            trace_id="t",
+            fingerprint="f",
+            decision="Allow",
+            latency_s=0.001,
+            reason="policy0",
+            protocol=PROTOCOL_EXTAUTHZ,
+        )
+        without = audit_entry(
+            path="authorization",
+            trace_id="t",
+            fingerprint="f",
+            decision="Allow",
+            latency_s=0.001,
+            reason="policy0",
+        )
+        assert with_p["protocol"] == "extauthz"
+        # absent, not empty: protocol-free audit lines are byte-unchanged
+        assert "protocol" not in without
+
+    def test_batcher_tallies_protocol_mix(self):
+        done = threading.Barrier(4)
+
+        def fn(items):
+            return [decision_of({}) for _ in items]
+
+        b = MicroBatcher(fn, max_batch=8, window_s=0.05)
+        try:
+            bodies = [
+                SAR_BODY,
+                PdpBody(SAR_BODY, PROTOCOL_EXTAUTHZ),
+                PdpBody(SAR_BODY, PROTOCOL_BATCH),
+            ]
+
+            def submit(x):
+                done.wait()
+                b.submit(x)
+
+            ts = [
+                threading.Thread(target=submit, args=(x,)) for x in bodies
+            ]
+            for t in ts:
+                t.start()
+            done.wait()
+            for t in ts:
+                t.join()
+            mix = b.debug_stats()["protocol_mix"]
+            assert sum(mix.values()) >= 1
+            joined = {
+                p for sig in mix for p in sig.split(",")
+            }
+            assert joined <= {"sar", "extauthz", "batch"}
+            assert "extauthz" in joined and "batch" in joined
+        finally:
+            b.stop()
+
+
+class TestMetricsProtocolLabel:
+    def test_protocol_free_exposition_byte_identical(self):
+        # the satellite's differential: a counter driven WITHOUT the
+        # extra mechanism and one driven through record_request_total
+        # with no protocol must collect to the same bytes
+        a = metrics.Counter("t_total", "h", ["decision"])
+        b = metrics.Counter("t_total", "h", ["decision"])
+        a.inc(decision="allowed")
+        b.inc(decision="allowed", extra=())
+        assert a.collect() == b.collect()
+
+    def test_protocol_label_appended_when_present(self):
+        c = metrics.Counter("t_total", "h", ["decision"])
+        c.inc(decision="allowed", extra=(("protocol", "extauthz"),))
+        assert 't_total{decision="allowed",protocol="extauthz"} 1' in (
+            c.collect()
+        )
+
+    def test_label_cap_folds_to_other(self):
+        snapshot = set(metrics._protocol_labels)
+        try:
+            metrics._protocol_labels.clear()
+            for i in range(metrics._PROTOCOL_LABEL_CAP):
+                assert metrics._protocol_label_for(f"p{i}") == f"p{i}"
+            # the set is full: a new name folds, a known name still maps
+            assert metrics._protocol_label_for("p-new") == "other"
+            assert metrics._protocol_label_for("p0") == "p0"
+        finally:
+            metrics._protocol_labels.clear()
+            metrics._protocol_labels.update(snapshot)
+
+
+class TestListenerHTTP:
+    def test_round_trip_over_the_wire(self):
+        listener = PdpListener(config=PdpConfig(), port=0)
+        _stores, server = mk_stack(pdp=listener)
+        try:
+            listener.start()
+            port = listener.bound_port
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+
+            # ext_authz allow
+            conn.request(
+                "GET", "/shop/cart", headers={"x-forwarded-user": "alice"}
+            )
+            r = conn.getresponse()
+            doc = json.loads(r.read())
+            assert r.status == 200 and doc["decision"] == "allow"
+
+            # ext_authz deny (unknown principal)
+            conn.request(
+                "GET", "/shop/cart", headers={"x-forwarded-user": "eve"}
+            )
+            r = conn.getresponse()
+            r.read()  # drain: HTTP/1.1 keep-alive reuses this connection
+            assert r.status == 403
+
+            # batch POST on the reserved path
+            raw = json.dumps(
+                {
+                    "requests": [
+                        {
+                            "principal": "App::User::alice",
+                            "action": "viewPhoto",
+                            "resource": "photos/v.jpg",
+                        },
+                        {
+                            "principal": "App::User::mallory",
+                            "action": "deleteAll",
+                            "resource": "anything",
+                        },
+                    ]
+                }
+            ).encode()
+            conn.request(
+                "POST",
+                "/v1/batch-authorize",
+                body=raw,
+                headers={"Content-Type": "application/json"},
+            )
+            r = conn.getresponse()
+            doc = json.loads(r.read())
+            assert r.status == 200
+            assert doc["responses"][0]["decision"] == "ALLOW"
+            assert doc["responses"][1]["decision"] == "DENY"
+
+            # unparseable batch body → whole-body 400
+            conn.request("POST", "/v1/batch-authorize", body=b"{nope")
+            r = conn.getresponse()
+            r.read()
+            assert r.status == 400
+            conn.close()
+        finally:
+            server.stop()  # stops the pdp listener too
+
+
+class TestDifferential:
+    def test_seeded_corpus_matches_oracle_on_both_protocols(self):
+        import random
+
+        stores, server = mk_stack()
+        oracle = PdpOracle(stores)
+        cfg = PdpConfig()
+        try:
+            bodies = []
+            paths = ["/shop/cart", "/shop/checkout", "/docs/a", "/x/y"]
+            users = ["alice", "bob", "", "mallory"]
+            for i in range(200):
+                r = random.Random(f"pdp-diff:ext:{i}")
+                bodies.append(
+                    check_body(
+                        r.choice(["GET", "POST", "DELETE"]),
+                        r.choice(paths),
+                        {"x-forwarded-user": r.choice(users)},
+                        cfg,
+                    )
+                )
+            actions = ["viewPhoto", "deleteAll", "edit"]
+            principals = [
+                "App::User::alice", "App::User::mallory", "App::User::x",
+            ]
+            for i in range(200):
+                r = random.Random(f"pdp-diff:bat:{i}")
+                bodies.append(
+                    encode_pdp_body(
+                        batch_tuple_to_sar(
+                            {
+                                "principal": r.choice(principals),
+                                "action": r.choice(actions),
+                                "resource": r.choice(paths).lstrip("/"),
+                            },
+                            cfg,
+                        ),
+                        PROTOCOL_BATCH,
+                        cfg,
+                    )
+                )
+            flips = []
+            for body in bodies:
+                got = decision_of(server.serve_authorize(body))
+                want, _reason = oracle.authorize_body(body)
+                if got != want:
+                    flips.append((body.protocol, got, want))
+            assert flips == []
+        finally:
+            server.stop_batchers()
+
+
+class TestCli:
+    def test_pdp_flags_parse(self):
+        from cedar_tpu.cli.webhook import make_parser
+
+        args = make_parser().parse_args(
+            ["--pdp-listen", "127.0.0.1:9191", "--pdp-schema", "/tmp/x"]
+        )
+        assert args.pdp_listen == "127.0.0.1:9191"
+        assert args.pdp_schema == "/tmp/x"
+
+    def test_pdp_defaults_off(self):
+        from cedar_tpu.cli.webhook import make_parser
+
+        args = make_parser().parse_args([])
+        assert args.pdp_listen == ""
