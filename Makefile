@@ -54,6 +54,10 @@ bench-cache: ## Decision-cache microbenchmark: Zipf SAR replay, hit ratio + cach
 bench-pipeline: ## Pipelined vs serial engine: decisions/sec + lone-request p50/p99 on one policy set (cpu; docs/performance.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --pipeline
 
+.PHONY: bench-steady
+bench-steady: ## Persistent serving loop: e2e >=80% of device-resident rate (hardware), >1 batch in flight + staging occupancy overlap, AOT cold-start-to-warm with zero fresh traces, 1152-body on/off byte differential (device when attached, cpu skip posture otherwise; docs/performance.md)
+	$(PYTHON) bench.py --steady
+
 .PHONY: bench-shadow
 bench-shadow: ## Shadow-rollout overhead: live p50/p99 + saturated throughput at 0/10/100% shadow sampling (cpu; docs/rollout.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --shadow

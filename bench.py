@@ -852,6 +852,415 @@ def run_pipeline_scenario() -> int:
     return 0 if (result["speedup_ok"] and lone_ok) else 1
 
 
+# cold-start child for bench.py --steady: a FRESH process (fresh jit
+# caches, fresh trace counter) loads the same deterministic policy set and
+# runs the full warm ladder against the shared executable cache. Run once
+# to export, once to prove warm-from-disk: the second run's warmup() must
+# report zero fresh kernel traces and all-hits from the cache. A
+# subprocess, not an in-process reset: the parent's jit caches would hide
+# fresh traces and turn the pin into a tautology.
+_STEADY_AOT_CHILD = r"""
+import json, sys, time
+
+import bench  # the same deterministic policy-set builder the parent used
+from cedar_tpu.engine.evaluator import TPUPolicyEngine
+
+ps = bench.build_policy_set(int(sys.argv[1]))[0]
+eng = TPUPolicyEngine(segred=True)
+t0 = time.time()
+eng.load([ps], warm="off")
+load_s = time.time() - t0
+t1 = time.time()
+w = eng.warmup()
+w["warm_wall_s"] = round(time.time() - t1, 3)
+w["load_s"] = round(load_s, 3)
+print(json.dumps(w))
+"""
+
+
+def run_steady_scenario() -> int:
+    """``bench.py --steady`` (``make bench-steady``): the persistent
+    serving loop, gated end-to-end (ISSUE 19). Four checks; rc 0 iff
+    every hard gate holds:
+
+      * e2e-vs-device-resident ratio — the pipelined native path must
+        sustain >= 80% of the device-resident kernel rate. HARDWARE
+        gate: on cpu(-fallback) hosts the "device" shares the host cores
+        with encode/decode, so the ratio measures core contention rather
+        than the serving loop — reported with a skip reason (the
+        bench-fanout posture), never enforced there.
+      * overlap evidence — steady state must show more than one batch in
+        flight (PipelinedBatcher ``inflight_peak`` > 1) and staging-slot
+        occupancy above the serial baseline (_StagingPool
+        ``peak_outstanding``: batch N+1's encode held buffers while
+        batch N's were still out). Hard on every backend: double
+        buffering is an execution-model property, not a device-speed one.
+      * AOT cold-start-to-warm — a fresh subprocess warms the full
+        ladder and exports executables into a throwaway cache dir; a
+        SECOND fresh subprocess warms from that cache. Zero fresh kernel
+        traces and aot hits > 0 in the second run are hard gates; the
+        < 5s cold-start-to-warm wall gate is hardware-only (cpu XLA
+        compile/deserialize speed is not the serving claim). Both
+        children run BEFORE this process touches the backend, so they
+        never race the parent's device attachment.
+      * byte differential — 1152 SAR bodies through the persistent loop
+        with AOT + double-buffering ON must serialize byte-identical to
+        the escape-hatch path (CEDAR_TPU_AOT=0 + CEDAR_TPU_INFLIGHT=1,
+        which collapses the pipeline to a single in-flight slot). Zero
+        flips, hard on every backend.
+    """
+    import statistics
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    t0 = time.time()
+    n_policies = _n(100, 60)
+    # deliberately NOT a bucket boundary: padding to the next bucket must
+    # route through the engine's staging pool so slot occupancy is
+    # observable in the overlap gate
+    B = _n(4000, 1000)
+    K = _n(24, 10)  # timed batches for the steady-state interval
+    ND = 1152  # differential bodies (>= 1.1k even in smoke: it is a gate)
+    DEPTH, WORKERS = 3, 2
+
+    cache_dir = tempfile.mkdtemp(prefix="cedar-aot-steady-")
+
+    # ---- AOT cold start FIRST: the children need the device to
+    # themselves on single-attach backends, so they run before this
+    # process initializes any jax backend.
+    def aot_child(tag):
+        env = dict(os.environ)
+        env["CEDAR_TPU_AOT_CACHE"] = cache_dir
+        env.pop("CEDAR_TPU_AOT", None)
+        r = subprocess.run(
+            [sys.executable, "-c", _STEADY_AOT_CHILD, str(n_policies)],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"aot {tag} child failed rc={r.returncode}: "
+                f"{r.stderr[-2000:]}"
+            )
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    cold = aot_child("export")
+    warm = aot_child("warm")
+    warm_aot = warm.get("aot") or {}
+    cold_to_warm_s = warm.get("load_s", 0.0) + warm.get("warm_wall_s", 0.0)
+    aot_zero_trace_ok = warm.get("traces") == 0 and warm_aot.get("hits", 0) > 0
+
+    import jax
+
+    from cedar_tpu.engine import aot
+    from cedar_tpu.engine.batcher import PipelinedBatcher
+    from cedar_tpu.engine.evaluator import TPUPolicyEngine
+    from cedar_tpu.engine.fastpath import SARFastPath
+    from cedar_tpu.server.authorizer import CedarWebhookAuthorizer
+    from cedar_tpu.stores.store import MemoryStore, TieredPolicyStores
+
+    backend = jax.default_backend()
+    on_cpu = backend == "cpu"
+    if on_cpu:
+        # pipeline_dispatch must launch without blocking on device
+        # compute, as PJRT does on a real TPU
+        jax.config.update("jax_cpu_enable_async_dispatch", True)
+    # the parent serves through the same executable cache the children
+    # populated: this IS the AOT-on path the differential compares against
+    aot.set_cache_dir(cache_dir)
+    aot.reset_counters()
+
+    ps, users, nss, resources, verbs, groups = build_policy_set(n_policies)
+    engine = TPUPolicyEngine(segred=True)
+    engine.load([ps], warm="off")
+    authorizer = CedarWebhookAuthorizer(
+        TieredPolicyStores([MemoryStore("bench", ps)]),
+        evaluate=engine.evaluate,
+    )
+    fast = SARFastPath(engine, authorizer)
+    if not fast.available:
+        print(json.dumps({
+            "scenario": "steady",
+            "error": "native fast path unavailable (no C++ toolchain)",
+            "pass": False,
+        }))
+        return 1
+
+    rng = random.Random(7)
+
+    def body():
+        return json.dumps(
+            {
+                "apiVersion": "authorization.k8s.io/v1",
+                "kind": "SubjectAccessReview",
+                "spec": {
+                    "user": rng.choice(users),
+                    "uid": "u",
+                    "groups": rng.sample(groups, rng.randint(0, 3)),
+                    "resourceAttributes": {
+                        "verb": rng.choice(verbs),
+                        "version": "v1",
+                        "resource": rng.choice(resources),
+                        "namespace": rng.choice(nss),
+                    },
+                },
+            }
+        ).encode()
+
+    pool = [[body() for _ in range(B)] for _ in range(6)]
+    fast.authorize_raw(pool[0])  # warm the B-row shapes + encoder
+    # serial baseline for the staging-occupancy gate: one batch's worth
+    # of buffers held at once (codes+extras per padded chunk); steady
+    # state must EXCEED this peak or nothing ever overlapped
+    staging_serial_peak = engine.staging_stats()["peak_outstanding"]
+
+    # ---- device-resident kernel rate (main()'s resident measure at
+    # steady-bench scale): inputs device_put up front, verdict words read
+    # back — the hardware ceiling the e2e loop is gated against.
+    from cedar_tpu.ops.match import match_rules_codes, match_rules_codes_wire
+
+    cs = engine._compiled
+    packed = cs.packed
+    snap = fast._current_snapshot()
+    codes_i32, extras_i32, _counts, _flags = snap.encoder.encode_batch(
+        pool[0]
+    )
+    codes_base = np.ascontiguousarray(codes_i32.astype(cs.code_dtype))
+    extras_base = np.ascontiguousarray(extras_i32.astype(cs.active_dtype))
+    wire = getattr(cs, "wire", None)
+    segs = getattr(cs, "segs", None)
+    kargs = (
+        cs.act_rows_dev,
+        cs.W_dev,
+        cs.thresh_dev,
+        cs.rule_group_dev,
+        cs.rule_policy_dev,
+    )
+
+    def mk_inp(c, e):
+        if wire is None:
+            return (c, e)
+        c8, cw = cs.pack_wire(c)
+        return (c8, cw, e)
+
+    def launch(inp):
+        if wire is None:
+            return match_rules_codes(
+                inp[0], inp[1], *kargs, packed.n_tiers, False,
+                False, None, packed.has_gate, segs,
+            )
+        return match_rules_codes_wire(
+            inp[0], inp[1], cs.lo8_dev, inp[2], *kargs, packed.n_tiers,
+            False, False, None, packed.has_gate, segs,
+        )
+
+    n_pipe = 4
+    host_inputs = [
+        mk_inp(np.roll(codes_base, i, axis=0), np.roll(extras_base, i, axis=0))
+        for i in range(n_pipe)
+    ]
+    w, _ = launch(host_inputs[0])
+    np.asarray(w)  # compile this exact shape
+    dev_inputs = [
+        tuple(jax.device_put(a) for a in inp) for inp in host_inputs
+    ]
+    jax.block_until_ready(dev_inputs)
+
+    def resident_trial():
+        t = time.time()
+        outs = []
+        for inp in dev_inputs:
+            w, _ = launch(inp)
+            w.copy_to_host_async()
+            outs.append(w)
+        for w in outs:
+            np.asarray(w)
+        return B * n_pipe / (time.time() - t)
+
+    rs = sorted(resident_trial() for _ in range(4))
+    resident_rate = (rs[1] + rs[2]) / 2  # median-of-4, like main()
+
+    # ---- steady-state e2e rate through the REAL three-stage pipeline:
+    # each submitted item is a whole B-row body batch (the bench-pipeline
+    # adapter), stamps mark batch completion, and the steady rate is
+    # B / median completion interval with the pipeline-fill edge dropped.
+    class _Stages:
+        def __init__(self, stamps):
+            self.stamps = stamps
+
+        def pipeline_encode(self, items):
+            return [fast.pipeline_encode(b) for b in items]
+
+        def pipeline_dispatch(self, ctxs):
+            return [fast.pipeline_dispatch(c) for c in ctxs]
+
+        def pipeline_decode(self, ctxs):
+            out = [fast.pipeline_decode(c) for c in ctxs]
+            self.stamps.append(time.monotonic())
+            return out
+
+    def steady_run(n):
+        stamps: list = []
+        pb = PipelinedBatcher(
+            _Stages(stamps), max_batch=1, window_s=0.0,
+            depth=DEPTH, encode_workers=WORKERS,
+        )
+        results = [None] * n
+
+        def one(i):
+            results[i] = pb.submit(pool[i % len(pool)], timeout=600)
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st = pb.debug_stats()
+        pb.stop()
+        assert all(r is not None for r in results)
+        deltas = [y - x for x, y in zip(stamps, stamps[1:])]
+        return deltas[DEPTH:], st
+
+    steady_run(_n(6, 4))  # warm the pipelined driver path
+    deltas, pstats = steady_run(K)
+    steady_med = statistics.median(deltas)
+    e2e_rate = B / steady_med
+    inflight_peak = pstats["inflight_peak"]
+    staging = engine.staging_stats()
+
+    ratio = e2e_rate / resident_rate if resident_rate else 0.0
+    ratio_skipped = ""
+    if on_cpu:
+        ratio_skipped = (
+            "cpu backend: device-resident and e2e share the host cores, "
+            "so the ratio measures core contention, not the serving loop"
+        )
+    ratio_ok = True if ratio_skipped else ratio >= 0.80
+    overlap_ok = bool(
+        inflight_peak > 1
+        and staging["peak_outstanding"] > staging_serial_peak
+    )
+    cold_skipped = (
+        "cpu backend: compile/deserialize wall time is not the serving "
+        "claim; traces/hits gates still enforced" if on_cpu else ""
+    )
+    cold_ok = True if cold_skipped else cold_to_warm_s < 5.0
+
+    # ---- byte differential: the SAME 1152 bodies through the persistent
+    # loop (AOT on, double-buffered) and through the escape hatches
+    # (CEDAR_TPU_AOT=0 jit path, CEDAR_TPU_INFLIGHT=1 single slot).
+    bodies_d = [body() for _ in range(ND)]
+
+    def run_submits(pb, items):
+        out = [None] * len(items)
+        NT = 16
+
+        def worker(t):
+            for i in range(t, len(items), NT):
+                out[i] = pb.submit(items[i], timeout=600)
+
+        ths = [
+            threading.Thread(target=worker, args=(t,)) for t in range(NT)
+        ]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        return out
+
+    pb_on = PipelinedBatcher(
+        fast, window_s=0.0002, depth=DEPTH, encode_workers=WORKERS
+    )
+    try:
+        on_res = run_submits(pb_on, bodies_d)
+    finally:
+        pb_on.stop()
+
+    saved_env = {
+        k: os.environ.get(k) for k in ("CEDAR_TPU_AOT", "CEDAR_TPU_INFLIGHT")
+    }
+    os.environ["CEDAR_TPU_AOT"] = "0"
+    os.environ["CEDAR_TPU_INFLIGHT"] = "1"
+    try:
+        engine_off = TPUPolicyEngine(segred=True)
+        engine_off.load([ps], warm="off")
+        auth_off = CedarWebhookAuthorizer(
+            TieredPolicyStores([MemoryStore("bench", ps)]),
+            evaluate=engine_off.evaluate,
+        )
+        fast_off = SARFastPath(engine_off, auth_off)
+        pb_off = PipelinedBatcher(
+            fast_off, window_s=0.0002, depth=DEPTH, encode_workers=WORKERS
+        )
+        off_depth = pb_off.debug_stats()["depth"]  # env hatch: must be 1
+        try:
+            off_res = run_submits(pb_off, bodies_d)
+        finally:
+            pb_off.stop()
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    flips = sum(
+        1 for a, b in zip(on_res, off_res)
+        if json.dumps(a).encode() != json.dumps(b).encode()
+    )
+    differential_ok = flips == 0 and off_depth == 1
+
+    ok = bool(
+        ratio_ok and overlap_ok and aot_zero_trace_ok and cold_ok
+        and differential_ok
+    )
+    fallback_note = os.environ.get("CEDAR_BENCH_CPU_FALLBACK", "")
+    result = {
+        "scenario": "steady",
+        "metric": "steady_serving_loop",
+        "smoke": _SMOKE,
+        "policies": n_policies,
+        "batch": B,
+        "batches_timed": len(deltas),
+        "device_resident_rate": round(resident_rate),
+        "e2e_steady_rate": round(e2e_rate),
+        "e2e_vs_resident_ratio": round(ratio, 3),
+        "ratio_gate_skipped": ratio_skipped,
+        "inflight_peak": inflight_peak,
+        "staging": staging,
+        "staging_serial_peak": staging_serial_peak,
+        "aot_cold": cold,
+        "aot_warm": warm,
+        "cold_to_warm_s": round(cold_to_warm_s, 3),
+        "cold_gate_skipped": cold_skipped,
+        "differential_bodies": ND,
+        "decision_flips": flips,
+        "single_buffer_depth": off_depth,
+        "pipeline_depth": DEPTH,
+        "encode_workers": WORKERS,
+        "backend": "cpu-fallback" if fallback_note or on_cpu else backend,
+        **({"backend_note": fallback_note} if fallback_note else {}),
+        "gates": {
+            "e2e_ratio_ok": bool(ratio_ok),
+            "overlap_ok": overlap_ok,
+            "aot_zero_trace_ok": bool(aot_zero_trace_ok),
+            "cold_to_warm_ok": bool(cold_ok),
+            "differential_ok": bool(differential_ok),
+        },
+        "elapsed_s": round(time.time() - t0, 1),
+        "pass": ok,
+    }
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 def run_shadow_scenario() -> int:
     """``bench.py --shadow`` (``make bench-shadow``): proves shadow
     evaluation is off the hot path. One WebhookServer (engine-backed
@@ -5436,6 +5845,9 @@ def main():
     print(json.dumps(result))
 
 
+_TAIL_EMITTED = False  # one JSON failure tail per process, never two
+
+
 def _emit_failure_tail(scenario: str, reason: str) -> None:
     """Terminal failure: print the machine-parseable JSON tail before the
     process exits nonzero. BENCH_r05.json recorded `rc: 1, parsed: null`
@@ -5446,6 +5858,8 @@ def _emit_failure_tail(scenario: str, reason: str) -> None:
     partial number can never be read as a device measurement."""
     import sys
 
+    global _TAIL_EMITTED
+    _TAIL_EMITTED = True
     record = {
         "scenario": scenario,
         "backend": "cpu-fallback",
@@ -5847,56 +6261,97 @@ if __name__ == "__main__":
         jax.config.update("jax_cpu_enable_async_dispatch", True)
         _scenario_exit("encode", run_encode_scenario)
 
-    was_waiter = bool(os.environ.pop("CEDAR_BENCH_WAIT", ""))
-    if _SMOKE or os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
-        # cpu-only run (smoke, or an explicit JAX_PLATFORMS=cpu fallback
-        # record): no device probe — the probe subprocess would hang on a
-        # dead tunnel even under cpu, because the site hook initializes
-        # the tunneled plugin through backends() (cedar_tpu/jaxenv.py).
-        # Fail-fast non-cpu backends and go straight into main().
-        from cedar_tpu.jaxenv import force_cpu
+    if "--steady" in sys.argv:
+        # steady-state serving-loop gates (make bench-steady): runs against
+        # the real device when the link answers — the e2e-vs-resident
+        # ratio is a hardware claim — and otherwise degrades through
+        # _cpu_fallback into skip posture (the overlap and byte-differential
+        # gates stay hard on cpu). NO jax import here: the scenario's AOT
+        # cold-start children must attach to the device before this
+        # process does (single-attach backends), so backend init happens
+        # inside run_steady_scenario after the children exit.
+        if _SMOKE or os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+            from cedar_tpu.jaxenv import force_cpu
 
-        force_cpu()
-    elif was_waiter:
-        # post-execv waiter stage: the failed run's device client died with
-        # the old process image, so this process (and its probe subprocesses)
-        # can attach cleanly once the link is back. Probing BEFORE the execv
-        # would race the still-attached dead client on single-attach backends.
-        if not _wait_for_backend():
-            _cpu_fallback("backend did not return within the wait budget")
-    elif not _wait_for_backend(
-        max_wait_s=float(os.environ.get("CEDAR_BENCH_PREFLIGHT_S", "240"))
-    ):
-        # cheap pre-flight (no prior attach to race): a dead link at bench
-        # START no longer hard-fails with a non-parseable tail (rc=1,
-        # BENCH_r05): the run degrades to the cpu backend and the JSON
-        # record carries "backend": "cpu-fallback" so it can never be
-        # mistaken for a device number
-        _cpu_fallback("device link unavailable at bench start")
-    deadline_s = float(os.environ.get("CEDAR_BENCH_DEADLINE_S", "2700"))
-    status, exc = _run_main_guarded(deadline_s)
-    if status == "ok":
-        sys.exit(0)
-    retries = int(os.environ.get("CEDAR_BENCH_RETRY", "0"))
-    if retries >= 2 or not (status == "hang" or _backend_transient(exc)):
-        # terminal failure: the parseable JSON tail goes out BEFORE the
-        # raise — rc stays nonzero, but the record is never `parsed: null`
-        _emit_failure_tail(
-            "main",
-            f"{type(exc).__name__}: {exc}"
-            if exc is not None
-            else f"bench hung past {deadline_s:.0f}s deadline",
+            force_cpu()
+        elif not _wait_for_backend(
+            max_wait_s=float(os.environ.get("CEDAR_BENCH_PREFLIGHT_S", "240"))
+        ):
+            _cpu_fallback("device link unavailable at bench start")
+        _scenario_exit("steady", run_steady_scenario)
+
+    def _default_entry():
+        """Preflight + guarded main() + transient-retry flow. Factored
+        into a function so the whole default entry path sits under ONE
+        tail guard: the BENCH_r05 failure mode was an exception escaping
+        this block (a probe/env failure outside any scenario's
+        _scenario_exit) leaving rc=1 with `parsed: null`."""
+        was_waiter = bool(os.environ.pop("CEDAR_BENCH_WAIT", ""))
+        if _SMOKE or os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+            # cpu-only run (smoke, or an explicit JAX_PLATFORMS=cpu fallback
+            # record): no device probe — the probe subprocess would hang on a
+            # dead tunnel even under cpu, because the site hook initializes
+            # the tunneled plugin through backends() (cedar_tpu/jaxenv.py).
+            # Fail-fast non-cpu backends and go straight into main().
+            from cedar_tpu.jaxenv import force_cpu
+
+            force_cpu()
+        elif was_waiter:
+            # post-execv waiter stage: the failed run's device client died
+            # with the old process image, so this process (and its probe
+            # subprocesses) can attach cleanly once the link is back.
+            # Probing BEFORE the execv would race the still-attached dead
+            # client on single-attach backends.
+            if not _wait_for_backend():
+                _cpu_fallback("backend did not return within the wait budget")
+        elif not _wait_for_backend(
+            max_wait_s=float(os.environ.get("CEDAR_BENCH_PREFLIGHT_S", "240"))
+        ):
+            # cheap pre-flight (no prior attach to race): a dead link at
+            # bench START no longer hard-fails with a non-parseable tail
+            # (rc=1, BENCH_r05): the run degrades to the cpu backend and
+            # the JSON record carries "backend": "cpu-fallback" so it can
+            # never be mistaken for a device number
+            _cpu_fallback("device link unavailable at bench start")
+        deadline_s = float(os.environ.get("CEDAR_BENCH_DEADLINE_S", "2700"))
+        status, exc = _run_main_guarded(deadline_s)
+        if status == "ok":
+            sys.exit(0)
+        retries = int(os.environ.get("CEDAR_BENCH_RETRY", "0"))
+        if retries >= 2 or not (status == "hang" or _backend_transient(exc)):
+            # terminal failure: the parseable JSON tail goes out BEFORE the
+            # raise — rc stays nonzero, but the record is never
+            # `parsed: null`
+            _emit_failure_tail(
+                "main",
+                f"{type(exc).__name__}: {exc}"
+                if exc is not None
+                else f"bench hung past {deadline_s:.0f}s deadline",
+            )
+            if exc is not None:
+                raise exc
+            raise SystemExit(f"# bench hung past {deadline_s:.0f}s deadline")
+        print(
+            "# transient backend failure "
+            f"({'hang' if status == 'hang' else f'{type(exc).__name__}: {exc}'}); "
+            "restarting with a fresh backend once the device returns",
+            file=sys.stderr,
+            flush=True,
         )
-        if exc is not None:
-            raise exc
-        raise SystemExit(f"# bench hung past {deadline_s:.0f}s deadline")
-    print(
-        "# transient backend failure "
-        f"({'hang' if status == 'hang' else f'{type(exc).__name__}: {exc}'}); "
-        "restarting with a fresh backend once the device returns",
-        file=sys.stderr,
-        flush=True,
-    )
-    os.environ["CEDAR_BENCH_RETRY"] = str(retries + 1)
-    os.environ["CEDAR_BENCH_WAIT"] = "1"
-    os.execv(sys.executable, [sys.executable] + sys.argv)
+        os.environ["CEDAR_BENCH_RETRY"] = str(retries + 1)
+        os.environ["CEDAR_BENCH_WAIT"] = "1"
+        os.execv(sys.executable, [sys.executable] + sys.argv)
+
+    try:
+        _default_entry()
+    except SystemExit:
+        raise
+    except BaseException as _e:  # noqa: BLE001 — tail first, then unwind
+        # anything that escaped the preflight/retry plumbing itself (a
+        # probe OSError, a force_cpu failure, an import error): same
+        # contract as every scenario — the LAST stdout line is a JSON
+        # record. _run_main_guarded's terminal path already printed one;
+        # don't print two.
+        if not _TAIL_EMITTED:
+            _emit_failure_tail("main", f"{type(_e).__name__}: {_e}")
+        raise

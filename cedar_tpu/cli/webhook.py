@@ -225,6 +225,14 @@ def build_server(args) -> WebhookServer:
         getattr(args, "pallas", "auto")
     ]
 
+    # serialized-executable cache (engine/aot.py, docs/Operations.md):
+    # the flag wins over CEDAR_TPU_AOT_CACHE; either enables warm-from-disk
+    # cold starts (zero fresh jit traces when the key matches)
+    if getattr(args, "aot_cache_dir", ""):
+        from ..engine import aot
+
+        aot.set_cache_dir(args.aot_cache_dir)
+
     config = None
     if args.config:
         with open(args.config) as f:
@@ -1364,6 +1372,17 @@ def make_parser() -> argparse.ArgumentParser:
         "overriding CEDAR_NATIVE_THREADS; 0 = env var, else cpu count "
         "(capped at 16). The bench projects near-linear encode scaling "
         "to ~16 cores (docs/performance.md, Host-side budget)",
+    )
+    cedar.add_argument(
+        "--aot-cache-dir",
+        default="",
+        help="serialized-executable cache directory (engine/aot.py): "
+        "compiled serving executables are exported here keyed by plane "
+        "shapes/dtypes + jax/jaxlib version + backend topology, and a "
+        "restart with a matching key warms from disk with ZERO fresh jit "
+        "traces; stale keys recompile loudly. Also CEDAR_TPU_AOT_CACHE; "
+        "CEDAR_TPU_AOT=0 disables. The dir must be trusted — entries are "
+        "pickled executables (docs/Operations.md)",
     )
     cedar.add_argument(
         "--pallas",

@@ -5,8 +5,13 @@ The packed form is the TPU-native policy representation:
   * ``W``      [L, R] int8   — +1 literal required true, -1 required false
   * ``thresh`` [R] float32   — number of positive literals per rule; a rule is
                                satisfied iff lit-vector @ W[:, r] >= thresh[r]
-  * ``rule_group``  [R]      — tier*2 + effect (0 permit / 1 forbid)
-  * ``rule_policy`` [R]      — index into the policy metadata list (reasons)
+  * ``rule_group``  [R] int16 — tier*3 + routing class (+ trailing gate
+                               group); values stay tiny (≤ ~30 for any real
+                               tier stack), so a narrow column halves its
+                               per-dispatch device traffic vs int32
+  * ``rule_policy`` [R] int32 — index into the policy metadata list
+                               (reasons); INT32_MAX padding sentinel keeps
+                               this one wide
 
 Shapes are bucketed (L, R rounded up to power-of-two-ish buckets) so a policy
 reload of similar size is a pure device-buffer swap with no XLA recompile —
@@ -247,8 +252,8 @@ class PackedPolicySet:
 
     W: np.ndarray  # [L, R] int8
     thresh: np.ndarray  # [R] float32
-    rule_group: np.ndarray  # [R] int32
-    rule_policy: np.ndarray  # [R] int32
+    rule_group: np.ndarray  # [R] int16 (group ids are tiny; see module doc)
+    rule_policy: np.ndarray  # [R] int32 (INT32_MAX pad sentinel needs width)
     n_tiers: int
     n_rules: int
     n_lits: int
@@ -418,7 +423,11 @@ def pack(compiled: CompiledPolicies) -> PackedPolicySet:
 
     W = np.zeros((L, R), dtype=np.int8)
     thresh = np.full((R,), 1e9, dtype=np.float32)  # padding never satisfied
-    rule_group = np.zeros((R,), dtype=np.int32)
+    # int16 group column: ids run 0 .. n_tiers*3 (gate group last) — far
+    # under the dtype ceiling, and half the int32 plane's device traffic.
+    # Padding columns ride group 0 with a never-satisfied thresh, exactly
+    # as before. rule_policy keeps int32 for its INT32_MAX pad sentinel.
+    rule_group = np.zeros((R,), dtype=np.int16)
     rule_policy = np.full((R,), np.iinfo(np.int32).max, dtype=np.int32)
 
     for r, (lits, group, pm_idx, _rc) in enumerate(rules):

@@ -29,6 +29,7 @@ results the serial batch fn would.
 from __future__ import annotations
 
 import logging
+import os
 import queue as _queue
 import threading
 import time
@@ -536,13 +537,22 @@ class MicroBatcher:
                 return None
             if self._stopped and not self._queue:
                 return None
-            # batch-forming window: let concurrent submitters pile in
-            deadline = time.monotonic() + self.window_s
-            while len(self._queue) < self.max_batch:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                self._cv.wait(timeout=remaining)
+            # batch-forming window: let concurrent submitters pile in.
+            # The window is a hook (_linger_window_s): the pipelined
+            # batcher returns 0 while batches are already in flight —
+            # the device is the pacing clock then, and arrivals
+            # accumulate in the queue for free while it drains batch N,
+            # so the steady-state tick claims one fused batch with NO
+            # host linger added to its latency (device-side
+            # accumulation, docs/performance.md).
+            window = self._linger_window_s()
+            if window > 0:
+                deadline = time.monotonic() + window
+                while len(self._queue) < self.max_batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
             batch = self._queue[: self.max_batch]
             del self._queue[: self.max_batch]
             # claimed entries leave the coalesce map: submitters
@@ -575,6 +585,12 @@ class MicroBatcher:
         if batch:
             _record_occupancy(self.metrics_path, len(batch))
         return batch
+
+    def _linger_window_s(self) -> float:
+        """The batch-forming linger for THIS claim (see _form_batch).
+        The serial batcher always lingers window_s; the pipelined
+        batcher overrides this with its in-flight-aware version."""
+        return self.window_s
 
     def _complete_batch(self, batch: list, results: Sequence[R]) -> None:
         if len(results) != len(batch):
@@ -682,6 +698,17 @@ class PipelinedBatcher(MicroBatcher):
         from concurrent.futures import ThreadPoolExecutor
 
         self.stages = stages
+        # CEDAR_TPU_INFLIGHT caps the in-flight batch depth from the
+        # environment: "1" is the single-buffer escape hatch for the
+        # double-buffering byte differential (bench.py --steady compares
+        # responses with and without overlap), larger values widen the
+        # staging window beyond the constructor's depth
+        env_depth = os.environ.get("CEDAR_TPU_INFLIGHT", "")
+        if env_depth:
+            try:
+                depth = int(env_depth)
+            except ValueError:
+                pass
         self.depth = max(1, int(depth))
         if encode_workers <= 0:
             # auto-size (--encode-workers 0): each encode worker drives a
@@ -708,6 +735,10 @@ class PipelinedBatcher(MicroBatcher):
         # the same, in ENTRIES (every batch's len added/removed at the
         # exact sites _inflight moves): backlog()'s in-pipeline half
         self._inflight_entries = 0
+        # high-water mark of concurrent in-flight batches: > 1 is the
+        # direct overlap evidence (batch N+1 staged/launched while batch
+        # N was still in the pipeline) bench.py --steady gates on
+        self._inflight_peak = 0
         self._inflight_lock = threading.Lock()
         self._stall_s = {"collect": 0.0, "dispatch": 0.0, "decode": 0.0}
         super().__init__(
@@ -844,6 +875,8 @@ class PipelinedBatcher(MicroBatcher):
             "dispatch_queue": self._dispatch_q.qsize(),
             "decode_queue": self._decode_q.qsize(),
             "batches_total": self._batches_total,
+            "inflight": self._inflight,
+            "inflight_peak": self._inflight_peak,
             "stall_seconds": {
                 k: round(v, 6) for k, v in self._stall_s.items()
             },
@@ -851,10 +884,23 @@ class PipelinedBatcher(MicroBatcher):
 
     # ------------------------------------------------------------- plumbing
 
+    def _linger_window_s(self) -> float:
+        """Device-side accumulation: while batches are already in flight
+        the collector claims immediately — requests that arrived during
+        the device's evaluation of batch N ARE the accumulated batch, so
+        an extra host linger only adds latency without adding rows. An
+        idle pipeline (nothing in flight) keeps the normal forming
+        window so a burst's first tick still coalesces."""
+        if self._inflight > 0:
+            return 0.0
+        return self.window_s
+
     def _inflight_add(self, n: int, entries: int = 0) -> None:
         with self._inflight_lock:
             self._inflight += n
             self._inflight_entries += entries
+            if self._inflight > self._inflight_peak:
+                self._inflight_peak = self._inflight
 
     def backlog(self) -> int:
         """Submitted-but-unanswered entries across the whole batcher:

@@ -77,6 +77,7 @@ from ..ops.match import (
     match_rules_codes_pallas,
     match_rules_codes_wire,
 )
+from . import aot
 
 _BATCH_BUCKETS = (1, 8, 32, 128, 512, 1024, 2048, 4096, 8192, 16384, 32768)
 
@@ -193,16 +194,38 @@ class _StagingPool:
 
     A buffer whose release is skipped (an exception unwound past finish) is
     simply garbage-collected — the pool holds no record of outstanding
-    buffers, so it can neither leak nor double-hand one out."""
+    buffers, so it can neither leak nor double-hand one out.
+
+    Occupancy accounting: acquire/release maintain an outstanding-buffer
+    count and its peak. A batch holds its staging buffers from encode
+    until its finish() materializes, so ``peak_outstanding`` exceeding
+    one batch's buffer count is direct evidence that a second batch's
+    H2D staging overlapped the first batch's device evaluation — the
+    double-buffering claim bench.py --steady gates on (stats())."""
 
     def __init__(self, max_per_key: int = 8):
         self._free: dict = {}  # (shape, dtype str) -> [ndarray]
         self._lock = threading.Lock()
         self._max_per_key = max_per_key
+        self._outstanding = 0
+        self._peak_outstanding = 0
+        self._acquires = 0
+        # acquires issued while other buffers were already out — steady
+        # state under the pipelined batcher keeps this climbing; the
+        # serial path (one batch at a time, released before the next
+        # encode) still overlaps within a batch (codes + extras), so the
+        # honest overlap signal is peak_outstanding, not this counter
+        self._overlapped_acquires = 0
 
     def acquire(self, shape, dtype) -> np.ndarray:
         key = (tuple(shape), np.dtype(dtype).str)
         with self._lock:
+            self._acquires += 1
+            if self._outstanding > 0:
+                self._overlapped_acquires += 1
+            self._outstanding += 1
+            if self._outstanding > self._peak_outstanding:
+                self._peak_outstanding = self._outstanding
             bufs = self._free.get(key)
             if bufs:
                 return bufs.pop()
@@ -211,11 +234,21 @@ class _StagingPool:
 
     def release(self, *arrays) -> None:
         with self._lock:
+            self._outstanding = max(0, self._outstanding - len(arrays))
             for a in arrays:
                 key = (a.shape, a.dtype.str)
                 bufs = self._free.setdefault(key, [])
                 if len(bufs) < self._max_per_key:
                     bufs.append(a)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "outstanding": self._outstanding,
+                "peak_outstanding": self._peak_outstanding,
+                "acquires": self._acquires,
+                "overlapped_acquires": self._overlapped_acquires,
+            }
 
 
 class _WordPacker:
@@ -559,7 +592,12 @@ class _CompiledSet:
                         ],
                         **kwargs,
                     ),
-                    jax.device_put(packed.rule_group[None, :], **kwargs),
+                    # the pallas kernel indexes groups as int32 [1, R];
+                    # upcast the (narrow int16) packed column here — the
+                    # chunked XLA planes consume it natively
+                    jax.device_put(
+                        packed.rule_group[None, :].astype(np.int32), **kwargs
+                    ),
                     jax.device_put(packed.rule_policy[None, :], **kwargs),
                 )
 
@@ -1193,6 +1231,7 @@ class TPUPolicyEngine:
             raise RuntimeError("TPUPolicyEngine.warmup: no policy set loaded")
         t0 = time.monotonic()
         tc0 = kernel_trace_count()
+        aot0 = aot.stats()
         shapes = self._warm_shape_plan(cs.packed, max_batch, extras_widths)
         for kind, b, E in shapes:
             if _shutdown.is_set() or (
@@ -1208,11 +1247,21 @@ class TPUPolicyEngine:
             set_engine_warmup_seconds(self.name, elapsed)
         except Exception:  # noqa: BLE001 — metrics must never break warm-up
             pass
-        return {
+        aot1 = aot.stats()
+        out = {
             "shapes": len(shapes),
             "seconds": round(elapsed, 3),
             "traces": kernel_trace_count() - tc0,
         }
+        if aot1["enabled"] or aot0["hits"] != aot1["hits"]:
+            # executable-cache contribution to THIS warm ladder: all-hits
+            # with traces == 0 is the warm-from-disk cold start the AOT
+            # path exists for (docs/Operations.md, tests/test_aot.py)
+            out["aot"] = {
+                k: aot1[k] - aot0[k]
+                for k in ("hits", "misses", "stale", "errors", "exports")
+            }
+        return out
 
     @property
     def compiled_set(self):
@@ -1360,6 +1409,11 @@ class TPUPolicyEngine:
     def loaded(self) -> bool:
         return self._compiled is not None
 
+    def staging_stats(self) -> dict:
+        """Staging-pool occupancy counters (overlap evidence for
+        bench.py --steady; see _StagingPool)."""
+        return self._staging.stats()
+
     @property
     def stats(self) -> dict:
         c = self._compiled
@@ -1379,6 +1433,9 @@ class TPUPolicyEngine:
             if c.plane.partition:
                 out["partition"] = c.plane.partition
                 out["pruned_policies"] = c.plane.pruned_policies
+        out["staging"] = self._staging.stats()
+        if aot.enabled():
+            out["aot"] = aot.stats()
         return out
 
     # ----------------------------------------------------------- evaluation
@@ -1720,15 +1777,20 @@ class TPUPolicyEngine:
                 from ..ops.pallas_match import pallas_supported
 
                 if pallas_supported(B, packed.L, packed.R):
-                    w, f = match_rules_codes_pallas(
-                        chunk_c,
-                        chunk_e,
-                        cs.act_rows_dev,
-                        *cs.pallas_args,
-                        packed.n_tiers,
-                        want_full,
-                        self._pallas_interpret,
-                        packed.has_gate,
+                    w, f = aot.dispatch(
+                        "pallas",
+                        match_rules_codes_pallas,
+                        (
+                            chunk_c,
+                            chunk_e,
+                            cs.act_rows_dev,
+                            *cs.pallas_args,
+                            packed.n_tiers,
+                            want_full,
+                            self._pallas_interpret,
+                            packed.has_gate,
+                        ),
+                        aot.STATICS["pallas"],
                     )
                     return w, f, None
             # shape-aware plane selection: the segmented kernel's win is
@@ -1763,11 +1825,16 @@ class TPUPolicyEngine:
                     if self._donate
                     else match_rules_codes_wire
                 )
-                out = wire_fn(
-                    c8, cw, cs.lo8_dev, chunk_e, *args,
-                    packed.n_tiers, want_full, want_bits,
-                    np.int32(m) if want_bits else None, packed.has_gate,
-                    segs,
+                out = aot.dispatch(
+                    "wire_donated" if self._donate else "wire",
+                    wire_fn,
+                    (
+                        c8, cw, cs.lo8_dev, chunk_e, *args,
+                        packed.n_tiers, want_full, want_bits,
+                        np.int32(m) if want_bits else None, packed.has_gate,
+                        segs,
+                    ),
+                    aot.STATICS["wire"],
                 )
             else:
                 from ..ops.match import match_rules_codes_donated
@@ -1777,10 +1844,15 @@ class TPUPolicyEngine:
                     if self._donate
                     else match_rules_codes
                 )
-                out = flat_fn(
-                    chunk_c, chunk_e, *args, packed.n_tiers, want_full,
-                    want_bits, np.int32(m) if want_bits else None,
-                    packed.has_gate, segs,
+                out = aot.dispatch(
+                    "codes_donated" if self._donate else "codes",
+                    flat_fn,
+                    (
+                        chunk_c, chunk_e, *args, packed.n_tiers, want_full,
+                        want_bits, np.int32(m) if want_bits else None,
+                        packed.has_gate, segs,
+                    ),
+                    aot.STATICS["codes"],
                 )
             return out if want_bits else (*out, None)
 
@@ -1935,14 +2007,19 @@ class TPUPolicyEngine:
             chunk_c, chunk_e = self._pad_to_bucket(
                 chunk_c, chunk_e, packed.L, target=CH, held=held
             )
-            return match_rules_codes_bits(
-                chunk_c,
-                chunk_e,
-                cs.act_rows_dev,
-                cs.W_dev,
-                cs.thresh_dev,
-                cs.rule_group_dev,
-                cs.rule_policy_dev,
+            return aot.dispatch(
+                "bits",
+                match_rules_codes_bits,
+                (
+                    chunk_c,
+                    chunk_e,
+                    cs.act_rows_dev,
+                    cs.W_dev,
+                    cs.thresh_dev,
+                    cs.rule_group_dev,
+                    cs.rule_policy_dev,
+                ),
+                aot.STATICS["bits"],
             )
 
         outs = []
