@@ -90,6 +90,10 @@ bench-fleet: ## Engine-fleet scaling: decisions/sec + lone p99 at 1/2/4 replicas
 bench-fanout: ## Cross-process worker tier: 1/2/4 spawned workers, scaling + zero-flip differential + cross-worker cache hit gate + barrier swap (cpu; docs/fleet.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --fanout
 
+.PHONY: bench-pod
+bench-pod: ## Multi-host pod tier: 1/2/4 simulated hosts (spawned processes, gloo CPU collectives) — capacity refused@1/served@4, zero-flip differential vs single-host oracle, owner-only dirty re-upload with zero fresh traces, data-axis scaling reported (cpu; docs/fleet.md)
+	$(PYTHON) bench.py --pod
+
 .PHONY: bench-storm
 bench-storm: ## Open-loop overload: 5x sustained storm — high-priority availability >=99.9% within budget, exact shed accounting, >=1 adaptive-tuner move, no-overload byte parity (cpu; docs/performance.md)
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --storm

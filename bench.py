@@ -1245,7 +1245,12 @@ def run_steady_scenario() -> int:
         "single_buffer_depth": off_depth,
         "pipeline_depth": DEPTH,
         "encode_workers": WORKERS,
-        "backend": "cpu-fallback" if fallback_note or on_cpu else backend,
+        # the REAL resolved backend + process world size — a "cpu-fallback"
+        # placeholder here hid which runtime actually produced the number;
+        # device_fallback preserves the never-read-as-device signal
+        "backend": backend,
+        "jax_processes": jax.process_count(),
+        "device_fallback": bool(fallback_note or on_cpu),
         **({"backend_note": fallback_note} if fallback_note else {}),
         "gates": {
             "e2e_ratio_ok": bool(ratio_ok),
@@ -2330,6 +2335,209 @@ def run_fanout_scenario() -> int:
         "cross_worker_hit_ratio": cross_ratio,
         "barrier": barrier,
         "backend": "cpu-fallback" if backend == "cpu" else backend,
+        "elapsed_s": round(time.time() - t0, 1),
+        "pass": ok,
+    }
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+def run_pod_scenario() -> int:
+    """``bench.py --pod`` (``make bench-pod``): the multi-host pod tier
+    (cedar_tpu/pod) on a SIMULATED slice — every "host" is a real spawned
+    OS process with its own jax runtime, joined by jax.distributed over
+    localhost with gloo CPU collectives and forced per-process device
+    counts. Four claims, each measured inside the pod by a
+    cedar_tpu/pod/drivers.py driver:
+
+      * policy-axis capacity scaling: a rule set sized past one host's
+        per-device budget (mesh_device_rules) is REFUSED at 1 host
+        (typed MeshCapacityError through hostmain rc 4) and SERVES at 4
+        hosts, where the policy axis is 4x wider;
+      * a zero-flip differential at 2 hosts vs a single-host oracle
+        (the same stack builder with no mesh), decisions AND reason
+        sets, over the full body stream;
+      * the one-policy CRD edit through the pod swap barrier: dirty
+        shards == 1, the H2D re-upload lands on the OWNING host only
+        (per-host placement transfer counts), ZERO fresh jit traces /
+        mesh step builds, plane tokens coherent, and a post-edit
+        differential vs the EDITED oracle with zero flips;
+      * data-axis throughput at 1/2/4 hosts (mesh shape (H, 1): batch
+        rows shard across hosts). Efficiency is REPORTED always; the
+        near-linear gate (CEDAR_BENCH_POD_SPEEDUP, default 3.0 at 4
+        hosts) is enforced only on hosts with >= 6 cores — below that
+        the processes time-share cores and the number measures the
+        scheduler, not the tier (bench-fanout's posture); the env var
+        forces a gate anywhere.
+
+    The JSON tail reports the REAL resolved backend + process count from
+    inside the pod (no hardcoded strings). rc 0 iff capacity scaling,
+    the differential, and the edit gates all hold."""
+    from cedar_tpu.pod.spawn import run_pod
+
+    t0 = time.time()
+    cores = os.cpu_count() or 1
+    TIMEOUT = 420.0
+
+    def _fail(stage: str, r) -> int:
+        result = {
+            "scenario": "pod",
+            "smoke": _SMOKE,
+            "stage": stage,
+            "error": r.error,
+            "error_type": r.error_type,
+            "returncodes": r.returncodes,
+            "log_tail": r.log_tail(0, 25),
+            "elapsed_s": round(time.time() - t0, 1),
+            "pass": False,
+        }
+        print(json.dumps(result))
+        return 1
+
+    # ---- capacity: the policy axis is the rule-capacity dial ----------
+    # n=400 synth compiles to more packed rule columns than 320/device
+    # admits over 2 devices (1 host), but fits 8 devices (4 hosts)
+    cap_n = 400
+    cap_spec = {
+        "synth": {"n": cap_n, "seed": 0, "clusters": 2},
+        "mesh_device_rules": 320,
+        "cache": 0,
+    }
+    r_cap1 = run_pod(
+        1, 2, "cedar_tpu.pod.drivers:smoke", cap_spec, timeout_s=TIMEOUT
+    )
+    refused_1host = (not r_cap1.ok) and r_cap1.error_type == "MeshCapacityError"
+    r_cap4 = run_pod(
+        4, 2, "cedar_tpu.pod.drivers:smoke", cap_spec, timeout_s=TIMEOUT
+    )
+    capacity_ok = bool(refused_1host and r_cap4.ok)
+
+    # ---- differential: 2 hosts vs the single-host oracle --------------
+    n_diff = 64 if _SMOKE else 192
+    diff_spec = {"synth": {"n": 96, "seed": 0, "clusters": 2}}
+    r_diff = run_pod(
+        2,
+        2,
+        "cedar_tpu.pod.drivers:differential",
+        diff_spec,
+        driver_args={"bodies": n_diff, "rate_bodies": 48},
+        timeout_s=TIMEOUT,
+    )
+    if not r_diff.ok:
+        return _fail("differential", r_diff)
+    diff = r_diff.result
+    diff_ok = diff["flips"] == 0 and diff["checked"] == n_diff
+
+    # ---- the cross-host one-policy edit through the barrier -----------
+    r_edit = run_pod(
+        2,
+        2,
+        "cedar_tpu.pod.drivers:edit_swap",
+        diff_spec,
+        driver_args={"warm_bodies": 24, "post_bodies": 48 if _SMOKE else 96},
+        timeout_s=TIMEOUT,
+    )
+    if not r_edit.ok:
+        return _fail("edit_swap", r_edit)
+    edit = r_edit.result
+    edit_gates = {
+        "dirty_one": edit["dirty_shards"] == 1,
+        "owner_only_reupload": len(edit["reupload_hosts"]) == 1,
+        "zero_step_builds": edit["step_builds"] == 0,
+        "zero_fresh_traces": edit["fresh_traces"] == 0,
+        "coherent": bool(edit["coherent"]),
+        "post_edit_zero_flips": edit["flips"] == 0,
+    }
+    edit_ok = all(edit_gates.values())
+
+    # ---- data-axis throughput scaling at 1/2/4 hosts -------------------
+    tp_spec = {"synth": {"n": 64, "seed": 0}, "cache": 0}
+    tp_bodies = 48 if _SMOKE else 96
+    rates: dict = {}
+    tp_failed = None
+    for h in (1, 2, 4):
+        r_tp = run_pod(
+            h,
+            1,
+            "cedar_tpu.pod.drivers:throughput",
+            tp_spec,
+            driver_args={"bodies": tp_bodies, "reps": 1},
+            mesh_shape=(h, 1),
+            timeout_s=TIMEOUT,
+        )
+        if not r_tp.ok:
+            tp_failed = {"hosts": h, "error": r_tp.error_type}
+            break
+        rates[h] = round(r_tp.result["rate"], 1)
+    speedup_4 = (
+        round(rates[4] / rates[1], 2) if 1 in rates and 4 in rates else None
+    )
+    forced = os.environ.get("CEDAR_BENCH_POD_SPEEDUP", "")
+    gate = None
+    gate_skipped = ""
+    if forced:
+        gate = float(forced)
+    elif cores >= 6:
+        gate = 3.0
+    else:
+        gate_skipped = (
+            f"host has {cores} core(s) for 4 pod processes; the rate "
+            "compares scheduler time-sharing, not tier capacity — set "
+            "CEDAR_BENCH_POD_SPEEDUP to force a gate"
+        )
+    speedup_ok = (
+        True
+        if gate is None
+        else (speedup_4 is not None and speedup_4 >= gate)
+    )
+
+    ok = bool(capacity_ok and diff_ok and edit_ok and speedup_ok)
+    result = {
+        "scenario": "pod",
+        "metric": "pod_one_logical_engine",
+        "smoke": _SMOKE,
+        # the REAL runtime from inside the pod, not a placeholder
+        "backend": diff["backend"],
+        "jax_processes": diff["process_count"],
+        "host_cores": cores,
+        "capacity": {
+            "policies": cap_n,
+            "device_rules": 320,
+            "refused_1host": refused_1host,
+            "refusal_type": r_cap1.error_type,
+            "served_4host": bool(r_cap4.ok),
+            "devices_4host": (r_cap4.result or {}).get("devices"),
+        },
+        "differential": {
+            "hosts": 2,
+            "bodies": n_diff,
+            "flips": diff["flips"],
+            "rate": round(diff["rate"], 1),
+            "collective_evals": diff["evals"],
+        },
+        "edit": {
+            "dirty_shards": edit["dirty_shards"],
+            "compile_scope": edit["compile_scope"],
+            "transfers": edit["transfers"],
+            "reupload_hosts": edit["reupload_hosts"],
+            "step_builds": edit["step_builds"],
+            "fresh_traces": edit["fresh_traces"],
+            "post_edit_flips": edit["flips"],
+            "gates": edit_gates,
+        },
+        "throughput": {
+            "rates": rates,
+            "speedup_4": speedup_4,
+            "speedup_gate": gate,
+            "speedup_gate_skipped": gate_skipped,
+            **({"failed": tp_failed} if tp_failed else {}),
+        },
+        "gates": {
+            "capacity_ok": capacity_ok,
+            "differential_ok": diff_ok,
+            "edit_ok": edit_ok,
+            "speedup_ok": speedup_ok,
+        },
         "elapsed_s": round(time.time() - t0, 1),
         "pass": ok,
     }
@@ -5854,15 +6062,28 @@ def _emit_failure_tail(scenario: str, reason: str) -> None:
     ("device link unavailable at bench start") because the failure path
     ended with a bare stderr line — the driver parses the LAST stdout
     line, so every bench entry path must put a JSON record there even
-    when it dies. The record carries "backend": "cpu-fallback" so a
-    partial number can never be read as a device measurement."""
+    when it dies. The record carries the REAL resolved backend + process
+    world size when jax is up (never a hardcoded placeholder — a tail
+    claiming "cpu-fallback" while a tpu runtime was live misattributed
+    the failure), with "pass": false carrying the can't-be-a-measurement
+    signal."""
     import sys
 
     global _TAIL_EMITTED
     _TAIL_EMITTED = True
+    backend = "uninitialized"
+    processes = 0
+    try:  # the failure may be jax itself failing to come up
+        import jax
+
+        backend = jax.default_backend()
+        processes = jax.process_count()
+    except Exception:  # noqa: BLE001 — report what we know
+        pass
     record = {
         "scenario": scenario,
-        "backend": "cpu-fallback",
+        "backend": backend,
+        "jax_processes": processes,
         "error": reason,
         "pass": False,
     }
@@ -6072,6 +6293,14 @@ if __name__ == "__main__":
 
         force_cpu()
         _scenario_exit("fanout", run_fanout_scenario)
+
+    if "--pod" in sys.argv:
+        # multi-host pod tier (make bench-pod): every pod "host" is a
+        # SPAWNED process with its own env (cpu platform, forced device
+        # count, gloo collectives) — the parent only orchestrates and
+        # never initializes its own jax runtime, so no force_cpu here;
+        # the JSON tail reports the backend the pod itself resolved.
+        _scenario_exit("pod", run_pod_scenario)
 
     if "--storm" in sys.argv:
         # open-loop overload harness (make bench-storm): cpu-only BY

@@ -1475,6 +1475,54 @@ def make_parser() -> argparse.ArgumentParser:
         "single-process deployments",
     )
 
+    pod = parser.add_argument_group("pod (multi-host one-engine tier)")
+    pod.add_argument(
+        "--pod-coordinator",
+        default=os.environ.get("CEDAR_POD_COORDINATOR", ""),
+        help="jax.distributed coordinator host:port shared by every host "
+        "of the pod (CEDAR_POD_COORDINATOR). With --pod-num-processes "
+        ">= 2 this process joins ONE logical engine spanning the slice "
+        "(cedar_tpu/pod, docs/fleet.md \"One mesh, many hosts\") — "
+        "mutually exclusive with --fleet-replicas/--fanout-workers",
+    )
+    pod.add_argument(
+        "--pod-num-processes",
+        type=int,
+        default=int(os.environ.get("CEDAR_POD_NUM_PROCESSES", "0") or 0),
+        help="total processes in the pod (CEDAR_POD_NUM_PROCESSES); "
+        "< 2 disables pod mode",
+    )
+    pod.add_argument(
+        "--pod-process-id",
+        type=int,
+        default=int(os.environ.get("CEDAR_POD_PROCESS_ID", "0") or 0),
+        help="this host's rank in the pod (CEDAR_POD_PROCESS_ID); rank 0 "
+        "leads: control server, barrier swaps, HTTP serving — other "
+        "ranks serve the collective over the control channel",
+    )
+    pod.add_argument(
+        "--pod-control",
+        default=os.environ.get("CEDAR_POD_CONTROL", ""),
+        help="leader's pod control channel host:port (CEDAR_POD_CONTROL); "
+        "empty = 127.0.0.1 on the default port — set it to the leader's "
+        "reachable address on real multi-host deployments",
+    )
+    pod.add_argument(
+        "--pod-local-devices",
+        type=int,
+        default=int(os.environ.get("CEDAR_POD_LOCAL_DEVICES", "0") or 0),
+        help="simulated local device count (CPU platform CI only: "
+        "XLA_FLAGS host_platform_device_count must ALSO be set before "
+        "jax imports); 0 = the platform's real device count",
+    )
+    pod.add_argument(
+        "--pod-mesh-shape",
+        default=os.environ.get("CEDAR_POD_MESH_SHAPE", ""),
+        help="explicit DATAxPOLICY factorization of the pod's GLOBAL "
+        "device set (e.g. 2x4); empty defaults to (devices per host, "
+        "hosts) — policy axis spans the pod, partitions host-exclusive",
+    )
+
     serving = parser.add_argument_group("secure serving")
     serving.add_argument("--bind-address", default=DEFAULT_ADDRESS)
     serving.add_argument("--secure-port", type=int, default=DEFAULT_PORT)
@@ -1946,12 +1994,172 @@ def make_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _run_pod_mode(args) -> int:
+    """Multi-host pod serving (cedar_tpu/pod): every host of the slice
+    runs THIS entry with the same --config and coordinator, its own
+    --pod-process-id. One logical engine spans the global device set;
+    rank 0 leads (control server, barrier swaps, HTTP) and the other
+    ranks serve the collective over the control channel — no HTTP, no
+    private engine state beyond their addressable plane shards. Policy
+    content resolves from each host's OWN stores; the pod swap barrier's
+    token verify is what proves they resolved identically (a stale CRD
+    cache on one host restores the whole pod and surfaces here).
+
+    Exit codes match pod/hostmain.py: 3 = distributed bring-up refused
+    (bounded, loud — a mis-wired coordinator/count/id must never hang)."""
+    from ..jaxenv import DistributedInitError
+    from ..pod.bootstrap import bootstrap
+    from ..pod.control import PodControlServer, follow
+    from ..pod.tier import PodTier, follower_handler
+    from ..pod.topology import PodConfig
+
+    if args.fleet_replicas > 1 or args.fanout_workers > 1:
+        raise ValueError(
+            "pod mode is its own scale-out layer: --pod-* is mutually "
+            "exclusive with --fleet-replicas/--fanout-workers"
+        )
+    shape = None
+    if args.pod_mesh_shape:
+        d, _, p = args.pod_mesh_shape.lower().partition("x")
+        shape = (int(d), int(p))
+    config = PodConfig(
+        coordinator=args.pod_coordinator or "127.0.0.1:7476",
+        num_processes=args.pod_num_processes,
+        process_id=args.pod_process_id,
+        control=args.pod_control,
+        local_devices=args.pod_local_devices or None,
+        mesh_shape=shape,
+    )
+    try:
+        ctx = bootstrap(config)
+    except DistributedInitError as e:
+        log.error("pod bring-up refused: %s", e)
+        return 3
+
+    from ..server.metrics import (
+        set_pod_hosts,
+        set_pod_process,
+        set_worker_label,
+    )
+
+    set_worker_label(args.worker_id or ctx.host_name())
+    set_pod_process(ctx.process_id)
+    set_pod_hosts(ctx.num_processes)
+
+    cfg = None
+    if args.config:
+        with open(args.config) as f:
+            cfg = parse_config(f.read())
+    stores = cedar_config_stores(cfg, kubeconfig_path=args.kubeconfig or None)
+
+    from ..engine.evaluator import TPUPolicyEngine
+    from ..fanout.worker import InProcessWorker
+    from ..server.authorizer import CedarWebhookAuthorizer
+
+    def tiers_factory(spec=None):
+        # swaps re-resolve from THIS host's stores (spec is the barrier's
+        # sentinel); the analysis gate rides along when the store has it
+        del spec
+        analyzed = getattr(stores, "analyzed_policy_sets", None)
+        if analyzed is not None:
+            return analyzed()
+        return [s.policy_set() for s in stores.stores]
+
+    env_rules = os.environ.get("CEDAR_TPU_MESH_DEVICE_RULES", "")
+    engine = TPUPolicyEngine(
+        name=ctx.host_name(),
+        mesh=ctx.mesh,
+        mesh_device_rules=int(env_rules) if env_rules else None,
+    )
+
+    def _eval(entities, request):
+        if not engine.loaded:
+            return stores.is_authorized(entities, request)
+        return engine.evaluate(entities, request)
+
+    def _eval_batch(items):
+        if not engine.loaded:
+            return [stores.is_authorized(em, r) for em, r in items]
+        return engine.evaluate_batch(items)
+
+    authorizer = CedarWebhookAuthorizer(
+        stores, evaluate=_eval, evaluate_batch=_eval_batch
+    )
+    worker = InProcessWorker(
+        ctx.host_name(),
+        None,
+        engine,
+        tiers_factory=tiers_factory,
+        authorizer=authorizer,
+    )
+
+    if not ctx.is_leader:
+        # connect first, THEN compile: the leader's health scan must see
+        # this host alive while its plane builds
+        def setup():
+            engine.load(tiers_factory(), warm="off")
+            return follower_handler(worker, engine)
+
+        log.info("pod follower %d serving the control loop", ctx.process_id)
+        follow(config.control_addr(), ctx.process_id, setup)
+        return 0
+
+    ctl = PodControlServer(config.control_addr())
+    try:
+        ctl.wait_joined(ctx.num_processes - 1)
+        engine.load(tiers_factory(), warm="off")
+        tier = PodTier(ctx, worker, ctl.handles)
+        ctl.start_health()
+
+        server = WebhookServer(
+            authorizer,
+            None,
+            address=args.bind_address,
+            port=args.secure_port,
+            metrics_port=args.metrics_port,
+            certfile=args.tls_cert_file or None,
+            keyfile=args.tls_private_key_file or None,
+            pod=tier,
+            max_batch=args.max_batch,
+            batch_window_s=args.batch_window_us / 1e6,
+        )
+        server.start()
+        stop = threading.Event()
+
+        def _signal(signum, frame):
+            log.info("received signal %d, shutting down", signum)
+            stop.set()
+
+        signal.signal(signal.SIGTERM, _signal)
+        signal.signal(signal.SIGINT, _signal)
+
+        last = _fingerprint(stores)
+        interval = max(1.0, float(args.tpu_reload_seconds))
+        while not stop.wait(interval):
+            cur = _fingerprint(stores)
+            if cur == last:
+                continue
+            try:
+                tier.load({"generation": cur})
+                last = cur
+                log.info("pod: barrier swap committed (%s)", cur)
+            except Exception:  # noqa: BLE001 — keep serving the prior set
+                log.exception("pod: barrier swap failed; serving previous")
+        server.stop()
+        tier.stop()
+        return 0
+    finally:
+        ctl.close()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = make_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbosity >= 5 else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    if args.pod_num_processes >= 2:
+        return _run_pod_mode(args)
     server = build_server(args)
     server.start()
 

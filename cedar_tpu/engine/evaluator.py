@@ -325,6 +325,11 @@ class _WordPacker:
         return self._host[lo : lo + m]
 
 
+def _mesh_spans_processes(mesh) -> bool:
+    """True for a pod mesh — devices owned by more than one jax process."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
 def _segment_plan(group_c: np.ndarray, n_rules: int):
     """Static per-chunk (group, start, end) column segments for the
     segmented-reduction kernel plane (ops/match.py _first_match_seg).
@@ -444,6 +449,13 @@ class _CompiledSet:
                 if plane_info
                 else {}
             )
+            if not policy_shard and _mesh_spans_processes(mesh):
+                raise RuntimeError(
+                    "a multi-process (pod) mesh needs shard lineage for "
+                    "host-aware placement: load with incremental "
+                    "compilation on (CEDAR_TPU_INCREMENTAL=1) so the "
+                    "plane carries policy_shard"
+                )
             if policy_shard:
                 # shard-partitioned placement: each (tier, bucket) shard
                 # owns a stable device partition, so an incremental
@@ -775,6 +787,15 @@ class TPUPolicyEngine:
         self._lock = threading.Lock()
         self._mesh_steps: dict = {}  # (n_tiers, has_gate) -> pjit step
         self._mesh_bits_step = None
+        # pod regime (cedar_tpu/pod): the mesh spans multiple jax
+        # processes, so step outputs replicate (each host must read the
+        # full result) and every device launch routes through self.pod —
+        # the runtime that broadcasts the batch so all hosts enter the
+        # collective together. None outside a pod; set by PodTier (leader)
+        # — followers execute broadcast launches via pod.runtime helpers
+        # and never originate their own.
+        self._mesh_multiproc = mesh is not None and _mesh_spans_processes(mesh)
+        self.pod = None
         # set once the first serving shape (b=1) of the current/previous set
         # has compiled: readiness gates on it so the first live request
         # never eats an XLA compile (latches across hot swaps — same-bucket
@@ -1402,6 +1423,7 @@ class TPUPolicyEngine:
             fn = self._mesh_steps[key] = sharded_codes_match_fn(
                 self.mesh, packed.n_tiers, packed.has_gate,
                 donate=self._mesh_donate, want_full=want_full,
+                replicated_out=self._mesh_multiproc,
             )
         return fn
 
@@ -1750,6 +1772,14 @@ class TPUPolicyEngine:
                     chunk_c, chunk_e, packed.L,
                     data_mult=cs.mesh.shape["data"], held=held,
                 )
+                if self.pod is not None:
+                    # pod regime: broadcast the padded batch so every
+                    # host enters this collective, serialized under the
+                    # pod lock so dispatch order matches fleet-wide
+                    w, full = self.pod.run_match(
+                        self, cs, chunk_c, chunk_e, want_full
+                    )
+                    return w, full, None
                 step_args = (
                     chunk_c,
                     chunk_e,
@@ -1987,7 +2017,9 @@ class TPUPolicyEngine:
         if cs.mesh is not None and self._mesh_bits_step is None:
             from ..parallel.mesh import sharded_codes_bits_fn
 
-            self._mesh_bits_step = sharded_codes_bits_fn(self.mesh)
+            self._mesh_bits_step = sharded_codes_bits_fn(
+                self.mesh, replicated_out=self._mesh_multiproc
+            )
 
         held: list = []  # pooled staging buffers, released by finish()
 
@@ -1997,6 +2029,8 @@ class TPUPolicyEngine:
                     chunk_c, chunk_e, packed.L, target=CH,
                     data_mult=cs.mesh.shape["data"], held=held,
                 )
+                if self.pod is not None:
+                    return self.pod.run_bits(self, cs, chunk_c, chunk_e)
                 return self._mesh_bits_step(
                     chunk_c,
                     chunk_e,

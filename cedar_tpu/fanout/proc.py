@@ -48,7 +48,9 @@ _DIED = "__died__"
 # --------------------------------------------------------------- worker side
 
 
-def build_worker_stack(spec: dict, worker_id: str):
+def build_worker_stack(
+    spec: dict, worker_id: str, mesh=None, mesh_device_rules=None
+):
     """Build one worker's full serving stack from a picklable spec:
 
       spec["source"]        Cedar policy source text (one tier), or
@@ -66,7 +68,13 @@ def build_worker_stack(spec: dict, worker_id: str):
     Returns an InProcessWorker (the process wrapper drives it). The
     engine is the authorizer's evaluate backend, so swaps reach the
     served answers on every path — with or without the native fast
-    path."""
+    path.
+
+    ``mesh``/``mesh_device_rules`` thread a (data, policy) device mesh
+    into the engine — the pod tier (cedar_tpu/pod) builds every host's
+    stack through here with the ONE pod-wide mesh, so a "fanout worker"
+    and a "pod host" are the same stack pointed at different device
+    sets."""
     from ..engine.evaluator import TPUPolicyEngine
     from ..lang import PolicySet
     from ..server.authorizer import CedarWebhookAuthorizer
@@ -96,7 +104,11 @@ def build_worker_stack(spec: dict, worker_id: str):
 
     tiers = tiers_from(spec)
     stores = TieredPolicyStores([MemoryStore(f"fanout-{worker_id}", tiers[0])])
-    engine = TPUPolicyEngine(name=f"fanout-{worker_id}")
+    engine = TPUPolicyEngine(
+        name=f"fanout-{worker_id}",
+        mesh=mesh,
+        mesh_device_rules=mesh_device_rules,
+    )
 
     def _eval(entities, request):
         # pre-load / post-clear guard (the CLI's _guarded twin): an

@@ -171,7 +171,7 @@ def audit_entry(
         "cached": bool(cached),
     }
     try:
-        from .trace import _process_worker_id
+        from .trace import _process_pod_id, _process_worker_id
 
         w = _process_worker_id()
         if w:
@@ -179,6 +179,11 @@ def audit_entry(
             # from N worker processes stay joinable per worker instead of
             # colliding into one anonymous stream
             entry["worker"] = w
+        p = _process_pod_id()
+        if p is not None:
+            # pod tier: the serving host's process index in the one
+            # logical engine (cedar_tpu/pod) — same joinability story
+            entry["pod_process"] = p
     except Exception:  # noqa: BLE001 — identity is best-effort context
         pass
     if tier is not None:

@@ -54,6 +54,18 @@ def _process_worker_id() -> str:
     except Exception:  # noqa: BLE001 — identity is best-effort context
         return ""
 
+
+def _process_pod_id():
+    """This process's pod process index (cedar_tpu/pod; set by PodTier /
+    the CLI --pod-process-id). None off-pod — the field is then omitted
+    entirely, like the `worker` label."""
+    try:
+        from ..server.metrics import pod_process
+
+        return pod_process()
+    except Exception:  # noqa: BLE001 — identity is best-effort context
+        return None
+
 # bounded per-span attribute set: traces are a debugging surface, not a
 # logging pipeline — unbounded attributes would turn the ring into one
 MAX_SPAN_ATTRS = 16
@@ -302,6 +314,12 @@ class Trace:
             # trace pulled from any worker's ring joins the tier-wide
             # metrics scrape and audit records instead of colliding
             doc["worker"] = w
+        p = _process_pod_id()
+        if p is not None:
+            # pod tier: which host of the one logical engine served this
+            # request (the collective ran everywhere; the REQUEST lived
+            # here) — joins cedar_pod_partition_reuploads_total{host}
+            doc["podProcess"] = p
         return doc
 
 

@@ -73,6 +73,25 @@ def make_mesh(
     return Mesh(arr, ("data", "policy"))
 
 
+def mesh_is_multiprocess(mesh: Mesh) -> bool:
+    """True when the mesh spans devices of more than one jax process —
+    the pod regime, where placement must restrict itself to addressable
+    devices and step outputs must replicate so every host can read them."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def partition_hosts(mesh: Mesh) -> Dict[int, Tuple[int, ...]]:
+    """Policy-partition → owning process indexes. The pod topology
+    (cedar_tpu/pod/topology.py) arranges the device grid so each policy
+    column lives on exactly ONE host; this map is how placement, the
+    dirty-reupload pinning, and /debug/pod all agree on who that is."""
+    devs = np.asarray(mesh.devices)
+    return {
+        p: tuple(sorted({d.process_index for d in devs[:, p].flat}))
+        for p in range(devs.shape[1])
+    }
+
+
 def shard_policy_tensors(mesh: Mesh, W, thresh, rule_group, rule_policy):
     """Place the packed policy tensors with the rule axis sharded."""
     w_s = NamedSharding(mesh, P(None, "policy"))
@@ -334,19 +353,28 @@ class PartitionedPlanes:
 
     def _assemble(self, name, blocks, global_shape, spec, prior):
         """One global array from per-partition host blocks, reusing the
-        prior placement's per-device pieces wherever the bytes match."""
+        prior placement's per-device pieces wherever the bytes match.
+
+        Multi-process meshes (the pod): each process uploads ONLY the
+        partitions that live on its own addressable devices and hands
+        jax.make_array_from_single_device_arrays its local pieces — the
+        multihost global-array idiom, no collective involved. A partition
+        owned elsewhere still gets its digest recorded (empty piece
+        tuple) so reuse bookkeeping stays uniform, but costs this host
+        zero transfers — which is exactly the per-host pinning the pod
+        dirty-swap tests gate on."""
         sharding = NamedSharding(self.mesh, spec)
         devs = np.asarray(self.mesh.devices)  # [data, policy]
+        proc = jax.process_index()
         pieces: List = []
         for p, block in enumerate(blocks):
             digest = self._digest(block)
+            local = [d for d in devs[:, p].flat if d.process_index == proc]
             held = prior._pieces.get((name, p)) if prior is not None else None
             if held is not None and held[0] == digest:
                 per_dev = held[1]
             else:
-                per_dev = tuple(
-                    self._put(block, dev) for dev in devs[:, p].flat
-                )
+                per_dev = tuple(self._put(block, dev) for dev in local)
             self._pieces[(name, p)] = (digest, per_dev)
             pieces.extend(per_dev)
         return jax.make_array_from_single_device_arrays(
@@ -355,6 +383,7 @@ class PartitionedPlanes:
 
     def _assemble_replicated(self, name, block, prior):
         digest = self._digest(block)
+        proc = jax.process_index()
         held = prior._pieces.get((name, 0)) if prior is not None else None
         if held is not None and held[0] == digest:
             per_dev = held[1]
@@ -362,6 +391,7 @@ class PartitionedPlanes:
             per_dev = tuple(
                 self._put(block, dev)
                 for dev in np.asarray(self.mesh.devices).flat
+                if dev.process_index == proc
             )
         self._pieces[(name, 0)] = (digest, per_dev)
         return jax.make_array_from_single_device_arrays(
@@ -405,6 +435,7 @@ def sharded_codes_match_fn(
     has_gate: bool = False,
     donate: bool = False,
     want_full: bool = True,
+    replicated_out: bool = False,
 ):
     """The production evaluation step, sharded: feature codes in, packed
     uint32 verdict words out. This is the step TPUPolicyEngine.match_arrays
@@ -433,7 +464,13 @@ def sharded_codes_match_fn(
     verdicts still reduce on device, but only the one packed uint32 word
     per request leaves the computation — the [B, G] first/last extrema
     never materialize as outputs, so the per-request device→host payload
-    is exactly 4 bytes however many devices the rules span."""
+    is exactly 4 bytes however many devices the rules span.
+
+    replicated_out=True (the pod regime — mesh_is_multiprocess) gathers
+    every output to all devices: on a multi-host mesh a data-sharded
+    output is only partially addressable per host, so the serving host
+    could not read the rows that landed on its peers. The extra
+    all-gather moves 4 bytes per request for the serving word."""
     global _step_builds
     _step_builds += 1
     G = n_tiers * 3 + (1 if has_gate else 0)
@@ -446,14 +483,16 @@ def sharded_codes_match_fn(
         NamedSharding(mesh, P("policy")),  # rule_group [R]
         NamedSharding(mesh, P("policy")),  # rule_policy [R]
     )
+    out_b = P() if replicated_out else P("data")
+    out_bg = P() if replicated_out else P("data", None)
     if want_full:
         out_shardings = (
-            NamedSharding(mesh, P("data")),  # packed words [B]
-            NamedSharding(mesh, P("data", None)),  # first [B, G]
-            NamedSharding(mesh, P("data", None)),  # last [B, G]
+            NamedSharding(mesh, out_b),  # packed words [B]
+            NamedSharding(mesh, out_bg),  # first [B, G]
+            NamedSharding(mesh, out_bg),  # last [B, G]
         )
     else:
-        out_shardings = NamedSharding(mesh, P("data"))  # packed words only
+        out_shardings = NamedSharding(mesh, out_b)  # packed words only
 
     @functools.partial(
         jax.jit,
@@ -496,11 +535,12 @@ def sharded_codes_match_fn(
     return step
 
 
-def sharded_codes_bits_fn(mesh: Mesh):
+def sharded_codes_bits_fn(mesh: Mesh, replicated_out: bool = False):
     """Sharded twin of ops.match.match_rules_codes_bits: per-rule
     satisfaction bitsets [B, R // 32] for diagnostic rendering. Each shard
     packs its contiguous rule range; the output sharding along the rule-word
-    axis makes the host concatenation implicit."""
+    axis makes the host concatenation implicit (replicated_out gathers it
+    everywhere instead — the pod regime, same rationale as the match step)."""
     global _step_builds
     _step_builds += 1
     from ..ops.match import _pack_sat_bits
@@ -512,7 +552,9 @@ def sharded_codes_bits_fn(mesh: Mesh):
         NamedSharding(mesh, P(None, "policy")),  # W
         NamedSharding(mesh, P("policy")),  # thresh
     )
-    out_shardings = NamedSharding(mesh, P("data", "policy"))
+    out_shardings = NamedSharding(
+        mesh, P() if replicated_out else P("data", "policy")
+    )
 
     @functools.partial(
         jax.jit, in_shardings=in_shardings, out_shardings=out_shardings

@@ -1,0 +1,63 @@
+"""Pod-scale serving: one logical policy plane across a multi-host slice.
+
+The mesh tier (parallel/mesh.py) scales across one process's devices;
+the fanout tier (cedar_tpu/fanout) scales across processes with private
+engines. This package fuses them: ``jax.distributed`` joins every host
+into one runtime, ONE (data, policy) mesh stretches over the global
+device set, and the fanout control protocol — re-homed onto sockets —
+coordinates barrier swaps, health, and the peer decision cache around
+the one shared plane. Rule capacity scales with the policy axis (a set
+that overflows one host's devices serves on four), batch throughput
+with the data axis, and a dirty-shard reload re-uploads on the owning
+host only.
+
+Testable without hardware: ``pod.spawn.run_pod`` simulates N hosts as N
+OS processes over a forced-device-count CPU mesh with gloo collectives
+(bench.py --pod, tests/test_pod.py).
+"""
+
+from .bootstrap import bootstrap, simulate_env
+from .control import PodControlServer, PodDegradedError, PodHostHandle, follow
+from .spawn import PodRunResult, free_port, run_pod
+from .tier import (
+    PodIncoherentError,
+    PodRuntime,
+    PodTier,
+    build_pod_stack,
+    follower_handler,
+    wire_pod_peers,
+)
+from .topology import (
+    PodConfig,
+    PodContext,
+    PodTopologyError,
+    arrange,
+    default_pod_shape,
+    grid_partition_hosts,
+    pod_config_from_env,
+)
+
+__all__ = [
+    "PodConfig",
+    "PodContext",
+    "PodControlServer",
+    "PodDegradedError",
+    "PodHostHandle",
+    "PodIncoherentError",
+    "PodRunResult",
+    "PodRuntime",
+    "PodTier",
+    "PodTopologyError",
+    "arrange",
+    "bootstrap",
+    "build_pod_stack",
+    "default_pod_shape",
+    "follow",
+    "follower_handler",
+    "free_port",
+    "grid_partition_hosts",
+    "pod_config_from_env",
+    "run_pod",
+    "simulate_env",
+    "wire_pod_peers",
+]

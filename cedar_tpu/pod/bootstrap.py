@@ -1,0 +1,115 @@
+"""Pod bring-up: jax.distributed across hosts, one mesh over the slice.
+
+Order matters and is why this module exists: CPU collectives (gloo) and
+``jax.distributed.initialize`` must both happen BEFORE any jax backend
+initializes, and the CI simulation additionally needs
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` in the
+environment before jax is imported at all. ``simulate_env`` builds that
+environment for spawned processes (pod/spawn.py, bench.py --pod);
+``bootstrap`` performs the in-process sequence and returns the
+PodContext every other pod component hangs off.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+from ..jaxenv import distributed_initialize
+from .topology import (
+    PodConfig,
+    PodContext,
+    arrange,
+    default_pod_shape,
+    grid_partition_hosts,
+)
+
+log = logging.getLogger(__name__)
+
+
+def simulate_env(
+    config: PodConfig, base: Optional[Dict[str, str]] = None
+) -> Dict[str, str]:
+    """The child-process environment for one simulated pod host: cpu
+    platform, forced local device count, warmup off (pod swaps are
+    collective — a per-process warm ladder would desync the fleet), and
+    the CEDAR_POD_* coordinates pod_config_from_env reads back."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CEDAR_TPU_WARM_DEFAULT"] = "off"
+    n_local = config.local_devices or 1
+    flags = env.get("XLA_FLAGS", "")
+    flags = " ".join(
+        f for f in flags.split() if "host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_local}".strip()
+    )
+    env["CEDAR_POD_COORDINATOR"] = config.coordinator
+    env["CEDAR_POD_NUM_PROCESSES"] = str(config.num_processes)
+    env["CEDAR_POD_PROCESS_ID"] = str(config.process_id)
+    env["CEDAR_POD_CONTROL"] = config.control
+    if config.local_devices:
+        env["CEDAR_POD_LOCAL_DEVICES"] = str(config.local_devices)
+    if config.mesh_shape:
+        env["CEDAR_POD_MESH_SHAPE"] = (
+            f"{config.mesh_shape[0]}x{config.mesh_shape[1]}"
+        )
+    return env
+
+
+def bootstrap(config: PodConfig) -> PodContext:
+    """Initialize jax.distributed (idempotent, loudly bounded —
+    jaxenv.distributed_initialize) and build the pod mesh over the
+    GLOBAL device set. Every process of the pod must call this with the
+    same coordinator/count/shape and its own process_id; the returned
+    mesh is identical everywhere (same sorted device order, same
+    arrangement), which is what lets one pjit program span the slice."""
+    if config.num_processes > 1:
+        distributed_initialize(
+            config.coordinator, config.num_processes, config.process_id
+        )
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    n = len(devices)
+    n_proc = jax.process_count()
+    if n_proc != config.num_processes:
+        # jax resolved a different world than the flags claim (e.g. the
+        # distributed runtime was initialized elsewhere first)
+        log.warning(
+            "pod: jax reports %d processes, config says %d — using jax's",
+            n_proc,
+            config.num_processes,
+        )
+    shape = config.mesh_shape or default_pod_shape(n, n_proc)
+    grid, exclusive = arrange(n, n_proc, shape)
+    arr = np.array([[devices[i] for i in row] for row in grid])
+    mesh = Mesh(arr, ("data", "policy"))
+    per_host = n // n_proc
+    ctx = PodContext(
+        config=config,
+        mesh=mesh,
+        num_processes=n_proc,
+        process_id=jax.process_index(),
+        local_device_count=jax.local_device_count(),
+        exclusive_axis=exclusive,
+        partition_hosts=grid_partition_hosts(grid, per_host),
+    )
+    log.info(
+        "pod host %d/%d up: mesh (data=%d, policy=%d), %s-exclusive, "
+        "%d local device(s)",
+        ctx.process_id,
+        ctx.num_processes,
+        shape[0],
+        shape[1],
+        exclusive,
+        ctx.local_device_count,
+    )
+    return ctx
+
+
+__all__ = ["bootstrap", "simulate_env"]

@@ -1,0 +1,325 @@
+"""The pod control channel: leader <-> follower coordination.
+
+The fanout tier's control story (duplex pipes speaking worker.py's
+swap/restore/commit/peer protocol) becomes the pod's coordination layer,
+re-homed onto ``multiprocessing.connection`` sockets so it spans hosts:
+
+  * each follower opens TWO authenticated connections to the leader —
+    ``ctl`` (strict request/reply for the worker protocol, plus the
+    one-way ``eval``/``bits`` broadcast stream that keeps every host's
+    collective dispatch order identical) and ``health`` (ping/pong on
+    its own socket, so liveness is observable while the main loop is
+    inside a collective);
+  * the leader-side ``PodHostHandle`` duck-types the fanout worker
+    protocol (swap/restore/commit/plane_wire/stats/peer_get/gossip_in),
+    so the barrier and the peer cache drive followers exactly like
+    fanout workers — pointed at ONE shared mesh instead of N private
+    engines;
+  * a dead host is detected by the health thread within
+    ``interval * misses`` seconds and every subsequent collective is
+    refused with PodDegradedError BEFORE entering it — bounded failure,
+    never a hang on a rendezvous nobody will join.
+
+Transport trust matches fanout's pipes: authenticated (HMAC challenge
+via the shared authkey) connections between processes of one
+deployment; records crossing are policy specs and content-addressed
+cache wire records.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from multiprocessing.connection import Client, Listener
+from typing import Callable, Dict, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+AUTHKEY = b"cedar-pod-control"
+# ops the leader streams without awaiting a reply: the collective itself
+# is the synchronization, and a per-batch round trip would serialize the
+# pipeline the persistent serving loop exists to overlap
+NOREPLY_OPS = frozenset({"eval", "bits"})
+
+
+class PodDegradedError(RuntimeError):
+    """A pod host is gone (health timeout or closed control socket); the
+    one logical engine cannot run its collective. The serving layer
+    degrades exactly like other device-path failures (interpreter
+    fallback) while the operator replaces the host."""
+
+
+class PodHostHandle:
+    """Leader-side endpoint for one follower host."""
+
+    def __init__(self, process_id: int, ctl, health):
+        self.process_id = process_id
+        self.worker_id = f"pod-{process_id}"
+        self._ctl = ctl
+        self._health = health
+        self._lock = threading.Lock()
+        self._health_lock = threading.Lock()
+        self.alive = True
+        self.health_misses = 0
+
+    # ----------------------------------------------------------- transport
+
+    def call(self, op: str, **kw):
+        """Strict request/reply on the ctl socket. Any transport error
+        marks the host dead and re-raises as PodDegradedError."""
+        msg = {"op": op, **kw}
+        with self._lock:
+            try:
+                self._ctl.send(msg)
+                reply = self._ctl.recv()
+            except (OSError, EOFError) as e:
+                self.alive = False
+                raise PodDegradedError(
+                    f"{self.worker_id} control channel lost during "
+                    f"{op!r}: {e}"
+                ) from e
+        if isinstance(reply, dict) and reply.get("error"):
+            raise RuntimeError(f"{self.worker_id} {op}: {reply['error']}")
+        return reply
+
+    def post(self, msg: dict) -> None:
+        """One-way stream send (NOREPLY_OPS). The caller holds the pod
+        runtime lock, so posts interleave with calls safely."""
+        with self._lock:
+            try:
+                self._ctl.send(msg)
+            except (OSError, EOFError) as e:
+                self.alive = False
+                raise PodDegradedError(
+                    f"{self.worker_id} control channel lost during "
+                    f"{msg.get('op')!r}: {e}"
+                ) from e
+
+    def ping(self, timeout: float = 1.0) -> bool:
+        with self._health_lock:
+            try:
+                while self._health.poll(0):  # drain late pongs
+                    self._health.recv()
+                self._health.send({"op": "ping"})
+                if self._health.poll(timeout):
+                    self._health.recv()
+                    self.health_misses = 0
+                    return True
+                self.health_misses += 1
+                return False
+            except (OSError, EOFError):
+                self.alive = False
+                return False
+
+    def close(self) -> None:
+        for c in (self._ctl, self._health):
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+
+    # ------------------------------------------------- worker protocol face
+
+    def swap(self, spec) -> dict:
+        return self.call("swap", spec=spec)
+
+    def restore(self) -> bool:
+        return bool(self.call("restore").get("ok"))
+
+    def commit(self) -> None:
+        self.call("commit")
+
+    def plane_wire(self) -> Optional[dict]:
+        return self.call("plane_wire").get("wire")
+
+    def stats(self) -> dict:
+        return self.call("stats")
+
+    def peer_get(self, key: str):
+        return self.call("peer_get", key=key).get("record")
+
+    def gossip_in(self, record: dict) -> bool:
+        return bool(self.call("gossip_in", record=record).get("ok"))
+
+    def shutdown(self) -> None:
+        try:
+            self.call("shutdown")
+        except Exception:  # noqa: BLE001 — it may already be gone
+            pass
+
+    def die(self) -> None:
+        """Chaos: ask the follower to hard-exit (host-loss injection for
+        tests/bench — the fanout kill() analogue)."""
+        try:
+            self.post({"op": "die"})
+        except PodDegradedError:
+            pass
+        self.alive = False
+
+
+class PodControlServer:
+    """The leader's side: accept both connections from every follower,
+    hand out PodHostHandles, and run the health scan."""
+
+    def __init__(self, addr: Tuple[str, int]):
+        self._listener = Listener(addr, authkey=AUTHKEY)
+        self.addr = self._listener.address
+        self.handles: Dict[int, PodHostHandle] = {}
+        self._health_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def wait_joined(self, n_followers: int, timeout_s: float = 60.0) -> None:
+        """Accept until every follower has presented both channels (or
+        raise on deadline — a mis-wired pod must fail loudly, not hang)."""
+        pending: Dict[int, dict] = {}
+        deadline = time.monotonic() + timeout_s
+        try:  # bounded accept: poke a timeout into the raw socket so a
+            # missing follower surfaces as the error below, not a hang
+            self._listener._listener._socket.settimeout(1.0)
+        except Exception:  # noqa: BLE001 — private API; deadline degrades
+            pass
+        while len(self.handles) < n_followers:
+            if time.monotonic() > deadline:
+                raise PodDegradedError(
+                    f"pod control: {len(self.handles)}/{n_followers} "
+                    f"followers joined within {timeout_s:.0f}s"
+                )
+            try:
+                conn = self._listener.accept()
+            except OSError:
+                continue  # accept timeout: re-check the deadline
+            hello = conn.recv()
+            pid = int(hello["process_id"])
+            chan = hello["channel"]
+            slot = pending.setdefault(pid, {})
+            slot[chan] = conn
+            if "ctl" in slot and "health" in slot:
+                self.handles[pid] = PodHostHandle(
+                    pid, slot["ctl"], slot["health"]
+                )
+                del pending[pid]
+
+    def start_health(self, interval_s: float = 0.3, misses: int = 3) -> None:
+        def scan():
+            while not self._stop.wait(interval_s):
+                for h in self.handles.values():
+                    if not h.alive:
+                        continue
+                    if not h.ping(timeout=interval_s * 2):
+                        if h.health_misses >= misses:
+                            h.alive = False
+                            log.error(
+                                "pod: %s failed %d health checks — dead",
+                                h.worker_id,
+                                misses,
+                            )
+
+        self._health_thread = threading.Thread(
+            target=scan, daemon=True, name="pod-health"
+        )
+        self._health_thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        for h in self.handles.values():
+            h.close()
+        try:
+            self._listener.close()
+        except Exception:  # noqa: BLE001 — teardown
+            pass
+
+
+def follow(
+    addr: Tuple[str, int],
+    process_id: int,
+    setup: Callable[[], Callable[[dict], Optional[dict]]],
+    connect_timeout_s: float = 60.0,
+) -> None:
+    """The follower's main loop: connect both channels, answer health
+    pings from a side thread, THEN run ``setup()`` to build the serving
+    stack (connect-first so the leader's health scan sees this host
+    alive while it compiles), and feed every ctl message to the handler
+    setup returned (its return value is the reply; NOREPLY_OPS get
+    none). Returns when the leader sends ``shutdown`` or the connection
+    dies."""
+    deadline = time.monotonic() + connect_timeout_s
+    last: Optional[Exception] = None
+    ctl = health = None
+    while time.monotonic() < deadline:
+        try:
+            ctl = Client(addr, authkey=AUTHKEY)
+            ctl.send({"process_id": process_id, "channel": "ctl"})
+            health = Client(addr, authkey=AUTHKEY)
+            health.send({"process_id": process_id, "channel": "health"})
+            break
+        except OSError as e:  # leader not listening yet
+            last = e
+            ctl = health = None
+            time.sleep(0.1)
+    if ctl is None or health is None:
+        raise PodDegradedError(
+            f"pod follower {process_id}: leader control at {addr} "
+            f"unreachable within {connect_timeout_s:.0f}s: {last}"
+        )
+
+    def pong_loop():
+        try:
+            while True:
+                msg = health.recv()
+                if msg.get("op") == "ping":
+                    health.send({"op": "pong"})
+        except (OSError, EOFError):
+            pass
+
+    threading.Thread(target=pong_loop, daemon=True, name="pod-pong").start()
+
+    handler = setup()
+    try:
+        while True:
+            try:
+                msg = ctl.recv()
+            except (OSError, EOFError):
+                log.warning("pod follower %d: leader gone", process_id)
+                return
+            op = msg.get("op")
+            if op == "die":
+                os._exit(1)
+            if op in NOREPLY_OPS:
+                try:
+                    handler(msg)
+                except Exception:  # noqa: BLE001 — a broadcast must not
+                    # kill the loop; the collective's own error surfaces
+                    # on every host
+                    log.exception(
+                        "pod follower %d: %s failed", process_id, op
+                    )
+                continue
+            try:
+                reply = handler(msg) or {}
+            except Exception as e:  # noqa: BLE001 — reply the error
+                log.exception("pod follower %d: %s failed", process_id, op)
+                reply = {"error": f"{type(e).__name__}: {e}"}
+            try:
+                ctl.send(reply)
+            except (OSError, EOFError):
+                return
+            if op == "shutdown":
+                return
+    finally:
+        for c in (ctl, health):
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+
+
+__all__ = [
+    "AUTHKEY",
+    "NOREPLY_OPS",
+    "PodControlServer",
+    "PodDegradedError",
+    "PodHostHandle",
+    "follow",
+]

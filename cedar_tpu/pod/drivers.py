@@ -1,0 +1,252 @@
+"""Pod leader drivers: the measurements bench.py --pod and
+tests/test_pod.py run INSIDE a spawned pod (hostmain resolves them by
+"module:function" name). Every driver returns a JSON-able dict; the
+assertions live in the harnesses, so a driver failure surfaces as data,
+not a half-dead pod.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+def _corpus(spec: dict):
+    from ..corpus.synth import synth_corpus
+
+    synth = spec["synth"]
+    c = synth_corpus(
+        int(synth["n"]),
+        int(synth.get("seed", 0)),
+        int(synth.get("clusters", 1)),
+    )
+    return c.with_edit() if synth.get("edit_probe") else c
+
+
+def _oracle(spec: dict):
+    """The single-host oracle: the SAME stack builder with NO mesh — one
+    process, one device, the plain planes."""
+    from ..fanout.proc import build_worker_stack
+
+    return build_worker_stack(
+        {**spec, "fastpath": False, "cache": 0}, "oracle"
+    )
+
+
+def _diff(worker, oracle, bodies) -> Tuple[int, int, Optional[dict]]:
+    """Zero-flip differential: decisions AND reason sets must agree."""
+    flips = 0
+    sample = None
+    for i, body in enumerate(bodies):
+        got = worker.authorize(body, f"pod-diff-{i}")
+        want = oracle.authorize(body, f"pod-diff-{i}")
+        if tuple(got) != tuple(want):
+            flips += 1
+            if sample is None:
+                sample = {"i": i, "got": list(got), "want": list(want)}
+    return flips, len(bodies), sample
+
+
+def _env_doc(tier) -> dict:
+    import jax
+
+    return {
+        "backend": jax.default_backend(),
+        "process_count": jax.process_count(),
+        "devices": len(jax.devices()),
+        "evals": tier.runtime.evals,
+    }
+
+
+def smoke(ctx, tier, worker, args) -> dict:
+    corpus = _corpus(args["spec"])
+    bodies = corpus.sar_bodies(int(args.get("bodies", 8)), seed=3)
+    answers = [list(worker.authorize(b)) for b in bodies]
+    return {**_env_doc(tier), "answers": answers, "status": tier.status()}
+
+
+def differential(ctx, tier, worker, args) -> dict:
+    """Serve through the pod engine and through a single-host oracle in
+    the same process; count flips (decisions + reason sets), measure the
+    pod serving rate, and report follower peer-cache replication."""
+    corpus = _corpus(args["spec"])
+    n = int(args.get("bodies", 192))
+    bodies = corpus.sar_bodies(n, seed=11)
+    oracle = _oracle(args["spec"])
+    flips, checked, sample = _diff(worker, oracle, bodies)
+
+    pool = corpus.sar_bodies(int(args.get("rate_bodies", 128)), seed=12)
+    t0 = time.perf_counter()
+    for i, b in enumerate(pool):
+        worker.authorize(b, f"pod-rate-{i}")
+    dt = time.perf_counter() - t0
+    follower_stats: Dict[str, dict] = {}
+    for pid in sorted(tier.handles):
+        h = tier.handles[pid]
+        if h.alive:
+            try:
+                follower_stats[h.worker_id] = h.stats()
+            except Exception:  # noqa: BLE001 — stats are best-effort
+                pass
+    return {
+        **_env_doc(tier),
+        "flips": flips,
+        "checked": checked,
+        "mismatch_sample": sample,
+        "rate": len(pool) / dt if dt > 0 else 0.0,
+        "rate_bodies": len(pool),
+        "follower_stats": follower_stats,
+        "status": tier.status(),
+    }
+
+
+def edit_swap(ctx, tier, worker, args) -> dict:
+    """The cross-host one-policy edit: barrier-swap the edit_probe
+    corpus, pin per-host placement transfers (owner only), zero fresh
+    step builds/traces, and a post-edit differential vs the EDITED
+    single-host oracle."""
+    from ..ops.match import kernel_trace_count
+    from ..parallel.mesh import mesh_step_build_count
+
+    spec = args["spec"]
+    corpus = _corpus(spec)
+    warm = corpus.sar_bodies(int(args.get("warm_bodies", 48)), seed=21)
+    for i, b in enumerate(warm):
+        worker.authorize(b, f"pod-warm-{i}")
+
+    edit_spec = {**spec, "synth": {**spec["synth"], "edit_probe": True}}
+    sb0 = mesh_step_build_count()
+    tc0 = kernel_trace_count()
+    jit0 = _mesh_jit_entries(worker.engine)
+    stats = tier.load(edit_spec)
+    transfers = dict(tier.last_swap_transfers)
+    # serve through the swapped plane BEFORE the trace snapshot: the
+    # no-retrace claim covers the edit AND the first post-edit batches
+    edited = _corpus(edit_spec)
+    post = edited.sar_bodies(int(args.get("post_bodies", 96)), seed=22)
+    for i, b in enumerate(post[:8]):
+        worker.authorize(b, f"pod-postwarm-{i}")
+    # snapshot before the oracle builds: its (non-mesh) engine compiles
+    # kernels of its own and the trace counters are process-global
+    step_builds = mesh_step_build_count() - sb0
+    fresh_traces = kernel_trace_count() - tc0
+    jit1 = _mesh_jit_entries(worker.engine)
+
+    oracle = _oracle(edit_spec)
+    flips, checked, sample = _diff(worker, oracle, post)
+    owners = sorted(h for h, n in transfers.items() if n > 0)
+    return {
+        **_env_doc(tier),
+        "dirty_shards": stats.get("dirty_shards"),
+        "compile_scope": stats.get("compile_scope"),
+        "transfers": transfers,
+        "reupload_hosts": owners,
+        "step_builds": step_builds,
+        "fresh_traces": fresh_traces,
+        "mesh_jit_entries_delta": (
+            None if jit0 is None or jit1 is None else jit1 - jit0
+        ),
+        "coherent": tier.plane_coherent(),
+        "flips": flips,
+        "checked": checked,
+        "mismatch_sample": sample,
+        "status": tier.status(),
+    }
+
+
+def _mesh_jit_entries(engine) -> Optional[int]:
+    """Best-effort pjit cache entry count across the engine's mesh steps
+    — a zero delta across the edit pins 'no retrace' beyond the step
+    factory counter. None when jax's private surface moved."""
+    total = 0
+    try:
+        for fn in engine._mesh_steps.values():
+            total += fn._cache_size()
+    except Exception:  # noqa: BLE001 — private API
+        return None
+    return total
+
+
+def throughput(ctx, tier, worker, args) -> dict:
+    """Data-axis serving rate: bodies stream through the pod engine
+    (batch rows shard across hosts). The harness compares rates across
+    host counts for the near-linear gate."""
+    corpus = _corpus(args["spec"])
+    n = int(args.get("bodies", 256))
+    bodies = corpus.sar_bodies(n, seed=31)
+    for i, b in enumerate(bodies[:16]):  # warm the serving shape
+        worker.authorize(b, f"pod-tw-{i}")
+    t0 = time.perf_counter()
+    reps = int(args.get("reps", 2))
+    for r in range(reps):
+        for i, b in enumerate(bodies):
+            worker.authorize(b, f"pod-tp-{r}-{i}")
+    dt = time.perf_counter() - t0
+    return {
+        **_env_doc(tier),
+        "served": reps * len(bodies),
+        "rate": (reps * len(bodies)) / dt if dt > 0 else 0.0,
+    }
+
+
+def host_death(ctx, tier, worker, args) -> dict:
+    """Kill one follower (chaos die op) and measure how long until the
+    pod runtime refuses collectives with the typed, bounded
+    PodDegradedError — the 'never hang on a dead rendezvous' property.
+    Also records that the serving surface still answers (the engine
+    path degrades like any device failure)."""
+    from .control import PodDegradedError
+
+    corpus = _corpus(args["spec"])
+    bodies = corpus.sar_bodies(8, seed=41)
+    for i, b in enumerate(bodies):
+        worker.authorize(b, f"pod-pre-{i}")
+
+    victim_pid = sorted(tier.handles)[0]
+    victim = tier.handles[victim_pid]
+    t0 = time.perf_counter()
+    # post the raw chaos op instead of handle.die(): die() marks the
+    # handle dead locally, which would make this measurement read our
+    # own flag — the point is that the HEALTH SCAN notices the silence
+    victim.post({"op": "die"})
+    detected: Optional[float] = None
+    deadline = t0 + float(args.get("detect_budget_s", 10.0))
+    while time.perf_counter() < deadline:
+        try:
+            tier.runtime.check_alive()
+        except PodDegradedError:
+            detected = time.perf_counter() - t0
+            break
+        time.sleep(0.05)
+    refused = False
+    try:
+        tier.runtime.check_alive()
+    except PodDegradedError:
+        refused = True
+    # the HTTP surface must still answer (degraded, never hung)
+    t1 = time.perf_counter()
+    try:
+        post = list(worker.authorize(bodies[0], "pod-post-death"))
+        post_err = None
+    except Exception as e:  # noqa: BLE001 — recorded, not asserted
+        post = None
+        post_err = f"{type(e).__name__}: {e}"
+    return {
+        **_env_doc(tier),
+        "victim": victim.worker_id,
+        "detected_s": detected,
+        "refused": refused,
+        "post_death_answer": post,
+        "post_death_error": post_err,
+        "post_death_latency_s": time.perf_counter() - t1,
+        "status": tier.status(),
+    }
+
+
+__all__ = [
+    "differential",
+    "edit_swap",
+    "host_death",
+    "smoke",
+    "throughput",
+]

@@ -1,0 +1,145 @@
+"""One pod host's entry point: ``python -m cedar_tpu.pod.hostmain``.
+
+Reads its coordinates from CEDAR_POD_* (bootstrap.simulate_env wrote
+them; production systemd units can set the same), brings the pod up,
+and becomes leader (rank 0: control server, PodTier, driver) or
+follower (serve the control loop until shutdown). Exit codes are the
+supervision contract:
+
+  0  clean run (driver finished / leader said shutdown)
+  3  distributed bring-up refused (DistributedInitError — mis-wired
+     coordinator/count/id; bounded by CEDAR_POD_INIT_TIMEOUT_S)
+  4  stack build refused (e.g. MeshCapacityError: the rule set does not
+     fit this slice — the capacity-scaling bench gates on this)
+  5  driver failed
+
+The leader also writes CEDAR_POD_RESULT_FILE ({"ok": ..}) so harnesses
+get structured errors, not just exit codes.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import os
+import sys
+
+
+def _write_result(doc: dict) -> None:
+    path = os.environ.get("CEDAR_POD_RESULT_FILE", "")
+    if not path:
+        return
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+    except OSError:
+        logging.getLogger(__name__).exception("pod result write failed")
+
+
+def _resolve_driver(name: str):
+    mod_name, _, fn_name = name.partition(":")
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, fn_name)
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    log = logging.getLogger("cedar_tpu.pod.hostmain")
+    from .topology import pod_config_from_env
+
+    config = pod_config_from_env(os.environ)
+    if config is None:
+        print("hostmain: no CEDAR_POD_* configuration", file=sys.stderr)
+        return 2
+    args_doc = {"spec": {"synth": {"n": 64, "seed": 0}}, "driver_args": {}}
+    args_path = os.environ.get("CEDAR_POD_ARGS_FILE", "")
+    if args_path:
+        with open(args_path, encoding="utf-8") as f:
+            args_doc = json.load(f)
+    spec = args_doc["spec"]
+
+    from ..jaxenv import DistributedInitError
+    from .bootstrap import bootstrap
+
+    try:
+        ctx = bootstrap(config)
+    except DistributedInitError as e:
+        log.error("pod bring-up refused: %s", e)
+        if config.is_leader:
+            _write_result(
+                {"ok": False, "error": str(e), "error_type": "DistributedInitError"}
+            )
+        return 3
+
+    from .control import PodControlServer, follow
+    from .tier import PodTier, build_pod_stack, follower_handler, wire_pod_peers
+
+    if not ctx.is_leader:
+        # connect FIRST (health pongs must flow while the stack compiles),
+        # then build inside the serve loop's setup
+        def setup():
+            worker = build_pod_stack(spec, ctx)
+            return follower_handler(worker, worker.engine)
+
+        follow(config.control_addr(), ctx.process_id, setup)
+        return 0
+
+    server = PodControlServer(config.control_addr())
+    try:
+        server.wait_joined(ctx.num_processes - 1)
+        try:
+            worker = build_pod_stack(spec, ctx)
+        except Exception as e:  # noqa: BLE001 — typed refusal for harnesses
+            log.error("pod stack build refused: %s", e)
+            _write_result(
+                {
+                    "ok": False,
+                    "error": str(e),
+                    "error_type": type(e).__name__,
+                }
+            )
+            return 4
+        tier = PodTier(ctx, worker, server.handles)
+        server.start_health()
+        wire_pod_peers(tier, worker.cache)
+        driver_name = os.environ.get(
+            "CEDAR_POD_DRIVER", "cedar_tpu.pod.drivers:smoke"
+        )
+        try:
+            driver = _resolve_driver(driver_name)
+            result = driver(
+                ctx, tier, worker, {"spec": spec, **args_doc["driver_args"]}
+            )
+        except Exception as e:  # noqa: BLE001 — structured driver failure
+            log.exception("pod driver %s failed", driver_name)
+            _write_result(
+                {
+                    "ok": False,
+                    "error": f"{type(e).__name__}: {e}",
+                    "error_type": type(e).__name__,
+                }
+            )
+            return 5
+        _write_result({"ok": True, "result": result})
+        tier.stop()
+        if any(not h.alive for h in server.handles.values()):
+            # a host died mid-run (chaos or real): jax.distributed's
+            # atexit barrier would block on the missing peer for its
+            # full timeout and abort — the result is already on disk,
+            # so skip interpreter teardown
+            log.warning("pod leader: dead host(s) — hard exit")
+            server.close()
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(0)
+        return 0
+    finally:
+        server.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""Spawn a simulated pod: N real OS processes, one CPU mesh, no hardware.
+
+``run_pod`` launches ``num_hosts`` fresh interpreters running
+``python -m cedar_tpu.pod.hostmain``, each with the environment
+bootstrap.simulate_env builds — cpu platform, forced local device
+count, gloo collectives, CEDAR_POD_* coordinates. Rank 0 becomes the
+leader (control server + PodTier + the named driver function); ranks
+1..N-1 become followers. The driver's JSON-able return value comes back
+through a result file; stdout/stderr land in per-rank logs for
+post-mortems. Fresh interpreters (not multiprocessing workers) because
+the pod env must exist BEFORE jax imports and the parent usually has a
+live jax runtime of its own (bench.py, pytest).
+
+This is the CI/bench harness the ISSUE's "testable without hardware"
+story rests on; production hosts run the same hostmain logic through
+``cedar-webhook --pod-*`` instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .bootstrap import simulate_env
+from .topology import PodConfig
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class PodRunResult:
+    ok: bool
+    result: Optional[dict]
+    error: Optional[str]
+    error_type: Optional[str]
+    returncodes: List[int]
+    elapsed_s: float
+    logs: Dict[int, str] = field(default_factory=dict)
+
+    def log_tail(self, rank: int, lines: int = 40) -> str:
+        text = self.logs.get(rank, "")
+        return "\n".join(text.splitlines()[-lines:])
+
+
+def run_pod(
+    num_hosts: int,
+    local_devices: int,
+    driver: str,
+    spec: dict,
+    driver_args: Optional[dict] = None,
+    mesh_shape: Optional[Tuple[int, int]] = None,
+    timeout_s: float = 600.0,
+    env_extra: Optional[Dict[str, str]] = None,
+) -> PodRunResult:
+    """Run ``driver`` ("module:function") on a fresh simulated pod.
+    ``spec`` is the worker-stack spec every host builds from (fanout
+    build_worker_stack's picklable form — synth corpus or source text).
+    Always reaps every child; on timeout the run fails with the leader's
+    log tail in ``error``."""
+    t0 = time.monotonic()
+    coordinator = f"127.0.0.1:{free_port()}"
+    control = f"127.0.0.1:{free_port()}"
+    tmp = tempfile.mkdtemp(prefix="cedar-pod-")
+    result_path = os.path.join(tmp, "result.json")
+    args_path = os.path.join(tmp, "args.json")
+    with open(args_path, "w", encoding="utf-8") as f:
+        json.dump({"spec": spec, "driver_args": driver_args or {}}, f)
+
+    procs: List[subprocess.Popen] = []
+    log_paths: Dict[int, str] = {}
+    for rank in range(num_hosts):
+        cfg = PodConfig(
+            coordinator=coordinator,
+            num_processes=num_hosts,
+            process_id=rank,
+            control=control,
+            local_devices=local_devices,
+            mesh_shape=mesh_shape,
+        )
+        env = simulate_env(cfg)
+        env["CEDAR_POD_DRIVER"] = driver
+        env["CEDAR_POD_ARGS_FILE"] = args_path
+        env["CEDAR_POD_RESULT_FILE"] = result_path
+        env.setdefault("CEDAR_POD_INIT_TIMEOUT_S", "60")
+        env.update(env_extra or {})
+        log_path = os.path.join(tmp, f"host-{rank}.log")
+        log_paths[rank] = log_path
+        logf = open(log_path, "w", encoding="utf-8")
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "cedar_tpu.pod.hostmain"],
+                env=env,
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+            )
+        )
+        logf.close()
+
+    deadline = time.monotonic() + timeout_s
+    timed_out = False
+    for p in procs:
+        left = deadline - time.monotonic()
+        try:
+            p.wait(timeout=max(0.1, left))
+        except subprocess.TimeoutExpired:
+            timed_out = True
+    if timed_out:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+    logs: Dict[int, str] = {}
+    for rank, path in log_paths.items():
+        try:
+            with open(path, encoding="utf-8") as f:
+                logs[rank] = f.read()
+        except OSError:
+            logs[rank] = ""
+    rcs = [p.returncode if p.returncode is not None else -9 for p in procs]
+    elapsed = time.monotonic() - t0
+
+    payload: Optional[dict] = None
+    if os.path.exists(result_path):
+        try:
+            with open(result_path, encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            payload = None
+    if timed_out:
+        tail = "\n".join(logs.get(0, "").splitlines()[-40:])
+        return PodRunResult(
+            False, None, f"pod run timed out after {timeout_s:.0f}s\n{tail}",
+            "Timeout", rcs, elapsed, logs,
+        )
+    if payload is None:
+        tail = "\n".join(logs.get(0, "").splitlines()[-40:])
+        return PodRunResult(
+            False, None, f"pod leader produced no result (rc={rcs})\n{tail}",
+            "NoResult", rcs, elapsed, logs,
+        )
+    if not payload.get("ok"):
+        return PodRunResult(
+            False,
+            None,
+            payload.get("error"),
+            payload.get("error_type"),
+            rcs,
+            elapsed,
+            logs,
+        )
+    return PodRunResult(
+        True, payload.get("result"), None, None, rcs, elapsed, logs
+    )
+
+
+__all__ = ["PodRunResult", "free_port", "run_pod"]

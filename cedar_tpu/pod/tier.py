@@ -1,0 +1,468 @@
+"""PodTier: the fused mesh+fanout serving tier — one engine, many hosts.
+
+The mesh tier (parallel/mesh.py) shards planes across one process's
+devices; the fanout tier (cedar_tpu/fanout) spans processes but gives
+each worker a private engine. This module fuses them: ONE logical
+TPUPolicyEngine whose (data, policy) mesh stretches over every host's
+devices, coordinated over the pod control channel (control.py).
+
+  * **Collective serving.** The leader's engine carries a PodRuntime in
+    ``engine.pod``; every mesh launch routes through it — broadcast the
+    padded batch to the followers, then enter the pjit step, all under
+    one lock so the dispatch order is identical fleet-wide (SPMD's one
+    rule). Followers execute the same step from the broadcast; outputs
+    replicate (parallel/mesh.py replicated_out) so the leader reads the
+    full result.
+  * **Two-phase VERIFIED barrier.** ``load()`` swaps every host
+    (retaining priors), then compares the content-derived plane wire
+    tokens BEFORE committing: on a pod, incoherent content is not a
+    cosmetic drift — different bytes entering one collective produce
+    garbage — so a token split restores the whole pod and raises where
+    the fanout tier merely logged. Placement is local-only H2D
+    (PartitionedPlanes filters to addressable devices), so swaps are
+    collective-free and per-host transfer deltas pin "a one-policy edit
+    re-uploads on the owning host ONLY".
+  * **One peer cache surface.** The leader's PeerBackedCache gossips to
+    follower caches through the same handles (they duck-type the fanout
+    worker protocol), with validation against the ONE shared plane's
+    wire state — a leader restart re-warms from followers that never
+    served a request themselves.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+from ..chaos.registry import chaos_fire
+from .control import PodDegradedError, PodHostHandle
+from .topology import PodContext
+
+log = logging.getLogger(__name__)
+
+
+class PodIncoherentError(RuntimeError):
+    """Post-swap plane wire tokens disagree across hosts: the same spec
+    compiled to different content somewhere. The barrier restored every
+    host to the prior set — one collective must never mix planes."""
+
+
+def _metric(fn_name: str, *args) -> None:
+    try:
+        from ..server import metrics
+
+        getattr(metrics, fn_name)(*args)
+    except Exception:  # noqa: BLE001 — metrics never break the pod
+        pass
+
+
+# ----------------------------------------------------- collective execution
+
+
+def _globalize(mesh, codes, extras):
+    """Host-local numpy batch -> global device arrays sharded over the
+    data axis. Every pod process holds the SAME full batch (the leader
+    broadcast it), so each builds just its addressable shards — the
+    multihost input idiom (a raw numpy arg would need non-addressable
+    placement and throw)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("data", None))
+    # own the bytes: the engine's staging pool recycles batch buffers
+    # after finish(), and on the cpu backend device_put may alias numpy
+    codes = np.array(codes, copy=True)
+    extras = np.array(extras, copy=True)
+    gc = jax.make_array_from_callback(codes.shape, sh, lambda i: codes[i])
+    ge = jax.make_array_from_callback(extras.shape, sh, lambda i: extras[i])
+    return gc, ge
+
+
+def collective_match(engine, codes, extras, want_full: bool):
+    """The one match-step entry every pod process shares: leader (via
+    PodRuntime.run_match) and followers (via the broadcast handler) call
+    THIS, so the jit program and argument shapes cannot drift between
+    hosts."""
+    cs = engine.compiled_set
+    if cs is None:
+        raise RuntimeError("pod: no policy set loaded for collective")
+    gc, ge = _globalize(engine.mesh, codes, extras)
+    step = engine._mesh_step(cs.packed, want_full)
+    return step(
+        gc,
+        ge,
+        cs.act_rows_dev,
+        cs.W_dev,
+        cs.thresh_dev,
+        cs.rule_group_dev,
+        cs.rule_policy_dev,
+    )
+
+
+def collective_bits(engine, codes, extras):
+    cs = engine.compiled_set
+    if cs is None:
+        raise RuntimeError("pod: no policy set loaded for collective")
+    if engine._mesh_bits_step is None:
+        from ..parallel.mesh import sharded_codes_bits_fn
+
+        engine._mesh_bits_step = sharded_codes_bits_fn(
+            engine.mesh, replicated_out=engine._mesh_multiproc
+        )
+    gc, ge = _globalize(engine.mesh, codes, extras)
+    return engine._mesh_bits_step(
+        gc, ge, cs.act_rows_dev, cs.W_dev, cs.thresh_dev
+    )
+
+
+class PodRuntime:
+    """The leader-side collective gate, installed as ``engine.pod``.
+    Serializes broadcast + dispatch so every host's device queue sees
+    the identical op sequence, and refuses (bounded, typed) the moment
+    any host is known dead — never entering a rendezvous that cannot
+    complete."""
+
+    def __init__(self, handles: Dict[int, PodHostHandle]):
+        self.handles = handles
+        self.lock = threading.RLock()
+        self.evals = 0
+
+    def check_alive(self) -> None:
+        dead = [h.worker_id for h in self.handles.values() if not h.alive]
+        if dead:
+            raise PodDegradedError(
+                f"pod degraded: {', '.join(sorted(dead))} down"
+            )
+
+    def _broadcast(self, msg: dict) -> None:
+        self.check_alive()
+        for h in self.handles.values():
+            h.post(msg)
+
+    def run_match(self, engine, cs, codes, extras, want_full: bool):
+        del cs  # the shared entry re-reads the live compiled set
+        with self.lock:
+            self._broadcast(
+                {
+                    "op": "eval",
+                    "codes": codes,
+                    "extras": extras,
+                    "want_full": bool(want_full),
+                }
+            )
+            out = collective_match(engine, codes, extras, want_full)
+            self.evals += 1
+        if want_full:
+            w, first, last = out
+            return w, (first, last)
+        return out, None
+
+    def run_bits(self, engine, cs, codes, extras):
+        del cs
+        with self.lock:
+            self._broadcast({"op": "bits", "codes": codes, "extras": extras})
+            out = collective_bits(engine, codes, extras)
+            self.evals += 1
+        return out
+
+
+# -------------------------------------------------------------- the tier
+
+
+class PodTier:
+    """Leader-side coordination over one pod (see module docstring).
+    Duck-types the reloader/promotion target exactly like
+    FanoutFrontend: ``load(spec)``/``promote(spec)`` drive the verified
+    barrier; ``status()`` is the /debug/pod document."""
+
+    def __init__(
+        self,
+        ctx: PodContext,
+        leader_worker,
+        handles: Dict[int, PodHostHandle],
+        name: str = "pod",
+    ):
+        self.ctx = ctx
+        self.name = name
+        self.leader = leader_worker  # InProcessWorker over the pod engine
+        self.handles = handles
+        self.engine = leader_worker.engine
+        self.runtime = PodRuntime(handles)
+        self.engine.pod = self.runtime if handles else None
+        self._swap_epoch = 0
+        self.last_swap_transfers: Dict[str, int] = {}
+        _metric("set_pod_hosts", ctx.num_processes)
+        _metric("set_pod_process", ctx.process_id)
+
+    # ------------------------------------------------------------- barrier
+
+    def _all_workers(self):
+        # followers first: a follower failure must not disturb the
+        # leader's serving set; the leader swaps last
+        return [
+            *(self.handles[p] for p in sorted(self.handles)),
+            self.leader,
+        ]
+
+    def _leader_swap(self, spec) -> dict:
+        from ..parallel.mesh import placement_transfer_count
+
+        before = placement_transfer_count()
+        stats = dict(self.leader.swap(spec))
+        stats["placement_transfers"] = placement_transfer_count() - before
+        return stats
+
+    def load(self, spec, warm: str = "default") -> dict:
+        """The pod swap barrier: swap every host (priors retained),
+        VERIFY the plane wire tokens agree, then commit — or restore the
+        whole pod and raise. Collective-free throughout (placement is
+        local H2D per host), so it runs under the runtime lock without
+        deadlocking in-flight evals."""
+        del warm  # pod hosts always swap warm="off" (collective warmth
+        # would need fleet-wide broadcast; first post-swap batch compiles
+        # in parallel on every host instead)
+        with self.runtime.lock:
+            swapped = []
+            stats: dict = {}
+            transfers: Dict[str, int] = {}
+            try:
+                for w in self._all_workers():
+                    chaos_fire("pod.swap", w.worker_id)
+                    if w is self.leader:
+                        stats = self._leader_swap(spec)
+                    else:
+                        stats = dict(w.swap(spec))
+                    transfers[w.worker_id] = int(
+                        stats.get("placement_transfers", 0)
+                    )
+                    swapped.append(w)
+                tokens = {
+                    w.worker_id: (w.plane_wire() or {}).get("token")
+                    for w in swapped
+                }
+                if len(set(tokens.values())) > 1:
+                    raise PodIncoherentError(
+                        f"pod {self.name}: swap produced split plane "
+                        f"content: {tokens}"
+                    )
+            except BaseException as e:
+                for w in reversed(swapped):
+                    try:
+                        w.restore()
+                    except Exception:  # noqa: BLE001 — restore the rest
+                        log.exception(
+                            "pod %s: restore of %s after failed swap "
+                            "ALSO failed",
+                            self.name,
+                            w.worker_id,
+                        )
+                log.error(
+                    "pod %s: barrier swap failed/incoherent after %d "
+                    "host(s); restored: %s",
+                    self.name,
+                    len(swapped),
+                    e,
+                )
+                raise
+            for w in swapped:
+                try:
+                    w.commit()
+                except Exception:  # noqa: BLE001 — commit is cleanup
+                    log.exception(
+                        "pod %s: commit on %s failed (serving state is "
+                        "already uniform)",
+                        self.name,
+                        w.worker_id,
+                    )
+            self._swap_epoch += 1
+            self.last_swap_transfers = transfers
+            for host, n in transfers.items():
+                if n > 0:
+                    _metric("record_pod_reupload", host, n)
+        return stats
+
+    promote = load  # rollout promotion is the same barrier over a new spec
+
+    # ------------------------------------------------------------- surface
+
+    def plane_coherent(self) -> bool:
+        try:
+            tokens = set()
+            for w in self._all_workers():
+                wire = w.plane_wire()
+                tokens.add(wire.get("token") if wire else None)
+            return len(tokens) == 1
+        except Exception:  # noqa: BLE001 — a dead host is incoherent
+            return False
+
+    def warm_ready(self) -> bool:
+        return self.engine.warm_ready()
+
+    def status(self) -> dict:
+        """/debug/pod: per-host health, owned partitions, plane content
+        tokens, and the coherence verdict."""
+        from ..cache.generation import plane_wire_state
+
+        leader_wire = plane_wire_state(self.engine)
+        hosts = [
+            {
+                "host": self.ctx.host_name(self.ctx.process_id),
+                "leader": True,
+                "alive": True,
+                "plane_token": leader_wire.get("token") if leader_wire else None,
+                "evals": self.runtime.evals,
+                "transfers": self.last_swap_transfers.get("pod-0"),
+            }
+        ]
+        for pid in sorted(self.handles):
+            h = self.handles[pid]
+            doc = {
+                "host": h.worker_id,
+                "leader": False,
+                "alive": h.alive,
+                "plane_token": None,
+                "transfers": self.last_swap_transfers.get(h.worker_id),
+            }
+            if h.alive:
+                try:
+                    wire = h.plane_wire()
+                    doc["plane_token"] = wire.get("token") if wire else None
+                except Exception:  # noqa: BLE001 — status is best-effort
+                    doc["alive"] = h.alive  # call() marked it dead
+            hosts.append(doc)
+        partitions: Dict[str, dict] = {}
+        cs = self.engine.compiled_set
+        planes = getattr(cs, "_mesh_planes", None) if cs is not None else None
+        shard_counts: Dict[int, int] = {}
+        if planes is not None:
+            for _sid, p in planes.shard_partition_map.items():
+                shard_counts[p] = shard_counts.get(p, 0) + 1
+        for p, owners in sorted(self.ctx.partition_hosts.items()):
+            partitions[str(p)] = {
+                "hosts": [self.ctx.host_name(o) for o in owners],
+                "shards": shard_counts.get(p, 0),
+            }
+        mesh_shape = dict(self.engine.mesh.shape) if self.engine.mesh else {}
+        return {
+            "name": self.name,
+            "processes": self.ctx.num_processes,
+            "process_id": self.ctx.process_id,
+            "mesh": mesh_shape,
+            "exclusive_axis": self.ctx.exclusive_axis,
+            "hosts": hosts,
+            "partitions": partitions,
+            "coherent": len(
+                {h["plane_token"] for h in hosts if h["alive"]}
+            ) <= 1,
+            "swap_epoch": self._swap_epoch,
+            "last_swap_transfers": dict(self.last_swap_transfers),
+        }
+
+    def stop(self) -> None:
+        self.engine.pod = None
+        for h in self.handles.values():
+            h.shutdown()
+
+
+# -------------------------------------------------------- follower plumbing
+
+
+def follower_handler(worker, engine):
+    """The follower's control-message dispatcher (control.follow feeds
+    it). Broadcast ops run the collective; everything else is the fanout
+    worker protocol served by the InProcessWorker face."""
+    from ..parallel.mesh import placement_transfer_count
+
+    def handle(msg: dict) -> Optional[dict]:
+        op = msg.get("op")
+        if op == "eval":
+            collective_match(
+                engine, msg["codes"], msg["extras"], msg["want_full"]
+            )
+            return None
+        if op == "bits":
+            collective_bits(engine, msg["codes"], msg["extras"])
+            return None
+        if op == "swap":
+            before = placement_transfer_count()
+            stats = dict(worker.swap(msg["spec"]))
+            stats["placement_transfers"] = (
+                placement_transfer_count() - before
+            )
+            return stats
+        if op == "restore":
+            return {"ok": worker.restore()}
+        if op == "commit":
+            worker.commit()
+            return {"ok": True}
+        if op == "plane_wire":
+            return {"wire": worker.plane_wire()}
+        if op == "stats":
+            doc = worker.stats()
+            doc["placement_transfers_total"] = placement_transfer_count()
+            return doc
+        if op == "peer_get":
+            return {"record": worker.peer_get(msg["key"])}
+        if op == "gossip_in":
+            return {"ok": worker.gossip_in(msg["record"])}
+        if op == "shutdown":
+            return {"ok": True}
+        return {"error": f"unknown pod op {op!r}"}
+
+    return handle
+
+
+def build_pod_stack(spec: dict, ctx: PodContext):
+    """One pod host's serving stack: the fanout worker builder with the
+    POD mesh threaded into the engine — identical spec resolution on
+    every host, so the barrier's token verify has real teeth. Returns
+    the InProcessWorker face (leader keeps its server for HTTP serving;
+    followers only ever use the control surface)."""
+    import os
+
+    from ..fanout.proc import build_worker_stack
+
+    device_rules = spec.get("mesh_device_rules")
+    if device_rules is None:
+        env = os.environ.get("CEDAR_TPU_MESH_DEVICE_RULES", "")
+        device_rules = int(env) if env else None
+    wspec = dict(spec)
+    if not ctx.is_leader:
+        # followers never serve HTTP: skip the native fast path and its
+        # batcher threads, keep engine + cache (peer ops need it)
+        wspec["fastpath"] = False
+    return build_worker_stack(
+        wspec,
+        ctx.host_name(),
+        mesh=ctx.mesh,
+        mesh_device_rules=device_rules,
+    )
+
+
+def wire_pod_peers(tier: PodTier, cache) -> None:
+    """Bind the leader's PeerBackedCache to the pod: followers' caches
+    are the peers, reached through the control handles (which duck-type
+    peer_get/gossip_in). One shared plane means one wire state — every
+    record validates against the same content tokens everywhere."""
+    if cache is None or not tier.handles:
+        return
+    from ..fanout.peers import PeerNet
+
+    net = PeerNet(path="authorization")
+    for h in tier.handles.values():
+        net.register(h.worker_id, h)
+    cache.bind(net, tier.ctx.host_name(), order_fn=None)
+
+
+__all__ = [
+    "PodIncoherentError",
+    "PodRuntime",
+    "PodTier",
+    "build_pod_stack",
+    "collective_bits",
+    "collective_match",
+    "follower_handler",
+    "wire_pod_peers",
+]

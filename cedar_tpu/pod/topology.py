@@ -1,0 +1,174 @@
+"""Pod mesh topology: who owns what on a multi-host slice.
+
+Pure arrangement math — no jax import, so the fast unit tests
+(tests/test_pod.py) pin the ownership properties without a distributed
+runtime. The pod's correctness story leans on one invariant:
+
+  **every policy-axis column of the device grid lives on exactly one
+  host** (policy-exclusive arrangement) — then `shard_partition` maps an
+  edited (tier, bucket) shard to one partition, the partition to one
+  column, the column to one host, and a dirty-shard reload performs its
+  H2D re-upload on that host ONLY (PartitionedPlanes filters placement
+  to addressable devices; placement_transfer_count pins it per host).
+
+The throughput shape flips the exclusivity to the data axis instead —
+each host owns whole batch rows, so request sharding never splits a
+row across hosts. `arrange` picks whichever exclusivity the requested
+(data, policy) factorization admits, preferring policy-exclusive.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_CONTROL_PORT = 17341
+
+
+class PodTopologyError(ValueError):
+    """The requested (data, policy) shape cannot be arranged with either
+    axis host-exclusive on this device set."""
+
+
+@dataclass(frozen=True)
+class PodConfig:
+    """One process's pod coordinates (flags/env; cli/webhook.py maps
+    --pod-coordinator/--pod-process-id/--pod-num-processes here).
+    ``local_devices`` simulates a host's device count on the cpu platform
+    (XLA_FLAGS=--xla_force_host_platform_device_count); None keeps the
+    platform's real count. ``mesh_shape`` is the explicit (data, policy)
+    factorization of the GLOBAL device set; None defaults to
+    (devices_per_host, num_processes) — rule capacity scales with hosts,
+    partitions stay host-exclusive."""
+
+    coordinator: str = ""
+    num_processes: int = 1
+    process_id: int = 0
+    control: str = ""  # leader's control channel, "host:port"
+    local_devices: Optional[int] = None
+    mesh_shape: Optional[Tuple[int, int]] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_id == 0
+
+    def control_addr(self) -> Tuple[str, int]:
+        host, _, port = (self.control or "").partition(":")
+        return (host or "127.0.0.1", int(port or DEFAULT_CONTROL_PORT))
+
+
+def pod_config_from_env(env) -> Optional[PodConfig]:
+    """CEDAR_POD_* environment form of the flags (spawned workers and
+    anything that cannot thread argv). None when no pod is configured."""
+    n = int(env.get("CEDAR_POD_NUM_PROCESSES", "0") or 0)
+    if n <= 0:
+        return None
+    shape = None
+    raw = env.get("CEDAR_POD_MESH_SHAPE", "")
+    if raw:
+        d, _, p = raw.lower().partition("x")
+        shape = (int(d), int(p))
+    ld = env.get("CEDAR_POD_LOCAL_DEVICES", "")
+    return PodConfig(
+        coordinator=env.get("CEDAR_POD_COORDINATOR", "127.0.0.1:7476"),
+        num_processes=n,
+        process_id=int(env.get("CEDAR_POD_PROCESS_ID", "0") or 0),
+        control=env.get("CEDAR_POD_CONTROL", ""),
+        local_devices=int(ld) if ld else None,
+        mesh_shape=shape,
+    )
+
+
+def default_pod_shape(n_devices: int, num_processes: int) -> Tuple[int, int]:
+    """(data, policy) = (devices per host, hosts): the policy axis spans
+    the pod so rule capacity scales with the slice, the data axis shards
+    batches across each host's local chips, and every policy partition is
+    host-exclusive (the dirty-reupload addressing property)."""
+    if n_devices % num_processes:
+        raise PodTopologyError(
+            f"{n_devices} devices do not divide over {num_processes} hosts"
+        )
+    return (n_devices // num_processes, num_processes)
+
+
+def arrange(
+    n_devices: int, num_processes: int, shape: Tuple[int, int]
+) -> Tuple[List[List[int]], str]:
+    """Device-INDEX grid [data][policy] for devices sorted host-major
+    (process_index, then id), plus which axis came out host-exclusive
+    ("policy" | "data"). Pure — bootstrap applies it to real devices,
+    tests to integers."""
+    data, policy = shape
+    if data * policy != n_devices:
+        raise PodTopologyError(
+            f"mesh shape {shape} needs {data * policy} devices, "
+            f"have {n_devices}"
+        )
+    if n_devices % num_processes:
+        raise PodTopologyError(
+            f"{n_devices} devices do not divide over {num_processes} hosts"
+        )
+    per_host = n_devices // num_processes
+    idx = list(range(n_devices))
+    if per_host % data == 0:
+        # column g <- devices [g*data, (g+1)*data): contiguous host-major,
+        # within one host because data divides the per-host count
+        grid = [[idx[g * data + r] for g in range(policy)] for r in range(data)]
+        return grid, "policy"
+    if per_host % policy == 0:
+        # row r <- devices [r*policy, (r+1)*policy): host-exclusive rows
+        grid = [[idx[r * policy + g] for g in range(policy)] for r in range(data)]
+        return grid, "data"
+    raise PodTopologyError(
+        f"shape {shape} leaves neither axis host-exclusive with "
+        f"{per_host} devices/host"
+    )
+
+
+def grid_partition_hosts(
+    grid: Sequence[Sequence[int]], per_host: int
+) -> Dict[int, Tuple[int, ...]]:
+    """Policy column -> owning host(s) for an index grid (host of device
+    i = i // per_host). Policy-exclusive arrangements yield singleton
+    tuples — the property the pod's dirty-upload addressing rests on."""
+    out: Dict[int, Tuple[int, ...]] = {}
+    n_pol = len(grid[0])
+    for g in range(n_pol):
+        hosts = {row[g] // per_host for row in grid}
+        out[g] = tuple(sorted(hosts))
+    return out
+
+
+@dataclass
+class PodContext:
+    """Everything a process knows about the pod it belongs to, after
+    bootstrap: its coordinates, the global mesh, and the ownership map.
+    ``partition_hosts`` maps policy partition -> owning process indexes
+    (singletons under the default arrangement)."""
+
+    config: PodConfig
+    mesh: object  # jax.sharding.Mesh — typed loosely to keep this pure
+    num_processes: int
+    process_id: int
+    local_device_count: int
+    exclusive_axis: str
+    partition_hosts: Dict[int, Tuple[int, ...]] = field(default_factory=dict)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_id == 0
+
+    def host_name(self, pid: Optional[int] = None) -> str:
+        return f"pod-{self.process_id if pid is None else pid}"
+
+
+__all__ = [
+    "DEFAULT_CONTROL_PORT",
+    "PodConfig",
+    "PodContext",
+    "PodTopologyError",
+    "arrange",
+    "default_pod_shape",
+    "grid_partition_hosts",
+    "pod_config_from_env",
+]

@@ -295,6 +295,7 @@ class WebhookServer:
         admission_fastpath=None,
         fleet=None,
         fanout=None,
+        pod=None,
         batch_window_s: float = 0.0002,
         max_batch: int = 8192,
         request_timeout_s: Optional[float] = None,
@@ -366,6 +367,12 @@ class WebhookServer:
         # interpreter fallback for FanoutUnavailable. Mutually exclusive
         # with an outer fleet by construction (the CLI enforces it).
         self.fanout = fanout
+        # multi-host pod tier (cedar_tpu/pod): the PodTier over this
+        # host's engine when the process is a pod leader — serving still
+        # flows through the ordinary engine paths (engine.pod routes
+        # mesh launches through the collective); this reference only
+        # feeds /debug/pod
+        self.pod = pod
         # native SAR fast path (engine/fastpath.py): request threads funnel
         # raw bodies through a micro-batcher into the C++ encoder + device
         # matcher; unavailable configurations fall back per request
@@ -1903,6 +1910,21 @@ class WebhookServer:
                     except Exception:  # noqa: BLE001 — debug must not 500
                         log.exception("fanout status failed")
                         doc = {"error": "fanout status failed"}
+                    self._send_json(doc)
+                elif self.path == "/debug/pod":
+                    # multi-host pod tier (cedar_tpu/pod, docs/fleet.md
+                    # "One mesh, many hosts"): per-host health + plane
+                    # tokens, policy-partition ownership, per-host swap
+                    # re-upload counts, and the pod coherence verdict;
+                    # 404 off-pod
+                    if server.pod is None:
+                        self.send_error(404)
+                        return
+                    try:
+                        doc = server.pod.status()
+                    except Exception:  # noqa: BLE001 — debug must not 500
+                        log.exception("pod status failed")
+                        doc = {"error": "pod status failed"}
                     self._send_json(doc)
                 elif self.path == "/debug/rollout":
                     # shadow-rollout state + decision-diff report

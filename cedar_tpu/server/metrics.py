@@ -15,7 +15,7 @@ reference leans on client_golang + component-base legacyregistry).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 SUBSYSTEM = "cedar_authorizer"
 
@@ -1176,6 +1176,29 @@ fanout_worker_restarts_total = REGISTRY.register(
     )
 )
 
+pod_hosts = REGISTRY.register(
+    Gauge(
+        "cedar_pod_hosts",
+        "Processes in this pod's one logical engine (jax.distributed "
+        "world size). 0/absent on single-host deployments; a value "
+        "below the deployed host count means part of the slice never "
+        "joined.",
+        [],
+    )
+)
+
+pod_partition_reuploads_total = REGISTRY.register(
+    Counter(
+        "cedar_pod_partition_reuploads_total",
+        "Dirty policy partitions re-uploaded per OWNING host by pod "
+        "barrier swaps. Under the policy-exclusive arrangement a "
+        "one-policy edit moves exactly one host's counter — several "
+        "hosts moving on one edit means shard->partition locality "
+        "regressed (docs/fleet.md).",
+        ["host"],
+    )
+)
+
 peer_cache_events_total = REGISTRY.register(
     Counter(
         "cedar_peer_cache_events_total",
@@ -1547,3 +1570,27 @@ def set_quarantined_objects(n: int) -> None:
 
 def record_chaos_injection(seam: str, kind: str) -> None:
     chaos_injections_total.inc(seam=seam, kind=kind)
+
+
+# pod identity (cedar_tpu/pod): which process of the multi-host engine
+# this is. None outside a pod; obs/trace.py and obs/audit.py stamp it on
+# root spans and audit lines next to the fanout `worker` label so one
+# request is attributable to a host even after log aggregation.
+_pod_process: Optional[int] = None
+
+
+def set_pod_process(process_id: int) -> None:
+    global _pod_process
+    _pod_process = int(process_id)
+
+
+def pod_process() -> Optional[int]:
+    return _pod_process
+
+
+def set_pod_hosts(n: int) -> None:
+    pod_hosts.set(n)
+
+
+def record_pod_reupload(host: str, n: int = 1) -> None:
+    pod_partition_reuploads_total.inc(n, host=host)

@@ -1,0 +1,272 @@
+"""Pod tier (cedar_tpu/pod): one logical policy plane across hosts.
+
+Two layers of coverage:
+
+  * fast unit tests — the pure topology/ownership math (arrange,
+    grid_partition_hosts, PodConfig env round-trip) and jaxenv's
+    distributed-init guard rails, no jax runtime or subprocesses;
+  * slow subprocess tests — REAL multi-process pods (pod/spawn.run_pod:
+    fresh interpreters, gloo CPU collectives, forced per-process device
+    counts) pinning the acceptance properties: the zero-flip
+    differential vs a single-host oracle (decisions AND reason sets),
+    the one-edit dirty-partition swap re-uploading on the owning host
+    only with zero fresh jit traces, bounded host-death failure, and
+    the bounded coordinator-refusal exit for a mis-wired worker.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+import pytest
+
+from cedar_tpu.pod.topology import (
+    PodConfig,
+    PodTopologyError,
+    arrange,
+    default_pod_shape,
+    grid_partition_hosts,
+    pod_config_from_env,
+)
+
+
+# ------------------------------------------------------------ topology math
+
+
+class TestArrange:
+    def test_default_shape_policy_axis_spans_hosts(self):
+        assert default_pod_shape(8, 4) == (2, 4)
+        assert default_pod_shape(4, 1) == (4, 1)
+
+    def test_default_shape_requires_divisibility(self):
+        with pytest.raises(PodTopologyError):
+            default_pod_shape(6, 4)
+
+    def test_policy_exclusive_arrangement(self):
+        # 4 hosts x 2 devices, shape (2, 4): every policy column must be
+        # one host's devices — the dirty-reupload addressing property
+        grid, exclusive = arrange(8, 4, (2, 4))
+        assert exclusive == "policy"
+        owners = grid_partition_hosts(grid, per_host=2)
+        assert owners == {0: (0,), 1: (1,), 2: (2,), 3: (3,)}
+        # every device appears exactly once
+        flat = sorted(d for row in grid for d in row)
+        assert flat == list(range(8))
+
+    def test_data_exclusive_arrangement(self):
+        # throughput shape (H, 1): rows are host-exclusive instead
+        grid, exclusive = arrange(4, 4, (4, 1))
+        assert exclusive == "data"
+        assert [row[0] // 1 for row in grid] == [0, 1, 2, 3]
+
+    def test_single_host_policy_split(self):
+        grid, exclusive = arrange(4, 1, (2, 2))
+        assert exclusive == "policy"
+        assert grid_partition_hosts(grid, per_host=4) == {0: (0,), 1: (0,)}
+
+    def test_impossible_shape_refused(self):
+        # 24 devices / 6 hosts = 4 per host; shape (8, 3): 4 % 8 != 0 and
+        # 4 % 3 != 0 — neither axis can be host-exclusive
+        with pytest.raises(PodTopologyError):
+            arrange(24, 6, (8, 3))
+
+    def test_wrong_device_count_refused(self):
+        with pytest.raises(PodTopologyError):
+            arrange(8, 2, (2, 3))
+
+
+class TestPodConfig:
+    def test_env_round_trip(self):
+        env = {
+            "CEDAR_POD_COORDINATOR": "10.0.0.1:7476",
+            "CEDAR_POD_NUM_PROCESSES": "4",
+            "CEDAR_POD_PROCESS_ID": "2",
+            "CEDAR_POD_CONTROL": "10.0.0.1:17341",
+            "CEDAR_POD_LOCAL_DEVICES": "2",
+            "CEDAR_POD_MESH_SHAPE": "2x4",
+        }
+        cfg = pod_config_from_env(env)
+        assert cfg == PodConfig(
+            coordinator="10.0.0.1:7476",
+            num_processes=4,
+            process_id=2,
+            control="10.0.0.1:17341",
+            local_devices=2,
+            mesh_shape=(2, 4),
+        )
+        assert not cfg.is_leader
+        assert cfg.control_addr() == ("10.0.0.1", 17341)
+
+    def test_no_pod_configured(self):
+        assert pod_config_from_env({}) is None
+        assert pod_config_from_env({"CEDAR_POD_NUM_PROCESSES": "0"}) is None
+
+    def test_leader_default_control(self):
+        cfg = PodConfig(coordinator="c:1", num_processes=2, process_id=0)
+        assert cfg.is_leader
+        host, port = cfg.control_addr()
+        assert host == "127.0.0.1" and port > 0
+
+
+# ----------------------------------------------------- distributed-init guard
+
+
+class TestDistributedInitGuards:
+    def test_out_of_range_process_id_is_immediate(self):
+        from cedar_tpu.jaxenv import DistributedInitError, distributed_initialize
+
+        with pytest.raises(DistributedInitError, match="out of range"):
+            distributed_initialize("127.0.0.1:1", 2, 2)
+        with pytest.raises(DistributedInitError, match="out of range"):
+            distributed_initialize("127.0.0.1:1", 2, -1)
+        with pytest.raises(DistributedInitError, match="out of range"):
+            distributed_initialize("127.0.0.1:1", 0, 0)
+
+    def test_conflicting_reinit_refused(self, monkeypatch):
+        from cedar_tpu import jaxenv
+
+        monkeypatch.setattr(
+            jaxenv, "_dist_params", ("127.0.0.1:9:", 2, 0)
+        )
+        # identical coordinates: idempotent no-op
+        assert (
+            jaxenv.distributed_initialize("127.0.0.1:9:", 2, 0) is False
+        )
+        # different coordinates: loud, typed, immediate
+        with pytest.raises(
+            jaxenv.DistributedInitError, match="refusing conflicting"
+        ):
+            jaxenv.distributed_initialize("127.0.0.1:9:", 2, 1)
+        with pytest.raises(jaxenv.DistributedInitError):
+            jaxenv.distributed_initialize("other:1", 2, 0)
+
+
+# ------------------------------------------------------------- subprocess pods
+
+
+def _run_pod(*args, **kw):
+    from cedar_tpu.pod.spawn import run_pod
+
+    return run_pod(*args, **kw)
+
+
+def _fail_text(r) -> str:
+    return (
+        f"error_type={r.error_type} error={r.error}\n"
+        f"--- leader log ---\n{r.log_tail(0, 40)}"
+    )
+
+
+@pytest.mark.slow
+class TestPodSubprocess:
+    SPEC = {"synth": {"n": 96, "seed": 0, "clusters": 2}}
+
+    def test_two_host_differential_zero_flips(self):
+        r = _run_pod(
+            2,
+            2,
+            "cedar_tpu.pod.drivers:differential",
+            self.SPEC,
+            driver_args={"bodies": 48, "rate_bodies": 16},
+            timeout_s=300,
+        )
+        assert r.ok, _fail_text(r)
+        assert r.result["process_count"] == 2
+        assert r.result["devices"] == 4
+        # decisions AND reason sets: _diff compares the full authorize
+        # triple, so a flip in either fails here
+        assert r.result["flips"] == 0, r.result["mismatch_sample"]
+        assert r.result["checked"] == 48
+        # the collective actually ran (not a local-engine shortcut)
+        assert r.result["evals"] > 0
+        status = r.result["status"]
+        assert status["coherent"] is True
+        assert {h["host"] for h in status["hosts"]} == {"pod-0", "pod-1"}
+        # default arrangement: every policy partition host-exclusive
+        for part in status["partitions"].values():
+            assert len(part["hosts"]) == 1
+
+    def test_one_edit_reuploads_owning_host_only(self):
+        r = _run_pod(
+            2,
+            2,
+            "cedar_tpu.pod.drivers:edit_swap",
+            self.SPEC,
+            driver_args={"warm_bodies": 16, "post_bodies": 32},
+            timeout_s=300,
+        )
+        assert r.ok, _fail_text(r)
+        res = r.result
+        assert res["dirty_shards"] == 1
+        assert res["compile_scope"] == "incremental"
+        # the H2D re-upload landed on exactly one host — the OWNER of
+        # the edited shard's partition; the other host moved zero bytes
+        assert len(res["reupload_hosts"]) == 1, res["transfers"]
+        zero_hosts = [h for h, n in res["transfers"].items() if n == 0]
+        assert len(zero_hosts) == 1
+        # no recompilation anywhere: the pjit step and kernels held
+        assert res["step_builds"] == 0
+        assert res["fresh_traces"] == 0
+        assert res["coherent"] is True
+        # post-edit differential vs the EDITED oracle
+        assert res["flips"] == 0, res["mismatch_sample"]
+
+    def test_host_death_bounded_refusal(self):
+        r = _run_pod(
+            2,
+            2,
+            "cedar_tpu.pod.drivers:host_death",
+            {"synth": {"n": 64, "seed": 0}},
+            timeout_s=300,
+        )
+        assert r.ok, _fail_text(r)
+        res = r.result
+        # the health scan must notice the silent death within its
+        # bounded window (interval * misses ~ 1s; 5s is generous), and
+        # every later collective refuses typed instead of hanging
+        assert res["detected_s"] is not None
+        assert res["detected_s"] < 5.0
+        assert res["refused"] is True
+        # the serving surface still answered (degraded, never hung)
+        assert res["post_death_error"] is None
+        assert res["post_death_latency_s"] < 5.0
+
+    def test_capacity_refused_at_one_host(self):
+        spec = {
+            "synth": {"n": 400, "seed": 0, "clusters": 2},
+            "mesh_device_rules": 320,
+            "cache": 0,
+        }
+        r = _run_pod(
+            1, 2, "cedar_tpu.pod.drivers:smoke", spec, timeout_s=300
+        )
+        assert not r.ok
+        assert r.error_type == "MeshCapacityError", _fail_text(r)
+        assert r.returncodes == [4]  # hostmain's typed build-refused exit
+
+    def test_miswired_worker_exits_nonzero_bounded(self):
+        # a worker pointed at a coordinator that will never answer must
+        # exit 3 (DistributedInitError) within its timeout — never hang
+        from cedar_tpu.pod.bootstrap import simulate_env
+        from cedar_tpu.pod.spawn import free_port
+        from cedar_tpu.pod.topology import PodConfig
+
+        cfg = PodConfig(
+            coordinator=f"127.0.0.1:{free_port()}",  # nobody listening
+            num_processes=2,
+            process_id=1,
+            control=f"127.0.0.1:{free_port()}",
+            local_devices=1,
+        )
+        env = simulate_env(cfg)
+        env["CEDAR_POD_INIT_TIMEOUT_S"] = "5"
+        proc = subprocess.run(
+            [sys.executable, "-m", "cedar_tpu.pod.hostmain"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 3, proc.stdout + proc.stderr
+        assert "bring-up refused" in (proc.stdout + proc.stderr)
